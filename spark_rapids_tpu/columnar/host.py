@@ -1,0 +1,204 @@
+"""Host-side columnar batches.
+
+The CPU twin of the device format: each column is a numpy data array plus a
+boolean validity array (True = valid), Arrow-style. Strings/binary use numpy
+object arrays on the host (the device side uses padded byte matrices, see
+device.py). This is what the CPU physical operators evaluate over, what file
+readers produce, and what `collect()` materializes — playing the role of
+Spark's UnsafeRow/ColumnarBatch world plus RapidsHostColumnVector
+(GpuColumnVector.java) in the reference.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.sql import types as T
+
+
+@dataclass
+class HostColumn:
+    """One column: `data` (numpy array) + `validity` (bool array).
+
+    Invalid slots hold an arbitrary-but-deterministic value (0 / "" / None)
+    so vectorized ops never see garbage.
+    """
+
+    dtype: T.DataType
+    data: np.ndarray
+    validity: np.ndarray  # bool, True = valid
+
+    def __post_init__(self):
+        assert len(self.data) == len(self.validity), (
+            f"{len(self.data)} != {len(self.validity)}")
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+    @property
+    def null_count(self) -> int:
+        return int((~self.validity).sum())
+
+    def to_pylist(self) -> List[Any]:
+        import decimal
+        out: List[Any] = []
+        is_bool = isinstance(self.dtype, T.BooleanType)
+        dec_scale = (self.dtype.scale
+                     if isinstance(self.dtype, T.DecimalType) else None)
+        for i in range(len(self.data)):
+            if not self.validity[i]:
+                out.append(None)
+            else:
+                v = self.data[i]
+                if isinstance(v, np.generic):
+                    v = v.item()
+                if is_bool:
+                    v = bool(v)
+                elif dec_scale is not None:
+                    v = decimal.Decimal(v).scaleb(-dec_scale)
+                out.append(v)
+        return out
+
+    def copy(self) -> "HostColumn":
+        return HostColumn(self.dtype, self.data.copy(), self.validity.copy())
+
+    def take(self, indices: np.ndarray) -> "HostColumn":
+        return HostColumn(self.dtype, self.data[indices],
+                          self.validity[indices])
+
+    def slice(self, start: int, end: int) -> "HostColumn":
+        return HostColumn(self.dtype, self.data[start:end],
+                          self.validity[start:end])
+
+    @staticmethod
+    def from_pylist(values: Sequence[Any], dtype: T.DataType) -> "HostColumn":
+        n = len(values)
+        validity = np.array([v is not None for v in values], dtype=bool)
+        np_dt = T.numpy_dtype(dtype)
+        if np_dt == np.dtype(object):
+            data = np.empty(n, dtype=object)
+            for i, v in enumerate(values):
+                data[i] = v if v is not None else ""
+        else:
+            fill = _zero_for(dtype)
+            data = np.array(
+                [fill if v is None else _to_storage(v, dtype)
+                 for v in values], dtype=np_dt)
+        return HostColumn(dtype, data, validity)
+
+    @staticmethod
+    def all_valid(data: np.ndarray, dtype: T.DataType) -> "HostColumn":
+        return HostColumn(dtype, data, np.ones(len(data), dtype=bool))
+
+    @staticmethod
+    def nulls(n: int, dtype: T.DataType) -> "HostColumn":
+        np_dt = T.numpy_dtype(dtype)
+        if np_dt == np.dtype(object):
+            data = np.full(n, "", dtype=object)
+        else:
+            data = np.zeros(n, dtype=np_dt)
+        return HostColumn(dtype, data, np.zeros(n, dtype=bool))
+
+    def normalized(self) -> "HostColumn":
+        """Zero out invalid slots for deterministic comparison/hashing."""
+        out = self.copy()
+        inv = ~out.validity
+        if out.data.dtype == np.dtype(object):
+            out.data[inv] = ""
+        else:
+            out.data[inv] = _zero_for(self.dtype)
+        return out
+
+
+def _zero_for(dtype: T.DataType) -> Any:
+    if isinstance(dtype, T.BooleanType):
+        return False
+    if isinstance(dtype, (T.FloatType, T.DoubleType)):
+        return 0.0
+    return 0
+
+
+def _to_storage(v: Any, dtype: T.DataType) -> Any:
+    import datetime
+    import decimal
+    if isinstance(dtype, T.DateType) and isinstance(v, datetime.date):
+        return (v - datetime.date(1970, 1, 1)).days
+    if isinstance(dtype, T.TimestampType) and isinstance(v, datetime.datetime):
+        epoch = datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc)
+        if v.tzinfo is None:
+            v = v.replace(tzinfo=datetime.timezone.utc)
+        return int((v - epoch).total_seconds() * 1_000_000)
+    if isinstance(dtype, T.DecimalType):
+        # unscaled int64 storage (DECIMAL64): value * 10^scale
+        d = v if isinstance(v, decimal.Decimal) else decimal.Decimal(str(v))
+        q = d.quantize(decimal.Decimal(1).scaleb(-dtype.scale),
+                       rounding=decimal.ROUND_HALF_UP)
+        return int(q.scaleb(dtype.scale))
+    return v
+
+
+@dataclass
+class HostBatch:
+    """A batch of rows as host columns; the CPU ColumnarBatch."""
+
+    schema: T.StructType
+    columns: List[HostColumn]
+    num_rows: int
+
+    def __post_init__(self):
+        for c in self.columns:
+            assert len(c) == self.num_rows
+
+    @property
+    def num_cols(self) -> int:
+        return len(self.columns)
+
+    def column(self, i: int) -> HostColumn:
+        return self.columns[i]
+
+    def to_pydict(self) -> dict:
+        return {f.name: c.to_pylist()
+                for f, c in zip(self.schema.fields, self.columns)}
+
+    def rows(self) -> Iterator[Tuple]:
+        cols = [c.to_pylist() for c in self.columns]
+        for i in range(self.num_rows):
+            yield tuple(col[i] for col in cols)
+
+    def take(self, indices: np.ndarray) -> "HostBatch":
+        return HostBatch(self.schema, [c.take(indices) for c in self.columns],
+                         len(indices))
+
+    def slice(self, start: int, end: int) -> "HostBatch":
+        end = min(end, self.num_rows)
+        return HostBatch(self.schema,
+                         [c.slice(start, end) for c in self.columns],
+                         max(0, end - start))
+
+    @staticmethod
+    def empty(schema: T.StructType) -> "HostBatch":
+        return HostBatch(schema,
+                         [HostColumn.nulls(0, f.data_type) for f in schema],
+                         0)
+
+    @staticmethod
+    def from_pydict(data: dict, schema: T.StructType) -> "HostBatch":
+        cols = [HostColumn.from_pylist(data[f.name], f.data_type)
+                for f in schema.fields]
+        n = cols[0].__len__() if cols else 0
+        return HostBatch(schema, cols, n)
+
+    @staticmethod
+    def concat(batches: Sequence["HostBatch"]) -> "HostBatch":
+        """Host-side Table.concatenate."""
+        assert batches
+        schema = batches[0].schema
+        cols = []
+        for i, f in enumerate(schema.fields):
+            data = np.concatenate([b.columns[i].data for b in batches])
+            val = np.concatenate([b.columns[i].validity for b in batches])
+            cols.append(HostColumn(f.data_type, data, val))
+        return HostBatch(schema, cols, sum(b.num_rows for b in batches))

@@ -1,0 +1,303 @@
+"""Typed configuration registry.
+
+Equivalent of the reference's RapidsConf (sql-plugin RapidsConf.scala:301-1400):
+a DSL of typed config entries under ``spark.rapids.*`` with docs, defaults,
+startup-vs-runtime distinction, and markdown doc generation
+(RapidsConf.scala's `help`/docs generation for docs/configs.md).
+
+Per-operator enable keys (``spark.rapids.sql.exec.<Op>``,
+``spark.rapids.sql.expression.<Expr>``) are auto-derived by the rule registry
+in overrides.py, mirroring ReplacementRule.confKey (GpuOverrides.scala:147).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclass
+class ConfEntry:
+    """One typed config entry. Mirrors RapidsConf's ConfEntry builders."""
+
+    key: str
+    doc: str
+    default: Any
+    converter: Callable[[str], Any]
+    is_startup: bool = False
+    is_internal: bool = False
+
+    def get(self, conf: Dict[str, str]) -> Any:
+        raw = conf.get(self.key)
+        if raw is None:
+            return self.default
+        if isinstance(raw, str):
+            return self.converter(raw)
+        return raw
+
+
+_REGISTRY: Dict[str, ConfEntry] = {}
+
+
+def _to_bool(s: str) -> bool:
+    return s.strip().lower() in ("true", "1", "yes")
+
+
+class _Builder:
+    """conf("key").doc(...).booleanConf.createWithDefault(x) style DSL
+    (RapidsConf.scala:103-240)."""
+
+    def __init__(self, key: str):
+        self._key = key
+        self._doc = ""
+        self._startup = False
+        self._internal = False
+
+    def doc(self, text: str) -> "_Builder":
+        self._doc = text
+        return self
+
+    def startup_only(self) -> "_Builder":
+        self._startup = True
+        return self
+
+    def internal(self) -> "_Builder":
+        self._internal = True
+        return self
+
+    def _create(self, default: Any, conv: Callable[[str], Any]) -> ConfEntry:
+        e = ConfEntry(self._key, self._doc, default, conv, self._startup,
+                      self._internal)
+        if self._key in _REGISTRY:
+            raise ValueError(f"duplicate conf key {self._key}")
+        _REGISTRY[self._key] = e
+        return e
+
+    def boolean(self, default: bool) -> ConfEntry:
+        return self._create(default, _to_bool)
+
+    def integer(self, default: int) -> ConfEntry:
+        return self._create(default, int)
+
+    def long(self, default: int) -> ConfEntry:
+        return self._create(default, int)
+
+    def double(self, default: float) -> ConfEntry:
+        return self._create(default, float)
+
+    def string(self, default: Optional[str]) -> ConfEntry:
+        return self._create(default, str)
+
+    def bytes(self, default: int) -> ConfEntry:
+        return self._create(default, parse_bytes)
+
+
+def conf(key: str) -> _Builder:
+    return _Builder(key)
+
+
+def parse_bytes(s: str) -> int:
+    """Parse '512m', '16g' style byte sizes (ConfHelper byteFromString)."""
+    s = s.strip().lower()
+    mult = 1
+    for suffix, m in (("k", 1 << 10), ("m", 1 << 20), ("g", 1 << 30),
+                      ("t", 1 << 40), ("b", 1)):
+        if s.endswith(suffix):
+            mult = m
+            s = s[: -len(suffix)]
+            break
+    return int(float(s) * mult)
+
+
+# ---------------------------------------------------------------------------
+# Core entries (subset of the reference's 122 spark.rapids.* keys;
+# RapidsConf.scala:301 onward). Grown as features land.
+# ---------------------------------------------------------------------------
+
+SQL_ENABLED = conf("spark.rapids.sql.enabled").doc(
+    "Enable (true) or disable (false) TPU acceleration of SQL plans. "
+    "(RapidsConf.scala SQL_ENABLED)").boolean(True)
+
+EXPLAIN = conf("spark.rapids.sql.explain").doc(
+    "Explain why parts of a query were or were not placed on the TPU: "
+    "NONE, ALL, or NOT_ON_GPU (GpuOverrides.scala:3609-3616).").string("NONE")
+
+CONCURRENT_TPU_TASKS = conf("spark.rapids.sql.concurrentGpuTasks").doc(
+    "Number of tasks that may use the TPU concurrently; bounds HBM pressure "
+    "(GpuSemaphore.scala:27).").integer(2)
+
+BATCH_SIZE_BYTES = conf("spark.rapids.sql.batchSizeBytes").doc(
+    "Target size in bytes of columnar batches fed to TPU operators "
+    "(RapidsConf.scala GPU_BATCH_SIZE_BYTES).").bytes(128 << 20)
+
+BATCH_SIZE_ROWS = conf("spark.rapids.sql.batchSizeRows").doc(
+    "Target row capacity of a device columnar batch. Static XLA shapes are "
+    "derived by bucketing row counts up to this ceiling.").integer(1 << 20)
+
+MAX_READER_BATCH_SIZE_ROWS = conf(
+    "spark.rapids.sql.reader.batchSizeRows").doc(
+    "Soft cap on rows per batch produced by file readers "
+    "(RapidsConf.scala MAX_READER_BATCH_SIZE_ROWS).").integer(1 << 20)
+
+HAS_NANS = conf("spark.rapids.sql.hasNans").doc(
+    "Assume floating point data may contain NaN; affects agg/join support "
+    "(RapidsConf.scala HAS_NANS).").boolean(True)
+
+ENABLE_FLOAT_AGG = conf("spark.rapids.sql.variableFloatAgg.enabled").doc(
+    "Allow float aggregations whose result can differ from CPU due to "
+    "ordering (RapidsConf.scala ENABLE_FLOAT_AGG).").boolean(True)
+
+INCOMPATIBLE_OPS = conf("spark.rapids.sql.incompatibleOps.enabled").doc(
+    "Enable ops that are not 100%% compatible with Spark semantics "
+    "(RapidsConf.scala INCOMPATIBLE_OPS).").boolean(False)
+
+IMPROVED_FLOAT_OPS = conf("spark.rapids.sql.improvedFloatOps.enabled").doc(
+    "Enable float ops that differ in edge rounding from the CPU "
+    "(RapidsConf.scala).").boolean(False)
+
+ANSI_ENABLED = conf("spark.sql.ansi.enabled").doc(
+    "ANSI SQL mode: overflow/invalid-cast raise instead of null/wrap "
+    "(Spark conf honored by the rewrite like GpuOverrides does).").boolean(False)
+
+CASE_SENSITIVE = conf("spark.sql.caseSensitive").doc(
+    "Case sensitivity of column resolution (Spark SQLConf).").boolean(False)
+
+SESSION_TIMEZONE = conf("spark.sql.session.timeZone").doc(
+    "Session timezone for timestamp/date expressions.").string("UTC")
+
+SHUFFLE_PARTITIONS = conf("spark.sql.shuffle.partitions").doc(
+    "Default partition count for exchanges (Spark SQLConf).").integer(8)
+
+METRICS_LEVEL = conf("spark.rapids.sql.metrics.level").doc(
+    "ESSENTIAL, MODERATE or DEBUG op metric verbosity "
+    "(RapidsConf.scala:491, GpuExec.scala:17-103).").string("MODERATE")
+
+CPU_RANGE_PARTITIONING = conf(
+    "spark.rapids.sql.rangePartitioning.sampleOnCpu").internal().doc(
+    "Sample range-partition bounds on CPU (GpuRangePartitioner).").boolean(True)
+
+DEVICE_MEMORY_LIMIT = conf("spark.rapids.memory.tpu.poolSize").doc(
+    "HBM budget (bytes) managed by the device store; 0 = 80%% of the "
+    "device's reported memory (GpuDeviceManager.initializeRmm, "
+    "GpuDeviceManager.scala:216).").startup_only().bytes(0)
+
+HOST_SPILL_STORAGE_SIZE = conf("spark.rapids.memory.host.spillStorageSize").doc(
+    "Bytes of host memory used to spill device batches before disk "
+    "(RapidsConf.scala HOST_SPILL_STORAGE_SIZE).").startup_only().bytes(1 << 30)
+
+SPILL_DIR = conf("spark.rapids.memory.spillDirectory").doc(
+    "Directory for the disk spill tier (RapidsDiskStore).").string("/tmp/srt_spill")
+
+MEMORY_DEBUG = conf("spark.rapids.memory.tpu.debug").doc(
+    "Log device allocation/free events (RapidsConf.scala:307).").boolean(False)
+
+SHUFFLE_TRANSPORT = conf("spark.rapids.shuffle.transport.mode").doc(
+    "Shuffle transport: HOST (serialize to host, sort-shuffle style), "
+    "ICI (device-resident all-to-all over the mesh; the UCX analogue, "
+    "SURVEY.md 2.3), or AUTO.").string("AUTO")
+
+SHUFFLE_COMPRESSION_CODEC = conf("spark.rapids.shuffle.compression.codec").doc(
+    "Codec for shuffle payloads on the host-staged path: none, lz4 "
+    "(TableCompressionCodec framework analogue).").string("none")
+
+STABLE_SORT = conf("spark.rapids.sql.stableSort.enabled").doc(
+    "Force stable sorts (RapidsConf.scala STABLE_SORT).").boolean(False)
+
+ALLOW_DISABLE_ENTIRE_PLAN = conf(
+    "spark.rapids.allowDisableEntirePlan").internal().doc(
+    "Allow the rewrite to bail out entirely when the whole plan would fall "
+    "back (GpuOverrides).").boolean(True)
+
+CBO_ENABLED = conf("spark.rapids.sql.optimizer.enabled").doc(
+    "Cost-based optimizer: revert subtrees to CPU when transition costs "
+    "outweigh speedup (CostBasedOptimizer.scala:52). Off by default, as in "
+    "the reference.").boolean(False)
+
+TEST_FORCE_DEVICE = conf("spark.rapids.sql.test.forceDevice").internal().doc(
+    "Testing: fail instead of falling back to CPU when an op is "
+    "unsupported (integration test TEST_CONF analogue).").boolean(False)
+
+UDF_COMPILER_ENABLED = conf("spark.rapids.sql.udfCompiler.enabled").doc(
+    "Compile Python lambda UDFs to Catalyst-style expressions "
+    "(udf-compiler/ Plugin.scala:27-37).").boolean(False)
+
+PARQUET_READER_TYPE = conf("spark.rapids.sql.format.parquet.reader.type").doc(
+    "PERFILE, MULTITHREADED or COALESCING parquet reader strategy "
+    "(RapidsConf.scala:719-733).").string("MULTITHREADED")
+
+MULTITHREADED_READ_NUM_THREADS = conf(
+    "spark.rapids.sql.format.parquet.multiThreadedRead.numThreads").doc(
+    "Thread pool size for the multithreaded reader "
+    "(GpuMultiFileReader.scala:300).").integer(8)
+
+
+class TpuConf:
+    """Bound view over a conf dict; the RapidsConf class equivalent.
+
+    Usage: ``TpuConf({"spark.rapids.sql.enabled": "true"}).get(SQL_ENABLED)``
+    or attribute-style helpers below.
+    """
+
+    def __init__(self, settings: Optional[Dict[str, Any]] = None):
+        self.settings: Dict[str, Any] = dict(settings or {})
+
+    def get(self, entry: ConfEntry) -> Any:
+        return entry.get(self.settings)
+
+    def get_key(self, key: str, default: Any = None) -> Any:
+        e = _REGISTRY.get(key)
+        if e is not None:
+            return e.get(self.settings)
+        return self.settings.get(key, default)
+
+    def set(self, key: str, value: Any) -> None:
+        self.settings[key] = value
+
+    def is_op_enabled(self, conf_key: str, default: bool = True) -> bool:
+        raw = self.settings.get(conf_key)
+        if raw is None:
+            return default
+        return raw if isinstance(raw, bool) else _to_bool(str(raw))
+
+    # Frequently used helpers
+    @property
+    def sql_enabled(self) -> bool:
+        return self.get(SQL_ENABLED)
+
+    @property
+    def batch_size_rows(self) -> int:
+        return self.get(BATCH_SIZE_ROWS)
+
+    @property
+    def batch_size_bytes(self) -> int:
+        return self.get(BATCH_SIZE_BYTES)
+
+    @property
+    def ansi_enabled(self) -> bool:
+        return self.get(ANSI_ENABLED)
+
+    @property
+    def shuffle_partitions(self) -> int:
+        return int(self.get(SHUFFLE_PARTITIONS))
+
+    @property
+    def explain(self) -> str:
+        return str(self.get(EXPLAIN)).upper()
+
+
+def registered_entries() -> List[ConfEntry]:
+    return list(_REGISTRY.values())
+
+
+def generate_docs() -> str:
+    """Markdown config table; the docs/configs.md generator equivalent
+    (RapidsConf.scala `help`)."""
+    lines = ["# spark-rapids-tpu configuration", "",
+             "| Key | Default | Startup | Description |",
+             "|---|---|---|---|"]
+    for e in sorted(_REGISTRY.values(), key=lambda e: e.key):
+        if e.is_internal:
+            continue
+        lines.append(
+            f"| {e.key} | {e.default} | {e.is_startup} | {e.doc} |")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,13 @@
+"""TpuOverrides: the plan-rewrite engine (GpuOverrides.scala equivalent).
+
+Placeholder entry point while the meta/typesig framework lands; currently
+returns the CPU plan unchanged.
+"""
+
+from __future__ import annotations
+
+from spark_rapids_tpu.conf import TpuConf
+
+
+def apply_overrides(physical, conf: TpuConf):
+    return physical

@@ -1,0 +1,48 @@
+"""RAII-style resource helpers.
+
+Equivalent of the reference's `Arm` trait (sql-plugin Arm.scala:23):
+withResource/closeOnExcept used pervasively to tie device buffer lifetime to
+scopes. JAX arrays are GC-managed, but the spill catalog and host buffers
+still need deterministic release, and the idiom keeps operator code shaped
+like the reference's.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Iterable, Iterator, TypeVar
+
+T = TypeVar("T")
+
+
+def _close(r: Any) -> None:
+    close = getattr(r, "close", None)
+    if callable(close):
+        close()
+
+
+@contextlib.contextmanager
+def with_resource(resource: T) -> Iterator[T]:
+    """Close `resource` (or each element if iterable of closables) on exit."""
+    try:
+        yield resource
+    finally:
+        if isinstance(resource, (list, tuple)):
+            for r in resource:
+                _close(r)
+        else:
+            _close(resource)
+
+
+@contextlib.contextmanager
+def close_on_except(resource: T) -> Iterator[T]:
+    """Close `resource` only if the body raises (Arm.closeOnExcept)."""
+    try:
+        yield resource
+    except BaseException:
+        if isinstance(resource, (list, tuple)):
+            for r in resource:
+                _close(r)
+        else:
+            _close(resource)
+        raise

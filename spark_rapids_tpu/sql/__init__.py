@@ -1,0 +1,10 @@
+"""The host SQL engine the plugin accelerates.
+
+In the reference, Apache Spark provides this layer unmodified (SURVEY.md L7+
+'Spark SQL (unmodified)'); here it is part of the framework: Catalyst-like
+expressions, logical plans, a DataFrame API, and CPU physical operators that
+implement Spark semantics and serve as the bit-identical baseline and the
+per-op fallback target.
+"""
+
+from spark_rapids_tpu.sql.session import TpuSparkSession  # noqa: F401

@@ -1,0 +1,393 @@
+"""DataFrame API (pyspark.sql.DataFrame shape) over logical plans."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Union
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.host import HostBatch
+from spark_rapids_tpu.sql import expressions as E
+from spark_rapids_tpu.sql import logical as L
+from spark_rapids_tpu.sql import types as T
+from spark_rapids_tpu.sql.functions import Column, _to_expr
+
+
+class Row(tuple):
+    """Lightweight named row."""
+
+    def __new__(cls, values, names):
+        r = super().__new__(cls, values)
+        r._names = list(names)
+        return r
+
+    def __getattr__(self, name):
+        try:
+            return self[self._names.index(name)]
+        except ValueError:
+            raise AttributeError(name)
+
+    def asDict(self):
+        return dict(zip(self._names, self))
+
+    def __repr__(self):
+        inner = ", ".join(f"{n}={v!r}" for n, v in zip(self._names, self))
+        return f"Row({inner})"
+
+
+class DataFrame:
+    def __init__(self, plan: L.LogicalPlan, session):
+        self.plan = plan
+        self.session = session
+
+    # -- schema ------------------------------------------------------------
+    @property
+    def schema(self) -> T.StructType:
+        return self.plan.schema
+
+    @property
+    def columns(self) -> List[str]:
+        return [a.name for a in self.plan.output]
+
+    def _resolve(self, c: Union[Column, str, E.Expression]) -> E.Expression:
+        if isinstance(c, str):
+            if c == "*":
+                raise ValueError("* only valid inside select()")
+            expr: E.Expression = E.UnresolvedAttribute(c)
+        else:
+            expr = _to_expr(c)
+        case_sensitive = self.session.conf_obj.get_key(
+            "spark.sql.caseSensitive", False)
+        resolved = L.resolve(expr, self.plan.output,
+                             bool(case_sensitive))
+        return _coerce_resolved(resolved)
+
+    # -- transformations ---------------------------------------------------
+    def select(self, *cols) -> "DataFrame":
+        items: List[E.Expression] = []
+        for c in cols:
+            if isinstance(c, str) and c == "*":
+                items.extend(self.plan.output)
+                continue
+            e = self._resolve(c)
+            if not isinstance(e, (E.AttributeReference, E.Alias)):
+                e = E.Alias(e, _auto_name(e))
+            items.append(e)
+        return DataFrame(L.Project(items, self.plan), self.session)
+
+    def selectExpr(self, *exprs: str) -> "DataFrame":
+        from spark_rapids_tpu.sql.parser import parse_expression
+        cols = [parse_expression(s) for s in exprs]
+        return self.select(*[Column(c) for c in cols])
+
+    def withColumn(self, name: str, col: Column) -> "DataFrame":
+        e = self._resolve(col)
+        items: List[E.Expression] = []
+        replaced = False
+        for a in self.plan.output:
+            if a.name == name:
+                items.append(E.Alias(e, name))
+                replaced = True
+            else:
+                items.append(a)
+        if not replaced:
+            items.append(E.Alias(e, name))
+        return DataFrame(L.Project(items, self.plan), self.session)
+
+    def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
+        items = [a.renamed(new) if a.name == old else a
+                 for a in self.plan.output]
+        return DataFrame(L.Project(items, self.plan), self.session)
+
+    def drop(self, *names: str) -> "DataFrame":
+        keep = [a for a in self.plan.output if a.name not in names]
+        return DataFrame(L.Project(keep, self.plan), self.session)
+
+    def filter(self, condition: Union[Column, str]) -> "DataFrame":
+        if isinstance(condition, str):
+            from spark_rapids_tpu.sql.parser import parse_expression
+            condition = Column(parse_expression(condition))
+        cond = self._resolve(condition)
+        return DataFrame(L.Filter(cond, self.plan), self.session)
+
+    where = filter
+
+    def groupBy(self, *cols) -> "GroupedData":
+        grouping = [self._resolve(c) for c in cols]
+        return GroupedData(self, grouping)
+
+    def agg(self, *cols) -> "DataFrame":
+        return self.groupBy().agg(*cols)
+
+    def join(self, other: "DataFrame", on=None, how: str = "inner"
+             ) -> "DataFrame":
+        how = {"left_outer": "leftouter", "right_outer": "rightouter",
+               "full_outer": "fullouter", "semi": "leftsemi",
+               "anti": "leftanti", "left_semi": "leftsemi",
+               "left_anti": "leftanti", "outer": "fullouter"}.get(how, how)
+        # Self-join disambiguation (Spark's dedupRight): re-alias the right
+        # side with fresh expr_ids when the two sides share attribute ids.
+        left_ids = {a.expr_id for a in self.plan.output}
+        if any(a.expr_id in left_ids for a in other.plan.output):
+            other = DataFrame(
+                L.Project([E.Alias(a, a.name) for a in other.plan.output],
+                          other.plan), other.session)
+        cond: Optional[E.Expression] = None
+        using: List[str] = []
+        if on is not None:
+            if isinstance(on, str):
+                using = [on]
+            elif isinstance(on, (list, tuple)) and on and isinstance(
+                    on[0], str):
+                using = list(on)
+            elif isinstance(on, Column):
+                combined = list(self.plan.output) + list(other.plan.output)
+                cond = L.resolve(on.expr, combined)
+                cond = _coerce_resolved(cond)
+        if using:
+            conds = []
+            for name in using:
+                lc = L.resolve(E.UnresolvedAttribute(name),
+                               self.plan.output)
+                rc = L.resolve(E.UnresolvedAttribute(name),
+                               other.plan.output)
+                conds.append(E.EqualTo(lc, rc))
+            for c in conds:
+                cond = c if cond is None else E.And(cond, c)
+        joined = L.Join(self.plan, other.plan, how, cond)
+        df = DataFrame(joined, self.session)
+        if using and how not in ("leftsemi", "leftanti"):
+            # USING join: single key column, drop duplicate right-side keys
+            keep: List[E.Expression] = []
+            right_ids = set()
+            for name in using:
+                r = L.resolve(E.UnresolvedAttribute(name),
+                              other.plan.output)
+                right_ids.add(r.expr_id)
+            for a in joined.output:
+                if a.expr_id not in right_ids:
+                    keep.append(a)
+            df = DataFrame(L.Project(keep, joined), self.session)
+        return df
+
+    def crossJoin(self, other: "DataFrame") -> "DataFrame":
+        left_ids = {a.expr_id for a in self.plan.output}
+        if any(a.expr_id in left_ids for a in other.plan.output):
+            other = DataFrame(
+                L.Project([E.Alias(a, a.name) for a in other.plan.output],
+                          other.plan), other.session)
+        return DataFrame(L.Join(self.plan, other.plan, "cross", None),
+                         self.session)
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(L.Union([self.plan, other.plan]), self.session)
+
+    unionAll = union
+
+    def distinct(self) -> "DataFrame":
+        return DataFrame(
+            L.Aggregate(list(self.plan.output), list(self.plan.output),
+                        self.plan), self.session)
+
+    def dropDuplicates(self, subset: Optional[List[str]] = None
+                       ) -> "DataFrame":
+        if subset is None:
+            return self.distinct()
+        keys = [self._resolve(s) for s in subset]
+        aggs: List[E.Expression] = []
+        key_ids = {k.expr_id for k in keys
+                   if isinstance(k, E.AttributeReference)}
+        for a in self.plan.output:
+            if a.expr_id in key_ids:
+                aggs.append(a)
+            else:
+                aggs.append(E.Alias(
+                    E.AggregateExpression(E.First(a)), a.name))
+        return DataFrame(L.Aggregate(keys, aggs, self.plan), self.session)
+
+    def orderBy(self, *cols) -> "DataFrame":
+        order = self._sort_orders(cols)
+        return DataFrame(L.Sort(order, True, self.plan), self.session)
+
+    sort = orderBy
+
+    def sortWithinPartitions(self, *cols) -> "DataFrame":
+        order = self._sort_orders(cols)
+        return DataFrame(L.Sort(order, False, self.plan), self.session)
+
+    def _sort_orders(self, cols) -> List[E.SortOrder]:
+        order: List[E.SortOrder] = []
+        for c in cols:
+            e = self._resolve(c)
+            if isinstance(e, E.SortOrder):
+                order.append(e)
+            else:
+                order.append(E.SortOrder(e, ascending=True))
+        return order
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(L.Limit(n, self.plan), self.session)
+
+    def repartition(self, num: int, *cols) -> "DataFrame":
+        by = [self._resolve(c) for c in cols] if cols else None
+        return DataFrame(L.Repartition(num, True, self.plan, by),
+                         self.session)
+
+    def coalesce(self, num: int) -> "DataFrame":
+        return DataFrame(L.Repartition(num, False, self.plan), self.session)
+
+    # -- actions -----------------------------------------------------------
+    def _execute(self) -> HostBatch:
+        return self.session.execute_plan(self.plan)
+
+    def collect(self) -> List[Row]:
+        batch = self._execute()
+        names = [f.name for f in batch.schema.fields]
+        return [Row(r, names) for r in batch.rows()]
+
+    def count(self) -> int:
+        return int(self._execute().num_rows)
+
+    def toPandas(self):
+        import pandas as pd
+        return pd.DataFrame(self._execute().to_pydict())
+
+    def show(self, n: int = 20) -> None:
+        rows = self.limit(n).collect()
+        names = self.columns
+        widths = [max(len(str(x)) for x in [nm] + [r[i] for r in rows])
+                  for i, nm in enumerate(names)]
+        line = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+        print(line)
+        print("|" + "|".join(f" {nm:<{w}} "
+                             for nm, w in zip(names, widths)) + "|")
+        print(line)
+        for r in rows:
+            print("|" + "|".join(f" {str(v):<{w}} "
+                                 for v, w in zip(r, widths)) + "|")
+        print(line)
+
+    def explain(self, extended: bool = False) -> None:
+        print(self.session.explain_string(self.plan))
+
+    def createOrReplaceTempView(self, name: str) -> None:
+        self.session.catalog_views[name.lower()] = self.plan
+
+    @property
+    def write(self):
+        from spark_rapids_tpu.io.writers import DataFrameWriter
+        return DataFrameWriter(self)
+
+    def cache(self) -> "DataFrame":
+        from spark_rapids_tpu.io.cache import cache_plan
+        return DataFrame(cache_plan(self), self.session)
+
+    def __getitem__(self, name: str) -> Column:
+        return Column(self._resolve(name))
+
+    def __getattr__(self, name: str) -> Column:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name in self.columns:
+            return Column(self._resolve(name))
+        raise AttributeError(name)
+
+
+class GroupedData:
+    def __init__(self, df: DataFrame, grouping: List[E.Expression]):
+        self.df = df
+        self.grouping = grouping
+
+    def agg(self, *cols) -> DataFrame:
+        # Non-attribute grouping keys get a single shared Alias so the
+        # planner's pre-projection and the result column refer to the same
+        # attribute id (Spark aliases grouping expressions the same way).
+        grouping: List[E.Expression] = []
+        aggs: List[E.Expression] = []
+        for g in self.grouping:
+            if isinstance(g, E.AttributeReference):
+                grouping.append(g)
+                aggs.append(g)
+            else:
+                alias = E.Alias(g, _auto_name(g))
+                grouping.append(alias)
+                aggs.append(alias.to_attribute())
+        for c in cols:
+            e = self.df._resolve(c)
+            if not isinstance(e, (E.Alias, E.AttributeReference)):
+                e = E.Alias(e, _auto_name(e))
+            aggs.append(e)
+        return DataFrame(L.Aggregate(grouping, aggs, self.df.plan),
+                         self.df.session)
+
+    def count(self) -> DataFrame:
+        from spark_rapids_tpu.sql import functions as F
+        return self.agg(F.count("*").alias("count"))
+
+    def _simple(self, fn, *cols) -> DataFrame:
+        from spark_rapids_tpu.sql import functions as F
+        targets = cols or [a.name for a in self.df.plan.output
+                           if T.is_numeric(a.data_type)]
+        return self.agg(*[fn(F.col(c)).alias(f"{fn.__name__}({c})")
+                          for c in targets])
+
+    def sum(self, *cols) -> DataFrame:
+        from spark_rapids_tpu.sql import functions as F
+        return self._simple(F.sum, *cols)
+
+    def avg(self, *cols) -> DataFrame:
+        from spark_rapids_tpu.sql import functions as F
+        return self._simple(F.avg, *cols)
+
+    def min(self, *cols) -> DataFrame:
+        from spark_rapids_tpu.sql import functions as F
+        return self._simple(F.min, *cols)
+
+    def max(self, *cols) -> DataFrame:
+        from spark_rapids_tpu.sql import functions as F
+        return self._simple(F.max, *cols)
+
+
+def _auto_name(e: E.Expression) -> str:
+    if isinstance(e, E.AggregateExpression):
+        inner = ", ".join(_auto_name(c) for c in e.func.children)
+        return f"{e.func.pretty_name}({inner})"
+    if isinstance(e, E.AttributeReference):
+        return e.name
+    if isinstance(e, E.Literal):
+        return str(e.value)
+    if isinstance(e, E.Cast):
+        return _auto_name(e.child)
+    return repr(e)
+
+
+def _coerce_resolved(e: E.Expression) -> E.Expression:
+    """Post-resolution type coercion: insert casts on mismatched binary
+    ops (the TypeCoercion role)."""
+    from spark_rapids_tpu.sql.functions import _coerce_pair
+
+    def rule(node: E.Expression) -> Optional[E.Expression]:
+        if isinstance(node, (E.BinaryArithmetic, E.BinaryComparison)) and \
+                not isinstance(node, E.Divide):
+            try:
+                lt, rt = node.left.data_type, node.right.data_type
+            except Exception:
+                return None
+            if lt != rt:
+                a, b = _coerce_pair(node.left, node.right)
+                return type(node)(a, b)
+        if isinstance(node, E.Divide):
+            try:
+                lt, rt = node.left.data_type, node.right.data_type
+            except Exception:
+                return None
+            if not isinstance(lt, (T.DoubleType, T.DecimalType)) or \
+                    not isinstance(rt, (T.DoubleType, T.DecimalType)):
+                a = node.left if isinstance(lt, T.DoubleType) \
+                    else E.Cast(node.left, T.DoubleT)
+                b = node.right if isinstance(rt, T.DoubleType) \
+                    else E.Cast(node.right, T.DoubleT)
+                return E.Divide(a, b)
+        return None
+
+    return e.transform(rule)

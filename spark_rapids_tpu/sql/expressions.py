@@ -1,0 +1,1887 @@
+"""Catalyst-like expression trees with vectorized CPU evaluation.
+
+In the reference, Spark provides Catalyst expressions and the plugin mirrors
+231 of them as Gpu* case classes (SURVEY.md 2.2 'Expressions'). Here the
+expression tree itself is part of the framework; each node carries a
+vectorized CPU `eval` over HostBatch implementing *Spark* semantics
+(null propagation, two's-complement overflow wrap in non-ANSI mode,
+NaN-equals-NaN ordering, 3-valued logic), and the plugin layer
+(overrides.py) maps nodes to device implementations.
+
+CPU eval requires bound references (`bind_references`), exactly like Spark's
+BoundReference binding before codegen.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import murmur3
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+from spark_rapids_tpu.sql import types as T
+
+_expr_id = itertools.count(1)
+
+
+def next_expr_id() -> int:
+    return next(_expr_id)
+
+
+class Expression:
+    """Base expression node."""
+
+    children: List["Expression"]
+
+    @property
+    def data_type(self) -> T.DataType:
+        raise NotImplementedError(type(self).__name__)
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        raise NotImplementedError(
+            f"CPU eval not implemented for {type(self).__name__}")
+
+    @property
+    def pretty_name(self) -> str:
+        return type(self).__name__.lower()
+
+    def __repr__(self) -> str:
+        cs = ", ".join(repr(c) for c in self.children)
+        return f"{type(self).__name__}({cs})"
+
+    def transform(self, fn: Callable[["Expression"], Optional["Expression"]]
+                  ) -> "Expression":
+        """Bottom-up transform; fn returns replacement or None to keep."""
+        new_children = [c.transform(fn) for c in self.children]
+        node = self
+        if new_children != self.children:
+            node = node.with_children(new_children)
+        replaced = fn(node)
+        return replaced if replaced is not None else node
+
+    def with_children(self, children: List["Expression"]) -> "Expression":
+        import copy
+        node = copy.copy(self)
+        node.children = children
+        return node
+
+    def collect(self, pred: Callable[["Expression"], bool]
+                ) -> List["Expression"]:
+        out = []
+        if pred(self):
+            out.append(self)
+        for c in self.children:
+            out.extend(c.collect(pred))
+        return out
+
+    def references(self) -> List["AttributeReference"]:
+        return self.collect(lambda e: isinstance(e, AttributeReference))
+
+
+# ---------------------------------------------------------------------------
+# Leaves
+# ---------------------------------------------------------------------------
+
+class Literal(Expression):
+    def __init__(self, value: Any, dtype: Optional[T.DataType] = None):
+        self.children = []
+        if dtype is None:
+            dtype = _infer_literal_type(value)
+        self.value = value
+        self._dtype = dtype
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self.value is None
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        from spark_rapids_tpu.columnar.host import _to_storage
+        n = batch.num_rows
+        if self.value is None:
+            return HostColumn.nulls(n, self._dtype)
+        np_dt = T.numpy_dtype(self._dtype)
+        if np_dt == np.dtype(object):
+            data = np.full(n, self.value, dtype=object)
+        else:
+            data = np.full(n, _to_storage(self.value, self._dtype),
+                           dtype=np_dt)
+        return HostColumn.all_valid(data, self._dtype)
+
+    def __repr__(self) -> str:
+        return f"lit({self.value!r})"
+
+
+def _infer_literal_type(v: Any) -> T.DataType:
+    import datetime
+    if v is None:
+        return T.NullT
+    if isinstance(v, bool):
+        return T.BooleanT
+    if isinstance(v, int):
+        return T.IntegerT if -(2**31) <= v < 2**31 else T.LongT
+    if isinstance(v, float):
+        return T.DoubleT
+    if isinstance(v, str):
+        return T.StringT
+    if isinstance(v, bytes):
+        return T.BinaryT
+    if isinstance(v, datetime.datetime):
+        return T.TimestampT
+    if isinstance(v, datetime.date):
+        return T.DateT
+    import decimal
+    if isinstance(v, decimal.Decimal):
+        sign, digits, exp = v.as_tuple()
+        scale = -exp if exp < 0 else 0
+        return T.DecimalType(max(len(digits), scale), scale)
+    raise TypeError(f"cannot infer literal type for {v!r}")
+
+
+class AttributeReference(Expression):
+    """A resolved column with a unique id (Catalyst AttributeReference)."""
+
+    def __init__(self, name: str, dtype: T.DataType, nullable: bool = True,
+                 expr_id: Optional[int] = None):
+        self.children = []
+        self.name = name
+        self._dtype = dtype
+        self._nullable = nullable
+        self.expr_id = expr_id if expr_id is not None else next_expr_id()
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self._nullable
+
+    def __repr__(self) -> str:
+        return f"{self.name}#{self.expr_id}"
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, AttributeReference)
+                and other.expr_id == self.expr_id)
+
+    def __hash__(self) -> int:
+        return hash(("attr", self.expr_id))
+
+    def renamed(self, name: str) -> "AttributeReference":
+        return AttributeReference(name, self._dtype, self._nullable,
+                                  self.expr_id)
+
+
+class UnresolvedAttribute(Expression):
+    def __init__(self, name: str):
+        self.children = []
+        self.name = name
+
+    @property
+    def data_type(self) -> T.DataType:
+        raise RuntimeError(f"unresolved attribute {self.name}")
+
+    def __repr__(self) -> str:
+        return f"'{self.name}"
+
+
+class BoundReference(Expression):
+    """Column by ordinal after binding (Catalyst BoundReference)."""
+
+    def __init__(self, ordinal: int, dtype: T.DataType, nullable: bool):
+        self.children = []
+        self.ordinal = ordinal
+        self._dtype = dtype
+        self._nullable = nullable
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self._nullable
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        return batch.columns[self.ordinal]
+
+    def __repr__(self) -> str:
+        return f"input[{self.ordinal}]"
+
+
+class Alias(Expression):
+    def __init__(self, child: Expression, name: str,
+                 expr_id: Optional[int] = None):
+        self.children = [child]
+        self.name = name
+        self.expr_id = expr_id if expr_id is not None else next_expr_id()
+
+    @property
+    def child(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.child.data_type
+
+    @property
+    def nullable(self) -> bool:
+        return self.child.nullable
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        return self.child.eval(batch)
+
+    def to_attribute(self) -> AttributeReference:
+        return AttributeReference(self.name, self.data_type, self.nullable,
+                                  self.expr_id)
+
+    def __repr__(self) -> str:
+        return f"{self.child!r} AS {self.name}#{self.expr_id}"
+
+
+def named_output(expr: Expression) -> AttributeReference:
+    """Output attribute for a projection item (Catalyst NamedExpression)."""
+    if isinstance(expr, Alias):
+        return expr.to_attribute()
+    if isinstance(expr, AttributeReference):
+        return expr
+    raise TypeError(f"not a named expression: {expr!r}")
+
+
+def bind_references(expr: Expression, input_attrs: Sequence[AttributeReference]
+                    ) -> Expression:
+    ids = {a.expr_id: i for i, a in enumerate(input_attrs)}
+
+    def rule(e: Expression) -> Optional[Expression]:
+        if isinstance(e, AttributeReference):
+            if e.expr_id not in ids:
+                raise KeyError(f"couldn't bind {e!r} against {input_attrs}")
+            return BoundReference(ids[e.expr_id], e.data_type, e.nullable)
+        return None
+
+    return expr.transform(rule)
+
+
+# ---------------------------------------------------------------------------
+# Eval helpers
+# ---------------------------------------------------------------------------
+
+def _combined_validity(cols: Sequence[HostColumn]) -> np.ndarray:
+    v = cols[0].validity
+    for c in cols[1:]:
+        v = v & c.validity
+    return v.copy()
+
+
+class UnaryExpression(Expression):
+    @property
+    def child(self) -> Expression:
+        return self.children[0]
+
+
+class BinaryExpression(Expression):
+    @property
+    def left(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def right(self) -> Expression:
+        return self.children[1]
+
+
+# ---------------------------------------------------------------------------
+# Arithmetic (Spark semantics: null-propagating; non-ANSI ints wrap like
+# Java two's complement — numpy matches; see GpuAdd etc. in the reference's
+# arithmetic.scala)
+# ---------------------------------------------------------------------------
+
+class BinaryArithmetic(BinaryExpression):
+    symbol = "?"
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = [left, right]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.left.data_type
+
+    def op(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        lc = self.left.eval(batch)
+        rc = self.right.eval(batch)
+        validity = _combined_validity([lc, rc])
+        with np.errstate(all="ignore"):
+            data = self.op(lc.data, rc.data)
+        np_dt = T.numpy_dtype(self.data_type)
+        if data.dtype != np_dt:
+            data = data.astype(np_dt)
+        return HostColumn(self.data_type, data, validity).normalized()
+
+
+class Add(BinaryArithmetic):
+    symbol = "+"
+
+    def op(self, a, b):
+        return a + b
+
+
+class Subtract(BinaryArithmetic):
+    symbol = "-"
+
+    def op(self, a, b):
+        return a - b
+
+
+class Multiply(BinaryArithmetic):
+    symbol = "*"
+
+    def op(self, a, b):
+        return a * b
+
+
+class Divide(BinaryArithmetic):
+    """Fractional division (Spark analyzer casts ints to double first).
+    Spark non-ANSI returns NULL for a zero divisor on every numeric type
+    (unlike IEEE); ANSI raises."""
+    symbol = "/"
+
+    def op(self, a, b):
+        return np.divide(a, b)
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        if isinstance(self.data_type, T.DecimalType):
+            return _decimal_divide(self, batch)
+        lc = self.left.eval(batch)
+        rc = self.right.eval(batch)
+        validity = _combined_validity([lc, rc]) & (rc.data != 0)
+        with np.errstate(all="ignore"):
+            data = np.divide(lc.data, np.where(rc.data != 0, rc.data, 1))
+        np_dt = T.numpy_dtype(self.data_type)
+        if data.dtype != np_dt:
+            data = data.astype(np_dt)
+        return HostColumn(self.data_type, data, validity).normalized()
+
+
+class IntegralDivide(BinaryExpression):
+    """`div`: long division, null on divide-by-zero (Spark IntegralDivide)."""
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = [left, right]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.LongT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        lc, rc = self.left.eval(batch), self.right.eval(batch)
+        a = lc.data.astype(np.int64)
+        b = rc.data.astype(np.int64)
+        validity = _combined_validity([lc, rc]) & (b != 0)
+        with np.errstate(all="ignore"):
+            safe_b = np.where(b == 0, 1, b)
+            # Java integer division truncates toward zero; numpy floors.
+            q = np.abs(a) // np.abs(safe_b)
+            data = np.where((a < 0) != (safe_b < 0), -q, q).astype(np.int64)
+        return HostColumn(T.LongT, data, validity).normalized()
+
+
+class Remainder(BinaryArithmetic):
+    """% with Java sign semantics (follows dividend); x % 0 -> null for
+    all numeric types in Spark non-ANSI mode."""
+    symbol = "%"
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        lc, rc = self.left.eval(batch), self.right.eval(batch)
+        a, b = lc.data, rc.data
+        validity = _combined_validity([lc, rc]) & (b != 0)
+        with np.errstate(all="ignore"):
+            safe_b = np.where(b == 0, 1, b)
+            data = np.fmod(a, safe_b)
+        np_dt = T.numpy_dtype(self.data_type)
+        return HostColumn(self.data_type, data.astype(np_dt),
+                          validity).normalized()
+
+
+class Pmod(BinaryArithmetic):
+    symbol = "pmod"
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        lc, rc = self.left.eval(batch), self.right.eval(batch)
+        a, b = lc.data, rc.data
+        validity = _combined_validity([lc, rc])
+        with np.errstate(all="ignore"):
+            if np.issubdtype(a.dtype, np.integer):
+                validity = validity & (b != 0)
+                b = np.where(b == 0, 1, b)
+            r = np.fmod(a, b)
+            data = np.where((r != 0) & ((r < 0) != (b < 0)), r + b, r)
+        np_dt = T.numpy_dtype(self.data_type)
+        return HostColumn(self.data_type, data.astype(np_dt),
+                          validity).normalized()
+
+
+class UnaryMinus(UnaryExpression):
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.child.data_type
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.child.eval(batch)
+        with np.errstate(all="ignore"):
+            return HostColumn(self.data_type, -c.data, c.validity.copy())
+
+
+class Abs(UnaryExpression):
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.child.data_type
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.child.eval(batch)
+        with np.errstate(all="ignore"):
+            return HostColumn(self.data_type, np.abs(c.data),
+                              c.validity.copy())
+
+
+def _decimal_divide(node: Divide, batch: HostBatch) -> HostColumn:
+    raise NotImplementedError("decimal division lands with the decimal pass")
+
+
+# ---------------------------------------------------------------------------
+# Comparisons. Spark orders NaN greater than any other value and
+# NaN == NaN is true (unlike IEEE); see the reference's hasNans handling.
+# ---------------------------------------------------------------------------
+
+class BinaryComparison(BinaryExpression):
+    symbol = "?"
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = [left, right]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.BooleanT
+
+    def cmp(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        lc, rc = self.left.eval(batch), self.right.eval(batch)
+        validity = _combined_validity([lc, rc])
+        data = self._compare(lc, rc)
+        return HostColumn(T.BooleanT, data, validity).normalized()
+
+    def _compare(self, lc: HostColumn, rc: HostColumn) -> np.ndarray:
+        a, b = lc.data, rc.data
+        if a.dtype == np.dtype(object):
+            n = len(a)
+            out = np.zeros(n, dtype=bool)
+            for i in range(n):
+                out[i] = self.cmp_scalar(a[i], b[i])
+            return out
+        if np.issubdtype(a.dtype, np.floating):
+            # Total order with NaN largest: compare via ordered keys.
+            ka, kb = _float_total_order(a), _float_total_order(b)
+            return self.cmp(ka, kb)
+        return self.cmp(a, b)
+
+    def cmp_scalar(self, a, b) -> bool:
+        return bool(self.cmp(np.array([a], dtype=object),
+                             np.array([b], dtype=object))[0])
+
+
+def _float_total_order(a: np.ndarray) -> np.ndarray:
+    """Map floats to unsigned keys preserving Spark's total order
+    (-inf < ... < -0.0 = 0.0 < ... < inf < NaN; all NaNs equal).
+
+    Classic radix trick on the IEEE bit pattern: flip all bits for
+    negatives, set the sign bit for non-negatives; NaNs and -0.0 are
+    canonicalized first so every NaN maps to one (maximal) key.
+    """
+    v = (a.astype(np.float32) if a.dtype == np.float32
+         else a.astype(np.float64)).copy()
+    v[np.isnan(v)] = np.nan  # canonical positive NaN
+    v[v == 0.0] = 0.0        # fold -0.0 into +0.0
+    if v.dtype == np.float32:
+        u = v.view(np.uint32)
+        return np.where((u >> np.uint32(31)) == 1, ~u,
+                        u | np.uint32(0x80000000))
+    u = v.view(np.uint64)
+    return np.where((u >> np.uint64(63)) == 1, ~u,
+                    u | np.uint64(0x8000000000000000))
+
+
+class EqualTo(BinaryComparison):
+    symbol = "="
+
+    def cmp(self, a, b):
+        return a == b
+
+
+class LessThan(BinaryComparison):
+    symbol = "<"
+
+    def cmp(self, a, b):
+        return a < b
+
+
+class LessThanOrEqual(BinaryComparison):
+    symbol = "<="
+
+    def cmp(self, a, b):
+        return a <= b
+
+
+class GreaterThan(BinaryComparison):
+    symbol = ">"
+
+    def cmp(self, a, b):
+        return a > b
+
+
+class GreaterThanOrEqual(BinaryComparison):
+    symbol = ">="
+
+    def cmp(self, a, b):
+        return a >= b
+
+
+class EqualNullSafe(BinaryComparison):
+    """<=>: never null; null <=> null is true."""
+    symbol = "<=>"
+
+    def cmp(self, a, b):
+        return a == b
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        lc, rc = self.left.eval(batch), self.right.eval(batch)
+        both_valid = lc.validity & rc.validity
+        both_null = (~lc.validity) & (~rc.validity)
+        eq = self._compare(lc, rc)
+        data = np.where(both_valid, eq, both_null)
+        return HostColumn.all_valid(data.astype(bool), T.BooleanT)
+
+
+# ---------------------------------------------------------------------------
+# Logic (3-valued)
+# ---------------------------------------------------------------------------
+
+class And(BinaryExpression):
+    def __init__(self, left: Expression, right: Expression):
+        self.children = [left, right]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.BooleanT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        lc, rc = self.left.eval(batch), self.right.eval(batch)
+        lt = lc.validity & lc.data.astype(bool)
+        lf = lc.validity & ~lc.data.astype(bool)
+        rt = rc.validity & rc.data.astype(bool)
+        rf = rc.validity & ~rc.data.astype(bool)
+        data = lt & rt
+        validity = lf | rf | (lt & rt)
+        return HostColumn(T.BooleanT, data, validity).normalized()
+
+
+class Or(BinaryExpression):
+    def __init__(self, left: Expression, right: Expression):
+        self.children = [left, right]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.BooleanT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        lc, rc = self.left.eval(batch), self.right.eval(batch)
+        lt = lc.validity & lc.data.astype(bool)
+        rt = rc.validity & rc.data.astype(bool)
+        lf = lc.validity & ~lc.data.astype(bool)
+        rf = rc.validity & ~rc.data.astype(bool)
+        data = lt | rt
+        validity = lt | rt | (lf & rf)
+        return HostColumn(T.BooleanT, data, validity).normalized()
+
+
+class Not(UnaryExpression):
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.BooleanT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.child.eval(batch)
+        return HostColumn(T.BooleanT, ~c.data.astype(bool),
+                          c.validity.copy()).normalized()
+
+
+class In(Expression):
+    def __init__(self, value: Expression, items: List[Expression]):
+        self.children = [value] + items
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.BooleanT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        vc = self.children[0].eval(batch)
+        any_true = np.zeros(batch.num_rows, dtype=bool)
+        any_null = np.zeros(batch.num_rows, dtype=bool)
+        for item in self.children[1:]:
+            ic = item.eval(batch)
+            eq = EqualTo(self.children[0], item)._compare(vc, ic)
+            valid = vc.validity & ic.validity
+            any_true |= valid & eq
+            any_null |= ~ic.validity
+        validity = vc.validity & (any_true | ~any_null)
+        return HostColumn(T.BooleanT, any_true, validity).normalized()
+
+
+# ---------------------------------------------------------------------------
+# Null handling / conditionals
+# ---------------------------------------------------------------------------
+
+class IsNull(UnaryExpression):
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.BooleanT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.child.eval(batch)
+        return HostColumn.all_valid(~c.validity, T.BooleanT)
+
+
+class IsNotNull(UnaryExpression):
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.BooleanT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.child.eval(batch)
+        return HostColumn.all_valid(c.validity.copy(), T.BooleanT)
+
+
+class IsNan(UnaryExpression):
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.BooleanT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.child.eval(batch)
+        data = np.isnan(c.data) & c.validity
+        return HostColumn.all_valid(data, T.BooleanT)
+
+
+class Coalesce(Expression):
+    def __init__(self, children: List[Expression]):
+        self.children = list(children)
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.children[0].data_type
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        cols = [c.eval(batch) for c in self.children]
+        data = cols[0].data.copy()
+        validity = cols[0].validity.copy()
+        for c in cols[1:]:
+            fill = (~validity) & c.validity
+            data[fill] = c.data[fill]
+            validity |= c.validity
+        return HostColumn(self.data_type, data, validity).normalized()
+
+
+class If(Expression):
+    def __init__(self, predicate: Expression, true_value: Expression,
+                 false_value: Expression):
+        self.children = [predicate, true_value, false_value]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.children[1].data_type
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        p = self.children[0].eval(batch)
+        tv = self.children[1].eval(batch)
+        fv = self.children[2].eval(batch)
+        cond = p.validity & p.data.astype(bool)  # null predicate -> false arm
+        data = np.where(cond, tv.data, fv.data)
+        validity = np.where(cond, tv.validity, fv.validity)
+        return HostColumn(self.data_type, data,
+                          validity.astype(bool)).normalized()
+
+
+class CaseWhen(Expression):
+    """CASE WHEN p1 THEN v1 ... ELSE e END. children =
+    [p1, v1, p2, v2, ..., (else)]."""
+
+    def __init__(self, branches: List, else_value: Optional[Expression]):
+        self.children = []
+        for p, v in branches:
+            self.children.extend([p, v])
+        self.has_else = else_value is not None
+        if else_value is not None:
+            self.children.append(else_value)
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.children[1].data_type
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        n = batch.num_rows
+        np_dt = T.numpy_dtype(self.data_type)
+        data = (np.full(n, "", dtype=object)
+                if np_dt == np.dtype(object) else np.zeros(n, dtype=np_dt))
+        validity = np.zeros(n, dtype=bool)
+        decided = np.zeros(n, dtype=bool)
+        pairs = (self.children[:-1] if self.has_else else self.children)
+        for i in range(0, len(pairs), 2):
+            p = pairs[i].eval(batch)
+            v = pairs[i + 1].eval(batch)
+            hit = (~decided) & p.validity & p.data.astype(bool)
+            data[hit] = v.data[hit]
+            validity[hit] = v.validity[hit]
+            decided |= hit
+        if self.has_else:
+            e = self.children[-1].eval(batch)
+            rest = ~decided
+            data[rest] = e.data[rest]
+            validity[rest] = e.validity[rest]
+        return HostColumn(self.data_type, data, validity).normalized()
+
+
+# ---------------------------------------------------------------------------
+# Math functions
+# ---------------------------------------------------------------------------
+
+class UnaryMath(UnaryExpression):
+    np_fn: Callable = None
+
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.DoubleT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.child.eval(batch)
+        with np.errstate(all="ignore"):
+            data = type(self).np_fn(c.data.astype(np.float64))
+        return HostColumn(T.DoubleT, data, c.validity.copy()).normalized()
+
+
+class Sqrt(UnaryMath):
+    np_fn = np.sqrt
+
+
+class Exp(UnaryMath):
+    np_fn = np.exp
+
+
+class Log(UnaryMath):
+    """Natural log; Spark non-ANSI returns null for x <= 0."""
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.child.eval(batch)
+        x = c.data.astype(np.float64)
+        validity = c.validity & (x > 0)
+        with np.errstate(all="ignore"):
+            data = np.log(np.where(x > 0, x, 1.0))
+        return HostColumn(T.DoubleT, data, validity).normalized()
+
+
+class Log10(UnaryMath):
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.child.eval(batch)
+        x = c.data.astype(np.float64)
+        validity = c.validity & (x > 0)
+        with np.errstate(all="ignore"):
+            data = np.log10(np.where(x > 0, x, 1.0))
+        return HostColumn(T.DoubleT, data, validity).normalized()
+
+
+class Sin(UnaryMath):
+    np_fn = np.sin
+
+
+class Cos(UnaryMath):
+    np_fn = np.cos
+
+
+class Tan(UnaryMath):
+    np_fn = np.tan
+
+
+class Asin(UnaryMath):
+    np_fn = np.arcsin
+
+
+class Acos(UnaryMath):
+    np_fn = np.arccos
+
+
+class Atan(UnaryMath):
+    np_fn = np.arctan
+
+
+class Sinh(UnaryMath):
+    np_fn = np.sinh
+
+
+class Cosh(UnaryMath):
+    np_fn = np.cosh
+
+
+class Tanh(UnaryMath):
+    np_fn = np.tanh
+
+
+class Signum(UnaryMath):
+    np_fn = np.sign
+
+
+class Floor(UnaryExpression):
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.LongT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.child.eval(batch)
+        with np.errstate(all="ignore"):
+            data = np.floor(c.data.astype(np.float64)).astype(np.int64)
+        return HostColumn(T.LongT, data, c.validity.copy()).normalized()
+
+
+class Ceil(UnaryExpression):
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.LongT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.child.eval(batch)
+        with np.errstate(all="ignore"):
+            data = np.ceil(c.data.astype(np.float64)).astype(np.int64)
+        return HostColumn(T.LongT, data, c.validity.copy()).normalized()
+
+
+class Pow(BinaryExpression):
+    def __init__(self, left: Expression, right: Expression):
+        self.children = [left, right]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.DoubleT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        lc, rc = self.left.eval(batch), self.right.eval(batch)
+        validity = _combined_validity([lc, rc])
+        with np.errstate(all="ignore"):
+            data = np.power(lc.data.astype(np.float64),
+                            rc.data.astype(np.float64))
+        return HostColumn(T.DoubleT, data, validity).normalized()
+
+
+class Round(Expression):
+    """HALF_UP rounding (Spark Round)."""
+
+    def __init__(self, child: Expression, scale: Expression):
+        self.children = [child, scale]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.children[0].data_type
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.children[0].eval(batch)
+        scale = self.children[1]
+        assert isinstance(scale, Literal), "round scale must be literal"
+        s = int(scale.value)
+        x = c.data
+        if np.issubdtype(x.dtype, np.integer):
+            if s >= 0:
+                data = x.copy()
+            else:
+                p = 10 ** (-s)
+                half = p // 2
+                data = ((np.abs(x) + half) // p * p) * np.sign(x)
+                data = data.astype(x.dtype)
+        else:
+            with np.errstate(all="ignore"):
+                p = 10.0 ** s
+                scaled = x.astype(np.float64) * p
+                # HALF_UP: away from zero on ties (np.round is HALF_EVEN)
+                data = (np.sign(scaled)
+                        * np.floor(np.abs(scaled) + 0.5)) / p
+                data = data.astype(x.dtype)
+        return HostColumn(self.data_type, data, c.validity.copy()).normalized()
+
+
+# ---------------------------------------------------------------------------
+# Strings (host: object arrays; per-row loops are acceptable on the CPU
+# baseline path). Mirrors the reference's stringFunctions.scala surface.
+# ---------------------------------------------------------------------------
+
+class StringUnary(UnaryExpression):
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.StringT
+
+    def fn(self, s: str) -> Any:
+        raise NotImplementedError
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.child.eval(batch)
+        out = np.empty(len(c.data), dtype=T.numpy_dtype(self.data_type))
+        if out.dtype == np.dtype(object):
+            out[:] = ""
+        for i in range(len(c.data)):
+            if c.validity[i]:
+                out[i] = self.fn(c.data[i])
+        return HostColumn(self.data_type, out, c.validity.copy())
+
+
+class Upper(StringUnary):
+    def fn(self, s: str) -> str:
+        return s.upper()
+
+
+class Lower(StringUnary):
+    def fn(self, s: str) -> str:
+        return s.lower()
+
+
+class Length(StringUnary):
+    @property
+    def data_type(self) -> T.DataType:
+        return T.IntegerT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.child.eval(batch)
+        data = np.array([len(s) if v else 0
+                         for s, v in zip(c.data, c.validity)], dtype=np.int32)
+        return HostColumn(T.IntegerT, data, c.validity.copy())
+
+
+class StringTrim(StringUnary):
+    def fn(self, s: str) -> str:
+        return s.strip(" ")
+
+
+class Substring(Expression):
+    """1-based substring with Spark's negative-position semantics."""
+
+    def __init__(self, child: Expression, pos: Expression, length: Expression):
+        self.children = [child, pos, length]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.StringT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.children[0].eval(batch)
+        p = self.children[1].eval(batch)
+        ln = self.children[2].eval(batch)
+        validity = _combined_validity([c, p, ln])
+        out = np.full(len(c.data), "", dtype=object)
+        for i in range(len(c.data)):
+            if not validity[i]:
+                continue
+            s = c.data[i]
+            pos, length = int(p.data[i]), int(ln.data[i])
+            if length <= 0:
+                out[i] = ""
+                continue
+            if pos > 0:
+                start = pos - 1
+            elif pos == 0:
+                start = 0
+            else:
+                start = max(len(s) + pos, 0)
+                if len(s) + pos < 0:
+                    length = length + (len(s) + pos)
+                    if length <= 0:
+                        out[i] = ""
+                        continue
+            out[i] = s[start:start + length]
+        return HostColumn(T.StringT, out, validity)
+
+
+class ConcatStr(Expression):
+    def __init__(self, children: List[Expression]):
+        self.children = list(children)
+
+    @property
+    def pretty_name(self) -> str:
+        return "concat"
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.StringT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        cols = [c.eval(batch) for c in self.children]
+        validity = _combined_validity(cols)
+        out = np.full(batch.num_rows, "", dtype=object)
+        for i in range(batch.num_rows):
+            if validity[i]:
+                out[i] = "".join(c.data[i] for c in cols)
+        return HostColumn(T.StringT, out, validity)
+
+
+class StartsWith(BinaryExpression):
+    def __init__(self, left: Expression, right: Expression):
+        self.children = [left, right]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.BooleanT
+
+    def scalar(self, s: str, p: str) -> bool:
+        return s.startswith(p)
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        lc, rc = self.left.eval(batch), self.right.eval(batch)
+        validity = _combined_validity([lc, rc])
+        out = np.zeros(batch.num_rows, dtype=bool)
+        for i in range(batch.num_rows):
+            if validity[i]:
+                out[i] = self.scalar(lc.data[i], rc.data[i])
+        return HostColumn(T.BooleanT, out, validity)
+
+
+class EndsWith(StartsWith):
+    def scalar(self, s: str, p: str) -> bool:
+        return s.endswith(p)
+
+
+class Contains(StartsWith):
+    def scalar(self, s: str, p: str) -> bool:
+        return p in s
+
+
+class Like(StartsWith):
+    """SQL LIKE with %% and _ wildcards, escape '\\'."""
+
+    def scalar(self, s: str, p: str) -> bool:
+        import re
+        regex = _like_to_regex(p)
+        return re.fullmatch(regex, s, flags=re.DOTALL) is not None
+
+
+def _like_to_regex(pattern: str) -> str:
+    import re
+    out = []
+    i = 0
+    while i < len(pattern):
+        ch = pattern[i]
+        if ch == "\\" and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+        i += 1
+    return "".join(out)
+
+
+# ---------------------------------------------------------------------------
+# Date/time (DateType = days since epoch; TimestampType = micros UTC;
+# mirrors datetimeExpressions.scala)
+# ---------------------------------------------------------------------------
+
+_EPOCH_ORD = 719163  # datetime.date(1970,1,1).toordinal()
+
+
+def _days_to_ymd(days: np.ndarray):
+    # Proleptic Gregorian, vectorized civil-from-days (Howard Hinnant's algo)
+    z = days.astype(np.int64) + 719468
+    era = np.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = np.where(mp < 10, mp + 3, mp - 9)
+    y = np.where(m <= 2, y + 1, y)
+    return y.astype(np.int64), m.astype(np.int64), d.astype(np.int64)
+
+
+class DateTimeField(UnaryExpression):
+    field = "year"
+
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.IntegerT
+
+    def _days(self, c: HostColumn) -> np.ndarray:
+        if isinstance(self.child.data_type, T.TimestampType):
+            micros = c.data.astype(np.int64)
+            return np.floor_divide(micros, 86_400_000_000)
+        return c.data.astype(np.int64)
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.child.eval(batch)
+        y, m, d = _days_to_ymd(self._days(c))
+        data = {"year": y, "month": m, "dayofmonth": d}[self.field]
+        return HostColumn(T.IntegerT, data.astype(np.int32),
+                          c.validity.copy()).normalized()
+
+
+class Year(DateTimeField):
+    field = "year"
+
+
+class Month(DateTimeField):
+    field = "month"
+
+
+class DayOfMonth(DateTimeField):
+    field = "dayofmonth"
+
+
+class TimeField(UnaryExpression):
+    divisor = 1
+    modulus = 1
+
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.IntegerT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.child.eval(batch)
+        micros = c.data.astype(np.int64)
+        sec_of_day = np.mod(np.floor_divide(micros, 1_000_000), 86400)
+        data = np.mod(np.floor_divide(sec_of_day, self.divisor), self.modulus)
+        return HostColumn(T.IntegerT, data.astype(np.int32),
+                          c.validity.copy()).normalized()
+
+
+class Hour(TimeField):
+    divisor, modulus = 3600, 24
+
+
+class Minute(TimeField):
+    divisor, modulus = 60, 60
+
+
+class Second(TimeField):
+    divisor, modulus = 1, 60
+
+
+class DateAdd(BinaryExpression):
+    def __init__(self, start: Expression, days: Expression):
+        self.children = [start, days]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.DateT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        sc, dc = self.left.eval(batch), self.right.eval(batch)
+        validity = _combined_validity([sc, dc])
+        data = (sc.data.astype(np.int64)
+                + dc.data.astype(np.int64)).astype(np.int32)
+        return HostColumn(T.DateT, data, validity).normalized()
+
+
+class DateSub(DateAdd):
+    def eval(self, batch: HostBatch) -> HostColumn:
+        sc, dc = self.left.eval(batch), self.right.eval(batch)
+        validity = _combined_validity([sc, dc])
+        data = (sc.data.astype(np.int64)
+                - dc.data.astype(np.int64)).astype(np.int32)
+        return HostColumn(T.DateT, data, validity).normalized()
+
+
+class DateDiff(BinaryExpression):
+    def __init__(self, end: Expression, start: Expression):
+        self.children = [end, start]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.IntegerT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        ec, sc = self.left.eval(batch), self.right.eval(batch)
+        validity = _combined_validity([ec, sc])
+        data = (ec.data.astype(np.int64)
+                - sc.data.astype(np.int64)).astype(np.int32)
+        return HostColumn(T.IntegerT, data, validity).normalized()
+
+
+# ---------------------------------------------------------------------------
+# Hash
+# ---------------------------------------------------------------------------
+
+class Murmur3Hash(Expression):
+    """Spark Murmur3Hash(seed=42) over columns left-to-right; the rewrite
+    maps this to the device twin in kernels/hashing.py
+    (reference: GpuMurmur3Hash, HashFunctions.scala)."""
+
+    def __init__(self, children: List[Expression], seed: int = 42):
+        self.children = list(children)
+        self.seed = seed
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.IntegerT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        n = batch.num_rows
+        h = np.full(n, self.seed, dtype=np.int32)
+        for child in self.children:
+            c = child.eval(batch)
+            h = _hash_column(c, h)
+        return HostColumn.all_valid(h, T.IntegerT)
+
+
+def _hash_column(c: HostColumn, seed: np.ndarray) -> np.ndarray:
+    dt = c.dtype
+    if isinstance(dt, (T.StringType, T.BinaryType)):
+        out = seed.copy()
+        for i in range(len(c.data)):
+            if c.validity[i]:
+                raw = (c.data[i].encode("utf-8")
+                       if isinstance(c.data[i], str) else bytes(c.data[i]))
+                out[i] = murmur3.hash_bytes_one(raw, int(seed[i]))
+        return out
+    if isinstance(dt, T.BooleanType):
+        h = murmur3.hash_int(c.data.astype(np.int32), seed)
+    elif isinstance(dt, (T.ByteType, T.ShortType, T.IntegerType, T.DateType)):
+        h = murmur3.hash_int(c.data.astype(np.int32), seed)
+    elif isinstance(dt, (T.LongType, T.TimestampType)):
+        h = murmur3.hash_long(c.data.astype(np.int64), seed)
+    elif isinstance(dt, T.FloatType):
+        h = murmur3.hash_float(c.data, seed)
+    elif isinstance(dt, T.DoubleType):
+        h = murmur3.hash_double(c.data, seed)
+    elif isinstance(dt, T.DecimalType) and dt.precision <= 18:
+        h = murmur3.hash_long(c.data.astype(np.int64), seed)
+    else:
+        raise TypeError(f"cannot hash {dt}")
+    return np.where(c.validity, h, seed)
+
+
+# ---------------------------------------------------------------------------
+# Cast (GpuCast.scala:1338 equivalent; the CastChecks matrix in typesig.py
+# gates which directions the device may take)
+# ---------------------------------------------------------------------------
+
+class Cast(UnaryExpression):
+    def __init__(self, child: Expression, dtype: T.DataType,
+                 ansi: bool = False):
+        self.children = [child]
+        self._dtype = dtype
+        self.ansi = ansi
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self._dtype
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.child.eval(batch)
+        return cast_host_column(c, self._dtype, self.ansi)
+
+    def __repr__(self) -> str:
+        return f"cast({self.child!r} as {self._dtype.simple_string})"
+
+
+def cast_host_column(c: HostColumn, to: T.DataType, ansi: bool = False
+                     ) -> HostColumn:
+    frm = c.dtype
+    if frm == to:
+        return c
+    if isinstance(frm, T.NullType):
+        return HostColumn.nulls(len(c), to)
+
+    # numeric -> numeric
+    if T.is_numeric(frm) and T.is_numeric(to) and not isinstance(
+            to, T.DecimalType) and not isinstance(frm, T.DecimalType):
+        return _cast_numeric(c, to, ansi)
+    # bool -> numeric
+    if isinstance(frm, T.BooleanType) and T.is_numeric(to):
+        data = c.data.astype(T.numpy_dtype(to))
+        return HostColumn(to, data, c.validity.copy())
+    # numeric -> bool
+    if T.is_numeric(frm) and isinstance(to, T.BooleanType):
+        return HostColumn(to, c.data != 0, c.validity.copy())
+    # anything -> string
+    if isinstance(to, T.StringType):
+        return _cast_to_string(c)
+    # string -> *
+    if isinstance(frm, T.StringType):
+        return _cast_from_string(c, to, ansi)
+    # date/timestamp conversions
+    if isinstance(frm, T.DateType) and isinstance(to, T.TimestampType):
+        data = c.data.astype(np.int64) * 86_400_000_000
+        return HostColumn(to, data, c.validity.copy())
+    if isinstance(frm, T.TimestampType) and isinstance(to, T.DateType):
+        data = np.floor_divide(c.data.astype(np.int64),
+                               86_400_000_000).astype(np.int32)
+        return HostColumn(to, data, c.validity.copy())
+    # decimal <-> numeric (decimal64 path)
+    if isinstance(to, T.DecimalType):
+        return _cast_to_decimal(c, to, ansi)
+    if isinstance(frm, T.DecimalType):
+        return _cast_from_decimal(c, to, ansi)
+    raise TypeError(f"unsupported cast {frm} -> {to}")
+
+
+def _cast_numeric(c: HostColumn, to: T.DataType, ansi: bool) -> HostColumn:
+    np_to = T.numpy_dtype(to)
+    src = c.data
+    validity = c.validity.copy()
+    if np.issubdtype(src.dtype, np.floating) and not T.is_floating(to):
+        # Java double->int semantics: NaN -> 0, saturate at bounds,
+        # truncate toward zero (Spark non-ANSI Cast).
+        info = np.iinfo(np_to)
+        x = np.nan_to_num(np.trunc(src), nan=0.0,
+                          posinf=float(info.max), neginf=float(info.min))
+        x = np.clip(x, float(info.min), float(info.max))
+        if ansi:
+            bad = np.isnan(src) | (np.trunc(src) != x)
+            if (bad & validity).any():
+                raise ArithmeticError("Cast overflow in ANSI mode")
+        data = x.astype(np_to)
+    else:
+        # int narrowing wraps (two's complement), widening exact;
+        # int->float may round — all match Java/Spark non-ANSI.
+        with np.errstate(all="ignore"):
+            data = src.astype(np_to)
+        if ansi and np.issubdtype(src.dtype, np.integer) \
+                and np.issubdtype(np_to, np.integer) \
+                and np_to.itemsize < src.dtype.itemsize:
+            bad = data.astype(src.dtype) != src
+            if (bad & validity).any():
+                raise ArithmeticError("Cast overflow in ANSI mode")
+    return HostColumn(to, data, validity)
+
+
+def _format_double_java(v: float) -> str:
+    """Approximate Java Double.toString (Spark cast double->string).
+    Gated behind castFloatToString like the reference."""
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "Infinity" if v > 0 else "-Infinity"
+    if v == int(v) and abs(v) < 1e7:
+        return f"{int(v)}.0"
+    r = repr(float(v))
+    if "e" in r:
+        mant, exp = r.split("e")
+        e = int(exp)
+        if "." not in mant:
+            mant += ".0"
+        return f"{mant}E{e}"
+    return r
+
+
+def _cast_to_string(c: HostColumn) -> HostColumn:
+    frm = c.dtype
+    out = np.full(len(c), "", dtype=object)
+    if isinstance(frm, T.BooleanType):
+        for i in range(len(c)):
+            if c.validity[i]:
+                out[i] = "true" if c.data[i] else "false"
+    elif isinstance(frm, T.DateType):
+        y, m, d = _days_to_ymd(c.data.astype(np.int64))
+        for i in range(len(c)):
+            if c.validity[i]:
+                out[i] = f"{y[i]:04d}-{m[i]:02d}-{d[i]:02d}"
+    elif isinstance(frm, T.TimestampType):
+        micros = c.data.astype(np.int64)
+        days = np.floor_divide(micros, 86_400_000_000)
+        y, m, d = _days_to_ymd(days)
+        rem = micros - days * 86_400_000_000
+        for i in range(len(c)):
+            if c.validity[i]:
+                s = int(rem[i] // 1_000_000)
+                us = int(rem[i] % 1_000_000)
+                base = (f"{y[i]:04d}-{m[i]:02d}-{d[i]:02d} "
+                        f"{s // 3600:02d}:{(s // 60) % 60:02d}:{s % 60:02d}")
+                if us:
+                    base += ("." + f"{us:06d}".rstrip("0"))
+                out[i] = base
+    elif T.is_floating(frm):
+        for i in range(len(c)):
+            if c.validity[i]:
+                out[i] = _format_double_java(float(c.data[i]))
+    elif isinstance(frm, T.DecimalType):
+        scale = frm.scale
+        for i in range(len(c)):
+            if c.validity[i]:
+                u = int(c.data[i])
+                out[i] = _format_decimal(u, scale)
+    elif isinstance(frm, T.StringType):
+        return c
+    else:
+        for i in range(len(c)):
+            if c.validity[i]:
+                out[i] = str(int(c.data[i]))
+    return HostColumn(T.StringT, out, c.validity.copy())
+
+
+def _format_decimal(unscaled: int, scale: int) -> str:
+    sign = "-" if unscaled < 0 else ""
+    u = abs(unscaled)
+    if scale == 0:
+        return f"{sign}{u}"
+    s = str(u).rjust(scale + 1, "0")
+    return f"{sign}{s[:-scale]}.{s[-scale:]}"
+
+
+def _cast_from_string(c: HostColumn, to: T.DataType, ansi: bool
+                      ) -> HostColumn:
+    n = len(c)
+    validity = c.validity.copy()
+    np_dt = T.numpy_dtype(to)
+    if isinstance(to, T.BooleanType):
+        data = np.zeros(n, dtype=bool)
+        for i in range(n):
+            if not validity[i]:
+                continue
+            s = c.data[i].strip().lower()
+            if s in ("t", "true", "y", "yes", "1"):
+                data[i] = True
+            elif s in ("f", "false", "n", "no", "0"):
+                data[i] = False
+            else:
+                validity[i] = False
+        return HostColumn(to, data, validity)
+    if T.is_floating(to):
+        data = np.zeros(n, dtype=np_dt)
+        for i in range(n):
+            if not validity[i]:
+                continue
+            try:
+                data[i] = float(c.data[i].strip())
+            except ValueError:
+                validity[i] = False
+        return HostColumn(to, data, validity)
+    if T.is_integral(to):
+        data = np.zeros(n, dtype=np_dt)
+        info = np.iinfo(np_dt)
+        for i in range(n):
+            if not validity[i]:
+                continue
+            s = c.data[i].strip()
+            try:
+                v = int(s)
+            except ValueError:
+                # Spark accepts "123.45" -> 123 for cast to int? It does
+                # truncate decimals in strings (UTF8String.toInt rejects;
+                # Cast uses toLongExact on trimmed decimal strings). Keep
+                # the common behavior: reject non-integer strings.
+                validity[i] = False
+                continue
+            if v < info.min or v > info.max:
+                validity[i] = False
+                continue
+            data[i] = v
+        return HostColumn(to, data, validity)
+    if isinstance(to, T.DateType):
+        data = np.zeros(n, dtype=np.int32)
+        import datetime
+        for i in range(n):
+            if not validity[i]:
+                continue
+            s = c.data[i].strip()
+            try:
+                parts = s.split("-")
+                d = datetime.date(int(parts[0]), int(parts[1]),
+                                  int(parts[2][:2]))
+                data[i] = d.toordinal() - _EPOCH_ORD
+            except (ValueError, IndexError):
+                validity[i] = False
+        return HostColumn(to, data, validity)
+    if isinstance(to, T.TimestampType):
+        data = np.zeros(n, dtype=np.int64)
+        import datetime
+        for i in range(n):
+            if not validity[i]:
+                continue
+            s = c.data[i].strip().replace("T", " ")
+            try:
+                if " " in s:
+                    dt = datetime.datetime.fromisoformat(s)
+                else:
+                    dt = datetime.datetime.fromisoformat(s + " 00:00:00")
+                dt = dt.replace(tzinfo=datetime.timezone.utc)
+                data[i] = int(dt.timestamp() * 1_000_000)
+            except ValueError:
+                validity[i] = False
+        return HostColumn(to, data, validity)
+    if isinstance(to, T.DecimalType):
+        data = np.zeros(n, dtype=np.int64)
+        import decimal as pydec
+        q = pydec.Decimal(1).scaleb(-to.scale)
+        for i in range(n):
+            if not validity[i]:
+                continue
+            try:
+                d = pydec.Decimal(c.data[i].strip()).quantize(
+                    q, rounding=pydec.ROUND_HALF_UP)
+                u = int(d.scaleb(to.scale))
+                if abs(u) >= 10 ** to.precision:
+                    validity[i] = False
+                else:
+                    data[i] = u
+            except pydec.InvalidOperation:
+                validity[i] = False
+        return HostColumn(to, data, validity)
+    raise TypeError(f"unsupported cast string -> {to}")
+
+
+def _cast_to_decimal(c: HostColumn, to: T.DecimalType, ansi: bool
+                     ) -> HostColumn:
+    assert to.precision <= 18, "decimal128 lands later"
+    validity = c.validity.copy()
+    frm = c.dtype
+    bound = 10 ** to.precision
+    if isinstance(frm, T.DecimalType):
+        # rescale
+        diff = to.scale - frm.scale
+        src = c.data.astype(np.int64)
+        if diff >= 0:
+            data = src * (10 ** diff)
+        else:
+            p = 10 ** (-diff)
+            half = p // 2
+            data = (np.abs(src) + half) // p * np.sign(src)
+        over = np.abs(data) >= bound
+    elif T.is_integral(frm) or isinstance(frm, T.BooleanType):
+        data = c.data.astype(np.int64) * (10 ** to.scale)
+        over = np.abs(data) >= bound
+    elif T.is_floating(frm):
+        with np.errstate(all="ignore"):
+            scaled = c.data.astype(np.float64) * (10.0 ** to.scale)
+            data = (np.sign(scaled) * np.floor(np.abs(scaled) + 0.5))
+            over = (np.isnan(scaled) | np.isinf(scaled)
+                    | (np.abs(data) >= bound))
+            data = np.nan_to_num(data, nan=0.0, posinf=0.0,
+                                 neginf=0.0).astype(np.int64)
+    else:
+        raise TypeError(f"cast {frm} -> {to}")
+    if ansi and (over & validity).any():
+        raise ArithmeticError("Decimal overflow in ANSI mode")
+    validity &= ~over
+    return HostColumn(to, np.asarray(data, dtype=np.int64), validity
+                      ).normalized()
+
+
+def _cast_from_decimal(c: HostColumn, to: T.DataType, ansi: bool
+                       ) -> HostColumn:
+    frm = c.dtype
+    assert isinstance(frm, T.DecimalType)
+    scale_div = 10 ** frm.scale
+    if T.is_floating(to):
+        data = (c.data.astype(np.float64) / scale_div).astype(
+            T.numpy_dtype(to))
+        return HostColumn(to, data, c.validity.copy())
+    if T.is_integral(to):
+        q = c.data.astype(np.int64)
+        trunc = np.where(q < 0, -((-q) // scale_div), q // scale_div)
+        info = np.iinfo(T.numpy_dtype(to))
+        validity = c.validity & (trunc >= info.min) & (trunc <= info.max)
+        if ansi and (~validity & c.validity).any():
+            raise ArithmeticError("Cast overflow in ANSI mode")
+        return HostColumn(to, trunc.astype(T.numpy_dtype(to)),
+                          validity).normalized()
+    raise TypeError(f"cast {frm} -> {to}")
+
+
+# ---------------------------------------------------------------------------
+# Aggregate functions. Modeled as (buffer slots + primitive segment ops)
+# so CPU (numpy) and TPU (jax.ops.segment_*) share one contract; mirrors
+# the update/merge split the reference binds separately per mode
+# (aggregate.scala:247 strategy doc).
+# ---------------------------------------------------------------------------
+
+# primitive segment ops understood by both engines
+PRIM_SUM = "sum"
+PRIM_COUNT = "count"   # counts valid slots
+PRIM_MIN = "min"
+PRIM_MAX = "max"
+PRIM_FIRST = "first"   # first valid value in segment (ignoreNulls=true)
+PRIM_LAST = "last"
+PRIM_FIRST_ANY = "first_any"  # first row incl. nulls (ignoreNulls=false);
+PRIM_LAST_ANY = "last_any"    # sound at merge: partial rows exist only for
+                              # non-empty groups, so a null buffer slot means
+                              # "first value was null", never "no rows"
+PRIM_SUM_NONNULL = "sum_nonnull"  # null-skipping sum that yields 0, not null
+
+
+class AggregateFunction(Expression):
+    """Declarative aggregate: buffer slots with update/merge primitives.
+
+    buffer_slots(): [(slot_name, DataType, update_prim, update_child_expr,
+                      merge_prim)]
+    evaluate(buffers): final result column from merged buffer columns.
+    """
+
+    def buffer_slots(self) -> List:
+        raise NotImplementedError
+
+    def evaluate(self, buffers: List[HostColumn]) -> HostColumn:
+        raise NotImplementedError
+
+
+def _sum_result_type(dt: T.DataType) -> T.DataType:
+    if isinstance(dt, T.DecimalType):
+        return T.DecimalType(min(dt.precision + 10, 38), dt.scale)
+    if T.is_integral(dt) or isinstance(dt, T.BooleanType):
+        return T.LongT
+    return T.DoubleT
+
+
+class Sum(AggregateFunction):
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return _sum_result_type(self.children[0].data_type)
+
+    def buffer_slots(self):
+        return [("sum", self.data_type, PRIM_SUM, self.children[0], PRIM_SUM)]
+
+    def evaluate(self, buffers):
+        return buffers[0]
+
+
+class Count(AggregateFunction):
+    def __init__(self, children: List[Expression]):
+        self.children = list(children)  # empty = COUNT(*)
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.LongT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def buffer_slots(self):
+        child = self.children[0] if self.children else Literal(1)
+        return [("count", T.LongT, PRIM_COUNT, child, PRIM_SUM_NONNULL)]
+
+    def evaluate(self, buffers):
+        b = buffers[0]
+        data = np.where(b.validity, b.data, 0).astype(np.int64)
+        return HostColumn.all_valid(data, T.LongT)
+
+
+class Min(AggregateFunction):
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.children[0].data_type
+
+    def buffer_slots(self):
+        return [("min", self.data_type, PRIM_MIN, self.children[0], PRIM_MIN)]
+
+    def evaluate(self, buffers):
+        return buffers[0]
+
+
+class Max(AggregateFunction):
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.children[0].data_type
+
+    def buffer_slots(self):
+        return [("max", self.data_type, PRIM_MAX, self.children[0], PRIM_MAX)]
+
+    def evaluate(self, buffers):
+        return buffers[0]
+
+
+class Average(AggregateFunction):
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    @property
+    def data_type(self) -> T.DataType:
+        # Spark returns decimal(p+4, s+4) for decimal input; until decimal
+        # average lands, declare the double we actually produce so schema
+        # and data agree (the TypeSig gate routes decimal avg to CPU... and
+        # the CPU engine computes it in double too — documented incompat).
+        return T.DoubleT
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def buffer_slots(self):
+        child = self.children[0]
+        if not isinstance(child.data_type, T.DoubleType):
+            child_d = Cast(child, T.DoubleT)
+        else:
+            child_d = child
+        return [("sum", T.DoubleT, PRIM_SUM, child_d, PRIM_SUM),
+                ("count", T.LongT, PRIM_COUNT, child, PRIM_SUM_NONNULL)]
+
+    def evaluate(self, buffers):
+        s, cnt = buffers[0], buffers[1]
+        count = np.where(cnt.validity, cnt.data, 0).astype(np.float64)
+        validity = count > 0
+        with np.errstate(all="ignore"):
+            data = s.data.astype(np.float64) / np.where(count > 0, count, 1)
+        return HostColumn(T.DoubleT, data, validity).normalized()
+
+
+class First(AggregateFunction):
+    def __init__(self, child: Expression, ignore_nulls: bool = False):
+        self.children = [child]
+        self.ignore_nulls = ignore_nulls
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.children[0].data_type
+
+    def buffer_slots(self):
+        prim = PRIM_FIRST if self.ignore_nulls else PRIM_FIRST_ANY
+        return [("first", self.data_type, prim, self.children[0], prim)]
+
+    def evaluate(self, buffers):
+        return buffers[0]
+
+
+class Last(AggregateFunction):
+    def __init__(self, child: Expression, ignore_nulls: bool = False):
+        self.children = [child]
+        self.ignore_nulls = ignore_nulls
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.children[0].data_type
+
+    def buffer_slots(self):
+        prim = PRIM_LAST if self.ignore_nulls else PRIM_LAST_ANY
+        return [("last", self.data_type, prim, self.children[0], prim)]
+
+    def evaluate(self, buffers):
+        return buffers[0]
+
+
+class AggregateExpression(Expression):
+    """Wraps an AggregateFunction with mode + distinct flag (Catalyst
+    AggregateExpression)."""
+
+    def __init__(self, func: AggregateFunction, is_distinct: bool = False):
+        self.children = [func]
+        self.is_distinct = is_distinct
+
+    @property
+    def func(self) -> AggregateFunction:
+        return self.children[0]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.func.data_type
+
+    def __repr__(self) -> str:
+        d = "distinct " if self.is_distinct else ""
+        return f"{self.func.pretty_name}({d}{self.func.children})"
+
+
+# ---------------------------------------------------------------------------
+# Sort order
+# ---------------------------------------------------------------------------
+
+class SortOrder(Expression):
+    def __init__(self, child: Expression, ascending: bool = True,
+                 nulls_first: Optional[bool] = None):
+        self.children = [child]
+        self.ascending = ascending
+        # Spark default: NULLS FIRST for asc, NULLS LAST for desc
+        self.nulls_first = (ascending if nulls_first is None else nulls_first)
+
+    @property
+    def child(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.child.data_type
+
+    def __repr__(self) -> str:
+        dirn = "ASC" if self.ascending else "DESC"
+        nf = "NULLS FIRST" if self.nulls_first else "NULLS LAST"
+        return f"{self.child!r} {dirn} {nf}"

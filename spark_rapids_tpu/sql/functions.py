@@ -1,0 +1,433 @@
+"""User-facing Column API and function constructors (pyspark.sql.functions
+shape). Handles binary-op type coercion by inserting Casts, like Spark's
+TypeCoercion rules, so expression trees are fully typed at construction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Union
+
+from spark_rapids_tpu.sql import types as T
+from spark_rapids_tpu.sql import expressions as E
+
+
+class Column:
+    def __init__(self, expr: E.Expression):
+        self.expr = expr
+
+    # -- naming
+    def alias(self, name: str) -> "Column":
+        return Column(E.Alias(self.expr, name))
+
+    name = alias
+
+    # -- arithmetic with coercion
+    def _bin(self, other: Any, cls, swap: bool = False) -> "Column":
+        o = _to_expr(other)
+        a, b = (o, self.expr) if swap else (self.expr, o)
+        a, b = _coerce_pair(a, b)
+        return Column(cls(a, b))
+
+    def __add__(self, other):
+        return self._bin(other, E.Add)
+
+    def __radd__(self, other):
+        return self._bin(other, E.Add, swap=True)
+
+    def __sub__(self, other):
+        return self._bin(other, E.Subtract)
+
+    def __rsub__(self, other):
+        return self._bin(other, E.Subtract, swap=True)
+
+    def __mul__(self, other):
+        return self._bin(other, E.Multiply)
+
+    def __rmul__(self, other):
+        return self._bin(other, E.Multiply, swap=True)
+
+    def __truediv__(self, other):
+        return _divide(self.expr, _to_expr(other))
+
+    def __rtruediv__(self, other):
+        return _divide(_to_expr(other), self.expr)
+
+    def __mod__(self, other):
+        return self._bin(other, E.Remainder)
+
+    def __neg__(self):
+        return Column(E.UnaryMinus(self.expr))
+
+    # -- comparisons
+    def __eq__(self, other):  # type: ignore[override]
+        return self._bin(other, E.EqualTo)
+
+    def __ne__(self, other):  # type: ignore[override]
+        return Column(E.Not(self._bin(other, E.EqualTo).expr))
+
+    def __lt__(self, other):
+        return self._bin(other, E.LessThan)
+
+    def __le__(self, other):
+        return self._bin(other, E.LessThanOrEqual)
+
+    def __gt__(self, other):
+        return self._bin(other, E.GreaterThan)
+
+    def __ge__(self, other):
+        return self._bin(other, E.GreaterThanOrEqual)
+
+    def eqNullSafe(self, other):
+        return self._bin(other, E.EqualNullSafe)
+
+    # -- logic
+    def __and__(self, other):
+        return Column(E.And(self.expr, _to_expr(other)))
+
+    def __or__(self, other):
+        return Column(E.Or(self.expr, _to_expr(other)))
+
+    def __invert__(self):
+        return Column(E.Not(self.expr))
+
+    # -- null / membership
+    def isNull(self):
+        return Column(E.IsNull(self.expr))
+
+    def isNotNull(self):
+        return Column(E.IsNotNull(self.expr))
+
+    def isin(self, *values):
+        items = [_to_expr(v) for v in
+                 (values[0] if len(values) == 1
+                  and isinstance(values[0], (list, tuple)) else values)]
+        return Column(E.In(self.expr, items))
+
+    # -- casts & misc
+    def cast(self, dtype: Union[T.DataType, str]) -> "Column":
+        return Column(E.Cast(self.expr, _parse_type(dtype)))
+
+    astype = cast
+
+    def substr(self, pos, length):
+        return Column(E.Substring(self.expr, _to_expr(pos),
+                                  _to_expr(length)))
+
+    def startswith(self, other):
+        return Column(E.StartsWith(self.expr, _to_expr(other)))
+
+    def endswith(self, other):
+        return Column(E.EndsWith(self.expr, _to_expr(other)))
+
+    def contains(self, other):
+        return Column(E.Contains(self.expr, _to_expr(other)))
+
+    def like(self, pattern: str):
+        return Column(E.Like(self.expr, E.Literal(pattern)))
+
+    def between(self, low, high):
+        return (self >= low) & (self <= high)
+
+    # -- sort orders
+    def asc(self):
+        return Column(E.SortOrder(self.expr, ascending=True))
+
+    def desc(self):
+        return Column(E.SortOrder(self.expr, ascending=False))
+
+    def asc_nulls_last(self):
+        return Column(E.SortOrder(self.expr, True, nulls_first=False))
+
+    def desc_nulls_first(self):
+        return Column(E.SortOrder(self.expr, False, nulls_first=True))
+
+    def when(self, condition: "Column", value) -> "Column":
+        raise TypeError("use functions.when(...) to start a CASE expression")
+
+    def otherwise(self, value) -> "Column":
+        expr = self.expr
+        if not isinstance(expr, E.CaseWhen) or expr.has_else:
+            raise TypeError("otherwise() follows when()")
+        branches = [(expr.children[i], expr.children[i + 1])
+                    for i in range(0, len(expr.children), 2)]
+        return Column(E.CaseWhen(branches, _to_expr(value)))
+
+    def __repr__(self):
+        return f"Column<{self.expr!r}>"
+
+
+def _to_expr(v: Any) -> E.Expression:
+    if isinstance(v, Column):
+        return v.expr
+    if isinstance(v, E.Expression):
+        return v
+    return E.Literal(v)
+
+
+def _expr_type(e: E.Expression) -> Optional[T.DataType]:
+    try:
+        return e.data_type
+    except Exception:
+        return None  # unresolved; coercion re-checked at plan build
+
+
+def _coerce_pair(a: E.Expression, b: E.Expression):
+    ta, tb = _expr_type(a), _expr_type(b)
+    if ta is None or tb is None or ta == tb:
+        return a, b
+    common = T.tightest_common_type(ta, tb)
+    if common is None:
+        return a, b
+    if ta != common:
+        a = E.Cast(a, common)
+    if tb != common:
+        b = E.Cast(b, common)
+    return a, b
+
+
+def _divide(a: E.Expression, b: E.Expression) -> Column:
+    """Spark: `/` on non-decimal operands is double division."""
+    ta, tb = _expr_type(a), _expr_type(b)
+    if isinstance(ta, T.DecimalType) or isinstance(tb, T.DecimalType):
+        a2, b2 = _coerce_pair(a, b)
+        return Column(E.Divide(a2, b2))
+    if not isinstance(ta, T.DoubleType):
+        a = E.Cast(a, T.DoubleT)
+    if not isinstance(tb, T.DoubleType):
+        b = E.Cast(b, T.DoubleT)
+    return Column(E.Divide(a, b))
+
+
+_TYPE_NAMES = {
+    "boolean": T.BooleanT, "bool": T.BooleanT,
+    "tinyint": T.ByteT, "byte": T.ByteT,
+    "smallint": T.ShortT, "short": T.ShortT,
+    "int": T.IntegerT, "integer": T.IntegerT,
+    "bigint": T.LongT, "long": T.LongT,
+    "float": T.FloatT, "double": T.DoubleT,
+    "string": T.StringT, "binary": T.BinaryT,
+    "date": T.DateT, "timestamp": T.TimestampT,
+}
+
+
+def _parse_type(dt: Union[T.DataType, str]) -> T.DataType:
+    if isinstance(dt, T.DataType):
+        return dt
+    s = dt.strip().lower()
+    if s in _TYPE_NAMES:
+        return _TYPE_NAMES[s]
+    if s.startswith("decimal"):
+        if "(" in s:
+            inner = s[s.index("(") + 1: s.index(")")]
+            p, sc = inner.split(",")
+            return T.DecimalType(int(p), int(sc))
+        return T.DecimalType(10, 0)
+    raise ValueError(f"unknown type string {dt!r}")
+
+
+
+
+def _to_col_expr(c: Any) -> E.Expression:
+    """In function position, a bare string names a column (pyspark
+    convention); elsewhere strings are literals."""
+    if isinstance(c, str):
+        return E.UnresolvedAttribute(c)
+    return _to_expr(c)
+
+# ---------------------------------------------------------------------------
+# functions
+# ---------------------------------------------------------------------------
+
+def col(name: str) -> Column:
+    return Column(E.UnresolvedAttribute(name))
+
+
+column = col
+
+
+def lit(v: Any) -> Column:
+    return Column(E.Literal(v))
+
+
+def expr_col(e: E.Expression) -> Column:
+    return Column(e)
+
+
+def when(condition: Column, value) -> Column:
+    return Column(E.CaseWhen([(_to_expr(condition), _to_expr(value))], None))
+
+
+def coalesce(*cols) -> Column:
+    return Column(E.Coalesce([_to_col_expr(c) for c in cols]))
+
+
+def isnull(c) -> Column:
+    return Column(E.IsNull(_to_col_expr(c)))
+
+
+def isnan(c) -> Column:
+    return Column(E.IsNan(_to_col_expr(c)))
+
+
+# aggregates
+def _agg(fn: E.AggregateFunction) -> Column:
+    return Column(E.AggregateExpression(fn))
+
+
+def sum(c) -> Column:  # noqa: A001 - mirrors pyspark.sql.functions
+    return _agg(E.Sum(_to_col_expr(c)))
+
+
+def count(c="*") -> Column:
+    if isinstance(c, str) and c == "*":
+        return _agg(E.Count([]))
+    return _agg(E.Count([_to_col_expr(c)]))
+
+
+def avg(c) -> Column:
+    return _agg(E.Average(_to_col_expr(c)))
+
+
+mean = avg
+
+
+def min(c) -> Column:  # noqa: A001
+    return _agg(E.Min(_to_col_expr(c)))
+
+
+def max(c) -> Column:  # noqa: A001
+    return _agg(E.Max(_to_col_expr(c)))
+
+
+def first(c, ignorenulls: bool = False) -> Column:
+    return _agg(E.First(_to_col_expr(c), ignorenulls))
+
+
+def last(c, ignorenulls: bool = False) -> Column:
+    return _agg(E.Last(_to_col_expr(c), ignorenulls))
+
+
+def countDistinct(c) -> Column:
+    return Column(E.AggregateExpression(E.Count([_to_col_expr(c)]),
+                                        is_distinct=True))
+
+
+# math
+def sqrt(c) -> Column:
+    return Column(E.Sqrt(_to_col_expr(c)))
+
+
+def exp(c) -> Column:
+    return Column(E.Exp(_to_col_expr(c)))
+
+
+def log(c) -> Column:
+    return Column(E.Log(_to_col_expr(c)))
+
+
+def log10(c) -> Column:
+    return Column(E.Log10(_to_col_expr(c)))
+
+
+def abs(c) -> Column:  # noqa: A001
+    return Column(E.Abs(_to_col_expr(c)))
+
+
+def floor(c) -> Column:
+    return Column(E.Floor(_to_col_expr(c)))
+
+
+def ceil(c) -> Column:
+    return Column(E.Ceil(_to_col_expr(c)))
+
+
+def pow(a, b) -> Column:  # noqa: A001
+    return Column(E.Pow(E.Cast(_to_col_expr(a), T.DoubleT),
+                        E.Cast(_to_col_expr(b), T.DoubleT)))
+
+
+def round(c, scale: int = 0) -> Column:  # noqa: A001
+    return Column(E.Round(_to_col_expr(c), E.Literal(scale)))
+
+
+def signum(c) -> Column:
+    return Column(E.Signum(_to_col_expr(c)))
+
+
+def sin(c) -> Column:
+    return Column(E.Sin(_to_col_expr(c)))
+
+
+def cos(c) -> Column:
+    return Column(E.Cos(_to_col_expr(c)))
+
+
+def tan(c) -> Column:
+    return Column(E.Tan(_to_col_expr(c)))
+
+
+# strings
+def upper(c) -> Column:
+    return Column(E.Upper(_to_col_expr(c)))
+
+
+def lower(c) -> Column:
+    return Column(E.Lower(_to_col_expr(c)))
+
+
+def length(c) -> Column:
+    return Column(E.Length(_to_col_expr(c)))
+
+
+def trim(c) -> Column:
+    return Column(E.StringTrim(_to_col_expr(c)))
+
+
+def substring(c, pos: int, length_: int) -> Column:
+    return Column(E.Substring(_to_col_expr(c), E.Literal(pos),
+                              E.Literal(length_)))
+
+
+def concat(*cols) -> Column:
+    return Column(E.ConcatStr([_to_col_expr(c) for c in cols]))
+
+
+# datetime
+def year(c) -> Column:
+    return Column(E.Year(_to_col_expr(c)))
+
+
+def month(c) -> Column:
+    return Column(E.Month(_to_col_expr(c)))
+
+
+def dayofmonth(c) -> Column:
+    return Column(E.DayOfMonth(_to_col_expr(c)))
+
+
+def hour(c) -> Column:
+    return Column(E.Hour(_to_col_expr(c)))
+
+
+def minute(c) -> Column:
+    return Column(E.Minute(_to_col_expr(c)))
+
+
+def second(c) -> Column:
+    return Column(E.Second(_to_col_expr(c)))
+
+
+def date_add(c, days) -> Column:
+    return Column(E.DateAdd(_to_col_expr(c), _to_col_expr(days)))
+
+
+def date_sub(c, days) -> Column:
+    return Column(E.DateSub(_to_col_expr(c), _to_col_expr(days)))
+
+
+def datediff(end, start) -> Column:
+    return Column(E.DateDiff(_to_col_expr(end), _to_col_expr(start)))
+
+
+def hash(*cols) -> Column:  # noqa: A001
+    return Column(E.Murmur3Hash([_to_col_expr(c) for c in cols]))

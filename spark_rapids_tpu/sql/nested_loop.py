@@ -1,0 +1,65 @@
+"""Broadcast nested-loop join (GpuBroadcastNestedLoopJoinExecBase twin,
+590 LoC in the reference; SURVEY.md 2.2 Joins row). CPU baseline
+implementation; device version arrives with the join kernel family.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.host import HostBatch
+from spark_rapids_tpu.sql import expressions as E
+from spark_rapids_tpu.sql import physical as P
+from spark_rapids_tpu.sql import types as T
+
+
+class CpuBroadcastNestedLoopJoinExec(P.PhysicalPlan):
+    def __init__(self, join_type: str, condition: Optional[E.Expression],
+                 left: P.PhysicalPlan, right: P.PhysicalPlan,
+                 output: List[E.AttributeReference]):
+        self.children = [left, right]
+        self.join_type = join_type
+        self.condition = condition
+        self._output = output
+
+    @property
+    def output(self):
+        return self._output
+
+    def partitions(self) -> List[P.PartitionThunk]:
+        left, right = self.children
+        rschema = T.StructType([
+            T.StructField(a.name, a.data_type, a.nullable)
+            for a in right.output])
+        rb: List[HostBatch] = []
+        for t in right.partitions():
+            rb.extend(b for b in t() if b.num_rows)
+        rwhole = HostBatch.concat(rb) if rb else HostBatch.empty(rschema)
+
+        cond = None
+        if self.condition is not None:
+            cond = E.bind_references(
+                self.condition, list(left.output) + list(right.output))
+
+        def make(lt: P.PartitionThunk) -> P.PartitionThunk:
+            def run() -> Iterator[HostBatch]:
+                for b in lt():
+                    if not b.num_rows:
+                        continue
+                    nl, nr = b.num_rows, rwhole.num_rows
+                    li = np.repeat(np.arange(nl, dtype=np.int64), nr)
+                    ri = np.tile(np.arange(nr, dtype=np.int64), nl)
+                    pairs = P._gather_pair(b, rwhole, li, ri, self.schema)
+                    if cond is not None and len(li):
+                        pr = cond.eval(pairs)
+                        keep = pr.validity & pr.data.astype(bool)
+                        pairs = pairs.take(np.nonzero(keep)[0])
+                    if self.join_type in ("inner", "cross"):
+                        yield pairs
+                    else:
+                        raise NotImplementedError(
+                            f"nested loop {self.join_type}")
+            return run
+        return [make(t) for t in left.partitions()]

@@ -1,0 +1,949 @@
+"""Physical plans and CPU operators.
+
+The physical tree is what the plugin rewrites (GpuOverrides.apply in the
+reference wraps SparkPlan nodes; SURVEY.md 3.2). CPU operators here play
+the role of Spark's own execs: they are the fallback target and the
+bit-identical baseline. Execution model mirrors RDD[ColumnarBatch]:
+each operator exposes `partitions()` -> list of thunks yielding HostBatch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+from spark_rapids_tpu.columnar import murmur3
+from spark_rapids_tpu.sql import types as T
+from spark_rapids_tpu.sql import expressions as E
+
+PartitionThunk = Callable[[], Iterator[HostBatch]]
+
+
+class Partitioning:
+    num_partitions: int
+
+
+class SinglePartitioning(Partitioning):
+    num_partitions = 1
+
+    def __repr__(self):
+        return "SinglePartition"
+
+
+class HashPartitioning(Partitioning):
+    """Spark HashPartitioning: pmod(murmur3(keys, 42), n)."""
+
+    def __init__(self, exprs: List[E.Expression], num_partitions: int):
+        self.exprs = exprs
+        self.num_partitions = num_partitions
+
+    def partition_ids(self, batch: HostBatch,
+                      bound_exprs: List[E.Expression]) -> np.ndarray:
+        h = E.Murmur3Hash(bound_exprs).eval(batch).data.astype(np.int64)
+        return np.mod(h, self.num_partitions).astype(np.int32)
+
+    def __repr__(self):
+        return f"HashPartitioning({self.exprs}, {self.num_partitions})"
+
+
+class RoundRobinPartitioning(Partitioning):
+    def __init__(self, num_partitions: int):
+        self.num_partitions = num_partitions
+
+    def __repr__(self):
+        return f"RoundRobinPartitioning({self.num_partitions})"
+
+
+class RangePartitioning(Partitioning):
+    def __init__(self, order: List[E.SortOrder], num_partitions: int):
+        self.order = order
+        self.num_partitions = num_partitions
+
+    def __repr__(self):
+        return f"RangePartitioning({self.order}, {self.num_partitions})"
+
+
+class PhysicalPlan:
+    children: List["PhysicalPlan"]
+
+    @property
+    def output(self) -> List[E.AttributeReference]:
+        raise NotImplementedError
+
+    @property
+    def schema(self) -> T.StructType:
+        return T.StructType([T.StructField(a.name, a.data_type, a.nullable)
+                             for a in self.output])
+
+    def partitions(self) -> List[PartitionThunk]:
+        raise NotImplementedError
+
+    def execute_collect(self) -> HostBatch:
+        batches: List[HostBatch] = []
+        for thunk in self.partitions():
+            batches.extend(thunk())
+        if not batches:
+            return HostBatch.empty(self.schema)
+        return HostBatch.concat(batches)
+
+    def with_new_children(self, children: List["PhysicalPlan"]
+                          ) -> "PhysicalPlan":
+        import copy
+        node = copy.copy(self)
+        node.children = list(children)
+        return node
+
+    def simple_string(self) -> str:
+        return type(self).__name__
+
+    def tree_string(self, indent: int = 0) -> str:
+        s = " " * indent + self.simple_string()
+        for c in self.children:
+            s += "\n" + c.tree_string(indent + 2)
+        return s
+
+    def __repr__(self) -> str:
+        return self.tree_string()
+
+
+def bind_list(exprs: Sequence[E.Expression],
+              inputs: Sequence[E.AttributeReference]) -> List[E.Expression]:
+    return [E.bind_references(e, inputs) for e in exprs]
+
+
+# ---------------------------------------------------------------------------
+# Sources
+# ---------------------------------------------------------------------------
+
+class CpuLocalScanExec(PhysicalPlan):
+    def __init__(self, output: List[E.AttributeReference],
+                 batches: List[HostBatch], num_partitions: int = 1):
+        self.children = []
+        self._output = output
+        self.batches = batches
+        self.num_partitions = max(1, num_partitions)
+
+    @property
+    def output(self):
+        return self._output
+
+    def partitions(self) -> List[PartitionThunk]:
+        parts: List[List[HostBatch]] = [[] for _ in
+                                        range(self.num_partitions)]
+        for i, b in enumerate(self.batches):
+            parts[i % self.num_partitions].append(b)
+        return [(lambda bs=bs: iter(bs)) for bs in parts]
+
+    def simple_string(self):
+        n = sum(b.num_rows for b in self.batches)
+        return f"LocalScan [{n} rows x {len(self._output)} cols]"
+
+
+class CpuRangeExec(PhysicalPlan):
+    def __init__(self, output, start: int, end: int, step: int,
+                 num_partitions: int):
+        self.children = []
+        self._output = output
+        self.start, self.end, self.step = start, end, step
+        self.num_partitions = max(1, num_partitions)
+
+    @property
+    def output(self):
+        return self._output
+
+    def partitions(self) -> List[PartitionThunk]:
+        total = max(0, (self.end - self.start + self.step
+                        - (1 if self.step > 0 else -1)) // self.step)
+        per = (total + self.num_partitions - 1) // self.num_partitions \
+            if total else 0
+
+        def make(pidx: int) -> PartitionThunk:
+            def run() -> Iterator[HostBatch]:
+                lo = pidx * per
+                hi = min(total, lo + per)
+                if hi <= lo:
+                    return
+                vals = (self.start
+                        + np.arange(lo, hi, dtype=np.int64) * self.step)
+                col = HostColumn.all_valid(vals, T.LongT)
+                yield HostBatch(self.schema, [col], len(vals))
+            return run
+        return [make(i) for i in range(self.num_partitions)]
+
+
+# ---------------------------------------------------------------------------
+# Row-level operators
+# ---------------------------------------------------------------------------
+
+class CpuProjectExec(PhysicalPlan):
+    def __init__(self, project_list: List[E.Expression], child: PhysicalPlan):
+        self.children = [child]
+        self.project_list = project_list
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def output(self):
+        return [E.named_output(e) for e in self.project_list]
+
+    def partitions(self) -> List[PartitionThunk]:
+        bound = bind_list(self.project_list, self.child.output)
+        schema = self.schema
+
+        def make(thunk: PartitionThunk) -> PartitionThunk:
+            def run() -> Iterator[HostBatch]:
+                for b in thunk():
+                    cols = [e.eval(b) for e in bound]
+                    yield HostBatch(schema, cols, b.num_rows)
+            return run
+        return [make(t) for t in self.child.partitions()]
+
+    def simple_string(self):
+        return f"Project {self.project_list}"
+
+
+class CpuFilterExec(PhysicalPlan):
+    def __init__(self, condition: E.Expression, child: PhysicalPlan):
+        self.children = [child]
+        self.condition = condition
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def partitions(self) -> List[PartitionThunk]:
+        bound = E.bind_references(self.condition, self.child.output)
+
+        def make(thunk: PartitionThunk) -> PartitionThunk:
+            def run() -> Iterator[HostBatch]:
+                for b in thunk():
+                    p = bound.eval(b)
+                    keep = p.validity & p.data.astype(bool)
+                    yield b.take(np.nonzero(keep)[0])
+            return run
+        return [make(t) for t in self.child.partitions()]
+
+    def simple_string(self):
+        return f"Filter {self.condition!r}"
+
+
+class CpuUnionExec(PhysicalPlan):
+    def __init__(self, children: List[PhysicalPlan],
+                 output: List[E.AttributeReference]):
+        self.children = list(children)
+        self._output = output
+
+    @property
+    def output(self):
+        return self._output
+
+    def partitions(self) -> List[PartitionThunk]:
+        out: List[PartitionThunk] = []
+        schema = self.schema
+
+        def retag(thunk: PartitionThunk) -> PartitionThunk:
+            def run():
+                for b in thunk():
+                    yield HostBatch(schema, b.columns, b.num_rows)
+            return run
+        for c in self.children:
+            out.extend(retag(t) for t in c.partitions())
+        return out
+
+
+class CpuLocalLimitExec(PhysicalPlan):
+    def __init__(self, n: int, child: PhysicalPlan):
+        self.children = [child]
+        self.n = n
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def partitions(self) -> List[PartitionThunk]:
+        n = self.n
+
+        def make(thunk: PartitionThunk) -> PartitionThunk:
+            def run() -> Iterator[HostBatch]:
+                remaining = n
+                for b in thunk():
+                    if remaining <= 0:
+                        break
+                    if b.num_rows > remaining:
+                        yield b.slice(0, remaining)
+                        remaining = 0
+                    else:
+                        yield b
+                        remaining -= b.num_rows
+            return run
+        return [make(t) for t in self.child.partitions()]
+
+
+class CpuGlobalLimitExec(CpuLocalLimitExec):
+    """Requires single-partition input (planner inserts exchange)."""
+
+
+# ---------------------------------------------------------------------------
+# Exchange
+# ---------------------------------------------------------------------------
+
+class CpuShuffleExchangeExec(PhysicalPlan):
+    """Materializes the child and redistributes rows; the Spark
+    ShuffleExchangeExec the plugin wraps (GpuShuffleExchangeExecBase)."""
+
+    def __init__(self, partitioning: Partitioning, child: PhysicalPlan):
+        self.children = [child]
+        self.partitioning = partitioning
+        self._cache: Optional[List[List[HostBatch]]] = None
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def _materialize(self) -> List[List[HostBatch]]:
+        if self._cache is not None:
+            return self._cache
+        p = self.partitioning
+        n = p.num_partitions
+        out: List[List[HostBatch]] = [[] for _ in range(n)]
+        if isinstance(p, HashPartitioning):
+            bound = bind_list(p.exprs, self.child.output)
+            for thunk in self.child.partitions():
+                for b in thunk():
+                    if b.num_rows == 0:
+                        continue
+                    pids = p.partition_ids(b, bound)
+                    for pid in range(n):
+                        idx = np.nonzero(pids == pid)[0]
+                        if len(idx):
+                            out[pid].append(b.take(idx))
+        elif isinstance(p, SinglePartitioning):
+            for thunk in self.child.partitions():
+                out[0].extend(list(thunk()))
+        elif isinstance(p, RoundRobinPartitioning):
+            i = 0
+            for thunk in self.child.partitions():
+                for b in thunk():
+                    for pid in range(n):
+                        idx = np.arange(pid, b.num_rows, n)
+                        if len(idx):
+                            out[(i + pid) % n].append(b.take(idx))
+                    i += 1
+        elif isinstance(p, RangePartitioning):
+            out = self._range_partition(p, n)
+        else:
+            raise NotImplementedError(repr(p))
+        self._cache = out
+        return out
+
+    def _range_partition(self, p: RangePartitioning, n: int
+                         ) -> List[List[HostBatch]]:
+        # Sample bounds on CPU like GpuRangePartitioner, then bucket rows.
+        all_batches: List[HostBatch] = []
+        for thunk in self.child.partitions():
+            all_batches.extend(b for b in thunk() if b.num_rows)
+        out: List[List[HostBatch]] = [[] for _ in range(n)]
+        if not all_batches:
+            return out
+        whole = HostBatch.concat(all_batches)
+        order_idx = sort_indices(
+            whole, bind_list([o.child for o in p.order], self.child.output),
+            p.order)
+        ranks = np.empty(len(order_idx), dtype=np.int64)
+        ranks[order_idx] = np.arange(len(order_idx))
+        # equal-depth bounds over the sorted rank space
+        bucket = np.minimum((ranks * n) // max(1, whole.num_rows), n - 1)
+        for pid in range(n):
+            idx = np.nonzero(bucket == pid)[0]
+            if len(idx):
+                out[pid].append(whole.take(idx))
+        return out
+
+    def partitions(self) -> List[PartitionThunk]:
+        nparts = self.partitioning.num_partitions
+
+        def make(pid: int) -> PartitionThunk:
+            def run() -> Iterator[HostBatch]:
+                return iter(self._materialize()[pid])
+            return run
+        return [make(i) for i in range(nparts)]
+
+    def simple_string(self):
+        return f"Exchange {self.partitioning!r}"
+
+
+# ---------------------------------------------------------------------------
+# Sort
+# ---------------------------------------------------------------------------
+
+def _composite_key(c: HostColumn, o: E.SortOrder) -> np.ndarray:
+    """Single int64/float64 pair encoded as structured key columns is
+    overkill here; produce a float64 key with nulls mapped to +/-inf and
+    direction applied. Exact for int53; object/large-int fall back to
+    rank-based keys."""
+    if c.data.dtype == np.dtype(object):
+        vals = c.to_pylist()
+        uniq = sorted({v for v in vals if v is not None})
+        ranks = {v: i + 1 for i, v in enumerate(uniq)}
+        base = np.array([np.nan if v is None else float(ranks[v])
+                         for v in vals], dtype=np.float64)
+    elif np.issubdtype(c.data.dtype, np.floating) \
+            or c.data.dtype == np.int64:
+        # rank-based keys: exact beyond float64's 53-bit mantissa for
+        # int64/timestamp, and for the uint64 float total-order keys
+        raw = (E._float_total_order(c.data)
+               if np.issubdtype(c.data.dtype, np.floating) else c.data)
+        su = np.unique(raw)
+        r = np.searchsorted(su, raw).astype(np.float64)
+        base = np.where(c.validity, r, np.nan)
+    else:
+        base = np.where(c.validity, c.data.astype(np.float64), np.nan)
+    if not o.ascending:
+        base = -base
+    null_key = -np.inf if o.nulls_first else np.inf
+    return np.where(np.isnan(base), null_key, base)
+
+
+def sort_indices(batch: HostBatch, bound_children: List[E.Expression],
+                 order: List[E.SortOrder]) -> np.ndarray:
+    keys = [_composite_key(e.eval(batch), o)
+            for e, o in zip(bound_children, order)]
+    return np.lexsort(keys[::-1])
+
+
+class CpuSortExec(PhysicalPlan):
+    def __init__(self, order: List[E.SortOrder], is_global: bool,
+                 child: PhysicalPlan):
+        self.children = [child]
+        self.order = order
+        self.is_global = is_global
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def partitions(self) -> List[PartitionThunk]:
+        bound = bind_list([o.child for o in self.order], self.child.output)
+
+        def make(thunk: PartitionThunk) -> PartitionThunk:
+            def run() -> Iterator[HostBatch]:
+                batches = [b for b in thunk() if b.num_rows]
+                if not batches:
+                    return
+                whole = HostBatch.concat(batches)
+                idx = sort_indices(whole, bound, self.order)
+                yield whole.take(idx)
+            return run
+        return [make(t) for t in self.child.partitions()]
+
+    def simple_string(self):
+        return f"Sort {self.order} global={self.is_global}"
+
+
+# ---------------------------------------------------------------------------
+# Hash aggregate (partial/final split mirroring Spark;
+# aggregate.scala:247 in the reference)
+# ---------------------------------------------------------------------------
+
+def group_ids(key_cols: List[HostColumn], n: int
+              ) -> Tuple[np.ndarray, int, np.ndarray]:
+    """(group_id per row, num_groups, representative row per group).
+    Nulls form groups; NaN normalized; -0.0 == 0.0."""
+    gids = np.empty(n, dtype=np.int64)
+    table: Dict[Tuple, int] = {}
+    reps: List[int] = []
+    key_lists = []
+    for c in key_cols:
+        if np.issubdtype(c.data.dtype, np.floating):
+            key_lists.append([None if not c.validity[i]
+                              else ("NaN" if np.isnan(c.data[i])
+                                    else float(c.data[i]) + 0.0)
+                              for i in range(n)])
+        elif c.data.dtype == np.dtype(object):
+            key_lists.append([c.data[i] if c.validity[i] else None
+                              for i in range(n)])
+        else:
+            key_lists.append([c.data[i].item() if c.validity[i] else None
+                              for i in range(n)])
+    for i in range(n):
+        k = tuple(kl[i] for kl in key_lists)
+        gid = table.get(k)
+        if gid is None:
+            gid = len(table)
+            table[k] = gid
+            reps.append(i)
+        gids[i] = gid
+    return gids, len(table), np.array(reps, dtype=np.int64)
+
+
+def apply_update_prim(prim: str, col: HostColumn, gids: np.ndarray,
+                      ngroups: int, out_type: T.DataType) -> HostColumn:
+    np_dt = T.numpy_dtype(out_type)
+    valid = col.validity
+    if prim == E.PRIM_COUNT:
+        counts = np.zeros(ngroups, dtype=np.int64)
+        np.add.at(counts, gids[valid], 1)
+        return HostColumn.all_valid(counts, T.LongT)
+    if prim in (E.PRIM_SUM, E.PRIM_SUM_NONNULL):
+        if np_dt == np.dtype(object):
+            raise TypeError("sum of non-numeric")
+        acc = np.zeros(ngroups, dtype=np_dt)
+        with np.errstate(all="ignore"):
+            np.add.at(acc, gids[valid], col.data[valid].astype(np_dt))
+        has = np.zeros(ngroups, dtype=bool)
+        has[gids[valid]] = True
+        if prim == E.PRIM_SUM_NONNULL:
+            return HostColumn.all_valid(acc, out_type)
+        return HostColumn(out_type, acc, has).normalized()
+    if prim in (E.PRIM_FIRST_ANY, E.PRIM_LAST_ANY):
+        # first/last row per group INCLUDING nulls (Spark ignoreNulls=false)
+        if np_dt == np.dtype(object):
+            data = np.full(ngroups, "", dtype=object)
+        else:
+            data = np.zeros(ngroups, dtype=np_dt)
+        validity = np.zeros(ngroups, dtype=bool)
+        touched = np.zeros(ngroups, dtype=bool)
+        for i in range(len(col.data)):
+            g = gids[i]
+            if prim == E.PRIM_FIRST_ANY and touched[g]:
+                continue
+            touched[g] = True
+            validity[g] = valid[i]
+            if valid[i]:
+                data[g] = col.data[i]
+        return HostColumn(out_type, data, validity).normalized()
+    if prim in (E.PRIM_MIN, E.PRIM_MAX, E.PRIM_FIRST, E.PRIM_LAST):
+        if np_dt == np.dtype(object):
+            data = np.full(ngroups, "", dtype=object)
+        else:
+            data = np.zeros(ngroups, dtype=np_dt)
+        has = np.zeros(ngroups, dtype=bool)
+        is_float = np.issubdtype(col.data.dtype, np.floating) \
+            and np_dt != np.dtype(object)
+        fk = E._float_total_order(col.data) if is_float else None
+        best_key = {}
+        for i in range(len(col.data)):
+            if not valid[i]:
+                continue
+            g = gids[i]
+            v = col.data[i]
+            if not has[g]:
+                has[g] = True
+                data[g] = v
+                if is_float:
+                    best_key[g] = fk[i]
+                continue
+            if prim == E.PRIM_FIRST:
+                continue
+            if prim == E.PRIM_LAST:
+                data[g] = v
+            elif is_float:
+                if (prim == E.PRIM_MIN and fk[i] < best_key[g]) or \
+                        (prim == E.PRIM_MAX and fk[i] > best_key[g]):
+                    best_key[g] = fk[i]
+                    data[g] = v
+            else:
+                if (prim == E.PRIM_MIN and v < data[g]) or \
+                        (prim == E.PRIM_MAX and v > data[g]):
+                    data[g] = v
+        return HostColumn(out_type, data, has).normalized()
+    raise NotImplementedError(prim)
+
+
+class AggSlot:
+    """One buffer slot of one aggregate function, with its attribute."""
+
+    def __init__(self, name: str, dtype: T.DataType, update_prim: str,
+                 update_expr: E.Expression, merge_prim: str):
+        self.name = name
+        self.dtype = dtype
+        self.update_prim = update_prim
+        self.update_expr = update_expr
+        self.merge_prim = merge_prim
+        self.attr = E.AttributeReference(name, dtype, True)
+
+
+def plan_agg_slots(aggregates: List[E.Expression]) -> Dict[int, List[AggSlot]]:
+    """aggregate Alias expr_id -> its slots."""
+    out: Dict[int, List[AggSlot]] = {}
+    for e in aggregates:
+        if isinstance(e, E.Alias) and isinstance(e.child,
+                                                 E.AggregateExpression):
+            if e.child.is_distinct:
+                raise NotImplementedError(
+                    "DISTINCT aggregates are not supported yet; rewrite "
+                    "with dropDuplicates + aggregate")
+            func = e.child.func
+            slots = [AggSlot(f"{e.name}_{s[0]}", s[1], s[2], s[3], s[4])
+                     for s in func.buffer_slots()]
+            out[e.expr_id] = slots
+    return out
+
+
+class CpuHashAggregateExec(PhysicalPlan):
+    """mode: 'partial' emits keys+buffers; 'final' merges buffers and
+    projects results; 'complete' does both in one node."""
+
+    def __init__(self, grouping: List[E.AttributeReference],
+                 aggregates: List[E.Expression], mode: str,
+                 child: PhysicalPlan,
+                 slots: Optional[Dict[int, List[AggSlot]]] = None):
+        self.children = [child]
+        self.grouping = grouping
+        self.aggregates = aggregates
+        self.mode = mode
+        self.slots = slots if slots is not None else \
+            plan_agg_slots(aggregates)
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def output(self):
+        if self.mode == "partial":
+            out = list(self.grouping)
+            for e in self.aggregates:
+                if isinstance(e, E.Alias) and isinstance(
+                        e.child, E.AggregateExpression):
+                    out.extend(s.attr for s in self.slots[e.expr_id])
+            return out
+        return [E.named_output(e) for e in self.aggregates]
+
+    def partitions(self) -> List[PartitionThunk]:
+        return [self._make(t) for t in self.child.partitions()]
+
+    def _make(self, thunk: PartitionThunk) -> PartitionThunk:
+        def run() -> Iterator[HostBatch]:
+            batches = [b for b in thunk() if b.num_rows]
+            grouped = len(self.grouping) > 0
+            if not batches:
+                if not grouped and self.mode in ("final", "complete"):
+                    yield self._empty_global_result()
+                return
+            whole = HostBatch.concat(batches)
+            yield self._aggregate(whole)
+        return run
+
+    def _aggregate(self, whole: HostBatch) -> HostBatch:
+        child_out = self.child.output
+        key_bound = bind_list(list(self.grouping), child_out)
+        key_cols = [e.eval(whole) for e in key_bound]
+        if self.grouping:
+            gids, ngroups, reps = group_ids(key_cols, whole.num_rows)
+        else:
+            gids = np.zeros(whole.num_rows, dtype=np.int64)
+            ngroups, reps = 1, np.array([0], dtype=np.int64)
+
+        out_cols: List[HostColumn] = []
+        if self.mode == "partial":
+            for kc in key_cols:
+                out_cols.append(kc.take(reps))
+            for e in self.aggregates:
+                if isinstance(e, E.Alias) and isinstance(
+                        e.child, E.AggregateExpression):
+                    for s in self.slots[e.expr_id]:
+                        prim = s.update_prim
+                        bound = E.bind_references(s.update_expr, child_out)
+                        col = bound.eval(whole)
+                        out_cols.append(apply_update_prim(
+                            prim, col, gids, ngroups, s.dtype))
+            return HostBatch(self.schema, out_cols, ngroups)
+
+        # final / complete: compute merged buffers per group
+        merged: Dict[int, List[HostColumn]] = {}
+        for e in self.aggregates:
+            if isinstance(e, E.Alias) and isinstance(e.child,
+                                                     E.AggregateExpression):
+                cols = []
+                for s in self.slots[e.expr_id]:
+                    if self.mode == "complete":
+                        prim, src = s.update_prim, s.update_expr
+                    else:
+                        prim, src = s.merge_prim, s.attr
+                    bound = E.bind_references(src, child_out)
+                    col = bound.eval(whole)
+                    cols.append(apply_update_prim(
+                        prim, col, gids, ngroups, s.dtype))
+                merged[e.expr_id] = cols
+
+        key_by_attr = {a.expr_id: kc.take(reps)
+                       for a, kc in zip(self.grouping, key_cols)}
+        for e in self.aggregates:
+            if isinstance(e, E.Alias) and isinstance(e.child,
+                                                     E.AggregateExpression):
+                out_cols.append(e.child.func.evaluate(merged[e.expr_id]))
+            elif isinstance(e, E.AttributeReference):
+                out_cols.append(key_by_attr[e.expr_id])
+            elif isinstance(e, E.Alias) and isinstance(e.child,
+                                                       E.AttributeReference):
+                out_cols.append(key_by_attr[e.child.expr_id])
+            else:
+                raise NotImplementedError(f"agg result expr {e!r}")
+        return HostBatch(self.schema, out_cols, ngroups)
+
+    def _empty_global_result(self) -> HostBatch:
+        """Global agg over empty input yields one row (sum=null, count=0)."""
+        cols = []
+        for e in self.aggregates:
+            assert isinstance(e, E.Alias)
+            func = e.child.func
+            buffers = [HostColumn.nulls(1, s.dtype)
+                       for s in self.slots[e.expr_id]]
+            cols.append(func.evaluate(buffers))
+        return HostBatch(self.schema, cols, 1)
+
+    def simple_string(self):
+        return (f"HashAggregate mode={self.mode} keys={self.grouping} "
+                f"aggs={self.aggregates}")
+
+
+# ---------------------------------------------------------------------------
+# Joins (CPU shuffled hash join; GpuShuffledHashJoinBase twin)
+# ---------------------------------------------------------------------------
+
+class CpuShuffledHashJoinExec(PhysicalPlan):
+    def __init__(self, left_keys: List[E.Expression],
+                 right_keys: List[E.Expression], join_type: str,
+                 condition: Optional[E.Expression],
+                 left: PhysicalPlan, right: PhysicalPlan,
+                 output: List[E.AttributeReference]):
+        self.children = [left, right]
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+        self.join_type = join_type
+        self.condition = condition
+        self._output = output
+
+    @property
+    def left(self):
+        return self.children[0]
+
+    @property
+    def right(self):
+        return self.children[1]
+
+    @property
+    def output(self):
+        return self._output
+
+    def partitions(self) -> List[PartitionThunk]:
+        lp = self.left.partitions()
+        rp = self.right.partitions()
+        assert len(lp) == len(rp), "join children must be co-partitioned"
+        return [self._make(lt, rt) for lt, rt in zip(lp, rp)]
+
+    def _key_tuples(self, batch: HostBatch, keys: List[E.Expression],
+                    inputs) -> List[Optional[Tuple]]:
+        cols = [E.bind_references(k, inputs).eval(batch) for k in keys]
+        out: List[Optional[Tuple]] = []
+        for i in range(batch.num_rows):
+            parts = []
+            null = False
+            for c in cols:
+                if not c.validity[i]:
+                    null = True
+                    break
+                v = c.data[i]
+                if isinstance(v, np.generic):
+                    v = v.item()
+                if isinstance(v, float):
+                    v = "NaN" if v != v else v + 0.0
+                parts.append(v)
+            out.append(None if null else tuple(parts))
+        return out
+
+    def _make(self, lt: PartitionThunk, rt: PartitionThunk) -> PartitionThunk:
+        def run() -> Iterator[HostBatch]:
+            lb = [b for b in lt() if b.num_rows]
+            rb = [b for b in rt() if b.num_rows]
+            jt = self.join_type
+            lschema = T.StructType([
+                T.StructField(a.name, a.data_type, a.nullable)
+                for a in self.left.output])
+            rschema = T.StructType([
+                T.StructField(a.name, a.data_type, a.nullable)
+                for a in self.right.output])
+            lwhole = HostBatch.concat(lb) if lb else HostBatch.empty(lschema)
+            rwhole = HostBatch.concat(rb) if rb else HostBatch.empty(rschema)
+            yield self._join(lwhole, rwhole)
+        return run
+
+    def _join(self, lwhole: HostBatch, rwhole: HostBatch) -> HostBatch:
+        jt = self.join_type
+        # build on right
+        build_map: Dict[Tuple, List[int]] = {}
+        rkeys = self._key_tuples(rwhole, self.right_keys, self.right.output)
+        for i, k in enumerate(rkeys):
+            if k is not None:
+                build_map.setdefault(k, []).append(i)
+        lkeys = self._key_tuples(lwhole, self.left_keys, self.left.output)
+
+        cond = None
+        if self.condition is not None:
+            cond = E.bind_references(
+                self.condition, list(self.left.output)
+                + list(self.right.output))
+
+        li: List[int] = []
+        ri: List[int] = []
+        lmatched = np.zeros(lwhole.num_rows, dtype=bool)
+        rmatched = np.zeros(rwhole.num_rows, dtype=bool)
+        for i, k in enumerate(lkeys):
+            if k is None:
+                continue
+            for j in build_map.get(k, ()):
+                li.append(i)
+                ri.append(j)
+        li_a = np.array(li, dtype=np.int64)
+        ri_a = np.array(ri, dtype=np.int64)
+        if cond is not None and len(li_a):
+            pairs = _gather_pair(lwhole, rwhole, li_a, ri_a,
+                                 self._pair_schema())
+            p = cond.eval(pairs)
+            keep = p.validity & p.data.astype(bool)
+            li_a, ri_a = li_a[keep], ri_a[keep]
+        lmatched[li_a] = True
+        rmatched[ri_a] = True
+
+        if jt == "inner" or jt == "cross":
+            return _gather_pair(lwhole, rwhole, li_a, ri_a, self.schema)
+        if jt in ("left", "leftouter"):
+            extra = np.nonzero(~lmatched)[0]
+            li_a = np.concatenate([li_a, extra])
+            ri_a = np.concatenate([ri_a, np.full(len(extra), -1,
+                                                 dtype=np.int64)])
+            return _gather_pair(lwhole, rwhole, li_a, ri_a, self.schema)
+        if jt in ("right", "rightouter"):
+            extra = np.nonzero(~rmatched)[0]
+            li_a = np.concatenate([li_a, np.full(len(extra), -1,
+                                                 dtype=np.int64)])
+            ri_a = np.concatenate([ri_a, extra])
+            return _gather_pair(lwhole, rwhole, li_a, ri_a, self.schema)
+        if jt in ("full", "fullouter"):
+            lex = np.nonzero(~lmatched)[0]
+            rex = np.nonzero(~rmatched)[0]
+            li_a = np.concatenate([li_a, lex,
+                                   np.full(len(rex), -1, dtype=np.int64)])
+            ri_a = np.concatenate([ri_a,
+                                   np.full(len(lex), -1, dtype=np.int64),
+                                   rex])
+            return _gather_pair(lwhole, rwhole, li_a, ri_a, self.schema)
+        if jt == "leftsemi":
+            idx = np.nonzero(lmatched)[0]
+            return lwhole.take(idx)
+        if jt == "leftanti":
+            # anti keeps rows with no match; null-keyed rows never match
+            idx = np.nonzero(~lmatched)[0]
+            return lwhole.take(idx)
+        raise NotImplementedError(jt)
+
+    def _pair_schema(self) -> T.StructType:
+        attrs = list(self.left.output) + list(self.right.output)
+        return T.StructType([T.StructField(a.name, a.data_type, a.nullable)
+                             for a in attrs])
+
+    def simple_string(self):
+        return (f"ShuffledHashJoin {self.join_type} "
+                f"l={self.left_keys} r={self.right_keys} "
+                f"cond={self.condition!r}")
+
+
+def _gather_pair(lwhole: HostBatch, rwhole: HostBatch, li: np.ndarray,
+                 ri: np.ndarray, schema: T.StructType) -> HostBatch:
+    """Gather rows from both sides; index -1 = null row (outer joins)."""
+    cols: List[HostColumn] = []
+    nl = lwhole.num_cols
+    fields = list(schema.fields)
+    for c_idx in range(nl):
+        cols.append(_gather_nullable(lwhole.columns[c_idx], li))
+    for c_idx in range(rwhole.num_cols):
+        cols.append(_gather_nullable(rwhole.columns[c_idx], ri))
+    return HostBatch(schema, cols, len(li))
+
+
+def _gather_nullable(c: HostColumn, idx: np.ndarray) -> HostColumn:
+    safe = np.where(idx >= 0, idx, 0)
+    data = c.data[safe]
+    validity = np.where(idx >= 0, c.validity[safe], False)
+    out = HostColumn(c.dtype, data.copy(), validity.astype(bool))
+    return out.normalized()
+
+
+class CpuBroadcastHashJoinExec(CpuShuffledHashJoinExec):
+    """Build side fully materialized and shared across stream partitions
+    (GpuBroadcastHashJoinExec twin; build side = right)."""
+
+    def partitions(self) -> List[PartitionThunk]:
+        rschema = T.StructType([
+            T.StructField(a.name, a.data_type, a.nullable)
+            for a in self.right.output])
+        rbatches: List[HostBatch] = []
+        for t in self.right.partitions():
+            rbatches.extend(b for b in t() if b.num_rows)
+        rwhole = (HostBatch.concat(rbatches) if rbatches
+                  else HostBatch.empty(rschema))
+
+        def make(lt: PartitionThunk) -> PartitionThunk:
+            def run() -> Iterator[HostBatch]:
+                lb = [b for b in lt() if b.num_rows]
+                lschema = T.StructType([
+                    T.StructField(a.name, a.data_type, a.nullable)
+                    for a in self.left.output])
+                lwhole = (HostBatch.concat(lb) if lb
+                          else HostBatch.empty(lschema))
+                yield self._join(lwhole, rwhole)
+            return run
+        return [make(t) for t in self.left.partitions()]
+
+
+class CpuExpandExec(PhysicalPlan):
+    def __init__(self, projections: List[List[E.Expression]],
+                 output: List[E.AttributeReference], child: PhysicalPlan):
+        self.children = [child]
+        self.projections = projections
+        self._output = output
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def output(self):
+        return self._output
+
+    def partitions(self) -> List[PartitionThunk]:
+        bound = [bind_list(p, self.child.output) for p in self.projections]
+        schema = self.schema
+
+        def make(thunk: PartitionThunk) -> PartitionThunk:
+            def run() -> Iterator[HostBatch]:
+                for b in thunk():
+                    outs = []
+                    for proj in bound:
+                        cols = [e.eval(b) for e in proj]
+                        outs.append(HostBatch(schema, cols, b.num_rows))
+                    if outs:
+                        yield HostBatch.concat(outs)
+            return run
+        return [make(t) for t in self.child.partitions()]

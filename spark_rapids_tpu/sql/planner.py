@@ -1,0 +1,219 @@
+"""Logical -> physical planning (the role Spark's SparkPlanner +
+EnsureRequirements plays in the reference). Produces the CPU physical plan
+that the plugin's TpuOverrides then rewrites (Plugin.scala:48 hook point).
+
+Planning decisions mirrored from Spark:
+- Aggregate splits into partial -> hash exchange on keys -> final.
+- Equi-joins become exchange(left) + exchange(right) + shuffled hash join,
+  or broadcast hash join when the build side is a small LocalRelation
+  (autoBroadcastJoinThreshold analogue).
+- Global sort inserts a range-partitioning exchange; the reference replaces
+  SortMergeJoin with shuffled hash join (GpuSortMergeJoinExec.scala:72-92),
+  so we never plan SMJ at all.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from spark_rapids_tpu.conf import TpuConf, SHUFFLE_PARTITIONS
+from spark_rapids_tpu.sql import expressions as E
+from spark_rapids_tpu.sql import logical as L
+from spark_rapids_tpu.sql import physical as P
+from spark_rapids_tpu.sql import types as T
+
+BROADCAST_THRESHOLD_ROWS = 100_000
+
+
+class Planner:
+    def __init__(self, conf: TpuConf):
+        self.conf = conf
+        self.shuffle_partitions = conf.shuffle_partitions
+
+    def plan(self, plan: L.LogicalPlan) -> P.PhysicalPlan:
+        m = getattr(self, f"_plan_{type(plan).__name__.lower()}", None)
+        if m is None:
+            raise NotImplementedError(
+                f"no physical planning for {type(plan).__name__}")
+        return m(plan)
+
+    # -- sources -----------------------------------------------------------
+    def _plan_localrelation(self, p: L.LocalRelation) -> P.PhysicalPlan:
+        return P.CpuLocalScanExec(p.output, p.batches, p.num_partitions)
+
+    def _plan_filescan(self, p: L.FileScan) -> P.PhysicalPlan:
+        from spark_rapids_tpu.io.readers import CpuFileScanExec
+        return CpuFileScanExec(p.output, p.fmt, p.paths, p.options,
+                               self.conf)
+
+    def _plan_range(self, p: L.Range) -> P.PhysicalPlan:
+        return P.CpuRangeExec(p.output, p.start, p.end, p.step,
+                              p.num_partitions)
+
+    # -- simple unary ------------------------------------------------------
+    def _plan_project(self, p: L.Project) -> P.PhysicalPlan:
+        return P.CpuProjectExec(p.project_list, self.plan(p.child))
+
+    def _plan_filter(self, p: L.Filter) -> P.PhysicalPlan:
+        return P.CpuFilterExec(p.condition, self.plan(p.child))
+
+    def _plan_union(self, p: L.Union) -> P.PhysicalPlan:
+        return P.CpuUnionExec([self.plan(c) for c in p.children], p.output)
+
+    def _plan_limit(self, p: L.Limit) -> P.PhysicalPlan:
+        child = self.plan(p.child)
+        local = P.CpuLocalLimitExec(p.n, child)
+        single = P.CpuShuffleExchangeExec(P.SinglePartitioning(), local)
+        return P.CpuGlobalLimitExec(p.n, single)
+
+    def _plan_sort(self, p: L.Sort) -> P.PhysicalPlan:
+        child = self.plan(p.child)
+        if p.is_global:
+            npart = min(self.shuffle_partitions,
+                        max(1, self.shuffle_partitions))
+            child = P.CpuShuffleExchangeExec(
+                P.RangePartitioning(p.order, npart), child)
+        return P.CpuSortExec(p.order, p.is_global, child)
+
+    def _plan_repartition(self, p: L.Repartition) -> P.PhysicalPlan:
+        child = self.plan(p.child)
+        if p.by is not None:
+            part = P.HashPartitioning(p.by, p.num_partitions)
+        else:
+            part = P.RoundRobinPartitioning(p.num_partitions)
+        return P.CpuShuffleExchangeExec(part, child)
+
+    def _plan_expand(self, p: L.Expand) -> P.PhysicalPlan:
+        return P.CpuExpandExec(p.projections, p.output, self.plan(p.child))
+
+    def _plan_window(self, p: L.Window) -> P.PhysicalPlan:
+        from spark_rapids_tpu.sql.window_exec import CpuWindowExec
+        child = self.plan(p.child)
+        if p.partition_spec:
+            child = P.CpuShuffleExchangeExec(
+                P.HashPartitioning(p.partition_spec,
+                                   self.shuffle_partitions), child)
+        else:
+            child = P.CpuShuffleExchangeExec(P.SinglePartitioning(), child)
+        return CpuWindowExec(p.window_exprs, p.partition_spec, p.order_spec,
+                             child)
+
+    # -- aggregate ---------------------------------------------------------
+    def _plan_aggregate(self, p: L.Aggregate) -> P.PhysicalPlan:
+        child = self.plan(p.child)
+        # grouping must be attributes; project aliased keys first, reusing
+        # the Alias' own id so result expressions bind to the same attr
+        grouping_attrs: List[E.AttributeReference] = []
+        pre_proj: List[E.Expression] = list(child.output)
+        need_proj = False
+        aggregates = list(p.aggregates)
+        for g in p.grouping:
+            if isinstance(g, E.AttributeReference):
+                grouping_attrs.append(g)
+            elif isinstance(g, E.Alias):
+                pre_proj.append(g)
+                grouping_attrs.append(g.to_attribute())
+                need_proj = True
+            else:
+                alias = E.Alias(g, f"_groupingexpr_{len(grouping_attrs)}")
+                pre_proj.append(alias)
+                grouping_attrs.append(alias.to_attribute())
+                need_proj = True
+        if need_proj:
+            child = P.CpuProjectExec(pre_proj, child)
+
+        slots = P.plan_agg_slots(aggregates)
+        partial = P.CpuHashAggregateExec(grouping_attrs, aggregates,
+                                         "partial", child, slots)
+        if grouping_attrs:
+            exchange = P.CpuShuffleExchangeExec(
+                P.HashPartitioning(list(grouping_attrs),
+                                   self.shuffle_partitions), partial)
+        else:
+            exchange = P.CpuShuffleExchangeExec(P.SinglePartitioning(),
+                                                partial)
+        return P.CpuHashAggregateExec(grouping_attrs, aggregates, "final",
+                                      exchange, slots)
+
+    # -- join --------------------------------------------------------------
+    def _plan_join(self, p: L.Join) -> P.PhysicalPlan:
+        left = self.plan(p.left)
+        right = self.plan(p.right)
+        left_keys, right_keys, residual = split_equi_join(
+            p.condition, p.left.output, p.right.output)
+        if not left_keys:
+            if p.join_type in ("inner", "cross"):
+                return self._nested_loop(p, left, right)
+            raise NotImplementedError(
+                f"non-equi {p.join_type} join not supported yet")
+
+        small_right = isinstance(p.right, L.LocalRelation) and sum(
+            b.num_rows for b in p.right.batches) < BROADCAST_THRESHOLD_ROWS
+        if small_right and p.join_type in ("inner", "left", "leftouter",
+                                           "leftsemi", "leftanti", "cross"):
+            return P.CpuBroadcastHashJoinExec(
+                left_keys, right_keys, p.join_type, residual, left, right,
+                p.output)
+        n = self.shuffle_partitions
+        lex = P.CpuShuffleExchangeExec(P.HashPartitioning(left_keys, n),
+                                       left)
+        rex = P.CpuShuffleExchangeExec(P.HashPartitioning(right_keys, n),
+                                       right)
+        return P.CpuShuffledHashJoinExec(left_keys, right_keys, p.join_type,
+                                         residual, lex, rex, p.output)
+
+    def _nested_loop(self, p: L.Join, left: P.PhysicalPlan,
+                     right: P.PhysicalPlan) -> P.PhysicalPlan:
+        from spark_rapids_tpu.sql.nested_loop import (
+            CpuBroadcastNestedLoopJoinExec)
+        return CpuBroadcastNestedLoopJoinExec(p.join_type, p.condition,
+                                              left, right, p.output)
+
+
+def split_equi_join(condition: Optional[E.Expression],
+                    left_out, right_out
+                    ) -> Tuple[List[E.Expression], List[E.Expression],
+                               Optional[E.Expression]]:
+    """Split a join condition into equi-key pairs + residual conjuncts
+    (Spark ExtractEquiJoinKeys)."""
+    if condition is None:
+        return [], [], None
+    left_ids = {a.expr_id for a in left_out}
+    right_ids = {a.expr_id for a in right_out}
+
+    def side(e: E.Expression) -> Optional[str]:
+        ids = {a.expr_id for a in e.references()}
+        if not ids:
+            return "none"
+        if ids <= left_ids:
+            return "left"
+        if ids <= right_ids:
+            return "right"
+        return None
+
+    conjuncts = split_conjuncts(condition)
+    lk: List[E.Expression] = []
+    rk: List[E.Expression] = []
+    residual: List[E.Expression] = []
+    for c in conjuncts:
+        if isinstance(c, E.EqualTo):
+            sl, sr = side(c.left), side(c.right)
+            if sl == "left" and sr == "right":
+                lk.append(c.left)
+                rk.append(c.right)
+                continue
+            if sl == "right" and sr == "left":
+                lk.append(c.right)
+                rk.append(c.left)
+                continue
+        residual.append(c)
+    res = None
+    for r in residual:
+        res = r if res is None else E.And(res, r)
+    return lk, rk, res
+
+
+def split_conjuncts(e: E.Expression) -> List[E.Expression]:
+    if isinstance(e, E.And):
+        return split_conjuncts(e.left) + split_conjuncts(e.right)
+    return [e]

@@ -1,0 +1,242 @@
+"""TpuSparkSession: the SparkSession-shaped entry point.
+
+Mirrors the role Spark's session + the plugin's ColumnarOverrideRules hook
+play in the reference (Plugin.scala:44-50): after CPU physical planning,
+`spark.rapids.sql.enabled` routes the plan through the TpuOverrides rewrite
+before execution.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from spark_rapids_tpu.conf import TpuConf
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+from spark_rapids_tpu.sql import expressions as E
+from spark_rapids_tpu.sql import logical as L
+from spark_rapids_tpu.sql import types as T
+from spark_rapids_tpu.sql.dataframe import DataFrame
+from spark_rapids_tpu.sql.planner import Planner
+
+
+class RuntimeConfApi:
+    """spark.conf facade."""
+
+    def __init__(self, conf: TpuConf):
+        self._conf = conf
+
+    def set(self, key: str, value: Any) -> None:
+        self._conf.set(key, value)
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._conf.get_key(key, default)
+
+    def unset(self, key: str) -> None:
+        self._conf.settings.pop(key, None)
+
+
+class TpuSparkSession:
+    _active: Optional["TpuSparkSession"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, conf: Optional[Dict[str, Any]] = None):
+        self.conf_obj = TpuConf(conf)
+        self.conf = RuntimeConfApi(self.conf_obj)
+        self.catalog_views: Dict[str, L.LogicalPlan] = {}
+        self._plan_capture: List = []  # ExecutionPlanCaptureCallback twin
+        self._capture_enabled = False
+        with TpuSparkSession._lock:
+            TpuSparkSession._active = self
+
+    # -- builder-compatible constructor
+    class Builder:
+        def __init__(self):
+            self._conf: Dict[str, Any] = {}
+
+        def config(self, key: str, value: Any) -> "TpuSparkSession.Builder":
+            self._conf[key] = value
+            return self
+
+        def appName(self, name: str) -> "TpuSparkSession.Builder":
+            return self
+
+        def master(self, m: str) -> "TpuSparkSession.Builder":
+            return self
+
+        def getOrCreate(self) -> "TpuSparkSession":
+            return TpuSparkSession(self._conf)
+
+    builder = None  # set below
+
+    @staticmethod
+    def active() -> "TpuSparkSession":
+        if TpuSparkSession._active is None:
+            TpuSparkSession._active = TpuSparkSession()
+        return TpuSparkSession._active
+
+    # -- data sources ------------------------------------------------------
+    def createDataFrame(self, data, schema=None,
+                        num_partitions: int = 2) -> DataFrame:
+        batch = _infer_batch(data, schema)
+        # split into partitions for realistic multi-partition plans
+        np_ = max(1, min(num_partitions, max(1, batch.num_rows)))
+        if np_ == 1 or batch.num_rows == 0:
+            batches = [batch]
+        else:
+            per = (batch.num_rows + np_ - 1) // np_
+            batches = [batch.slice(i * per, (i + 1) * per)
+                       for i in range(np_)
+                       if batch.slice(i * per, (i + 1) * per).num_rows > 0]
+        rel = L.LocalRelation(batch.schema, batches, len(batches))
+        return DataFrame(rel, self)
+
+    def range(self, start: int, end: Optional[int] = None, step: int = 1,
+              numPartitions: int = 2) -> DataFrame:
+        if end is None:
+            start, end = 0, start
+        return DataFrame(L.Range(start, end, step, numPartitions), self)
+
+    @property
+    def read(self):
+        from spark_rapids_tpu.io.readers import DataFrameReader
+        return DataFrameReader(self)
+
+    def table(self, name: str) -> DataFrame:
+        return DataFrame(self.catalog_views[name.lower()], self)
+
+    def sql(self, query: str) -> DataFrame:
+        from spark_rapids_tpu.sql.parser import parse_sql
+        return parse_sql(query, self)
+
+    # -- execution ---------------------------------------------------------
+    def plan_physical(self, plan: L.LogicalPlan):
+        """CPU physical plan, then the plugin rewrite when enabled."""
+        physical = Planner(self.conf_obj).plan(plan)
+        if self.conf_obj.sql_enabled:
+            from spark_rapids_tpu.overrides import apply_overrides
+            physical = apply_overrides(physical, self.conf_obj)
+        if self._capture_enabled:
+            self._plan_capture.append(physical)
+        return physical
+
+    def execute_plan(self, plan: L.LogicalPlan) -> HostBatch:
+        return self.plan_physical(plan).execute_collect()
+
+    def explain_string(self, plan: L.LogicalPlan) -> str:
+        physical = self.plan_physical(plan)
+        return f"== Logical ==\n{plan!r}\n== Physical ==\n{physical!r}"
+
+    # -- plan capture (ExecutionPlanCaptureCallback, Plugin.scala:268-390)
+    def start_capture(self) -> None:
+        self._plan_capture.clear()
+        self._capture_enabled = True
+
+    def get_captured_plans(self) -> List:
+        self._capture_enabled = False
+        return list(self._plan_capture)
+
+    def stop(self) -> None:
+        with TpuSparkSession._lock:
+            if TpuSparkSession._active is self:
+                TpuSparkSession._active = None
+
+
+class _BuilderFactory:
+    def __get__(self, obj, objtype=None):
+        return TpuSparkSession.Builder()
+
+
+TpuSparkSession.builder = _BuilderFactory()
+
+
+def _infer_batch(data, schema) -> HostBatch:
+    if isinstance(data, HostBatch):
+        return data
+    if isinstance(schema, str):
+        schema = _parse_ddl_schema(schema)
+    if isinstance(data, dict):
+        if schema is None:
+            schema = T.StructType([
+                T.StructField(k, _infer_type_from_values(v))
+                for k, v in data.items()])
+        return HostBatch.from_pydict(data, schema)
+    rows = list(data)
+    if schema is None:
+        if not rows:
+            raise ValueError("cannot infer schema from empty data")
+        first = rows[0]
+        if isinstance(first, dict):
+            names = list(first.keys())
+            cols = {n: [r.get(n) for r in rows] for n in names}
+            schema = T.StructType([
+                T.StructField(n, _infer_type_from_values(cols[n]))
+                for n in names])
+            return HostBatch.from_pydict(cols, schema)
+        names = [f"_{i + 1}" for i in range(len(first))]
+        cols = {n: [r[i] for r in rows] for i, n in enumerate(names)}
+        schema = T.StructType([
+            T.StructField(n, _infer_type_from_values(cols[n]))
+            for n in names])
+        return HostBatch.from_pydict(cols, schema)
+    if isinstance(schema, (list, tuple)):
+        names = list(schema)
+        if not rows:
+            raise ValueError("cannot infer schema from empty data")
+        cols = {n: [r[i] for r in rows] for i, n in enumerate(names)}
+        schema = T.StructType([
+            T.StructField(n, _infer_type_from_values(cols[n]))
+            for n in names])
+        return HostBatch.from_pydict(cols, schema)
+    cols = {f.name: [r[i] for r in rows]
+            for i, f in enumerate(schema.fields)}
+    return HostBatch.from_pydict(cols, schema)
+
+
+def _infer_type_from_values(values: Iterable[Any]) -> T.DataType:
+    import datetime
+    for v in values:
+        if v is None:
+            continue
+        if isinstance(v, bool):
+            return T.BooleanT
+        if isinstance(v, int):
+            return T.LongT
+        if isinstance(v, float):
+            return T.DoubleT
+        if isinstance(v, str):
+            return T.StringT
+        if isinstance(v, datetime.datetime):
+            return T.TimestampT
+        if isinstance(v, datetime.date):
+            return T.DateT
+        if isinstance(v, bytes):
+            return T.BinaryT
+    return T.StringT
+
+
+def _parse_ddl_schema(ddl: str) -> T.StructType:
+    from spark_rapids_tpu.sql.functions import _parse_type
+    # split on commas not inside parens (decimal(10,2) etc.)
+    parts: List[str] = []
+    depth = 0
+    cur = ""
+    for ch in ddl:
+        if ch == "," and depth == 0:
+            parts.append(cur)
+            cur = ""
+            continue
+        if ch in "(<":
+            depth += 1
+        elif ch in ")>":
+            depth -= 1
+        cur += ch
+    if cur.strip():
+        parts.append(cur)
+    fields = []
+    for part in parts:
+        name, _, tp = part.strip().partition(" ")
+        fields.append(T.StructField(name.strip(), _parse_type(tp.strip())))
+    return T.StructType(fields)

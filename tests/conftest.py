@@ -1,0 +1,15 @@
+"""Test config: force JAX onto a virtual 8-device CPU mesh so multi-chip
+sharding paths compile/execute without TPU hardware (SURVEY.md section 4
+blueprint: 'jax CPU devices / multiprocess ICI emulation covers what
+Mockito does' for the reference's transport suites)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

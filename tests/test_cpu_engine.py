@@ -1,0 +1,166 @@
+"""CPU engine smoke tests: the baseline half of the dual-session harness."""
+
+import math
+
+import pytest
+
+from spark_rapids_tpu.sql.session import TpuSparkSession
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql import types as T
+
+
+@pytest.fixture()
+def spark():
+    s = TpuSparkSession({"spark.rapids.sql.enabled": "false",
+                         "spark.sql.shuffle.partitions": "4"})
+    yield s
+    s.stop()
+
+
+def test_select_project(spark):
+    df = spark.createDataFrame(
+        {"a": [1, 2, None, 4], "b": [10.0, 20.0, 30.0, None]},
+        "a int, b double")
+    out = df.select((F.col("a") + 1).alias("a1"), "b").collect()
+    assert [r.a1 for r in out] == [2, 3, None, 5]
+    assert [r.b for r in out] == [10.0, 20.0, 30.0, None]
+
+
+def test_filter(spark):
+    df = spark.createDataFrame({"a": [1, 2, None, 4, 5]}, "a int")
+    out = df.filter(F.col("a") > 2).collect()
+    assert sorted(r.a for r in out) == [4, 5]
+
+
+def test_three_valued_logic(spark):
+    df = spark.createDataFrame(
+        {"a": [True, False, None], "b": [None, None, None]},
+        "a boolean, b boolean")
+    out = df.select(
+        (F.col("a") & F.col("b")).alias("and_"),
+        (F.col("a") | F.col("b")).alias("or_")).collect()
+    assert [r.and_ for r in out] == [None, False, None]
+    assert [r.or_ for r in out] == [True, None, None]
+
+
+def test_groupby_agg(spark):
+    df = spark.createDataFrame(
+        {"k": ["a", "b", "a", "b", "a", None],
+         "v": [1, 2, 3, None, 5, 10]}, "k string, v int")
+    out = df.groupBy("k").agg(
+        F.sum("v").alias("s"),
+        F.count("v").alias("c"),
+        F.avg("v").alias("m"),
+        F.min("v").alias("lo"),
+        F.max("v").alias("hi")).collect()
+    by_k = {r.k: r for r in out}
+    assert by_k["a"].s == 9 and by_k["a"].c == 3
+    assert by_k["b"].s == 2 and by_k["b"].c == 1
+    assert by_k[None].s == 10 and by_k[None].c == 1
+    assert by_k["a"].m == pytest.approx(3.0)
+    assert by_k["a"].lo == 1 and by_k["a"].hi == 5
+
+
+def test_global_agg_empty_and_nonempty(spark):
+    df = spark.createDataFrame({"v": [1, 2, 3]}, "v int")
+    out = df.agg(F.sum("v").alias("s"), F.count("*").alias("c")).collect()
+    assert out[0].s == 6 and out[0].c == 3
+    empty = df.filter(F.col("v") > 100).agg(
+        F.sum("v").alias("s"), F.count("*").alias("c")).collect()
+    assert empty[0].s is None and empty[0].c == 0
+
+
+def test_join_inner(spark):
+    left = spark.createDataFrame(
+        {"k": [1, 2, 3, None], "l": ["a", "b", "c", "d"]},
+        "k int, l string")
+    right = spark.createDataFrame(
+        {"k": [2, 3, 4, None], "r": ["x", "y", "z", "w"]},
+        "k int, r string", num_partitions=1)
+    out = left.join(right, "k").collect()
+    got = sorted((r.k, r.l, r.r) for r in out)
+    assert got == [(2, "b", "x"), (3, "c", "y")]
+
+
+def test_join_left_outer(spark):
+    left = spark.createDataFrame({"k": [1, 2], "l": ["a", "b"]},
+                                 "k int, l string")
+    right = spark.createDataFrame({"k": [2], "r": ["x"]}, "k int, r string")
+    out = left.join(right, "k", "left").collect()
+    got = {(r.k, r.l, r.r) for r in out}
+    assert got == {(1, "a", None), (2, "b", "x")}
+
+
+def test_sort(spark):
+    df = spark.createDataFrame(
+        {"a": [3, 1, None, 2], "b": [1.0, float("nan"), 2.0, None]},
+        "a int, b double")
+    out = df.orderBy(F.col("a")).collect()
+    assert [r.a for r in out] == [None, 1, 2, 3]  # nulls first asc
+    out2 = df.orderBy(F.col("a").desc()).collect()
+    assert [r.a for r in out2] == [3, 2, 1, None]  # nulls last desc
+    out3 = df.orderBy(F.col("b")).collect()
+    bs = [r.b for r in out3]
+    assert bs[0] is None and bs[1] == 1.0 and bs[2] == 2.0 \
+        and math.isnan(bs[3])  # NaN sorts greatest
+
+
+def test_limit_union_distinct(spark):
+    df = spark.createDataFrame({"a": [1, 2, 3, 4, 5]}, "a int")
+    assert df.limit(3).count() == 3
+    assert df.union(df).count() == 10
+    assert df.union(df).distinct().count() == 5
+
+
+def test_case_when_and_cast(spark):
+    df = spark.createDataFrame({"a": [1, 2, None]}, "a int")
+    out = df.select(
+        F.when(F.col("a") > 1, "big").otherwise("small").alias("c"),
+        F.col("a").cast("string").alias("s"),
+        F.col("a").cast("double").alias("d")).collect()
+    assert [r.c for r in out] == ["small", "big", "small"]
+    assert [r.s for r in out] == ["1", "2", None]
+    assert [r.d for r in out] == [1.0, 2.0, None]
+
+
+def test_string_functions(spark):
+    df = spark.createDataFrame({"s": ["Hello", "WORLD", None, ""]},
+                               "s string")
+    out = df.select(
+        F.upper("s").alias("u"), F.lower("s").alias("l"),
+        F.length("s").alias("n"),
+        F.substring("s", 2, 3).alias("sub")).collect()
+    assert [r.u for r in out] == ["HELLO", "WORLD", None, ""]
+    assert [r.n for r in out] == [5, 5, None, 0]
+    assert [r.sub for r in out] == ["ell", "ORL", None, ""]
+
+
+def test_integer_overflow_wraps(spark):
+    df = spark.createDataFrame({"a": [2**31 - 1]}, "a int")
+    out = df.select((F.col("a") + 1).alias("x")).collect()
+    assert out[0].x == -(2**31)
+
+
+def test_division_semantics(spark):
+    df = spark.createDataFrame({"a": [7, -7], "b": [2, 2]}, "a int, b int")
+    out = df.select(
+        (F.col("a") / F.col("b")).alias("d"),
+        (F.col("a") % F.col("b")).alias("m")).collect()
+    assert out[0].d == 3.5 and out[1].d == -3.5
+    assert out[0].m == 1 and out[1].m == -1  # sign of dividend
+
+
+def test_hash_partitioning_stability(spark):
+    # group results identical regardless of partition count
+    data = {"k": [i % 7 for i in range(100)], "v": list(range(100))}
+    df1 = TpuSparkSession({"spark.rapids.sql.enabled": "false",
+                           "spark.sql.shuffle.partitions": "1"}
+                          ).createDataFrame(data, "k int, v long")
+    df8 = TpuSparkSession({"spark.rapids.sql.enabled": "false",
+                           "spark.sql.shuffle.partitions": "8"}
+                          ).createDataFrame(data, "k int, v long")
+    r1 = sorted((r.k, r.s) for r in df1.groupBy("k").agg(
+        F.sum("v").alias("s")).collect())
+    r8 = sorted((r.k, r.s) for r in df8.groupBy("k").agg(
+        F.sum("v").alias("s")).collect())
+    assert r1 == r8

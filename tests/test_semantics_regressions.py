@@ -1,0 +1,92 @@
+"""Regressions for Spark-semantics defects found in review: zero-divisor
+nulls, decimal storage, first/last null handling, grouping by expressions,
+float64 sort precision, DDL parsing, self-join dedup."""
+
+from decimal import Decimal
+
+import pytest
+
+from spark_rapids_tpu.sql.session import TpuSparkSession
+from spark_rapids_tpu.sql import functions as F
+
+
+@pytest.fixture()
+def spark():
+    s = TpuSparkSession({"spark.rapids.sql.enabled": "false",
+                         "spark.sql.shuffle.partitions": "4"})
+    yield s
+    s.stop()
+
+
+def test_divide_by_zero_is_null(spark):
+    df = spark.createDataFrame(
+        {"a": [1.0, -1.0, 0.0], "b": [0.0, 0.0, 0.0]}, "a double, b double")
+    out = df.select((F.col("a") / F.col("b")).alias("d"),
+                    (F.col("a") % F.col("b")).alias("m")).collect()
+    assert [r.d for r in out] == [None, None, None]
+    assert [r.m for r in out] == [None, None, None]
+    # int zero divisor too
+    df2 = spark.createDataFrame({"a": [7], "b": [0]}, "a int, b int")
+    out2 = df2.select((F.col("a") / F.col("b")).alias("d"),
+                      (F.col("a") % F.col("b")).alias("m")).collect()
+    assert out2[0].d is None and out2[0].m is None
+
+
+def test_decimal_storage_roundtrip(spark):
+    df = spark.createDataFrame({"d": [Decimal("1.00"), Decimal("2.50"),
+                                      None]}, "d decimal(10,2)")
+    out = df.collect()
+    assert out[0].d == Decimal("1.00")
+    assert out[1].d == Decimal("2.50")
+    assert out[2].d is None
+    s = df.agg(F.min("d").alias("lo"), F.max("d").alias("hi")).collect()
+    assert s[0].lo == Decimal("1.00") and s[0].hi == Decimal("2.50")
+
+
+def test_first_respects_nulls(spark):
+    df = spark.createDataFrame(
+        {"k": [1, 1, 2, 2], "v": [None, 5, 7, None]}, "k int, v int",
+        num_partitions=1)
+    out = {r.k: (r.f, r.l) for r in df.groupBy("k").agg(
+        F.first("v").alias("f"), F.last("v").alias("l")).collect()}
+    assert out[1] == (None, 5)   # first row's null is kept
+    assert out[2] == (7, None)
+    out2 = {r.k: r.f for r in df.groupBy("k").agg(
+        F.first("v", ignorenulls=True).alias("f")).collect()}
+    assert out2[1] == 5 and out2[2] == 7
+
+
+def test_group_by_expression(spark):
+    df = spark.createDataFrame({"a": [1, 2, 3, 4, 5, 6]}, "a int")
+    out = df.groupBy(F.col("a") % 2).agg(F.count("*").alias("c")).collect()
+    got = sorted((r[0], r.c) for r in out)
+    assert got == [(0, 3), (1, 3)]
+
+
+def test_sort_adjacent_doubles(spark):
+    vals = [1.0000000000000002, 1.0, 0.9999999999999999]
+    df = spark.createDataFrame({"x": vals}, "x double")
+    out = [r.x for r in df.orderBy("x").collect()]
+    assert out == sorted(vals)
+
+
+def test_ddl_with_decimal(spark):
+    df = spark.createDataFrame({"d": [Decimal("3.14")], "i": [1]},
+                               "d decimal(10,2), i int")
+    assert df.schema.fields[0].data_type.scale == 2
+    assert df.collect()[0].d == Decimal("3.14")
+
+
+def test_count_distinct_fails_loudly(spark):
+    df = spark.createDataFrame({"x": [1, 1, 2]}, "x int")
+    with pytest.raises(NotImplementedError):
+        df.agg(F.countDistinct("x")).collect()
+
+
+def test_drop_duplicates(spark):
+    df = spark.createDataFrame(
+        {"k": [1, 1, 2], "v": ["a", "b", "c"]}, "k int, v string",
+        num_partitions=1)
+    out = df.dropDuplicates(["k"]).collect()
+    assert len(out) == 2
+    assert {r.k for r in out} == {1, 2}
