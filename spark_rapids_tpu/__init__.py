@@ -27,4 +27,10 @@ Layering mirrors SURVEY.md section 1:
 
 __version__ = "0.1.0"
 
-from spark_rapids_tpu.conf import TpuConf  # noqa: F401
+# SQL semantics require 64-bit longs/doubles; JAX defaults to 32-bit.
+# Must run before any jax array is created anywhere in the package.
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+from spark_rapids_tpu.conf import TpuConf  # noqa: F401,E402

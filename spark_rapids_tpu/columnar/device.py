@@ -1,0 +1,405 @@
+"""Device-resident columnar batches (the GpuColumnVector / cudf Table twin).
+
+TPU-first design, not a translation of the reference's device model:
+
+- Every column is a pair of JAX arrays in HBM: fixed-width ``data`` plus a
+  ``validity`` bool mask (Arrow-style; reference keeps the same split in
+  GpuColumnVector.java over cudf buffers).
+- Strings/binary are padded byte matrices ``uint8[capacity, char_cap]`` with
+  a ``lengths`` vector — tensor-shaped so XLA can tile them (the reference
+  gets offset+bytes columns from cudf; offsets fight static shapes on TPU).
+- **Static shapes everywhere**: a batch has a ``capacity`` bucketed to a
+  power of two; the real row count is tracked by an ``active`` row mask and
+  a lazily-fetched host count. Filters only flip mask bits (no data
+  movement); compaction happens on explicit request with a fixed-shape
+  argsort-gather. This is how the build avoids XLA recompilation storms on
+  data-dependent row counts (SURVEY.md section 7 "hard parts" (a)).
+- A row is *padding* iff ``active[i]`` is False. Padding rows also carry
+  validity=False in every column so masked reductions never see them.
+
+Null slots hold deterministic zeros (normalized), mirroring
+HostColumn.normalized(), so bitwise comparisons and hashing are stable.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+from spark_rapids_tpu.sql import types as T
+
+# Minimum capacity bucket: small enough for tests, large enough that op
+# compile caches stay tiny (log2 buckets between MIN and max batch rows).
+MIN_CAPACITY = 64
+DEFAULT_CHAR_CAP = 32
+
+
+def bucket_capacity(n: int) -> int:
+    """Round up to the next power of two, floored at MIN_CAPACITY."""
+    if n <= MIN_CAPACITY:
+        return MIN_CAPACITY
+    return 1 << math.ceil(math.log2(n))
+
+
+def bucket_char_cap(max_len: int) -> int:
+    """Byte-matrix width bucket: multiple-of-8 padding, floor 8."""
+    if max_len <= 8:
+        return 8
+    return 8 * math.ceil(max_len / 8)
+
+
+def is_string_like(dt: T.DataType) -> bool:
+    return isinstance(dt, (T.StringType, T.BinaryType))
+
+
+def storage_jnp_dtype(dt: T.DataType) -> jnp.dtype:
+    """Device storage dtype for fixed-width types."""
+    return jnp.dtype(T.numpy_dtype(dt))
+
+
+@dataclass
+class DeviceColumn:
+    """Fixed-width device column: data[capacity] + validity[capacity]."""
+
+    dtype: T.DataType
+    data: jax.Array
+    validity: jax.Array  # bool
+
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    def arrays(self) -> Tuple[jax.Array, ...]:
+        return (self.data, self.validity)
+
+    @staticmethod
+    def from_arrays(dtype: T.DataType, arrs: Sequence[jax.Array]
+                    ) -> "DeviceColumn":
+        data, validity = arrs
+        return DeviceColumn(dtype, data, validity)
+
+
+@dataclass
+class DeviceStringColumn:
+    """String/binary device column: padded byte matrix + lengths.
+
+    ``chars`` is uint8[capacity, char_cap], zero-padded past ``lengths[i]``;
+    zero-padding keeps plain lexicographic comparison of rows equal to
+    UTF-8 binary order (shorter string sorts before its extensions), which
+    the sort/join kernels rely on.
+
+    Rows longer than char_cap cannot be represented; the host->device
+    transfer picks char_cap from the actual max length, and TypeSig gating
+    falls back to CPU for columns beyond ``MAX_DEVICE_STRING`` bytes.
+    """
+
+    dtype: T.DataType
+    chars: jax.Array    # uint8[capacity, char_cap]
+    lengths: jax.Array  # int32[capacity]
+    validity: jax.Array
+
+    MAX_DEVICE_STRING = 1 << 14
+
+    @property
+    def capacity(self) -> int:
+        return self.chars.shape[0]
+
+    @property
+    def char_cap(self) -> int:
+        return self.chars.shape[1]
+
+    def arrays(self) -> Tuple[jax.Array, ...]:
+        return (self.chars, self.lengths, self.validity)
+
+    @staticmethod
+    def from_arrays(dtype: T.DataType, arrs: Sequence[jax.Array]
+                    ) -> "DeviceStringColumn":
+        chars, lengths, validity = arrs
+        return DeviceStringColumn(dtype, chars, lengths, validity)
+
+
+AnyDeviceColumn = Union[DeviceColumn, DeviceStringColumn]
+
+
+def make_column(dtype: T.DataType, arrs: Sequence[jax.Array]
+                ) -> AnyDeviceColumn:
+    if is_string_like(dtype):
+        return DeviceStringColumn.from_arrays(dtype, arrs)
+    return DeviceColumn.from_arrays(dtype, arrs)
+
+
+@dataclass
+class DeviceBatch:
+    """A columnar batch resident in device HBM.
+
+    ``active`` marks real rows; everything at i >= original row count (and
+    everything filtered out since) is False. ``_num_rows`` caches the host
+    row count; ``row_count()`` materializes it (one tiny transfer) when a
+    sizing decision needs it.
+    """
+
+    schema: T.StructType
+    columns: List[AnyDeviceColumn]
+    active: jax.Array  # bool[capacity]
+    _num_rows: Optional[int] = None
+
+    @property
+    def capacity(self) -> int:
+        return int(self.active.shape[0])
+
+    @property
+    def num_cols(self) -> int:
+        return len(self.columns)
+
+    def column(self, i: int) -> AnyDeviceColumn:
+        return self.columns[i]
+
+    def row_count(self) -> int:
+        if self._num_rows is None:
+            self._num_rows = int(jnp.sum(self.active))
+        return self._num_rows
+
+    def with_columns(self, schema: T.StructType,
+                     columns: List[AnyDeviceColumn]) -> "DeviceBatch":
+        return DeviceBatch(schema, columns, self.active, self._num_rows)
+
+    def sizeof(self) -> int:
+        """Device bytes held by this batch (for HBM accounting)."""
+        total = self.active.size * 1
+        for c in self.columns:
+            for a in c.arrays():
+                total += a.size * a.dtype.itemsize
+        return total
+
+    # -- transfer ----------------------------------------------------------
+
+    @staticmethod
+    def from_host(batch: HostBatch, capacity: Optional[int] = None,
+                  device: Optional[jax.Device] = None) -> "DeviceBatch":
+        cap = capacity or bucket_capacity(max(1, batch.num_rows))
+        assert cap >= batch.num_rows, (cap, batch.num_rows)
+        cols: List[AnyDeviceColumn] = []
+        for f, c in zip(batch.schema.fields, batch.columns):
+            cols.append(_host_col_to_device(c, f.data_type, cap, device))
+        active_np = np.zeros(cap, dtype=bool)
+        active_np[:batch.num_rows] = True
+        active = _put(active_np, device)
+        return DeviceBatch(batch.schema, cols, active, batch.num_rows)
+
+    def to_host(self) -> HostBatch:
+        """Gather active rows back to a HostBatch (device -> host copy)."""
+        active = np.asarray(self.active)
+        idx = np.nonzero(active)[0]
+        cols: List[HostColumn] = []
+        for f, c in zip(self.schema.fields, self.columns):
+            cols.append(_device_col_to_host(c, f.data_type, idx))
+        b = HostBatch(self.schema, cols, len(idx))
+        return b
+
+    @staticmethod
+    def empty(schema: T.StructType, capacity: int = MIN_CAPACITY
+              ) -> "DeviceBatch":
+        return DeviceBatch.from_host(HostBatch.empty(schema), capacity)
+
+
+def _put(arr: np.ndarray, device: Optional[jax.Device]) -> jax.Array:
+    if device is not None:
+        return jax.device_put(arr, device)
+    return jnp.asarray(arr)
+
+
+def _host_col_to_device(c: HostColumn, dt: T.DataType, cap: int,
+                        device: Optional[jax.Device]) -> AnyDeviceColumn:
+    n = len(c)
+    validity = np.zeros(cap, dtype=bool)
+    validity[:n] = c.validity
+    if is_string_like(dt):
+        encoded: List[bytes] = []
+        max_len = 1
+        for i in range(n):
+            if c.validity[i]:
+                v = c.data[i]
+                b = v.encode("utf-8") if isinstance(v, str) else bytes(v)
+            else:
+                b = b""
+            encoded.append(b)
+            max_len = max(max_len, len(b))
+        char_cap = bucket_char_cap(max_len)
+        chars = np.zeros((cap, char_cap), dtype=np.uint8)
+        lengths = np.zeros(cap, dtype=np.int32)
+        for i, b in enumerate(encoded):
+            chars[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
+            lengths[i] = len(b)
+        return DeviceStringColumn(dt, _put(chars, device),
+                                  _put(lengths, device),
+                                  _put(validity, device))
+    np_dt = T.numpy_dtype(dt)
+    data = np.zeros(cap, dtype=np_dt)
+    # normalized() zeroes invalid slots on the host side already
+    data[:n] = c.normalized().data
+    return DeviceColumn(dt, _put(data, device), _put(validity, device))
+
+
+def _device_col_to_host(c: AnyDeviceColumn, dt: T.DataType,
+                        idx: np.ndarray) -> HostColumn:
+    if isinstance(c, DeviceStringColumn):
+        chars = np.asarray(c.chars)
+        lengths = np.asarray(c.lengths)
+        validity = np.asarray(c.validity)[idx]
+        data = np.empty(len(idx), dtype=object)
+        is_binary = isinstance(dt, T.BinaryType)
+        for out_i, i in enumerate(idx):
+            raw = chars[i, :lengths[i]].tobytes()
+            if is_binary:
+                data[out_i] = raw if validity[out_i] else b""
+            else:
+                data[out_i] = (raw.decode("utf-8", errors="replace")
+                               if validity[out_i] else "")
+        return HostColumn(dt, data, validity)
+    data = np.asarray(c.data)[idx]
+    validity = np.asarray(c.validity)[idx]
+    return HostColumn(dt, data.copy(), validity.copy()).normalized()
+
+
+def concat_device(batches: Sequence[DeviceBatch]) -> DeviceBatch:
+    """Device-side Table.concatenate: compact all actives into one batch.
+
+    Output capacity = bucket(total active rows); fixed-shape per input
+    (gather into slices), so XLA sees only bucketed shapes.
+    """
+    assert batches
+    schema = batches[0].schema
+    counts = [b.row_count() for b in batches]
+    total = sum(counts)
+    cap = bucket_capacity(max(1, total))
+    compacted = [compact(b) for b in batches]
+    cols: List[AnyDeviceColumn] = []
+    for ci, f in enumerate(schema.fields):
+        parts = [b.columns[ci] for b in compacted]
+        if is_string_like(f.data_type):
+            char_cap = max(p.char_cap for p in parts)
+            chars = jnp.zeros((cap, char_cap), dtype=jnp.uint8)
+            lengths = jnp.zeros(cap, dtype=jnp.int32)
+            validity = jnp.zeros(cap, dtype=bool)
+            off = 0
+            for p, n in zip(parts, counts):
+                if n == 0:
+                    continue
+                pc = p.chars[:n]
+                if p.char_cap < char_cap:
+                    pc = jnp.pad(pc, ((0, 0), (0, char_cap - p.char_cap)))
+                chars = jax.lax.dynamic_update_slice(chars, pc, (off, 0))
+                lengths = jax.lax.dynamic_update_slice(
+                    lengths, p.lengths[:n], (off,))
+                validity = jax.lax.dynamic_update_slice(
+                    validity, p.validity[:n], (off,))
+                off += n
+            cols.append(DeviceStringColumn(f.data_type, chars, lengths,
+                                           validity))
+        else:
+            data = jnp.zeros(cap, dtype=storage_jnp_dtype(f.data_type))
+            validity = jnp.zeros(cap, dtype=bool)
+            off = 0
+            for p, n in zip(parts, counts):
+                if n == 0:
+                    continue
+                data = jax.lax.dynamic_update_slice(data, p.data[:n], (off,))
+                validity = jax.lax.dynamic_update_slice(
+                    validity, p.validity[:n], (off,))
+                off += n
+            cols.append(DeviceColumn(f.data_type, data, validity))
+    active = jnp.arange(cap) < total
+    return DeviceBatch(schema, cols, active, total)
+
+
+def _compaction_order(active: jax.Array) -> jax.Array:
+    """Stable permutation moving active rows to the front."""
+    # stable argsort of (!active): False (active) sorts first, order kept
+    return jnp.argsort(~active, stable=True)
+
+
+def take_columns(columns: Sequence[AnyDeviceColumn], idx: jax.Array,
+                 valid_at: Optional[jax.Array] = None
+                 ) -> List[AnyDeviceColumn]:
+    """Gather rows by index; when valid_at is given, rows where it is
+    False become null (outer-join style null rows use idx clamped to 0)."""
+    out: List[AnyDeviceColumn] = []
+    for c in columns:
+        if isinstance(c, DeviceStringColumn):
+            chars = c.chars[idx]
+            lengths = c.lengths[idx]
+            validity = c.validity[idx]
+            if valid_at is not None:
+                validity = validity & valid_at
+                lengths = jnp.where(validity, lengths, 0)
+                chars = jnp.where(validity[:, None], chars, 0)
+            out.append(DeviceStringColumn(c.dtype, chars, lengths, validity))
+        else:
+            data = c.data[idx]
+            validity = c.validity[idx]
+            if valid_at is not None:
+                validity = validity & valid_at
+                data = jnp.where(validity, data,
+                                 jnp.zeros((), dtype=data.dtype))
+            out.append(DeviceColumn(c.dtype, data, validity))
+    return out
+
+
+@jax.jit
+def _compact_arrays(active: jax.Array, *flat: jax.Array):
+    order = _compaction_order(active)
+    n = jnp.sum(active)
+    new_active = jnp.arange(active.shape[0]) < n
+    outs = []
+    for a in flat:
+        g = a[order]
+        # zero out the padding tail for determinism
+        if a.ndim == 2:
+            g = jnp.where(new_active[:, None], g, 0)
+        else:
+            g = jnp.where(new_active, g, jnp.zeros((), dtype=g.dtype))
+        outs.append(g)
+    return new_active, tuple(outs)
+
+
+def compact(batch: DeviceBatch) -> DeviceBatch:
+    """Move active rows to the front (fixed-shape compaction)."""
+    flat: List[jax.Array] = []
+    spec: List[Tuple[T.DataType, int]] = []
+    for c in batch.columns:
+        arrs = c.arrays()
+        spec.append((c.dtype, len(arrs)))
+        flat.extend(arrs)
+    new_active, outs = _compact_arrays(batch.active, *flat)
+    cols: List[AnyDeviceColumn] = []
+    i = 0
+    for dt, n_arr in spec:
+        cols.append(make_column(dt, outs[i:i + n_arr]))
+        i += n_arr
+    return DeviceBatch(batch.schema, cols, new_active, batch._num_rows)
+
+
+def shrink_to_bucket(batch: DeviceBatch) -> DeviceBatch:
+    """Compact, then if the active count fits a smaller capacity bucket,
+    slice down to it (keeps shuffle payloads tight)."""
+    n = batch.row_count()
+    cap = bucket_capacity(max(1, n))
+    if cap >= batch.capacity:
+        return compact(batch)
+    c = compact(batch)
+    cols: List[AnyDeviceColumn] = []
+    for col in c.columns:
+        if isinstance(col, DeviceStringColumn):
+            cols.append(DeviceStringColumn(
+                col.dtype, col.chars[:cap], col.lengths[:cap],
+                col.validity[:cap]))
+        else:
+            cols.append(DeviceColumn(col.dtype, col.data[:cap],
+                                     col.validity[:cap]))
+    return DeviceBatch(c.schema, cols, c.active[:cap], n)

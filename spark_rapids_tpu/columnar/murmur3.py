@@ -82,7 +82,8 @@ def hash_bytes_one(data: bytes, seed: int) -> int:
         word = np.frombuffer(data[i:i + 4], dtype="<u4").copy()
         h1 = _mix_h1(h1, _mix_k1(word))
     for i in range(aligned, n):
-        b = np.array([np.int8(data[i])], dtype=np.int32).view(np.uint32)
+        b = (np.array([data[i]], dtype=np.uint8).astype(np.int8)
+             .astype(np.int32).view(np.uint32))
         h1 = _mix_h1(h1, _mix_k1(b))
     res = _fmix(h1, np.uint32(n))
     return int(res.view(np.int32)[0])

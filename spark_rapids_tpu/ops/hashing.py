@@ -1,0 +1,142 @@
+"""Device Murmur3 (Spark Murmur3_x86_32, seed 42) — bit-compatible with the
+host implementation in columnar/murmur3.py, which itself matches Spark's
+HashExpression so device hash partitioning places rows exactly where CPU
+Spark would (reference: GpuHashPartitioning.scala + cudf spark-murmur3 mode).
+
+All arithmetic is uint32 with wraparound (XLA integer ops wrap, like Java).
+Strings hash their UTF-8 bytes from the padded byte matrix: full 4-byte
+little-endian words first, then trailing bytes one at a time as sign-extended
+ints — a static loop over the (bucketed) char capacity, masked per row by
+the actual byte length.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.sql import types as T
+
+_C1 = jnp.uint32(0xCC9E2D51)
+_C2 = jnp.uint32(0x1B873593)
+_M5 = jnp.uint32(0xE6546B64)
+
+
+def _rotl(x: jax.Array, r: int) -> jax.Array:
+    return (x << jnp.uint32(r)) | (x >> jnp.uint32(32 - r))
+
+
+def _mix_k1(k1: jax.Array) -> jax.Array:
+    k1 = k1.astype(jnp.uint32) * _C1
+    k1 = _rotl(k1, 15)
+    return k1 * _C2
+
+
+def _mix_h1(h1: jax.Array, k1: jax.Array) -> jax.Array:
+    h1 = h1 ^ k1
+    h1 = _rotl(h1, 13)
+    return h1 * jnp.uint32(5) + _M5
+
+
+def _fmix(h1: jax.Array, length: jax.Array) -> jax.Array:
+    h1 = h1 ^ length.astype(jnp.uint32)
+    h1 = h1 ^ (h1 >> jnp.uint32(16))
+    h1 = h1 * jnp.uint32(0x85EBCA6B)
+    h1 = h1 ^ (h1 >> jnp.uint32(13))
+    h1 = h1 * jnp.uint32(0xC2B2AE35)
+    h1 = h1 ^ (h1 >> jnp.uint32(16))
+    return h1
+
+
+def hash_int(values: jax.Array, seed: jax.Array) -> jax.Array:
+    """hashInt: one 4-byte round + fmix(4). Returns int32."""
+    k1 = _mix_k1(values.astype(jnp.int32).view(jnp.uint32))
+    h1 = _mix_h1(seed.astype(jnp.int32).view(jnp.uint32), k1)
+    return _fmix(h1, jnp.uint32(4)).view(jnp.int32)
+
+
+def hash_long(values: jax.Array, seed: jax.Array) -> jax.Array:
+    """hashLong: low int32 word then high, + fmix(8)."""
+    v = values.astype(jnp.int64).view(jnp.uint64)
+    low = (v & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    high = (v >> jnp.uint64(32)).astype(jnp.uint32)
+    h1 = seed.astype(jnp.int32).view(jnp.uint32)
+    h1 = _mix_h1(h1, _mix_k1(low))
+    h1 = _mix_h1(h1, _mix_k1(high))
+    return _fmix(h1, jnp.uint32(8)).view(jnp.int32)
+
+
+def hash_float(values: jax.Array, seed: jax.Array) -> jax.Array:
+    v = values.astype(jnp.float32)
+    v = jnp.where(v == jnp.float32(0.0), jnp.float32(0.0), v)  # fold -0.0
+    return hash_int(v.view(jnp.int32), seed)
+
+
+def hash_double(values: jax.Array, seed: jax.Array) -> jax.Array:
+    v = values.astype(jnp.float64)
+    v = jnp.where(v == 0.0, 0.0, v)
+    return hash_long(v.view(jnp.int64), seed)
+
+
+def hash_bytes(chars: jax.Array, lengths: jax.Array,
+               seed: jax.Array) -> jax.Array:
+    """hashUnsafeBytes over a padded uint8[n, char_cap] matrix.
+
+    Static unrolled loop over word slots; each row applies only the rounds
+    its length covers. Trailing (< 4) bytes are sign-extended int8 rounds,
+    matching Spark's byte-at-a-time tail handling.
+    """
+    n, char_cap = chars.shape
+    lengths = lengths.astype(jnp.int32)
+    aligned = lengths - (lengths % 4)
+    h1 = seed.astype(jnp.int32).view(jnp.uint32)
+    c32 = chars.astype(jnp.uint32)
+    n_words = char_cap // 4
+    for w in range(n_words):
+        off = 4 * w
+        word = (c32[:, off]
+                | (c32[:, off + 1] << 8)
+                | (c32[:, off + 2] << 16)
+                | (c32[:, off + 3] << 24))
+        mixed = _mix_h1(h1, _mix_k1(word))
+        h1 = jnp.where(off + 4 <= aligned, mixed, h1)
+    # tail: up to 3 bytes at offsets aligned+k; gather per row
+    for k in range(3):
+        off = jnp.minimum(aligned + k, char_cap - 1)
+        b = jnp.take_along_axis(chars, off[:, None], axis=1)[:, 0]
+        sb = b.astype(jnp.int8).astype(jnp.int32).view(jnp.uint32)
+        mixed = _mix_h1(h1, _mix_k1(sb))
+        h1 = jnp.where(aligned + k < lengths, mixed, h1)
+    return _fmix(h1, lengths.astype(jnp.uint32)).view(jnp.int32)
+
+
+def hash_device_column(col, seed: jax.Array) -> jax.Array:
+    """Fold one device column into the running per-row hash (seed);
+    null slots leave the hash unchanged (Spark HashExpression)."""
+    from spark_rapids_tpu.columnar.device import DeviceStringColumn
+    dt = col.dtype
+    if isinstance(col, DeviceStringColumn):
+        h = hash_bytes(col.chars, col.lengths, seed)
+    elif isinstance(dt, T.BooleanType):
+        h = hash_int(col.data.astype(jnp.int32), seed)
+    elif isinstance(dt, (T.ByteType, T.ShortType, T.IntegerType, T.DateType)):
+        h = hash_int(col.data.astype(jnp.int32), seed)
+    elif isinstance(dt, (T.LongType, T.TimestampType)):
+        h = hash_long(col.data.astype(jnp.int64), seed)
+    elif isinstance(dt, T.FloatType):
+        h = hash_float(col.data, seed)
+    elif isinstance(dt, T.DoubleType):
+        h = hash_double(col.data, seed)
+    elif isinstance(dt, T.DecimalType) and dt.precision <= 18:
+        h = hash_long(col.data.astype(jnp.int64), seed)
+    else:
+        raise TypeError(f"cannot hash {dt} on device")
+    return jnp.where(col.validity, h, seed)
+
+
+def murmur3_columns(cols, capacity: int, seed: int = 42) -> jax.Array:
+    """Spark Murmur3Hash(cols, seed): fold columns left-to-right."""
+    h = jnp.full(capacity, seed, dtype=jnp.int32)
+    for c in cols:
+        h = hash_device_column(c, h)
+    return h

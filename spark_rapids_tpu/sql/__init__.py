@@ -5,6 +5,14 @@ In the reference, Apache Spark provides this layer unmodified (SURVEY.md L7+
 expressions, logical plans, a DataFrame API, and CPU physical operators that
 implement Spark semantics and serve as the bit-identical baseline and the
 per-op fallback target.
+
+TpuSparkSession is exposed lazily to keep the package import-order free
+(columnar <-> sql would otherwise cycle through session.py).
 """
 
-from spark_rapids_tpu.sql.session import TpuSparkSession  # noqa: F401
+
+def __getattr__(name):
+    if name == "TpuSparkSession":
+        from spark_rapids_tpu.sql.session import TpuSparkSession
+        return TpuSparkSession
+    raise AttributeError(name)

@@ -420,11 +420,10 @@ class Pmod(BinaryArithmetic):
     def eval(self, batch: HostBatch) -> HostColumn:
         lc, rc = self.left.eval(batch), self.right.eval(batch)
         a, b = lc.data, rc.data
-        validity = _combined_validity([lc, rc])
+        # Spark DivModLike: divisor 0 -> null for ALL numeric types
+        validity = _combined_validity([lc, rc]) & (b != 0)
         with np.errstate(all="ignore"):
-            if np.issubdtype(a.dtype, np.integer):
-                validity = validity & (b != 0)
-                b = np.where(b == 0, 1, b)
+            b = np.where(b == 0, 1, b).astype(b.dtype)
             r = np.fmod(a, b)
             data = np.where((r != 0) & ((r < 0) != (b < 0)), r + b, r)
         np_dt = T.numpy_dtype(self.data_type)
@@ -884,7 +883,11 @@ class Tanh(UnaryMath):
 
 
 class Signum(UnaryMath):
-    np_fn = np.sign
+    """Java Math.signum: preserves ±0.0 and NaN (np.sign folds -0.0)."""
+
+    @staticmethod
+    def np_fn(x):
+        return np.where(x == 0.0, x, np.sign(x))
 
 
 class Floor(UnaryExpression):
@@ -898,7 +901,7 @@ class Floor(UnaryExpression):
     def eval(self, batch: HostBatch) -> HostColumn:
         c = self.child.eval(batch)
         with np.errstate(all="ignore"):
-            data = np.floor(c.data.astype(np.float64)).astype(np.int64)
+            data = _java_double_to_long(np.floor(c.data.astype(np.float64)))
         return HostColumn(T.LongT, data, c.validity.copy()).normalized()
 
 
@@ -913,8 +916,25 @@ class Ceil(UnaryExpression):
     def eval(self, batch: HostBatch) -> HostColumn:
         c = self.child.eval(batch)
         with np.errstate(all="ignore"):
-            data = np.ceil(c.data.astype(np.float64)).astype(np.int64)
+            data = _java_double_to_long(np.ceil(c.data.astype(np.float64)))
         return HostColumn(T.LongT, data, c.validity.copy()).normalized()
+
+
+def _java_double_to_long(x: np.ndarray) -> np.ndarray:
+    """Java (long) cast: NaN -> 0, saturate at Long.MIN/MAX, trunc.
+
+    Saturation needs threshold compares: float(Long.MAX) rounds up to
+    2**63, so clip-then-astype would wrap positive overflow to MIN."""
+    info = np.iinfo(np.int64)
+    with np.errstate(all="ignore"):
+        y = np.nan_to_num(x, nan=0.0, posinf=0.0, neginf=0.0)
+        hi = x >= 2.0 ** 63          # covers +inf
+        lo = x <= -(2.0 ** 63) - 1.0  # -2^63 itself is representable
+        y = np.where(hi | lo, 0.0, y)
+        out = y.astype(np.int64)
+        out = np.where(hi, info.max, out)
+        out = np.where(lo | (x == -np.inf), info.min, out)
+        return np.where(np.isnan(x), 0, out)
 
 
 class Pow(BinaryExpression):
@@ -1404,16 +1424,15 @@ def _cast_numeric(c: HostColumn, to: T.DataType, ansi: bool) -> HostColumn:
     validity = c.validity.copy()
     if np.issubdtype(src.dtype, np.floating) and not T.is_floating(to):
         # Java double->int semantics: NaN -> 0, saturate at bounds,
-        # truncate toward zero (Spark non-ANSI Cast).
+        # truncate toward zero (Spark non-ANSI Cast). Long.MAX is not
+        # representable as double, so saturate via threshold compares.
         info = np.iinfo(np_to)
-        x = np.nan_to_num(np.trunc(src), nan=0.0,
-                          posinf=float(info.max), neginf=float(info.min))
-        x = np.clip(x, float(info.min), float(info.max))
+        as_long = _java_double_to_long(np.trunc(src))
+        data = np.clip(as_long, info.min, info.max).astype(np_to)
         if ansi:
-            bad = np.isnan(src) | (np.trunc(src) != x)
+            bad = np.isnan(src) | (data.astype(np.float64) != np.trunc(src))
             if (bad & validity).any():
                 raise ArithmeticError("Cast overflow in ANSI mode")
-        data = x.astype(np_to)
     else:
         # int narrowing wraps (two's complement), widening exact;
         # int->float may round — all match Java/Spark non-ANSI.

@@ -1,15 +1,27 @@
 """Test config: force JAX onto a virtual 8-device CPU mesh so multi-chip
 sharding paths compile/execute without TPU hardware (SURVEY.md section 4
 blueprint: 'jax CPU devices / multiprocess ICI emulation covers what
-Mockito does' for the reference's transport suites)."""
+Mockito does' for the reference's transport suites).
+
+The hosting environment may pre-register a TPU PJRT plugin via
+sitecustomize before this file runs, so os.environ.setdefault is not
+enough: set XLA_FLAGS before the backend initializes and override the
+platform with jax.config (which works even after jax was imported).
+"""
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
-import sys
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+assert jax.default_backend() == "cpu", jax.default_backend()
+assert len(jax.devices()) == 8, jax.devices()

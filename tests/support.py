@@ -1,0 +1,34 @@
+"""Shared test helpers: NaN-aware recursive equality (asserts.py _assert_equal
+in the reference's integration harness) used by the kernel and dual-session
+suites."""
+
+import math
+
+
+def values_equal(a, b, approx: bool = False) -> bool:
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, float) and isinstance(b, float):
+        if math.isnan(a) or math.isnan(b):
+            return math.isnan(a) and math.isnan(b)
+        if a == 0.0 and b == 0.0:
+            # distinguish -0.0 from 0.0: bit-identity matters
+            return math.copysign(1.0, a) == math.copysign(1.0, b)
+        if approx:
+            # approximate_float marker analogue: libm implementations
+            # (XLA vs numpy) differ in the last ULPs for transcendentals
+            return math.isclose(a, b, rel_tol=1e-12, abs_tol=1e-300)
+        return a == b
+    return a == b
+
+
+def lists_equal(xs, ys, approx: bool = False) -> bool:
+    return len(xs) == len(ys) and all(
+        values_equal(a, b, approx) for a, b in zip(xs, ys))
+
+
+def assert_pydicts_equal(got: dict, expect: dict, context: str = ""):
+    assert set(got) == set(expect), (set(got), set(expect))
+    for k in expect:
+        assert lists_equal(got[k], expect[k]), (
+            f"{context} column {k}: {got[k]} != {expect[k]}")
