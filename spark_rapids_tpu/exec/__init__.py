@@ -1,0 +1,1 @@
+"""Device (Tpu*Exec) physical operators — the GpuExec layer (SURVEY.md L5)."""

@@ -1,0 +1,274 @@
+"""TpuHashAggregateExec: device groupBy aggregation
+(GpuHashAggregateExec / GpuHashAggregateIterator, aggregate.scala:247).
+
+Modes mirror Spark/the CPU engine: 'partial' emits keys+buffer slots
+per input batch (merged downstream after the exchange), 'final' merges
+buffers, 'complete' does both. Each batch aggregation is ONE jitted XLA
+program built from the sort+segment kernel in ops/groupby.py, with the
+slot update/merge expressions traced inline (so e.g. Average's
+Cast-to-double fuses into the same program).
+
+The reference's concat+merge / sort-fallback staging (aggregate.scala
+:224-245) is unnecessary here: the kernel IS sort-based, so repeated
+partial-result batches simply concat (static-bucketed) and re-aggregate.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import metrics as M
+from spark_rapids_tpu.columnar.device import (
+    AnyDeviceColumn, DeviceBatch, DeviceColumn, concat_device,
+    shrink_to_bucket, take_columns)
+from spark_rapids_tpu.columnar.host import HostColumn
+from spark_rapids_tpu.conf import TpuConf
+from spark_rapids_tpu.exec.base import (DevicePartitionThunk, TpuExec,
+                                        device_channel)
+from spark_rapids_tpu.ops import exprs as X
+from spark_rapids_tpu.ops import groupby as G
+from spark_rapids_tpu.sql import expressions as E
+from spark_rapids_tpu.sql import physical as P
+from spark_rapids_tpu.sql import types as T
+
+
+def apply_prim_device(prim: str, seg: G.Segments, col: AnyDeviceColumn,
+                      out_type: T.DataType) -> AnyDeviceColumn:
+    """Device twin of physical.apply_update_prim (same prim vocabulary)."""
+    if prim == E.PRIM_COUNT:
+        return G.seg_count(seg, col)
+    if prim == E.PRIM_SUM:
+        return G.seg_sum(seg, col, out_type, null_when_empty=True)
+    if prim == E.PRIM_SUM_NONNULL:
+        return G.seg_sum(seg, col, out_type, null_when_empty=False)
+    if prim == E.PRIM_MIN:
+        return G.seg_extreme(seg, col, is_min=True)
+    if prim == E.PRIM_MAX:
+        return G.seg_extreme(seg, col, is_min=False)
+    if prim == E.PRIM_FIRST:
+        return G.seg_first_last(seg, col, is_first=True, ignore_nulls=True)
+    if prim == E.PRIM_LAST:
+        return G.seg_first_last(seg, col, is_first=False, ignore_nulls=True)
+    if prim == E.PRIM_FIRST_ANY:
+        return G.seg_first_last(seg, col, is_first=True, ignore_nulls=False)
+    if prim == E.PRIM_LAST_ANY:
+        return G.seg_first_last(seg, col, is_first=False, ignore_nulls=False)
+    raise X.DeviceUnsupported(f"aggregate primitive {prim}")
+
+
+def dev_evaluate(func: E.AggregateFunction,
+                 buffers: List[AnyDeviceColumn],
+                 out_active: jax.Array) -> AnyDeviceColumn:
+    """Device twin of AggregateFunction.evaluate over merged buffers."""
+    if isinstance(func, (E.Sum, E.Min, E.Max, E.First, E.Last)):
+        return buffers[0]
+    if isinstance(func, E.Count):
+        b = buffers[0]
+        data = jnp.where(b.validity, b.data, jnp.int64(0))
+        data = jnp.where(out_active, data, jnp.int64(0))
+        return DeviceColumn(T.LongT, data, out_active)
+    if isinstance(func, E.Average):
+        s, cnt = buffers[0], buffers[1]
+        count = jnp.where(cnt.validity, cnt.data, jnp.int64(0))
+        validity = (count > 0) & out_active
+        data = s.data.astype(jnp.float64) / jnp.where(
+            count > 0, count, jnp.int64(1)).astype(jnp.float64)
+        data = jnp.where(validity, data, jnp.float64(0.0))
+        return DeviceColumn(T.DoubleT, data, validity)
+    raise X.DeviceUnsupported(
+        f"aggregate {type(func).__name__} has no device evaluate")
+
+
+def is_device_agg(grouping: List[E.AttributeReference],
+                  aggregates: List[E.Expression]) -> Optional[str]:
+    """Tagging helper: None if the whole aggregate can run on device."""
+    for g in grouping:
+        if isinstance(g.data_type, T.DecimalType):
+            return "decimal grouping keys run on CPU"
+        if isinstance(g.data_type, (T.ArrayType, T.MapType, T.StructType)):
+            return "nested grouping keys are not supported on TPU"
+    for e in aggregates:
+        if isinstance(e, E.Alias) and isinstance(e.child,
+                                                 E.AggregateExpression):
+            func = e.child.func
+            if e.child.is_distinct:
+                return "DISTINCT aggregates are not supported"
+            if not isinstance(func, (E.Sum, E.Count, E.Min, E.Max,
+                                     E.Average, E.First, E.Last)):
+                return (f"aggregate {type(func).__name__} has no device "
+                        "implementation")
+            for s in func.buffer_slots():
+                r = X.is_device_expr(s[3]) if isinstance(
+                    s[3], E.Expression) else None
+                if r:
+                    return r
+                if isinstance(s[1], T.DecimalType):
+                    return "decimal aggregate buffers run on CPU"
+    return None
+
+
+class TpuHashAggregateExec(TpuExec):
+    def __init__(self, grouping: List[E.AttributeReference],
+                 aggregates: List[E.Expression], mode: str, child: TpuExec,
+                 slots: Dict[int, List[P.AggSlot]], conf: TpuConf):
+        super().__init__(conf)
+        self.children = [child]
+        self.grouping = grouping
+        self.aggregates = aggregates
+        self.mode = mode
+        self.slots = slots
+        self._fn_cache: Dict[Tuple, Callable] = {}
+
+    @property
+    def child(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def output(self):
+        if self.mode == "partial":
+            out = list(self.grouping)
+            for e in self.aggregates:
+                if isinstance(e, E.Alias) and isinstance(
+                        e.child, E.AggregateExpression):
+                    out.extend(s.attr for s in self.slots[e.expr_id])
+            return out
+        return [E.named_output(e) for e in self.aggregates]
+
+    # -- helpers -------------------------------------------------------
+
+    def _agg_aliases(self):
+        return [e for e in self.aggregates
+                if isinstance(e, E.Alias)
+                and isinstance(e.child, E.AggregateExpression)]
+
+    def _bound_slot_sources(self) -> Tuple[List[E.Expression],
+                                           List[Tuple[str, T.DataType]]]:
+        """Per-slot (bound source expr, (prim, out_type)) for this mode."""
+        child_out = self.child.output
+        srcs: List[E.Expression] = []
+        prims: List[Tuple[str, T.DataType]] = []
+        for alias in self._agg_aliases():
+            for s in self.slots[alias.expr_id]:
+                if self.mode in ("partial", "complete"):
+                    prim, src = s.update_prim, s.update_expr
+                else:
+                    prim, src = s.merge_prim, s.attr
+                srcs.append(E.bind_references(src, child_out))
+                prims.append((prim, s.dtype))
+        return srcs, prims
+
+    def _build_fn(self, key_bound: List[E.Expression],
+                  slot_srcs: List[E.Expression],
+                  prims: List[Tuple[str, T.DataType]]) -> Callable:
+        mode = self.mode
+        aliases = self._agg_aliases()
+        slot_counts = [len(self.slots[a.expr_id]) for a in aliases]
+        grouping = self.grouping
+        aggregates = self.aggregates
+        all_exprs = tuple(key_bound) + tuple(slot_srcs)
+
+        def fn(cols, active, lit_vals):
+            cap = active.shape[0]
+            ctx = X.Ctx(cols, cap, all_exprs, lit_vals)
+            key_cols = [X.dev_eval(e, ctx) for e in key_bound]
+            if grouping:
+                seg = G.build_segments(key_cols, active)
+            else:
+                # single global segment over active rows
+                seg = G.build_segments([], active)
+            slot_vals = [X.dev_eval(e, ctx) for e in slot_srcs]
+            buffers = [apply_prim_device(p, seg, v, dt)
+                       for (p, dt), v in zip(prims, slot_vals)]
+            out_active = seg.seg_active
+            rep = G.representative_rows(seg)
+            key_out = take_columns(key_cols, rep, valid_at=out_active) \
+                if grouping else []
+
+            if mode == "partial":
+                out_cols = list(key_out) + list(buffers)
+                return out_cols, out_active
+
+            # final / complete: evaluate results
+            by_alias: Dict[int, List[AnyDeviceColumn]] = {}
+            off = 0
+            for a, n in zip(aliases, slot_counts):
+                by_alias[a.expr_id] = buffers[off:off + n]
+                off += n
+            key_by_attr = {a.expr_id: kc for a, kc in
+                           zip(grouping, key_out)}
+            out_cols = []
+            for e in aggregates:
+                if isinstance(e, E.Alias) and isinstance(
+                        e.child, E.AggregateExpression):
+                    out_cols.append(dev_evaluate(
+                        e.child.func, by_alias[e.expr_id], out_active))
+                elif isinstance(e, E.AttributeReference):
+                    out_cols.append(key_by_attr[e.expr_id])
+                elif isinstance(e, E.Alias) and isinstance(
+                        e.child, E.AttributeReference):
+                    out_cols.append(key_by_attr[e.child.expr_id])
+                else:
+                    raise X.DeviceUnsupported(f"agg result expr {e!r}")
+            return out_cols, out_active
+        return jax.jit(fn)
+
+    def _aggregate_batch(self, batch: DeviceBatch) -> DeviceBatch:
+        child_out = self.child.output
+        key_bound = [E.bind_references(g, child_out) for g in self.grouping]
+        slot_srcs, prims = self._bound_slot_sources()
+        key = (self.mode,
+               tuple(X.expr_key(e) for e in key_bound),
+               tuple(X.expr_key(e) for e in slot_srcs),
+               tuple(p for p, _ in prims),
+               tuple(repr(dt) for _, dt in prims))
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            fn = self._build_fn(key_bound, slot_srcs, prims)
+            self._fn_cache[key] = fn
+        lit_vals = X.literal_values(list(key_bound) + list(slot_srcs))
+        with self.metrics.timed(M.AGG_TIME):
+            out_cols, out_active = fn(batch.columns, batch.active, lit_vals)
+        return DeviceBatch(self.schema, list(out_cols), out_active, None)
+
+    def _empty_global_result(self) -> DeviceBatch:
+        cols: List[HostColumn] = []
+        for e in self.aggregates:
+            assert isinstance(e, E.Alias)
+            func = e.child.func
+            buffers = [HostColumn.nulls(1, s.dtype)
+                       for s in self.slots[e.expr_id]]
+            cols.append(func.evaluate(buffers))
+        from spark_rapids_tpu.columnar.host import HostBatch
+        return DeviceBatch.from_host(HostBatch(self.schema, cols, 1))
+
+    def device_partitions(self) -> List[DevicePartitionThunk]:
+        grouped = len(self.grouping) > 0
+
+        def make(thunk: DevicePartitionThunk) -> DevicePartitionThunk:
+            def run() -> Iterator[DeviceBatch]:
+                if self.mode == "partial":
+                    # per-batch partial aggregation, no concat needed
+                    any_out = False
+                    for b in thunk():
+                        if b.row_count() == 0:
+                            continue
+                        any_out = True
+                        yield shrink_to_bucket(self._aggregate_batch(b))
+                    return
+                batches = [b for b in thunk() if b.row_count()]
+                if not batches:
+                    if not grouped and self.mode in ("final", "complete"):
+                        yield self._empty_global_result()
+                    return
+                whole = (batches[0] if len(batches) == 1
+                         else concat_device(batches))
+                yield shrink_to_bucket(self._aggregate_batch(whole))
+            return run
+        return [make(t) for t in device_channel(self.child)]
+
+    def simple_string(self):
+        return (f"TpuHashAggregate mode={self.mode} keys={self.grouping} "
+                f"aggs={self.aggregates}")

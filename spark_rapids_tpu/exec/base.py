@@ -1,0 +1,210 @@
+"""TpuExec base + row/columnar transitions (GpuExec.scala:196,
+GpuRowToColumnarExec.scala, GpuColumnarToRowExec.scala twins).
+
+Execution model mirrors the CPU engine's ``partitions() -> [thunk]`` shape,
+with a device-side channel: every TpuExec produces ``device_partitions()``
+yielding HBM-resident ``DeviceBatch``es; ``partitions()`` (rows-for-CPU
+view) is derived by gathering to host, which is exactly what the plugin's
+``GpuColumnarToRowExec`` transition does. The rewrite engine inserts
+explicit transition nodes so plans show the same boundaries the reference
+plans do.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Optional
+
+from spark_rapids_tpu import metrics as M
+from spark_rapids_tpu.columnar.device import (
+    DeviceBatch, bucket_capacity, concat_device, shrink_to_bucket)
+from spark_rapids_tpu.columnar.host import HostBatch
+from spark_rapids_tpu.conf import TpuConf, METRICS_LEVEL
+from spark_rapids_tpu.resource import get_semaphore
+from spark_rapids_tpu.sql import physical as P
+
+DevicePartitionThunk = Callable[[], Iterator[DeviceBatch]]
+
+
+class TpuExec(P.PhysicalPlan):
+    """Base of all device operators. Subclasses implement
+    ``device_partitions``; the host-row view is derived via to_host the way
+    GpuColumnarToRowExec derives rows (the rewrite inserts an explicit
+    TpuColumnarToRowExec at real boundaries — partitions() here only backs
+    execute_collect on nested/driver paths)."""
+
+    def __init__(self, conf: TpuConf):
+        self.conf = conf
+        self.metrics = M.MetricRegistry(str(conf.get(METRICS_LEVEL)))
+
+    def device_partitions(self) -> List[DevicePartitionThunk]:
+        raise NotImplementedError
+
+    def partitions(self) -> List[P.PartitionThunk]:
+        def make(thunk: DevicePartitionThunk) -> P.PartitionThunk:
+            def run() -> Iterator[HostBatch]:
+                for b in thunk():
+                    yield b.to_host()
+            return run
+        return [make(t) for t in self.device_partitions()]
+
+
+def device_channel(plan: P.PhysicalPlan) -> List[DevicePartitionThunk]:
+    """Child's device batches: direct when the child is a TpuExec, else it
+    is a bug in the rewrite (transitions must have been inserted)."""
+    assert isinstance(plan, TpuExec), (
+        f"device operator consuming non-device child {plan.simple_string()}; "
+        "the rewrite engine must insert TpuRowToColumnarExec")
+    return plan.device_partitions()
+
+
+class TpuRowToColumnarExec(TpuExec):
+    """CPU rows -> device batches (GpuRowToColumnarExec.scala:830).
+
+    Uploads each HostBatch into HBM with power-of-two capacity bucketing,
+    coalescing consecutive small host batches up to the goal row count
+    first (the reference reaches its goal via GpuCoalesceBatches; here the
+    upload itself batches, which keeps one HBM copy per goal batch).
+    Acquires the TpuSemaphore before touching the device.
+    """
+
+    def __init__(self, child: P.PhysicalPlan, conf: TpuConf,
+                 goal_rows: Optional[int] = None):
+        super().__init__(conf)
+        self.children = [child]
+        self.goal_rows = goal_rows or conf.batch_size_rows
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def device_partitions(self) -> List[DevicePartitionThunk]:
+        sem = get_semaphore(self.conf)
+        metrics = self.metrics
+
+        def make(thunk: P.PartitionThunk) -> DevicePartitionThunk:
+            def run() -> Iterator[DeviceBatch]:
+                pending: List[HostBatch] = []
+                rows = 0
+                for b in thunk():
+                    if b.num_rows == 0:
+                        continue
+                    pending.append(b)
+                    rows += b.num_rows
+                    if rows >= self.goal_rows:
+                        yield self._upload(pending, sem, metrics)
+                        pending, rows = [], 0
+                if pending:
+                    yield self._upload(pending, sem, metrics)
+            return run
+        return [make(t) for t in self.child.partitions()]
+
+    def _upload(self, batches: List[HostBatch], sem, metrics) -> DeviceBatch:
+        whole = batches[0] if len(batches) == 1 else HostBatch.concat(batches)
+        sem.acquire_if_necessary(metrics)
+        with metrics.timed(M.COPY_TO_DEVICE_TIME):
+            d = DeviceBatch.from_host(whole)
+        metrics.create(M.NUM_OUTPUT_ROWS, M.ESSENTIAL).add(whole.num_rows)
+        metrics.create(M.NUM_OUTPUT_BATCHES, M.ESSENTIAL).add(1)
+        return d
+
+    def simple_string(self):
+        return "TpuRowToColumnar"
+
+
+class TpuColumnarToRowExec(P.PhysicalPlan):
+    """Device batches -> CPU rows (GpuColumnarToRowExec.scala:358); releases
+    the semaphore once a partition's device data is exhausted."""
+
+    def __init__(self, child: TpuExec, conf: TpuConf):
+        self.children = [child]
+        self.conf = conf
+        self.metrics = M.MetricRegistry(str(conf.get(METRICS_LEVEL)))
+
+    @property
+    def child(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def partitions(self) -> List[P.PartitionThunk]:
+        sem = get_semaphore(self.conf)
+        metrics = self.metrics
+
+        def make(thunk: DevicePartitionThunk) -> P.PartitionThunk:
+            def run() -> Iterator[HostBatch]:
+                try:
+                    for b in thunk():
+                        with metrics.timed(M.COPY_FROM_DEVICE_TIME):
+                            h = b.to_host()
+                        metrics.create(M.NUM_OUTPUT_ROWS,
+                                       M.ESSENTIAL).add(h.num_rows)
+                        yield h
+                finally:
+                    sem.release_if_necessary()
+            return run
+        return [make(t) for t in self.child.device_partitions()]
+
+    def simple_string(self):
+        return "TpuColumnarToRow"
+
+
+class TpuCoalesceBatchesExec(TpuExec):
+    """Concats small device batches up to the goal (GpuCoalesceBatches.scala
+    :519; goal algebra at :143-177). ``require_single_batch`` is the
+    RequireSingleBatch goal used by ops that need the whole partition."""
+
+    def __init__(self, child: TpuExec, conf: TpuConf,
+                 goal_rows: Optional[int] = None,
+                 require_single_batch: bool = False):
+        super().__init__(conf)
+        self.children = [child]
+        self.goal_rows = goal_rows or conf.batch_size_rows
+        self.require_single_batch = require_single_batch
+
+    @property
+    def child(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def device_partitions(self) -> List[DevicePartitionThunk]:
+        metrics = self.metrics
+
+        def make(thunk: DevicePartitionThunk) -> DevicePartitionThunk:
+            def run() -> Iterator[DeviceBatch]:
+                pending: List[DeviceBatch] = []
+                rows = 0
+                for b in thunk():
+                    n = b.row_count()
+                    if n == 0:
+                        continue
+                    pending.append(b)
+                    rows += n
+                    if not self.require_single_batch and \
+                            rows >= self.goal_rows:
+                        yield self._emit(pending, metrics)
+                        pending, rows = [], 0
+                if pending:
+                    yield self._emit(pending, metrics)
+            return run
+        return [make(t) for t in self.child.device_partitions()]
+
+    def _emit(self, pending: List[DeviceBatch], metrics) -> DeviceBatch:
+        with metrics.timed(M.CONCAT_TIME):
+            out = pending[0] if len(pending) == 1 else concat_device(pending)
+        metrics.create(M.NUM_OUTPUT_BATCHES, M.ESSENTIAL).add(1)
+        metrics.create(M.NUM_OUTPUT_ROWS, M.ESSENTIAL).add(out.row_count())
+        return out
+
+    def simple_string(self):
+        goal = ("RequireSingleBatch" if self.require_single_batch
+                else f"TargetSize({self.goal_rows})")
+        return f"TpuCoalesceBatches {goal}"

@@ -1,0 +1,217 @@
+"""Basic device operators: project, filter, range, union, limit
+(basicPhysicalOperators.scala:113,313,374,510 and limit.scala twins).
+
+Projects/filters evaluate their whole bound expression list as ONE fused
+jitted XLA program (ops/exprs.py); filters only flip the ``active`` mask —
+no data movement until an explicit compaction point (shuffle/concat), which
+is the static-shape discipline SURVEY.md section 7(a) calls for.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import metrics as M
+from spark_rapids_tpu.columnar.device import DeviceBatch, bucket_capacity
+from spark_rapids_tpu.conf import TpuConf
+from spark_rapids_tpu.exec.base import (DevicePartitionThunk, TpuExec,
+                                        device_channel)
+from spark_rapids_tpu.ops import exprs as X
+from spark_rapids_tpu.sql import expressions as E
+from spark_rapids_tpu.sql import physical as P
+from spark_rapids_tpu.sql import types as T
+
+
+class TpuProjectExec(TpuExec):
+    def __init__(self, project_list: List[E.Expression], child: TpuExec,
+                 conf: TpuConf):
+        super().__init__(conf)
+        self.children = [child]
+        self.project_list = project_list
+
+    @property
+    def child(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def output(self):
+        return [E.named_output(e) for e in self.project_list]
+
+    def device_partitions(self) -> List[DevicePartitionThunk]:
+        bound = P.bind_list(self.project_list, self.child.output)
+        schema = self.schema
+        metrics = self.metrics
+
+        def make(thunk: DevicePartitionThunk) -> DevicePartitionThunk:
+            def run() -> Iterator[DeviceBatch]:
+                for b in thunk():
+                    with metrics.timed(M.OP_TIME):
+                        cols = X.run_project(bound, b)
+                    metrics.create(M.NUM_OUTPUT_BATCHES, M.ESSENTIAL).add(1)
+                    yield b.with_columns(schema, cols)
+            return run
+        return [make(t) for t in device_channel(self.child)]
+
+    def simple_string(self):
+        return f"TpuProject {self.project_list}"
+
+
+class TpuFilterExec(TpuExec):
+    def __init__(self, condition: E.Expression, child: TpuExec,
+                 conf: TpuConf):
+        super().__init__(conf)
+        self.children = [child]
+        self.condition = condition
+
+    @property
+    def child(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def device_partitions(self) -> List[DevicePartitionThunk]:
+        bound = E.bind_references(self.condition, self.child.output)
+        metrics = self.metrics
+
+        def make(thunk: DevicePartitionThunk) -> DevicePartitionThunk:
+            def run() -> Iterator[DeviceBatch]:
+                for b in thunk():
+                    with metrics.timed(M.OP_TIME):
+                        out = X.run_filter(bound, b)
+                    metrics.create(M.NUM_OUTPUT_BATCHES, M.ESSENTIAL).add(1)
+                    yield out
+            return run
+        return [make(t) for t in device_channel(self.child)]
+
+    def simple_string(self):
+        return f"TpuFilter {self.condition!r}"
+
+
+class TpuRangeExec(TpuExec):
+    """Device iota (GpuRangeExec basicPhysicalOperators.scala:374): values
+    are generated directly in HBM, chunked to the batch-row goal."""
+
+    def __init__(self, output, start: int, end: int, step: int,
+                 num_partitions: int, conf: TpuConf):
+        super().__init__(conf)
+        self.children = []
+        self._output = output
+        self.start, self.end, self.step = start, end, step
+        self.num_partitions = max(1, num_partitions)
+
+    @property
+    def output(self):
+        return self._output
+
+    def device_partitions(self) -> List[DevicePartitionThunk]:
+        total = max(0, (self.end - self.start + self.step
+                        - (1 if self.step > 0 else -1)) // self.step)
+        per = (total + self.num_partitions - 1) // self.num_partitions \
+            if total else 0
+        goal = self.conf.batch_size_rows
+        schema = self.schema
+
+        def make(pidx: int) -> DevicePartitionThunk:
+            def run() -> Iterator[DeviceBatch]:
+                lo = pidx * per
+                hi = min(total, lo + per)
+                off = lo
+                while off < hi:
+                    n = min(goal, hi - off)
+                    cap = bucket_capacity(n)
+                    idx = jnp.arange(cap, dtype=jnp.int64)
+                    data = jnp.int64(self.start) + (
+                        jnp.int64(off) + idx) * jnp.int64(self.step)
+                    active = idx < n
+                    data = jnp.where(active, data, jnp.int64(0))
+                    from spark_rapids_tpu.columnar.device import DeviceColumn
+                    col = DeviceColumn(T.LongT, data, active)
+                    yield DeviceBatch(schema, [col], active, n)
+                    off += n
+            return run
+        return [make(i) for i in range(self.num_partitions)]
+
+    def simple_string(self):
+        return f"TpuRange ({self.start}, {self.end}, step={self.step})"
+
+
+class TpuUnionExec(TpuExec):
+    def __init__(self, children: List[TpuExec], output, conf: TpuConf):
+        super().__init__(conf)
+        self.children = list(children)
+        self._output = output
+
+    @property
+    def output(self):
+        return self._output
+
+    def device_partitions(self) -> List[DevicePartitionThunk]:
+        out: List[DevicePartitionThunk] = []
+        schema = self.schema
+
+        def retag(thunk: DevicePartitionThunk) -> DevicePartitionThunk:
+            def run() -> Iterator[DeviceBatch]:
+                for b in thunk():
+                    yield DeviceBatch(schema, b.columns, b.active,
+                                      b._num_rows)
+            return run
+        for c in self.children:
+            out.extend(retag(t) for t in device_channel(c))
+        return out
+
+    def simple_string(self):
+        return "TpuUnion"
+
+
+class TpuLocalLimitExec(TpuExec):
+    """Limit on device batches (limit.scala:124): keeps the first n active
+    rows by masking — cumulative count over the active mask, fixed shape."""
+
+    def __init__(self, n: int, child: TpuExec, conf: TpuConf):
+        super().__init__(conf)
+        self.children = [child]
+        self.n = n
+
+    @property
+    def child(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def device_partitions(self) -> List[DevicePartitionThunk]:
+        n = self.n
+
+        def make(thunk: DevicePartitionThunk) -> DevicePartitionThunk:
+            def run() -> Iterator[DeviceBatch]:
+                remaining = n
+                for b in thunk():
+                    if remaining <= 0:
+                        break
+                    cnt = b.row_count()
+                    if cnt <= remaining:
+                        remaining -= cnt
+                        yield b
+                        continue
+                    rank = jnp.cumsum(b.active.astype(jnp.int32))
+                    active = b.active & (rank <= remaining)
+                    yield DeviceBatch(b.schema, b.columns, active, remaining)
+                    remaining = 0
+            return run
+        return [make(t) for t in device_channel(self.child)]
+
+    def simple_string(self):
+        return f"TpuLocalLimit {self.n}"
+
+
+class TpuGlobalLimitExec(TpuLocalLimitExec):
+    """Same mask-based limit over the single post-exchange partition
+    (limit.scala:129)."""
+
+    def simple_string(self):
+        return f"TpuGlobalLimit {self.n}"

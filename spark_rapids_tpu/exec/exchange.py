@@ -1,0 +1,126 @@
+"""TpuShuffleExchangeExec: device-side partitioning + exchange
+(GpuShuffleExchangeExecBase.scala:148, GpuPartitioning.scala:50).
+
+Hash partition ids are computed on device with the bit-exact Spark
+murmur3 (ops/hashing.py), so rows land in exactly the partitions CPU
+Spark would use. The "split" is mask-only: each output partition reuses
+the input batch's columns with ``active & (pid == p)`` — zero data
+movement on device — then ``shrink_to_bucket`` compacts to the smallest
+power-of-two payload (the contiguousSplit analogue) before handing the
+batch to the consumer. In-process the exchange is a materialized list per
+partition (Spark's shuffle files); the multi-chip ICI all-to-all path
+replaces this transport while keeping the same partition-id kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import metrics as M
+from spark_rapids_tpu.columnar.device import DeviceBatch, shrink_to_bucket
+from spark_rapids_tpu.conf import TpuConf
+from spark_rapids_tpu.exec.base import (DevicePartitionThunk, TpuExec,
+                                        device_channel)
+from spark_rapids_tpu.ops import exprs as X
+from spark_rapids_tpu.sql import expressions as E
+from spark_rapids_tpu.sql import physical as P
+
+_PID_CACHE: Dict[Tuple, Callable] = {}
+
+
+def hash_partition_ids(exprs: List[E.Expression], batch: DeviceBatch,
+                       num_partitions: int) -> jax.Array:
+    """pmod(murmur3(keys, 42), n) per row — Spark HashPartitioning."""
+    key = (tuple(X.expr_key(e) for e in exprs), num_partitions)
+    fn = _PID_CACHE.get(key)
+    if fn is None:
+        def _fn(cols, active, lit_vals):
+            ctx = X.Ctx(cols, active.shape[0], tuple(exprs), lit_vals)
+            cols_eval = [X.dev_eval(e, ctx) for e in exprs]
+            from spark_rapids_tpu.ops import hashing
+            hv = hashing.murmur3_columns(cols_eval, active.shape[0], 42)
+            return jnp.mod(hv.astype(jnp.int64),
+                           num_partitions).astype(jnp.int32)
+        fn = jax.jit(_fn)
+        _PID_CACHE[key] = fn
+    return fn(batch.columns, batch.active, X.literal_values(exprs))
+
+
+class TpuShuffleExchangeExec(TpuExec):
+    def __init__(self, partitioning: P.Partitioning, child: TpuExec,
+                 conf: TpuConf):
+        super().__init__(conf)
+        self.children = [child]
+        self.partitioning = partitioning
+        self._cache: Optional[List[List[DeviceBatch]]] = None
+
+    @property
+    def child(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def _materialize(self) -> List[List[DeviceBatch]]:
+        if self._cache is not None:
+            return self._cache
+        p = self.partitioning
+        n = p.num_partitions
+        out: List[List[DeviceBatch]] = [[] for _ in range(n)]
+        if isinstance(p, P.HashPartitioning):
+            bound = P.bind_list(p.exprs, self.child.output)
+            for thunk in device_channel(self.child):
+                for b in thunk():
+                    if b.row_count() == 0:
+                        continue
+                    with self.metrics.timed(M.PARTITION_TIME):
+                        pids = hash_partition_ids(bound, b, n)
+                    for pid in range(n):
+                        part = DeviceBatch(
+                            b.schema, b.columns,
+                            b.active & (pids == pid), None)
+                        part = shrink_to_bucket(part)
+                        if part.row_count():
+                            out[pid].append(part)
+        elif isinstance(p, P.SinglePartitioning):
+            for thunk in device_channel(self.child):
+                for b in thunk():
+                    if b.row_count():
+                        out[0].append(b)
+        elif isinstance(p, P.RoundRobinPartitioning):
+            start = 0
+            for thunk in device_channel(self.child):
+                for b in thunk():
+                    cnt = b.row_count()
+                    if cnt == 0:
+                        continue
+                    rank = jnp.cumsum(b.active.astype(jnp.int32)) - 1
+                    pids = jnp.mod(rank + start, n).astype(jnp.int32)
+                    for pid in range(n):
+                        part = DeviceBatch(
+                            b.schema, b.columns,
+                            b.active & (pids == pid), None)
+                        part = shrink_to_bucket(part)
+                        if part.row_count():
+                            out[pid].append(part)
+                    start += 1
+        else:
+            raise NotImplementedError(repr(p))
+        self._cache = out
+        return out
+
+    def device_partitions(self) -> List[DevicePartitionThunk]:
+        nparts = self.partitioning.num_partitions
+
+        def make(pid: int) -> DevicePartitionThunk:
+            def run() -> Iterator[DeviceBatch]:
+                return iter(self._materialize()[pid])
+            return run
+        return [make(i) for i in range(nparts)]
+
+    def simple_string(self):
+        return f"TpuExchange {self.partitioning!r}"

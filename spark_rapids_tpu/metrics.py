@@ -1,0 +1,85 @@
+"""Operator metrics (GpuMetric, GpuExec.scala:17-103 twin).
+
+Three verbosity levels (ESSENTIAL/MODERATE/DEBUG) gated by
+``spark.rapids.sql.metrics.level``; each Tpu exec owns a named metric map
+surfaced by ``TpuExec.metrics``. Timers are wall-clock nanoseconds.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator
+
+ESSENTIAL = 0
+MODERATE = 1
+DEBUG = 2
+
+_LEVELS = {"ESSENTIAL": ESSENTIAL, "MODERATE": MODERATE, "DEBUG": DEBUG}
+
+# canonical metric names (GpuMetric object in GpuExec.scala)
+NUM_OUTPUT_ROWS = "numOutputRows"
+NUM_OUTPUT_BATCHES = "numOutputBatches"
+NUM_INPUT_ROWS = "numInputRows"
+NUM_INPUT_BATCHES = "numInputBatches"
+OP_TIME = "opTime"
+SEMAPHORE_WAIT_TIME = "semaphoreWaitTime"
+PEAK_DEVICE_MEMORY = "peakDeviceMemory"
+SPILL_BYTES = "spillBytes"
+SORT_TIME = "sortTime"
+AGG_TIME = "computeAggTime"
+JOIN_TIME = "joinTime"
+CONCAT_TIME = "concatTime"
+PARTITION_TIME = "partitionTime"
+COPY_TO_DEVICE_TIME = "copyToDeviceTime"
+COPY_FROM_DEVICE_TIME = "copyFromDeviceTime"
+
+
+@dataclass
+class TpuMetric:
+    name: str
+    level: int = MODERATE
+    value: int = 0
+
+    def add(self, v: int) -> None:
+        self.value += int(v)
+
+    def set_max(self, v: int) -> None:
+        self.value = max(self.value, int(v))
+
+
+class MetricRegistry:
+    """Per-exec metric map; creation is gated by the configured level so
+    disabled metrics cost a no-op (the reference wraps them in NoopMetric)."""
+
+    def __init__(self, conf_level: str = "MODERATE"):
+        self.enabled_level = _LEVELS.get(conf_level.upper(), MODERATE)
+        self.metrics: Dict[str, TpuMetric] = {}
+
+    def create(self, name: str, level: int = MODERATE) -> TpuMetric:
+        m = self.metrics.get(name)
+        if m is None:
+            m = TpuMetric(name, level)
+            if level <= self.enabled_level:
+                self.metrics[name] = m
+        return m
+
+    def __getitem__(self, name: str) -> TpuMetric:
+        return self.metrics.get(name) or TpuMetric(name)
+
+    def value(self, name: str) -> int:
+        m = self.metrics.get(name)
+        return m.value if m else 0
+
+    @contextlib.contextmanager
+    def timed(self, name: str, level: int = MODERATE) -> Iterator[None]:
+        m = self.create(name, level)
+        t0 = time.perf_counter_ns()
+        try:
+            yield
+        finally:
+            m.add(time.perf_counter_ns() - t0)
+
+    def snapshot(self) -> Dict[str, int]:
+        return {k: m.value for k, m in self.metrics.items()}

@@ -1,0 +1,266 @@
+"""Device groupBy kernel: sort-based segmented aggregation, fully static
+shapes (the cuDF groupBy the reference leans on, reimagined for XLA).
+
+Strategy (one jitted program per (expr-structure, capacity)):
+  1. Encode each key column into order-preserving unsigned sub-keys
+     (floats via total-order bit tricks, strings as packed big-endian
+     uint64 words from the byte matrix).
+  2. ``lexsort`` rows with the batch ``active`` mask as the primary key so
+     live rows are contiguous at the front.
+  3. Boundary flags where any sub-key (or active flag) changes between
+     adjacent sorted rows; ``cumsum`` -> segment ids. Segments over
+     inactive rows land at the tail and are dropped by the output mask.
+  4. Aggregate with ``jax.ops.segment_*`` at ``num_segments = capacity``
+     (static!). min/max/first/last pick a winning *row index* per segment
+     and gather, so values round-trip bit-exactly.
+
+This replaces the reference's hash-based cudf groupby with the only shape
+XLA loves: sort + segmented scan. The agg exec's concat/merge passes sit on
+top, mirroring GpuHashAggregateIterator (aggregate.scala:247).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.device import (
+    AnyDeviceColumn, DeviceColumn, DeviceStringColumn)
+from spark_rapids_tpu.ops.exprs import _float_total_order
+from spark_rapids_tpu.sql import types as T
+
+_U64_MAX = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+_SIGN64 = jnp.uint64(0x8000000000000000)
+
+
+def rank_u64(col: DeviceColumn) -> jax.Array:
+    """Order-preserving uint64 encoding of fixed-width data (Spark total
+    order for floats: NaN greatest, -0.0 == 0.0)."""
+    data = col.data
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        u = _float_total_order(data)
+        return u.astype(jnp.uint64)
+    if data.dtype == jnp.bool_:
+        return data.astype(jnp.uint64)
+    return data.astype(jnp.int64).view(jnp.uint64) ^ _SIGN64
+
+
+def pack_string_words(c: DeviceStringColumn) -> List[jax.Array]:
+    """Big-endian packed uint64 words: numeric word order == byte
+    lexicographic order, so word-wise compare/sort matches UTF-8 binary
+    order (with the lengths column as tiebreak for zero padding)."""
+    cap, char_cap = c.chars.shape
+    n_words = (char_cap + 7) // 8
+    chars = c.chars
+    if char_cap % 8:
+        chars = jnp.pad(chars, ((0, 0), (0, 8 * n_words - char_cap)))
+    words: List[jax.Array] = []
+    c64 = chars.astype(jnp.uint64)
+    for w in range(n_words):
+        word = jnp.zeros(cap, dtype=jnp.uint64)
+        for k in range(8):
+            word = word | (c64[:, 8 * w + k] << jnp.uint64(56 - 8 * k))
+        words.append(word)
+    return words
+
+
+def grouping_subkeys(col: AnyDeviceColumn) -> List[jax.Array]:
+    """Sub-key arrays whose joint equality == Spark group-key equality.
+    Validity is included so null forms its own group; invalid slots hold
+    normalized zeros so their data words tie."""
+    if isinstance(col, DeviceStringColumn):
+        return [col.validity, col.lengths] + pack_string_words(col)
+    return [col.validity, rank_u64(col)]
+
+
+class Segments:
+    """Result of the sort+boundary pass, everything capacity-shaped."""
+
+    def __init__(self, order: jax.Array, seg_ids: jax.Array,
+                 num_segments_arr: jax.Array, seg_active: jax.Array,
+                 active_sorted: jax.Array, capacity: int):
+        self.order = order              # sorted-row -> original-row index
+        self.seg_ids = seg_ids          # per sorted row
+        self.num_segments_arr = num_segments_arr  # scalar (traced)
+        self.seg_active = seg_active    # bool[capacity]: real group?
+        self.active_sorted = active_sorted
+        self.capacity = capacity
+
+
+def build_segments(key_cols: Sequence[AnyDeviceColumn],
+                   active: jax.Array) -> Segments:
+    cap = active.shape[0]
+    subkeys: List[jax.Array] = []
+    for c in key_cols:
+        subkeys.extend(grouping_subkeys(c))
+    # lexsort: last key is primary -> ~active puts live rows first
+    order = jnp.lexsort([k for k in subkeys] + [~active])
+    active_s = active[order]
+    sorted_keys = [k[order] for k in subkeys]
+    prev_differs = jnp.zeros(cap, dtype=bool)
+    for k in sorted_keys:
+        if k.ndim == 1:
+            d = k[1:] != k[:-1]
+        else:
+            d = (k[1:] != k[:-1]).any(axis=1)
+        prev_differs = prev_differs.at[1:].set(prev_differs[1:] | d)
+    prev_differs = prev_differs.at[1:].set(
+        prev_differs[1:] | (active_s[1:] != active_s[:-1]))
+    boundary = prev_differs.at[0].set(True)
+    seg_ids = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    nseg = jnp.sum(boundary.astype(jnp.int32))
+    seg_exists = jnp.arange(cap, dtype=jnp.int32) < nseg
+    seg_has_active = jax.ops.segment_max(
+        active_s.astype(jnp.int32), seg_ids, num_segments=cap,
+        indices_are_sorted=True) > 0
+    return Segments(order, seg_ids, nseg, seg_exists & seg_has_active,
+                    active_s, cap)
+
+
+def representative_rows(seg: Segments) -> jax.Array:
+    """Original row index of the first sorted row of each segment."""
+    pos = jnp.arange(seg.capacity, dtype=jnp.int32)
+    first_pos = jax.ops.segment_min(pos, seg.seg_ids,
+                                    num_segments=seg.capacity,
+                                    indices_are_sorted=True)
+    safe = jnp.clip(first_pos, 0, seg.capacity - 1)
+    return seg.order[safe]
+
+
+def _acc_dtype(out_type: T.DataType) -> jnp.dtype:
+    from spark_rapids_tpu.columnar.device import storage_jnp_dtype
+    return storage_jnp_dtype(out_type)
+
+
+def seg_sum(seg: Segments, col: AnyDeviceColumn, out_type: T.DataType,
+            null_when_empty: bool) -> DeviceColumn:
+    """sum / sum_nonnull primitive."""
+    valid_s = (col.validity[seg.order]) & seg.active_sorted
+    acc_dt = _acc_dtype(out_type)
+    vals = jnp.where(valid_s, col.data[seg.order].astype(acc_dt),
+                     jnp.zeros((), acc_dt))
+    acc = jax.ops.segment_sum(vals, seg.seg_ids, num_segments=seg.capacity,
+                              indices_are_sorted=True)
+    if null_when_empty:
+        has = jax.ops.segment_max(valid_s.astype(jnp.int32), seg.seg_ids,
+                                  num_segments=seg.capacity,
+                                  indices_are_sorted=True) > 0
+        validity = has & seg.seg_active
+    else:
+        validity = seg.seg_active
+    acc = jnp.where(validity, acc, jnp.zeros((), acc_dt))
+    return DeviceColumn(out_type, acc, validity)
+
+
+def seg_count(seg: Segments, col: AnyDeviceColumn) -> DeviceColumn:
+    valid_s = (col.validity[seg.order]) & seg.active_sorted
+    acc = jax.ops.segment_sum(valid_s.astype(jnp.int64), seg.seg_ids,
+                              num_segments=seg.capacity,
+                              indices_are_sorted=True)
+    acc = jnp.where(seg.seg_active, acc, jnp.int64(0))
+    return DeviceColumn(T.LongT, acc, seg.seg_active)
+
+
+def _winner_gather(seg: Segments, col: AnyDeviceColumn,
+                   winner_orig_idx: jax.Array, won: jax.Array
+                   ) -> AnyDeviceColumn:
+    """Gather per-segment winning rows; `won` marks segments with a
+    winner (others -> null)."""
+    from spark_rapids_tpu.columnar.device import take_columns
+    safe = jnp.clip(winner_orig_idx, 0, seg.capacity - 1)
+    return take_columns([col], safe, valid_at=won)[0]
+
+
+def seg_extreme(seg: Segments, col: AnyDeviceColumn, is_min: bool
+                ) -> AnyDeviceColumn:
+    """min/max by winning-row-index so values round-trip untouched."""
+    if isinstance(col, DeviceStringColumn):
+        # strings: sorted position is already lexicographic *within a
+        # segment only if the string is a grouping key*; for arbitrary
+        # value columns fall back to word-wise tournament
+        return _seg_extreme_string(seg, col, is_min)
+    rank = rank_u64(col)[seg.order]
+    valid_s = (col.validity[seg.order]) & seg.active_sorted
+    if is_min:
+        rank = jnp.where(valid_s, rank, _U64_MAX)
+        best = jax.ops.segment_min(rank, seg.seg_ids,
+                                   num_segments=seg.capacity,
+                                   indices_are_sorted=True)
+    else:
+        rank = jnp.where(valid_s, rank, jnp.uint64(0))
+        best = jax.ops.segment_max(rank, seg.seg_ids,
+                                   num_segments=seg.capacity,
+                                   indices_are_sorted=True)
+    is_winner = valid_s & (rank == best[seg.seg_ids])
+    pos = jnp.arange(seg.capacity, dtype=jnp.int32)
+    cand = jnp.where(is_winner, pos, jnp.int32(seg.capacity))
+    win_pos = jax.ops.segment_min(cand, seg.seg_ids,
+                                  num_segments=seg.capacity,
+                                  indices_are_sorted=True)
+    won = (win_pos < seg.capacity) & seg.seg_active
+    winner_orig = seg.order[jnp.clip(win_pos, 0, seg.capacity - 1)]
+    return _winner_gather(seg, col, winner_orig, won)
+
+
+def _seg_extreme_string(seg: Segments, col: DeviceStringColumn,
+                        is_min: bool) -> DeviceStringColumn:
+    """String min/max: tournament over (words..., length) ranking. Builds
+    a per-row composite comparison by walking words most-significant
+    first; segments pick the winning row index."""
+    words = pack_string_words(col)
+    valid_s = (col.validity[seg.order]) & seg.active_sorted
+    cap = seg.capacity
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    # iterative refinement: start with all valid rows as candidates, then
+    # for each word keep only rows matching the per-segment best word
+    cand = valid_s
+    for w in words + [col.lengths.astype(jnp.uint64)]:
+        w_s = w[seg.order].astype(jnp.uint64)
+        if is_min:
+            masked = jnp.where(cand, w_s, _U64_MAX)
+            best = jax.ops.segment_min(masked, seg.seg_ids,
+                                       num_segments=cap,
+                                       indices_are_sorted=True)
+        else:
+            masked = jnp.where(cand, w_s, jnp.uint64(0))
+            best = jax.ops.segment_max(masked, seg.seg_ids,
+                                       num_segments=cap,
+                                       indices_are_sorted=True)
+        has_cand = jax.ops.segment_max(cand.astype(jnp.int32), seg.seg_ids,
+                                       num_segments=cap,
+                                       indices_are_sorted=True) > 0
+        keep = cand & (w_s == best[seg.seg_ids]) & has_cand[seg.seg_ids]
+        cand = keep
+    p = jnp.where(cand, pos, jnp.int32(cap))
+    win_pos = jax.ops.segment_min(p, seg.seg_ids, num_segments=cap,
+                                  indices_are_sorted=True)
+    won = (win_pos < cap) & seg.seg_active
+    winner_orig = seg.order[jnp.clip(win_pos, 0, cap - 1)]
+    return _winner_gather(seg, col, winner_orig, won)
+
+
+def seg_first_last(seg: Segments, col: AnyDeviceColumn, is_first: bool,
+                   ignore_nulls: bool) -> AnyDeviceColumn:
+    """first/last by original row order (Spark First/Last semantics).
+    ignore_nulls=False ("_any" prims) takes the first/last *row* and keeps
+    its null-ness."""
+    orig = seg.order.astype(jnp.int32)
+    eligible = seg.active_sorted
+    if ignore_nulls:
+        eligible = eligible & col.validity[seg.order]
+    cap = seg.capacity
+    if is_first:
+        cand = jnp.where(eligible, orig, jnp.int32(cap))
+        win = jax.ops.segment_min(cand, seg.seg_ids, num_segments=cap,
+                                  indices_are_sorted=True)
+        won = (win < cap) & seg.seg_active
+    else:
+        cand = jnp.where(eligible, orig, jnp.int32(-1))
+        win = jax.ops.segment_max(cand, seg.seg_ids, num_segments=cap,
+                                  indices_are_sorted=True)
+        won = (win >= 0) & seg.seg_active
+    # _winner_gather keeps the winning row's own validity, which is what
+    # ignore_nulls=False needs (null first-row -> null result)
+    return _winner_gather(seg, col, jnp.clip(win, 0, cap - 1), won)

@@ -1,13 +1,410 @@
-"""TpuOverrides: the plan-rewrite engine (GpuOverrides.scala equivalent).
+"""TpuOverrides: the plan-rewrite engine (GpuOverrides.scala:3564 twin).
 
-Placeholder entry point while the meta/typesig framework lands; currently
-returns the CPU plan unchanged.
+Pipeline mirrors the reference's wrap -> tag -> convert flow:
+
+1. **wrap**: every CPU physical node is wrapped in an ``ExecMeta`` (the
+   RapidsMeta tree, RapidsMeta.scala:70) carrying its matching
+   ``ExecRule`` from the registry below.
+2. **tag**: each meta collects ``willNotWorkOnTpu`` reasons — per-op conf
+   keys (``spark.rapids.sql.exec.<Op>`` / ``...sql.expression.<Expr>``,
+   auto-derived like ReplacementRule.confKey GpuOverrides.scala:147),
+   TypeSig checks over the node's schema, expression-tree device support,
+   and op-specific rules (e.g. range partitioning stays on CPU until the
+   device sort lands).
+3. **convert**: supported subtrees become ``Tpu*Exec`` nodes; transitions
+   ``TpuRowToColumnarExec`` / ``TpuColumnarToRowExec`` are inserted at
+   every CPU<->device boundary (GpuTransitionOverrides.scala:48), and the
+   root is brought back to rows.
+
+``RewriteReport`` records every fallback with its reason — the
+``spark.rapids.sql.explain=NOT_ON_GPU`` output and the hook the
+fallback-assertion tests use (ExecutionPlanCaptureCallback analogue).
 """
 
 from __future__ import annotations
 
-from spark_rapids_tpu.conf import TpuConf
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Type
+
+from spark_rapids_tpu import typesig as TS
+from spark_rapids_tpu.conf import (ALLOW_DISABLE_ENTIRE_PLAN,
+                                   ENABLE_FLOAT_AGG, INCOMPATIBLE_OPS,
+                                   TEST_FORCE_DEVICE, TpuConf)
+from spark_rapids_tpu.ops import exprs as X
+from spark_rapids_tpu.sql import expressions as E
+from spark_rapids_tpu.sql import physical as P
+from spark_rapids_tpu.sql import types as T
 
 
-def apply_overrides(physical, conf: TpuConf):
-    return physical
+# ---------------------------------------------------------------------------
+# Expression rules (the `expressions` registry, GpuOverrides.scala:3136)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ExprRule:
+    name: str
+    checks: TS.ExprChecks
+    incompat: Optional[str] = None  # reason string when semantics differ
+
+    @property
+    def conf_key(self) -> str:
+        return f"spark.rapids.sql.expression.{self.name}"
+
+
+_EXPR_RULES: Dict[Type, ExprRule] = {}
+
+
+def expr_rule(cls: Type, checks: Optional[TS.ExprChecks] = None,
+              incompat: Optional[str] = None) -> None:
+    _EXPR_RULES[cls] = ExprRule(
+        cls.__name__, checks or TS.expr_checks(TS.common_tpu), incompat)
+
+
+# default rules for every device-implemented expression; specific
+# signatures/incompat flags override below
+for _cls in X._HANDLERS:
+    expr_rule(_cls)
+
+expr_rule(E.Substring, incompat="byte-positioned substring is exact only "
+          "for ASCII strings")
+expr_rule(E.Upper, incompat="case conversion is ASCII-only")
+expr_rule(E.Lower, incompat="case conversion is ASCII-only")
+
+# leaves that are valid in any device expression tree without a handler
+_LEAF_OK = (E.AttributeReference,)
+
+
+def check_expr_tree(e: E.Expression, conf: TpuConf) -> Optional[str]:
+    """willNotWorkOnTpu reason for an (unbound) expression tree, or None."""
+    if isinstance(e, E.Alias):
+        return check_expr_tree(e.child, conf)
+    if isinstance(e, _LEAF_OK):
+        r = TS.common_tpu.support(e.data_type)
+        if r:
+            return f"attribute {e.name}: {r}"
+        return None
+    rule = _EXPR_RULES.get(type(e))
+    if rule is None:
+        return (f"expression {type(e).__name__} is not supported on TPU")
+    if not conf.is_op_enabled(rule.conf_key):
+        return (f"expression {type(e).__name__} has been disabled "
+                f"({rule.conf_key}=false)")
+    if rule.incompat and not conf.get(INCOMPATIBLE_OPS):
+        return (f"expression {type(e).__name__} is not 100% compatible: "
+                f"{rule.incompat}. Set "
+                f"spark.rapids.sql.incompatibleOps.enabled=true to allow")
+    r = rule.checks.tag(e)
+    if r:
+        return f"expression {type(e).__name__}: {r}"
+    extra = X._EXTRA_CHECKS.get(type(e))
+    if extra is not None:
+        r = extra(e)
+        if r:
+            return f"expression {type(e).__name__}: {r}"
+    for c in e.children:
+        r = check_expr_tree(c, conf)
+        if r:
+            return r
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Exec rules (the `commonExecs` registry, GpuOverrides.scala:3252)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ExecRule:
+    name: str
+    desc: str
+    checks: TS.ExecChecks
+    tag_fn: Optional[Callable[["ExecMeta"], None]] = None
+    convert_fn: Optional[Callable] = None  # (meta, device_children) -> plan
+
+    @property
+    def conf_key(self) -> str:
+        return f"spark.rapids.sql.exec.{self.name}"
+
+
+_EXEC_RULES: Dict[Type, ExecRule] = {}
+
+
+def exec_rule(cls: Type, desc: str,
+              checks: Optional[TS.ExecChecks] = None,
+              tag_fn=None, convert_fn=None) -> None:
+    _EXEC_RULES[cls] = ExecRule(cls.__name__.replace("Cpu", ""), desc,
+                                checks or TS.ExecChecks(TS.common_tpu),
+                                tag_fn, convert_fn)
+
+
+# CPU data sources that legitimately feed the device through a
+# TpuRowToColumnarExec transition; they are not "fallbacks" (the reference
+# likewise scans host-side relations via HostColumnarToGpu without
+# reporting them NOT_ON_GPU)
+_TRANSPARENT_CPU: tuple = ()
+
+
+def register_transparent_cpu(*classes: Type) -> None:
+    global _TRANSPARENT_CPU
+    _TRANSPARENT_CPU = _TRANSPARENT_CPU + classes
+
+
+class ExecMeta:
+    """Wrapper over one CPU physical node (SparkPlanMeta RapidsMeta:543)."""
+
+    def __init__(self, wrapped: P.PhysicalPlan, conf: TpuConf,
+                 parent: Optional["ExecMeta"]):
+        self.wrapped = wrapped
+        self.conf = conf
+        self.parent = parent
+        self.rule = _EXEC_RULES.get(type(wrapped))
+        self.children = [ExecMeta(c, conf, self) for c in wrapped.children]
+        self.reasons: List[str] = []
+
+    def will_not_work(self, reason: str) -> None:
+        if reason not in self.reasons:
+            self.reasons.append(reason)
+
+    @property
+    def can_replace(self) -> bool:
+        return self.rule is not None and not self.reasons
+
+    def tag(self) -> None:
+        for c in self.children:
+            c.tag()
+        if isinstance(self.wrapped, _TRANSPARENT_CPU):
+            return
+        if self.rule is None:
+            self.will_not_work(
+                f"{type(self.wrapped).__name__} has no TPU replacement")
+            return
+        if not self.conf.is_op_enabled(self.rule.conf_key):
+            self.will_not_work(
+                f"the exec has been disabled ({self.rule.conf_key}=false)")
+        r = self.rule.checks.tag(
+            [f.data_type for f in self.wrapped.schema.fields])
+        if r:
+            self.will_not_work(r)
+        # inputs must be representable too (transitions carry data)
+        for c in self.wrapped.children:
+            r = TS.common_tpu.supports_all(
+                [f.data_type for f in c.schema.fields])
+            if r:
+                self.will_not_work(f"input: {r}")
+        if self.rule.tag_fn is not None:
+            self.rule.tag_fn(self)
+
+    def convert(self) -> P.PhysicalPlan:
+        """Emit the mixed plan under this meta (convertIfNeeded)."""
+        from spark_rapids_tpu.exec.base import (TpuColumnarToRowExec,
+                                                TpuExec,
+                                                TpuRowToColumnarExec)
+        conf = self.conf
+        converted = [c.convert() for c in self.children]
+        if self.can_replace:
+            device_children = []
+            for plan in converted:
+                if isinstance(plan, TpuExec):
+                    device_children.append(plan)
+                else:
+                    if isinstance(plan, TpuColumnarToRowExec):
+                        # fuse C2R->R2C back to the device channel
+                        device_children.append(plan.child)
+                    else:
+                        device_children.append(
+                            TpuRowToColumnarExec(plan, conf))
+            return self.rule.convert_fn(self, device_children)
+        # stays on CPU: device children come back through C2R
+        cpu_children = []
+        for plan in converted:
+            if isinstance(plan, TpuExec):
+                cpu_children.append(TpuColumnarToRowExec(plan, conf))
+            else:
+                cpu_children.append(plan)
+        if cpu_children:
+            return self.wrapped.with_new_children(cpu_children)
+        return self.wrapped
+
+    # -- reporting -----------------------------------------------------
+
+    def collect_fallbacks(self, out: List) -> None:
+        if self.rule is not None and self.reasons:
+            out.append((type(self.wrapped).__name__, list(self.reasons)))
+        elif self.rule is None and self.reasons:
+            out.append((type(self.wrapped).__name__, list(self.reasons)))
+        for c in self.children:
+            c.collect_fallbacks(out)
+
+
+# -- op-specific tagging ----------------------------------------------------
+
+def _tag_project(meta: ExecMeta) -> None:
+    for e in meta.wrapped.project_list:
+        r = check_expr_tree(e, meta.conf)
+        if r:
+            meta.will_not_work(r)
+
+
+def _tag_filter(meta: ExecMeta) -> None:
+    r = check_expr_tree(meta.wrapped.condition, meta.conf)
+    if r:
+        meta.will_not_work(r)
+
+
+def _tag_exchange(meta: ExecMeta) -> None:
+    p = meta.wrapped.partitioning
+    if isinstance(p, P.HashPartitioning):
+        for e in p.exprs:
+            r = check_expr_tree(e, meta.conf)
+            if r:
+                meta.will_not_work(r)
+            dt = getattr(e, "data_type", None)
+            if dt is not None and isinstance(dt, T.DecimalType) \
+                    and dt.precision > 18:
+                meta.will_not_work(
+                    "decimal128 hash partitioning runs on CPU")
+    elif isinstance(p, (P.SinglePartitioning, P.RoundRobinPartitioning)):
+        pass
+    else:
+        meta.will_not_work(
+            f"{type(p).__name__} is not supported on TPU yet")
+
+
+def _tag_aggregate(meta: ExecMeta) -> None:
+    from spark_rapids_tpu.exec.agg import is_device_agg
+    node = meta.wrapped
+    r = is_device_agg(node.grouping, node.aggregates)
+    if r:
+        meta.will_not_work(r)
+        return
+    for g in node.grouping:
+        rr = TS.common_tpu.support(g.data_type)
+        if rr:
+            meta.will_not_work(f"grouping key {g.name}: {rr}")
+    if not meta.conf.get(ENABLE_FLOAT_AGG):
+        for e in node.aggregates:
+            if isinstance(e, E.Alias) and isinstance(
+                    e.child, E.AggregateExpression):
+                func = e.child.func
+                if isinstance(func, (E.Sum, E.Average)) and T.is_floating(
+                        func.children[0].data_type):
+                    meta.will_not_work(
+                        "device float sum/average may differ from CPU due "
+                        "to addition ordering "
+                        "(spark.rapids.sql.variableFloatAgg.enabled=false)")
+
+
+# -- converters -------------------------------------------------------------
+
+def _conv_project(meta, kids):
+    from spark_rapids_tpu.exec.basic import TpuProjectExec
+    return TpuProjectExec(meta.wrapped.project_list, kids[0], meta.conf)
+
+
+def _conv_filter(meta, kids):
+    from spark_rapids_tpu.exec.basic import TpuFilterExec
+    return TpuFilterExec(meta.wrapped.condition, kids[0], meta.conf)
+
+
+def _conv_range(meta, kids):
+    from spark_rapids_tpu.exec.basic import TpuRangeExec
+    w = meta.wrapped
+    return TpuRangeExec(w.output, w.start, w.end, w.step,
+                        w.num_partitions, meta.conf)
+
+
+def _conv_union(meta, kids):
+    from spark_rapids_tpu.exec.basic import TpuUnionExec
+    return TpuUnionExec(kids, meta.wrapped.output, meta.conf)
+
+
+def _conv_local_limit(meta, kids):
+    from spark_rapids_tpu.exec.basic import TpuLocalLimitExec
+    return TpuLocalLimitExec(meta.wrapped.n, kids[0], meta.conf)
+
+
+def _conv_global_limit(meta, kids):
+    from spark_rapids_tpu.exec.basic import TpuGlobalLimitExec
+    return TpuGlobalLimitExec(meta.wrapped.n, kids[0], meta.conf)
+
+
+def _conv_exchange(meta, kids):
+    from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+    return TpuShuffleExchangeExec(meta.wrapped.partitioning, kids[0],
+                                  meta.conf)
+
+
+def _conv_aggregate(meta, kids):
+    from spark_rapids_tpu.exec.agg import TpuHashAggregateExec
+    w = meta.wrapped
+    return TpuHashAggregateExec(w.grouping, w.aggregates, w.mode, kids[0],
+                                w.slots, meta.conf)
+
+
+exec_rule(P.CpuProjectExec, "projection onto device columns",
+          tag_fn=_tag_project, convert_fn=_conv_project)
+exec_rule(P.CpuFilterExec, "device predicate filter (mask update)",
+          tag_fn=_tag_filter, convert_fn=_conv_filter)
+exec_rule(P.CpuRangeExec, "device iota range source",
+          convert_fn=_conv_range)
+exec_rule(P.CpuUnionExec, "union of device partitions",
+          convert_fn=_conv_union)
+exec_rule(P.CpuLocalLimitExec, "per-partition limit by mask",
+          convert_fn=_conv_local_limit)
+exec_rule(P.CpuGlobalLimitExec, "global limit by mask",
+          convert_fn=_conv_global_limit)
+exec_rule(P.CpuShuffleExchangeExec, "device-partitioned exchange",
+          tag_fn=_tag_exchange, convert_fn=_conv_exchange)
+exec_rule(P.CpuHashAggregateExec, "sort-segmented device aggregation",
+          tag_fn=_tag_aggregate, convert_fn=_conv_aggregate)
+register_transparent_cpu(P.CpuLocalScanExec)
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+@dataclass
+class RewriteReport:
+    """Explain/fallback record for one query (GpuOverrides explain)."""
+
+    fallbacks: List = field(default_factory=list)  # (exec name, [reasons])
+    replaced_any: bool = False
+
+    def format(self) -> str:
+        lines = []
+        for name, reasons in self.fallbacks:
+            for r in reasons:
+                lines.append(f"!Exec <{name}> cannot run on TPU because {r}")
+        return "\n".join(lines)
+
+
+def apply_overrides(physical: P.PhysicalPlan, conf: TpuConf,
+                    report: Optional[RewriteReport] = None
+                    ) -> P.PhysicalPlan:
+    """GpuOverrides.apply + GpuTransitionOverrides in one pass."""
+    from spark_rapids_tpu.exec.base import TpuColumnarToRowExec, TpuExec
+    meta = ExecMeta(physical, conf, None)
+    meta.tag()
+    if report is None:
+        report = RewriteReport()
+    meta.collect_fallbacks(report.fallbacks)
+    if conf.get(TEST_FORCE_DEVICE) and report.fallbacks:
+        raise AssertionError(
+            "Part of the plan is not columnar (test.forceDevice):\n"
+            + report.format())
+    new_plan = meta.convert()
+    if isinstance(new_plan, TpuExec):
+        new_plan = TpuColumnarToRowExec(new_plan, conf)
+        report.replaced_any = True
+    else:
+        report.replaced_any = _has_device_op(new_plan)
+    if conf.explain in ("ALL", "NOT_ON_GPU") and report.fallbacks:
+        print(report.format())
+    return new_plan
+
+
+def _has_device_op(plan: P.PhysicalPlan) -> bool:
+    from spark_rapids_tpu.exec.base import TpuExec
+    if isinstance(plan, TpuExec):
+        return True
+    return any(_has_device_op(c) for c in plan.children)

@@ -46,3 +46,54 @@ def close_on_except(resource: T) -> Iterator[T]:
         else:
             _close(resource)
         raise
+
+
+class TpuSemaphore:
+    """Throttles concurrent tasks touching the device (GpuSemaphore.scala:27).
+
+    Bounds HBM pressure from parallel partitions: a task thread acquires
+    before uploading/computing on device and releases once its device data
+    is exhausted (C2R / serializer). Reentrant per thread, like the
+    reference's per-task tracking. Wait time is reported to the caller's
+    metric registry.
+    """
+
+    def __init__(self, permits: int):
+        import threading
+        self.permits = max(1, permits)
+        self._sem = threading.Semaphore(self.permits)
+        self._held = threading.local()
+
+    def acquire_if_necessary(self, metrics=None) -> None:
+        import time
+        if getattr(self._held, "count", 0) > 0:
+            self._held.count += 1
+            return
+        t0 = time.perf_counter_ns()
+        self._sem.acquire()
+        if metrics is not None:
+            from spark_rapids_tpu import metrics as M
+            metrics.create(M.SEMAPHORE_WAIT_TIME).add(
+                time.perf_counter_ns() - t0)
+        self._held.count = 1
+
+    def release_if_necessary(self) -> None:
+        count = getattr(self._held, "count", 0)
+        if count > 1:
+            self._held.count = count - 1
+        elif count == 1:
+            self._held.count = 0
+            self._sem.release()
+
+
+_SEMAPHORE: "TpuSemaphore | None" = None
+
+
+def get_semaphore(conf) -> TpuSemaphore:
+    """Process-wide semaphore sized by spark.rapids.sql.concurrentGpuTasks
+    (initialized lazily; Plugin.scala:199 does this at executor startup)."""
+    global _SEMAPHORE
+    if _SEMAPHORE is None:
+        from spark_rapids_tpu.conf import CONCURRENT_TPU_TASKS
+        _SEMAPHORE = TpuSemaphore(conf.get(CONCURRENT_TPU_TASKS))
+    return _SEMAPHORE

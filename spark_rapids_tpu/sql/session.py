@@ -48,6 +48,7 @@ class TpuSparkSession:
         self.catalog_views: Dict[str, L.LogicalPlan] = {}
         self._plan_capture: List = []  # ExecutionPlanCaptureCallback twin
         self._capture_enabled = False
+        self.last_rewrite_report = None
         with TpuSparkSession._lock:
             TpuSparkSession._active = self
 
@@ -115,9 +116,13 @@ class TpuSparkSession:
     def plan_physical(self, plan: L.LogicalPlan):
         """CPU physical plan, then the plugin rewrite when enabled."""
         physical = Planner(self.conf_obj).plan(plan)
+        self.last_rewrite_report = None
         if self.conf_obj.sql_enabled:
-            from spark_rapids_tpu.overrides import apply_overrides
-            physical = apply_overrides(physical, self.conf_obj)
+            from spark_rapids_tpu.overrides import (RewriteReport,
+                                                    apply_overrides)
+            report = RewriteReport()
+            physical = apply_overrides(physical, self.conf_obj, report)
+            self.last_rewrite_report = report
         if self._capture_enabled:
             self._plan_capture.append(physical)
         return physical

@@ -1,0 +1,164 @@
+"""TypeSig: declarative per-op type support (TypeChecks.scala:171 twin).
+
+The reference's `TypeSig` is an algebra of supported-type sets attached to
+every exec/expression rule; tagging evaluates an op's input/output types
+against its signature and records human-readable fallback reasons, and the
+same data generates the support-matrix docs (SupportedOpsDocs,
+TypeChecks.scala:1637). This module reproduces that shape in Python:
+``TypeSig`` instances are immutable sets of type tags plus a decimal
+precision bound, combined with ``+``/``-``, and checked with
+``sig.support(dtype)`` returning ``None`` or a reason string.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import FrozenSet, Optional, Tuple
+
+from spark_rapids_tpu.sql import types as T
+
+# type tags
+BOOLEAN = "BOOLEAN"
+BYTE = "BYTE"
+SHORT = "SHORT"
+INT = "INT"
+LONG = "LONG"
+FLOAT = "FLOAT"
+DOUBLE = "DOUBLE"
+DATE = "DATE"
+TIMESTAMP = "TIMESTAMP"
+STRING = "STRING"
+BINARY = "BINARY"
+DECIMAL = "DECIMAL"
+NULL = "NULL"
+ARRAY = "ARRAY"
+MAP = "MAP"
+STRUCT = "STRUCT"
+
+_TAG_OF = {
+    T.BooleanType: BOOLEAN, T.ByteType: BYTE, T.ShortType: SHORT,
+    T.IntegerType: INT, T.LongType: LONG, T.FloatType: FLOAT,
+    T.DoubleType: DOUBLE, T.DateType: DATE, T.TimestampType: TIMESTAMP,
+    T.StringType: STRING, T.BinaryType: BINARY, T.DecimalType: DECIMAL,
+    T.NullType: NULL,
+}
+
+
+def tag_of(dt: T.DataType) -> Optional[str]:
+    for cls, tag in _TAG_OF.items():
+        if isinstance(dt, cls):
+            return tag
+    if isinstance(dt, T.ArrayType):
+        return ARRAY
+    if isinstance(dt, T.MapType):
+        return MAP
+    if isinstance(dt, T.StructType):
+        return STRUCT
+    return None
+
+
+@dataclass(frozen=True)
+class TypeSig:
+    """Immutable set of supported type tags (TypeSig, TypeChecks.scala:171).
+
+    ``max_decimal_precision`` bounds DECIMAL support (the reference caps at
+    DECIMAL64, TypeChecks.scala's decimal handling); 0 means no decimals.
+    """
+
+    tags: FrozenSet[str] = frozenset()
+    max_decimal_precision: int = 0
+    notes: Tuple[Tuple[str, str], ...] = ()  # tag -> caveat note (psNote)
+
+    def __add__(self, other: "TypeSig") -> "TypeSig":
+        return TypeSig(self.tags | other.tags,
+                       max(self.max_decimal_precision,
+                           other.max_decimal_precision),
+                       self.notes + other.notes)
+
+    def __sub__(self, other: "TypeSig") -> "TypeSig":
+        return TypeSig(self.tags - other.tags, self.max_decimal_precision,
+                       self.notes)
+
+    def with_psNote(self, tag: str, note: str) -> "TypeSig":
+        return TypeSig(self.tags, self.max_decimal_precision,
+                       self.notes + ((tag, note),))
+
+    def support(self, dt: T.DataType) -> Optional[str]:
+        """None when supported, else the willNotWorkOnGpu reason."""
+        tag = tag_of(dt)
+        if tag is None:
+            return f"unknown type {dt!r} is not supported"
+        if tag == DECIMAL:
+            if DECIMAL not in self.tags:
+                return "decimal is not supported"
+            if dt.precision > self.max_decimal_precision:
+                return (f"decimal precision {dt.precision} exceeds max "
+                        f"supported {self.max_decimal_precision}")
+            return None
+        if tag not in self.tags:
+            return f"{tag.lower()} is not supported"
+        return None
+
+    def supports_all(self, dts) -> Optional[str]:
+        for dt in dts:
+            r = self.support(dt)
+            if r:
+                return r
+        return None
+
+
+def _sig(*tags: str, decimal_precision: int = 0) -> TypeSig:
+    return TypeSig(frozenset(tags), decimal_precision)
+
+
+none = _sig()
+integral = _sig(BYTE, SHORT, INT, LONG)
+fp = _sig(FLOAT, DOUBLE)
+numeric = integral + fp
+DECIMAL_64 = _sig(DECIMAL, decimal_precision=18)
+DECIMAL_128 = _sig(DECIMAL, decimal_precision=38)
+numeric_and_decimal = numeric + DECIMAL_64
+comparable = numeric + _sig(BOOLEAN, DATE, TIMESTAMP, STRING)
+ordered = comparable
+# what the device columnar layer can represent today (strings as byte
+# matrices, no nested types yet) — the `commonCudfTypes` analogue
+common_tpu = numeric + _sig(BOOLEAN, DATE, TIMESTAMP, STRING, BINARY)
+common_tpu_with_null = common_tpu + _sig(NULL)
+all_types = common_tpu + DECIMAL_128 + _sig(NULL, ARRAY, MAP, STRUCT)
+
+
+@dataclass
+class ExecChecks:
+    """Input/output signature of an exec rule (ExecChecks TypeChecks:890)."""
+
+    sig: TypeSig
+
+    def tag(self, schema_types) -> Optional[str]:
+        return self.sig.supports_all(schema_types)
+
+
+@dataclass
+class ExprChecks:
+    """Signature of an expression rule (ExprChecks TypeChecks:1409):
+    the output sig plus one sig for all inputs (fine-grained per-param
+    checks can be added per rule as the matrix grows)."""
+
+    output: TypeSig
+    inputs: TypeSig
+
+    def tag(self, expr) -> Optional[str]:
+        r = self.output.support(expr.data_type)
+        if r:
+            return f"output: {r}"
+        for c in expr.children:
+            dt = getattr(c, "data_type", None)
+            if dt is not None:
+                rc = self.inputs.support(dt)
+                if rc:
+                    return f"input {type(c).__name__}: {rc}"
+        return None
+
+
+def expr_checks(output: TypeSig, inputs: Optional[TypeSig] = None
+                ) -> ExprChecks:
+    return ExprChecks(output, inputs if inputs is not None else output)

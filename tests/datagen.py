@@ -1,0 +1,184 @@
+"""Seeded random data generators — the DataGen hierarchy twin
+(integration_tests data_gen.py:30 in the reference). Deterministic per
+seed; every generator mixes nulls and the type's edge values (extremes,
+NaN/±Inf/-0.0 for floats, empty/whitespace strings) because those are
+where device/CPU semantics diverge first.
+"""
+
+from __future__ import annotations
+
+import string
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+from spark_rapids_tpu.sql import types as T
+
+DEFAULT_SEED = 42
+
+
+class DataGen:
+    dtype: T.DataType
+
+    def __init__(self, nullable: bool = True, null_prob: float = 0.1):
+        self.nullable = nullable
+        self.null_prob = null_prob
+
+    def _values(self, n: int, rng: np.random.Generator) -> np.ndarray:
+        raise NotImplementedError
+
+    def gen(self, n: int, rng: np.random.Generator) -> HostColumn:
+        data = self._values(n, rng)
+        if self.nullable:
+            validity = rng.random(n) >= self.null_prob
+        else:
+            validity = np.ones(n, dtype=bool)
+        return HostColumn(self.dtype, data, validity).normalized()
+
+
+class _IntegralGen(DataGen):
+    np_dtype: np.dtype
+    lo: int
+    hi: int
+
+    def _values(self, n, rng):
+        vals = rng.integers(self.lo, self.hi, size=n, endpoint=True,
+                            dtype=np.int64).astype(self.np_dtype)
+        # sprinkle extremes
+        for v in (self.lo, self.hi, 0):
+            idx = rng.integers(0, n)
+            vals[idx] = v
+        return vals
+
+
+class ByteGen(_IntegralGen):
+    dtype = T.ByteT
+    np_dtype = np.int8
+    lo, hi = -128, 127
+
+
+class ShortGen(_IntegralGen):
+    dtype = T.ShortT
+    np_dtype = np.int16
+    lo, hi = -(1 << 15), (1 << 15) - 1
+
+
+class IntegerGen(_IntegralGen):
+    dtype = T.IntegerT
+    np_dtype = np.int32
+    lo, hi = -(1 << 31), (1 << 31) - 1
+
+
+class LongGen(_IntegralGen):
+    dtype = T.LongT
+    np_dtype = np.int64
+    lo, hi = -(1 << 63), (1 << 63) - 1
+
+
+class SmallIntGen(_IntegralGen):
+    """Narrow-range ints: produce key collisions for group/join tests."""
+    dtype = T.IntegerT
+    np_dtype = np.int32
+    lo, hi = -10, 10
+
+
+class BooleanGen(DataGen):
+    dtype = T.BooleanT
+
+    def _values(self, n, rng):
+        return rng.integers(0, 2, size=n).astype(bool)
+
+
+class DoubleGen(DataGen):
+    dtype = T.DoubleT
+
+    def __init__(self, nullable=True, null_prob=0.1,
+                 special: bool = True, lo=-1e6, hi=1e6):
+        super().__init__(nullable, null_prob)
+        self.special = special
+        self.lo, self.hi = lo, hi
+
+    def _values(self, n, rng):
+        vals = rng.uniform(self.lo, self.hi, size=n)
+        if self.special and n >= 8:
+            specials = [np.nan, np.inf, -np.inf, -0.0, 0.0,
+                        np.finfo(np.float64).max, np.finfo(np.float64).min]
+            pos = rng.choice(n, size=len(specials), replace=False)
+            for p, s in zip(pos, specials):
+                vals[p] = s
+        return vals
+
+
+class FloatGen(DoubleGen):
+    dtype = T.FloatT
+
+    def _values(self, n, rng):
+        return super()._values(n, rng).astype(np.float32)
+
+
+class StringGen(DataGen):
+    dtype = T.StringT
+
+    def __init__(self, nullable=True, null_prob=0.1, max_len: int = 12,
+                 charset: str = string.ascii_letters + string.digits + " _",
+                 with_empty: bool = True):
+        super().__init__(nullable, null_prob)
+        self.max_len = max_len
+        self.charset = charset
+        self.with_empty = with_empty
+
+    def _values(self, n, rng):
+        chars = np.array(list(self.charset))
+        out = np.empty(n, dtype=object)
+        lens = rng.integers(0 if self.with_empty else 1,
+                            self.max_len, size=n, endpoint=True)
+        for i in range(n):
+            out[i] = "".join(rng.choice(chars, size=lens[i]))
+        return out
+
+
+class KeyStringGen(StringGen):
+    """Low-cardinality strings for grouping keys."""
+
+    def __init__(self, nullable=True, cardinality: int = 7):
+        super().__init__(nullable)
+        self.cardinality = cardinality
+
+    def _values(self, n, rng):
+        pool = [f"key_{i}" for i in range(self.cardinality)] + ["", " "]
+        return np.array([pool[i] for i in
+                         rng.integers(0, len(pool), size=n)], dtype=object)
+
+
+class DateGen(DataGen):
+    dtype = T.DateT
+
+    def _values(self, n, rng):
+        # 1940..2100 in days-since-epoch
+        return rng.integers(-11000, 47000, size=n).astype(np.int32)
+
+
+class TimestampGen(DataGen):
+    dtype = T.TimestampT
+
+    def _values(self, n, rng):
+        lo = -1_000_000_000_000_000
+        hi = 4_000_000_000_000_000
+        return rng.integers(lo, hi, size=n).astype(np.int64)
+
+
+def gen_batch(named_gens: Sequence[Tuple[str, DataGen]], n: int,
+              seed: int = DEFAULT_SEED) -> HostBatch:
+    """Deterministic HostBatch from (name, gen) pairs (gen_df twin)."""
+    rng = np.random.default_rng(seed)
+    cols: List[HostColumn] = []
+    fields = []
+    for name, g in named_gens:
+        cols.append(g.gen(n, rng))
+        fields.append(T.StructField(name, g.dtype, g.nullable))
+    return HostBatch(T.StructType(fields), cols, n)
+
+
+def gen_pydict(named_gens, n: int, seed: int = DEFAULT_SEED) -> dict:
+    return gen_batch(named_gens, n, seed).to_pydict()

@@ -1,0 +1,113 @@
+"""Dual-session equality harness (spark_session.py:82-88 + asserts.py:434
+twins): run the same DataFrame lambda under a CPU session and a TPU
+session and assert identical results, plus the fallback-assertion helpers
+built on the rewrite report (ExecutionPlanCaptureCallback analogue).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Optional
+
+from spark_rapids_tpu.sql.session import TpuSparkSession
+
+from tests.support import values_equal
+
+
+def _run(df_fn: Callable, conf: Dict[str, str]):
+    spark = TpuSparkSession(conf)
+    try:
+        df = df_fn(spark)
+        batch = df._execute()
+        return batch.to_pydict(), spark
+    finally:
+        spark.stop()
+
+
+def _sort_key(row):
+    out = []
+    for v in row:
+        if v is None:
+            out.append((0, ""))
+        elif isinstance(v, float):
+            out.append((1, "nan") if math.isnan(v) else (2, v))
+        elif isinstance(v, bool):
+            out.append((3, v))
+        elif isinstance(v, (int,)):
+            out.append((2, float(v)) if abs(v) < (1 << 52) else (4, str(v)))
+        elif isinstance(v, bytes):
+            out.append((5, v.decode("latin1")))
+        else:
+            out.append((6, str(v)))
+    return out
+
+
+def _rows(pydict: dict):
+    names = list(pydict)
+    n = len(pydict[names[0]]) if names else 0
+    return [tuple(pydict[c][i] for c in names) for i in range(n)]
+
+
+def assert_tpu_and_cpu_equal_collect(
+        df_fn: Callable, conf: Optional[Dict[str, str]] = None,
+        ignore_order: bool = True, approx: bool = False,
+        require_device: bool = True) -> None:
+    """assert_gpu_and_cpu_are_equal_collect twin. ``require_device``
+    additionally asserts the TPU run actually placed ops on the device
+    (so tests can't silently pass on all-CPU fallback)."""
+    conf = dict(conf or {})
+    cpu_conf = dict(conf)
+    cpu_conf["spark.rapids.sql.enabled"] = "false"
+    tpu_conf = dict(conf)
+    tpu_conf["spark.rapids.sql.enabled"] = "true"
+
+    cpu, _ = _run(df_fn, cpu_conf)
+
+    spark = TpuSparkSession(tpu_conf)
+    try:
+        df = df_fn(spark)
+        batch = df._execute()
+        tpu = batch.to_pydict()
+        report = spark.last_rewrite_report
+    finally:
+        spark.stop()
+
+    if require_device:
+        assert report is not None and report.replaced_any, (
+            "no operator was placed on the device; fallbacks:\n"
+            + (report.format() if report else "<no report>"))
+
+    assert set(cpu) == set(tpu), (set(cpu), set(tpu))
+    crows, trows = _rows(cpu), _rows(tpu)
+    assert len(crows) == len(trows), (len(crows), len(trows))
+    if ignore_order:
+        crows = sorted(crows, key=_sort_key)
+        trows = sorted(trows, key=_sort_key)
+    for i, (cr, tr) in enumerate(zip(crows, trows)):
+        for j, (a, b) in enumerate(zip(cr, tr)):
+            assert values_equal(a, b, approx), (
+                f"row {i} col {list(cpu)[j]}: CPU={a!r} TPU={b!r}\n"
+                f"CPU row: {cr}\nTPU row: {tr}")
+
+
+def assert_tpu_fallback_collect(df_fn: Callable, fallback_exec: str,
+                                conf: Optional[Dict[str, str]] = None
+                                ) -> None:
+    """assert_gpu_fallback_collect twin: results must match AND the named
+    exec class must have stayed on CPU with a recorded reason."""
+    conf = dict(conf or {})
+    tpu_conf = dict(conf)
+    tpu_conf["spark.rapids.sql.enabled"] = "true"
+    spark = TpuSparkSession(tpu_conf)
+    try:
+        df = df_fn(spark)
+        df._execute()
+        report = spark.last_rewrite_report
+    finally:
+        spark.stop()
+    assert report is not None
+    names = [n for n, _ in report.fallbacks]
+    assert fallback_exec in names, (
+        f"expected fallback of {fallback_exec}, got {report.fallbacks}")
+    # and the two engines still agree
+    assert_tpu_and_cpu_equal_collect(df_fn, conf, require_device=False)
