@@ -145,7 +145,8 @@ HAS_NANS = conf("spark.rapids.sql.hasNans").doc(
 
 ENABLE_FLOAT_AGG = conf("spark.rapids.sql.variableFloatAgg.enabled").doc(
     "Allow float aggregations whose result can differ from CPU due to "
-    "ordering (RapidsConf.scala ENABLE_FLOAT_AGG).").boolean(True)
+    "ordering (RapidsConf.scala:557 defaults this off; opt-in only)."
+    ).boolean(False)
 
 INCOMPATIBLE_OPS = conf("spark.rapids.sql.incompatibleOps.enabled").doc(
     "Enable ops that are not 100%% compatible with Spark semantics "

@@ -82,9 +82,18 @@ def dev_evaluate(func: E.AggregateFunction,
         f"aggregate {type(func).__name__} has no device evaluate")
 
 
+def _float_agg_allowed(conf) -> bool:
+    if conf is None:
+        return False
+    from spark_rapids_tpu.conf import ENABLE_FLOAT_AGG
+    return bool(conf.get(ENABLE_FLOAT_AGG))
+
+
 def is_device_agg(grouping: List[E.AttributeReference],
-                  aggregates: List[E.Expression]) -> Optional[str]:
+                  aggregates: List[E.Expression],
+                  conf=None) -> Optional[str]:
     """Tagging helper: None if the whole aggregate can run on device."""
+    from spark_rapids_tpu import device_caps as DC
     for g in grouping:
         if isinstance(g.data_type, T.DecimalType):
             return "decimal grouping keys run on CPU"
@@ -100,8 +109,18 @@ def is_device_agg(grouping: List[E.AttributeReference],
                                      E.Average, E.First, E.Last)):
                 return (f"aggregate {type(func).__name__} has no device "
                         "implementation")
+            if isinstance(func, E.Average) and not DC.float_div_exact() \
+                    and not _float_agg_allowed(conf):
+                # the final sum/count division is emulated on this backend;
+                # same knob as ordering-variable float aggs (the reference's
+                # spark.rapids.sql.variableFloatAgg.enabled semantics:
+                # "results can differ from CPU")
+                return ("device Average division is not bit-identical to "
+                        "CPU on this backend (TPU f64 is emulated); set "
+                        "spark.rapids.sql.variableFloatAgg.enabled=true "
+                        "to allow")
             for s in func.buffer_slots():
-                r = X.is_device_expr(s[3]) if isinstance(
+                r = X.is_device_expr(s[3], conf) if isinstance(
                     s[3], E.Expression) else None
                 if r:
                     return r

@@ -79,10 +79,7 @@ def check_expr_tree(e: E.Expression, conf: TpuConf) -> Optional[str]:
     if isinstance(e, E.Alias):
         return check_expr_tree(e.child, conf)
     if isinstance(e, _LEAF_OK):
-        r = TS.common_tpu.support(e.data_type)
-        if r:
-            return f"attribute {e.name}: {r}"
-        return None
+        return X.leaf_support(e)
     rule = _EXPR_RULES.get(type(e))
     if rule is None:
         return (f"expression {type(e).__name__} is not supported on TPU")
@@ -93,6 +90,10 @@ def check_expr_tree(e: E.Expression, conf: TpuConf) -> Optional[str]:
         return (f"expression {type(e).__name__} is not 100% compatible: "
                 f"{rule.incompat}. Set "
                 f"spark.rapids.sql.incompatibleOps.enabled=true to allow")
+    if not conf.get(INCOMPATIBLE_OPS):
+        r = X.platform_gate(e)
+        if r:
+            return f"expression {type(e).__name__}: {r}"
     r = rule.checks.tag(e)
     if r:
         return f"expression {type(e).__name__}: {r}"
@@ -206,12 +207,7 @@ class ExecMeta:
                 if isinstance(plan, TpuExec):
                     device_children.append(plan)
                 else:
-                    if isinstance(plan, TpuColumnarToRowExec):
-                        # fuse C2R->R2C back to the device channel
-                        device_children.append(plan.child)
-                    else:
-                        device_children.append(
-                            TpuRowToColumnarExec(plan, conf))
+                    device_children.append(TpuRowToColumnarExec(plan, conf))
             return self.rule.convert_fn(self, device_children)
         # stays on CPU: device children come back through C2R
         cpu_children = []
@@ -272,7 +268,7 @@ def _tag_exchange(meta: ExecMeta) -> None:
 def _tag_aggregate(meta: ExecMeta) -> None:
     from spark_rapids_tpu.exec.agg import is_device_agg
     node = meta.wrapped
-    r = is_device_agg(node.grouping, node.aggregates)
+    r = is_device_agg(node.grouping, node.aggregates, meta.conf)
     if r:
         meta.will_not_work(r)
         return

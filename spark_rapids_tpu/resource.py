@@ -65,9 +65,11 @@ class TpuSemaphore:
         self._held = threading.local()
 
     def acquire_if_necessary(self, metrics=None) -> None:
+        """Idempotent while held (GpuSemaphore.acquireIfNecessary): repeated
+        acquires on the same thread do NOT nest, so a single release frees
+        the permit regardless of how many uploads the task performed."""
         import time
         if getattr(self._held, "count", 0) > 0:
-            self._held.count += 1
             return
         t0 = time.perf_counter_ns()
         self._sem.acquire()
@@ -78,10 +80,9 @@ class TpuSemaphore:
         self._held.count = 1
 
     def release_if_necessary(self) -> None:
-        count = getattr(self._held, "count", 0)
-        if count > 1:
-            self._held.count = count - 1
-        elif count == 1:
+        """Fully release the thread's hold (reference releases the task's
+        permit in one call at C2R / task end)."""
+        if getattr(self._held, "count", 0) > 0:
             self._held.count = 0
             self._sem.release()
 

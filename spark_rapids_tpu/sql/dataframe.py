@@ -309,7 +309,8 @@ class GroupedData:
                 grouping.append(g)
                 aggs.append(g)
             else:
-                alias = E.Alias(g, _auto_name(g))
+                alias = g if isinstance(g, E.Alias) else \
+                    E.Alias(g, _auto_name(g))
                 grouping.append(alias)
                 aggs.append(alias.to_attribute())
         for c in cols:

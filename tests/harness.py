@@ -51,10 +51,13 @@ def _rows(pydict: dict):
 def assert_tpu_and_cpu_equal_collect(
         df_fn: Callable, conf: Optional[Dict[str, str]] = None,
         ignore_order: bool = True, approx: bool = False,
-        require_device: bool = True) -> None:
+        require_device: bool = True,
+        expect_execs: Optional[list] = None) -> None:
     """assert_gpu_and_cpu_are_equal_collect twin. ``require_device``
     additionally asserts the TPU run actually placed ops on the device
-    (so tests can't silently pass on all-CPU fallback)."""
+    (so tests can't silently pass on all-CPU fallback); ``expect_execs``
+    names Tpu* operators that must appear in the final physical plan
+    (the ExecutionPlanCaptureCallback placement assertion)."""
     conf = dict(conf or {})
     cpu_conf = dict(conf)
     cpu_conf["spark.rapids.sql.enabled"] = "false"
@@ -65,10 +68,12 @@ def assert_tpu_and_cpu_equal_collect(
 
     spark = TpuSparkSession(tpu_conf)
     try:
+        spark.start_capture()
         df = df_fn(spark)
         batch = df._execute()
         tpu = batch.to_pydict()
         report = spark.last_rewrite_report
+        plans = spark.get_captured_plans()
     finally:
         spark.stop()
 
@@ -76,6 +81,11 @@ def assert_tpu_and_cpu_equal_collect(
         assert report is not None and report.replaced_any, (
             "no operator was placed on the device; fallbacks:\n"
             + (report.format() if report else "<no report>"))
+    if expect_execs:
+        plan_str = "\n".join(p.tree_string() for p in plans)
+        for name in expect_execs:
+            assert name in plan_str, (
+                f"expected {name} in the physical plan:\n{plan_str}")
 
     assert set(cpu) == set(tpu), (set(cpu), set(tpu))
     crows, trows = _rows(cpu), _rows(tpu)
