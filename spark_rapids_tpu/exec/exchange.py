@@ -71,7 +71,9 @@ class TpuShuffleExchangeExec(TpuExec):
         p = self.partitioning
         n = p.num_partitions
         out: List[List[DeviceBatch]] = [[] for _ in range(n)]
-        if isinstance(p, P.HashPartitioning):
+        if isinstance(p, P.HashPartitioning) and self._mesh_eligible():
+            out = self._materialize_mesh(p, n)
+        elif isinstance(p, P.HashPartitioning):
             bound = P.bind_list(p.exprs, self.child.output)
             for thunk in device_channel(self.child):
                 for b in thunk():
@@ -112,6 +114,34 @@ class TpuShuffleExchangeExec(TpuExec):
             raise NotImplementedError(repr(p))
         self._cache = out
         return out
+
+    def _mesh_eligible(self) -> bool:
+        from spark_rapids_tpu.parallel.mesh import get_active_mesh, mesh_size
+        return get_active_mesh() is not None and mesh_size() > 1
+
+    def _materialize_mesh(self, p: P.HashPartitioning, n: int
+                          ) -> List[List[DeviceBatch]]:
+        """ICI path: batches stay HBM-resident per chip and ride one
+        all_to_all (SURVEY.md §2.3 TPU mapping note)."""
+        from spark_rapids_tpu.columnar.device import concat_device
+        from spark_rapids_tpu.parallel.ici import mesh_exchange
+        from spark_rapids_tpu.parallel.mesh import get_active_mesh, mesh_size
+        mesh = get_active_mesh()
+        n_dev = mesh_size(mesh)
+        bound = P.bind_list(p.exprs, self.child.output)
+        # land child partitions on chips round-robin (the task->chip
+        # placement Spark's scheduler provides in the reference)
+        slots: List[List[DeviceBatch]] = [[] for _ in range(n_dev)]
+        for i, thunk in enumerate(device_channel(self.child)):
+            for b in thunk():
+                if b.row_count():
+                    slots[i % n_dev].append(b)
+        schema = self.child.schema
+        slot_batches = [
+            concat_device(bs) if bs else DeviceBatch.empty(schema)
+            for bs in slots]
+        with self.metrics.timed(M.PARTITION_TIME):
+            return mesh_exchange(slot_batches, bound, n, mesh)
 
     def device_partitions(self) -> List[DevicePartitionThunk]:
         nparts = self.partitioning.num_partitions

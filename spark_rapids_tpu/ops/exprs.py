@@ -198,8 +198,9 @@ def dev_eval(e: E.Expression, ctx: Ctx) -> AnyDeviceColumn:
 _FLOAT_DIV_LIKE = (E.Divide, E.Sqrt, E.Exp, E.Sin, E.Cos, E.Tan, E.Asin,
                    E.Acos, E.Atan, E.Sinh, E.Cosh, E.Tanh, E.Log, E.Log10,
                    E.Pow, E.Round)
-_FLOAT_ARITH = (E.Add, E.Subtract, E.Multiply, E.Remainder, E.Pmod,
-                E.UnaryMinus, E.Abs)
+# UnaryMinus/Abs are excluded: negation and |x| are sign-bit operations,
+# bit-exact even where f64 arithmetic is emulated.
+_FLOAT_ARITH = (E.Add, E.Subtract, E.Multiply, E.Remainder, E.Pmod)
 
 
 def platform_gate(e: E.Expression) -> Optional[str]:

@@ -1,0 +1,17 @@
+"""Multi-chip execution over a jax device mesh.
+
+This package is the TPU-native replacement for the reference's first-class
+shuffle transport (shuffle-plugin UCX stack, SURVEY.md §2.3): instead of
+Active Messages + bounce buffers + GPUDirect RDMA, hash-partitioned
+exchanges ride the ICI as a single XLA ``all_to_all`` collective inside a
+``shard_map`` program, and batches stay HBM-resident on their owning chip
+(the RapidsShuffleInternalManagerBase.scala:76 design goal, reached with
+collectives instead of P2P transfers).
+"""
+
+from spark_rapids_tpu.parallel.mesh import (SHUFFLE_AXIS, active_mesh,
+                                            build_mesh, get_active_mesh,
+                                            set_active_mesh)
+
+__all__ = ["SHUFFLE_AXIS", "active_mesh", "build_mesh", "get_active_mesh",
+           "set_active_mesh"]

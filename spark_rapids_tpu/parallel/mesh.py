@@ -1,0 +1,74 @@
+"""Device-mesh management (the GpuDeviceManager + heartbeat-topology
+analogue, GpuDeviceManager.scala:36, RapidsShuffleHeartbeatManager.scala:50).
+
+The reference discovers shuffle peers through a driver-RPC heartbeat; on
+TPU the runtime already knows the topology — ``jax.devices()`` — so the
+"transport bootstrap" collapses to building a 1-D ``jax.sharding.Mesh``
+over the chips and remembering it for the exchange operators.  A session
+activates a mesh once (executor-plugin init in the reference); operators
+consult ``get_active_mesh()`` and take the in-process path when no mesh is
+active or it has a single device.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Iterator, Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# The one mesh axis a SQL exchange needs: every chip is a shuffle peer.
+# (Trainer-style tp/pp axes have no analogue in a columnar SQL engine; the
+# reference likewise has a flat peer topology.)
+SHUFFLE_AXIS = "shuffle"
+
+_lock = threading.Lock()
+_active: Optional[Mesh] = None
+
+
+def build_mesh(n_devices: Optional[int] = None,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D mesh over the first ``n_devices`` chips (all by default)."""
+    import numpy as np
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devs)} present")
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (SHUFFLE_AXIS,))
+
+
+def set_active_mesh(mesh: Optional[Mesh]) -> None:
+    global _active
+    with _lock:
+        _active = mesh
+
+
+def get_active_mesh() -> Optional[Mesh]:
+    return _active
+
+
+def mesh_size(mesh: Optional[Mesh] = None) -> int:
+    m = mesh if mesh is not None else _active
+    return 1 if m is None else m.shape[SHUFFLE_AXIS]
+
+
+@contextlib.contextmanager
+def active_mesh(mesh: Mesh) -> Iterator[Mesh]:
+    """Scoped activation (tests; a long-lived session calls set_active_mesh
+    once at startup like RapidsExecutorPlugin.init)."""
+    prev = get_active_mesh()
+    set_active_mesh(mesh)
+    try:
+        yield mesh
+    finally:
+        set_active_mesh(prev)
+
+
+def shard_leading(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Sharding placing a stacked array's leading axis across the mesh."""
+    return NamedSharding(
+        mesh, PartitionSpec(SHUFFLE_AXIS, *([None] * (ndim - 1))))

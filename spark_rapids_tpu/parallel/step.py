@@ -1,0 +1,92 @@
+"""Fused multi-chip aggregate step: the framework's "training step".
+
+The canonical distributed SQL pipeline — scan-local partial aggregation,
+hash exchange, final aggregation (SURVEY.md §3.3/§3.4) — expressed as ONE
+``shard_map`` program jitted over the mesh, so XLA schedules the ICI
+collective together with the segment kernels.  This is what the driver's
+``dryrun_multichip`` compiles, and the strongest perf shape the framework
+has: zero host round-trips between the partial agg, the shuffle, and the
+final agg.
+
+Reference counterpart: GpuHashAggregateExec(partial) ->
+GpuShuffleExchangeExec -> GpuHashAggregateExec(final), three operators
+bridged by the UCX transport; here the whole pipeline is one XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from spark_rapids_tpu.columnar.device import DeviceColumn
+from spark_rapids_tpu.ops import groupby as G
+from spark_rapids_tpu.ops import hashing
+from spark_rapids_tpu.parallel.ici import all_to_all_rows
+from spark_rapids_tpu.parallel.mesh import SHUFFLE_AXIS
+from spark_rapids_tpu.sql import types as T
+
+_STEP_CACHE: Dict[Tuple, Callable] = {}
+
+
+def sum_count_step(mesh: Mesh) -> Callable:
+    """groupBy(key).agg(sum(val), count(val)) over the mesh.
+
+    Inputs (stacked, leading axis = chip): ``keys`` int64[n, cap],
+    ``vals`` int64[n, cap], ``active`` bool[n, cap].  Output per chip:
+    final (keys, sums, counts, out_active) for the key-groups that chip
+    owns (murmur3(key) % n_dev).
+    """
+    n_dev = mesh.shape[SHUFFLE_AXIS]
+    key = (id(mesh), "sum_count")
+    fn = _STEP_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    def per_shard(keys, vals, active):
+        keys, vals, active = keys[0], vals[0], active[0]
+        cap = active.shape[0]
+        kc = DeviceColumn(T.LongT, keys, active)
+        vc = DeviceColumn(T.LongT, vals, active)
+        # local partial aggregation (segment kernel)
+        seg = G.build_segments([kc], active)
+        psum = G.seg_sum(seg, vc, T.LongT, null_when_empty=True)
+        pcnt = G.seg_count(seg, vc)
+        rep = G.representative_rows(seg)
+        pkeys = keys[rep]
+        pact = seg.seg_active
+        pkeys = jnp.where(pact, pkeys, jnp.int64(0))
+        # route partial rows by bit-exact Spark murmur3 of the key
+        kcol = DeviceColumn(T.LongT, pkeys, pact)
+        hv = hashing.murmur3_columns([kcol], cap, 42)
+        dest = jnp.mod(hv.astype(jnp.int64), n_dev).astype(jnp.int32)
+        recv, recv_act = all_to_all_rows(
+            [pkeys, psum.data, psum.validity, pcnt.data], pact, dest, n_dev)
+        rkeys = recv[0].reshape(n_dev * cap)
+        rsum = recv[1].reshape(n_dev * cap)
+        rsum_valid = recv[2].reshape(n_dev * cap)
+        rcnt = recv[3].reshape(n_dev * cap)
+        ract = recv_act.reshape(n_dev * cap)
+        # final merge: segment-sum the partial buffers per key
+        fkc = DeviceColumn(T.LongT, rkeys, ract)
+        fseg = G.build_segments([fkc], ract)
+        fsum = G.seg_sum(fseg, DeviceColumn(T.LongT, rsum, rsum_valid & ract),
+                         T.LongT, null_when_empty=True)
+        fcnt = G.seg_sum(fseg, DeviceColumn(T.LongT, rcnt, ract), T.LongT,
+                         null_when_empty=False)
+        frep = G.representative_rows(fseg)
+        fkeys = jnp.where(fseg.seg_active, rkeys[frep], jnp.int64(0))
+        add = lambda a: a[None]
+        return (add(fkeys), add(fsum.data), add(fcnt.data),
+                add(fseg.seg_active))
+
+    sm = shard_map(per_shard, mesh=mesh,
+                   in_specs=(P(SHUFFLE_AXIS), P(SHUFFLE_AXIS),
+                             P(SHUFFLE_AXIS)),
+                   out_specs=(P(SHUFFLE_AXIS),) * 4)
+    fn = jax.jit(sm)
+    _STEP_CACHE[key] = fn
+    return fn
