@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Driver benchmark: scan -> filter -> project -> groupBy aggregate.
+
+Measures the flagship device pipeline (the TPC-H q1 shape from BASELINE.md's
+first config: wide scan, predicate filter, arithmetic projection, grouped
+sum/count/min/max) at 10M rows, against this engine's own CPU path — the
+stand-in for "CPU Spark" that the reference's 3x-7x / "4x typical" claim is
+measured against (/root/reference/docs/FAQ.md:104-105).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": rows/s on device, "unit": "rows/s",
+   "vs_baseline": device_speedup_over_cpu / 4.0}
+
+so vs_baseline >= 1.0 means matching the reference's typical published
+speedup on its own terms. Correctness is asserted before timing: results
+must be bit-identical between sessions, and the device run must place every
+operator on the TPU (spark.rapids.test.forceDevice).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+N_ROWS = 10_000_000
+N_KEYS = 1_000
+N_PARTITIONS = 8
+REFERENCE_TYPICAL_SPEEDUP = 4.0
+
+
+def make_batch():
+    from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+    from spark_rapids_tpu.sql import types as T
+
+    rng = np.random.default_rng(42)
+    k = rng.integers(0, N_KEYS, N_ROWS).astype(np.int64)
+    v1 = rng.integers(-1_000, 100_000, N_ROWS).astype(np.int64)
+    v2 = rng.integers(0, 1_000_000, N_ROWS).astype(np.int64)
+    schema = T.StructType([
+        T.StructField("k", T.LongT),
+        T.StructField("v1", T.LongT),
+        T.StructField("v2", T.LongT),
+    ])
+    return HostBatch(schema, [
+        HostColumn.all_valid(k, T.LongT),
+        HostColumn.all_valid(v1, T.LongT),
+        HostColumn.all_valid(v2, T.LongT),
+    ], N_ROWS)
+
+
+def build_query(spark, batch):
+    from spark_rapids_tpu.sql import functions as F
+
+    df = spark.createDataFrame(batch, num_partitions=N_PARTITIONS)
+    return (df
+            .filter(F.col("v1") >= 0)
+            .withColumn("v3", F.col("v1") * F.lit(2) + F.col("v2"))
+            .groupBy("k")
+            .agg(F.sum("v1").alias("s1"),
+                 F.sum("v3").alias("s3"),
+                 F.count("v1").alias("c"),
+                 F.min("v2").alias("lo"),
+                 F.max("v2").alias("hi")))
+
+
+def run_once(q):
+    t0 = time.perf_counter()
+    rows = q.collect()
+    return time.perf_counter() - t0, rows
+
+
+def canon(rows):
+    return sorted(tuple(r) for r in rows)
+
+
+def main():
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+
+    batch = make_batch()
+
+    cpu = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    q_cpu = build_query(cpu, batch)
+    # warm (allocator, numpy paths), then best-of-3
+    run_once(q_cpu)
+    cpu_times, cpu_rows = [], None
+    for _ in range(3):
+        dt, cpu_rows = run_once(q_cpu)
+        cpu_times.append(dt)
+    cpu.stop()
+
+    tpu = TpuSparkSession({
+        "spark.rapids.sql.enabled": "true",
+        "spark.rapids.sql.test.forceDevice": "true",  # fail on any fallback
+    })
+    q_tpu = build_query(tpu, batch)
+    run_once(q_tpu)  # jit compile warm-up
+    tpu_times, tpu_rows = [], None
+    for _ in range(3):
+        dt, tpu_rows = run_once(q_tpu)
+        tpu_times.append(dt)
+    tpu.stop()
+
+    assert canon(cpu_rows) == canon(tpu_rows), \
+        "device results diverge from CPU engine"
+
+    cpu_t = min(cpu_times)
+    tpu_t = min(tpu_times)
+    speedup = cpu_t / tpu_t
+    print(json.dumps({
+        "metric": "scan_filter_project_groupby_agg_10M",
+        "value": round(N_ROWS / tpu_t, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(speedup / REFERENCE_TYPICAL_SPEEDUP, 4),
+        "detail": {
+            "device_wall_s": round(tpu_t, 4),
+            "cpu_engine_wall_s": round(cpu_t, 4),
+            "speedup_vs_cpu_engine": round(speedup, 4),
+            "backend": __import__("jax").default_backend(),
+            "rows": N_ROWS,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -368,20 +368,34 @@ def _compact_arrays(active: jax.Array, *flat: jax.Array):
     return new_active, tuple(outs)
 
 
-def compact(batch: DeviceBatch) -> DeviceBatch:
-    """Move active rows to the front (fixed-shape compaction)."""
+def flatten_batch(batch: DeviceBatch
+                  ) -> Tuple[List[jax.Array], List[Tuple[T.DataType, int]]]:
+    """Flatten column arrays + per-column (dtype, arity) spec; inverse is
+    rebuild_columns. Shared by compaction and the split/serialize kernels."""
     flat: List[jax.Array] = []
     spec: List[Tuple[T.DataType, int]] = []
     for c in batch.columns:
         arrs = c.arrays()
         spec.append((c.dtype, len(arrs)))
         flat.extend(arrs)
-    new_active, outs = _compact_arrays(batch.active, *flat)
+    return flat, spec
+
+
+def rebuild_columns(spec: Sequence[Tuple[T.DataType, int]],
+                    outs: Sequence[jax.Array]) -> List[AnyDeviceColumn]:
     cols: List[AnyDeviceColumn] = []
     i = 0
     for dt, n_arr in spec:
         cols.append(make_column(dt, outs[i:i + n_arr]))
         i += n_arr
+    return cols
+
+
+def compact(batch: DeviceBatch) -> DeviceBatch:
+    """Move active rows to the front (fixed-shape compaction)."""
+    flat, spec = flatten_batch(batch)
+    new_active, outs = _compact_arrays(batch.active, *flat)
+    cols = rebuild_columns(spec, outs)
     return DeviceBatch(batch.schema, cols, new_active, batch._num_rows)
 
 

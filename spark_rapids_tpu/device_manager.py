@@ -1,0 +1,58 @@
+"""Device bootstrap: the GpuDeviceManager twin (GpuDeviceManager.scala:36).
+
+The reference's executor plugin initializes the device and the RMM pool
+once per process (initializeGpuAndMemory, GpuDeviceManager.scala:125).
+XLA owns the HBM allocator on TPU, so initialization here is:
+
+- enable the persistent XLA compilation cache (compiled programs survive
+  process restarts — the analogue of CUDA's on-disk kernel cache; first
+  TPU compiles are 20-40s, so this dominates cold-start latency);
+- discover device/backend facts used for memory accounting (HBM bytes)
+  and capability gating (device_caps probes exactness separately).
+
+Idempotent and cheap; every TpuSparkSession calls ``initialize()``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+_LOCK = threading.Lock()
+_INITIALIZED = False
+
+DEFAULT_CACHE_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "spark_rapids_tpu", "xla_cache")
+
+
+def initialize(conf=None) -> None:
+    global _INITIALIZED
+    with _LOCK:
+        if _INITIALIZED:
+            return
+        _INITIALIZED = True
+        import jax
+        cache_dir = os.environ.get("SPARK_RAPIDS_TPU_XLA_CACHE",
+                                   DEFAULT_CACHE_DIR)
+        if cache_dir and cache_dir.lower() != "off":
+            try:
+                os.makedirs(cache_dir, exist_ok=True)
+                jax.config.update("jax_compilation_cache_dir", cache_dir)
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 0.5)
+            except Exception:
+                pass  # cache is an optimization; never fail startup
+
+
+def device_memory_bytes() -> Optional[int]:
+    """Reported HBM size of the default device (None when the backend
+    does not expose it, e.g. CPU)."""
+    import jax
+    try:
+        stats = jax.devices()[0].memory_stats()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    return None

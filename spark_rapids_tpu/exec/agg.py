@@ -129,6 +129,11 @@ def is_device_agg(grouping: List[E.AttributeReference],
     return None
 
 
+# Compiled aggregation programs cached on structure so re-planned queries
+# (every collect() builds fresh exec instances) reuse XLA executables.
+_AGG_FN_CACHE: Dict[Tuple, Callable] = {}
+
+
 class TpuHashAggregateExec(TpuExec):
     def __init__(self, grouping: List[E.AttributeReference],
                  aggregates: List[E.Expression], mode: str, child: TpuExec,
@@ -139,7 +144,6 @@ class TpuHashAggregateExec(TpuExec):
         self.aggregates = aggregates
         self.mode = mode
         self.slots = slots
-        self._fn_cache: Dict[Tuple, Callable] = {}
 
     @property
     def child(self) -> TpuExec:
@@ -234,6 +238,27 @@ class TpuHashAggregateExec(TpuExec):
             return out_cols, out_active
         return jax.jit(fn)
 
+    def _out_desc(self) -> Tuple:
+        """Structural descriptor of the result-column layout (what the
+        compiled program's output order depends on besides the exprs)."""
+        aliases = self._agg_aliases()
+        alias_ids = {a.expr_id: i for i, a in enumerate(aliases)}
+        group_ids = {g.expr_id: i for i, g in enumerate(self.grouping)}
+        desc = []
+        for e in self.aggregates:
+            if isinstance(e, E.Alias) and isinstance(e.child,
+                                                     E.AggregateExpression):
+                desc.append(("agg", alias_ids[e.expr_id],
+                             type(e.child.func).__name__))
+            elif isinstance(e, E.AttributeReference):
+                desc.append(("key", group_ids[e.expr_id]))
+            elif isinstance(e, E.Alias) and isinstance(e.child,
+                                                       E.AttributeReference):
+                desc.append(("key", group_ids[e.child.expr_id]))
+            else:
+                desc.append(("other", repr(e)))
+        return tuple(desc)
+
     def _aggregate_batch(self, batch: DeviceBatch) -> DeviceBatch:
         child_out = self.child.output
         key_bound = [E.bind_references(g, child_out) for g in self.grouping]
@@ -242,11 +267,14 @@ class TpuHashAggregateExec(TpuExec):
                tuple(X.expr_key(e) for e in key_bound),
                tuple(X.expr_key(e) for e in slot_srcs),
                tuple(p for p, _ in prims),
-               tuple(repr(dt) for _, dt in prims))
-        fn = self._fn_cache.get(key)
+               tuple(repr(dt) for _, dt in prims),
+               tuple(len(self.slots[a.expr_id])
+                     for a in self._agg_aliases()),
+               self._out_desc())
+        fn = _AGG_FN_CACHE.get(key)
         if fn is None:
             fn = self._build_fn(key_bound, slot_srcs, prims)
-            self._fn_cache[key] = fn
+            _AGG_FN_CACHE[key] = fn
         lit_vals = X.literal_values(list(key_bound) + list(slot_srcs))
         with self.metrics.timed(M.AGG_TIME):
             out_cols, out_active = fn(batch.columns, batch.active, lit_vals)

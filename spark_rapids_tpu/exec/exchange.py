@@ -3,13 +3,14 @@
 
 Hash partition ids are computed on device with the bit-exact Spark
 murmur3 (ops/hashing.py), so rows land in exactly the partitions CPU
-Spark would use. The "split" is mask-only: each output partition reuses
-the input batch's columns with ``active & (pid == p)`` — zero data
-movement on device — then ``shrink_to_bucket`` compacts to the smallest
-power-of-two payload (the contiguousSplit analogue) before handing the
-batch to the consumer. In-process the exchange is a materialized list per
-partition (Spark's shuffle files); the multi-chip ICI all-to-all path
-replaces this transport while keeping the same partition-id kernel.
+Spark would use. The "split" (``split_by_pid``) is one device program per
+input batch: stable-sort rows by partition id, then slice each partition
+out at its own power-of-two capacity (the contiguousSplit analogue,
+GpuPartitioning.scala:50) — a single host sync for the counts, with row
+counts attached so consumers never re-sync. In-process the exchange is a
+materialized list per partition (Spark's shuffle files); the multi-chip
+ICI all-to-all path replaces this transport while keeping the same
+partition-id kernel.
 """
 
 from __future__ import annotations
@@ -19,8 +20,11 @@ from typing import Callable, Dict, Iterator, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+import numpy as np
+
 from spark_rapids_tpu import metrics as M
-from spark_rapids_tpu.columnar.device import DeviceBatch, shrink_to_bucket
+from spark_rapids_tpu.columnar.device import (DeviceBatch, bucket_capacity,
+                                              flatten_batch, rebuild_columns)
 from spark_rapids_tpu.conf import TpuConf
 from spark_rapids_tpu.exec.base import (DevicePartitionThunk, TpuExec,
                                         device_channel)
@@ -29,6 +33,8 @@ from spark_rapids_tpu.sql import expressions as E
 from spark_rapids_tpu.sql import physical as P
 
 _PID_CACHE: Dict[Tuple, Callable] = {}
+_SORT_CACHE: Dict[Tuple, Callable] = {}
+_EXTRACT_CACHE: Dict[Tuple, Callable] = {}
 
 
 def hash_partition_ids(exprs: List[E.Expression], batch: DeviceBatch,
@@ -37,16 +43,70 @@ def hash_partition_ids(exprs: List[E.Expression], batch: DeviceBatch,
     key = (tuple(X.expr_key(e) for e in exprs), num_partitions)
     fn = _PID_CACHE.get(key)
     if fn is None:
+        from spark_rapids_tpu.ops import hashing
+
         def _fn(cols, active, lit_vals):
-            ctx = X.Ctx(cols, active.shape[0], tuple(exprs), lit_vals)
-            cols_eval = [X.dev_eval(e, ctx) for e in exprs]
-            from spark_rapids_tpu.ops import hashing
-            hv = hashing.murmur3_columns(cols_eval, active.shape[0], 42)
-            return jnp.mod(hv.astype(jnp.int64),
-                           num_partitions).astype(jnp.int32)
+            return hashing.traced_partition_ids(exprs, cols, active,
+                                                lit_vals, num_partitions)
         fn = jax.jit(_fn)
         _PID_CACHE[key] = fn
     return fn(batch.columns, batch.active, X.literal_values(exprs))
+
+
+def split_by_pid(batch: DeviceBatch, pids: jax.Array, n: int
+                 ) -> List[Optional[DeviceBatch]]:
+    """contiguousSplit (GpuPartitioning.scala:50) as ONE device program:
+    stable-sort rows by partition id (inactive rows sink), then slice each
+    partition out at its own capacity bucket. One host sync (the counts)
+    per input batch; row counts are attached so downstream consumers never
+    re-sync."""
+    flat, spec = flatten_batch(batch)
+    shapes = tuple((a.shape, str(a.dtype)) for a in flat)
+    skey = (shapes, n)
+    sort_fn = _SORT_CACHE.get(skey)
+    if sort_fn is None:
+        def _sort(pids, active, *arrs):
+            key = jnp.where(active, pids, jnp.int32(n))
+            counts = jnp.bincount(key, length=n + 1)[:n]
+            order = jnp.argsort(key, stable=True)
+            return counts, active[order], tuple(a[order] for a in arrs)
+        sort_fn = jax.jit(_sort)
+        _SORT_CACHE[skey] = sort_fn
+    counts_d, sorted_active, sorted_flat = sort_fn(pids, batch.active, *flat)
+    counts = np.asarray(counts_d)
+    offsets = np.concatenate([[0], np.cumsum(counts)])
+
+    out: List[Optional[DeviceBatch]] = []
+    for pid in range(n):
+        cnt = int(counts[pid])
+        if cnt == 0:
+            out.append(None)
+            continue
+        cap = bucket_capacity(cnt)
+        ekey = (shapes, cap)
+        ext_fn = _EXTRACT_CACHE.get(ekey)
+        if ext_fn is None:
+            def _extract(off, cnt, *arrs, _cap=cap):
+                new_active = jnp.arange(_cap) < cnt
+                idx = jnp.clip(off + jnp.arange(_cap), 0,
+                               arrs[0].shape[0] - 1)
+                outs = []
+                for a in arrs:
+                    g = a[idx]
+                    if a.ndim == 2:
+                        g = jnp.where(new_active[:, None], g, 0)
+                    else:
+                        g = jnp.where(new_active, g,
+                                      jnp.zeros((), dtype=g.dtype))
+                    outs.append(g)
+                return new_active, tuple(outs)
+            ext_fn = jax.jit(_extract)
+            _EXTRACT_CACHE[ekey] = ext_fn
+        new_active, outs = ext_fn(
+            jnp.int64(offsets[pid]), jnp.int64(cnt), *sorted_flat)
+        out.append(DeviceBatch(batch.schema, rebuild_columns(spec, outs),
+                               new_active, cnt))
+    return out
 
 
 class TpuShuffleExchangeExec(TpuExec):
@@ -77,16 +137,11 @@ class TpuShuffleExchangeExec(TpuExec):
             bound = P.bind_list(p.exprs, self.child.output)
             for thunk in device_channel(self.child):
                 for b in thunk():
-                    if b.row_count() == 0:
-                        continue
                     with self.metrics.timed(M.PARTITION_TIME):
                         pids = hash_partition_ids(bound, b, n)
-                    for pid in range(n):
-                        part = DeviceBatch(
-                            b.schema, b.columns,
-                            b.active & (pids == pid), None)
-                        part = shrink_to_bucket(part)
-                        if part.row_count():
+                        parts = split_by_pid(b, pids, n)
+                    for pid, part in enumerate(parts):
+                        if part is not None:
                             out[pid].append(part)
         elif isinstance(p, P.SinglePartitioning):
             for thunk in device_channel(self.child):
@@ -97,17 +152,12 @@ class TpuShuffleExchangeExec(TpuExec):
             start = 0
             for thunk in device_channel(self.child):
                 for b in thunk():
-                    cnt = b.row_count()
-                    if cnt == 0:
-                        continue
                     rank = jnp.cumsum(b.active.astype(jnp.int32)) - 1
                     pids = jnp.mod(rank + start, n).astype(jnp.int32)
-                    for pid in range(n):
-                        part = DeviceBatch(
-                            b.schema, b.columns,
-                            b.active & (pids == pid), None)
-                        part = shrink_to_bucket(part)
-                        if part.row_count():
+                    with self.metrics.timed(M.PARTITION_TIME):
+                        parts = split_by_pid(b, pids, n)
+                    for pid, part in enumerate(parts):
+                        if part is not None:
                             out[pid].append(part)
                     start += 1
         else:

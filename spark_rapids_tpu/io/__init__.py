@@ -1,0 +1,9 @@
+"""File IO: readers, writers, cache serializer.
+
+The reference reimplements Parquet/ORC/CSV scans with a CPU-fetch /
+GPU-decode split (GpuParquetScanBase.scala:82) and writes columnar data
+back with device encoders (GpuParquetFileFormat). On TPU the decode stays
+host-side (Arrow decoders; a Pallas page decoder is not yet profitable) and
+the device boundary is the coalesced upload in TpuRowToColumnarExec —
+mirroring the reference's HostColumnarToGpu path for host-columnar sources.
+"""

@@ -1,0 +1,178 @@
+"""Arrow <-> HostBatch conversion and type mapping.
+
+The JVM<->device interchange format of the reference is Arrow-shaped
+(GpuColumnVector.java wraps Arrow-layout cuDF buffers;
+AccessibleArrowColumnVector reads Spark's Arrow cache). Here Arrow is the
+host interchange for file formats and the pandas-UDF path.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+from spark_rapids_tpu.sql import types as T
+
+
+def arrow_type_to_sql(at: pa.DataType) -> T.DataType:
+    if pa.types.is_boolean(at):
+        return T.BooleanT
+    if pa.types.is_int8(at):
+        return T.ByteT
+    if pa.types.is_int16(at):
+        return T.ShortT
+    if pa.types.is_int32(at):
+        return T.IntegerT
+    if pa.types.is_int64(at):
+        return T.LongT
+    if pa.types.is_float32(at):
+        return T.FloatT
+    if pa.types.is_float64(at):
+        return T.DoubleT
+    if pa.types.is_string(at) or pa.types.is_large_string(at):
+        return T.StringT
+    if pa.types.is_binary(at) or pa.types.is_large_binary(at):
+        return T.BinaryT
+    if pa.types.is_date32(at):
+        return T.DateT
+    if pa.types.is_timestamp(at):
+        return T.TimestampT
+    if pa.types.is_decimal(at):
+        return T.DecimalType(at.precision, at.scale)
+    # unsigned ints land in the next-wider signed type (Spark has none)
+    if pa.types.is_uint8(at):
+        return T.ShortT
+    if pa.types.is_uint16(at):
+        return T.IntegerT
+    if pa.types.is_uint32(at) or pa.types.is_uint64(at):
+        return T.LongT
+    raise TypeError(f"unsupported arrow type {at}")
+
+
+def sql_type_to_arrow(dt: T.DataType) -> pa.DataType:
+    if isinstance(dt, T.BooleanType):
+        return pa.bool_()
+    if isinstance(dt, T.ByteType):
+        return pa.int8()
+    if isinstance(dt, T.ShortType):
+        return pa.int16()
+    if isinstance(dt, T.IntegerType):
+        return pa.int32()
+    if isinstance(dt, T.LongType):
+        return pa.int64()
+    if isinstance(dt, T.FloatType):
+        return pa.float32()
+    if isinstance(dt, T.DoubleType):
+        return pa.float64()
+    if isinstance(dt, T.StringType):
+        return pa.string()
+    if isinstance(dt, T.BinaryType):
+        return pa.binary()
+    if isinstance(dt, T.DateType):
+        return pa.date32()
+    if isinstance(dt, T.TimestampType):
+        return pa.timestamp("us", tz="UTC")
+    if isinstance(dt, T.DecimalType):
+        return pa.decimal128(dt.precision, dt.scale)
+    raise TypeError(f"unsupported sql type {dt}")
+
+
+def arrow_schema_to_sql(schema: pa.Schema) -> T.StructType:
+    return T.StructType([
+        T.StructField(f.name, arrow_type_to_sql(f.type), f.nullable)
+        for f in schema])
+
+
+def sql_schema_to_arrow(schema: T.StructType) -> pa.Schema:
+    return pa.schema([
+        pa.field(f.name, sql_type_to_arrow(f.data_type), f.nullable)
+        for f in schema.fields])
+
+
+def _fill_for(dt: T.DataType):
+    if isinstance(dt, T.BooleanType):
+        return False
+    if isinstance(dt, (T.FloatType, T.DoubleType)):
+        return 0.0
+    return 0
+
+
+def arrow_column_to_host(arr: pa.ChunkedArray | pa.Array,
+                         dt: T.DataType) -> HostColumn:
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    n = len(arr)
+    np_dt = T.numpy_dtype(dt)
+    if arr.null_count:
+        validity = np.asarray(arr.is_valid())
+    else:
+        validity = np.ones(n, dtype=bool)
+    if np_dt == np.dtype(object):
+        data = np.empty(n, dtype=object)
+        py = arr.to_pylist()
+        for i, v in enumerate(py):
+            data[i] = v if v is not None else ""
+        return HostColumn(dt, data, validity)
+    if isinstance(dt, T.DecimalType):
+        # unscaled int64 storage
+        py = arr.to_pylist()
+        data = np.zeros(n, dtype=np.int64)
+        scale = dt.scale
+        for i, v in enumerate(py):
+            if v is not None:
+                data[i] = int(v.scaleb(scale))
+        return HostColumn(dt, data, validity)
+    if isinstance(dt, T.TimestampType):
+        arr = arr.cast(pa.timestamp("us"))
+        data = np.asarray(arr.cast(pa.int64()).fill_null(0),
+                          dtype=np.int64)
+        return HostColumn(dt, data, validity)
+    if isinstance(dt, T.DateType):
+        data = np.asarray(arr.cast(pa.int32()).fill_null(0), dtype=np.int32)
+        return HostColumn(dt, data, validity)
+    arr = arr.cast(sql_type_to_arrow(dt))
+    if arr.null_count:
+        arr = arr.fill_null(_fill_for(dt))
+    data = np.ascontiguousarray(np.asarray(arr), dtype=np_dt)
+    return HostColumn(dt, data, validity)
+
+
+def arrow_to_host_batch(table: pa.Table,
+                        schema: Optional[T.StructType] = None) -> HostBatch:
+    if schema is None:
+        schema = arrow_schema_to_sql(table.schema)
+    cols: List[HostColumn] = []
+    for i, f in enumerate(schema.fields):
+        cols.append(arrow_column_to_host(table.column(i), f.data_type))
+    return HostBatch(schema, cols, table.num_rows)
+
+
+def host_column_to_arrow(c: HostColumn) -> pa.Array:
+    dt = c.dtype
+    at = sql_type_to_arrow(dt)
+    mask = None if c.validity.all() else ~c.validity
+    if isinstance(dt, (T.StringType, T.BinaryType)):
+        vals = [v if ok else None
+                for v, ok in zip(c.data.tolist(), c.validity.tolist())]
+        return pa.array(vals, type=at)
+    if isinstance(dt, T.DecimalType):
+        import decimal
+        vals = [decimal.Decimal(int(v)).scaleb(-dt.scale) if ok else None
+                for v, ok in zip(c.data.tolist(), c.validity.tolist())]
+        return pa.array(vals, type=at)
+    if isinstance(dt, T.TimestampType):
+        a = pa.array(c.data.astype(np.int64), type=pa.int64(), mask=mask)
+        return a.cast(at)
+    if isinstance(dt, T.DateType):
+        a = pa.array(c.data.astype(np.int32), type=pa.int32(), mask=mask)
+        return a.cast(at)
+    return pa.array(c.data, type=at, mask=mask)
+
+
+def host_batch_to_arrow(b: HostBatch) -> pa.Table:
+    arrays = [host_column_to_arrow(c) for c in b.columns]
+    return pa.Table.from_arrays(
+        arrays, schema=sql_schema_to_arrow(b.schema))

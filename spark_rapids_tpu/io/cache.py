@@ -1,0 +1,100 @@
+"""df.cache(): Parquet-compressed in-memory cache.
+
+Twin of the reference's ParquetCachedBatchSerializer
+(sql-plugin/src/main/311+-all/.../ParquetCachedBatchSerializer.scala):
+`df.cache()` stores each partition's batches as compressed Parquet bytes in
+host memory, decoded back on demand. Materialization is lazy and happens at
+most once per cached plan.
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+from typing import List, Optional
+
+from spark_rapids_tpu.columnar.host import HostBatch
+from spark_rapids_tpu.io.arrow_convert import (arrow_to_host_batch,
+                                               host_batch_to_arrow)
+from spark_rapids_tpu.sql import logical as L
+from spark_rapids_tpu.sql import physical as P
+
+
+class CachedRelation(L.LogicalPlan):
+    """InMemoryRelation: holds parquet-compressed partition payloads."""
+
+    def __init__(self, child: L.LogicalPlan, session):
+        self.children = []  # leaf once materialized; child kept for lazy run
+        self.child_plan = child
+        self.session = session
+        self._output = list(child.output)
+        self._lock = threading.Lock()
+        self._payloads: Optional[List[List[bytes]]] = None
+        self.cached_bytes = 0
+
+    @property
+    def output(self):
+        return self._output
+
+    def simple_string(self):
+        state = "materialized" if self._payloads is not None else "lazy"
+        return f"InMemoryRelation [parquet-cached, {state}]"
+
+    def materialize(self) -> List[List[bytes]]:
+        with self._lock:
+            if self._payloads is None:
+                physical = self.session.plan_physical(self.child_plan)
+                payloads: List[List[bytes]] = []
+                for thunk in physical.partitions():
+                    part: List[bytes] = []
+                    for batch in thunk():
+                        part.append(_encode(batch))
+                    payloads.append(part)
+                self._payloads = payloads
+                self.cached_bytes = sum(
+                    len(b) for p in payloads for b in p)
+            return self._payloads
+
+
+def _encode(batch: HostBatch) -> bytes:
+    import pyarrow.parquet as pq
+    buf = io.BytesIO()
+    pq.write_table(host_batch_to_arrow(batch), buf, compression="snappy")
+    return buf.getvalue()
+
+
+def _decode(payload: bytes, schema) -> HostBatch:
+    import pyarrow.parquet as pq
+    tbl = pq.read_table(io.BytesIO(payload))
+    return arrow_to_host_batch(tbl, schema)
+
+
+class CpuCachedScanExec(P.PhysicalPlan):
+    def __init__(self, rel: CachedRelation):
+        self.children = []
+        self.rel = rel
+
+    @property
+    def output(self):
+        return self.rel.output
+
+    def simple_string(self):
+        return f"CachedScan [{len(self.rel._payloads or [])} partitions]"
+
+    def partitions(self):
+        payloads = self.rel.materialize()
+        schema = self.schema
+
+        def make(part: List[bytes]):
+            def run():
+                for payload in part:
+                    yield _decode(payload, schema)
+            return run
+        return [make(p) for p in payloads]
+
+
+def cache_plan(df) -> CachedRelation:
+    plan = df.plan
+    if isinstance(plan, CachedRelation):
+        return plan
+    return CachedRelation(plan, df.session)

@@ -1,0 +1,402 @@
+"""File scans: DataFrameReader + CpuFileScanExec.
+
+Mirrors the reference's scan architecture (GpuParquetScanBase.scala:82):
+the host side lists files, parses footers, and plans partition units
+(row-group granularity for Parquet, like the reference's copy-filtered
+row-group blocks), then each partition decodes with one of three reader
+strategies selected by ``spark.rapids.sql.format.parquet.reader.type``
+(RapidsConf.scala:719-733):
+
+- PERFILE       — decode units one by one (reference ParquetPartitionReader)
+- MULTITHREADED — prefetch units with a thread pool, overlap IO with
+                  downstream compute (MultiFileCloudParquetPartitionReader)
+- COALESCING    — stitch all units of the partition into one decode
+                  (MultiFileParquetPartitionReader)
+
+Decode is Arrow on the host; device residency begins at the coalesced
+upload in TpuRowToColumnarExec (HostColumnarToGpu's role).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+from spark_rapids_tpu.columnar.host import HostBatch
+from spark_rapids_tpu.conf import (MAX_READER_BATCH_SIZE_ROWS,
+                                   MULTITHREADED_READ_NUM_THREADS,
+                                   PARQUET_READER_TYPE, TpuConf)
+from spark_rapids_tpu.io.arrow_convert import (arrow_schema_to_sql,
+                                               arrow_to_host_batch,
+                                               sql_type_to_arrow)
+from spark_rapids_tpu.sql import logical as L
+from spark_rapids_tpu.sql import physical as P
+from spark_rapids_tpu.sql import types as T
+
+DEFAULT_MAX_PARTITION_BYTES = 128 << 20
+
+_DATA_EXT = {".parquet", ".orc", ".csv", ".json", ".txt", ".tsv"}
+
+
+def expand_paths(paths: Sequence[str]) -> List[str]:
+    """Directory/glob expansion (FilePartition listing role)."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, names in os.walk(p):
+                for n in sorted(names):
+                    if n.startswith(("_", ".")):
+                        continue
+                    files.append(os.path.join(root, n))
+        elif any(ch in p for ch in "*?["):
+            files.extend(sorted(glob.glob(p)))
+        elif os.path.exists(p):
+            files.append(p)
+        else:
+            raise FileNotFoundError(p)
+    if not files:
+        raise FileNotFoundError(f"no input files in {list(paths)}")
+    return files
+
+
+@dataclass
+class ScanUnit:
+    """One decode unit: a file, or a row-group range of a parquet file
+    (the reference's filtered-block unit, GpuParquetScanBase.scala:1363)."""
+
+    path: str
+    size_bytes: int
+    row_groups: Optional[List[int]] = None  # parquet only; None = whole file
+
+
+def plan_scan_units(fmt: str, files: List[str]) -> List[ScanUnit]:
+    units: List[ScanUnit] = []
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+        for f in files:
+            try:
+                meta = pq.ParquetFile(f).metadata
+            except Exception:
+                units.append(ScanUnit(f, os.path.getsize(f)))
+                continue
+            for rg in range(meta.num_row_groups):
+                units.append(ScanUnit(
+                    f, meta.row_group(rg).total_byte_size, [rg]))
+            if meta.num_row_groups == 0:
+                units.append(ScanUnit(f, 0, []))
+    else:
+        for f in files:
+            units.append(ScanUnit(f, os.path.getsize(f)))
+    return units
+
+
+def pack_partitions(units: List[ScanUnit],
+                    max_bytes: int) -> List[List[ScanUnit]]:
+    """Bin-pack units into partitions (FilePartition.getFilePartitions)."""
+    parts: List[List[ScanUnit]] = []
+    cur: List[ScanUnit] = []
+    cur_bytes = 0
+    for u in units:
+        if cur and cur_bytes + u.size_bytes > max_bytes:
+            parts.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(u)
+        cur_bytes += u.size_bytes
+    if cur:
+        parts.append(cur)
+    return parts
+
+
+# ---------------------------------------------------------------------------
+# Decoders (host side)
+# ---------------------------------------------------------------------------
+
+def _read_unit(fmt: str, unit: ScanUnit, schema: T.StructType,
+               options: Dict[str, Any]):
+    """Decode one unit to a pyarrow Table with `schema`'s columns."""
+    import pyarrow as pa
+    names = [f.name for f in schema.fields]
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+        pf = pq.ParquetFile(unit.path)
+        if unit.row_groups is not None:
+            if not unit.row_groups:
+                return pa.table(
+                    {n: pa.array([], type=sql_type_to_arrow(f.data_type))
+                     for n, f in zip(names, schema.fields)})
+            return pf.read_row_groups(unit.row_groups, columns=names)
+        return pf.read(columns=names)
+    if fmt == "orc":
+        import pyarrow.orc as po
+        return po.ORCFile(unit.path).read(columns=names)
+    if fmt == "csv":
+        return _read_csv(unit.path, schema, options)
+    if fmt == "json":
+        import pyarrow.json as pj
+        tbl = pj.read_json(unit.path)
+        return _conform(tbl, schema)
+    if fmt == "text":
+        import pyarrow.csv as pc
+        tbl = pc.read_csv(unit.path, parse_options=pc.ParseOptions(
+            delimiter="\x01", quote_char=False, escape_char=False),
+            read_options=pc.ReadOptions(column_names=[names[0]]))
+        return tbl
+    raise NotImplementedError(f"format {fmt}")
+
+
+def _read_csv(path: str, schema: T.StructType, options: Dict[str, Any]):
+    import pyarrow.csv as pc
+    header = str(options.get("header", "false")).lower() == "true"
+    sep = options.get("sep", options.get("delimiter", ","))
+    null_value = options.get("nullValue", "")
+    names = [f.name for f in schema.fields]
+    read_opts = pc.ReadOptions(
+        column_names=None if header else names,
+        skip_rows=0)
+    parse_opts = pc.ParseOptions(delimiter=sep)
+    convert_opts = pc.ConvertOptions(
+        column_types={f.name: sql_type_to_arrow(f.data_type)
+                      for f in schema.fields},
+        null_values=[null_value] if null_value else [""],
+        strings_can_be_null=True,
+        timestamp_parsers=[pc.ISO8601, "%Y-%m-%d %H:%M:%S"])
+    tbl = pc.read_csv(path, read_options=read_opts,
+                      parse_options=parse_opts,
+                      convert_options=convert_opts)
+    if header:
+        # align by position when file header names differ from schema
+        tbl = tbl.rename_columns(names[:tbl.num_columns])
+    return tbl.select(names)
+
+
+def _conform(tbl, schema: T.StructType):
+    """Reorder/cast a table to the requested schema (schema evolution)."""
+    import pyarrow as pa
+    cols = []
+    for f in schema.fields:
+        if f.name in tbl.column_names:
+            cols.append(tbl.column(f.name).cast(
+                sql_type_to_arrow(f.data_type)))
+        else:
+            cols.append(pa.nulls(tbl.num_rows,
+                                 type=sql_type_to_arrow(f.data_type)))
+    return pa.Table.from_arrays(cols, names=[f.name for f in schema.fields])
+
+
+# ---------------------------------------------------------------------------
+# Physical scan
+# ---------------------------------------------------------------------------
+
+_READ_POOL: Optional[ThreadPoolExecutor] = None
+_POOL_LOCK = threading.Lock()
+
+
+def _shared_pool(n_threads: int) -> ThreadPoolExecutor:
+    global _READ_POOL
+    with _POOL_LOCK:
+        if _READ_POOL is None:
+            _READ_POOL = ThreadPoolExecutor(
+                max_workers=n_threads, thread_name_prefix="srt-multifile")
+        return _READ_POOL
+
+
+class CpuFileScanExec(P.PhysicalPlan):
+    """File source scan; feeds the device through the transparent R2C
+    transition (GpuFileSourceScanExec's role, host-decode variant)."""
+
+    def __init__(self, output, fmt: str, paths: List[str],
+                 options: Dict[str, Any], conf: TpuConf):
+        self.children = []
+        self._output = output
+        self.fmt = fmt
+        self.paths = paths
+        self.options = options or {}
+        self.conf = conf
+        self.files = expand_paths(paths)
+        max_bytes = int(conf.get_key("spark.sql.files.maxPartitionBytes",
+                                     DEFAULT_MAX_PARTITION_BYTES))
+        self._parts = pack_partitions(
+            plan_scan_units(fmt, self.files), max_bytes)
+
+    @property
+    def output(self):
+        return self._output
+
+    def simple_string(self):
+        return (f"FileScan {self.fmt} [{len(self.files)} files, "
+                f"{len(self._parts)} partitions]")
+
+    def partitions(self):
+        reader_type = str(self.conf.get(PARQUET_READER_TYPE)).upper()
+        max_rows = int(self.conf.get(MAX_READER_BATCH_SIZE_ROWS))
+        schema = self.schema
+
+        def decode(u: ScanUnit):
+            return _read_unit(self.fmt, u, schema, self.options)
+
+        def emit(tbl) -> Iterator[HostBatch]:
+            for lo in range(0, max(1, tbl.num_rows), max_rows):
+                yield arrow_to_host_batch(
+                    tbl.slice(lo, max_rows), schema)
+
+        def make(units: List[ScanUnit]):
+            def run() -> Iterator[HostBatch]:
+                if reader_type == "COALESCING" and len(units) > 1:
+                    import pyarrow as pa
+                    tbl = pa.concat_tables([decode(u) for u in units])
+                    yield from emit(tbl)
+                elif reader_type == "MULTITHREADED" and len(units) > 1:
+                    pool = _shared_pool(
+                        int(self.conf.get(MULTITHREADED_READ_NUM_THREADS)))
+                    futures = [pool.submit(decode, u) for u in units]
+                    for f in futures:
+                        yield from emit(f.result())
+                else:  # PERFILE
+                    for u in units:
+                        yield from emit(decode(u))
+            return run
+
+        return [make(us) for us in self._parts]
+
+
+# ---------------------------------------------------------------------------
+# DataFrameReader
+# ---------------------------------------------------------------------------
+
+class DataFrameReader:
+    """spark.read facade (pyspark DataFrameReader shape)."""
+
+    def __init__(self, session):
+        self._session = session
+        self._format = "parquet"
+        self._schema: Optional[T.StructType] = None
+        self._options: Dict[str, Any] = {}
+
+    def format(self, fmt: str) -> "DataFrameReader":
+        self._format = fmt.lower()
+        return self
+
+    def schema(self, schema) -> "DataFrameReader":
+        if isinstance(schema, str):
+            from spark_rapids_tpu.sql.session import _parse_ddl_schema
+            schema = _parse_ddl_schema(schema)
+        self._schema = schema
+        return self
+
+    def option(self, key: str, value: Any) -> "DataFrameReader":
+        self._options[key] = value
+        return self
+
+    def options(self, **opts) -> "DataFrameReader":
+        self._options.update(opts)
+        return self
+
+    def load(self, path=None):
+        from spark_rapids_tpu.sql.dataframe import DataFrame
+        paths = [path] if isinstance(path, str) else list(path)
+        schema = self._schema or self._infer_schema(paths)
+        plan = L.FileScan(self._format, paths, schema, dict(self._options))
+        return DataFrame(plan, self._session)
+
+    def parquet(self, *paths: str):
+        return self.format("parquet").load(list(paths))
+
+    def orc(self, *paths: str):
+        return self.format("orc").load(list(paths))
+
+    def csv(self, path, schema=None, header=None, sep=None,
+            inferSchema=None, nullValue=None):
+        if schema is not None:
+            self.schema(schema)
+        if header is not None:
+            self.option("header", str(header).lower())
+        if sep is not None:
+            self.option("sep", sep)
+        if inferSchema is not None:
+            self.option("inferSchema", str(inferSchema).lower())
+        if nullValue is not None:
+            self.option("nullValue", nullValue)
+        return self.format("csv").load(path)
+
+    def json(self, path, schema=None):
+        if schema is not None:
+            self.schema(schema)
+        return self.format("json").load(path)
+
+    def text(self, path):
+        self._schema = T.StructType([T.StructField("value", T.StringT)])
+        return self.format("text").load(path)
+
+    def table(self, name: str):
+        return self._session.table(name)
+
+    # -- schema inference --------------------------------------------------
+
+    def _infer_schema(self, paths: List[str]) -> T.StructType:
+        files = expand_paths(paths)
+        first = files[0]
+        fmt = self._format
+        if fmt == "parquet":
+            import pyarrow.parquet as pq
+            return arrow_schema_to_sql(
+                pq.ParquetFile(first).schema_arrow)
+        if fmt == "orc":
+            import pyarrow.orc as po
+            return arrow_schema_to_sql(po.ORCFile(first).schema)
+        if fmt == "json":
+            import pyarrow.json as pj
+            return arrow_schema_to_sql(pj.read_json(first).schema)
+        if fmt == "csv":
+            return self._infer_csv_schema(first)
+        raise ValueError(
+            f"cannot infer schema for format {fmt}; pass .schema(...)")
+
+    def _infer_csv_schema(self, path: str) -> T.StructType:
+        import pyarrow.csv as pc
+        header = str(self._options.get("header", "false")).lower() == "true"
+        sep = self._options.get("sep", self._options.get("delimiter", ","))
+        infer = str(self._options.get("inferSchema",
+                                      "false")).lower() == "true"
+        tbl = pc.read_csv(
+            path,
+            read_options=pc.ReadOptions(),
+            parse_options=pc.ParseOptions(delimiter=sep))
+        names = (tbl.column_names if header
+                 else [f"_c{i}" for i in range(tbl.num_columns)])
+        if not header:
+            # first row was data; re-read without consuming it as header
+            tbl = pc.read_csv(
+                path,
+                read_options=pc.ReadOptions(column_names=names),
+                parse_options=pc.ParseOptions(delimiter=sep))
+        if infer:
+            fields = []
+            for n, col in zip(names, tbl.columns):
+                try:
+                    dt = arrow_type_to_sql_for_csv(col.type)
+                except TypeError:
+                    dt = T.StringT
+                fields.append(T.StructField(n, dt))
+            return T.StructType(fields)
+        return T.StructType([T.StructField(n, T.StringT) for n in names])
+
+
+def arrow_type_to_sql_for_csv(at) -> T.DataType:
+    """CSV inference maps ints to LONG and floats to DOUBLE (Spark's
+    CSVInferSchema tightest types)."""
+    import pyarrow as pa
+    if pa.types.is_boolean(at):
+        return T.BooleanT
+    if pa.types.is_integer(at):
+        return T.LongT
+    if pa.types.is_floating(at):
+        return T.DoubleT
+    if pa.types.is_timestamp(at):
+        return T.TimestampT
+    if pa.types.is_date(at):
+        return T.DateT
+    return T.StringT

@@ -140,3 +140,18 @@ def murmur3_columns(cols, capacity: int, seed: int = 42) -> jax.Array:
     for c in cols:
         h = hash_device_column(c, h)
     return h
+
+
+def traced_partition_ids(exprs, cols, active, lit_vals,
+                         n_parts: int) -> jax.Array:
+    """Inside a traced program: pmod(murmur3(keys, 42), n) per row — the
+    single definition of Spark HashPartitioning placement, shared by the
+    in-process exchange and the ICI shard_map exchange so the two paths
+    can never diverge. ``lit_vals`` must be passed as traced inputs (the
+    compile caches key on expression *structure*, not literal values)."""
+    from spark_rapids_tpu.ops import exprs as X
+    cap = active.shape[0]
+    ctx = X.Ctx(cols, cap, tuple(exprs), lit_vals)
+    key_cols = [X.dev_eval(e, ctx) for e in exprs]
+    hv = murmur3_columns(key_cols, cap, 42)
+    return jnp.mod(hv.astype(jnp.int64), n_parts).astype(jnp.int32)

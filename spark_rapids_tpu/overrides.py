@@ -354,6 +354,10 @@ exec_rule(P.CpuHashAggregateExec, "sort-segmented device aggregation",
           tag_fn=_tag_aggregate, convert_fn=_conv_aggregate)
 register_transparent_cpu(P.CpuLocalScanExec)
 
+from spark_rapids_tpu.io.readers import CpuFileScanExec  # noqa: E402
+from spark_rapids_tpu.io.cache import CpuCachedScanExec  # noqa: E402
+register_transparent_cpu(CpuFileScanExec, CpuCachedScanExec)
+
 
 # ---------------------------------------------------------------------------
 # Entry points

@@ -33,7 +33,7 @@ from jax import shard_map
 
 from spark_rapids_tpu.columnar.device import (
     AnyDeviceColumn, DeviceBatch, DeviceColumn, DeviceStringColumn,
-    make_column, shrink_to_bucket)
+    make_column)
 from spark_rapids_tpu.parallel.mesh import SHUFFLE_AXIS, shard_leading
 from spark_rapids_tpu.sql import expressions as E
 from spark_rapids_tpu.sql import types as T
@@ -93,11 +93,8 @@ def _build_exchange(mesh: Mesh, exprs: Tuple[E.Expression, ...],
         # leaves arrive as [1, cap, ...]; squeeze the shard axis
         cols = jax.tree_util.tree_map(lambda a: a[0], cols)
         active = active[0]
-        cap = active.shape[0]
-        ctx = X.Ctx(cols, cap, exprs, lit_vals)
-        key_cols = [X.dev_eval(e, ctx) for e in exprs]
-        hv = hashing.murmur3_columns(key_cols, cap, 42)
-        pids = jnp.mod(hv.astype(jnp.int64), n_parts).astype(jnp.int32)
+        pids = hashing.traced_partition_ids(exprs, cols, active, lit_vals,
+                                            n_parts)
         dest = jnp.mod(pids, n_dev)
         flat, treedef = jax.tree_util.tree_flatten(cols)
         recv, recv_act = all_to_all_rows(flat + [pids], active, dest, n_dev)
@@ -118,7 +115,8 @@ def _build_exchange(mesh: Mesh, exprs: Tuple[E.Expression, ...],
 def exchange_fn(mesh: Mesh, exprs: Sequence[E.Expression],
                 n_parts: int) -> Callable:
     from spark_rapids_tpu.ops import exprs as X
-    key = (id(mesh), tuple(X.expr_key(e) for e in exprs), n_parts)
+    from spark_rapids_tpu.parallel.mesh import mesh_key
+    key = (mesh_key(mesh), tuple(X.expr_key(e) for e in exprs), n_parts)
     fn = _EXCHANGE_CACHE.get(key)
     if fn is None:
         fn = _build_exchange(mesh, tuple(exprs), n_parts)
@@ -196,7 +194,10 @@ def mesh_exchange(slots: Sequence[DeviceBatch],
     lit_vals = X.literal_values(list(bound_exprs))
     recv_cols, recv_pids, recv_act = fn(stacked_cols, stacked_active,
                                         lit_vals)
-    # recv leaves: [n_dev(owner), n_src, cap, ...]
+    # recv leaves: [n_dev(owner), n_src, cap, ...]; land each owner chip's
+    # block through the shared sort-split (one counts sync per chip, no
+    # per-partition round trips)
+    from spark_rapids_tpu.exec.exchange import split_by_pid
     out: List[List[DeviceBatch]] = [[] for _ in range(n_parts)]
     for d in range(n_dev):
         flat_cols: List[AnyDeviceColumn] = []
@@ -206,10 +207,8 @@ def mesh_exchange(slots: Sequence[DeviceBatch],
             flat_cols.append(make_column(c.dtype, arrs))
         pids_d = recv_pids[d].reshape(n_dev * cap)
         act_d = recv_act[d].reshape(n_dev * cap)
-        for pid in range(d, n_parts, n_dev):
-            part = DeviceBatch(schema, flat_cols,
-                               act_d & (pids_d == pid), None)
-            part = shrink_to_bucket(part)
-            if part.row_count():
+        landed = DeviceBatch(schema, flat_cols, act_d, None)
+        for pid, part in enumerate(split_by_pid(landed, pids_d, n_parts)):
+            if part is not None:
                 out[pid].append(part)
     return out

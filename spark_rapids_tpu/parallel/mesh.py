@@ -68,6 +68,13 @@ def active_mesh(mesh: Mesh) -> Iterator[Mesh]:
         set_active_mesh(prev)
 
 
+def mesh_key(mesh: Mesh) -> tuple:
+    """Value-based cache key for compiled per-mesh programs (two Mesh
+    objects over the same devices share executables; id()-keyed caches
+    would retain every Mesh ever built)."""
+    return (tuple(d.id for d in mesh.devices.flat), mesh.axis_names)
+
+
 def shard_leading(mesh: Mesh, ndim: int) -> NamedSharding:
     """Sharding placing a stacked array's leading axis across the mesh."""
     return NamedSharding(

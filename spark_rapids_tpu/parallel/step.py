@@ -40,8 +40,9 @@ def sum_count_step(mesh: Mesh) -> Callable:
     final (keys, sums, counts, out_active) for the key-groups that chip
     owns (murmur3(key) % n_dev).
     """
+    from spark_rapids_tpu.parallel.mesh import mesh_key
     n_dev = mesh.shape[SHUFFLE_AXIS]
-    key = (id(mesh), "sum_count")
+    key = (mesh_key(mesh), "sum_count")
     fn = _STEP_CACHE.get(key)
     if fn is not None:
         return fn

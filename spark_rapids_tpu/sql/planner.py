@@ -46,6 +46,10 @@ class Planner:
         return CpuFileScanExec(p.output, p.fmt, p.paths, p.options,
                                self.conf)
 
+    def _plan_cachedrelation(self, p) -> P.PhysicalPlan:
+        from spark_rapids_tpu.io.cache import CpuCachedScanExec
+        return CpuCachedScanExec(p)
+
     def _plan_range(self, p: L.Range) -> P.PhysicalPlan:
         return P.CpuRangeExec(p.output, p.start, p.end, p.step,
                               p.num_partitions)
