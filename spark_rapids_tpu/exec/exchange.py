@@ -35,6 +35,7 @@ from spark_rapids_tpu.sql import physical as P
 _PID_CACHE: Dict[Tuple, Callable] = {}
 _SORT_CACHE: Dict[Tuple, Callable] = {}
 _EXTRACT_CACHE: Dict[Tuple, Callable] = {}
+_RANGE_PID_CACHE: Dict[Tuple, Callable] = {}
 
 
 def hash_partition_ids(exprs: List[E.Expression], batch: DeviceBatch,
@@ -53,6 +54,34 @@ def hash_partition_ids(exprs: List[E.Expression], batch: DeviceBatch,
     return fn(batch.columns, batch.active, X.literal_values(exprs))
 
 
+def range_partition_ids(order: List[E.Expression],
+                        bound: List[E.Expression], batch: DeviceBatch,
+                        n: int) -> jax.Array:
+    """Equal-depth range bucketing over the whole dataset's sort-rank
+    space (GpuRangePartitioner analogue; matches the CPU engine's
+    _range_partition bucketing bit-for-bit because both rank with the
+    same stable lexicographic order)."""
+    from spark_rapids_tpu.ops import sort as S
+    key = (tuple(X.expr_key(e) for e in bound),
+           tuple((o.ascending, o.nulls_first) for o in order), n)
+    fn = _RANGE_PID_CACHE.get(key)
+    if fn is None:
+        bound_t = tuple(bound)
+        orders = list(order)
+
+        def _fn(cols, active, lit_vals):
+            cap = active.shape[0]
+            ctx = X.Ctx(cols, cap, bound_t, lit_vals)
+            key_cols = [X.dev_eval(e, ctx) for e in bound_t]
+            ranks = S.rank_of_rows(key_cols, orders, active)
+            total = jnp.maximum(jnp.sum(active), 1)
+            return jnp.minimum((ranks * n) // total,
+                               n - 1).astype(jnp.int32)
+        fn = jax.jit(_fn)
+        _RANGE_PID_CACHE[key] = fn
+    return fn(batch.columns, batch.active, X.literal_values(bound))
+
+
 def split_by_pid(batch: DeviceBatch, pids: jax.Array, n: int
                  ) -> List[Optional[DeviceBatch]]:
     """contiguousSplit (GpuPartitioning.scala:50) as ONE device program:
@@ -69,10 +98,10 @@ def split_by_pid(batch: DeviceBatch, pids: jax.Array, n: int
             key = jnp.where(active, pids, jnp.int32(n))
             counts = jnp.bincount(key, length=n + 1)[:n]
             order = jnp.argsort(key, stable=True)
-            return counts, active[order], tuple(a[order] for a in arrs)
+            return counts, tuple(a[order] for a in arrs)
         sort_fn = jax.jit(_sort)
         _SORT_CACHE[skey] = sort_fn
-    counts_d, sorted_active, sorted_flat = sort_fn(pids, batch.active, *flat)
+    counts_d, sorted_flat = sort_fn(pids, batch.active, *flat)
     counts = np.asarray(counts_d)
     offsets = np.concatenate([[0], np.cumsum(counts)])
 
@@ -160,6 +189,22 @@ class TpuShuffleExchangeExec(TpuExec):
                         if part is not None:
                             out[pid].append(part)
                     start += 1
+        elif isinstance(p, P.RangePartitioning):
+            from spark_rapids_tpu.columnar.device import concat_device
+            all_batches: List[DeviceBatch] = []
+            for thunk in device_channel(self.child):
+                all_batches.extend(b for b in thunk() if b.row_count())
+            if all_batches:
+                whole = (all_batches[0] if len(all_batches) == 1
+                         else concat_device(all_batches))
+                bound = P.bind_list([o.child for o in p.order],
+                                    self.child.output)
+                with self.metrics.timed(M.PARTITION_TIME):
+                    pids = range_partition_ids(p.order, bound, whole, n)
+                    parts = split_by_pid(whole, pids, n)
+                for pid, part in enumerate(parts):
+                    if part is not None:
+                        out[pid].append(part)
         else:
             raise NotImplementedError(repr(p))
         self._cache = out

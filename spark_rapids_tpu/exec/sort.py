@@ -1,0 +1,139 @@
+"""TpuSortExec / TpuTopNExec: device sort (GpuSortExec.scala:68 twin).
+
+Per-partition sort, matching the CPU engine's semantics: the partition's
+batches are concatenated on device, sort keys are evaluated as fused
+device expressions, and one jitted program (cached on expression
+structure + capacity bucket) produces the permuted batch. Global sorts
+rely on the planner's range-partitioning exchange for cross-partition
+order, exactly like Spark.
+
+TpuTopNExec is the TakeOrderedAndProject analogue (GpuTopN,
+limit.scala:123): sort then keep the first ``n`` rows via the active
+mask — no data movement beyond the sort's own gather.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterator, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import metrics as M
+from spark_rapids_tpu.columnar.device import (DeviceBatch, concat_device,
+                                              take_columns)
+from spark_rapids_tpu.conf import TpuConf
+from spark_rapids_tpu.exec.base import (DevicePartitionThunk, TpuExec,
+                                        device_channel)
+from spark_rapids_tpu.ops import exprs as X
+from spark_rapids_tpu.ops import sort as S
+from spark_rapids_tpu.sql import expressions as E
+from spark_rapids_tpu.sql import physical as P
+
+_SORT_FN_CACHE: Dict[Tuple, Callable] = {}
+
+
+def is_device_sort(order: List[E.SortOrder], conf: TpuConf):
+    """Tagging helper: None when every sort key can run on device."""
+    from spark_rapids_tpu.sql import types as T
+    for o in order:
+        dt = o.child.data_type
+        if isinstance(dt, T.DecimalType):
+            return "decimal sort keys run on CPU"
+        if isinstance(dt, (T.ArrayType, T.MapType, T.StructType)):
+            return "nested sort keys are not supported on TPU"
+        r = X.is_device_expr(o.child, conf)
+        if r:
+            return r
+    return None
+
+
+def sorted_batch(order: List[E.SortOrder], bound: List[E.Expression],
+                 batch: DeviceBatch, limit: int = -1) -> DeviceBatch:
+    """Sort one device batch by `order` (keys pre-bound); optionally keep
+    only the first `limit` rows. One fused jitted program."""
+    key = (tuple(X.expr_key(e) for e in bound),
+           tuple((o.ascending, o.nulls_first) for o in order),
+           limit)
+    fn = _SORT_FN_CACHE.get(key)
+    if fn is None:
+        orders = list(order)
+        bound_t = tuple(bound)
+
+        def _fn(cols, active, lit_vals):
+            cap = active.shape[0]
+            ctx = X.Ctx(cols, cap, bound_t, lit_vals)
+            key_cols = [X.dev_eval(e, ctx) for e in bound_t]
+            perm = S.sort_permutation(key_cols, orders, active)
+            n = jnp.sum(active)
+            if limit >= 0:
+                n = jnp.minimum(n, limit)
+            new_active = jnp.arange(cap) < n
+            out = take_columns(cols, perm, valid_at=new_active)
+            return [c.arrays() for c in out], new_active
+        fn = jax.jit(_fn)
+        _SORT_FN_CACHE[key] = fn
+    arrs, new_active = fn(batch.columns, batch.active,
+                          X.literal_values(bound))
+    from spark_rapids_tpu.columnar.device import make_column
+    cols = [make_column(c.dtype, a) for c, a in zip(batch.columns, arrs)]
+    return DeviceBatch(batch.schema, cols, new_active, None)
+
+
+class TpuSortExec(TpuExec):
+    def __init__(self, order: List[E.SortOrder], is_global: bool,
+                 child: TpuExec, conf: TpuConf):
+        super().__init__(conf)
+        self.children = [child]
+        self.order = order
+        self.is_global = is_global
+
+    @property
+    def child(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def _limit(self) -> int:
+        return -1
+
+    def device_partitions(self) -> List[DevicePartitionThunk]:
+        bound = P.bind_list([o.child for o in self.order],
+                            self.child.output)
+        metrics = self.metrics
+
+        def make(thunk: DevicePartitionThunk) -> DevicePartitionThunk:
+            def run() -> Iterator[DeviceBatch]:
+                batches = [b for b in thunk() if b.row_count()]
+                if not batches:
+                    return
+                whole = (batches[0] if len(batches) == 1
+                         else concat_device(batches))
+                with metrics.timed(M.SORT_TIME):
+                    out = sorted_batch(self.order, bound, whole,
+                                       self._limit())
+                metrics.create(M.NUM_OUTPUT_ROWS, M.ESSENTIAL).add(
+                    out.row_count())
+                yield out
+            return run
+        return [make(t) for t in device_channel(self.child)]
+
+    def simple_string(self):
+        return f"TpuSort {self.order} global={self.is_global}"
+
+
+class TpuTopNExec(TpuSortExec):
+    """Sort + per-partition limit in one device program (GpuTopN)."""
+
+    def __init__(self, n: int, order: List[E.SortOrder], child: TpuExec,
+                 conf: TpuConf):
+        super().__init__(order, False, child, conf)
+        self.n = n
+
+    def _limit(self) -> int:
+        return self.n
+
+    def simple_string(self):
+        return f"TpuTopN n={self.n} {self.order}"

@@ -1,0 +1,73 @@
+"""Device sort kernel: order-preserving subkey encoding + lexsort.
+
+The reference sorts on device via cudf radix/merge sort behind
+GpuSortExec (GpuSortExec.scala:68 per-batch, SortUtils.scala:138 for the
+key ordering rules). The TPU shape of the same idea: encode every
+SortOrder into unsigned-integer subkeys whose ascending lexicographic
+order *is* Spark's ordering — nulls-first/last via a validity key,
+descending via bitwise complement (strictly order-reversing on uint64) —
+then one ``jnp.lexsort``, which XLA lowers to its sort HLO. Gather rows
+through ``take_columns`` and the batch is sorted with zero recompilation
+across batches of the same capacity bucket.
+
+Spark ordering semantics handled here (SortUtils.scala / TypeUtils):
+- NaN sorts greater than all floats, all NaNs equal (rank_u64's
+  total-order encoding, shared with the groupby kernel).
+- -0.0 == 0.0 (same encoding).
+- Strings compare as UTF-8 bytes; zero-padded word packing + length
+  tiebreak reproduces binary order exactly (ops/groupby.py
+  pack_string_words invariant).
+- Nulls first for ascending, last for descending by default; explicit
+  ``nulls_first`` honored either way.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.device import (AnyDeviceColumn,
+                                              DeviceStringColumn)
+from spark_rapids_tpu.ops.groupby import pack_string_words, rank_u64
+
+
+def order_subkeys(col: AnyDeviceColumn, ascending: bool,
+                  nulls_first: bool) -> List[jax.Array]:
+    """Subkeys (most-significant first) whose joint ascending order equals
+    the SortOrder's ordering of this column. The validity key is most
+    significant so the null group separates cleanly; null slots hold
+    normalized zeros underneath and tie, keeping the sort stable there."""
+    if isinstance(col, DeviceStringColumn):
+        data_keys = pack_string_words(col) + [col.lengths.astype(jnp.uint64)]
+    else:
+        data_keys = [rank_u64(col)]
+    if not ascending:
+        data_keys = [~k for k in data_keys]
+    # False sorts before True: validity as-is puts nulls first
+    null_key = col.validity if nulls_first else ~col.validity
+    return [null_key] + data_keys
+
+
+def sort_permutation(key_cols: Sequence[AnyDeviceColumn],
+                     orders: Sequence,  # List[E.SortOrder]
+                     active: jax.Array) -> jax.Array:
+    """Stable permutation sorting rows by the given SortOrders, with all
+    inactive (padding/filtered) rows sunk to the tail."""
+    keys: List[jax.Array] = []
+    for col, o in zip(key_cols, orders):
+        keys.extend(order_subkeys(col, o.ascending, o.nulls_first))
+    # lexsort: LAST key is primary -> reverse significance, then ~active
+    # on top so padding rows sort after every active row
+    return jnp.lexsort(tuple(reversed(keys)) + (~active,))
+
+
+def rank_of_rows(key_cols: Sequence[AnyDeviceColumn], orders: Sequence,
+                 active: jax.Array) -> jax.Array:
+    """Per-row sort rank (0-based among active rows; padding rows get
+    ranks past the active count). Used by range partitioning."""
+    perm = sort_permutation(key_cols, orders, active)
+    cap = active.shape[0]
+    ranks = jnp.zeros(cap, dtype=jnp.int64)
+    return ranks.at[perm].set(jnp.arange(cap, dtype=jnp.int64))

@@ -260,9 +260,21 @@ def _tag_exchange(meta: ExecMeta) -> None:
                     "decimal128 hash partitioning runs on CPU")
     elif isinstance(p, (P.SinglePartitioning, P.RoundRobinPartitioning)):
         pass
+    elif isinstance(p, P.RangePartitioning):
+        from spark_rapids_tpu.exec.sort import is_device_sort
+        r = is_device_sort(p.order, meta.conf)
+        if r:
+            meta.will_not_work(f"range partitioning: {r}")
     else:
         meta.will_not_work(
             f"{type(p).__name__} is not supported on TPU yet")
+
+
+def _tag_sort(meta: ExecMeta) -> None:
+    from spark_rapids_tpu.exec.sort import is_device_sort
+    r = is_device_sort(meta.wrapped.order, meta.conf)
+    if r:
+        meta.will_not_work(r)
 
 
 def _tag_aggregate(meta: ExecMeta) -> None:
@@ -315,6 +327,12 @@ def _conv_union(meta, kids):
 
 def _conv_local_limit(meta, kids):
     from spark_rapids_tpu.exec.basic import TpuLocalLimitExec
+    from spark_rapids_tpu.exec.sort import TpuSortExec, TpuTopNExec
+    kid = kids[0]
+    # LocalLimit over Sort fuses into TopN (TakeOrderedAndProject /
+    # GpuTopN, limit.scala:123)
+    if type(kid) is TpuSortExec:
+        return TpuTopNExec(meta.wrapped.n, kid.order, kid.child, meta.conf)
     return TpuLocalLimitExec(meta.wrapped.n, kids[0], meta.conf)
 
 
@@ -336,6 +354,12 @@ def _conv_aggregate(meta, kids):
                                 w.slots, meta.conf)
 
 
+def _conv_sort(meta, kids):
+    from spark_rapids_tpu.exec.sort import TpuSortExec
+    w = meta.wrapped
+    return TpuSortExec(w.order, w.is_global, kids[0], meta.conf)
+
+
 exec_rule(P.CpuProjectExec, "projection onto device columns",
           tag_fn=_tag_project, convert_fn=_conv_project)
 exec_rule(P.CpuFilterExec, "device predicate filter (mask update)",
@@ -352,6 +376,8 @@ exec_rule(P.CpuShuffleExchangeExec, "device-partitioned exchange",
           tag_fn=_tag_exchange, convert_fn=_conv_exchange)
 exec_rule(P.CpuHashAggregateExec, "sort-segmented device aggregation",
           tag_fn=_tag_aggregate, convert_fn=_conv_aggregate)
+exec_rule(P.CpuSortExec, "device lexsort over encoded sort keys",
+          tag_fn=_tag_sort, convert_fn=_conv_sort)
 register_transparent_cpu(P.CpuLocalScanExec)
 
 from spark_rapids_tpu.io.readers import CpuFileScanExec  # noqa: E402
