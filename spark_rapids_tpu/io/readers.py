@@ -39,28 +39,84 @@ from spark_rapids_tpu.sql import types as T
 
 DEFAULT_MAX_PARTITION_BYTES = 128 << 20
 
-_DATA_EXT = {".parquet", ".orc", ".csv", ".json", ".txt", ".tsv"}
+HIVE_DEFAULT_PARTITION = "__HIVE_DEFAULT_PARTITION__"
+
+
+def list_files(paths: Sequence[str]) -> List[tuple]:
+    """Directory/glob expansion with Hive partition-directory discovery
+    (PartitioningAwareFileIndex role): returns ``(file, part_values)``
+    pairs where part_values maps partition column -> raw string value
+    parsed from ``k=v`` path components under a directory input."""
+    out: List[tuple] = []
+    for p in paths:
+        if os.path.isdir(p):
+            base = os.path.abspath(p)
+            for root, dirs, names in os.walk(base):
+                dirs.sort()
+                dirs[:] = [d for d in dirs if not d.startswith((".", "_"))]
+                pv: Dict[str, str] = {}
+                rel = os.path.relpath(root, base)
+                if rel != ".":
+                    from urllib.parse import unquote
+                    for comp in rel.split(os.sep):
+                        if "=" in comp:
+                            k, v = comp.split("=", 1)
+                            pv[k] = (v if v == HIVE_DEFAULT_PARTITION
+                                     else unquote(v))
+                for n in sorted(names):
+                    if n.startswith(("_", ".")):
+                        continue
+                    out.append((os.path.join(root, n), pv))
+        elif any(ch in p for ch in "*?["):
+            out.extend((f, {}) for f in sorted(glob.glob(p)))
+        elif os.path.exists(p):
+            out.append((p, {}))
+        else:
+            raise FileNotFoundError(p)
+    if not out:
+        raise FileNotFoundError(f"no input files in {list(paths)}")
+    return out
 
 
 def expand_paths(paths: Sequence[str]) -> List[str]:
     """Directory/glob expansion (FilePartition listing role)."""
-    files: List[str] = []
-    for p in paths:
-        if os.path.isdir(p):
-            for root, _dirs, names in os.walk(p):
-                for n in sorted(names):
-                    if n.startswith(("_", ".")):
-                        continue
-                    files.append(os.path.join(root, n))
-        elif any(ch in p for ch in "*?["):
-            files.extend(sorted(glob.glob(p)))
-        elif os.path.exists(p):
-            files.append(p)
-        else:
-            raise FileNotFoundError(p)
-    if not files:
-        raise FileNotFoundError(f"no input files in {list(paths)}")
-    return files
+    return [f for f, _ in list_files(paths)]
+
+
+def discovered_partition_fields(files: List[tuple]) -> List[T.StructField]:
+    """Partition columns + value-inferred types (Spark's
+    PartitioningUtils.inferPartitionColumnValue: int -> long -> double ->
+    string, null for the Hive default marker)."""
+    names: List[str] = []
+    values: Dict[str, List[str]] = {}
+    for _f, pv in files:
+        for k, v in pv.items():
+            if k not in values:
+                names.append(k)
+                values[k] = []
+            values[k].append(v)
+    fields = []
+    for n in names:
+        fields.append(T.StructField(n, _infer_part_type(values[n])))
+    return fields
+
+
+def _infer_part_type(raw: List[str]) -> T.DataType:
+    vals = [v for v in raw if v != HIVE_DEFAULT_PARTITION]
+    if not vals:
+        return T.StringT
+    try:
+        ints = [int(v) for v in vals]
+        if all(-(1 << 31) <= i < (1 << 31) for i in ints):
+            return T.IntegerT
+        return T.LongT
+    except ValueError:
+        pass
+    try:
+        [float(v) for v in vals]
+        return T.DoubleT
+    except ValueError:
+        return T.StringT
 
 
 @dataclass
@@ -71,26 +127,44 @@ class ScanUnit:
     path: str
     size_bytes: int
     row_groups: Optional[List[int]] = None  # parquet only; None = whole file
+    part_values: Optional[Dict[str, str]] = None  # Hive dir values
 
 
-def plan_scan_units(fmt: str, files: List[str]) -> List[ScanUnit]:
+# Footer-parse results memoized per (fmt, file set), invalidated by the
+# files' stat signature, so re-planning the same DataFrame (every
+# collect()) doesn't re-read every parquet footer — the reference caches
+# its file index per relation. Keyed by path set (stat sig stored in the
+# value) so overwrites replace entries instead of accumulating.
+_UNITS_CACHE: Dict[tuple, tuple] = {}
+
+
+def plan_scan_units(fmt: str, files: List[tuple]) -> List[ScanUnit]:
+    key = (fmt, tuple(f for f, _ in files))
+    sig = tuple((tuple(sorted(pv.items())),
+                 os.path.getmtime(f), os.path.getsize(f))
+                for f, pv in files)
+    cached = _UNITS_CACHE.get(key)
+    if cached is not None and cached[0] == sig:
+        return cached[1]
     units: List[ScanUnit] = []
     if fmt == "parquet":
         import pyarrow.parquet as pq
-        for f in files:
+        for f, pv in files:
             try:
                 meta = pq.ParquetFile(f).metadata
             except Exception:
-                units.append(ScanUnit(f, os.path.getsize(f)))
+                units.append(ScanUnit(f, os.path.getsize(f),
+                                      part_values=pv))
                 continue
             for rg in range(meta.num_row_groups):
                 units.append(ScanUnit(
-                    f, meta.row_group(rg).total_byte_size, [rg]))
+                    f, meta.row_group(rg).total_byte_size, [rg], pv))
             if meta.num_row_groups == 0:
-                units.append(ScanUnit(f, 0, []))
+                units.append(ScanUnit(f, 0, [], pv))
     else:
-        for f in files:
-            units.append(ScanUnit(f, os.path.getsize(f)))
+        for f, pv in files:
+            units.append(ScanUnit(f, os.path.getsize(f), part_values=pv))
+    _UNITS_CACHE[key] = (sig, units)
     return units
 
 
@@ -149,28 +223,61 @@ def _read_unit(fmt: str, unit: ScanUnit, schema: T.StructType,
 
 
 def _read_csv(path: str, schema: T.StructType, options: Dict[str, Any]):
+    import pyarrow as pa
     import pyarrow.csv as pc
     header = str(options.get("header", "false")).lower() == "true"
     sep = options.get("sep", options.get("delimiter", ","))
     null_value = options.get("nullValue", "")
     names = [f.name for f in schema.fields]
-    read_opts = pc.ReadOptions(
-        column_names=None if header else names,
-        skip_rows=0)
+    null_values = [null_value] if null_value else [""]
     parse_opts = pc.ParseOptions(delimiter=sep)
     convert_opts = pc.ConvertOptions(
         column_types={f.name: sql_type_to_arrow(f.data_type)
                       for f in schema.fields},
-        null_values=[null_value] if null_value else [""],
+        null_values=null_values,
         strings_can_be_null=True,
         timestamp_parsers=[pc.ISO8601, "%Y-%m-%d %H:%M:%S"])
-    tbl = pc.read_csv(path, read_options=read_opts,
-                      parse_options=parse_opts,
-                      convert_options=convert_opts)
-    if header:
-        # align by position when file header names differ from schema
-        tbl = tbl.rename_columns(names[:tbl.num_columns])
-    return tbl.select(names)
+    try:
+        tbl = pc.read_csv(
+            path,
+            read_options=pc.ReadOptions(
+                column_names=None if header else names, skip_rows=0),
+            parse_options=parse_opts,
+            convert_options=convert_opts)
+    except pa.lib.ArrowInvalid:
+        # PERMISSIVE-mode tolerance: the file's column count differs from
+        # the schema — re-read with positional names (same null semantics,
+        # types conformed by cast below)
+        tbl = pc.read_csv(
+            path,
+            read_options=pc.ReadOptions(autogenerate_column_names=True,
+                                        skip_rows=1 if header else 0),
+            parse_options=parse_opts,
+            convert_options=pc.ConvertOptions(
+                null_values=null_values, strings_can_be_null=True,
+                timestamp_parsers=[pc.ISO8601, "%Y-%m-%d %H:%M:%S"]))
+    # align by position when file header names/column count differ from
+    # the schema; extra columns are dropped, missing ones become null
+    n = min(len(names), tbl.num_columns)
+    tbl = tbl.select(list(range(n))).rename_columns(names[:n])
+    return _conform(tbl, schema)
+
+
+def _append_partition_columns(tbl, part_fields: List[T.StructField],
+                              part_values: Dict[str, str]):
+    """Attach directory-derived partition values as constant columns
+    (PartitioningUtils.castPartValueToDesiredType role)."""
+    import pyarrow as pa
+    for f in part_fields:
+        raw = part_values.get(f.name)
+        at = sql_type_to_arrow(f.data_type)
+        if raw is None or raw == HIVE_DEFAULT_PARTITION:
+            arr = pa.nulls(tbl.num_rows, type=at)
+        else:
+            arr = pa.array([raw] * tbl.num_rows,
+                           type=pa.string()).cast(at)
+        tbl = tbl.append_column(f.name, arr)
+    return tbl
 
 
 def _conform(tbl, schema: T.StructType):
@@ -192,15 +299,19 @@ def _conform(tbl, schema: T.StructType):
 # ---------------------------------------------------------------------------
 
 _READ_POOL: Optional[ThreadPoolExecutor] = None
+_POOL_SIZE: int = 0
 _POOL_LOCK = threading.Lock()
 
 
 def _shared_pool(n_threads: int) -> ThreadPoolExecutor:
-    global _READ_POOL
+    global _READ_POOL, _POOL_SIZE
     with _POOL_LOCK:
-        if _READ_POOL is None:
+        if _READ_POOL is None or _POOL_SIZE != n_threads:
+            if _READ_POOL is not None:
+                _READ_POOL.shutdown(wait=False)
             _READ_POOL = ThreadPoolExecutor(
                 max_workers=n_threads, thread_name_prefix="srt-multifile")
+            _POOL_SIZE = n_threads
         return _READ_POOL
 
 
@@ -216,11 +327,15 @@ class CpuFileScanExec(P.PhysicalPlan):
         self.paths = paths
         self.options = options or {}
         self.conf = conf
-        self.files = expand_paths(paths)
+        listed = list_files(paths)
+        self.files = [f for f, _ in listed]
+        part_names = {k for _f, pv in listed for k in pv}
+        self._part_fields = [f for f in self.schema.fields
+                             if f.name in part_names]
         max_bytes = int(conf.get_key("spark.sql.files.maxPartitionBytes",
                                      DEFAULT_MAX_PARTITION_BYTES))
         self._parts = pack_partitions(
-            plan_scan_units(fmt, self.files), max_bytes)
+            plan_scan_units(fmt, listed), max_bytes)
 
     @property
     def output(self):
@@ -234,9 +349,18 @@ class CpuFileScanExec(P.PhysicalPlan):
         reader_type = str(self.conf.get(PARQUET_READER_TYPE)).upper()
         max_rows = int(self.conf.get(MAX_READER_BATCH_SIZE_ROWS))
         schema = self.schema
+        part_fields = self._part_fields
+        part_names = {f.name for f in part_fields}
+        data_schema = T.StructType(
+            [f for f in schema.fields if f.name not in part_names])
 
         def decode(u: ScanUnit):
-            return _read_unit(self.fmt, u, schema, self.options)
+            tbl = _read_unit(self.fmt, u, data_schema, self.options)
+            if part_fields:
+                tbl = _append_partition_columns(tbl, part_fields,
+                                                u.part_values or {})
+                tbl = tbl.select([f.name for f in schema.fields])
+            return tbl
 
         def emit(tbl) -> Iterator[HostBatch]:
             for lo in range(0, max(1, tbl.num_rows), max_rows):
@@ -298,7 +422,14 @@ class DataFrameReader:
     def load(self, path=None):
         from spark_rapids_tpu.sql.dataframe import DataFrame
         paths = [path] if isinstance(path, str) else list(path)
-        schema = self._schema or self._infer_schema(paths)
+        listed = list_files(paths)  # one walk for infer + discovery
+        schema = self._schema or self._infer_schema_from(listed)
+        # append Hive-style partition columns discovered from k=v dirs
+        have = {f.name for f in schema.fields}
+        extra = [f for f in discovered_partition_fields(listed)
+                 if f.name not in have]
+        if extra:
+            schema = T.StructType(list(schema.fields) + extra)
         plan = L.FileScan(self._format, paths, schema, dict(self._options))
         return DataFrame(plan, self._session)
 
@@ -336,9 +467,8 @@ class DataFrameReader:
 
     # -- schema inference --------------------------------------------------
 
-    def _infer_schema(self, paths: List[str]) -> T.StructType:
-        files = expand_paths(paths)
-        first = files[0]
+    def _infer_schema_from(self, listed: List[tuple]) -> T.StructType:
+        first = listed[0][0]
         fmt = self._format
         if fmt == "parquet":
             import pyarrow.parquet as pq

@@ -112,8 +112,12 @@ class DataFrameWriter:
             dschema = T.StructType([schema.fields[i] for i in data_fields])
             dcols = [sub.columns[i] for i in data_fields]
             dbatch = HostBatch(dschema, dcols, sub.num_rows)
+            # partition values are URL-escaped like Spark's
+            # PartitioningUtils.escapePathName so separators/specials
+            # round-trip through the directory name
+            from urllib.parse import quote
             subdir = os.path.join(root, *[
-                f"{c}={'__HIVE_DEFAULT_PARTITION__' if v is None else v}"
+                f"{c}={'__HIVE_DEFAULT_PARTITION__' if v is None else quote(str(v), safe='')}"
                 for c, v in zip(self._partition_by, k)])
             os.makedirs(subdir, exist_ok=True)
             self._write_file(dbatch, subdir, task_id)

@@ -216,3 +216,129 @@ def test_device_scan_is_transparent_not_fallback(tmpdir_path):
         assert report.fallbacks == [], report.format()
     finally:
         spark.stop()
+
+
+# -- Hive partition discovery (PartitioningAwareFileIndex twin) -------------
+
+def test_partitionby_roundtrip_recovers_partition_column(tmpdir_path):
+    p = os.path.join(tmpdir_path, "pds")
+    spark = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        df = spark.createDataFrame(
+            {"k": [1, 1, 2, 2, 3], "v": [10.0, 20.0, 30.0, None, 50.0]},
+            "k int, v double")
+        df.write.partitionBy("k").mode("overwrite").parquet(p)
+        back = spark.read.parquet(p)
+        names = [f.name for f in back.plan.schema.fields]
+        assert set(names) == {"k", "v"}
+        rows = sorted((r.k, r.v) for r in back.collect()
+                      if r.v is not None)
+        assert rows == [(1, 10.0), (1, 20.0), (2, 30.0), (3, 50.0)]
+        # null partition value round-trips as null (__HIVE_DEFAULT_PARTITION__)
+        df2 = spark.createDataFrame({"k": [None, 5], "v": [1.0, 2.0]},
+                                    "k int, v double")
+        p2 = os.path.join(tmpdir_path, "pds2")
+        df2.write.partitionBy("k").parquet(p2)
+        back2 = {(r.k, r.v) for r in spark.read.parquet(p2).collect()}
+        assert back2 == {(None, 1.0), (5, 2.0)}
+    finally:
+        spark.stop()
+
+
+def test_partition_column_type_inference(tmpdir_path):
+    root = os.path.join(tmpdir_path, "typed")
+    spark = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        df = spark.createDataFrame(
+            {"tag": ["a", "b"], "v": [1.5, 2.5]}, "tag string, v double")
+        df.write.partitionBy("tag").parquet(root)
+        back = spark.read.parquet(root)
+        sch = {f.name: f.data_type for f in back.plan.schema.fields}
+        assert isinstance(sch["tag"], T.StringType)
+        assert {(r.tag, r.v) for r in back.collect()} == {
+            ("a", 1.5), ("b", 2.5)}
+    finally:
+        spark.stop()
+
+
+def test_partitioned_scan_on_device(tmpdir_path):
+    p = os.path.join(tmpdir_path, "pdev")
+    _spark = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        _spark.createDataFrame(
+            {"k": [1, 2, 1, 2, 1], "v": [1.0, 2.0, 3.0, 4.0, 5.0]},
+            "k int, v double").write.partitionBy("k").parquet(p)
+    finally:
+        _spark.stop()
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: s.read.parquet(p).groupBy("k").agg(
+            F.count("v").alias("c")),
+        expect_execs=["TpuHashAggregate"])
+
+
+# -- CSV permissive column-count handling -----------------------------------
+
+def test_csv_more_columns_than_schema(tmpdir_path):
+    f = os.path.join(tmpdir_path, "wide.csv")
+    with open(f, "w") as fh:
+        fh.write("a,b,c\n1,2,3\n4,5,6\n")
+    spark = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        got = spark.read.csv(f, schema="a bigint, b bigint",
+                             header=True).collect()
+        assert [(r.a, r.b) for r in got] == [(1, 2), (4, 5)]
+    finally:
+        spark.stop()
+
+
+def test_csv_fewer_columns_than_schema(tmpdir_path):
+    f = os.path.join(tmpdir_path, "narrow.csv")
+    with open(f, "w") as fh:
+        fh.write("a\n1\n4\n")
+    spark = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        got = spark.read.csv(f, schema="a bigint, b bigint",
+                             header=True).collect()
+        assert [(r.a, r.b) for r in got] == [(1, None), (4, None)]
+    finally:
+        spark.stop()
+
+
+def test_partition_value_escaping_roundtrip(tmpdir_path):
+    p = os.path.join(tmpdir_path, "esc")
+    spark = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        spark.createDataFrame(
+            {"tag": ["a/b", "c=d", "plain"], "v": [1.0, 2.0, 3.0]},
+            "tag string, v double").write.partitionBy("tag").parquet(p)
+        back = {(r.tag, r.v) for r in spark.read.parquet(p).collect()}
+        assert back == {("a/b", 1.0), ("c=d", 2.0), ("plain", 3.0)}
+    finally:
+        spark.stop()
+
+
+def test_csv_extra_column_name_collision(tmpdir_path):
+    f = os.path.join(tmpdir_path, "collide.csv")
+    with open(f, "w") as fh:
+        fh.write("x,y,a\n1,2,3\n")
+    spark = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        got = spark.read.csv(f, schema="a bigint, b bigint",
+                             header=True).collect()
+        assert [(r.a, r.b) for r in got] == [(1, 2)]
+    finally:
+        spark.stop()
+
+
+def test_csv_mismatch_keeps_null_value_option(tmpdir_path):
+    f = os.path.join(tmpdir_path, "nv.csv")
+    with open(f, "w") as fh:
+        fh.write("a\n1\nXX\n")
+    spark = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        got = spark.read.format("csv").schema("a bigint, b bigint") \
+            .option("header", "true").option("nullValue", "XX").load(f) \
+            .collect()
+        assert [(r.a, r.b) for r in got] == [(1, None), (None, None)]
+    finally:
+        spark.stop()
