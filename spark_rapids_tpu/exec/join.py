@@ -1,0 +1,166 @@
+"""TpuShuffledHashJoinExec / TpuBroadcastHashJoinExec
+(GpuShuffledHashJoinExec.scala / GpuBroadcastHashJoinExec.scala twins over
+the count-then-gather kernel in ops/join.py).
+
+Residual (non-equi) conditions are applied as a device filter over the
+joined pairs — valid for inner/cross joins only; the rewrite tags
+conditional outer joins back to CPU (the reference compiles those to AST
+filters inside cudf's join, a complexity this design doesn't need yet).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+from spark_rapids_tpu import metrics as M
+from spark_rapids_tpu.columnar.device import DeviceBatch, concat_device
+from spark_rapids_tpu.conf import TpuConf
+from spark_rapids_tpu.exec.base import (DevicePartitionThunk, TpuExec,
+                                        device_channel)
+from spark_rapids_tpu.ops import exprs as X
+from spark_rapids_tpu.ops.join import MASK_JOINS, PAIR_JOINS, device_join
+from spark_rapids_tpu.sql import expressions as E
+from spark_rapids_tpu.sql import physical as P
+from spark_rapids_tpu.sql import types as T
+
+
+def is_device_join(join_type: str, left_keys: List[E.Expression],
+                   right_keys: List[E.Expression],
+                   condition: Optional[E.Expression],
+                   conf: TpuConf) -> Optional[str]:
+    """Tagging helper: None when the join can run on device."""
+    if join_type not in PAIR_JOINS + MASK_JOINS:
+        return f"join type {join_type} is not supported on TPU"
+    if condition is not None and join_type not in ("inner", "cross"):
+        return (f"conditional {join_type} join runs on CPU (residual "
+                "conditions are device-filtered for inner joins only)")
+    if condition is not None:
+        r = X.is_device_expr(condition, conf)
+        if r:
+            return r
+    for lk, rk in zip(left_keys, right_keys):
+        for e in (lk, rk):
+            dt = e.data_type
+            if isinstance(dt, T.DecimalType):
+                return "decimal join keys run on CPU"
+            if isinstance(dt, (T.ArrayType, T.MapType, T.StructType)):
+                return "nested join keys are not supported on TPU"
+            r = X.is_device_expr(e, conf)
+            if r:
+                return r
+        if type(lk.data_type) is not type(rk.data_type):
+            return (f"mismatched join key types {lk.data_type} vs "
+                    f"{rk.data_type} run on CPU")
+    return None
+
+
+class TpuShuffledHashJoinExec(TpuExec):
+    def __init__(self, left_keys: List[E.Expression],
+                 right_keys: List[E.Expression], join_type: str,
+                 condition: Optional[E.Expression], left: TpuExec,
+                 right: TpuExec, output: List[E.AttributeReference],
+                 conf: TpuConf):
+        super().__init__(conf)
+        self.children = [left, right]
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+        self.join_type = join_type
+        self.condition = condition
+        self._output = output
+
+    @property
+    def left(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def right(self) -> TpuExec:
+        return self.children[1]
+
+    @property
+    def output(self):
+        return self._output
+
+    def _pair_attrs(self):
+        return list(self.left.output) + list(self.right.output)
+
+    def _join_one(self, lbatches: List[DeviceBatch],
+                  rbatches: List[DeviceBatch]) -> Iterator[DeviceBatch]:
+        lschema = self.left.schema
+        rschema = self.right.schema
+        lwhole = (concat_device(lbatches) if len(lbatches) > 1 else
+                  lbatches[0] if lbatches else DeviceBatch.empty(lschema))
+        rwhole = (concat_device(rbatches) if len(rbatches) > 1 else
+                  rbatches[0] if rbatches else DeviceBatch.empty(rschema))
+        lk = P.bind_list(self.left_keys, self.left.output)
+        rk = P.bind_list(self.right_keys, self.right.output)
+        if self.join_type in MASK_JOINS:
+            out_schema = lschema
+        else:
+            out_schema = T.StructType(
+                [T.StructField(a.name, a.data_type, a.nullable)
+                 for a in self._pair_attrs()])
+        with self.metrics.timed(M.JOIN_TIME):
+            out = device_join(lwhole, rwhole, lk, rk, self.join_type,
+                              out_schema)
+            if self.condition is not None:
+                cond = E.bind_references(self.condition, self._pair_attrs())
+                out = X.run_filter(cond, out)
+        self.metrics.create(M.NUM_OUTPUT_ROWS, M.ESSENTIAL).add(
+            out.row_count())
+        # the exec's declared output may prune/reorder pair columns
+        if self.join_type not in MASK_JOINS:
+            out = self._project_output(out)
+        yield out
+
+    def _project_output(self, pair: DeviceBatch) -> DeviceBatch:
+        attrs = self._pair_attrs()
+        want = [a.expr_id for a in self._output]
+        have = {a.expr_id: i for i, a in enumerate(attrs)}
+        if want == [a.expr_id for a in attrs]:
+            return pair
+        cols = [pair.columns[have[w]] for w in want]
+        return DeviceBatch(self.schema, cols, pair.active, pair._num_rows)
+
+    def device_partitions(self) -> List[DevicePartitionThunk]:
+        lparts = device_channel(self.left)
+        rparts = device_channel(self.right)
+        assert len(lparts) == len(rparts), \
+            "join children must be co-partitioned"
+
+        def make(lt: DevicePartitionThunk, rt: DevicePartitionThunk
+                 ) -> DevicePartitionThunk:
+            def run() -> Iterator[DeviceBatch]:
+                lb = [b for b in lt() if b.row_count()]
+                rb = [b for b in rt() if b.row_count()]
+                yield from self._join_one(lb, rb)
+            return run
+        return [make(lt, rt) for lt, rt in zip(lparts, rparts)]
+
+    def simple_string(self):
+        return (f"TpuShuffledHashJoin {self.join_type} l={self.left_keys} "
+                f"r={self.right_keys} cond={self.condition!r}")
+
+
+class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
+    """Build side (right) materialized once in HBM and shared across all
+    stream partitions (GpuBroadcastHashJoinExec; the broadcast itself is
+    the device residency — no per-partition re-upload)."""
+
+    def device_partitions(self) -> List[DevicePartitionThunk]:
+        rbatches: List[DeviceBatch] = []
+        for t in device_channel(self.right):
+            rbatches.extend(b for b in t() if b.row_count())
+        # concat the build side ONCE; every stream partition reuses it
+        if len(rbatches) > 1:
+            rbatches = [concat_device(rbatches)]
+
+        def make(lt: DevicePartitionThunk) -> DevicePartitionThunk:
+            def run() -> Iterator[DeviceBatch]:
+                lb = [b for b in lt() if b.row_count()]
+                yield from self._join_one(lb, list(rbatches))
+            return run
+        return [make(lt) for lt in device_channel(self.left)]
+
+    def simple_string(self):
+        return (f"TpuBroadcastHashJoin {self.join_type} l={self.left_keys} "
+                f"r={self.right_keys} cond={self.condition!r}")

@@ -21,7 +21,9 @@ from __future__ import annotations
 
 import glob
 import os
+import re
 import threading
+from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Any, Dict, Iterator, List, Optional, Sequence
@@ -78,11 +80,6 @@ def list_files(paths: Sequence[str]) -> List[tuple]:
     return out
 
 
-def expand_paths(paths: Sequence[str]) -> List[str]:
-    """Directory/glob expansion (FilePartition listing role)."""
-    return [f for f, _ in list_files(paths)]
-
-
 def discovered_partition_fields(files: List[tuple]) -> List[T.StructField]:
     """Partition columns + value-inferred types (Spark's
     PartitioningUtils.inferPartitionColumnValue: int -> long -> double ->
@@ -101,22 +98,27 @@ def discovered_partition_fields(files: List[tuple]) -> List[T.StructField]:
     return fields
 
 
+_INT_RE = re.compile(r"-?\d+\Z")
+_FLOAT_RE = re.compile(r"-?(\d+\.\d*|\.\d+|\d+)([eE][-+]?\d+)?\Z")
+
+
 def _infer_part_type(raw: List[str]) -> T.DataType:
+    """Strict numeric parse (Long.parseLong/parseDouble shape): values
+    Python's int()/float() accept but Arrow's cast rejects ('1_0', '+5',
+    ' 7') must stay strings or the scan crashes casting later."""
     vals = [v for v in raw if v != HIVE_DEFAULT_PARTITION]
     if not vals:
         return T.StringT
-    try:
+    if all(_INT_RE.match(v) for v in vals):
         ints = [int(v) for v in vals]
         if all(-(1 << 31) <= i < (1 << 31) for i in ints):
             return T.IntegerT
-        return T.LongT
-    except ValueError:
-        pass
-    try:
-        [float(v) for v in vals]
-        return T.DoubleT
-    except ValueError:
+        if all(-(1 << 63) <= i < (1 << 63) for i in ints):
+            return T.LongT
         return T.StringT
+    if all(_FLOAT_RE.match(v) for v in vals):
+        return T.DoubleT
+    return T.StringT
 
 
 @dataclass
@@ -133,9 +135,10 @@ class ScanUnit:
 # Footer-parse results memoized per (fmt, file set), invalidated by the
 # files' stat signature, so re-planning the same DataFrame (every
 # collect()) doesn't re-read every parquet footer — the reference caches
-# its file index per relation. Keyed by path set (stat sig stored in the
-# value) so overwrites replace entries instead of accumulating.
-_UNITS_CACHE: Dict[tuple, tuple] = {}
+# its file index per relation. Bounded LRU so sessions reading many
+# distinct/growing datasets don't accumulate stale listings.
+_UNITS_CACHE: "OrderedDict[tuple, tuple]" = OrderedDict()
+_UNITS_CACHE_MAX = 64
 
 
 def plan_scan_units(fmt: str, files: List[tuple]) -> List[ScanUnit]:
@@ -145,6 +148,7 @@ def plan_scan_units(fmt: str, files: List[tuple]) -> List[ScanUnit]:
                 for f, pv in files)
     cached = _UNITS_CACHE.get(key)
     if cached is not None and cached[0] == sig:
+        _UNITS_CACHE.move_to_end(key)
         return cached[1]
     units: List[ScanUnit] = []
     if fmt == "parquet":
@@ -165,6 +169,8 @@ def plan_scan_units(fmt: str, files: List[tuple]) -> List[ScanUnit]:
         for f, pv in files:
             units.append(ScanUnit(f, os.path.getsize(f), part_values=pv))
     _UNITS_CACHE[key] = (sig, units)
+    if len(_UNITS_CACHE) > _UNITS_CACHE_MAX:
+        _UNITS_CACHE.popitem(last=False)
     return units
 
 
@@ -274,8 +280,9 @@ def _append_partition_columns(tbl, part_fields: List[T.StructField],
         if raw is None or raw == HIVE_DEFAULT_PARTITION:
             arr = pa.nulls(tbl.num_rows, type=at)
         else:
-            arr = pa.array([raw] * tbl.num_rows,
-                           type=pa.string()).cast(at)
+            # parse the value ONCE, then broadcast the scalar
+            scalar = pa.scalar(raw, type=pa.string()).cast(at)
+            arr = pa.repeat(scalar, tbl.num_rows)
         tbl = tbl.append_column(f.name, arr)
     return tbl
 

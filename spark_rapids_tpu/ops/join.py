@@ -1,0 +1,293 @@
+"""Device equi-join kernel: count-then-gather with static shapes.
+
+The reference joins on device through cudf hash joins + chunked gather
+maps (GpuHashJoin.scala:377, JoinGatherer.scala:55). A hash table is the
+wrong shape for XLA, so this kernel re-designs the same contract around
+the sort/segment machinery the groupby and sort kernels already use:
+
+1. **Key-id assignment**: concatenate the (evaluated) join-key columns of
+   both sides into one combined key set and run ``build_segments`` over
+   it — every row gets a dense key id, and two rows (either side) share
+   an id iff their keys are Spark-equal (NaN==NaN, -0.0==0.0, null
+   excluded from matching entirely by masking it out of ``active``).
+2. **Count phase** (one jitted program per structure): per-key right
+   counts via ``segment_sum``, per-left-row match counts, exclusive
+   offsets, the right side's key-grouped ordering, and the outer-join
+   extras — everything capacity-shaped. Two scalars (total pairs, extra
+   rows) sync to host to pick the output capacity bucket.
+3. **Gather phase** (one jitted program per (structure, out-capacity)):
+   output slot ``s`` finds its left row by ``searchsorted`` over the
+   offsets, its k-th match through the right ordering, and gathers both
+   sides with null rows for the outer sides — the gather-map idea, built
+   in one fused XLA program instead of cudf calls.
+
+Semi/anti joins never expand: they are pure mask updates on the left
+batch (m > 0 / m == 0), the cheapest possible form on this design.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.device import (AnyDeviceColumn, DeviceBatch,
+                                              DeviceColumn,
+                                              DeviceStringColumn,
+                                              bucket_capacity, make_column,
+                                              take_columns)
+from spark_rapids_tpu.ops import exprs as X
+from spark_rapids_tpu.ops import groupby as G
+from spark_rapids_tpu.sql import expressions as E
+from spark_rapids_tpu.sql import types as T
+
+_COUNT_CACHE: Dict[Tuple, Callable] = {}
+_GATHER_CACHE: Dict[Tuple, Callable] = {}
+_MASK_CACHE: Dict[Tuple, Callable] = {}
+
+# join types that expand to (left, right) pairs
+PAIR_JOINS = ("inner", "cross", "left", "leftouter", "right", "rightouter",
+              "full", "fullouter")
+MASK_JOINS = ("leftsemi", "leftanti")
+
+
+def _concat_key_columns(kl: Sequence[AnyDeviceColumn],
+                        kr: Sequence[AnyDeviceColumn]
+                        ) -> List[AnyDeviceColumn]:
+    """Stack left over right key columns (left rows first)."""
+    out: List[AnyDeviceColumn] = []
+    for a, b in zip(kl, kr):
+        if isinstance(a, DeviceStringColumn):
+            cc = max(a.char_cap, b.char_cap)
+            ac, bc = a.chars, b.chars
+            if a.char_cap < cc:
+                ac = jnp.pad(ac, ((0, 0), (0, cc - a.char_cap)))
+            if b.char_cap < cc:
+                bc = jnp.pad(bc, ((0, 0), (0, cc - b.char_cap)))
+            out.append(DeviceStringColumn(
+                a.dtype, jnp.concatenate([ac, bc]),
+                jnp.concatenate([a.lengths, b.lengths]),
+                jnp.concatenate([a.validity, b.validity])))
+        else:
+            out.append(DeviceColumn(
+                a.dtype, jnp.concatenate([a.data, b.data]),
+                jnp.concatenate([a.validity, b.validity])))
+    return out
+
+
+def _key_plan(lkeys: Sequence[E.Expression], rkeys: Sequence[E.Expression],
+              ctx_l: X.Ctx, ctx_r: X.Ctx, active_l, active_r):
+    """Shared by both phases: evaluate keys, assign dense key ids."""
+    kl = [X.dev_eval(e, ctx_l) for e in lkeys]
+    kr = [X.dev_eval(e, ctx_r) for e in rkeys]
+    valid_l = active_l
+    for c in kl:
+        valid_l = valid_l & c.validity
+    valid_r = active_r
+    for c in kr:
+        valid_r = valid_r & c.validity
+    cap_l = active_l.shape[0]
+    cap_r = active_r.shape[0]
+    cap_c = cap_l + cap_r
+    combined = _concat_key_columns(kl, kr)
+    valid_c = jnp.concatenate([valid_l, valid_r])
+    seg = G.build_segments(combined, valid_c)
+    ids = jnp.zeros(cap_c, dtype=jnp.int32).at[seg.order].set(seg.seg_ids)
+    ids_l, ids_r = ids[:cap_l], ids[cap_l:]
+    one = jnp.int32(1)
+    cnt_r = jax.ops.segment_sum(
+        jnp.where(valid_r, one, 0), jnp.clip(ids_r, 0, cap_c - 1),
+        num_segments=cap_c)
+    cnt_l = jax.ops.segment_sum(
+        jnp.where(valid_l, one, 0), jnp.clip(ids_l, 0, cap_c - 1),
+        num_segments=cap_c)
+    return kl, kr, valid_l, valid_r, ids_l, ids_r, cnt_l, cnt_r
+
+
+def _match_counts(valid_l, ids_l, cnt_r, cap_c):
+    """Per-left-row number of matching right rows (0 for null keys)."""
+    at = jnp.take(cnt_r, jnp.clip(ids_l, 0, cap_c - 1))
+    return jnp.where(valid_l, at, jnp.int32(0))
+
+
+def _build_count_fn(lkeys: Tuple[E.Expression, ...],
+                    rkeys: Tuple[E.Expression, ...],
+                    join_type: str) -> Callable:
+    left_outer = join_type in ("left", "leftouter", "full", "fullouter")
+    right_outer = join_type in ("right", "rightouter", "full", "fullouter")
+
+    def fn(cols_l, active_l, lits_l, cols_r, active_r, lits_r):
+        cap_l = active_l.shape[0]
+        cap_r = active_r.shape[0]
+        cap_c = cap_l + cap_r
+        ctx_l = X.Ctx(cols_l, cap_l, lkeys, lits_l)
+        ctx_r = X.Ctx(cols_r, cap_r, rkeys, lits_r)
+        (_kl, _kr, valid_l, valid_r, ids_l, ids_r, cnt_l, cnt_r
+         ) = _key_plan(lkeys, rkeys, ctx_l, ctx_r, active_l, active_r)
+        m = _match_counts(valid_l, ids_l, cnt_r, cap_c)
+        if left_outer:
+            m_eff = jnp.where(active_l, jnp.maximum(m, 1), 0)
+        else:
+            m_eff = m
+        m_eff = m_eff.astype(jnp.int64)
+        offsets = jnp.cumsum(m_eff) - m_eff  # exclusive
+        total_pairs = jnp.sum(m_eff)
+        # right side ordered by key id (invalid/missing keys to the tail)
+        key_r = jnp.where(valid_r, ids_r, jnp.int32(cap_c))
+        order_r = jnp.argsort(key_r, stable=True)
+        starts_r = jnp.cumsum(cnt_r) - cnt_r
+        if right_outer:
+            matched_r = valid_r & (
+                jnp.take(cnt_l, jnp.clip(ids_r, 0, cap_c - 1)) > 0)
+            extra_r = active_r & ~matched_r
+            n_extra = jnp.sum(extra_r.astype(jnp.int64))
+            pos = jnp.arange(cap_r, dtype=jnp.int32)
+            extra_order = jnp.argsort(
+                jnp.where(extra_r, pos, jnp.int32(cap_r)), stable=True)
+        else:
+            n_extra = jnp.int64(0)
+            extra_order = jnp.zeros(cap_r, dtype=jnp.int32)
+        return (total_pairs, n_extra, m, offsets, ids_l, order_r, starts_r,
+                extra_order)
+    return jax.jit(fn)
+
+
+def _build_gather_fn(out_cap: int, join_type: str) -> Callable:
+    right_outer = join_type in ("right", "rightouter", "full", "fullouter")
+
+    def fn(cols_l, cols_r, total_pairs, n_extra, m, offsets, ids_l,
+           order_r, starts_r):
+        cap_l = m.shape[0]
+        cap_r = order_r.shape[0]
+        cap_c = starts_r.shape[0]
+        s = jnp.arange(out_cap, dtype=jnp.int64)
+        li = jnp.clip(
+            jnp.searchsorted(offsets, s, side="right") - 1, 0, cap_l - 1
+        ).astype(jnp.int32)
+        k = s - jnp.take(offsets, li)
+        in_pairs = s < total_pairs
+        has_match = jnp.take(m, li) > 0
+        base = jnp.take(starts_r, jnp.clip(jnp.take(ids_l, li), 0,
+                                           cap_c - 1))
+        ri_matched = jnp.take(
+            order_r,
+            jnp.clip(base + k, 0, cap_r - 1).astype(jnp.int32))
+        left_valid = in_pairs
+        right_valid = in_pairs & has_match
+        ri = jnp.where(right_valid, ri_matched, 0).astype(jnp.int32)
+        active = in_pairs
+        out_l = take_columns(cols_l, jnp.where(left_valid, li, 0),
+                             valid_at=left_valid)
+        return out_l, take_columns(cols_r, ri, valid_at=right_valid), \
+            active, left_valid, right_valid
+
+    def fn_right(cols_l, cols_r, total_pairs, n_extra, m, offsets, ids_l,
+                 order_r, starts_r, extra_order):
+        out_l, out_r0, active, lv, rv = fn(
+            cols_l, cols_r, total_pairs, n_extra, m, offsets, ids_l,
+            order_r, starts_r)
+        cap_r = order_r.shape[0]
+        s = jnp.arange(out_cap, dtype=jnp.int64)
+        e = s - total_pairs
+        is_extra = (s >= total_pairs) & (e < n_extra)
+        ei = jnp.take(extra_order,
+                      jnp.clip(e, 0, cap_r - 1).astype(jnp.int32))
+        extra_cols = take_columns(cols_r, jnp.where(is_extra, ei, 0),
+                                  valid_at=is_extra)
+        # merge the pairs region with the extras region
+        merged: List[AnyDeviceColumn] = []
+        for a, b in zip(out_r0, extra_cols):
+            if isinstance(a, DeviceStringColumn):
+                merged.append(DeviceStringColumn(
+                    a.dtype,
+                    jnp.where(is_extra[:, None], b.chars, a.chars),
+                    jnp.where(is_extra, b.lengths, a.lengths),
+                    jnp.where(is_extra, b.validity, a.validity)))
+            else:
+                merged.append(DeviceColumn(
+                    a.dtype, jnp.where(is_extra, b.data, a.data),
+                    jnp.where(is_extra, b.validity, a.validity)))
+        active = active | is_extra
+        return out_l, merged, active, lv, rv | is_extra
+
+    return jax.jit(fn_right if right_outer else fn)
+
+
+def _build_mask_fn(lkeys: Tuple[E.Expression, ...],
+                   rkeys: Tuple[E.Expression, ...],
+                   join_type: str) -> Callable:
+    is_semi = join_type == "leftsemi"
+
+    def fn(cols_l, active_l, lits_l, cols_r, active_r, lits_r):
+        cap_l = active_l.shape[0]
+        cap_r = active_r.shape[0]
+        cap_c = cap_l + cap_r
+        ctx_l = X.Ctx(cols_l, cap_l, lkeys, lits_l)
+        ctx_r = X.Ctx(cols_r, cap_r, rkeys, lits_r)
+        (_kl, _kr, valid_l, _valid_r, ids_l, _ids_r, _cnt_l, cnt_r
+         ) = _key_plan(lkeys, rkeys, ctx_l, ctx_r, active_l, active_r)
+        m = _match_counts(valid_l, ids_l, cnt_r, cap_c)
+        if is_semi:
+            return active_l & (m > 0)
+        return active_l & (m == 0)
+    return jax.jit(fn)
+
+
+def device_join(left: DeviceBatch, right: DeviceBatch,
+                lkeys: List[E.Expression], rkeys: List[E.Expression],
+                join_type: str,
+                out_schema: T.StructType) -> DeviceBatch:
+    """Run the equi-join of two device batches; keys are pre-bound device
+    expressions. Returns the joined batch (pair layout: left columns then
+    right columns) or, for semi/anti, the masked left batch."""
+    lk = tuple(lkeys)
+    rk = tuple(rkeys)
+    struct = (tuple(X.expr_key(e) for e in lk),
+              tuple(X.expr_key(e) for e in rk))
+    lits_l = X.literal_values(list(lk))
+    lits_r = X.literal_values(list(rk))
+
+    if join_type in MASK_JOINS:
+        key = (struct, join_type)
+        fn = _MASK_CACHE.get(key)
+        if fn is None:
+            fn = _build_mask_fn(lk, rk, join_type)
+            _MASK_CACHE[key] = fn
+        new_active = fn(left.columns, left.active, lits_l,
+                        right.columns, right.active, lits_r)
+        return DeviceBatch(left.schema, left.columns, new_active, None)
+
+    if join_type not in PAIR_JOINS:
+        raise X.DeviceUnsupported(f"join type {join_type}")
+
+    ckey = (struct, join_type)
+    count_fn = _COUNT_CACHE.get(ckey)
+    if count_fn is None:
+        count_fn = _build_count_fn(lk, rk, join_type)
+        _COUNT_CACHE[ckey] = count_fn
+    (total_pairs, n_extra, m, offsets, ids_l, order_r, starts_r,
+     extra_order) = count_fn(left.columns, left.active, lits_l,
+                             right.columns, right.active, lits_r)
+    total = int(total_pairs) + int(n_extra)  # ONE host sync for sizing
+    out_cap = bucket_capacity(max(1, total))
+
+    shapes = (tuple((a.shape, str(a.dtype))
+                    for c in left.columns for a in c.arrays()),
+              tuple((a.shape, str(a.dtype))
+                    for c in right.columns for a in c.arrays()))
+    gkey = (shapes, out_cap, join_type, m.shape, order_r.shape,
+            starts_r.shape)
+    gather_fn = _GATHER_CACHE.get(gkey)
+    if gather_fn is None:
+        gather_fn = _build_gather_fn(out_cap, join_type)
+        _GATHER_CACHE[gkey] = gather_fn
+    if join_type in ("right", "rightouter", "full", "fullouter"):
+        out_l, out_r, active, _lv, _rv = gather_fn(
+            left.columns, right.columns, total_pairs, n_extra, m, offsets,
+            ids_l, order_r, starts_r, extra_order)
+    else:
+        out_l, out_r, active, _lv, _rv = gather_fn(
+            left.columns, right.columns, total_pairs, n_extra, m, offsets,
+            ids_l, order_r, starts_r)
+    return DeviceBatch(out_schema, list(out_l) + list(out_r), active, total)

@@ -277,6 +277,15 @@ def _tag_sort(meta: ExecMeta) -> None:
         meta.will_not_work(r)
 
 
+def _tag_join(meta: ExecMeta) -> None:
+    from spark_rapids_tpu.exec.join import is_device_join
+    w = meta.wrapped
+    r = is_device_join(w.join_type, w.left_keys, w.right_keys, w.condition,
+                       meta.conf)
+    if r:
+        meta.will_not_work(r)
+
+
 def _tag_aggregate(meta: ExecMeta) -> None:
     from spark_rapids_tpu.exec.agg import is_device_agg
     node = meta.wrapped
@@ -360,6 +369,22 @@ def _conv_sort(meta, kids):
     return TpuSortExec(w.order, w.is_global, kids[0], meta.conf)
 
 
+def _conv_shuffled_join(meta, kids):
+    from spark_rapids_tpu.exec.join import TpuShuffledHashJoinExec
+    w = meta.wrapped
+    return TpuShuffledHashJoinExec(w.left_keys, w.right_keys, w.join_type,
+                                   w.condition, kids[0], kids[1], w.output,
+                                   meta.conf)
+
+
+def _conv_broadcast_join(meta, kids):
+    from spark_rapids_tpu.exec.join import TpuBroadcastHashJoinExec
+    w = meta.wrapped
+    return TpuBroadcastHashJoinExec(w.left_keys, w.right_keys, w.join_type,
+                                    w.condition, kids[0], kids[1], w.output,
+                                    meta.conf)
+
+
 exec_rule(P.CpuProjectExec, "projection onto device columns",
           tag_fn=_tag_project, convert_fn=_conv_project)
 exec_rule(P.CpuFilterExec, "device predicate filter (mask update)",
@@ -378,6 +403,11 @@ exec_rule(P.CpuHashAggregateExec, "sort-segmented device aggregation",
           tag_fn=_tag_aggregate, convert_fn=_conv_aggregate)
 exec_rule(P.CpuSortExec, "device lexsort over encoded sort keys",
           tag_fn=_tag_sort, convert_fn=_conv_sort)
+exec_rule(P.CpuShuffledHashJoinExec, "count-then-gather device equi-join",
+          tag_fn=_tag_join, convert_fn=_conv_shuffled_join)
+exec_rule(P.CpuBroadcastHashJoinExec,
+          "device equi-join with HBM-resident build side",
+          tag_fn=_tag_join, convert_fn=_conv_broadcast_join)
 register_transparent_cpu(P.CpuLocalScanExec)
 
 from spark_rapids_tpu.io.readers import CpuFileScanExec  # noqa: E402

@@ -883,6 +883,9 @@ def _gather_pair(lwhole: HostBatch, rwhole: HostBatch, li: np.ndarray,
 
 
 def _gather_nullable(c: HostColumn, idx: np.ndarray) -> HostColumn:
+    if len(c.data) == 0:
+        # empty side of an outer join: every gathered row is a null row
+        return HostColumn.nulls(len(idx), c.dtype)
     safe = np.where(idx >= 0, idx, 0)
     data = c.data[safe]
     validity = np.where(idx >= 0, c.validity[safe], False)

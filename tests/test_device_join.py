@@ -1,0 +1,144 @@
+"""Device join tests through the dual-session harness (GpuHashJoin
+coverage; reference integration pattern: integration_tests join_test.py).
+Covers broadcast + shuffled paths, all join types, null keys, duplicate
+keys, string/float/multi keys, residual conditions, and self-joins.
+The right side is .repartition()-ed to force the shuffled path (the
+planner broadcasts small LocalRelations otherwise).
+"""
+
+import pytest
+
+from spark_rapids_tpu.sql import functions as F
+
+from tests.datagen import (DoubleGen, IntegerGen, KeyStringGen, LongGen,
+                           SmallIntGen, StringGen, gen_batch)
+from tests.harness import (assert_tpu_and_cpu_equal_collect,
+                           assert_tpu_fallback_collect)
+
+ALL_JOINS = ["inner", "left", "right", "full", "leftsemi", "leftanti"]
+
+
+def _pair(spark, kgen, n=300, parts=2, seed=3):
+    left = spark.createDataFrame(
+        gen_batch([("k", kgen), ("a", IntegerGen())], n, seed),
+        num_partitions=parts)
+    right = spark.createDataFrame(
+        gen_batch([("k2", kgen), ("b", LongGen())], n // 2, seed + 1),
+        num_partitions=parts)
+    return left, right
+
+
+@pytest.mark.parametrize("jt", ALL_JOINS)
+def test_broadcast_join_int_keys(jt):
+    # the planner only broadcasts build-right-able join types; right/full
+    # plan as shuffled joins (same as Spark's BuildSide constraint)
+    expected = ("TpuBroadcastHashJoin"
+                if jt in ("inner", "left", "leftsemi", "leftanti")
+                else "TpuShuffledHashJoin")
+
+    def fn(s):
+        l, r = _pair(s, SmallIntGen())
+        return l.join(r, l["k"] == r["k2"], jt)
+    assert_tpu_and_cpu_equal_collect(fn, expect_execs=[expected])
+
+
+@pytest.mark.parametrize("jt", ALL_JOINS)
+def test_shuffled_join_int_keys(jt):
+    def fn(s):
+        l, r = _pair(s, SmallIntGen())
+        return l.join(r.repartition(3), l["k"] == r["k2"], jt)
+    assert_tpu_and_cpu_equal_collect(
+        fn, expect_execs=["TpuShuffledHashJoin"])
+
+
+@pytest.mark.parametrize("kgen", [KeyStringGen(), DoubleGen(), LongGen()],
+                         ids=["string", "double", "long"])
+def test_join_key_types(kgen):
+    def fn(s):
+        l, r = _pair(s, kgen)
+        return l.join(r, l["k"] == r["k2"], "inner")
+    assert_tpu_and_cpu_equal_collect(
+        fn, expect_execs=["TpuBroadcastHashJoin"])
+
+
+def test_join_multi_key():
+    def fn(s):
+        l = s.createDataFrame(
+            gen_batch([("k1", SmallIntGen()), ("k2", KeyStringGen()),
+                       ("a", IntegerGen())], 400, 5), num_partitions=2)
+        r = s.createDataFrame(
+            gen_batch([("j1", SmallIntGen()), ("j2", KeyStringGen()),
+                       ("b", LongGen())], 200, 6), num_partitions=2)
+        return l.join(r, (l["k1"] == r["j1"]) & (l["k2"] == r["j2"]),
+                      "left")
+    assert_tpu_and_cpu_equal_collect(
+        fn, expect_execs=["TpuBroadcastHashJoin"])
+
+
+def test_join_inner_with_condition():
+    def fn(s):
+        l, r = _pair(s, SmallIntGen())
+        return l.join(r, (l["k"] == r["k2"]) & (l["a"] > r["b"]), "inner")
+    assert_tpu_and_cpu_equal_collect(
+        fn, expect_execs=["TpuBroadcastHashJoin"])
+
+
+def test_conditional_outer_join_falls_back():
+    def fn(s):
+        l, r = _pair(s, SmallIntGen())
+        return l.join(r, (l["k"] == r["k2"]) & (l["a"] > r["b"]), "left")
+    assert_tpu_fallback_collect(fn, fallback_exec="CpuBroadcastHashJoinExec")
+
+
+def test_self_join():
+    def fn(s):
+        df = s.createDataFrame(
+            gen_batch([("k", SmallIntGen()), ("v", IntegerGen())], 150, 9),
+            num_partitions=2)
+        other = df.select(F.col("k").alias("k2"),
+                          F.col("v").alias("v2"))
+        return df.join(other, F.col("k") == F.col("k2"), "inner")
+    assert_tpu_and_cpu_equal_collect(
+        fn, expect_execs=["TpuShuffledHashJoin"])
+
+
+def test_join_all_null_keys():
+    def fn(s):
+        l = s.createDataFrame({"k": [None, None, 1], "a": [1, 2, 3]},
+                              "k int, a int")
+        r = s.createDataFrame({"k2": [None, 1], "b": [10, 20]},
+                              "k2 int, b int")
+        return l.join(r, F.col("k") == F.col("k2"), "full")
+    assert_tpu_and_cpu_equal_collect(
+        fn, expect_execs=["TpuShuffledHashJoin"])
+
+
+def test_join_empty_sides():
+    def fn(s):
+        l = s.createDataFrame({"k": [], "a": []}, "k int, a int")
+        r = s.createDataFrame({"k2": [1, 2], "b": [10, 20]},
+                              "k2 int, b int")
+        return l.join(r, F.col("k") == F.col("k2"), "right")
+    assert_tpu_and_cpu_equal_collect(fn, require_device=False)
+
+
+def test_join_duplicate_heavy_keys():
+    """Many-to-many expansion: every left row matches many right rows."""
+    def fn(s):
+        l = s.createDataFrame({"k": [1] * 40 + [2] * 20,
+                               "a": list(range(60))}, "k int, a int")
+        r = s.createDataFrame({"k2": [1] * 15 + [2] * 25,
+                               "b": list(range(40))}, "k2 int, b int")
+        return l.join(r, F.col("k") == F.col("k2"), "inner")
+    assert_tpu_and_cpu_equal_collect(
+        fn, expect_execs=["TpuBroadcastHashJoin"])
+
+
+def test_join_then_agg_pipeline_on_device():
+    def fn(s):
+        l, r = _pair(s, SmallIntGen(), n=500)
+        return (l.join(r, l["k"] == r["k2"], "inner")
+                .groupBy("k").agg(F.count("*").alias("c"),
+                                  F.sum("b").alias("sb")))
+    assert_tpu_and_cpu_equal_collect(
+        fn, expect_execs=["TpuBroadcastHashJoin", "TpuHashAggregate"])

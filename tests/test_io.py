@@ -342,3 +342,21 @@ def test_csv_mismatch_keeps_null_value_option(tmpdir_path):
         assert [(r.a, r.b) for r in got] == [(1, None), (None, None)]
     finally:
         spark.stop()
+
+
+def test_partition_value_not_loosely_numeric(tmpdir_path):
+    """'1_0' parses with Python int() but not Arrow's cast — must stay a
+    string column (Spark's strict Long.parseLong shape)."""
+    p = os.path.join(tmpdir_path, "loose")
+    spark = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        spark.createDataFrame(
+            {"tag": ["1_0", "2_5"], "v": [1.0, 2.0]},
+            "tag string, v double").write.partitionBy("tag").parquet(p)
+        back = spark.read.parquet(p)
+        sch = {f.name: f.data_type for f in back.plan.schema.fields}
+        assert isinstance(sch["tag"], T.StringType)
+        assert {(r.tag, r.v) for r in back.collect()} == {
+            ("1_0", 1.0), ("2_5", 2.0)}
+    finally:
+        spark.stop()
