@@ -1,0 +1,611 @@
+"""TpuWindowExec: device window functions (GpuWindowExec.scala:187 twin).
+
+One fused jitted program per (expression structure, capacity bucket):
+sort rows by (partition keys, order keys) with the existing subkey
+encodings, derive partition/peer boundary flags, and compute every window
+expression with segment ops + prefix scans — the batched-running-window
+idea of the reference (GpuWindowExec's GpuRunningWindowExec path)
+generalized to the whole supported frame set:
+
+- ranking: row_number / rank / dense_rank / ntile from boundary flags
+- offset: lag / lead as shifted gathers inside the partition
+- aggregates sum/count/avg/min/max/first/last over
+  - the whole partition (segment ops, broadcast back),
+  - running frames (prefix scans; RANGE frames take the value at the
+    last peer row — Spark's default frame),
+  - bounded ROWS frames for sum/count/avg (prefix differences).
+
+Running min/max uses a segmented associative scan over (partition id,
+total-order rank, winner position) so values round-trip bit-exactly.
+Results are scattered back to ORIGINAL row order (the exec appends
+columns without permuting its input, matching CpuWindowExec).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import metrics as M
+from spark_rapids_tpu.columnar.device import (AnyDeviceColumn, DeviceBatch,
+                                              DeviceColumn,
+                                              DeviceStringColumn,
+                                              concat_device, make_column,
+                                              storage_jnp_dtype)
+from spark_rapids_tpu.conf import TpuConf
+from spark_rapids_tpu.exec.base import (DevicePartitionThunk, TpuExec,
+                                        device_channel)
+from spark_rapids_tpu.ops import exprs as X
+from spark_rapids_tpu.ops import groupby as G
+from spark_rapids_tpu.ops import sort as S
+from spark_rapids_tpu.sql import expressions as E
+from spark_rapids_tpu.sql import physical as P
+from spark_rapids_tpu.sql import types as T
+
+_WINDOW_FN_CACHE: Dict[Tuple, Callable] = {}
+
+_U64_MAX = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def is_device_window(window_exprs: List[E.Expression],
+                     partition_spec: List[E.Expression],
+                     order_spec: List[E.SortOrder],
+                     conf: TpuConf) -> Optional[str]:
+    """Tagging helper (GpuWindowExpression tagging rules)."""
+    for e in partition_spec:
+        dt = e.data_type
+        if isinstance(dt, (T.DecimalType, T.ArrayType, T.MapType,
+                           T.StructType)):
+            return f"window partition key type {dt} runs on CPU"
+        r = X.is_device_expr(e, conf)
+        if r:
+            return r
+    for o in order_spec:
+        dt = o.child.data_type
+        if isinstance(dt, (T.DecimalType, T.ArrayType, T.MapType,
+                           T.StructType)):
+            return f"window order key type {dt} runs on CPU"
+        r = X.is_device_expr(o.child, conf)
+        if r:
+            return r
+    for alias in window_exprs:
+        wx = alias.child if isinstance(alias, E.Alias) else alias
+        if not isinstance(wx, E.WindowExpression):
+            return f"{type(wx).__name__} is not a window expression"
+        func = wx.func
+        frame = wx.frame
+        if isinstance(func, (E.RowNumber, E.Rank, E.DenseRank, E.NTile)):
+            continue
+        if isinstance(func, E.Lag):  # covers Lead
+            r = X.is_device_expr(func.input, conf)
+            if r:
+                return r
+            if func.default is not None:
+                r = X.is_device_expr(func.default, conf)
+                if r:
+                    return r
+                in_str = isinstance(func.input.data_type,
+                                    (T.StringType, T.BinaryType))
+                df_str = isinstance(func.default.data_type,
+                                    (T.StringType, T.BinaryType))
+                if in_str != df_str:
+                    return ("lag/lead default type is incompatible with "
+                            "the input type; runs on CPU")
+            continue
+        if isinstance(func, E.AggregateExpression):
+            agg = func.func
+            if func.is_distinct:
+                return "DISTINCT window aggregates are not supported"
+            if not isinstance(agg, (E.Sum, E.Count, E.Min, E.Max,
+                                    E.Average, E.First, E.Last)):
+                return (f"window aggregate {type(agg).__name__} has no "
+                        "device implementation")
+            if agg.children:
+                from spark_rapids_tpu import device_caps as DC
+                from spark_rapids_tpu.conf import ENABLE_FLOAT_AGG
+                src = agg.children[0]
+                if isinstance(src.data_type, (T.StringType, T.BinaryType,
+                                              T.DecimalType)):
+                    return (f"window aggregate over {src.data_type} "
+                            "runs on CPU")
+                float_ok = bool(conf.get(ENABLE_FLOAT_AGG))
+                if isinstance(agg, (E.Sum, E.Average)) \
+                        and T.is_floating(src.data_type) and not float_ok:
+                    return ("device float window sum/average may differ "
+                            "from CPU due to addition ordering "
+                            "(spark.rapids.sql.variableFloatAgg.enabled"
+                            "=false)")
+                if isinstance(agg, E.Average) and not DC.float_div_exact()\
+                        and not float_ok:
+                    return ("device Average division is not bit-identical "
+                            "to CPU on this backend; set spark.rapids.sql."
+                            "variableFloatAgg.enabled=true to allow")
+                r = X.is_device_expr(src, conf)
+                if r:
+                    return r
+            bounded = not (frame.is_unbounded_whole or frame.is_running)
+            if bounded and not isinstance(agg, (E.Sum, E.Count, E.Average)):
+                return (f"bounded {frame.frame_type} frames are device-"
+                        "supported for sum/count/avg only")
+            continue
+        return f"window function {type(func).__name__} is not supported"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Kernel pieces (all operate in SORTED row space)
+# ---------------------------------------------------------------------------
+
+def _seg_running_extreme(part_id: jax.Array, rank: jax.Array,
+                         valid: jax.Array, is_min: bool
+                         ) -> Tuple[jax.Array, jax.Array]:
+    """Segmented running min/max over the total-order rank encoding.
+    Returns (winner position per row, has-winner flag)."""
+    cap = part_id.shape[0]
+    sentinel = _U64_MAX if is_min else jnp.uint64(0)
+    r = jnp.where(valid, rank, sentinel)
+    pos = jnp.arange(cap, dtype=jnp.int32)
+
+    def combine(a, b):
+        a_id, a_r, a_p = a
+        b_id, b_r, b_p = b
+        same = b_id == a_id
+        if is_min:
+            better = a_r < b_r
+        else:
+            better = a_r > b_r
+        take_a = same & better
+        return (b_id,
+                jnp.where(take_a, a_r, b_r),
+                jnp.where(take_a, a_p, b_p))
+
+    _ids, best_r, best_p = jax.lax.associative_scan(
+        combine, (part_id, r, pos))
+    return best_p, best_r != sentinel
+
+
+def _prefix_in_part(x: jax.Array, start_of_row: jax.Array) -> jax.Array:
+    """Inclusive prefix sum restarting at each partition boundary.
+    ``start_of_row[i]`` is the sorted position where row i's partition
+    begins."""
+    prefix = jnp.cumsum(x)
+    base = jnp.where(start_of_row > 0,
+                     jnp.take(prefix, jnp.maximum(start_of_row - 1, 0)),
+                     jnp.zeros((), x.dtype))
+    return prefix - base
+
+
+class _SortedLayout:
+    """Everything the per-function kernels need, in sorted row space."""
+
+    def __init__(self, perm, active_s, part_id, peer_id, pos, start_of_row,
+                 end_of_row, peer_last, new_peer, part_size):
+        self.perm = perm              # sorted pos -> original row
+        self.active_s = active_s
+        self.part_id = part_id
+        self.peer_id = peer_id
+        self.pos = pos
+        self.start_of_row = start_of_row  # partition start pos, per row
+        self.end_of_row = end_of_row      # partition end pos (incl)
+        self.peer_last = peer_last        # last pos of row's peer group
+        self.new_peer = new_peer
+        self.part_size = part_size        # rows in row's partition
+
+
+def _layout(part_keys: List[AnyDeviceColumn],
+            order_specs: List[E.SortOrder],
+            order_keys: List[AnyDeviceColumn],
+            active: jax.Array) -> _SortedLayout:
+    cap = active.shape[0]
+    part_subkeys: List[jax.Array] = []
+    for c in part_keys:
+        part_subkeys.extend(G.grouping_subkeys(c))
+    order_subkeys: List[jax.Array] = []
+    for c, o in zip(order_keys, order_specs):
+        order_subkeys.extend(S.order_subkeys(c, o.ascending, o.nulls_first))
+    # significance: active first, then partition keys, then order keys
+    all_keys = part_subkeys + order_subkeys
+    perm = jnp.lexsort(tuple(reversed(all_keys)) + (~active,))
+    active_s = active[perm]
+    pos = jnp.arange(cap, dtype=jnp.int32)
+
+    def boundaries(keys: List[jax.Array]) -> jax.Array:
+        new = jnp.zeros(cap, dtype=bool).at[0].set(True)
+        for k in keys:
+            ks = k[perm]
+            d = ks[1:] != ks[:-1]
+            if d.ndim == 2:
+                d = d.any(axis=1)
+            new = new.at[1:].set(new[1:] | d)
+        return new.at[1:].set(new[1:] | (active_s[1:] != active_s[:-1]))
+
+    new_part = boundaries(part_subkeys)
+    new_peer = new_part | boundaries(part_subkeys + order_subkeys)
+    part_id = jnp.cumsum(new_part.astype(jnp.int32)) - 1
+    peer_id = jnp.cumsum(new_peer.astype(jnp.int32)) - 1
+    part_start = jax.ops.segment_min(pos, part_id, num_segments=cap,
+                                     indices_are_sorted=True)
+    part_end = jax.ops.segment_max(pos, part_id, num_segments=cap,
+                                   indices_are_sorted=True)
+    peer_end = jax.ops.segment_max(pos, peer_id, num_segments=cap,
+                                   indices_are_sorted=True)
+    start_of_row = jnp.take(part_start, part_id)
+    end_of_row = jnp.take(part_end, part_id)
+    peer_last = jnp.take(peer_end, peer_id)
+    part_size = end_of_row - start_of_row + 1
+    return _SortedLayout(perm, active_s, part_id, peer_id, pos,
+                         start_of_row, end_of_row, peer_last, new_peer,
+                         part_size)
+
+
+def _ranking(func, lay: _SortedLayout) -> Tuple[jax.Array, jax.Array]:
+    """(data int32, validity) in sorted space."""
+    if isinstance(func, E.RowNumber):
+        return (lay.pos - lay.start_of_row + 1).astype(jnp.int32), \
+            lay.active_s
+    if isinstance(func, E.Rank):
+        peer_first = jax.ops.segment_min(
+            lay.pos, lay.peer_id, num_segments=lay.pos.shape[0],
+            indices_are_sorted=True)
+        first = jnp.take(peer_first, lay.peer_id)
+        return (first - lay.start_of_row + 1).astype(jnp.int32), \
+            lay.active_s
+    if isinstance(func, E.DenseRank):
+        prefix = jnp.cumsum(lay.new_peer.astype(jnp.int32))
+        base = jnp.take(prefix, lay.start_of_row)
+        return (prefix - base + 1).astype(jnp.int32), lay.active_s
+    if isinstance(func, E.NTile):
+        k = func.n
+        m = lay.part_size
+        p = lay.pos - lay.start_of_row
+        base = m // k
+        rem = m % k
+        big = rem * (base + 1)
+        tile = jnp.where(
+            p < big,
+            p // jnp.maximum(base + 1, 1),
+            rem + (p - big) // jnp.maximum(base, 1))
+        return (tile + 1).astype(jnp.int32), lay.active_s
+    raise X.DeviceUnsupported(type(func).__name__)
+
+
+def _offset_fn(func: E.Lag, val: AnyDeviceColumn, default_val,
+               lay: _SortedLayout):
+    """lag/lead as a shifted gather inside the partition."""
+    cap = lay.pos.shape[0]
+    off = func.offset if not isinstance(func, E.Lead) else -func.offset
+    src = lay.pos - off
+    ok = (src >= lay.start_of_row) & (src <= lay.end_of_row) & lay.active_s
+    safe = jnp.clip(src, 0, cap - 1)
+    src_orig = jnp.take(lay.perm, safe)  # gather from ORIGINAL rows
+    if isinstance(val, DeviceStringColumn):
+        chars = val.chars[src_orig]
+        lengths = val.lengths[src_orig]
+        validity = val.validity[src_orig] & ok
+        if default_val is not None:
+            dchars, dlengths, dvalid = default_val
+            cc = max(chars.shape[1], dchars.shape[1])
+            if chars.shape[1] < cc:
+                chars = jnp.pad(chars, ((0, 0), (0, cc - chars.shape[1])))
+            if dchars.shape[1] < cc:
+                dchars = jnp.pad(dchars,
+                                 ((0, 0), (0, cc - dchars.shape[1])))
+            chars = jnp.where(ok[:, None], chars, dchars)
+            lengths = jnp.where(ok, lengths, dlengths)
+            validity = jnp.where(ok, validity, dvalid & lay.active_s)
+        chars = jnp.where(validity[:, None], chars, 0)
+        lengths = jnp.where(validity, lengths, 0)
+        return (chars, lengths), validity
+    data = val.data[src_orig]
+    validity = val.validity[src_orig] & ok
+    if default_val is not None:
+        dflt_data, dflt_valid = default_val
+        data = jnp.where(ok, data, dflt_data)
+        validity = jnp.where(ok, validity, dflt_valid & lay.active_s)
+    data = jnp.where(validity, data, jnp.zeros((), data.dtype))
+    return (data,), validity
+
+
+def _to_orig(perm: jax.Array, arr: jax.Array) -> jax.Array:
+    """Scatter a sorted-space result back to original row order."""
+    return jnp.zeros_like(arr).at[perm].set(arr)
+
+
+def _winner_value(val: DeviceColumn, lay: _SortedLayout,
+                  win_pos: jax.Array, has: jax.Array
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Gather the value at sorted position ``win_pos`` (per sorted row)."""
+    cap = lay.pos.shape[0]
+    orig = jnp.take(lay.perm, jnp.clip(win_pos, 0, cap - 1))
+    data = jnp.take(val.data, orig)
+    validity = has & lay.active_s
+    data = jnp.where(validity, data, jnp.zeros((), data.dtype))
+    return data, validity
+
+
+def _agg_window(agg: E.AggregateFunction, frame: E.WindowFrame,
+                val: Optional[DeviceColumn], lay: _SortedLayout,
+                out_type: T.DataType) -> Tuple[jax.Array, jax.Array]:
+    """(data, validity) in sorted space for one windowed aggregate."""
+    cap = lay.pos.shape[0]
+    if val is not None:
+        data_s = jnp.take(val.data, lay.perm)
+        valid_s = jnp.take(val.validity, lay.perm) & lay.active_s
+    else:  # Count(*) — every active row counts
+        data_s = jnp.ones(cap, dtype=jnp.int64)
+        valid_s = lay.active_s
+    ones = jnp.where(valid_s, jnp.int64(1), jnp.int64(0))
+
+    def running(x):
+        """Inclusive running value; RANGE frames read the peer-group end."""
+        pp = _prefix_in_part(x, lay.start_of_row)
+        if frame.frame_type == "range":
+            return jnp.take(pp, lay.peer_last)
+        return pp
+
+    def whole(x):
+        s = jax.ops.segment_sum(x, lay.part_id, num_segments=cap,
+                                indices_are_sorted=True)
+        return jnp.take(s, lay.part_id)
+
+    def bounded(x):
+        pp = _prefix_in_part(x, lay.start_of_row)
+        lower = frame.lower
+        upper = frame.upper
+        lo = (lay.start_of_row if lower is None
+              else jnp.maximum(lay.pos + lower, lay.start_of_row))
+        hi = (lay.end_of_row if upper is None
+              else jnp.minimum(lay.pos + upper, lay.end_of_row))
+        nonempty = hi >= lo
+        hi_v = jnp.take(pp, jnp.clip(hi, 0, cap - 1))
+        lo_base = jnp.where(
+            lo > lay.start_of_row,
+            jnp.take(pp, jnp.clip(lo - 1, 0, cap - 1)),
+            jnp.zeros((), x.dtype))
+        return jnp.where(nonempty, hi_v - lo_base, jnp.zeros((), x.dtype))
+
+    if frame.is_unbounded_whole:
+        scan = whole
+    elif frame.is_running:
+        scan = running
+    else:
+        scan = bounded
+
+    if isinstance(agg, E.Count):
+        return scan(ones), lay.active_s
+
+    if isinstance(agg, (E.Sum, E.Average)):
+        acc_dt = (jnp.float64 if isinstance(agg, E.Average)
+                  else storage_jnp_dtype(out_type))
+        x = jnp.where(valid_s, data_s.astype(acc_dt),
+                      jnp.zeros((), acc_dt))
+        cnt = scan(ones)
+        s = scan(x)
+        validity = (cnt > 0) & lay.active_s
+        if isinstance(agg, E.Average):
+            d = s / jnp.maximum(cnt, 1).astype(jnp.float64)
+        else:
+            d = s
+        return jnp.where(validity, d, jnp.zeros((), d.dtype)), validity
+
+    if isinstance(agg, (E.Min, E.Max)):
+        is_min = isinstance(agg, E.Min)
+        rank = G.rank_u64(DeviceColumn(val.dtype, data_s, valid_s))
+        if frame.is_unbounded_whole:
+            sentinel = _U64_MAX if is_min else jnp.uint64(0)
+            r = jnp.where(valid_s, rank, sentinel)
+            seg_op = jax.ops.segment_min if is_min else jax.ops.segment_max
+            best = jnp.take(
+                seg_op(r, lay.part_id, num_segments=cap,
+                       indices_are_sorted=True), lay.part_id)
+            is_winner = valid_s & (r == best)
+            cand = jnp.where(is_winner, lay.pos, jnp.int32(cap))
+            win = jnp.take(
+                jax.ops.segment_min(cand, lay.part_id, num_segments=cap,
+                                    indices_are_sorted=True), lay.part_id)
+            has = (win < cap)
+        else:  # running
+            win, has = _seg_running_extreme(lay.part_id, rank, valid_s,
+                                            is_min)
+            if frame.frame_type == "range":
+                win = jnp.take(win, lay.peer_last)
+                has = jnp.take(has, lay.peer_last)
+        return _winner_value(val, lay, win, has)
+
+    if isinstance(agg, (E.First, E.Last)):
+        is_first = isinstance(agg, E.First)
+        if not agg.ignore_nulls:
+            if frame.is_unbounded_whole:
+                tgt = lay.start_of_row if is_first else lay.end_of_row
+            elif is_first:
+                tgt = lay.start_of_row
+            else:  # running last row = current row / last peer
+                tgt = (lay.peer_last if frame.frame_type == "range"
+                       else lay.pos)
+            orig = jnp.take(lay.perm, tgt)
+            d = jnp.take(val.data, orig)
+            v = jnp.take(val.validity, orig) & lay.active_s
+            return jnp.where(v, d, jnp.zeros((), d.dtype)), v
+        # ignore_nulls: running min/max over the position of valid rows
+        posrank = (lay.pos + 1).astype(jnp.uint64)
+        if frame.is_unbounded_whole:
+            cand = jnp.where(valid_s, lay.pos,
+                             jnp.int32(cap) if is_first else jnp.int32(-1))
+            seg_op = jax.ops.segment_min if is_first else jax.ops.segment_max
+            win = jnp.take(
+                seg_op(cand, lay.part_id, num_segments=cap,
+                       indices_are_sorted=True), lay.part_id)
+            has = (win < cap) & (win >= 0)
+            win = jnp.clip(win, 0, cap - 1)
+        else:
+            win, has = _seg_running_extreme(lay.part_id, posrank, valid_s,
+                                            is_first)
+            if frame.frame_type == "range":
+                win = jnp.take(win, lay.peer_last)
+                has = jnp.take(has, lay.peer_last)
+        return _winner_value(val, lay, win, has)
+
+    raise X.DeviceUnsupported(type(agg).__name__)
+
+
+# ---------------------------------------------------------------------------
+# Program builder + exec
+# ---------------------------------------------------------------------------
+
+def _build_window_fn(part_bound: Tuple[E.Expression, ...],
+                     order_specs: Tuple[E.SortOrder, ...],
+                     order_bound: Tuple[E.Expression, ...],
+                     items: Tuple[Tuple, ...],
+                     all_exprs: Tuple[E.Expression, ...]) -> Callable:
+    """items: ("rank", func) | ("offset", func, src_i, default_i|None)
+    | ("agg", agg_func, frame, src_i|None, out_type)."""
+
+    def fn(cols, active, lit_vals):
+        cap = active.shape[0]
+        ctx = X.Ctx(cols, cap, all_exprs, lit_vals)
+        part_cols = [X.dev_eval(e, ctx) for e in part_bound]
+        order_cols = [X.dev_eval(e, ctx) for e in order_bound]
+        lay = _layout(part_cols, list(order_specs), order_cols, active)
+        outs = []
+        for item in items:
+            kind = item[0]
+            if kind == "rank":
+                d, v = _ranking(item[1], lay)
+                outs.append(((_to_orig(lay.perm, d),),
+                             _to_orig(lay.perm, v)))
+            elif kind == "offset":
+                _k, func, src_i, dflt_i = item
+                val = X.dev_eval(all_exprs[src_i], ctx)
+                dflt = None
+                if dflt_i is not None:
+                    dc = X.dev_eval(all_exprs[dflt_i], ctx)
+                    dflt = (dc.arrays() if isinstance(
+                        dc, DeviceStringColumn)
+                        else (dc.data, dc.validity))
+                arrs, v = _offset_fn(func, val, dflt, lay)
+                outs.append((tuple(_to_orig(lay.perm, a) for a in arrs),
+                             _to_orig(lay.perm, v)))
+            else:  # agg
+                _k, agg, frame, src_i, out_type = item
+                val = (X.dev_eval(all_exprs[src_i], ctx)
+                       if src_i is not None else None)
+                d, v = _agg_window(agg, frame, val, lay, out_type)
+                outs.append(((_to_orig(lay.perm, d),),
+                             _to_orig(lay.perm, v)))
+        return outs
+    return jax.jit(fn)
+
+
+class TpuWindowExec(TpuExec):
+    def __init__(self, window_exprs: List[E.Expression],
+                 partition_spec: List[E.Expression],
+                 order_spec: List[E.SortOrder], child: TpuExec,
+                 conf: TpuConf):
+        super().__init__(conf)
+        self.children = [child]
+        self.window_exprs = window_exprs
+        self.partition_spec = partition_spec
+        self.order_spec = order_spec
+
+    @property
+    def child(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def output(self):
+        return list(self.child.output) + [E.named_output(e)
+                                          for e in self.window_exprs]
+
+    def _plan_items(self):
+        """Bind everything and build the static item descriptors."""
+        child_out = self.child.output
+        part_bound = tuple(E.bind_references(e, child_out)
+                           for e in self.partition_spec)
+        order_bound = tuple(E.bind_references(o.child, child_out)
+                            for o in self.order_spec)
+        extra: List[E.Expression] = []
+        base = len(part_bound) + len(order_bound)
+
+        def add(e: E.Expression) -> int:
+            extra.append(E.bind_references(e, child_out))
+            return base + len(extra) - 1
+
+        items: List[Tuple] = []
+        out_types: List[T.DataType] = []
+        for alias in self.window_exprs:
+            wx = alias.child
+            func = wx.func
+            if isinstance(func, (E.RowNumber, E.Rank, E.DenseRank,
+                                 E.NTile)):
+                items.append(("rank", func))
+            elif isinstance(func, E.Lag):
+                src_i = add(func.input)
+                dflt_i = None
+                if func.default is not None:
+                    dflt = func.default
+                    if type(dflt.data_type) is not type(
+                            func.input.data_type):
+                        dflt = E.Cast(dflt, func.input.data_type)
+                    dflt_i = add(dflt)
+                items.append(("offset", func, src_i, dflt_i))
+            else:
+                agg = func.func
+                src_i = add(agg.children[0]) if agg.children else None
+                items.append(("agg", agg, wx.frame, src_i, wx.data_type))
+            out_types.append(wx.data_type)
+        all_exprs = part_bound + order_bound + tuple(extra)
+        return part_bound, order_bound, items, all_exprs, out_types
+
+    def _item_key(self, items) -> Tuple:
+        out = []
+        for it in items:
+            if it[0] == "rank":
+                out.append(("rank", type(it[1]).__name__,
+                            getattr(it[1], "n", None)))
+            elif it[0] == "offset":
+                out.append(("offset", type(it[1]).__name__, it[1].offset,
+                            it[2], it[3]))
+            else:
+                out.append(("agg", type(it[1]).__name__,
+                            getattr(it[1], "ignore_nulls", None),
+                            it[2].key(), it[3], repr(it[4])))
+        return tuple(out)
+
+    def _run_batch(self, batch: DeviceBatch) -> DeviceBatch:
+        (part_bound, order_bound, items, all_exprs, out_types
+         ) = self._plan_items()
+        key = (tuple(X.expr_key(e) for e in all_exprs),
+               len(part_bound),
+               tuple((o.ascending, o.nulls_first) for o in self.order_spec),
+               self._item_key(items))
+        fn = _WINDOW_FN_CACHE.get(key)
+        if fn is None:
+            fn = _build_window_fn(part_bound, tuple(self.order_spec),
+                                  order_bound, tuple(items), all_exprs)
+            _WINDOW_FN_CACHE[key] = fn
+        lit_vals = X.literal_values(list(all_exprs))
+        with self.metrics.timed(M.OP_TIME):
+            outs = fn(batch.columns, batch.active, lit_vals)
+        new_cols: List[AnyDeviceColumn] = list(batch.columns)
+        for (arrs, validity), dt in zip(outs, out_types):
+            new_cols.append(make_column(dt, tuple(arrs) + (validity,)))
+        return DeviceBatch(self.schema, new_cols, batch.active,
+                           batch._num_rows)
+
+    def device_partitions(self) -> List[DevicePartitionThunk]:
+        def make(thunk: DevicePartitionThunk) -> DevicePartitionThunk:
+            def run() -> Iterator[DeviceBatch]:
+                batches = [b for b in thunk() if b.row_count()]
+                if not batches:
+                    return
+                whole = (batches[0] if len(batches) == 1
+                         else concat_device(batches))
+                yield self._run_batch(whole)
+            return run
+        return [make(t) for t in device_channel(self.child)]
+
+    def simple_string(self):
+        return (f"TpuWindow {self.window_exprs} part={self.partition_spec} "
+                f"order={self.order_spec}")

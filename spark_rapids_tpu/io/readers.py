@@ -115,7 +115,8 @@ def _infer_part_type(raw: List[str]) -> T.DataType:
             return T.IntegerT
         if all(-(1 << 63) <= i < (1 << 63) for i in ints):
             return T.LongT
-        return T.StringT
+        # beyond int64: Spark widens numerically rather than to string
+        return T.DoubleT
     if all(_FLOAT_RE.match(v) for v in vals):
         return T.DoubleT
     return T.StringT

@@ -277,6 +277,15 @@ def _tag_sort(meta: ExecMeta) -> None:
         meta.will_not_work(r)
 
 
+def _tag_window(meta: ExecMeta) -> None:
+    from spark_rapids_tpu.exec.window import is_device_window
+    w = meta.wrapped
+    r = is_device_window(w.window_exprs, w.partition_spec, w.order_spec,
+                         meta.conf)
+    if r:
+        meta.will_not_work(r)
+
+
 def _tag_join(meta: ExecMeta) -> None:
     from spark_rapids_tpu.exec.join import is_device_join
     w = meta.wrapped
@@ -369,6 +378,13 @@ def _conv_sort(meta, kids):
     return TpuSortExec(w.order, w.is_global, kids[0], meta.conf)
 
 
+def _conv_window(meta, kids):
+    from spark_rapids_tpu.exec.window import TpuWindowExec
+    w = meta.wrapped
+    return TpuWindowExec(w.window_exprs, w.partition_spec, w.order_spec,
+                         kids[0], meta.conf)
+
+
 def _conv_shuffled_join(meta, kids):
     from spark_rapids_tpu.exec.join import TpuShuffledHashJoinExec
     w = meta.wrapped
@@ -403,6 +419,9 @@ exec_rule(P.CpuHashAggregateExec, "sort-segmented device aggregation",
           tag_fn=_tag_aggregate, convert_fn=_conv_aggregate)
 exec_rule(P.CpuSortExec, "device lexsort over encoded sort keys",
           tag_fn=_tag_sort, convert_fn=_conv_sort)
+from spark_rapids_tpu.sql.window_exec import CpuWindowExec  # noqa: E402
+exec_rule(CpuWindowExec, "segment-scan device window functions",
+          tag_fn=_tag_window, convert_fn=_conv_window)
 exec_rule(P.CpuShuffledHashJoinExec, "count-then-gather device equi-join",
           tag_fn=_tag_join, convert_fn=_conv_shuffled_join)
 exec_rule(P.CpuBroadcastHashJoinExec,

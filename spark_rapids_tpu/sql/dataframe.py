@@ -73,7 +73,40 @@ class DataFrame:
             if not isinstance(e, (E.AttributeReference, E.Alias)):
                 e = E.Alias(e, _auto_name(e))
             items.append(e)
-        return DataFrame(L.Project(items, self.plan), self.session)
+        return DataFrame(self._project_plan(items), self.session)
+
+    def _project_plan(self, items: List[E.Expression]) -> L.LogicalPlan:
+        """Project, extracting window expressions into L.Window nodes
+        grouped by (partition, order) spec — the analyzer's
+        ExtractWindowExpressions role."""
+        if not any(e.collect(lambda x: isinstance(x, E.WindowExpression))
+                   for e in items):
+            return L.Project(items, self.plan)
+        groups: dict = {}
+        counter = [0]
+
+        def extract(item: E.Expression) -> E.Expression:
+            def rule(x):
+                if isinstance(x, E.WindowExpression):
+                    name = (item.name if isinstance(item, E.Alias)
+                            and item.child is x
+                            else f"_we{counter[0]}")
+                    counter[0] += 1
+                    alias = E.Alias(x, name)
+                    key = (tuple(map(repr, x.partition_spec)),
+                           tuple(map(repr, x.order_spec)))
+                    groups.setdefault(
+                        key, (x.partition_spec, x.order_spec, []))[2] \
+                        .append(alias)
+                    return alias.to_attribute()
+                return None
+            return item.transform(rule)
+
+        new_items = [extract(e) for e in items]
+        child = self.plan
+        for part, order, aliases in groups.values():
+            child = L.Window(aliases, list(part), list(order), child)
+        return L.Project(new_items, child)
 
     def selectExpr(self, *exprs: str) -> "DataFrame":
         from spark_rapids_tpu.sql.parser import parse_expression
@@ -92,7 +125,7 @@ class DataFrame:
                 items.append(a)
         if not replaced:
             items.append(E.Alias(e, name))
-        return DataFrame(L.Project(items, self.plan), self.session)
+        return DataFrame(self._project_plan(items), self.session)
 
     def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
         items = [a.renamed(new) if a.name == old else a

@@ -1904,3 +1904,164 @@ class SortOrder(Expression):
         dirn = "ASC" if self.ascending else "DESC"
         nf = "NULLS FIRST" if self.nulls_first else "NULLS LAST"
         return f"{self.child!r} {dirn} {nf}"
+
+
+# ---------------------------------------------------------------------------
+# Window expressions (Catalyst windowExpressions.scala shape; reference
+# device impl: GpuWindowExec.scala:187, GpuWindowExpression.scala)
+# ---------------------------------------------------------------------------
+
+# Frame boundary sentinels: None = unbounded in that direction, 0 = the
+# current row, +/-k = k rows after/before (rows frames only).
+class WindowFrame:
+    """Rows/range frame. Spark defaults: with an order spec -> RANGE
+    BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW; without -> ROWS BETWEEN
+    UNBOUNDED PRECEDING AND UNBOUNDED FOLLOWING."""
+
+    def __init__(self, frame_type: str, lower: Optional[int],
+                 upper: Optional[int]):
+        assert frame_type in ("rows", "range")
+        self.frame_type = frame_type
+        self.lower = lower
+        self.upper = upper
+
+    @property
+    def is_unbounded_whole(self) -> bool:
+        return self.lower is None and self.upper is None
+
+    @property
+    def is_running(self) -> bool:
+        """UNBOUNDED PRECEDING .. CURRENT ROW."""
+        return self.lower is None and self.upper == 0
+
+    def key(self) -> tuple:
+        return (self.frame_type, self.lower, self.upper)
+
+    def __repr__(self) -> str:
+        def b(v, side):
+            if v is None:
+                return f"UNBOUNDED {side}"
+            if v == 0:
+                return "CURRENT ROW"
+            return f"{abs(v)} {'PRECEDING' if v < 0 else 'FOLLOWING'}"
+        return (f"{self.frame_type.upper()} BETWEEN "
+                f"{b(self.lower, 'PRECEDING')} AND "
+                f"{b(self.upper, 'FOLLOWING')}")
+
+
+def default_frame(has_order: bool) -> WindowFrame:
+    if has_order:
+        return WindowFrame("range", None, 0)
+    return WindowFrame("rows", None, None)
+
+
+class WindowFunction(Expression):
+    """Base of ranking/offset window functions (non-aggregate)."""
+
+
+class RowNumber(WindowFunction):
+    def __init__(self):
+        self.children = []
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.IntegerT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+
+class Rank(WindowFunction):
+    def __init__(self):
+        self.children = []
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.IntegerT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+
+class DenseRank(WindowFunction):
+    def __init__(self):
+        self.children = []
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.IntegerT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+
+class NTile(WindowFunction):
+    def __init__(self, n: int):
+        self.children = []
+        self.n = n
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.IntegerT
+
+
+class Lag(WindowFunction):
+    """children = [input, default?]; offset is static."""
+
+    def __init__(self, child: Expression, offset: int = 1,
+                 default: Optional[Expression] = None):
+        self.children = [child] + ([default] if default is not None else [])
+        self.offset = offset
+
+    @property
+    def input(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def default(self) -> Optional[Expression]:
+        return self.children[1] if len(self.children) > 1 else None
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.input.data_type
+
+
+class Lead(Lag):
+    pass
+
+
+class WindowExpression(Expression):
+    """function OVER (spec). children = [func] + partition exprs + order
+    SortOrders so resolution/transforms reach every subtree; the frame
+    rides alongside."""
+
+    def __init__(self, func: Expression, partition_spec: List[Expression],
+                 order_spec: List[SortOrder],
+                 frame: Optional[WindowFrame] = None):
+        self.children = [func] + list(partition_spec) + list(order_spec)
+        self.n_partition = len(partition_spec)
+        self.n_order = len(order_spec)
+        self.frame = frame or default_frame(bool(order_spec))
+
+    @property
+    def func(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def partition_spec(self) -> List[Expression]:
+        return self.children[1:1 + self.n_partition]
+
+    @property
+    def order_spec(self) -> List["SortOrder"]:
+        return self.children[1 + self.n_partition:]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.func.data_type
+
+    def __repr__(self) -> str:
+        return (f"{self.func!r} OVER (PARTITION BY {self.partition_spec} "
+                f"ORDER BY {self.order_spec} {self.frame!r})")

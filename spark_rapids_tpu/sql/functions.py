@@ -128,6 +128,11 @@ class Column:
     def between(self, low, high):
         return (self >= low) & (self <= high)
 
+    # -- window
+    def over(self, spec: "WindowSpec") -> "Column":
+        return Column(E.WindowExpression(
+            self.expr, spec._partition, spec._order, spec._frame))
+
     # -- sort orders
     def asc(self):
         return Column(E.SortOrder(self.expr, ascending=True))
@@ -431,3 +436,92 @@ def datediff(end, start) -> Column:
 
 def hash(*cols) -> Column:  # noqa: A001
     return Column(E.Murmur3Hash([_to_col_expr(c) for c in cols]))
+
+
+# ---------------------------------------------------------------------------
+# Window API (pyspark.sql.window.Window / WindowSpec shape)
+# ---------------------------------------------------------------------------
+
+class WindowSpec:
+    def __init__(self, partition_spec=None, order_spec=None, frame=None):
+        self._partition = list(partition_spec or [])
+        self._order = list(order_spec or [])
+        self._frame = frame
+
+    def partitionBy(self, *cols) -> "WindowSpec":
+        exprs = [_to_expr(c if not isinstance(c, str) else col(c))
+                 for c in cols]
+        return WindowSpec(exprs, self._order, self._frame)
+
+    def orderBy(self, *cols) -> "WindowSpec":
+        order = []
+        for c in cols:
+            e = _to_expr(c if not isinstance(c, str) else col(c))
+            order.append(e if isinstance(e, E.SortOrder)
+                         else E.SortOrder(e, ascending=True))
+        return WindowSpec(self._partition, order, self._frame)
+
+    def rowsBetween(self, start: int, end: int) -> "WindowSpec":
+        lo = None if start <= Window.unboundedPreceding else int(start)
+        hi = None if end >= Window.unboundedFollowing else int(end)
+        return WindowSpec(self._partition, self._order,
+                          E.WindowFrame("rows", lo, hi))
+
+    def rangeBetween(self, start: int, end: int) -> "WindowSpec":
+        if start <= Window.unboundedPreceding and end == 0:
+            frame = E.WindowFrame("range", None, 0)
+        elif start <= Window.unboundedPreceding \
+                and end >= Window.unboundedFollowing:
+            frame = E.WindowFrame("range", None, None)
+        else:
+            raise NotImplementedError(
+                "only UNBOUNDED PRECEDING range frames are supported")
+        return WindowSpec(self._partition, self._order, frame)
+
+
+class Window:
+    """pyspark.sql.Window twin (static constructors)."""
+
+    unboundedPreceding = -(1 << 63)
+    unboundedFollowing = (1 << 63)
+    currentRow = 0
+
+    @staticmethod
+    def partitionBy(*cols) -> WindowSpec:
+        return WindowSpec().partitionBy(*cols)
+
+    @staticmethod
+    def orderBy(*cols) -> WindowSpec:
+        return WindowSpec().orderBy(*cols)
+
+    @staticmethod
+    def rowsBetween(start: int, end: int) -> WindowSpec:
+        return WindowSpec().rowsBetween(start, end)
+
+
+def row_number() -> Column:
+    return Column(E.RowNumber())
+
+
+def rank() -> Column:
+    return Column(E.Rank())
+
+
+def dense_rank() -> Column:
+    return Column(E.DenseRank())
+
+
+def ntile(n: int) -> Column:
+    return Column(E.NTile(int(n)))
+
+
+def lag(c, offset: int = 1, default=None) -> Column:
+    e = _to_expr(col(c) if isinstance(c, str) else c)
+    d = None if default is None else _to_expr(lit(default))
+    return Column(E.Lag(e, int(offset), d))
+
+
+def lead(c, offset: int = 1, default=None) -> Column:
+    e = _to_expr(col(c) if isinstance(c, str) else c)
+    d = None if default is None else _to_expr(lit(default))
+    return Column(E.Lead(e, int(offset), d))

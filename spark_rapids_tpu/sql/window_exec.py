@@ -1,0 +1,248 @@
+"""CpuWindowExec: reference-semantics window evaluation on the host
+(Spark WindowExec twin; the device twin is exec/window.py). Used by the
+CPU session as the bit-exactness oracle for TpuWindowExec.
+
+Per partition-group: rows are ordered by the window order spec; each
+window expression computes a result array in ORIGINAL row order so the
+operator appends columns without permuting its input (order-insensitive
+output, same contract as the device exec).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+from spark_rapids_tpu.sql import expressions as E
+from spark_rapids_tpu.sql import physical as P
+from spark_rapids_tpu.sql import types as T
+
+
+class CpuWindowExec(P.PhysicalPlan):
+    def __init__(self, window_exprs: List[E.Expression],
+                 partition_spec: List[E.Expression],
+                 order_spec: List[E.SortOrder], child: P.PhysicalPlan):
+        self.children = [child]
+        self.window_exprs = window_exprs  # Alias(WindowExpression)
+        self.partition_spec = partition_spec
+        self.order_spec = order_spec
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def output(self):
+        return list(self.child.output) + [E.named_output(e)
+                                          for e in self.window_exprs]
+
+    def partitions(self) -> List[P.PartitionThunk]:
+        schema = self.schema
+
+        def make(thunk: P.PartitionThunk) -> P.PartitionThunk:
+            def run():
+                batches = [b for b in thunk() if b.num_rows]
+                if not batches:
+                    return
+                whole = (batches[0] if len(batches) == 1
+                         else HostBatch.concat(batches))
+                yield self._evaluate(whole, schema)
+            return run
+        return [make(t) for t in self.child.partitions()]
+
+    # -- evaluation --------------------------------------------------------
+
+    def _evaluate(self, batch: HostBatch, schema: T.StructType) -> HostBatch:
+        child_out = self.child.output
+        n = batch.num_rows
+        # partition groups
+        if self.partition_spec:
+            key_cols = [E.bind_references(e, child_out).eval(batch)
+                        for e in self.partition_spec]
+            gids, n_groups, _rep = P.group_ids(key_cols, n)
+        else:
+            gids, n_groups = np.zeros(n, dtype=np.int64), 1
+        # order composite keys (whole batch, sliced per group)
+        composites = [P._composite_key(
+            E.bind_references(o.child, child_out).eval(batch), o)
+            for o in self.order_spec]
+
+        out_cols = list(batch.columns)
+        for alias in self.window_exprs:
+            wx = alias.child
+            assert isinstance(wx, E.WindowExpression)
+            out_cols.append(self._eval_window(wx, batch, child_out, gids,
+                                              n_groups, composites))
+        return HostBatch(schema, out_cols, n)
+
+    def _eval_window(self, wx: E.WindowExpression, batch: HostBatch,
+                     child_out, gids: np.ndarray, n_groups: int,
+                     composites: List[np.ndarray]) -> HostColumn:
+        n = batch.num_rows
+        dt = wx.data_type
+        func = wx.func
+        frame = wx.frame
+        # input values for aggregate/offset functions
+        vals: Optional[HostColumn] = None
+        if isinstance(func, E.AggregateExpression):
+            agg = func.func
+            if isinstance(agg, E.Count) and not agg.children:
+                vals = HostColumn(
+                    T.LongT, np.ones(n, dtype=np.int64),
+                    np.ones(n, dtype=bool))
+            else:
+                src = agg.children[0]
+                if isinstance(agg, E.Average):
+                    src = E.Cast(src, T.DoubleT)
+                vals = E.bind_references(src, child_out).eval(batch)
+        elif isinstance(func, E.Lag):
+            vals = E.bind_references(func.input, child_out).eval(batch)
+
+        out_data = np.zeros(n, dtype=T.numpy_dtype(dt))
+        out_valid = np.zeros(n, dtype=bool)
+
+        for g in range(n_groups):
+            rows = np.nonzero(gids == g)[0]
+            if not len(rows):
+                continue
+            if composites:
+                order_local = np.lexsort(
+                    [c[rows] for c in composites][::-1])
+            else:
+                order_local = np.arange(len(rows))
+            sorted_rows = rows[order_local]
+            m = len(sorted_rows)
+            # peer boundaries (for rank/dense_rank/range frames)
+            new_peer = np.ones(m, dtype=bool)
+            if composites:
+                eq = np.ones(m - 1, dtype=bool) if m > 1 else \
+                    np.zeros(0, dtype=bool)
+                for c in composites:
+                    cv = c[sorted_rows]
+                    eq &= cv[1:] == cv[:-1]
+                new_peer[1:] = ~eq
+            d, v = self._func_over_group(func, frame, vals, sorted_rows,
+                                         new_peer, dt)
+            out_data[sorted_rows] = d
+            out_valid[sorted_rows] = v
+        return HostColumn(dt, out_data, out_valid).normalized()
+
+    def _func_over_group(self, func, frame: E.WindowFrame,
+                         vals: Optional[HostColumn],
+                         sorted_rows: np.ndarray, new_peer: np.ndarray,
+                         dt: T.DataType) -> Tuple[np.ndarray, np.ndarray]:
+        """Result (data, validity) in SORTED group order."""
+        m = len(sorted_rows)
+        if isinstance(func, E.RowNumber):
+            return np.arange(1, m + 1, dtype=np.int32), np.ones(m, bool)
+        if isinstance(func, E.DenseRank):
+            return np.cumsum(new_peer).astype(np.int32), np.ones(m, bool)
+        if isinstance(func, E.Rank):
+            pos = np.arange(m)
+            peer_start = np.maximum.accumulate(np.where(new_peer, pos, 0))
+            return (peer_start + 1).astype(np.int32), np.ones(m, bool)
+        if isinstance(func, E.NTile):
+            k = func.n
+            pos = np.arange(m)
+            base, rem = divmod(m, k)
+            # first `rem` buckets get base+1 rows
+            big = rem * (base + 1)
+            tile = np.where(pos < big, pos // max(base + 1, 1),
+                            rem + (pos - big) // max(base, 1))
+            return (tile + 1).astype(np.int32), np.ones(m, bool)
+        if isinstance(func, E.Lag):
+            off = func.offset if isinstance(func, E.Lag) and \
+                not isinstance(func, E.Lead) else -func.offset
+            src_pos = np.arange(m) - off
+            ok = (src_pos >= 0) & (src_pos < m)
+            safe = np.clip(src_pos, 0, m - 1)
+            gd = vals.data[sorted_rows][safe]
+            gv = vals.validity[sorted_rows][safe] & ok
+            if func.default is not None:
+                dcol = func.default.eval(
+                    HostBatch(T.StructType([]), [], 1))
+                if dcol.validity[0]:
+                    gd = np.where(ok, gd, dcol.data[0])
+                    gv = gv | ~ok
+            return gd.astype(T.numpy_dtype(dt)), gv
+        if isinstance(func, E.AggregateExpression):
+            return self._agg_over_group(func.func, frame, vals,
+                                        sorted_rows, new_peer, dt)
+        raise NotImplementedError(type(func).__name__)
+
+    def _agg_over_group(self, agg: E.AggregateFunction,
+                        frame: E.WindowFrame, vals: HostColumn,
+                        sorted_rows: np.ndarray, new_peer: np.ndarray,
+                        dt: T.DataType) -> Tuple[np.ndarray, np.ndarray]:
+        m = len(sorted_rows)
+        v = vals.data[sorted_rows]
+        ok = vals.validity[sorted_rows].astype(bool)
+        # frame [lo_i, hi_i] inclusive bounds per sorted position
+        pos = np.arange(m)
+        if frame.is_unbounded_whole:
+            lo = np.zeros(m, dtype=np.int64)
+            hi = np.full(m, m - 1, dtype=np.int64)
+        elif frame.frame_type == "range":
+            # running with peers: frame end = last row of the peer group
+            peer_id = np.cumsum(new_peer) - 1
+            last_of_peer = np.zeros(peer_id.max() + 1, dtype=np.int64)
+            np.maximum.at(last_of_peer, peer_id, pos)
+            lo = np.zeros(m, dtype=np.int64)
+            hi = last_of_peer[peer_id]
+        else:  # rows frame
+            lo = pos + (-(1 << 62) if frame.lower is None else frame.lower)
+            hi = pos + ((1 << 62) if frame.upper is None else frame.upper)
+            lo = np.clip(lo, 0, m)
+            hi = np.clip(hi, -1, m - 1)
+        out = np.zeros(m, dtype=T.numpy_dtype(dt))
+        valid = np.zeros(m, dtype=bool)
+        for i in range(m):
+            l, h = int(lo[i]), int(hi[i])
+            if h < l:
+                if isinstance(agg, E.Count):
+                    out[i], valid[i] = 0, True
+                continue
+            sl_ok = ok[l:h + 1]
+            sl = v[l:h + 1][sl_ok]
+            if isinstance(agg, E.Count):
+                out[i], valid[i] = len(sl), True
+                continue
+            if isinstance(agg, (E.First, E.Last)) and not agg.ignore_nulls:
+                j = l if isinstance(agg, E.First) else h
+                out[i], valid[i] = v[j], ok[j]
+                continue
+            if len(sl) == 0:
+                continue
+            if isinstance(agg, E.Sum):
+                out[i], valid[i] = sl.sum(), True
+            elif isinstance(agg, E.Min):
+                # Spark total order: NaN is greatest, so min skips NaNs
+                if np.issubdtype(sl.dtype, np.floating):
+                    nn = sl[~np.isnan(sl)]
+                    out[i] = nn.min() if len(nn) else np.nan
+                else:
+                    out[i] = sl.min()
+                valid[i] = True
+            elif isinstance(agg, E.Max):
+                # np.max already yields NaN when present (NaN greatest)
+                if np.issubdtype(sl.dtype, np.floating) and \
+                        np.isnan(sl).any():
+                    out[i] = np.nan
+                else:
+                    out[i] = sl.max()
+                valid[i] = True
+            elif isinstance(agg, E.Average):
+                out[i], valid[i] = sl.astype(np.float64).mean(), True
+            elif isinstance(agg, E.First):
+                out[i], valid[i] = sl[0], True
+            elif isinstance(agg, E.Last):
+                out[i], valid[i] = sl[-1], True
+            else:
+                raise NotImplementedError(type(agg).__name__)
+        return out, valid
+
+    def simple_string(self):
+        return (f"Window {self.window_exprs} part={self.partition_spec} "
+                f"order={self.order_spec}")
