@@ -167,26 +167,26 @@ class TpuHashAggregateExec(TpuExec):
                 if isinstance(e, E.Alias)
                 and isinstance(e.child, E.AggregateExpression)]
 
-    def _bound_slot_sources(self) -> Tuple[List[E.Expression],
-                                           List[Tuple[str, T.DataType]]]:
-        """Per-slot (bound source expr, (prim, out_type)) for this mode."""
+    def _bound_slot_sources(self, mode: str
+                            ) -> Tuple[List[E.Expression],
+                                       List[Tuple[str, T.DataType]]]:
+        """Per-slot (bound source expr, (prim, out_type)) for `mode`."""
         child_out = self.child.output
         srcs: List[E.Expression] = []
         prims: List[Tuple[str, T.DataType]] = []
         for alias in self._agg_aliases():
             for s in self.slots[alias.expr_id]:
-                if self.mode in ("partial", "complete"):
+                if mode in ("partial", "complete"):
                     prim, src = s.update_prim, s.update_expr
-                else:
+                else:  # final and the internal buffer-merge mode
                     prim, src = s.merge_prim, s.attr
                 srcs.append(E.bind_references(src, child_out))
                 prims.append((prim, s.dtype))
         return srcs, prims
 
-    def _build_fn(self, key_bound: List[E.Expression],
+    def _build_fn(self, mode: str, key_bound: List[E.Expression],
                   slot_srcs: List[E.Expression],
                   prims: List[Tuple[str, T.DataType]]) -> Callable:
-        mode = self.mode
         aliases = self._agg_aliases()
         slot_counts = [len(self.slots[a.expr_id]) for a in aliases]
         grouping = self.grouping
@@ -210,7 +210,9 @@ class TpuHashAggregateExec(TpuExec):
             key_out = take_columns(key_cols, rep, valid_at=out_active) \
                 if grouping else []
 
-            if mode == "partial":
+            if mode in ("partial", "merge"):
+                # merge: buffer-space -> buffer-space (the bounded
+                # concat+merge staging of aggregate.scala:224-245)
                 out_cols = list(key_out) + list(buffers)
                 return out_cols, out_active
 
@@ -259,11 +261,13 @@ class TpuHashAggregateExec(TpuExec):
                 desc.append(("other", repr(e)))
         return tuple(desc)
 
-    def _aggregate_batch(self, batch: DeviceBatch) -> DeviceBatch:
+    def _aggregate_batch(self, batch: DeviceBatch,
+                         mode: Optional[str] = None) -> DeviceBatch:
+        mode = mode or self.mode
         child_out = self.child.output
         key_bound = [E.bind_references(g, child_out) for g in self.grouping]
-        slot_srcs, prims = self._bound_slot_sources()
-        key = (self.mode,
+        slot_srcs, prims = self._bound_slot_sources(mode)
+        key = (mode,
                tuple(X.expr_key(e) for e in key_bound),
                tuple(X.expr_key(e) for e in slot_srcs),
                tuple(p for p, _ in prims),
@@ -273,12 +277,18 @@ class TpuHashAggregateExec(TpuExec):
                self._out_desc())
         fn = _AGG_FN_CACHE.get(key)
         if fn is None:
-            fn = self._build_fn(key_bound, slot_srcs, prims)
+            fn = self._build_fn(mode, key_bound, slot_srcs, prims)
             _AGG_FN_CACHE[key] = fn
         lit_vals = X.literal_values(list(key_bound) + list(slot_srcs))
         with self.metrics.timed(M.AGG_TIME):
             out_cols, out_active = fn(batch.columns, batch.active, lit_vals)
-        return DeviceBatch(self.schema, list(out_cols), out_active, None)
+        if mode == "merge":  # buffer layout keeps the child's schema
+            schema = T.StructType(
+                [T.StructField(a.name, a.data_type, a.nullable)
+                 for a in child_out])
+        else:
+            schema = self.schema
+        return DeviceBatch(schema, list(out_cols), out_active, None)
 
     def _empty_global_result(self) -> DeviceBatch:
         cols: List[HostColumn] = []
@@ -291,6 +301,42 @@ class TpuHashAggregateExec(TpuExec):
         from spark_rapids_tpu.columnar.host import HostBatch
         return DeviceBatch.from_host(HostBatch(self.schema, cols, 1))
 
+    def _merge_bounded(self, handles: List, store) -> DeviceBatch:
+        """Out-of-core final staging: repeatedly concat+merge chunks of
+        buffer batches whose total row count stays within
+        ``batchSizeRows`` (aggregate.scala:224-245); inputs and
+        intermediates live behind spillable handles so the partition
+        never needs to fit in HBM at once."""
+        limit = max(self.conf.batch_size_rows, 2)
+        while len(handles) > 1:
+            merged: List = []
+            i = 0
+            while i < len(handles):
+                chunk = [handles[i]]
+                rows = handles[i].rows  # cached; never touches the tiers
+                i += 1
+                # take at least 2 per chunk (guaranteed progress), more
+                # while the concat stays within the row budget
+                while i < len(handles) and (
+                        len(chunk) < 2
+                        or rows + handles[i].rows <= limit):
+                    rows += handles[i].rows
+                    chunk.append(handles[i])
+                    i += 1
+                if len(chunk) == 1:
+                    merged.append(chunk[0])
+                    continue
+                whole = concat_device([h.get() for h in chunk])
+                out = shrink_to_bucket(
+                    self._aggregate_batch(whole, mode="merge"))
+                for h in chunk:
+                    h.close()
+                merged.append(store.register(out))
+            handles = merged
+        final = handles[0].get()
+        handles[0].close()
+        return final
+
     def device_partitions(self) -> List[DevicePartitionThunk]:
         grouped = len(self.grouping) > 0
 
@@ -298,20 +344,25 @@ class TpuHashAggregateExec(TpuExec):
             def run() -> Iterator[DeviceBatch]:
                 if self.mode == "partial":
                     # per-batch partial aggregation, no concat needed
-                    any_out = False
                     for b in thunk():
                         if b.row_count() == 0:
                             continue
-                        any_out = True
                         yield shrink_to_bucket(self._aggregate_batch(b))
                     return
-                batches = [b for b in thunk() if b.row_count()]
-                if not batches:
+                from spark_rapids_tpu.memory import get_device_store
+                store = get_device_store(self.conf)
+                handles = [store.register(b) for b in thunk()
+                           if b.row_count()]
+                if not handles:
                     if not grouped and self.mode in ("final", "complete"):
                         yield self._empty_global_result()
                     return
-                whole = (batches[0] if len(batches) == 1
-                         else concat_device(batches))
+                if self.mode == "final":
+                    whole = self._merge_bounded(handles, store)
+                else:  # complete consumes raw rows; concat directly
+                    whole = concat_device([h.get() for h in handles])
+                    for h in handles:
+                        h.close()
                 yield shrink_to_bucket(self._aggregate_batch(whole))
             return run
         return [make(t) for t in device_channel(self.child)]

@@ -54,32 +54,72 @@ def hash_partition_ids(exprs: List[E.Expression], batch: DeviceBatch,
     return fn(batch.columns, batch.active, X.literal_values(exprs))
 
 
-def range_partition_ids(order: List[E.Expression],
-                        bound: List[E.Expression], batch: DeviceBatch,
-                        n: int) -> jax.Array:
-    """Equal-depth range bucketing over the whole dataset's sort-rank
-    space (GpuRangePartitioner analogue; matches the CPU engine's
-    _range_partition bucketing bit-for-bit because both rank with the
-    same stable lexicographic order)."""
-    from spark_rapids_tpu.ops import sort as S
-    key = (tuple(X.expr_key(e) for e in bound),
-           tuple((o.ascending, o.nulls_first) for o in order), n)
+def range_key_columns(order: List[E.Expression],
+                      bound: List[E.Expression],
+                      batch: DeviceBatch) -> List:
+    """Per-batch evaluated order-key COLUMNS for range partitioning. Only
+    the keys leave the batch — the global ranking below never
+    concatenates full batches (the sampled-boundary memory discipline of
+    GpuRangePartitioner, exact instead of sampled)."""
+    from spark_rapids_tpu.columnar.device import make_column
+    key = tuple(X.expr_key(e) for e in bound)
     fn = _RANGE_PID_CACHE.get(key)
     if fn is None:
         bound_t = tuple(bound)
-        orders = list(order)
 
         def _fn(cols, active, lit_vals):
             cap = active.shape[0]
             ctx = X.Ctx(cols, cap, bound_t, lit_vals)
-            key_cols = [X.dev_eval(e, ctx) for e in bound_t]
-            ranks = S.rank_of_rows(key_cols, orders, active)
-            total = jnp.maximum(jnp.sum(active), 1)
-            return jnp.minimum((ranks * n) // total,
-                               n - 1).astype(jnp.int32)
+            return tuple(X.dev_eval(e, ctx).arrays() for e in bound_t)
         fn = jax.jit(_fn)
         _RANGE_PID_CACHE[key] = fn
-    return fn(batch.columns, batch.active, X.literal_values(bound))
+    arrs = fn(batch.columns, batch.active, X.literal_values(bound))
+    return [make_column(e.data_type, a) for e, a in zip(bound, arrs)]
+
+
+def global_range_pids(order: List[E.Expression],
+                      keycols_per_batch: List[List],
+                      actives: List[jax.Array], n: int) -> List[jax.Array]:
+    """Equal-depth bucketing over the global sort-rank space; returns the
+    per-batch partition-id arrays. String key columns are padded to a
+    common char width first so every batch yields the same subkey shape
+    (pack_string_words emits ceil(char_cap/8) words). Matches the CPU
+    engine's _range_partition assignment bit-for-bit (same stable
+    order)."""
+    from spark_rapids_tpu.columnar.device import DeviceStringColumn
+    from spark_rapids_tpu.ops import sort as S
+    n_keys = len(keycols_per_batch[0])
+    for ki in range(n_keys):
+        cols = [kc[ki] for kc in keycols_per_batch]
+        if isinstance(cols[0], DeviceStringColumn):
+            cc = max(c.char_cap for c in cols)
+            for bi, c in enumerate(cols):
+                if c.char_cap < cc:
+                    keycols_per_batch[bi][ki] = DeviceStringColumn(
+                        c.dtype,
+                        jnp.pad(c.chars, ((0, 0), (0, cc - c.char_cap))),
+                        c.lengths, c.validity)
+    keysets = []
+    for kc in keycols_per_batch:
+        subkeys: List[jax.Array] = []
+        for c, o in zip(kc, order):
+            subkeys.extend(S.order_subkeys(c, o.ascending, o.nulls_first))
+        keysets.append(tuple(subkeys))
+    combined = [jnp.concatenate([ks[i] for ks in keysets])
+                for i in range(len(keysets[0]))]
+    active = jnp.concatenate(actives)
+    perm = jnp.lexsort(tuple(reversed(combined)) + (~active,))
+    cap = active.shape[0]
+    ranks = jnp.zeros(cap, dtype=jnp.int64).at[perm].set(
+        jnp.arange(cap, dtype=jnp.int64))
+    total = jnp.maximum(jnp.sum(active), 1)
+    pids = jnp.minimum((ranks * n) // total, n - 1).astype(jnp.int32)
+    out: List[jax.Array] = []
+    off = 0
+    for a in actives:
+        out.append(pids[off:off + a.shape[0]])
+        off += a.shape[0]
+    return out
 
 
 def split_by_pid(batch: DeviceBatch, pids: jax.Array, n: int
@@ -154,13 +194,27 @@ class TpuShuffleExchangeExec(TpuExec):
     def output(self):
         return self.child.output
 
-    def _materialize(self) -> List[List[DeviceBatch]]:
+    def _materialize(self) -> List[List]:
         if self._cache is not None:
             return self._cache
+        from spark_rapids_tpu.memory import get_device_store
+        store = get_device_store(self.conf)
         p = self.partitioning
         n = p.num_partitions
-        out: List[List[DeviceBatch]] = [[] for _ in range(n)]
+        out: List[List] = [[] for _ in range(n)]
+
+        def keep(pid: int, part: DeviceBatch) -> None:
+            """Retain a materialized partition as a spillable handle —
+            the exchange holds the whole dataset across yields, so every
+            held batch must be demotable (SpillableColumnarBatch role)."""
+            out[pid].append(store.register(part))
+
         if isinstance(p, P.HashPartitioning) and self._mesh_eligible():
+            # mesh batches are sharded jax arrays pinned per chip; the
+            # spill tiers (host numpy round-trip) would gather them
+            # cross-device, so the ICI path manages residency itself —
+            # the reference likewise exempts UCX bounce buffers from the
+            # catalog (RapidsShuffleClient).
             out = self._materialize_mesh(p, n)
         elif isinstance(p, P.HashPartitioning):
             bound = P.bind_list(p.exprs, self.child.output)
@@ -171,12 +225,12 @@ class TpuShuffleExchangeExec(TpuExec):
                         parts = split_by_pid(b, pids, n)
                     for pid, part in enumerate(parts):
                         if part is not None:
-                            out[pid].append(part)
+                            keep(pid, part)
         elif isinstance(p, P.SinglePartitioning):
             for thunk in device_channel(self.child):
                 for b in thunk():
                     if b.row_count():
-                        out[0].append(b)
+                        keep(0, b)
         elif isinstance(p, P.RoundRobinPartitioning):
             start = 0
             for thunk in device_channel(self.child):
@@ -187,28 +241,49 @@ class TpuShuffleExchangeExec(TpuExec):
                         parts = split_by_pid(b, pids, n)
                     for pid, part in enumerate(parts):
                         if part is not None:
-                            out[pid].append(part)
+                            keep(pid, part)
                     start += 1
         elif isinstance(p, P.RangePartitioning):
-            from spark_rapids_tpu.columnar.device import concat_device
-            all_batches: List[DeviceBatch] = []
-            for thunk in device_channel(self.child):
-                all_batches.extend(b for b in thunk() if b.row_count())
-            if all_batches:
-                whole = (all_batches[0] if len(all_batches) == 1
-                         else concat_device(all_batches))
-                bound = P.bind_list([o.child for o in p.order],
-                                    self.child.output)
-                with self.metrics.timed(M.PARTITION_TIME):
-                    pids = range_partition_ids(p.order, bound, whole, n)
-                    parts = split_by_pid(whole, pids, n)
-                for pid, part in enumerate(parts):
-                    if part is not None:
-                        out[pid].append(part)
+            self._materialize_range(p, n, store, keep)
         else:
             raise NotImplementedError(repr(p))
         self._cache = out
         return out
+
+    def _materialize_range(self, p: P.RangePartitioning, n: int, store,
+                           keep) -> None:
+        """Two passes: (1) extract order-encoded KEYS per batch while the
+        batches themselves become spillable, (2) rank keys globally and
+        split each batch by its partition ids. Full batches are never
+        concatenated — only the uint64 key columns are."""
+        bound = P.bind_list([o.child for o in p.order], self.child.output)
+        handles, keycols, actives = [], [], []
+        for thunk in device_channel(self.child):
+            for b in thunk():
+                if b.row_count() == 0:
+                    continue
+                with self.metrics.timed(M.PARTITION_TIME):
+                    keycols.append(range_key_columns(p.order, bound, b))
+                actives.append(b.active)
+                handles.append(store.register(b))
+        if not handles:
+            return
+        with self.metrics.timed(M.PARTITION_TIME):
+            pids_per_batch = global_range_pids(p.order, keycols, actives, n)
+        for h, pids, act in zip(handles, pids_per_batch, actives):
+            b = h.get()
+            if h.ever_spilled or b.capacity != act.shape[0]:
+                # a spill round-trip compacted the batch: active rows are
+                # now a prefix, in original order — remap the per-slot
+                # pids through the same compaction permutation
+                comp = jnp.argsort(~act, stable=True)
+                pids = pids[comp][:b.capacity]
+            with self.metrics.timed(M.PARTITION_TIME):
+                parts = split_by_pid(b, pids, n)
+            h.close()
+            for pid, part in enumerate(parts):
+                if part is not None:
+                    keep(pid, part)
 
     def _mesh_eligible(self) -> bool:
         from spark_rapids_tpu.parallel.mesh import get_active_mesh, mesh_size
@@ -239,11 +314,14 @@ class TpuShuffleExchangeExec(TpuExec):
             return mesh_exchange(slot_batches, bound, n, mesh)
 
     def device_partitions(self) -> List[DevicePartitionThunk]:
+        from spark_rapids_tpu.memory import SpillableBatch
         nparts = self.partitioning.num_partitions
 
         def make(pid: int) -> DevicePartitionThunk:
             def run() -> Iterator[DeviceBatch]:
-                return iter(self._materialize()[pid])
+                for item in self._materialize()[pid]:
+                    yield (item.get() if isinstance(item, SpillableBatch)
+                           else item)
             return run
         return [make(i) for i in range(nparts)]
 

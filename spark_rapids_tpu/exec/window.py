@@ -46,7 +46,6 @@ from spark_rapids_tpu.sql import types as T
 
 _WINDOW_FN_CACHE: Dict[Tuple, Callable] = {}
 
-_U64_MAX = jnp.uint64(0xFFFFFFFFFFFFFFFF)
 
 
 def is_device_window(window_exprs: List[E.Expression],
@@ -138,32 +137,38 @@ def is_device_window(window_exprs: List[E.Expression],
 # Kernel pieces (all operate in SORTED row space)
 # ---------------------------------------------------------------------------
 
-def _seg_running_extreme(part_id: jax.Array, rank: jax.Array,
+def _seg_running_extreme(part_id: jax.Array, words: List[jax.Array],
                          valid: jax.Array, is_min: bool
                          ) -> Tuple[jax.Array, jax.Array]:
-    """Segmented running min/max over the total-order rank encoding.
-    Returns (winner position per row, has-winner flag)."""
+    """Segmented running min/max over multi-word ranks (most-significant
+    first; native dtypes — see groupby.rank_words). Returns (winner
+    position per row, has-winner flag)."""
     cap = part_id.shape[0]
-    sentinel = _U64_MAX if is_min else jnp.uint64(0)
-    r = jnp.where(valid, rank, sentinel)
     pos = jnp.arange(cap, dtype=jnp.int32)
+    n_words = len(words)
 
     def combine(a, b):
-        a_id, a_r, a_p = a
-        b_id, b_r, b_p = b
+        a_id, a_valid, a_p = a[0], a[1], a[2]
+        b_id, b_valid, b_p = b[0], b[1], b[2]
+        aw = a[3:]
+        bw = b[3:]
         same = b_id == a_id
-        if is_min:
-            better = a_r < b_r
-        else:
-            better = a_r > b_r
-        take_a = same & better
-        return (b_id,
-                jnp.where(take_a, a_r, b_r),
-                jnp.where(take_a, a_p, b_p))
+        a_live = a_valid & same
+        better = jnp.zeros_like(a_valid)
+        eq = jnp.ones_like(a_valid)
+        for wa, wb in zip(aw, bw):
+            c = (wa < wb) if is_min else (wa > wb)
+            better = better | (eq & c)
+            eq = eq & (wa == wb)
+        take_a = a_live & ((~b_valid) | better)
+        out = [b_id, a_live | b_valid,
+               jnp.where(take_a, a_p, b_p)]
+        out += [jnp.where(take_a, wa, wb) for wa, wb in zip(aw, bw)]
+        return tuple(out)
 
-    _ids, best_r, best_p = jax.lax.associative_scan(
-        combine, (part_id, r, pos))
-    return best_p, best_r != sentinel
+    res = jax.lax.associative_scan(
+        combine, tuple([part_id, valid, pos] + list(words)))
+    return res[2], res[1]
 
 
 def _prefix_in_part(x: jax.Array, start_of_row: jax.Array) -> jax.Array:
@@ -392,22 +397,27 @@ def _agg_window(agg: E.AggregateFunction, frame: E.WindowFrame,
 
     if isinstance(agg, (E.Min, E.Max)):
         is_min = isinstance(agg, E.Min)
-        rank = G.rank_u64(DeviceColumn(val.dtype, data_s, valid_s))
+        words = G.rank_words(DeviceColumn(val.dtype, data_s, valid_s))
         if frame.is_unbounded_whole:
-            sentinel = _U64_MAX if is_min else jnp.uint64(0)
-            r = jnp.where(valid_s, rank, sentinel)
-            seg_op = jax.ops.segment_min if is_min else jax.ops.segment_max
-            best = jnp.take(
-                seg_op(r, lay.part_id, num_segments=cap,
-                       indices_are_sorted=True), lay.part_id)
-            is_winner = valid_s & (r == best)
-            cand = jnp.where(is_winner, lay.pos, jnp.int32(cap))
+            # word-wise tournament over the partition (groupby
+            # _seg_extreme_words shape, keyed on part_id)
+            cand = valid_s
+            for w in words:
+                sent = G.word_sentinel(w.dtype, is_min)
+                masked = jnp.where(cand, w, sent)
+                seg_op = (jax.ops.segment_min if is_min
+                          else jax.ops.segment_max)
+                best = jnp.take(
+                    seg_op(masked, lay.part_id, num_segments=cap,
+                           indices_are_sorted=True), lay.part_id)
+                cand = cand & (w == best)
+            p = jnp.where(cand, lay.pos, jnp.int32(cap))
             win = jnp.take(
-                jax.ops.segment_min(cand, lay.part_id, num_segments=cap,
+                jax.ops.segment_min(p, lay.part_id, num_segments=cap,
                                     indices_are_sorted=True), lay.part_id)
             has = (win < cap)
         else:  # running
-            win, has = _seg_running_extreme(lay.part_id, rank, valid_s,
+            win, has = _seg_running_extreme(lay.part_id, words, valid_s,
                                             is_min)
             if frame.frame_type == "range":
                 win = jnp.take(win, lay.peer_last)
@@ -440,8 +450,8 @@ def _agg_window(agg: E.AggregateFunction, frame: E.WindowFrame,
             has = (win < cap) & (win >= 0)
             win = jnp.clip(win, 0, cap - 1)
         else:
-            win, has = _seg_running_extreme(lay.part_id, posrank, valid_s,
-                                            is_first)
+            win, has = _seg_running_extreme(lay.part_id, [posrank],
+                                            valid_s, is_first)
             if frame.frame_type == "range":
                 win = jnp.take(win, lay.peer_last)
                 has = jnp.take(has, lay.peer_last)

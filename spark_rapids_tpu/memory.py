@@ -1,0 +1,282 @@
+"""Device memory store + spill tiers (RapidsBufferCatalog.scala:40,
+SpillableColumnarBatch.scala:29, DeviceMemoryEventHandler.scala:43 twins).
+
+A byte-budget pool over HBM-resident batches. Operators that hold batches
+across yields (exchange materialization, aggregation staging) register
+them as ``SpillableBatch`` handles; when the pool exceeds its budget the
+least-recently-used handles are demoted device -> host (numpy) -> disk
+(pickle under spark.rapids.memory.spillDirectory), and transparently
+re-promoted on access — the reference's 3-tier store collapsed onto the
+JAX transfer primitives (to_host/from_host ARE the spill copies).
+
+Lifecycle: handles release deterministically via ``close()``; a dropped
+handle (operator GC'd with its plan) auto-releases through a weakref
+finalizer, so the process-wide store never pins batches whose owner died
+(the reference ties this to Spark's TaskCompletionListener).
+
+Note: a spill round-trip COMPACTS the batch (to_host gathers active rows,
+from_host rebuilds prefix-active at a possibly smaller capacity bucket) —
+active row ORDER is preserved, but per-slot layouts are not. Callers that
+pair a batch with precomputed per-slot arrays must check
+``ever_spilled``/capacity and remap (see the range exchange).
+
+The pool cannot intercept XLA's own allocations (scratch inside a fused
+program); like the reference's RMM pool it bounds what the framework
+retains between kernels, which is where multi-batch operators hold the
+bytes that matter.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import uuid
+import weakref
+from collections import OrderedDict
+from typing import Dict, Optional
+
+from spark_rapids_tpu.columnar.device import DeviceBatch
+from spark_rapids_tpu.columnar.host import HostBatch
+from spark_rapids_tpu.conf import (DEVICE_MEMORY_LIMIT,
+                                   HOST_SPILL_STORAGE_SIZE, SPILL_DIR,
+                                   TpuConf)
+
+_DEFAULT_BUDGET = 8 << 30  # when the backend reports no memory stats
+
+TIER_DEVICE = "device"
+TIER_HOST = "host"
+TIER_DISK = "disk"
+
+
+class _State:
+    """Per-handle storage owned by the store (survives handle GC so the
+    finalizer can release whatever tier the data sits in)."""
+
+    __slots__ = ("tier", "device", "host", "disk_path", "device_bytes",
+                 "host_bytes", "closed", "rows", "ever_spilled")
+
+    def __init__(self, batch: DeviceBatch):
+        self.tier = TIER_DEVICE
+        self.device: Optional[DeviceBatch] = batch
+        self.host: Optional[HostBatch] = None
+        self.disk_path: Optional[str] = None
+        self.device_bytes = batch.sizeof()
+        self.host_bytes = 0
+        self.closed = False
+        self.rows = batch.row_count()
+        self.ever_spilled = False
+
+
+class SpillableBatch:
+    """Handle over a batch the store may demote (SpillableColumnarBatch)."""
+
+    def __init__(self, store: "DeviceStore", state: _State,
+                 handle_id: int):
+        self._store = store
+        self._state = state
+        self._id = handle_id
+        weakref.finalize(self, store._release_id, handle_id)
+
+    def get(self) -> DeviceBatch:
+        """The device batch, re-promoted through the tiers if spilled."""
+        return self._store._access(self._id)
+
+    @property
+    def rows(self) -> int:
+        """Row count (cached at registration; never touches the tiers)."""
+        return self._state.rows
+
+    @property
+    def ever_spilled(self) -> bool:
+        """True once the batch has been demoted at least once — its slot
+        layout/capacity may differ from the originally registered batch."""
+        return self._state.ever_spilled
+
+    def sizeof(self) -> int:
+        return self._state.device_bytes
+
+    @property
+    def closed(self) -> bool:
+        return self._state.closed
+
+    def close(self) -> None:
+        self._store._release_id(self._id)
+
+    def __repr__(self) -> str:
+        return f"SpillableBatch(id={self._id}, tier={self._state.tier})"
+
+
+class DeviceStore:
+    """The catalog: tracks handles, enforces the HBM budget via LRU
+    spill, and accounts host-tier bytes against the host budget."""
+
+    def __init__(self, device_budget: int, host_budget: int,
+                 spill_dir: str):
+        self.device_budget = device_budget
+        self.host_budget = host_budget
+        self.spill_dir = spill_dir
+        self._lock = threading.RLock()
+        self._states: "OrderedDict[int, _State]" = OrderedDict()
+        self._next_id = 0
+        self.device_bytes = 0
+        self.host_bytes = 0
+        # observability (surfaced by bench + tests)
+        self.spill_count = 0
+        self.spilled_device_bytes = 0
+        self.disk_spill_count = 0
+        self.peak_device_bytes = 0
+
+    # -- registration ------------------------------------------------------
+
+    def register(self, batch: DeviceBatch) -> SpillableBatch:
+        with self._lock:
+            st = _State(batch)
+            hid = self._next_id
+            self._next_id += 1
+            self._states[hid] = st
+            self.device_bytes += st.device_bytes
+            self.peak_device_bytes = max(self.peak_device_bytes,
+                                         self.device_bytes)
+            self._enforce(exclude=hid)
+            return SpillableBatch(self, st, hid)
+
+    # -- internal tier movement --------------------------------------------
+
+    def _access(self, hid: int) -> DeviceBatch:
+        with self._lock:
+            st = self._states.get(hid)
+            assert st is not None and not st.closed, \
+                "SpillableBatch used after close"
+            if st.tier == TIER_DISK:
+                with open(st.disk_path, "rb") as f:
+                    st.host = pickle.load(f)
+                os.unlink(st.disk_path)
+                st.disk_path = None
+                st.tier = TIER_HOST
+                st.host_bytes = _host_sizeof(st.host)
+                self.host_bytes += st.host_bytes
+            if st.tier == TIER_HOST:
+                st.device = DeviceBatch.from_host(st.host)
+                self.host_bytes -= st.host_bytes
+                st.host, st.host_bytes = None, 0
+                st.tier = TIER_DEVICE
+                st.device_bytes = st.device.sizeof()
+                self.device_bytes += st.device_bytes
+                self.peak_device_bytes = max(self.peak_device_bytes,
+                                             self.device_bytes)
+            self._states.move_to_end(hid)
+            self._enforce(exclude=hid)
+            return st.device
+
+    def _enforce(self, exclude: int) -> None:
+        if self.device_bytes > self.device_budget:
+            for hid in list(self._states):
+                if self.device_bytes <= self.device_budget:
+                    break
+                if hid == exclude:
+                    continue
+                st = self._states[hid]
+                if st.tier == TIER_DEVICE:
+                    self._spill_to_host(st)
+        if self.host_bytes > self.host_budget:
+            for hid in list(self._states):
+                if self.host_bytes <= self.host_budget:
+                    break
+                st = self._states[hid]
+                if st.tier == TIER_HOST:
+                    self._spill_to_disk(st)
+
+    def _spill_to_host(self, st: _State) -> None:
+        st.host = st.device.to_host()
+        st.device = None
+        self.device_bytes -= st.device_bytes
+        st.host_bytes = _host_sizeof(st.host)
+        self.host_bytes += st.host_bytes
+        st.tier = TIER_HOST
+        st.ever_spilled = True
+        self.spill_count += 1
+        self.spilled_device_bytes += st.device_bytes
+
+    def _spill_to_disk(self, st: _State) -> None:
+        os.makedirs(self.spill_dir, exist_ok=True)
+        path = os.path.join(self.spill_dir,
+                            f"spill-{uuid.uuid4().hex[:16]}.bin")
+        with open(path, "wb") as f:
+            pickle.dump(st.host, f, protocol=pickle.HIGHEST_PROTOCOL)
+        self.host_bytes -= st.host_bytes
+        st.host, st.host_bytes = None, 0
+        st.disk_path = path
+        st.tier = TIER_DISK
+        self.disk_spill_count += 1
+
+    def _release_id(self, hid: int) -> None:
+        with self._lock:
+            st = self._states.pop(hid, None)
+            if st is None or st.closed:
+                return
+            st.closed = True
+            if st.tier == TIER_DEVICE:
+                self.device_bytes -= st.device_bytes
+            elif st.tier == TIER_HOST:
+                self.host_bytes -= st.host_bytes
+            elif st.disk_path:
+                try:
+                    os.unlink(st.disk_path)
+                except OSError:
+                    pass
+            st.device = None
+            st.host = None
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "deviceBytes": self.device_bytes,
+            "peakDeviceBytes": self.peak_device_bytes,
+            "hostBytes": self.host_bytes,
+            "spillCount": self.spill_count,
+            "spilledDeviceBytes": self.spilled_device_bytes,
+            "diskSpillCount": self.disk_spill_count,
+        }
+
+
+def _host_sizeof(b: HostBatch) -> int:
+    total = 0
+    for c in b.columns:
+        if c.data.dtype == object:
+            total += sum(len(str(v)) for v in c.data) + len(c.data)
+        else:
+            total += c.data.nbytes
+        total += c.validity.nbytes
+    return total
+
+
+def _default_budget() -> int:
+    try:
+        import jax
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        if limit:
+            return int(limit * 0.8)
+    except Exception:
+        pass
+    return _DEFAULT_BUDGET
+
+
+_STORE: Optional[DeviceStore] = None
+_STORE_KEY: Optional[tuple] = None
+_STORE_LOCK = threading.Lock()
+
+
+def get_device_store(conf: TpuConf) -> DeviceStore:
+    """Process-wide store (GpuDeviceManager owns one RMM pool per
+    executor); rebuilt when the configured budget changes (tests)."""
+    global _STORE, _STORE_KEY
+    budget = int(conf.get(DEVICE_MEMORY_LIMIT)) or _default_budget()
+    host_budget = int(conf.get(HOST_SPILL_STORAGE_SIZE))
+    spill_dir = str(conf.get(SPILL_DIR))
+    key = (budget, host_budget, spill_dir)
+    with _STORE_LOCK:
+        if _STORE is None or _STORE_KEY != key:
+            _STORE = DeviceStore(budget, host_budget, spill_dir)
+            _STORE_KEY = key
+        return _STORE

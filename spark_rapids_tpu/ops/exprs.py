@@ -303,23 +303,6 @@ def _normalized(dt: T.DataType, data: jax.Array, validity: jax.Array
     return DeviceColumn(dt, data, validity)
 
 
-def _float_total_order(a: jax.Array) -> jax.Array:
-    """Device twin of expressions._float_total_order: unsigned keys with
-    -0.0 folded and a single maximal NaN (Spark total order)."""
-    if a.dtype == jnp.float32:
-        v = jnp.where(jnp.isnan(a), jnp.float32(jnp.nan), a)
-        v = jnp.where(v == jnp.float32(0.0), jnp.float32(0.0), v)
-        u = v.view(jnp.uint32)
-        return jnp.where((u >> jnp.uint32(31)) == 1, ~u,
-                         u | jnp.uint32(0x80000000))
-    v = a.astype(jnp.float64)
-    v = jnp.where(jnp.isnan(v), jnp.nan, v)
-    v = jnp.where(v == 0.0, 0.0, v)
-    u = v.view(jnp.uint64)
-    return jnp.where((u >> jnp.uint64(63)) == 1, ~u,
-                     u | jnp.uint64(0x8000000000000000))
-
-
 def _pad_chars(c: DeviceStringColumn, char_cap: int) -> jax.Array:
     if c.char_cap >= char_cap:
         return c.chars
@@ -507,7 +490,15 @@ def _compare(op: str, lc: AnyDeviceColumn, rc: AnyDeviceColumn) -> jax.Array:
                 "ge": gt | eq}[op]
     a, b = lc.data, rc.data
     if jnp.issubdtype(a.dtype, jnp.floating):
-        a, b = _float_total_order(a), _float_total_order(b)
+        # Spark total order via predicates (NOT a 64-bit bitcast, which
+        # some TPU compile stacks cannot lower): NaN is greatest and
+        # equal to itself; IEEE == already folds -0.0 == 0.0.
+        an, bn = jnp.isnan(a), jnp.isnan(b)
+        eq = (a == b) | (an & bn)
+        lt = (~an) & (bn | (a < b))
+        gt = (~bn) & (an | (a > b))
+        return {"eq": eq, "lt": lt, "le": lt | eq, "gt": gt,
+                "ge": gt | eq}[op]
     return {"eq": a == b, "lt": a < b, "le": a <= b, "gt": a > b,
             "ge": a >= b}[op]
 

@@ -28,7 +28,6 @@ import jax.numpy as jnp
 
 from spark_rapids_tpu.columnar.device import (
     AnyDeviceColumn, DeviceColumn, DeviceStringColumn)
-from spark_rapids_tpu.ops.exprs import _float_total_order
 from spark_rapids_tpu.sql import types as T
 
 _U64_MAX = jnp.uint64(0xFFFFFFFFFFFFFFFF)
@@ -36,15 +35,30 @@ _SIGN64 = jnp.uint64(0x8000000000000000)
 
 
 def rank_u64(col: DeviceColumn) -> jax.Array:
-    """Order-preserving uint64 encoding of fixed-width data (Spark total
-    order for floats: NaN greatest, -0.0 == 0.0)."""
+    """Order-preserving uint64 encoding of NON-FLOAT fixed-width data.
+    Floats use :func:`rank_words` instead — their total-order encoding
+    would need a 64-bit float bitcast, which some TPU compile stacks
+    (v5e X64-rewrite) cannot lower; integer bitcasts lower fine."""
     data = col.data
-    if jnp.issubdtype(data.dtype, jnp.floating):
-        u = _float_total_order(data)
-        return u.astype(jnp.uint64)
+    assert not jnp.issubdtype(data.dtype, jnp.floating), \
+        "float ranks are multi-word; use rank_words"
     if data.dtype == jnp.bool_:
         return data.astype(jnp.uint64)
     return data.astype(jnp.int64).view(jnp.uint64) ^ _SIGN64
+
+
+def rank_words(col: DeviceColumn) -> List[jax.Array]:
+    """Order+equality words (most significant first) whose joint
+    ascending lexicographic order is Spark's total order, using only
+    native-dtype comparisons: floats become [is_nan, nan-zeroed value]
+    (NaN greatest + all NaNs equal; IEEE compare folds -0.0 == 0.0;
+    ``+0.0`` normalizes any -0.0 so equality words match bitwise)."""
+    data = col.data
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        zero = jnp.zeros((), data.dtype)
+        nanf = jnp.isnan(data)
+        return [nanf, jnp.where(nanf, zero, data) + zero]
+    return [rank_u64(col)]
 
 
 def pack_string_words(c: DeviceStringColumn) -> List[jax.Array]:
@@ -72,7 +86,7 @@ def grouping_subkeys(col: AnyDeviceColumn) -> List[jax.Array]:
     normalized zeros so their data words tie."""
     if isinstance(col, DeviceStringColumn):
         return [col.validity, col.lengths] + pack_string_words(col)
-    return [col.validity, rank_u64(col)]
+    return [col.validity] + rank_words(col)
 
 
 class Segments:
@@ -173,6 +187,44 @@ def _winner_gather(seg: Segments, col: AnyDeviceColumn,
     return take_columns([col], safe, valid_at=won)[0]
 
 
+def word_sentinel(dtype, is_min: bool):
+    """A value no real candidate beats: the loser for this word dtype."""
+    if dtype == jnp.bool_:
+        return jnp.array(is_min, dtype=jnp.bool_)
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf if is_min else -jnp.inf, dtype=dtype)
+    if dtype == jnp.uint64:
+        return _U64_MAX if is_min else jnp.uint64(0)
+    info = jnp.iinfo(dtype)
+    return jnp.array(info.max if is_min else info.min, dtype=dtype)
+
+
+def _seg_extreme_words(seg: Segments, col: AnyDeviceColumn,
+                       words: List[jax.Array], is_min: bool
+                       ) -> AnyDeviceColumn:
+    """Tournament over (word0, word1, ...) most-significant first:
+    iteratively keep the rows matching the per-segment best word. The
+    winning ROW is gathered so values round-trip untouched."""
+    valid_s = (col.validity[seg.order]) & seg.active_sorted
+    cap = seg.capacity
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    cand = valid_s
+    for w in words:
+        w_s = w[seg.order]
+        sent = word_sentinel(w_s.dtype, is_min)
+        masked = jnp.where(cand, w_s, sent)
+        seg_op = jax.ops.segment_min if is_min else jax.ops.segment_max
+        best = seg_op(masked, seg.seg_ids, num_segments=cap,
+                      indices_are_sorted=True)
+        cand = cand & (w_s == best[seg.seg_ids])
+    p = jnp.where(cand, pos, jnp.int32(cap))
+    win_pos = jax.ops.segment_min(p, seg.seg_ids, num_segments=cap,
+                                  indices_are_sorted=True)
+    won = (win_pos < cap) & seg.seg_active
+    winner_orig = seg.order[jnp.clip(win_pos, 0, cap - 1)]
+    return _winner_gather(seg, col, winner_orig, won)
+
+
 def seg_extreme(seg: Segments, col: AnyDeviceColumn, is_min: bool
                 ) -> AnyDeviceColumn:
     """min/max by winning-row-index so values round-trip untouched."""
@@ -181,27 +233,7 @@ def seg_extreme(seg: Segments, col: AnyDeviceColumn, is_min: bool
         # segment only if the string is a grouping key*; for arbitrary
         # value columns fall back to word-wise tournament
         return _seg_extreme_string(seg, col, is_min)
-    rank = rank_u64(col)[seg.order]
-    valid_s = (col.validity[seg.order]) & seg.active_sorted
-    if is_min:
-        rank = jnp.where(valid_s, rank, _U64_MAX)
-        best = jax.ops.segment_min(rank, seg.seg_ids,
-                                   num_segments=seg.capacity,
-                                   indices_are_sorted=True)
-    else:
-        rank = jnp.where(valid_s, rank, jnp.uint64(0))
-        best = jax.ops.segment_max(rank, seg.seg_ids,
-                                   num_segments=seg.capacity,
-                                   indices_are_sorted=True)
-    is_winner = valid_s & (rank == best[seg.seg_ids])
-    pos = jnp.arange(seg.capacity, dtype=jnp.int32)
-    cand = jnp.where(is_winner, pos, jnp.int32(seg.capacity))
-    win_pos = jax.ops.segment_min(cand, seg.seg_ids,
-                                  num_segments=seg.capacity,
-                                  indices_are_sorted=True)
-    won = (win_pos < seg.capacity) & seg.seg_active
-    winner_orig = seg.order[jnp.clip(win_pos, 0, seg.capacity - 1)]
-    return _winner_gather(seg, col, winner_orig, won)
+    return _seg_extreme_words(seg, col, rank_words(col), is_min)
 
 
 def _seg_extreme_string(seg: Segments, col: DeviceStringColumn,

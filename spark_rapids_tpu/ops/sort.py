@@ -11,9 +11,10 @@ through ``take_columns`` and the batch is sorted with zero recompilation
 across batches of the same capacity bucket.
 
 Spark ordering semantics handled here (SortUtils.scala / TypeUtils):
-- NaN sorts greater than all floats, all NaNs equal (rank_u64's
-  total-order encoding, shared with the groupby kernel).
-- -0.0 == 0.0 (same encoding).
+- NaN sorts greater than all floats, all NaNs equal (rank_words'
+  [is_nan, nan-zeroed value] float words, shared with the groupby
+  kernel; no 64-bit float bitcasts, which some TPU stacks can't lower).
+- -0.0 == 0.0 (the value word is +0.0-normalized).
 - Strings compare as UTF-8 bytes; zero-padded word packing + length
   tiebreak reproduces binary order exactly (ops/groupby.py
   pack_string_words invariant).
@@ -30,7 +31,7 @@ import jax.numpy as jnp
 
 from spark_rapids_tpu.columnar.device import (AnyDeviceColumn,
                                               DeviceStringColumn)
-from spark_rapids_tpu.ops.groupby import pack_string_words, rank_u64
+from spark_rapids_tpu.ops.groupby import pack_string_words, rank_words
 
 
 def order_subkeys(col: AnyDeviceColumn, ascending: bool,
@@ -38,13 +39,29 @@ def order_subkeys(col: AnyDeviceColumn, ascending: bool,
     """Subkeys (most-significant first) whose joint ascending order equals
     the SortOrder's ordering of this column. The validity key is most
     significant so the null group separates cleanly; null slots hold
-    normalized zeros underneath and tie, keeping the sort stable there."""
+    normalized zeros underneath and tie, keeping the sort stable there.
+
+    Descending reverses each word with its native order-reversing
+    transform: bitwise-not for unsigned words, logical-not for bools, and
+    IEEE negation for the float value word (exact, and every zero in that
+    word is already normalized to +0.0 so negation keeps them tied) —
+    no 64-bit float bitcasts (unsupported on some TPU compile stacks)."""
     if isinstance(col, DeviceStringColumn):
         data_keys = pack_string_words(col) + [col.lengths.astype(jnp.uint64)]
+        if not ascending:
+            data_keys = [~k for k in data_keys]
     else:
-        data_keys = [rank_u64(col)]
-    if not ascending:
-        data_keys = [~k for k in data_keys]
+        data_keys = rank_words(col)
+        if not ascending:
+            inverted = []
+            for k in data_keys:
+                if k.dtype == jnp.bool_:
+                    inverted.append(~k)
+                elif jnp.issubdtype(k.dtype, jnp.floating):
+                    inverted.append(-k)
+                else:
+                    inverted.append(~k)
+            data_keys = inverted
     # False sorts before True: validity as-is puts nulls first
     null_key = col.validity if nulls_first else ~col.validity
     return [null_key] + data_keys
@@ -63,11 +80,3 @@ def sort_permutation(key_cols: Sequence[AnyDeviceColumn],
     return jnp.lexsort(tuple(reversed(keys)) + (~active,))
 
 
-def rank_of_rows(key_cols: Sequence[AnyDeviceColumn], orders: Sequence,
-                 active: jax.Array) -> jax.Array:
-    """Per-row sort rank (0-based among active rows; padding rows get
-    ranks past the active count). Used by range partitioning."""
-    perm = sort_permutation(key_cols, orders, active)
-    cap = active.shape[0]
-    ranks = jnp.zeros(cap, dtype=jnp.int64)
-    return ranks.at[perm].set(jnp.arange(cap, dtype=jnp.int64))

@@ -140,11 +140,17 @@ class Column:
     def desc(self):
         return Column(E.SortOrder(self.expr, ascending=False))
 
+    def asc_nulls_first(self):
+        return Column(E.SortOrder(self.expr, True, nulls_first=True))
+
     def asc_nulls_last(self):
         return Column(E.SortOrder(self.expr, True, nulls_first=False))
 
     def desc_nulls_first(self):
         return Column(E.SortOrder(self.expr, False, nulls_first=True))
+
+    def desc_nulls_last(self):
+        return Column(E.SortOrder(self.expr, False, nulls_first=False))
 
     def when(self, condition: "Column", value) -> "Column":
         raise TypeError("use functions.when(...) to start a CASE expression")
@@ -497,6 +503,10 @@ class Window:
     @staticmethod
     def rowsBetween(start: int, end: int) -> WindowSpec:
         return WindowSpec().rowsBetween(start, end)
+
+    @staticmethod
+    def rangeBetween(start: int, end: int) -> WindowSpec:
+        return WindowSpec().rangeBetween(start, end)
 
 
 def row_number() -> Column:

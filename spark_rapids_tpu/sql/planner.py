@@ -104,6 +104,9 @@ class Planner:
 
     # -- aggregate ---------------------------------------------------------
     def _plan_aggregate(self, p: L.Aggregate) -> P.PhysicalPlan:
+        rewritten = self._rewrite_distinct(p)
+        if rewritten is not None:
+            return self.plan(rewritten)
         child = self.plan(p.child)
         # grouping must be attributes; project aliased keys first, reusing
         # the Alias' own id so result expressions bind to the same attr
@@ -138,6 +141,57 @@ class Planner:
                                                 partial)
         return P.CpuHashAggregateExec(grouping_attrs, aggregates, "final",
                                       exchange, slots)
+
+    def _rewrite_distinct(self, p: L.Aggregate) -> Optional[L.Aggregate]:
+        """DISTINCT aggregates -> dedup-then-aggregate (Spark's
+        RewriteDistinctAggregates single-distinct-group shape): an inner
+        Aggregate on (grouping, distinct children) deduplicates, the
+        outer runs the same functions non-distinct. Mixed distinct +
+        non-distinct aggregates would need Expand; unsupported."""
+        aliases = [e for e in p.aggregates
+                   if isinstance(e, E.Alias)
+                   and isinstance(e.child, E.AggregateExpression)]
+        distinct = [a for a in aliases if a.child.is_distinct]
+        if not distinct:
+            return None
+        if len(distinct) != len(aliases):
+            raise NotImplementedError(
+                "mixing DISTINCT and plain aggregates needs the Expand "
+                "rewrite; split the query instead")
+        child_sets = {tuple(sorted(repr(c) for c in a.child.func.children))
+                      for a in distinct}
+        if len(child_sets) > 1:
+            raise NotImplementedError(
+                "multiple DISTINCT aggregates over different columns need "
+                "the Expand rewrite; split the query instead")
+        inner_items: List[E.Expression] = list(p.grouping)
+        child_attr: dict = {}
+        for a in distinct:
+            for c in a.child.func.children:
+                key = repr(c)
+                if key in child_attr:
+                    continue
+                if isinstance(c, E.AttributeReference):
+                    child_attr[key] = c
+                    inner_items.append(c)
+                else:
+                    al = E.Alias(c, f"_d{len(child_attr)}")
+                    child_attr[key] = al.to_attribute()
+                    inner_items.append(al)
+        inner = L.Aggregate(list(inner_items), list(inner_items), p.child)
+        outer_aggs: List[E.Expression] = []
+        for e in p.aggregates:
+            if e in distinct:
+                func = e.child.func
+                new_children = [child_attr[repr(c)]
+                                for c in func.children]
+                new_func = func.with_children(new_children)
+                outer_aggs.append(E.Alias(
+                    E.AggregateExpression(new_func, is_distinct=False),
+                    e.name, expr_id=e.expr_id))
+            else:
+                outer_aggs.append(e)
+        return L.Aggregate(list(p.grouping), outer_aggs, inner)
 
     # -- join --------------------------------------------------------------
     def _plan_join(self, p: L.Join) -> P.PhysicalPlan:

@@ -1,0 +1,133 @@
+"""HBM accounting + spill tests (RapidsBufferCatalog /
+SpillableColumnarBatch coverage): exchanges and final aggregation over a
+deliberately tiny device budget must complete correctly WITH spills.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import memory as MEM
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+from spark_rapids_tpu.columnar.device import DeviceBatch
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql import types as T
+from spark_rapids_tpu.sql.session import TpuSparkSession
+
+from tests.datagen import IntegerGen, LongGen, SmallIntGen, gen_batch
+from tests.harness import assert_tpu_and_cpu_equal_collect
+
+
+def _store_for(budget, host_budget=1 << 30, spill_dir="/tmp/srt_spill_t"):
+    return MEM.DeviceStore(budget, host_budget, spill_dir)
+
+
+def _batch(n=256, seed=0):
+    rng = np.random.default_rng(seed)
+    col = HostColumn(T.LongT, rng.integers(0, 1 << 40, n),
+                     np.ones(n, dtype=bool))
+    return DeviceBatch.from_host(
+        HostBatch(T.StructType([T.StructField("v", T.LongT)]), [col], n))
+
+
+def test_store_spills_lru_and_repromotes():
+    b1, b2, b3 = _batch(256, 1), _batch(256, 2), _batch(256, 3)
+    budget = b1.sizeof() * 2 + 10
+    store = _store_for(budget)
+    h1, h2, h3 = (store.register(b) for b in (b1, b2, b3))
+    assert store.spill_count >= 1            # h1 went to host (LRU)
+    assert store.device_bytes <= budget
+    out1 = h1.get()                          # re-promotes, evicts another
+    assert out1.row_count() == 256
+    got = np.asarray(out1.columns[0].data)[:256]
+    want = np.asarray(b1.columns[0].data)[:256]
+    assert (got == want).all()
+    for h in (h1, h2, h3):
+        h.close()
+    assert store.device_bytes == 0 and store.host_bytes == 0
+
+
+def test_store_disk_tier(tmp_path):
+    b1, b2 = _batch(512, 4), _batch(512, 5)
+    store = MEM.DeviceStore(device_budget=b1.sizeof() + 10,
+                            host_budget=100,  # force host -> disk
+                            spill_dir=str(tmp_path))
+    h1 = store.register(b1)
+    h2 = store.register(b2)
+    assert store.disk_spill_count >= 1
+    got = np.asarray(h1.get().columns[0].data)[:512]
+    want = np.asarray(b1.columns[0].data)[:512]
+    assert (got == want).all()
+    h1.close()
+    h2.close()
+
+
+def test_exchange_completes_under_tiny_budget_with_spill():
+    """An exchange whose materialized output exceeds the HBM budget by far
+    must still produce exact results, with spill metrics > 0."""
+    conf = {
+        "spark.rapids.memory.tpu.poolSize": str(64 << 10),  # 64 KiB
+    }
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: s.createDataFrame(
+            gen_batch([("k", SmallIntGen()), ("v", LongGen())], 4000, 21),
+            num_partitions=4)
+        .repartition(8, "k").groupBy("k").agg(F.sum("v").alias("s"),
+                                              F.count("*").alias("c")),
+        conf=conf,
+        expect_execs=["TpuExchange", "TpuHashAggregate"])
+    store = MEM.get_device_store.__globals__["_STORE"]
+    assert store is not None and store.spill_count > 0
+    assert store.peak_device_bytes > 0
+
+
+def test_global_sort_under_tiny_budget():
+    conf = {"spark.rapids.memory.tpu.poolSize": str(64 << 10)}
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: s.createDataFrame(
+            gen_batch([("a", LongGen()), ("b", IntegerGen())], 3000, 22),
+            num_partitions=4).orderBy("a", "b"),
+        conf=conf, ignore_order=False,
+        expect_execs=["TpuSort", "TpuExchange"])
+
+
+def test_final_agg_bounded_merge():
+    """Many partial batches with a small batchSizeRows force multi-round
+    bounded merging; results must stay exact."""
+    conf = {
+        "spark.rapids.sql.batchSizeRows": "256",
+        "spark.rapids.memory.tpu.poolSize": str(64 << 10),
+    }
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: s.createDataFrame(
+            gen_batch([("k", IntegerGen()), ("v", LongGen())], 5000, 23),
+            num_partitions=6)
+        .groupBy("k").agg(F.sum("v").alias("s"), F.min("v").alias("mn"),
+                          F.max("v").alias("mx"), F.count("v").alias("c")),
+        conf=conf,
+        expect_execs=["TpuHashAggregate"])
+
+
+def test_range_partition_ragged_string_keys():
+    """Batches whose longest strings land in different char-cap buckets
+    must still rank globally (per-batch subkey word counts differ)."""
+    def fn(s):
+        a = ["x" * 3, "zz", "a"]
+        b = ["y" * 20, "x" * 17, "b"]
+        return s.createDataFrame({"v": a + b, "i": list(range(6))},
+                                 "v string, i int",
+                                 num_partitions=2).orderBy("v")
+    assert_tpu_and_cpu_equal_collect(fn, ignore_order=False,
+                                     expect_execs=["TpuSort"])
+
+
+def test_range_partition_after_filter_under_tiny_budget():
+    """Scattered active masks + spill round-trips: the remapped pids must
+    still land every row in its rank-correct range partition."""
+    conf = {"spark.rapids.memory.tpu.poolSize": str(32 << 10)}
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: s.createDataFrame(
+            gen_batch([("a", LongGen()), ("b", IntegerGen())], 4000, 31),
+            num_partitions=5)
+        .filter(F.col("b") % 3 != 0).orderBy("a", "b"),
+        conf=conf, ignore_order=False,
+        expect_execs=["TpuSort", "TpuExchange"])

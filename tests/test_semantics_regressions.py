@@ -77,10 +77,12 @@ def test_ddl_with_decimal(spark):
     assert df.collect()[0].d == Decimal("3.14")
 
 
-def test_count_distinct_fails_loudly(spark):
-    df = spark.createDataFrame({"x": [1, 1, 2]}, "x int")
-    with pytest.raises(NotImplementedError):
-        df.agg(F.countDistinct("x")).collect()
+def test_count_distinct(spark):
+    """DISTINCT aggregates execute via the planner's dedup-then-aggregate
+    rewrite (RewriteDistinctAggregates single-group shape)."""
+    df = spark.createDataFrame({"x": [1, 1, 2, None]}, "x int")
+    out = df.agg(F.countDistinct("x").alias("c")).collect()
+    assert out[0].c == 2
 
 
 def test_drop_duplicates(spark):

@@ -1,0 +1,135 @@
+"""spark.sql() / selectExpr / string-filter tests (the Catalyst-parser
+role; dual-session equality like every other surface).
+"""
+
+import pytest
+
+from spark_rapids_tpu.sql.session import TpuSparkSession
+
+from tests.datagen import (DoubleGen, IntegerGen, KeyStringGen, LongGen,
+                           SmallIntGen, gen_batch)
+from tests.harness import assert_tpu_and_cpu_equal_collect
+
+
+def _with_views(s):
+    df = s.createDataFrame(
+        gen_batch([("k", SmallIntGen()), ("v", LongGen()),
+                   ("s", KeyStringGen())], 400, 17), num_partitions=3)
+    df.createOrReplaceTempView("t")
+    dim = s.createDataFrame(
+        gen_batch([("k2", SmallIntGen()), ("w", IntegerGen())], 80, 18),
+        num_partitions=2)
+    dim.createOrReplaceTempView("dim")
+    return s
+
+
+@pytest.mark.parametrize("q", [
+    "SELECT k, v FROM t WHERE v > 0 AND k IS NOT NULL",
+    "SELECT k + 1 AS k1, v * 2 AS v2 FROM t",
+    "SELECT DISTINCT k FROM t",
+    "SELECT * FROM t WHERE s LIKE 'k%' OR v BETWEEN 0 AND 100",
+    "SELECT k, CASE WHEN v > 0 THEN 'pos' WHEN v < 0 THEN 'neg' "
+    "ELSE 'zero' END AS sign FROM t",
+    "SELECT CAST(v AS int) AS vi, upper(s) AS u FROM t",
+    "SELECT k FROM t WHERE k IN (1, 2, 3)",
+    "SELECT s, sum(v) AS sv, count(*) AS c, min(v) AS mn FROM t "
+    "GROUP BY s",
+    "SELECT k, sum(v) AS sv FROM t GROUP BY k HAVING count(*) > 5",
+    "SELECT k, v FROM t ORDER BY v DESC, k ASC NULLS LAST LIMIT 25",
+    "SELECT t.k, t.v, dim.w FROM t JOIN dim ON t.k = dim.k2",
+    "SELECT t.k FROM t LEFT JOIN dim ON t.k = dim.k2 WHERE dim.w IS NULL",
+    "SELECT a.k, a.sv FROM (SELECT k, sum(v) AS sv FROM t GROUP BY k) a "
+    "WHERE a.sv > 0",
+    "SELECT k FROM t WHERE v > 0 UNION ALL SELECT k2 FROM dim",
+    "SELECT k, v, row_number() OVER (PARTITION BY k ORDER BY v) AS rn "
+    "FROM t",
+    "SELECT count(DISTINCT k) AS dk FROM t",
+    "SELECT sum(v) AS total FROM t",
+])
+def test_sql_queries_dual_engine(q):
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: _with_views(s).sql(q), require_device=False)
+
+
+def test_sql_exact_values():
+    s = TpuSparkSession({"spark.rapids.sql.enabled": "true"})
+    try:
+        s.createDataFrame({"k": [1, 1, 2], "v": [10, 20, 5]},
+                          "k int, v int").createOrReplaceTempView("x")
+        got = s.sql("SELECT k, sum(v) AS sv FROM x GROUP BY k "
+                    "ORDER BY k").collect()
+        assert [(r.k, r.sv) for r in got] == [(1, 30), (2, 5)]
+        one = s.sql("SELECT max(v) AS m, count(*) AS c FROM x").collect()
+        assert [(one[0].m, one[0].c)] == [(20, 3)]
+    finally:
+        s.stop()
+
+
+def test_select_expr_and_string_filter():
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: s.createDataFrame(
+            gen_batch([("a", IntegerGen()), ("b", LongGen())], 300, 19))
+        .selectExpr("a + b AS ab", "abs(a) AS aa", "a % 7 AS am")
+        .filter("ab IS NOT NULL AND am > 1"),
+        require_device=False)
+
+
+def test_sql_window_in_text():
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: _with_views(s).sql(
+            "SELECT k, v, sum(v) OVER (PARTITION BY k ORDER BY v) AS rs, "
+            "lag(v, 1) OVER (PARTITION BY k ORDER BY v) AS lg FROM t"),
+        require_device=False)
+
+
+def test_sql_syntax_error():
+    s = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        s.createDataFrame({"a": [1]}, "a int").createOrReplaceTempView("z")
+        with pytest.raises(Exception):
+            s.sql("SELECT FROM WHERE")
+        with pytest.raises(Exception):
+            s.sql("SELECT a FROM z trailing junk here ,")
+    finally:
+        s.stop()
+
+
+def test_sql_distinct_before_order_limit():
+    s = TpuSparkSession({"spark.rapids.sql.enabled": "true"})
+    try:
+        s.createDataFrame({"x": [1, 1, 1, 2, 3]},
+                          "x int").createOrReplaceTempView("d")
+        got = sorted(r.x for r in s.sql(
+            "SELECT DISTINCT x FROM d LIMIT 2").collect())
+        assert len(got) == 2 and set(got) <= {1, 2, 3}
+        ordered = [r.x for r in s.sql(
+            "SELECT DISTINCT x FROM d ORDER BY x DESC").collect()]
+        assert ordered == [3, 2, 1]
+    finally:
+        s.stop()
+
+
+def test_sql_sum_distinct():
+    s = TpuSparkSession({"spark.rapids.sql.enabled": "true"})
+    try:
+        s.createDataFrame({"x": [5, 5, 3], "k": [1, 1, 1]},
+                          "x int, k int").createOrReplaceTempView("sd")
+        got = s.sql("SELECT sum(DISTINCT x) AS sx FROM sd").collect()
+        assert got[0].sx == 8
+        got2 = s.sql("SELECT k, count(DISTINCT x) AS cx FROM sd "
+                     "GROUP BY k").collect()
+        assert [(r.k, r.cx) for r in got2] == [(1, 2)]
+    finally:
+        s.stop()
+
+
+def test_multiple_distinct_over_different_columns_rejected():
+    s = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        s.createDataFrame({"a": [1, 1], "b": [1, 2]},
+                          "a int, b int").createOrReplaceTempView("md")
+        with pytest.raises(NotImplementedError):
+            s.sql("SELECT count(DISTINCT a) AS ca, count(DISTINCT b) AS cb "
+                  "FROM md").collect()
+    finally:
+        s.stop()
