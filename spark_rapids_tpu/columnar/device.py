@@ -40,6 +40,11 @@ MIN_CAPACITY = 64
 DEFAULT_CHAR_CAP = 32
 
 
+@jax.jit
+def _count_active(active: jax.Array) -> jax.Array:
+    return jnp.sum(active)
+
+
 def bucket_capacity(n: int) -> int:
     """Round up to the next power of two, floored at MIN_CAPACITY."""
     if n <= MIN_CAPACITY:
@@ -162,7 +167,9 @@ class DeviceBatch:
 
     def row_count(self) -> int:
         if self._num_rows is None:
-            self._num_rows = int(jnp.sum(self.active))
+            # jitted: an EAGER jnp.sum pays a per-op dispatch handshake
+            # (~100ms on tunneled TPU backends)
+            self._num_rows = int(_count_active(self.active))
         return self._num_rows
 
     def with_columns(self, schema: T.StructType,
@@ -184,16 +191,33 @@ class DeviceBatch:
                   device: Optional[jax.Device] = None) -> "DeviceBatch":
         cap = capacity or bucket_capacity(max(1, batch.num_rows))
         assert cap >= batch.num_rows, (cap, batch.num_rows)
-        cols: List[AnyDeviceColumn] = []
+        # stage every buffer on the host first, then ONE device_put for
+        # the whole batch (per-array uploads pay a ~100ms dispatch
+        # handshake each on tunneled TPU backends)
+        np_arrays: List[np.ndarray] = []
+        spec: List[Tuple[T.DataType, int]] = []
         for f, c in zip(batch.schema.fields, batch.columns):
-            cols.append(_host_col_to_device(c, f.data_type, cap, device))
+            parts = _host_col_np(c, f.data_type, cap)
+            spec.append((f.data_type, len(parts)))
+            np_arrays.extend(parts)
         active_np = np.zeros(cap, dtype=bool)
         active_np[:batch.num_rows] = True
-        active = _put(active_np, device)
-        return DeviceBatch(batch.schema, cols, active, batch.num_rows)
+        np_arrays.append(active_np)
+        if device is not None:
+            dev = jax.device_put(np_arrays, device)
+        else:
+            dev = jax.device_put(np_arrays)
+        cols = rebuild_columns(spec, dev[:-1])
+        return DeviceBatch(batch.schema, cols, dev[-1], batch.num_rows)
 
     def to_host(self) -> HostBatch:
-        """Gather active rows back to a HostBatch (device -> host copy)."""
+        """Gather active rows back to a HostBatch (device -> host copy).
+        All buffers are prefetched CONCURRENTLY: on tunneled backends
+        each fetch is a ~45ms round trip, so serial per-array fetches
+        dominate wall clock; jax caches the host copy, making the
+        per-column np.asarray below free."""
+        _prefetch_host([self.active]
+                       + [a for c in self.columns for a in c.arrays()])
         active = np.asarray(self.active)
         idx = np.nonzero(active)[0]
         cols: List[HostColumn] = []
@@ -208,14 +232,30 @@ class DeviceBatch:
         return DeviceBatch.from_host(HostBatch.empty(schema), capacity)
 
 
+_FETCH_POOL = None
+
+
+def _prefetch_host(arrays: List[jax.Array]) -> None:
+    global _FETCH_POOL
+    if len(arrays) <= 1:
+        return
+    if _FETCH_POOL is None:
+        from concurrent.futures import ThreadPoolExecutor
+        _FETCH_POOL = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="srt-fetch")
+    list(_FETCH_POOL.map(np.asarray, arrays))
+
+
 def _put(arr: np.ndarray, device: Optional[jax.Device]) -> jax.Array:
     if device is not None:
         return jax.device_put(arr, device)
     return jnp.asarray(arr)
 
 
-def _host_col_to_device(c: HostColumn, dt: T.DataType, cap: int,
-                        device: Optional[jax.Device]) -> AnyDeviceColumn:
+def _host_col_np(c: HostColumn, dt: T.DataType,
+                 cap: int) -> List[np.ndarray]:
+    """Host-side staging buffers for one column (uploaded in one batch
+    by from_host)."""
     n = len(c)
     validity = np.zeros(cap, dtype=bool)
     validity[:n] = c.validity
@@ -236,14 +276,12 @@ def _host_col_to_device(c: HostColumn, dt: T.DataType, cap: int,
         for i, b in enumerate(encoded):
             chars[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
             lengths[i] = len(b)
-        return DeviceStringColumn(dt, _put(chars, device),
-                                  _put(lengths, device),
-                                  _put(validity, device))
+        return [chars, lengths, validity]
     np_dt = T.numpy_dtype(dt)
     data = np.zeros(cap, dtype=np_dt)
     # normalized() zeroes invalid slots on the host side already
     data[:n] = c.normalized().data
-    return DeviceColumn(dt, _put(data, device), _put(validity, device))
+    return [data, validity]
 
 
 def _device_col_to_host(c: AnyDeviceColumn, dt: T.DataType,
@@ -267,55 +305,124 @@ def _device_col_to_host(c: AnyDeviceColumn, dt: T.DataType,
     return HostColumn(dt, data.copy(), validity.copy()).normalized()
 
 
+# One fused program per (input shape-set, output capacity): eager
+# op-by-op dispatch costs ~100ms per op on tunneled TPU backends, so the
+# whole concatenation must be a single XLA executable.
+_CONCAT_CACHE: dict = {}
+
+
 def concat_device(batches: Sequence[DeviceBatch]) -> DeviceBatch:
     """Device-side Table.concatenate: compact all actives into one batch.
 
-    Output capacity = bucket(total active rows); fixed-shape per input
-    (gather into slices), so XLA sees only bucketed shapes.
+    Output capacity = bucket(total active rows). ONE jitted program
+    (cached on input shapes + output capacity): each compacted input is
+    written at its traced row offset in FORWARD order, so every write
+    repairs the previous input's zero padding — full-capacity updates
+    with dynamic offsets, no dynamic shapes. A sum-of-capacities scratch
+    guards against XLA's update-slice start clamping, then a static
+    slice takes the bucketed prefix.
     """
     assert batches
+    if len(batches) == 1:
+        return batches[0]
     schema = batches[0].schema
     counts = [b.row_count() for b in batches]
     total = sum(counts)
     cap = bucket_capacity(max(1, total))
     compacted = [compact(b) for b in batches]
-    cols: List[AnyDeviceColumn] = []
+    # normalize string char widths per column (static shape property)
+    char_caps: List[int] = []
     for ci, f in enumerate(schema.fields):
-        parts = [b.columns[ci] for b in compacted]
         if is_string_like(f.data_type):
-            char_cap = max(p.char_cap for p in parts)
-            chars = jnp.zeros((cap, char_cap), dtype=jnp.uint8)
-            lengths = jnp.zeros(cap, dtype=jnp.int32)
-            validity = jnp.zeros(cap, dtype=bool)
-            off = 0
-            for p, n in zip(parts, counts):
-                if n == 0:
-                    continue
-                pc = p.chars[:n]
-                if p.char_cap < char_cap:
-                    pc = jnp.pad(pc, ((0, 0), (0, char_cap - p.char_cap)))
-                chars = jax.lax.dynamic_update_slice(chars, pc, (off, 0))
-                lengths = jax.lax.dynamic_update_slice(
-                    lengths, p.lengths[:n], (off,))
-                validity = jax.lax.dynamic_update_slice(
-                    validity, p.validity[:n], (off,))
-                off += n
-            cols.append(DeviceStringColumn(f.data_type, chars, lengths,
-                                           validity))
+            char_caps.append(max(b.columns[ci].char_cap for b in compacted))
         else:
-            data = jnp.zeros(cap, dtype=storage_jnp_dtype(f.data_type))
-            validity = jnp.zeros(cap, dtype=bool)
-            off = 0
-            for p, n in zip(parts, counts):
-                if n == 0:
-                    continue
-                data = jax.lax.dynamic_update_slice(data, p.data[:n], (off,))
-                validity = jax.lax.dynamic_update_slice(
-                    validity, p.validity[:n], (off,))
-                off += n
-            cols.append(DeviceColumn(f.data_type, data, validity))
-    active = jnp.arange(cap) < total
+            char_caps.append(0)
+
+    flats = []
+    specs = []
+    for b in compacted:
+        flat, spec = flatten_batch(b)
+        flats.append(flat)
+        specs.append(spec)
+    shapes = tuple(tuple((a.shape, str(a.dtype)) for a in flat)
+                   for flat in flats)
+    key = (shapes, cap, tuple(char_caps))
+    fn = _CONCAT_CACHE.get(key)
+    if fn is None:
+        n_arrays = len(flats[0])
+        # scratch must cover BOTH the forward-write extent (sum of input
+        # capacities) and the output bucket (which can exceed it when
+        # inputs are fully active)
+        caps_sum = max(sum(b.capacity for b in compacted), cap)
+        # per-array target char width (2-D arrays only)
+        arr_widths: List[int] = []
+        for ci, (dt, n_arr) in enumerate(specs[0]):
+            for k in range(n_arr):
+                arr_widths.append(char_caps[ci])
+
+        def _fn(counts_arr, *all_flat):
+            offs = jnp.concatenate([
+                jnp.zeros(1, jnp.int64), jnp.cumsum(counts_arr)])
+            outs = []
+            for ai in range(n_arrays):
+                first = all_flat[ai]
+                if first.ndim == 2:
+                    cc = arr_widths[ai]
+                    big = jnp.zeros((caps_sum, cc), dtype=first.dtype)
+                    for bi in range(len(flats)):
+                        a = all_flat[bi * n_arrays + ai]
+                        big = jax.lax.dynamic_update_slice(
+                            big, a, (offs[bi], jnp.int64(0)))
+                    outs.append(big[:cap])
+                else:
+                    big = jnp.zeros(caps_sum, dtype=first.dtype)
+                    for bi in range(len(flats)):
+                        a = all_flat[bi * n_arrays + ai]
+                        big = jax.lax.dynamic_update_slice(
+                            big, a, (offs[bi],))
+                    outs.append(big[:cap])
+            total_t = offs[len(flats)]
+            active = jnp.arange(cap) < total_t
+            return active, tuple(outs)
+        fn = jax.jit(_fn)
+        _CONCAT_CACHE[key] = fn
+    counts_arr = jnp.asarray(np.asarray(counts, dtype=np.int64))
+    all_flat = [a for flat in flats for a in flat]
+    active, outs = fn(counts_arr, *all_flat)
+    cols = rebuild_columns(specs[0], outs)
     return DeviceBatch(schema, cols, active, total)
+
+
+def mask_col(c: AnyDeviceColumn, keep: jax.Array) -> AnyDeviceColumn:
+    """Null out rows outside `keep` (normalized zeros underneath)."""
+    if isinstance(c, DeviceStringColumn):
+        v = c.validity & keep
+        return DeviceStringColumn(
+            c.dtype, jnp.where(v[:, None], c.chars, 0),
+            jnp.where(v, c.lengths, 0), v)
+    v = c.validity & keep
+    return DeviceColumn(c.dtype, jnp.where(v, c.data,
+                                           jnp.zeros((), c.data.dtype)), v)
+
+
+def sort_with_payload(keys: Sequence[jax.Array],
+                      payload: Sequence[jax.Array]):
+    """ONE multi-operand lax.sort: lexicographic by `keys` (row index
+    appended as the final key, so the sort is total/stable) with
+    `payload` arrays co-permuted. Returns (sorted_keys, order,
+    sorted_payload). On TPU this is ~16x cheaper than sorting an index
+    and gathering each payload array (random gathers are HBM-bound).
+    2-D payloads (string byte matrices) fall back to one order-gather."""
+    cap = keys[0].shape[0]
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    ks = tuple(keys) + (pos,)
+    one_d = tuple(a for a in payload if a.ndim == 1)
+    out = jax.lax.sort(ks + one_d, num_keys=len(ks))
+    order = out[len(ks) - 1]
+    it = iter(out[len(ks):])
+    sorted_payload = [jnp.take(a, order, axis=0) if a.ndim == 2
+                      else next(it) for a in payload]
+    return out[:len(keys)], order, sorted_payload
 
 
 def _compaction_order(active: jax.Array) -> jax.Array:
@@ -351,16 +458,14 @@ def take_columns(columns: Sequence[AnyDeviceColumn], idx: jax.Array,
     return out
 
 
-@jax.jit
-def _compact_arrays(active: jax.Array, *flat: jax.Array):
-    order = _compaction_order(active)
+def _compact_body(active: jax.Array, flat):
+    _keys, _order, sorted_flat = sort_with_payload([~active], flat)
     n = jnp.sum(active)
     new_active = jnp.arange(active.shape[0]) < n
     outs = []
-    for a in flat:
-        g = a[order]
+    for g in sorted_flat:
         # zero out the padding tail for determinism
-        if a.ndim == 2:
+        if g.ndim == 2:
             g = jnp.where(new_active[:, None], g, 0)
         else:
             g = jnp.where(new_active, g, jnp.zeros((), dtype=g.dtype))
@@ -368,17 +473,29 @@ def _compact_arrays(active: jax.Array, *flat: jax.Array):
     return new_active, tuple(outs)
 
 
-def flatten_batch(batch: DeviceBatch
-                  ) -> Tuple[List[jax.Array], List[Tuple[T.DataType, int]]]:
+@jax.jit
+def _compact_arrays(active: jax.Array, *flat: jax.Array):
+    return _compact_body(active, flat)
+
+
+def flatten_columns(columns: Sequence[AnyDeviceColumn]
+                    ) -> Tuple[List[jax.Array], List[Tuple[T.DataType, int]]]:
     """Flatten column arrays + per-column (dtype, arity) spec; inverse is
-    rebuild_columns. Shared by compaction and the split/serialize kernels."""
+    rebuild_columns."""
     flat: List[jax.Array] = []
     spec: List[Tuple[T.DataType, int]] = []
-    for c in batch.columns:
+    for c in columns:
         arrs = c.arrays()
         spec.append((c.dtype, len(arrs)))
         flat.extend(arrs)
     return flat, spec
+
+
+def flatten_batch(batch: DeviceBatch
+                  ) -> Tuple[List[jax.Array], List[Tuple[T.DataType, int]]]:
+    """Flatten a batch's column arrays (see flatten_columns). Shared by
+    compaction and the split/serialize kernels."""
+    return flatten_columns(batch.columns)
 
 
 def rebuild_columns(spec: Sequence[Tuple[T.DataType, int]],
@@ -399,21 +516,27 @@ def compact(batch: DeviceBatch) -> DeviceBatch:
     return DeviceBatch(batch.schema, cols, new_active, batch._num_rows)
 
 
+_SHRINK_CACHE: dict = {}
+
+
 def shrink_to_bucket(batch: DeviceBatch) -> DeviceBatch:
     """Compact, then if the active count fits a smaller capacity bucket,
-    slice down to it (keeps shuffle payloads tight)."""
-    n = batch.row_count()
+    slice down to it (keeps shuffle payloads tight). Compaction + slice
+    run as ONE jitted program per (shape-set, target capacity)."""
+    n = batch.row_count()  # the one necessary host sync (sizes the bucket)
     cap = bucket_capacity(max(1, n))
     if cap >= batch.capacity:
         return compact(batch)
-    c = compact(batch)
-    cols: List[AnyDeviceColumn] = []
-    for col in c.columns:
-        if isinstance(col, DeviceStringColumn):
-            cols.append(DeviceStringColumn(
-                col.dtype, col.chars[:cap], col.lengths[:cap],
-                col.validity[:cap]))
-        else:
-            cols.append(DeviceColumn(col.dtype, col.data[:cap],
-                                     col.validity[:cap]))
-    return DeviceBatch(c.schema, cols, c.active[:cap], n)
+    flat, spec = flatten_batch(batch)
+    key = (tuple((a.shape, str(a.dtype)) for a in flat), cap)
+    fn = _SHRINK_CACHE.get(key)
+    if fn is None:
+        def _fn(active, *arrs):
+            new_active, outs = _compact_body(active, arrs)
+            return new_active[:cap], tuple(
+                (a[:cap] if a.ndim == 1 else a[:cap, :]) for a in outs)
+        fn = jax.jit(_fn)
+        _SHRINK_CACHE[key] = fn
+    new_active, outs = fn(batch.active, *flat)
+    return DeviceBatch(batch.schema, rebuild_columns(spec, outs),
+                       new_active, n)

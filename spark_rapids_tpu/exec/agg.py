@@ -22,7 +22,7 @@ import jax.numpy as jnp
 
 from spark_rapids_tpu import metrics as M
 from spark_rapids_tpu.columnar.device import (
-    AnyDeviceColumn, DeviceBatch, DeviceColumn, concat_device,
+    AnyDeviceColumn, DeviceBatch, DeviceColumn, concat_device, mask_col,
     shrink_to_bucket, take_columns)
 from spark_rapids_tpu.columnar.host import HostColumn
 from spark_rapids_tpu.conf import TpuConf
@@ -124,6 +124,9 @@ def is_device_agg(grouping: List[E.AttributeReference],
                     s[3], E.Expression) else None
                 if r:
                     return r
+                if isinstance(s[3], E.Expression) and \
+                        X.contains_ansi_cast(s[3]):
+                    return "ANSI casts in aggregate inputs run on CPU" 
                 if isinstance(s[1], T.DecimalType):
                     return "decimal aggregate buffers run on CPU"
     return None
@@ -194,20 +197,26 @@ class TpuHashAggregateExec(TpuExec):
         all_exprs = tuple(key_bound) + tuple(slot_srcs)
 
         def fn(cols, active, lit_vals):
+            from spark_rapids_tpu.columnar.device import (flatten_columns,
+                                                          rebuild_columns)
             cap = active.shape[0]
             ctx = X.Ctx(cols, cap, all_exprs, lit_vals)
             key_cols = [X.dev_eval(e, ctx) for e in key_bound]
-            if grouping:
-                seg = G.build_segments(key_cols, active)
-            else:
-                # single global segment over active rows
-                seg = G.build_segments([], active)
             slot_vals = [X.dev_eval(e, ctx) for e in slot_srcs]
+            # keys AND slot values ride the segment sort as payload (one
+            # multi-operand lax.sort; sort-then-gather is ~16x slower on
+            # TPU for wide rows)
+            flat, spec = flatten_columns(key_cols + slot_vals)
+            seg = G.build_segments(key_cols, active, payload=flat)
+            sorted_cols = rebuild_columns(spec, seg.payload)
+            keys_s = sorted_cols[:len(key_cols)]
+            vals_s = sorted_cols[len(key_cols):]
             buffers = [apply_prim_device(p, seg, v, dt)
-                       for (p, dt), v in zip(prims, slot_vals)]
-            out_active = seg.seg_active
-            rep = G.representative_rows(seg)
-            key_out = take_columns(key_cols, rep, valid_at=out_active) \
+                       for (p, dt), v in zip(prims, vals_s)]
+            # results live at segment-END rows of the sorted layout;
+            # the keys are ALREADY in that layout — just mask them
+            out_active = seg.out_active
+            key_out = [mask_col(c, out_active) for c in keys_s] \
                 if grouping else []
 
             if mode in ("partial", "merge"):
@@ -343,11 +352,15 @@ class TpuHashAggregateExec(TpuExec):
         def make(thunk: DevicePartitionThunk) -> DevicePartitionThunk:
             def run() -> Iterator[DeviceBatch]:
                 if self.mode == "partial":
-                    # per-batch partial aggregation, no concat needed
+                    # per-batch partial aggregation, no concat needed.
+                    # Empty INPUTS aggregate harmlessly (zero segments);
+                    # checking the OUTPUT count instead avoids one host
+                    # sync per batch (shrink syncs anyway to size its
+                    # bucket).
                     for b in thunk():
-                        if b.row_count() == 0:
-                            continue
-                        yield shrink_to_bucket(self._aggregate_batch(b))
+                        out = shrink_to_bucket(self._aggregate_batch(b))
+                        if out.row_count():
+                            yield out
                     return
                 from spark_rapids_tpu.memory import get_device_store
                 store = get_device_store(self.conf)

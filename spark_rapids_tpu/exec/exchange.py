@@ -110,8 +110,9 @@ def global_range_pids(order: List[E.Expression],
     active = jnp.concatenate(actives)
     perm = jnp.lexsort(tuple(reversed(combined)) + (~active,))
     cap = active.shape[0]
-    ranks = jnp.zeros(cap, dtype=jnp.int64).at[perm].set(
-        jnp.arange(cap, dtype=jnp.int64))
+    # rank of row p = its sorted position = inverse permutation (a sort,
+    # not a scatter — scatters serialize on TPU)
+    ranks = jnp.argsort(perm).astype(jnp.int64)
     total = jnp.maximum(jnp.sum(active), 1)
     pids = jnp.minimum((ranks * n) // total, n - 1).astype(jnp.int32)
     out: List[jax.Array] = []
@@ -135,10 +136,17 @@ def split_by_pid(batch: DeviceBatch, pids: jax.Array, n: int
     sort_fn = _SORT_CACHE.get(skey)
     if sort_fn is None:
         def _sort(pids, active, *arrs):
+            from spark_rapids_tpu.columnar.device import sort_with_payload
             key = jnp.where(active, pids, jnp.int32(n))
-            counts = jnp.bincount(key, length=n + 1)[:n]
-            order = jnp.argsort(key, stable=True)
-            return counts, tuple(a[order] for a in arrs)
+            (sorted_key,), _order, sorted_arrs = sort_with_payload(
+                [key], arrs)
+            # counts via binary search over the sorted keys (n+1 tiny
+            # queries) — bincount is a scatter-add, slow on TPU
+            edges = jnp.searchsorted(sorted_key,
+                                     jnp.arange(n + 1, dtype=jnp.int32),
+                                     side="left")
+            counts = edges[1:] - edges[:-1]
+            return counts, tuple(sorted_arrs)
         sort_fn = jax.jit(_sort)
         _SORT_CACHE[skey] = sort_fn
     counts_d, sorted_flat = sort_fn(pids, batch.active, *flat)

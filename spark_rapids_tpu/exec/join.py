@@ -38,6 +38,8 @@ def is_device_join(join_type: str, left_keys: List[E.Expression],
         r = X.is_device_expr(condition, conf)
         if r:
             return r
+        if X.contains_ansi_cast(condition):
+            return "ANSI casts in join conditions run on CPU" 
     for lk, rk in zip(left_keys, right_keys):
         for e in (lk, rk):
             dt = e.data_type
@@ -48,6 +50,8 @@ def is_device_join(join_type: str, left_keys: List[E.Expression],
             r = X.is_device_expr(e, conf)
             if r:
                 return r
+            if X.contains_ansi_cast(e):
+                return "ANSI casts in join keys run on CPU" 
         if type(lk.data_type) is not type(rk.data_type):
             return (f"mismatched join key types {lk.data_type} vs "
                     f"{rk.data_type} run on CPU")

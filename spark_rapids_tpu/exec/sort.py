@@ -45,6 +45,8 @@ def is_device_sort(order: List[E.SortOrder], conf: TpuConf):
         r = X.is_device_expr(o.child, conf)
         if r:
             return r
+        if X.contains_ansi_cast(o.child):
+            return "ANSI casts in sort keys run on CPU"
     return None
 
 
@@ -61,16 +63,27 @@ def sorted_batch(order: List[E.SortOrder], bound: List[E.Expression],
         bound_t = tuple(bound)
 
         def _fn(cols, active, lit_vals):
+            from spark_rapids_tpu.columnar.device import (
+                flatten_columns, rebuild_columns, sort_with_payload)
             cap = active.shape[0]
             ctx = X.Ctx(cols, cap, bound_t, lit_vals)
             key_cols = [X.dev_eval(e, ctx) for e in bound_t]
-            perm = S.sort_permutation(key_cols, orders, active)
+            # every column array rides the sort as payload (one
+            # multi-operand lax.sort; sort+gather is far slower on TPU)
+            subkeys: list = [~active]
+            for c, o in zip(key_cols, orders):
+                subkeys.extend(
+                    S.order_subkeys(c, o.ascending, o.nulls_first))
+            flat, spec = flatten_columns(cols)
+            _k, _order, sorted_flat = sort_with_payload(subkeys, flat)
             n = jnp.sum(active)
             if limit >= 0:
                 n = jnp.minimum(n, limit)
             new_active = jnp.arange(cap) < n
-            out = take_columns(cols, perm, valid_at=new_active)
-            return [c.arrays() for c in out], new_active
+            from spark_rapids_tpu.columnar.device import mask_col
+            out = [mask_col(c, new_active).arrays()
+                   for c in rebuild_columns(spec, sorted_flat)]
+            return out, new_active
         fn = jax.jit(_fn)
         _SORT_FN_CACHE[key] = fn
     arrs, new_active = fn(batch.columns, batch.active,

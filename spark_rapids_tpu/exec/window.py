@@ -61,6 +61,8 @@ def is_device_window(window_exprs: List[E.Expression],
         r = X.is_device_expr(e, conf)
         if r:
             return r
+        if X.contains_ansi_cast(e):
+            return "ANSI casts in window partition keys run on CPU"
     for o in order_spec:
         dt = o.child.data_type
         if isinstance(dt, (T.DecimalType, T.ArrayType, T.MapType,
@@ -69,6 +71,8 @@ def is_device_window(window_exprs: List[E.Expression],
         r = X.is_device_expr(o.child, conf)
         if r:
             return r
+        if X.contains_ansi_cast(o.child):
+            return "ANSI casts in window order keys run on CPU"
     for alias in window_exprs:
         wx = alias.child if isinstance(alias, E.Alias) else alias
         if not isinstance(wx, E.WindowExpression):
@@ -81,10 +85,14 @@ def is_device_window(window_exprs: List[E.Expression],
             r = X.is_device_expr(func.input, conf)
             if r:
                 return r
+            if X.contains_ansi_cast(func.input):
+                return "ANSI casts in lag/lead inputs run on CPU"
             if func.default is not None:
                 r = X.is_device_expr(func.default, conf)
                 if r:
                     return r
+                if X.contains_ansi_cast(func.default):
+                    return "ANSI casts in lag/lead defaults run on CPU"
                 in_str = isinstance(func.input.data_type,
                                     (T.StringType, T.BinaryType))
                 df_str = isinstance(func.default.data_type,
@@ -124,6 +132,8 @@ def is_device_window(window_exprs: List[E.Expression],
                 r = X.is_device_expr(src, conf)
                 if r:
                     return r
+                if X.contains_ansi_cast(src):
+                    return "ANSI casts in window aggregates run on CPU"
             bounded = not (frame.is_unbounded_whole or frame.is_running)
             if bounded and not isinstance(agg, (E.Sum, E.Count, E.Average)):
                 return (f"bounded {frame.frame_type} frames are device-"
@@ -174,7 +184,10 @@ def _seg_running_extreme(part_id: jax.Array, words: List[jax.Array],
 def _prefix_in_part(x: jax.Array, start_of_row: jax.Array) -> jax.Array:
     """Inclusive prefix sum restarting at each partition boundary.
     ``start_of_row[i]`` is the sorted position where row i's partition
-    begins."""
+    begins. Floats use a segmented scan (no cross-partition
+    cancellation); ints use the cheaper global-cumsum difference."""
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        return G.seg_running_sum(start_of_row, x)
     prefix = jnp.cumsum(x)
     base = jnp.where(start_of_row > 0,
                      jnp.take(prefix, jnp.maximum(start_of_row - 1, 0)),
@@ -210,35 +223,41 @@ def _layout(part_keys: List[AnyDeviceColumn],
     order_subkeys: List[jax.Array] = []
     for c, o in zip(order_keys, order_specs):
         order_subkeys.extend(S.order_subkeys(c, o.ascending, o.nulls_first))
-    # significance: active first, then partition keys, then order keys
-    all_keys = part_subkeys + order_subkeys
-    perm = jnp.lexsort(tuple(reversed(all_keys)) + (~active,))
-    active_s = active[perm]
+    # significance: active first, then partition keys, then order keys;
+    # ONE multi-operand sort gives the sorted keys directly (payload
+    # sort — no per-key gathers, which are HBM-bound on TPU)
+    from spark_rapids_tpu.columnar.device import sort_with_payload
+    all_keys = [~active] + part_subkeys + order_subkeys
+    sorted_keys, perm, _ = sort_with_payload(all_keys, [])
+    active_s = ~sorted_keys[0]
+    part_sorted = sorted_keys[1:1 + len(part_subkeys)]
+    order_sorted = sorted_keys[1 + len(part_subkeys):]
     pos = jnp.arange(cap, dtype=jnp.int32)
 
-    def boundaries(keys: List[jax.Array]) -> jax.Array:
+    def boundaries(keys) -> jax.Array:
         new = jnp.zeros(cap, dtype=bool).at[0].set(True)
-        for k in keys:
-            ks = k[perm]
+        for ks in keys:
             d = ks[1:] != ks[:-1]
-            if d.ndim == 2:
-                d = d.any(axis=1)
             new = new.at[1:].set(new[1:] | d)
         return new.at[1:].set(new[1:] | (active_s[1:] != active_s[:-1]))
 
-    new_part = boundaries(part_subkeys)
-    new_peer = new_part | boundaries(part_subkeys + order_subkeys)
+    new_part = boundaries(part_sorted)
+    new_peer = new_part | boundaries(list(part_sorted)
+                                     + list(order_sorted))
     part_id = jnp.cumsum(new_part.astype(jnp.int32)) - 1
     peer_id = jnp.cumsum(new_peer.astype(jnp.int32)) - 1
-    part_start = jax.ops.segment_min(pos, part_id, num_segments=cap,
-                                     indices_are_sorted=True)
-    part_end = jax.ops.segment_max(pos, part_id, num_segments=cap,
-                                   indices_are_sorted=True)
-    peer_end = jax.ops.segment_max(pos, peer_id, num_segments=cap,
-                                   indices_are_sorted=True)
-    start_of_row = jnp.take(part_start, part_id)
-    end_of_row = jnp.take(part_end, part_id)
-    peer_last = jnp.take(peer_end, peer_id)
+    # boundary latches, not segment ops (XLA scatters serialize on TPU):
+    # partition start = last boundary position at-or-before me (cummax),
+    # ends = next boundary position at-or-after me (reverse cummin)
+    start_of_row = jax.lax.cummax(jnp.where(new_part, pos, -1))
+    part_last_flag = jnp.concatenate(
+        [new_part[1:], jnp.ones(1, dtype=bool)])
+    end_of_row = jnp.flip(jax.lax.cummin(
+        jnp.flip(jnp.where(part_last_flag, pos, cap))))
+    peer_last_flag = jnp.concatenate(
+        [new_peer[1:], jnp.ones(1, dtype=bool)])
+    peer_last = jnp.flip(jax.lax.cummin(
+        jnp.flip(jnp.where(peer_last_flag, pos, cap))))
     part_size = end_of_row - start_of_row + 1
     return _SortedLayout(perm, active_s, part_id, peer_id, pos,
                          start_of_row, end_of_row, peer_last, new_peer,
@@ -251,10 +270,8 @@ def _ranking(func, lay: _SortedLayout) -> Tuple[jax.Array, jax.Array]:
         return (lay.pos - lay.start_of_row + 1).astype(jnp.int32), \
             lay.active_s
     if isinstance(func, E.Rank):
-        peer_first = jax.ops.segment_min(
-            lay.pos, lay.peer_id, num_segments=lay.pos.shape[0],
-            indices_are_sorted=True)
-        first = jnp.take(peer_first, lay.peer_id)
+        # peer-group start = last new_peer boundary at-or-before me
+        first = jax.lax.cummax(jnp.where(lay.new_peer, lay.pos, -1))
         return (first - lay.start_of_row + 1).astype(jnp.int32), \
             lay.active_s
     if isinstance(func, E.DenseRank):
@@ -313,9 +330,10 @@ def _offset_fn(func: E.Lag, val: AnyDeviceColumn, default_val,
     return (data,), validity
 
 
-def _to_orig(perm: jax.Array, arr: jax.Array) -> jax.Array:
-    """Scatter a sorted-space result back to original row order."""
-    return jnp.zeros_like(arr).at[perm].set(arr)
+def _to_orig(inv_perm: jax.Array, arr: jax.Array) -> jax.Array:
+    """Map a sorted-space result back to original row order via the
+    inverse permutation (a gather; scatters serialize on TPU)."""
+    return jnp.take(arr, inv_perm, axis=0)
 
 
 def _winner_value(val: DeviceColumn, lay: _SortedLayout,
@@ -351,9 +369,9 @@ def _agg_window(agg: E.AggregateFunction, frame: E.WindowFrame,
         return pp
 
     def whole(x):
-        s = jax.ops.segment_sum(x, lay.part_id, num_segments=cap,
-                                indices_are_sorted=True)
-        return jnp.take(s, lay.part_id)
+        # running total read at the partition's END row (scatter-free)
+        pp = _prefix_in_part(x, lay.start_of_row)
+        return jnp.take(pp, lay.end_of_row)
 
     def bounded(x):
         pp = _prefix_in_part(x, lay.start_of_row)
@@ -398,30 +416,16 @@ def _agg_window(agg: E.AggregateFunction, frame: E.WindowFrame,
     if isinstance(agg, (E.Min, E.Max)):
         is_min = isinstance(agg, E.Min)
         words = G.rank_words(DeviceColumn(val.dtype, data_s, valid_s))
+        win, has = _seg_running_extreme(lay.part_id, words, valid_s,
+                                        is_min)
         if frame.is_unbounded_whole:
-            # word-wise tournament over the partition (groupby
-            # _seg_extreme_words shape, keyed on part_id)
-            cand = valid_s
-            for w in words:
-                sent = G.word_sentinel(w.dtype, is_min)
-                masked = jnp.where(cand, w, sent)
-                seg_op = (jax.ops.segment_min if is_min
-                          else jax.ops.segment_max)
-                best = jnp.take(
-                    seg_op(masked, lay.part_id, num_segments=cap,
-                           indices_are_sorted=True), lay.part_id)
-                cand = cand & (w == best)
-            p = jnp.where(cand, lay.pos, jnp.int32(cap))
-            win = jnp.take(
-                jax.ops.segment_min(p, lay.part_id, num_segments=cap,
-                                    indices_are_sorted=True), lay.part_id)
-            has = (win < cap)
-        else:  # running
-            win, has = _seg_running_extreme(lay.part_id, words, valid_s,
-                                            is_min)
-            if frame.frame_type == "range":
-                win = jnp.take(win, lay.peer_last)
-                has = jnp.take(has, lay.peer_last)
+            # the running winner at the partition END row is the
+            # whole-partition winner — broadcast by gather
+            win = jnp.take(win, lay.end_of_row)
+            has = jnp.take(has, lay.end_of_row)
+        elif frame.frame_type == "range":
+            win = jnp.take(win, lay.peer_last)
+            has = jnp.take(has, lay.peer_last)
         return _winner_value(val, lay, win, has)
 
     if isinstance(agg, (E.First, E.Last)):
@@ -440,21 +444,14 @@ def _agg_window(agg: E.AggregateFunction, frame: E.WindowFrame,
             return jnp.where(v, d, jnp.zeros((), d.dtype)), v
         # ignore_nulls: running min/max over the position of valid rows
         posrank = (lay.pos + 1).astype(jnp.uint64)
+        win, has = _seg_running_extreme(lay.part_id, [posrank],
+                                        valid_s, is_first)
         if frame.is_unbounded_whole:
-            cand = jnp.where(valid_s, lay.pos,
-                             jnp.int32(cap) if is_first else jnp.int32(-1))
-            seg_op = jax.ops.segment_min if is_first else jax.ops.segment_max
-            win = jnp.take(
-                seg_op(cand, lay.part_id, num_segments=cap,
-                       indices_are_sorted=True), lay.part_id)
-            has = (win < cap) & (win >= 0)
-            win = jnp.clip(win, 0, cap - 1)
-        else:
-            win, has = _seg_running_extreme(lay.part_id, [posrank],
-                                            valid_s, is_first)
-            if frame.frame_type == "range":
-                win = jnp.take(win, lay.peer_last)
-                has = jnp.take(has, lay.peer_last)
+            win = jnp.take(win, lay.end_of_row)
+            has = jnp.take(has, lay.end_of_row)
+        elif frame.frame_type == "range":
+            win = jnp.take(win, lay.peer_last)
+            has = jnp.take(has, lay.peer_last)
         return _winner_value(val, lay, win, has)
 
     raise X.DeviceUnsupported(type(agg).__name__)
@@ -478,13 +475,14 @@ def _build_window_fn(part_bound: Tuple[E.Expression, ...],
         part_cols = [X.dev_eval(e, ctx) for e in part_bound]
         order_cols = [X.dev_eval(e, ctx) for e in order_bound]
         lay = _layout(part_cols, list(order_specs), order_cols, active)
+        inv = jnp.argsort(lay.perm)  # original row -> sorted pos
         outs = []
         for item in items:
             kind = item[0]
             if kind == "rank":
                 d, v = _ranking(item[1], lay)
-                outs.append(((_to_orig(lay.perm, d),),
-                             _to_orig(lay.perm, v)))
+                outs.append(((_to_orig(inv, d),),
+                             _to_orig(inv, v)))
             elif kind == "offset":
                 _k, func, src_i, dflt_i = item
                 val = X.dev_eval(all_exprs[src_i], ctx)
@@ -495,15 +493,15 @@ def _build_window_fn(part_bound: Tuple[E.Expression, ...],
                         dc, DeviceStringColumn)
                         else (dc.data, dc.validity))
                 arrs, v = _offset_fn(func, val, dflt, lay)
-                outs.append((tuple(_to_orig(lay.perm, a) for a in arrs),
-                             _to_orig(lay.perm, v)))
+                outs.append((tuple(_to_orig(inv, a) for a in arrs),
+                             _to_orig(inv, v)))
             else:  # agg
                 _k, agg, frame, src_i, out_type = item
                 val = (X.dev_eval(all_exprs[src_i], ctx)
                        if src_i is not None else None)
                 d, v = _agg_window(agg, frame, val, lay, out_type)
-                outs.append(((_to_orig(lay.perm, d),),
-                             _to_orig(lay.perm, v)))
+                outs.append(((_to_orig(inv, d),),
+                             _to_orig(inv, v)))
         return outs
     return jax.jit(fn)
 

@@ -1,0 +1,265 @@
+"""Device string<->numeric/date/bool cast kernels (GpuCast.scala:1338's
+matrix, the string legs). Everything is fixed-shape vectorized byte-matrix
+arithmetic — digit extraction, Horner parses, and Hinnant civil-date math
+lower to pure integer XLA ops, no host round trips.
+
+Spark semantics implemented (Cast.scala / UTF8String):
+- string->integral: ASCII-whitespace trim, optional sign, digits with an
+  optional ignored fraction ("1.9" -> 1, truncation toward zero), null on
+  malformed/overflow (ANSI raises instead, via the Ctx error channel).
+- string->boolean: t/true/y/yes/1 vs f/false/n/no/0, case-insensitive.
+- string->date: [+-]?y{1,7}[-m[-d]] prefixes, calendar-validated.
+- integral/bool/date->string: exact Java rendering.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+_POW10 = [10 ** k for k in range(20)]
+
+
+def _take_byte(chars: jax.Array, idx: jax.Array) -> jax.Array:
+    """chars[i, idx[i]] with clamping (u8[cap, cc], idx int32[cap])."""
+    cc = chars.shape[1]
+    safe = jnp.clip(idx, 0, cc - 1)
+    return jnp.take_along_axis(chars, safe[:, None], axis=1)[:, 0]
+
+
+def _trim_bounds(chars: jax.Array, lengths: jax.Array
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """(start, end) after trimming ASCII control/space bytes (<= 0x20),
+    matching UTF8String.trimAll's handling of the cast paths."""
+    cap, cc = chars.shape
+    pos = jnp.arange(cc, dtype=jnp.int32)[None, :]
+    in_str = pos < lengths[:, None]
+    ws = (chars <= 0x20)
+    non_ws = in_str & ~ws
+    any_nw = non_ws.any(axis=1)
+    first = jnp.argmax(non_ws, axis=1).astype(jnp.int32)
+    last = (cc - 1 - jnp.argmax(non_ws[:, ::-1], axis=1)).astype(jnp.int32)
+    start = jnp.where(any_nw, first, 0)
+    end = jnp.where(any_nw, last + 1, 0)  # exclusive
+    return start, end
+
+
+def parse_string_to_long(chars: jax.Array, lengths: jax.Array,
+                         validity: jax.Array
+                         ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns (value int64, ok bool, overflow bool). ok=False means
+    malformed; overflow means well-formed but beyond int64."""
+    cap, cc = chars.shape
+    start, end = _trim_bounds(chars, lengths)
+    first = _take_byte(chars, start)
+    has_sign = (first == ord("-")) | (first == ord("+"))
+    neg = first == ord("-")
+    int_start = start + has_sign.astype(jnp.int32)
+    pos = jnp.arange(cc, dtype=jnp.int32)[None, :]
+    in_tok = (pos >= int_start[:, None]) & (pos < end[:, None])
+    is_digit = (chars >= ord("0")) & (chars <= ord("9"))
+    # the CPU oracle (int(str)) rejects fractions — so do we
+    int_ok = jnp.where(in_tok, is_digit, True).all(axis=1)
+    n_dig = end - int_start
+    ok = validity & (end > start) & (n_dig > 0) & int_ok
+    # magnitude via Horner over up to 19 left-aligned digits
+    k = jnp.arange(19, dtype=jnp.int32)
+    gidx = int_start[:, None] + k[None, :]
+    dig = (_gather_bytes(chars, gidx) - ord("0")).astype(jnp.uint64)
+    live = k[None, :] < jnp.minimum(n_dig, 19)[:, None]
+    p10 = jnp.asarray(_POW10, dtype=jnp.uint64)
+    exp = jnp.clip(n_dig[:, None] - 1 - k[None, :], 0, 19)
+    mag = jnp.sum(jnp.where(live, dig * p10[exp], jnp.uint64(0)), axis=1)
+    too_long = n_dig > 19
+    # 19-digit values can still exceed int64; compare against the limit
+    lim = jnp.where(neg, jnp.uint64(1 << 63), jnp.uint64((1 << 63) - 1))
+    overflow = ok & (too_long | (mag > lim))
+    value = jnp.where(neg, jnp.int64(0) - mag.astype(jnp.int64),
+                      mag.astype(jnp.int64))
+    value = jnp.where(ok & ~overflow, value, jnp.int64(0))
+    return value, ok, overflow
+
+
+def _gather_bytes(chars: jax.Array, idx: jax.Array) -> jax.Array:
+    cc = chars.shape[1]
+    return jnp.take_along_axis(chars, jnp.clip(idx, 0, cc - 1), axis=1)
+
+
+def parse_string_to_bool(chars: jax.Array, lengths: jax.Array,
+                         validity: jax.Array
+                         ) -> Tuple[jax.Array, jax.Array]:
+    """(value, ok): Spark StringUtils.isTrueString/isFalseString sets."""
+    start, end = _trim_bounds(chars, lengths)
+    n = end - start
+    k = jnp.arange(5, dtype=jnp.int32)
+    b = _gather_bytes(chars, start[:, None] + k[None, :])
+    lower = jnp.where((b >= ord("A")) & (b <= ord("Z")), b + 32, b)
+
+    def word(w: str):
+        m = jnp.asarray([ord(c) for c in w.ljust(5, "\0")], dtype=jnp.uint8)
+        match = (n == len(w))
+        for i in range(len(w)):
+            match = match & (lower[:, i] == m[i])
+        return match
+
+    t = word("t") | word("true") | word("y") | word("yes") | word("1")
+    f = word("f") | word("false") | word("n") | word("no") | word("0")
+    ok = validity & (t | f)
+    return t, ok
+
+
+def parse_string_to_date(chars: jax.Array, lengths: jax.Array,
+                         validity: jax.Array
+                         ) -> Tuple[jax.Array, jax.Array]:
+    """(epoch_days int32, ok): accepts y-m-d with 1-2 digit month/day,
+    optional leading +/- on the year (the CPU oracle requires all three
+    fields; Spark additionally allows y / y-m prefixes)."""
+    cap, cc = chars.shape
+    start, end = _trim_bounds(chars, lengths)
+    first = _take_byte(chars, start)
+    has_sign = (first == ord("-")) | (first == ord("+"))
+    neg_year = first == ord("-")
+    ystart = start + has_sign.astype(jnp.int32)
+    pos = jnp.arange(cc, dtype=jnp.int32)[None, :]
+    in_tok = (pos >= ystart[:, None]) & (pos < end[:, None])
+    is_digit = (chars >= ord("0")) & (chars <= ord("9"))
+    is_dash = chars == ord("-")
+    dash = in_tok & is_dash
+    n_dash = dash.sum(axis=1)
+    d1 = jnp.where(dash.any(axis=1),
+                   jnp.argmax(dash, axis=1).astype(jnp.int32), end)
+    after1 = dash & (pos > d1[:, None])
+    d2 = jnp.where(after1.any(axis=1),
+                   jnp.argmax(after1, axis=1).astype(jnp.int32), end)
+
+    def seg_value(s, e, lo, hi):
+        """Parse digits chars[s:e); ok iff lo<=len<=hi and all digits."""
+        ln = e - s
+        k = jnp.arange(7, dtype=jnp.int32)
+        b = _gather_bytes(chars, s[:, None] + k[None, :])
+        live = k[None, :] < jnp.minimum(ln, 7)[:, None]
+        seg_digits = jnp.where(live, is_digit_at(b), True).all(axis=1)
+        p10 = jnp.asarray(_POW10[:8], dtype=jnp.int64)
+        exp = jnp.clip(ln[:, None] - 1 - k[None, :], 0, 7)
+        val = jnp.sum(jnp.where(live,
+                                (b - ord("0")).astype(jnp.int64)
+                                * p10[exp], jnp.int64(0)), axis=1)
+        ok = (ln >= lo) & (ln <= hi) & seg_digits
+        return val, ok
+
+    def is_digit_at(b):
+        return (b >= ord("0")) & (b <= ord("9"))
+
+    y, y_ok = seg_value(ystart, jnp.minimum(d1, end), 1, 7)
+    m, m_ok = seg_value(d1 + 1, jnp.minimum(d2, end), 1, 2)
+    d, d_ok = seg_value(d2 + 1, end, 1, 2)
+    shape_ok = y_ok & m_ok & d_ok & (n_dash == 2) & (end > start)
+    y = jnp.where(neg_year, -y, y)
+    leap = ((jnp.remainder(y, 4) == 0) & (jnp.remainder(y, 100) != 0)) \
+        | (jnp.remainder(y, 400) == 0)
+    dim = jnp.select(
+        [m == 2, (m == 4) | (m == 6) | (m == 9) | (m == 11)],
+        [jnp.where(leap, 29, 28), jnp.full_like(m, 30)],
+        jnp.full_like(m, 31))
+    cal_ok = (m >= 1) & (m <= 12) & (d >= 1) & (d <= dim)
+    ok = validity & shape_ok & cal_ok
+    return civil_to_days(y, m, d).astype(jnp.int32), ok
+
+
+def civil_to_days(y: jax.Array, m: jax.Array, d: jax.Array) -> jax.Array:
+    """Hinnant days_from_civil, proleptic Gregorian (what Spark's
+    LocalDate uses)."""
+    y = y - (m <= 2)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = jnp.floor_divide(153 * mp + 2, 5) + d - 1
+    doe = yoe * 365 + jnp.floor_divide(yoe, 4) \
+        - jnp.floor_divide(yoe, 100) + doy
+    return era * 146097 + doe - 719468
+
+
+def civil_from_days(days: jax.Array):
+    z = days.astype(jnp.int64) + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = jnp.floor_divide(
+        doe - jnp.floor_divide(doe, 1460) + jnp.floor_divide(doe, 36524)
+        - jnp.floor_divide(doe, 146096), 365)
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + jnp.floor_divide(yoe, 4)
+                 - jnp.floor_divide(yoe, 100))
+    mp = jnp.floor_divide(5 * doy + 2, 153)
+    d = doy - jnp.floor_divide(153 * mp + 2, 5) + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+def long_to_string(data: jax.Array, validity: jax.Array
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """(chars u8[cap,24], lengths int32): Java Long.toString."""
+    cap = data.shape[0]
+    data = data.astype(jnp.int64)
+    neg = data < 0
+    # magnitude in uint64 (INT64_MIN-safe)
+    mag = jnp.where(neg, (-(data + 1)).astype(jnp.uint64) + 1,
+                    data.astype(jnp.uint64))
+    p10 = jnp.asarray(_POW10, dtype=jnp.uint64)
+    digits = jnp.remainder(mag[:, None] // p10[None, :], 10)  # [cap, 20]
+    ndig = jnp.maximum(
+        jnp.max(jnp.where(digits > 0,
+                          jnp.arange(20, dtype=jnp.int32)[None, :] + 1, 0),
+                axis=1), 1)
+    length = ndig + neg.astype(jnp.int32)
+    width = 24
+    p = jnp.arange(width, dtype=jnp.int32)[None, :]
+    digit_idx = ndig[:, None] - 1 - (p - neg.astype(jnp.int32)[:, None])
+    dig = jnp.take_along_axis(
+        digits, jnp.clip(digit_idx, 0, 19), axis=1)
+    ch = (ord("0") + dig).astype(jnp.uint8)
+    ch = jnp.where((p == 0) & neg[:, None], jnp.uint8(ord("-")), ch)
+    ch = jnp.where(p < length[:, None], ch, jnp.uint8(0))
+    ch = jnp.where(validity[:, None], ch, jnp.uint8(0))
+    return ch, jnp.where(validity, length, 0)
+
+
+def bool_to_string(data: jax.Array, validity: jax.Array
+                   ) -> Tuple[jax.Array, jax.Array]:
+    cap = data.shape[0]
+    t = jnp.asarray([ord(c) for c in "true\0"], dtype=jnp.uint8)
+    f = jnp.asarray([ord(c) for c in "false"], dtype=jnp.uint8)
+    b = data.astype(bool)
+    ch = jnp.where(b[:, None], t[None, :], f[None, :])
+    length = jnp.where(b, 4, 5).astype(jnp.int32)
+    p = jnp.arange(8, dtype=jnp.int32)[None, :]
+    ch = jnp.pad(ch, ((0, 0), (0, 3)))
+    ch = jnp.where((p < length[:, None]) & validity[:, None], ch,
+                   jnp.uint8(0))
+    return ch, jnp.where(validity, length, 0)
+
+
+def date_to_string(days: jax.Array, validity: jax.Array
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """yyyy-MM-dd (years 0..9999 render 4-digit zero-padded, Spark's
+    DateFormatter default)."""
+    y, m, d = civil_from_days(days)
+    cap = days.shape[0]
+
+    def two(v):
+        return jnp.stack([ord("0") + v // 10, ord("0") + v % 10],
+                         axis=1).astype(jnp.uint8)
+
+    y4 = jnp.stack([ord("0") + jnp.remainder(y // 1000, 10),
+                    ord("0") + jnp.remainder(y // 100, 10),
+                    ord("0") + jnp.remainder(y // 10, 10),
+                    ord("0") + jnp.remainder(y, 10)],
+                   axis=1).astype(jnp.uint8)
+    dash = jnp.full((cap, 1), ord("-"), dtype=jnp.uint8)
+    ch = jnp.concatenate([y4, dash, two(m), dash, two(d)], axis=1)
+    ch = jnp.pad(ch, ((0, 0), (0, 6)))  # width 16 (8-aligned)
+    ch = jnp.where(validity[:, None], ch, jnp.uint8(0))
+    length = jnp.where(validity, 10, 0).astype(jnp.int32)
+    return ch, length

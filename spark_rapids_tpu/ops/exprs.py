@@ -147,6 +147,11 @@ class Ctx:
                  lit_vals: Optional[Sequence[jax.Array]] = None):
         self.inputs = list(inputs)
         self.capacity = capacity
+        # ANSI error channel: (row-flags, message) pairs collected during
+        # tracing; run_project/run_filter surface them as raised
+        # ArithmeticError after the program executes.
+        self.errors: List[Tuple[jax.Array, str]] = []
+        self._scope: Optional[jax.Array] = None
         self.lit_index: Dict[int, int] = {}
         self.derived_index: Dict[int, int] = {}
         self.lit_vals = list(lit_vals or [])
@@ -170,6 +175,30 @@ class Ctx:
         if idx is None:
             return []
         return self.lit_vals[idx:idx + n]
+
+    def record_error(self, row_flags: jax.Array, message: str) -> None:
+        """ANSI-mode runtime error: row_flags marks offending rows (the
+        program builder masks them with `active` so errors on rows a
+        prior filter removed don't fire, then any()-reduces). Errors
+        raised while tracing an untaken conditional branch are masked by
+        the branch scope (Spark only errors on the taken branch)."""
+        if self._scope is not None:
+            row_flags = row_flags & self._scope
+        self.errors.append((row_flags, message))
+
+    def scoped(self, mask: jax.Array):
+        """Context manager narrowing the error scope to `mask` rows."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _cm():
+            prev = self._scope
+            self._scope = mask if prev is None else (prev & mask)
+            try:
+                yield
+            finally:
+                self._scope = prev
+        return _cm()
 
 
 _HANDLERS: Dict[type, Callable] = {}
@@ -611,25 +640,38 @@ def _select(dt: T.DataType, cond: jax.Array, tc: AnyDeviceColumn,
 @handles(E.If)
 def _h_if(e: E.If, ctx: Ctx) -> AnyDeviceColumn:
     p = dev_eval(e.children[0], ctx)
-    tv = dev_eval(e.children[1], ctx)
-    fv = dev_eval(e.children[2], ctx)
     cond = p.validity & _as_bool(p)
+    # ANSI errors only fire on the taken arm (Spark's lazy branches)
+    with ctx.scoped(cond):
+        tv = dev_eval(e.children[1], ctx)
+    with ctx.scoped(~cond):
+        fv = dev_eval(e.children[2], ctx)
     return _select(e.data_type, cond, tv, fv)
 
 
 @handles(E.CaseWhen)
 def _h_case(e: E.CaseWhen, ctx: Ctx) -> AnyDeviceColumn:
     pairs = e.children[:-1] if e.has_else else e.children
-    # fold right-to-left into nested selects; else-branch = null column
+    # left-to-right (Spark's first-match evaluation order), scoping ANSI
+    # errors to the rows whose branch is actually TAKEN
+    prior = jnp.zeros(ctx.capacity, dtype=bool)
+    entries = []
+    for i in range(0, len(pairs) - 1, 2):
+        with ctx.scoped(~prior):
+            p = dev_eval(pairs[i], ctx)
+        cond = p.validity & _as_bool(p)
+        take = cond & ~prior
+        with ctx.scoped(take):
+            v = dev_eval(pairs[i + 1], ctx)
+        entries.append((take, v))
+        prior = prior | cond
     if e.has_else:
-        acc = dev_eval(e.children[-1], ctx)
+        with ctx.scoped(~prior):
+            acc = dev_eval(e.children[-1], ctx)
     else:
         acc = _null_column(e.data_type, ctx.capacity)
-    for i in range(len(pairs) - 2, -1, -2):
-        p = dev_eval(pairs[i], ctx)
-        v = dev_eval(pairs[i + 1], ctx)
-        cond = p.validity & _as_bool(p)
-        acc = _select(e.data_type, cond, v, acc)
+    for take, v in reversed(entries):
+        acc = _select(e.data_type, take, v, acc)
     return acc
 
 
@@ -644,9 +686,12 @@ def _null_column(dt: T.DataType, cap: int) -> AnyDeviceColumn:
 
 @handles(E.Coalesce)
 def _h_coalesce(e: E.Coalesce, ctx: Ctx) -> AnyDeviceColumn:
-    cols = [dev_eval(c, ctx) for c in e.children]
-    acc = cols[0]
-    for c in cols[1:]:
+    # later arguments only evaluate (ANSI-error-wise) where every earlier
+    # one was null
+    acc = dev_eval(e.children[0], ctx)
+    for child in e.children[1:]:
+        with ctx.scoped(~acc.validity):
+            c = dev_eval(child, ctx)
         acc = _select(e.data_type, acc.validity, acc, c)
     return acc
 
@@ -996,35 +1041,58 @@ def _h_murmur3(e: E.Murmur3Hash, ctx: Ctx) -> DeviceColumn:
 @handles(E.Cast)
 def _h_cast(e: E.Cast, ctx: Ctx) -> AnyDeviceColumn:
     c = dev_eval(e.child, ctx)
-    return cast_device_column(c, e.data_type, ctx)
+    return cast_device_column(c, e.data_type, ctx, ansi=e.ansi)
+
+
+def device_cast_supported(frm: T.DataType, to: T.DataType,
+                          ansi: bool) -> Optional[str]:
+    """The CastChecks matrix (GpuCast.scala:1338 / TypeChecks.scala:1259
+    shape): None when the from->to leg runs on device."""
+    if frm == to:
+        return None
+    is_plain_num = (lambda t: T.is_numeric(t)
+                    and not isinstance(t, T.DecimalType))
+    ok_num = is_plain_num(frm) and is_plain_num(to)
+    ok_bool = (isinstance(frm, T.BooleanType) and is_plain_num(to)) or \
+              (is_plain_num(frm) and isinstance(to, T.BooleanType))
+    ok_dt = (isinstance(frm, T.DateType) and isinstance(to, T.TimestampType)
+             ) or (isinstance(frm, T.TimestampType)
+                   and isinstance(to, T.DateType))
+    ok_from_str = isinstance(frm, T.StringType) and (
+        T.is_integral(to) or isinstance(to, (T.BooleanType, T.DateType)))
+    ok_to_str = isinstance(to, T.StringType) and (
+        T.is_integral(frm) or isinstance(frm, (T.BooleanType, T.DateType)))
+    if not (ok_num or ok_bool or ok_dt or ok_from_str or ok_to_str):
+        return f"cast {frm.simple_string} -> {to.simple_string} on TPU"
+    if ansi and not ok_num:
+        # ANSI overflow/parse errors are implemented for the numeric legs
+        return (f"ANSI cast {frm.simple_string} -> {to.simple_string} "
+                "runs on CPU")
+    return None
 
 
 @extra_check(E.Cast)
 def _c_cast(e: E.Cast) -> Optional[str]:
-    frm, to = e.child.data_type, e.data_type
-    if e.ansi:
-        return "ANSI cast overflow checks run on CPU"
-    if frm == to:
-        return None
-    ok_num = (T.is_numeric(frm) and not isinstance(frm, T.DecimalType)
-              and T.is_numeric(to) and not isinstance(to, T.DecimalType))
-    ok_bool = (isinstance(frm, T.BooleanType) and T.is_numeric(to)
-               and not isinstance(to, T.DecimalType)) or \
-              (T.is_numeric(frm) and not isinstance(frm, T.DecimalType)
-               and isinstance(to, T.BooleanType))
-    ok_dt = (isinstance(frm, T.DateType) and isinstance(to, T.TimestampType)
-             ) or (isinstance(frm, T.TimestampType)
-                   and isinstance(to, T.DateType))
-    if not (ok_num or ok_bool or ok_dt):
-        return f"cast {frm.simple_string} -> {to.simple_string} on TPU"
-    return None
+    return device_cast_supported(e.child.data_type, e.data_type, e.ansi)
 
 
-def cast_device_column(c: AnyDeviceColumn, to: T.DataType, ctx: Ctx
-                       ) -> AnyDeviceColumn:
+def contains_ansi_cast(e: E.Expression) -> bool:
+    """Programs without the Ctx error channel (sort/join/window/agg
+    kernels) must not silently drop ANSI errors — their taggers fall
+    back when one is present."""
+    return bool(e.collect(lambda x: isinstance(x, E.Cast) and x.ansi))
+
+
+def cast_device_column(c: AnyDeviceColumn, to: T.DataType, ctx: Ctx,
+                       ansi: bool = False) -> AnyDeviceColumn:
+    from spark_rapids_tpu.ops import cast as CK
     frm = c.dtype
     if frm == to:
         return c
+    if isinstance(frm, T.StringType) and not isinstance(to, T.StringType):
+        return _cast_string_device(c, to, ctx)
+    if isinstance(to, T.StringType):
+        return _cast_to_string_device(c, ctx)
     if T.is_numeric(frm) and T.is_numeric(to):
         src = c.data
         np_to = storage_jnp_dtype(to)
@@ -1032,8 +1100,24 @@ def cast_device_column(c: AnyDeviceColumn, to: T.DataType, ctx: Ctx
             info = np.iinfo(np_to)
             as_long = _java_double_to_long_dev(jnp.trunc(src))
             data = jnp.clip(as_long, info.min, info.max).astype(np_to)
+            if ansi:
+                # bound compares in float space (exact: 2^k bounds are
+                # representable) — a round-trip compare misses values
+                # that round back onto the clipped result (e.g. 2^63)
+                t = jnp.trunc(src)
+                bad = (jnp.isnan(src)
+                       | (t >= jnp.float64(info.max) + 1.0)
+                       | (t < jnp.float64(info.min)))
+                ctx.record_error(bad & c.validity,
+                                 "Cast overflow in ANSI mode")
         else:
             data = src.astype(np_to)
+            if ansi and not jnp.issubdtype(src.dtype, jnp.floating) \
+                    and not T.is_floating(to) \
+                    and jnp.dtype(np_to).itemsize < src.dtype.itemsize:
+                bad = data.astype(src.dtype) != src
+                ctx.record_error(bad & c.validity,
+                                 "Cast overflow in ANSI mode")
         return DeviceColumn(to, data, c.validity)
     if isinstance(frm, T.BooleanType) and T.is_numeric(to):
         return DeviceColumn(to, c.data.astype(storage_jnp_dtype(to)),
@@ -1048,6 +1132,47 @@ def cast_device_column(c: AnyDeviceColumn, to: T.DataType, ctx: Ctx
                                 86_400_000_000).astype(jnp.int32)
         return DeviceColumn(to, data, c.validity)
     raise DeviceUnsupported(f"cast {frm} -> {to} on device")
+
+
+def _cast_string_device(c: DeviceStringColumn, to: T.DataType,
+                        ctx: Ctx) -> DeviceColumn:
+    from spark_rapids_tpu.ops import cast as CK
+    if T.is_integral(to):
+        value, ok, overflow = CK.parse_string_to_long(
+            c.chars, c.lengths, c.validity)
+        np_to = storage_jnp_dtype(to)
+        if jnp.dtype(np_to).itemsize < 8:
+            info = np.iinfo(np_to)
+            in_range = (value >= info.min) & (value <= info.max)
+        else:
+            in_range = jnp.ones_like(ok)
+        validity = ok & ~overflow & in_range
+        data = jnp.where(validity, value, jnp.int64(0)).astype(np_to)
+        return DeviceColumn(to, data, validity)
+    if isinstance(to, T.BooleanType):
+        value, ok = CK.parse_string_to_bool(c.chars, c.lengths, c.validity)
+        return DeviceColumn(to, jnp.where(ok, value, False), ok)
+    if isinstance(to, T.DateType):
+        days, ok = CK.parse_string_to_date(c.chars, c.lengths, c.validity)
+        return DeviceColumn(to, jnp.where(ok, days, 0), ok)
+    raise DeviceUnsupported(f"cast string -> {to} on device")
+
+
+def _cast_to_string_device(c: AnyDeviceColumn, ctx: Ctx
+                           ) -> DeviceStringColumn:
+    from spark_rapids_tpu.ops import cast as CK
+    frm = c.dtype
+    if isinstance(frm, T.BooleanType):
+        chars, lengths = CK.bool_to_string(c.data, c.validity)
+    elif isinstance(frm, T.DateType):
+        chars, lengths = CK.date_to_string(c.data, c.validity)
+    elif T.is_integral(frm):
+        chars, lengths = CK.long_to_string(c.data.astype(jnp.int64),
+                                           c.validity)
+    else:
+        raise DeviceUnsupported(f"cast {frm} -> string on device")
+    return DeviceStringColumn(T.StringT, chars,
+                              lengths.astype(jnp.int32), c.validity)
 
 
 # ---------------------------------------------------------------------------
@@ -1074,8 +1199,18 @@ def _build_project(exprs: Tuple[E.Expression, ...]) -> Callable:
                 outs.append(DeviceColumn(
                     out.dtype, jnp.where(v, out.data,
                                          _zero(out.data.dtype)), v))
-        return outs
+        # ANSI errors collapse into ONE scalar (one host sync max, only
+        # when ANSI casts exist), masked to still-active rows
+        err = (jnp.any(jnp.stack([jnp.any(f & active)
+                                  for f, _m in ctx.errors]))
+               if ctx.errors else None)
+        return outs, err
     return jax.jit(fn)
+
+
+def _raise_if_errors(err) -> None:
+    if err is not None and bool(err):
+        raise ArithmeticError("Cast overflow in ANSI mode")
 
 
 def run_project(exprs: Sequence[E.Expression], batch: DeviceBatch
@@ -1087,7 +1222,9 @@ def run_project(exprs: Sequence[E.Expression], batch: DeviceBatch
     if fn is None:
         fn = _build_project(tuple(exprs))
         _PROJECT_CACHE[key] = fn
-    return fn(batch.columns, batch.active, literal_values(exprs))
+    outs, err = fn(batch.columns, batch.active, literal_values(exprs))
+    _raise_if_errors(err)
+    return outs
 
 
 _FILTER_CACHE: Dict[Tuple, Callable] = {}
@@ -1102,8 +1239,13 @@ def run_filter(cond: E.Expression, batch: DeviceBatch) -> DeviceBatch:
         def _fn(cols, active, lit_vals):
             ctx = Ctx(cols, active.shape[0], (cond,), lit_vals)
             p = dev_eval(cond, ctx)
-            return active & p.validity & _as_bool(p)
+            err = (jnp.any(jnp.stack([jnp.any(f & active)
+                                      for f, _m in ctx.errors]))
+                   if ctx.errors else None)
+            return active & p.validity & _as_bool(p), err
         fn = jax.jit(_fn)
         _FILTER_CACHE[key] = fn
-    new_active = fn(batch.columns, batch.active, literal_values([cond]))
+    new_active, err = fn(batch.columns, batch.active,
+                         literal_values([cond]))
+    _raise_if_errors(err)
     return DeviceBatch(batch.schema, batch.columns, new_active, None)

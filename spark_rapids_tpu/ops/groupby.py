@@ -2,21 +2,25 @@
 shapes (the cuDF groupBy the reference leans on, reimagined for XLA).
 
 Strategy (one jitted program per (expr-structure, capacity)):
-  1. Encode each key column into order-preserving unsigned sub-keys
-     (floats via total-order bit tricks, strings as packed big-endian
-     uint64 words from the byte matrix).
+  1. Encode each key column into order-preserving sub-key words
+     (floats as [is_nan, nan-zeroed value] — no 64-bit float bitcasts,
+     which some TPU compile stacks can't lower — strings as packed
+     big-endian uint64 words from the byte matrix).
   2. ``lexsort`` rows with the batch ``active`` mask as the primary key so
      live rows are contiguous at the front.
   3. Boundary flags where any sub-key (or active flag) changes between
-     adjacent sorted rows; ``cumsum`` -> segment ids. Segments over
-     inactive rows land at the tail and are dropped by the output mask.
-  4. Aggregate with ``jax.ops.segment_*`` at ``num_segments = capacity``
-     (static!). min/max/first/last pick a winning *row index* per segment
-     and gather, so values round-trip bit-exactly.
+     adjacent sorted rows.
+  4. Aggregate with SCAN primitives — prefix sums and segmented
+     associative scans — and read each segment's result at its END row.
 
-This replaces the reference's hash-based cudf groupby with the only shape
-XLA loves: sort + segmented scan. The agg exec's concat/merge passes sit on
-top, mirroring GpuHashAggregateIterator (aggregate.scala:247).
+Step 4 is the TPU-critical design point: `jax.ops.segment_*` lowers to
+XLA scatters, which serialize on TPU (~200ms per op at 2M rows measured
+on v5e); prefix scans and sorts are fast parallel primitives. So NOTHING
+here scatters: per-segment results live at segment-end rows of the
+sorted layout (``out_active`` marks exactly one row per real group), and
+the aggregation output batch simply uses that scattered active mask —
+the engine's mask-based batch model makes "one result row per group"
+free. Compaction (an argsort) happens later at shrink/shuffle points.
 """
 
 from __future__ import annotations
@@ -61,6 +65,13 @@ def rank_words(col: DeviceColumn) -> List[jax.Array]:
     return [rank_u64(col)]
 
 
+def value_words(col: AnyDeviceColumn) -> List[jax.Array]:
+    """Comparison words for ANY column type (strings included)."""
+    if isinstance(col, DeviceStringColumn):
+        return pack_string_words(col) + [col.lengths.astype(jnp.uint64)]
+    return rank_words(col)
+
+
 def pack_string_words(c: DeviceStringColumn) -> List[jax.Array]:
     """Big-endian packed uint64 words: numeric word order == byte
     lexicographic order, so word-wise compare/sort matches UTF-8 binary
@@ -89,104 +100,6 @@ def grouping_subkeys(col: AnyDeviceColumn) -> List[jax.Array]:
     return [col.validity] + rank_words(col)
 
 
-class Segments:
-    """Result of the sort+boundary pass, everything capacity-shaped."""
-
-    def __init__(self, order: jax.Array, seg_ids: jax.Array,
-                 num_segments_arr: jax.Array, seg_active: jax.Array,
-                 active_sorted: jax.Array, capacity: int):
-        self.order = order              # sorted-row -> original-row index
-        self.seg_ids = seg_ids          # per sorted row
-        self.num_segments_arr = num_segments_arr  # scalar (traced)
-        self.seg_active = seg_active    # bool[capacity]: real group?
-        self.active_sorted = active_sorted
-        self.capacity = capacity
-
-
-def build_segments(key_cols: Sequence[AnyDeviceColumn],
-                   active: jax.Array) -> Segments:
-    cap = active.shape[0]
-    subkeys: List[jax.Array] = []
-    for c in key_cols:
-        subkeys.extend(grouping_subkeys(c))
-    # lexsort: last key is primary -> ~active puts live rows first
-    order = jnp.lexsort([k for k in subkeys] + [~active])
-    active_s = active[order]
-    sorted_keys = [k[order] for k in subkeys]
-    prev_differs = jnp.zeros(cap, dtype=bool)
-    for k in sorted_keys:
-        if k.ndim == 1:
-            d = k[1:] != k[:-1]
-        else:
-            d = (k[1:] != k[:-1]).any(axis=1)
-        prev_differs = prev_differs.at[1:].set(prev_differs[1:] | d)
-    prev_differs = prev_differs.at[1:].set(
-        prev_differs[1:] | (active_s[1:] != active_s[:-1]))
-    boundary = prev_differs.at[0].set(True)
-    seg_ids = jnp.cumsum(boundary.astype(jnp.int32)) - 1
-    nseg = jnp.sum(boundary.astype(jnp.int32))
-    seg_exists = jnp.arange(cap, dtype=jnp.int32) < nseg
-    seg_has_active = jax.ops.segment_max(
-        active_s.astype(jnp.int32), seg_ids, num_segments=cap,
-        indices_are_sorted=True) > 0
-    return Segments(order, seg_ids, nseg, seg_exists & seg_has_active,
-                    active_s, cap)
-
-
-def representative_rows(seg: Segments) -> jax.Array:
-    """Original row index of the first sorted row of each segment."""
-    pos = jnp.arange(seg.capacity, dtype=jnp.int32)
-    first_pos = jax.ops.segment_min(pos, seg.seg_ids,
-                                    num_segments=seg.capacity,
-                                    indices_are_sorted=True)
-    safe = jnp.clip(first_pos, 0, seg.capacity - 1)
-    return seg.order[safe]
-
-
-def _acc_dtype(out_type: T.DataType) -> jnp.dtype:
-    from spark_rapids_tpu.columnar.device import storage_jnp_dtype
-    return storage_jnp_dtype(out_type)
-
-
-def seg_sum(seg: Segments, col: AnyDeviceColumn, out_type: T.DataType,
-            null_when_empty: bool) -> DeviceColumn:
-    """sum / sum_nonnull primitive."""
-    valid_s = (col.validity[seg.order]) & seg.active_sorted
-    acc_dt = _acc_dtype(out_type)
-    vals = jnp.where(valid_s, col.data[seg.order].astype(acc_dt),
-                     jnp.zeros((), acc_dt))
-    acc = jax.ops.segment_sum(vals, seg.seg_ids, num_segments=seg.capacity,
-                              indices_are_sorted=True)
-    if null_when_empty:
-        has = jax.ops.segment_max(valid_s.astype(jnp.int32), seg.seg_ids,
-                                  num_segments=seg.capacity,
-                                  indices_are_sorted=True) > 0
-        validity = has & seg.seg_active
-    else:
-        validity = seg.seg_active
-    acc = jnp.where(validity, acc, jnp.zeros((), acc_dt))
-    return DeviceColumn(out_type, acc, validity)
-
-
-def seg_count(seg: Segments, col: AnyDeviceColumn) -> DeviceColumn:
-    valid_s = (col.validity[seg.order]) & seg.active_sorted
-    acc = jax.ops.segment_sum(valid_s.astype(jnp.int64), seg.seg_ids,
-                              num_segments=seg.capacity,
-                              indices_are_sorted=True)
-    acc = jnp.where(seg.seg_active, acc, jnp.int64(0))
-    return DeviceColumn(T.LongT, acc, seg.seg_active)
-
-
-def _winner_gather(seg: Segments, col: AnyDeviceColumn,
-                   winner_orig_idx: jax.Array, won: jax.Array
-                   ) -> AnyDeviceColumn:
-    """Gather per-segment winning rows; `won` marks segments with a
-    winner (others -> null)."""
-    from spark_rapids_tpu.columnar.device import take_columns
-    safe = jnp.clip(winner_orig_idx, 0, seg.capacity - 1)
-    return take_columns([col], safe, valid_at=won)[0]
-
-
 def word_sentinel(dtype, is_min: bool):
     """A value no real candidate beats: the loser for this word dtype."""
     if dtype == jnp.bool_:
@@ -199,100 +112,199 @@ def word_sentinel(dtype, is_min: bool):
     return jnp.array(info.max if is_min else info.min, dtype=dtype)
 
 
-def _seg_extreme_words(seg: Segments, col: AnyDeviceColumn,
-                       words: List[jax.Array], is_min: bool
-                       ) -> AnyDeviceColumn:
-    """Tournament over (word0, word1, ...) most-significant first:
-    iteratively keep the rows matching the per-segment best word. The
-    winning ROW is gathered so values round-trip untouched."""
-    valid_s = (col.validity[seg.order]) & seg.active_sorted
-    cap = seg.capacity
+def seg_scan_best(seg_marker: jax.Array, words: Sequence[jax.Array],
+                  valid: jax.Array, is_min: bool
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Segmented RUNNING arg-min/max over multi-word ranks: for each
+    sorted row, the position of the best valid row from its segment's
+    start up to itself (lexicographic over `words`, most-significant
+    first). Returns (winner position, has-winner). One associative scan
+    — no scatters. ``seg_marker`` is any per-row value constant within a
+    segment and distinct across adjacent segments (e.g. the segment's
+    start position)."""
+    cap = seg_marker.shape[0]
     pos = jnp.arange(cap, dtype=jnp.int32)
-    cand = valid_s
-    for w in words:
-        w_s = w[seg.order]
-        sent = word_sentinel(w_s.dtype, is_min)
-        masked = jnp.where(cand, w_s, sent)
-        seg_op = jax.ops.segment_min if is_min else jax.ops.segment_max
-        best = seg_op(masked, seg.seg_ids, num_segments=cap,
-                      indices_are_sorted=True)
-        cand = cand & (w_s == best[seg.seg_ids])
-    p = jnp.where(cand, pos, jnp.int32(cap))
-    win_pos = jax.ops.segment_min(p, seg.seg_ids, num_segments=cap,
-                                  indices_are_sorted=True)
-    won = (win_pos < cap) & seg.seg_active
-    winner_orig = seg.order[jnp.clip(win_pos, 0, cap - 1)]
-    return _winner_gather(seg, col, winner_orig, won)
+
+    def combine(a, b):
+        a_id, a_valid, a_p = a[0], a[1], a[2]
+        b_id, b_valid, b_p = b[0], b[1], b[2]
+        aw, bw = a[3:], b[3:]
+        same = b_id == a_id
+        a_live = a_valid & same
+        better = jnp.zeros_like(a_valid)
+        eq = jnp.ones_like(a_valid)
+        for wa, wb in zip(aw, bw):
+            c = (wa < wb) if is_min else (wa > wb)
+            better = better | (eq & c)
+            eq = eq & (wa == wb)
+        take_a = a_live & ((~b_valid) | better)
+        out = [b_id, a_live | b_valid, jnp.where(take_a, a_p, b_p)]
+        out += [jnp.where(take_a, wa, wb) for wa, wb in zip(aw, bw)]
+        return tuple(out)
+
+    res = jax.lax.associative_scan(
+        combine, tuple([seg_marker, valid, pos] + list(words)))
+    return res[2], res[1]
 
 
-def seg_extreme(seg: Segments, col: AnyDeviceColumn, is_min: bool
-                ) -> AnyDeviceColumn:
-    """min/max by winning-row-index so values round-trip untouched."""
-    if isinstance(col, DeviceStringColumn):
-        # strings: sorted position is already lexicographic *within a
-        # segment only if the string is a grouping key*; for arbitrary
-        # value columns fall back to word-wise tournament
-        return _seg_extreme_string(seg, col, is_min)
-    return _seg_extreme_words(seg, col, rank_words(col), is_min)
+class Segments:
+    """Sorted-row-space segmentation. Aggregates read their per-segment
+    result at the segment's END row; ``out_active`` marks those rows.
+    ``payload`` holds the caller's arrays co-permuted by the SAME sort
+    (lax.sort payload operands — far cheaper on TPU than sorting an
+    index and gathering each array separately)."""
+
+    def __init__(self, order, active_sorted, boundary, is_end,
+                 start_of_row, end_of_row, seg_ids, capacity: int,
+                 payload: Tuple[jax.Array, ...] = ()):
+        self.order = order                  # sorted pos -> original row
+        self.active_sorted = active_sorted
+        self.boundary = boundary            # first row of its segment
+        self.is_end = is_end                # last row of its segment
+        self.start_of_row = start_of_row    # own segment's first pos
+        self.end_of_row = end_of_row        # own segment's last pos
+        self.seg_ids = seg_ids              # dense id per sorted row
+        self.capacity = capacity
+        self.out_active = is_end & active_sorted
+        self.payload = payload              # co-sorted caller arrays
 
 
-def _seg_extreme_string(seg: Segments, col: DeviceStringColumn,
-                        is_min: bool) -> DeviceStringColumn:
-    """String min/max: tournament over (words..., length) ranking. Builds
-    a per-row composite comparison by walking words most-significant
-    first; segments pick the winning row index."""
-    words = pack_string_words(col)
-    valid_s = (col.validity[seg.order]) & seg.active_sorted
-    cap = seg.capacity
+def build_segments(key_cols: Sequence[AnyDeviceColumn],
+                   active: jax.Array,
+                   payload: Sequence[jax.Array] = ()) -> Segments:
+    cap = active.shape[0]
+    subkeys: List[jax.Array] = []
+    for c in key_cols:
+        subkeys.extend(grouping_subkeys(c))
     pos = jnp.arange(cap, dtype=jnp.int32)
-    # iterative refinement: start with all valid rows as candidates, then
-    # for each word keep only rows matching the per-segment best word
-    cand = valid_s
-    for w in words + [col.lengths.astype(jnp.uint64)]:
-        w_s = w[seg.order].astype(jnp.uint64)
-        if is_min:
-            masked = jnp.where(cand, w_s, _U64_MAX)
-            best = jax.ops.segment_min(masked, seg.seg_ids,
-                                       num_segments=cap,
-                                       indices_are_sorted=True)
+    # ONE multi-operand sort: ~active primary (live rows first), then the
+    # sub-keys, with the row index as the last key (total order = stable)
+    # and the caller's payload co-permuted for free.
+    keys = tuple([~active] + subkeys + [pos])
+    flat_payload = []
+    payload_2d = []
+    for a in payload:
+        if a.ndim == 2:  # lax.sort wants rank-1 operands of equal shape
+            payload_2d.append(len(flat_payload))
+        flat_payload.append(a)
+    operands = keys + tuple(a for a in payload if a.ndim == 1)
+    sorted_out = jax.lax.sort(operands, num_keys=len(keys))
+    inactive_s = sorted_out[0]
+    active_s = ~inactive_s
+    sorted_keys = sorted_out[1:1 + len(subkeys)]
+    order = sorted_out[len(keys) - 1]
+    payload_1d = list(sorted_out[len(keys):])
+    # 2-D payloads (string byte matrices) ride via an order gather
+    payload_sorted: List[jax.Array] = []
+    it = iter(payload_1d)
+    for a in payload:
+        if a.ndim == 2:
+            payload_sorted.append(jnp.take(a, order, axis=0))
         else:
-            masked = jnp.where(cand, w_s, jnp.uint64(0))
-            best = jax.ops.segment_max(masked, seg.seg_ids,
-                                       num_segments=cap,
-                                       indices_are_sorted=True)
-        has_cand = jax.ops.segment_max(cand.astype(jnp.int32), seg.seg_ids,
-                                       num_segments=cap,
-                                       indices_are_sorted=True) > 0
-        keep = cand & (w_s == best[seg.seg_ids]) & has_cand[seg.seg_ids]
-        cand = keep
-    p = jnp.where(cand, pos, jnp.int32(cap))
-    win_pos = jax.ops.segment_min(p, seg.seg_ids, num_segments=cap,
-                                  indices_are_sorted=True)
-    won = (win_pos < cap) & seg.seg_active
-    winner_orig = seg.order[jnp.clip(win_pos, 0, cap - 1)]
-    return _winner_gather(seg, col, winner_orig, won)
+            payload_sorted.append(next(it))
+    prev_differs = jnp.zeros(cap, dtype=bool)
+    for k in sorted_keys:
+        d = k[1:] != k[:-1]
+        prev_differs = prev_differs.at[1:].set(prev_differs[1:] | d)
+    prev_differs = prev_differs.at[1:].set(
+        prev_differs[1:] | (active_s[1:] != active_s[:-1]))
+    boundary = prev_differs.at[0].set(True)
+    is_end = jnp.concatenate(
+        [boundary[1:], jnp.ones(1, dtype=bool)])
+    start_of_row = jax.lax.cummax(jnp.where(boundary, pos, -1))
+    end_of_row = jnp.flip(jax.lax.cummin(
+        jnp.flip(jnp.where(is_end, pos, cap))))
+    seg_ids = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    return Segments(order, active_s, boundary, is_end, start_of_row,
+                    end_of_row, seg_ids, cap, tuple(payload_sorted))
 
 
-def seg_first_last(seg: Segments, col: AnyDeviceColumn, is_first: bool,
+def seg_running_sum(seg_marker: jax.Array, x: jax.Array) -> jax.Array:
+    """Segmented inclusive running sum via one associative scan (resets
+    at marker changes). Used for FLOATS, where the global-cumsum-
+    difference trick suffers catastrophic cancellation contaminated by
+    unrelated preceding segments."""
+    def combine(a, b):
+        a_id, a_v = a
+        b_id, b_v = b
+        same = b_id == a_id
+        return (b_id, jnp.where(same, a_v + b_v, b_v))
+    _ids, run = jax.lax.associative_scan(combine, (seg_marker, x))
+    return run
+
+
+def prefix_total(seg: Segments, x: jax.Array) -> jax.Array:
+    """Per-row running total restarting at segment starts; at END rows
+    this is the segment total (the scatter-free segment_sum)."""
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        return seg_running_sum(seg.start_of_row, x)
+    pp = jnp.cumsum(x)
+    base = jnp.where(seg.start_of_row > 0,
+                     jnp.take(pp, jnp.maximum(seg.start_of_row - 1, 0)),
+                     jnp.zeros((), x.dtype))
+    return pp - base
+
+
+def seg_sum(seg: Segments, col_s: AnyDeviceColumn, out_type: T.DataType,
+            null_when_empty: bool) -> DeviceColumn:
+    """sum / sum_nonnull primitive. ``col_s`` is ALREADY in sorted row
+    space (ride it through build_segments' payload)."""
+    from spark_rapids_tpu.columnar.device import storage_jnp_dtype
+    valid_s = col_s.validity & seg.active_sorted
+    acc_dt = storage_jnp_dtype(out_type)
+    vals = jnp.where(valid_s, col_s.data.astype(acc_dt),
+                     jnp.zeros((), acc_dt))
+    run = prefix_total(seg, vals)
+    if null_when_empty:
+        has = prefix_total(seg, valid_s.astype(jnp.int64)) > 0
+        validity = has & seg.out_active
+    else:
+        validity = seg.out_active
+    return DeviceColumn(out_type, jnp.where(validity, run,
+                                            jnp.zeros((), acc_dt)),
+                        validity)
+
+
+def seg_count(seg: Segments, col_s: AnyDeviceColumn) -> DeviceColumn:
+    valid_s = col_s.validity & seg.active_sorted
+    run = prefix_total(seg, valid_s.astype(jnp.int64))
+    validity = seg.out_active
+    return DeviceColumn(T.LongT, jnp.where(validity, run, jnp.int64(0)),
+                        validity)
+
+
+def _winner_gather(seg: Segments, col_s: AnyDeviceColumn,
+                   win_pos: jax.Array, won: jax.Array) -> AnyDeviceColumn:
+    """Gather the winning SORTED position's row from the sorted column;
+    `won` marks rows with a winner (others -> null)."""
+    from spark_rapids_tpu.columnar.device import take_columns
+    safe = jnp.clip(win_pos, 0, seg.capacity - 1)
+    return take_columns([col_s], safe, valid_at=won)[0]
+
+
+def seg_extreme(seg: Segments, col_s: AnyDeviceColumn, is_min: bool
+                ) -> AnyDeviceColumn:
+    """min/max by winning-row-position so values round-trip untouched."""
+    valid_s = col_s.validity & seg.active_sorted
+    words = value_words(col_s)
+    win, has = seg_scan_best(seg.start_of_row, words, valid_s, is_min)
+    won = has & seg.out_active
+    return _winner_gather(seg, col_s, win, won)
+
+
+def seg_first_last(seg: Segments, col_s: AnyDeviceColumn, is_first: bool,
                    ignore_nulls: bool) -> AnyDeviceColumn:
     """first/last by original row order (Spark First/Last semantics).
-    ignore_nulls=False ("_any" prims) takes the first/last *row* and keeps
-    its null-ness."""
-    orig = seg.order.astype(jnp.int32)
+    ignore_nulls=False ("_any" prims) takes the first/last *row* and
+    keeps its null-ness."""
     eligible = seg.active_sorted
     if ignore_nulls:
-        eligible = eligible & col.validity[seg.order]
-    cap = seg.capacity
-    if is_first:
-        cand = jnp.where(eligible, orig, jnp.int32(cap))
-        win = jax.ops.segment_min(cand, seg.seg_ids, num_segments=cap,
-                                  indices_are_sorted=True)
-        won = (win < cap) & seg.seg_active
-    else:
-        cand = jnp.where(eligible, orig, jnp.int32(-1))
-        win = jax.ops.segment_max(cand, seg.seg_ids, num_segments=cap,
-                                  indices_are_sorted=True)
-        won = (win >= 0) & seg.seg_active
+        eligible = eligible & col_s.validity
+    # rank = original row index (+1 so the uint encoding has no 0 tie)
+    orig_rank = (seg.order.astype(jnp.int64) + 1).astype(jnp.uint64)
+    win, has = seg_scan_best(seg.start_of_row, [orig_rank], eligible,
+                             is_min=is_first)
+    won = has & seg.out_active
     # _winner_gather keeps the winning row's own validity, which is what
     # ignore_nulls=False needs (null first-row -> null result)
-    return _winner_gather(seg, col, jnp.clip(win, 0, cap - 1), won)
+    return _winner_gather(seg, col_s, win, won)

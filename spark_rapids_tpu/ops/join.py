@@ -78,7 +78,10 @@ def _concat_key_columns(kl: Sequence[AnyDeviceColumn],
 
 def _key_plan(lkeys: Sequence[E.Expression], rkeys: Sequence[E.Expression],
               ctx_l: X.Ctx, ctx_r: X.Ctx, active_l, active_r):
-    """Shared by both phases: evaluate keys, assign dense key ids."""
+    """Shared by both phases: evaluate keys, segment the combined key
+    set, and derive per-row match counts/offsets with prefix sums over
+    the sorted layout — NO scatter-based segment ops (XLA scatters
+    serialize on TPU)."""
     kl = [X.dev_eval(e, ctx_l) for e in lkeys]
     kr = [X.dev_eval(e, ctx_r) for e in rkeys]
     valid_l = active_l
@@ -93,22 +96,34 @@ def _key_plan(lkeys: Sequence[E.Expression], rkeys: Sequence[E.Expression],
     combined = _concat_key_columns(kl, kr)
     valid_c = jnp.concatenate([valid_l, valid_r])
     seg = G.build_segments(combined, valid_c)
-    ids = jnp.zeros(cap_c, dtype=jnp.int32).at[seg.order].set(seg.seg_ids)
-    ids_l, ids_r = ids[:cap_l], ids[cap_l:]
-    one = jnp.int32(1)
-    cnt_r = jax.ops.segment_sum(
-        jnp.where(valid_r, one, 0), jnp.clip(ids_r, 0, cap_c - 1),
-        num_segments=cap_c)
-    cnt_l = jax.ops.segment_sum(
-        jnp.where(valid_l, one, 0), jnp.clip(ids_l, 0, cap_c - 1),
-        num_segments=cap_c)
-    return kl, kr, valid_l, valid_r, ids_l, ids_r, cnt_l, cnt_r
+    inv = jnp.argsort(seg.order)  # original combined row -> sorted pos
+    is_left_s = seg.order < cap_l
+    left_valid_s = is_left_s & seg.active_sorted
+    right_valid_s = (~is_left_s) & seg.active_sorted
+    prefL = jnp.cumsum(left_valid_s.astype(jnp.int64))
+    prefR = jnp.cumsum(right_valid_s.astype(jnp.int64))
+    start, end = seg.start_of_row, seg.end_of_row
 
+    def seg_range(pref):
+        before = jnp.where(start > 0,
+                           jnp.take(pref, jnp.maximum(start - 1, 0)),
+                           jnp.int64(0))
+        total = jnp.take(pref, jnp.clip(end, 0, cap_c - 1)) - before
+        return before, total
 
-def _match_counts(valid_l, ids_l, cnt_r, cap_c):
-    """Per-left-row number of matching right rows (0 for null keys)."""
-    at = jnp.take(cnt_r, jnp.clip(ids_l, 0, cap_c - 1))
-    return jnp.where(valid_l, at, jnp.int32(0))
+    base_r_s, cnt_r_s = seg_range(prefR)
+    _base_l_s, cnt_l_s = seg_range(prefL)
+    sp_l, sp_r = inv[:cap_l], inv[cap_l:]
+    m = jnp.where(valid_l, jnp.take(cnt_r_s, sp_l), jnp.int64(0))
+    base = jnp.where(valid_l, jnp.take(base_r_s, sp_l), jnp.int64(0))
+    cnt_l_at_r = jnp.where(valid_r, jnp.take(cnt_l_s, sp_r), jnp.int64(0))
+    # order_r[j] = original right index of the j-th valid right row in
+    # key-sorted order (base/cnt index into this)
+    pos_c = jnp.arange(cap_c, dtype=jnp.int32)
+    rkey_sorted = jnp.where(right_valid_s, pos_c, jnp.int32(cap_c))
+    ord2 = jnp.argsort(rkey_sorted, stable=True)[:cap_r]
+    order_r = jnp.clip(jnp.take(seg.order, ord2) - cap_l, 0, cap_r - 1)
+    return kl, kr, valid_l, valid_r, m, base, order_r, cnt_l_at_r
 
 
 def _build_count_fn(lkeys: Tuple[E.Expression, ...],
@@ -120,12 +135,10 @@ def _build_count_fn(lkeys: Tuple[E.Expression, ...],
     def fn(cols_l, active_l, lits_l, cols_r, active_r, lits_r):
         cap_l = active_l.shape[0]
         cap_r = active_r.shape[0]
-        cap_c = cap_l + cap_r
         ctx_l = X.Ctx(cols_l, cap_l, lkeys, lits_l)
         ctx_r = X.Ctx(cols_r, cap_r, rkeys, lits_r)
-        (_kl, _kr, valid_l, valid_r, ids_l, ids_r, cnt_l, cnt_r
+        (_kl, _kr, _valid_l, valid_r, m, base, order_r, cnt_l_at_r
          ) = _key_plan(lkeys, rkeys, ctx_l, ctx_r, active_l, active_r)
-        m = _match_counts(valid_l, ids_l, cnt_r, cap_c)
         if left_outer:
             m_eff = jnp.where(active_l, jnp.maximum(m, 1), 0)
         else:
@@ -133,13 +146,8 @@ def _build_count_fn(lkeys: Tuple[E.Expression, ...],
         m_eff = m_eff.astype(jnp.int64)
         offsets = jnp.cumsum(m_eff) - m_eff  # exclusive
         total_pairs = jnp.sum(m_eff)
-        # right side ordered by key id (invalid/missing keys to the tail)
-        key_r = jnp.where(valid_r, ids_r, jnp.int32(cap_c))
-        order_r = jnp.argsort(key_r, stable=True)
-        starts_r = jnp.cumsum(cnt_r) - cnt_r
         if right_outer:
-            matched_r = valid_r & (
-                jnp.take(cnt_l, jnp.clip(ids_r, 0, cap_c - 1)) > 0)
+            matched_r = valid_r & (cnt_l_at_r > 0)
             extra_r = active_r & ~matched_r
             n_extra = jnp.sum(extra_r.astype(jnp.int64))
             pos = jnp.arange(cap_r, dtype=jnp.int32)
@@ -148,7 +156,7 @@ def _build_count_fn(lkeys: Tuple[E.Expression, ...],
         else:
             n_extra = jnp.int64(0)
             extra_order = jnp.zeros(cap_r, dtype=jnp.int32)
-        return (total_pairs, n_extra, m, offsets, ids_l, order_r, starts_r,
+        return (total_pairs, n_extra, m, offsets, base, order_r,
                 extra_order)
     return jax.jit(fn)
 
@@ -156,11 +164,10 @@ def _build_count_fn(lkeys: Tuple[E.Expression, ...],
 def _build_gather_fn(out_cap: int, join_type: str) -> Callable:
     right_outer = join_type in ("right", "rightouter", "full", "fullouter")
 
-    def fn(cols_l, cols_r, total_pairs, n_extra, m, offsets, ids_l,
-           order_r, starts_r):
+    def fn(cols_l, cols_r, total_pairs, n_extra, m, offsets, base,
+           order_r):
         cap_l = m.shape[0]
         cap_r = order_r.shape[0]
-        cap_c = starts_r.shape[0]
         s = jnp.arange(out_cap, dtype=jnp.int64)
         li = jnp.clip(
             jnp.searchsorted(offsets, s, side="right") - 1, 0, cap_l - 1
@@ -168,11 +175,10 @@ def _build_gather_fn(out_cap: int, join_type: str) -> Callable:
         k = s - jnp.take(offsets, li)
         in_pairs = s < total_pairs
         has_match = jnp.take(m, li) > 0
-        base = jnp.take(starts_r, jnp.clip(jnp.take(ids_l, li), 0,
-                                           cap_c - 1))
+        b = jnp.take(base, li)
         ri_matched = jnp.take(
             order_r,
-            jnp.clip(base + k, 0, cap_r - 1).astype(jnp.int32))
+            jnp.clip(b + k, 0, cap_r - 1).astype(jnp.int32))
         left_valid = in_pairs
         right_valid = in_pairs & has_match
         ri = jnp.where(right_valid, ri_matched, 0).astype(jnp.int32)
@@ -182,11 +188,11 @@ def _build_gather_fn(out_cap: int, join_type: str) -> Callable:
         return out_l, take_columns(cols_r, ri, valid_at=right_valid), \
             active, left_valid, right_valid
 
-    def fn_right(cols_l, cols_r, total_pairs, n_extra, m, offsets, ids_l,
-                 order_r, starts_r, extra_order):
+    def fn_right(cols_l, cols_r, total_pairs, n_extra, m, offsets, base,
+                 order_r, extra_order):
         out_l, out_r0, active, lv, rv = fn(
-            cols_l, cols_r, total_pairs, n_extra, m, offsets, ids_l,
-            order_r, starts_r)
+            cols_l, cols_r, total_pairs, n_extra, m, offsets, base,
+            order_r)
         cap_r = order_r.shape[0]
         s = jnp.arange(out_cap, dtype=jnp.int64)
         e = s - total_pairs
@@ -222,12 +228,10 @@ def _build_mask_fn(lkeys: Tuple[E.Expression, ...],
     def fn(cols_l, active_l, lits_l, cols_r, active_r, lits_r):
         cap_l = active_l.shape[0]
         cap_r = active_r.shape[0]
-        cap_c = cap_l + cap_r
         ctx_l = X.Ctx(cols_l, cap_l, lkeys, lits_l)
         ctx_r = X.Ctx(cols_r, cap_r, rkeys, lits_r)
-        (_kl, _kr, valid_l, _valid_r, ids_l, _ids_r, _cnt_l, cnt_r
+        (_kl, _kr, _valid_l, _valid_r, m, _base, _order_r, _cnt_l_at_r
          ) = _key_plan(lkeys, rkeys, ctx_l, ctx_r, active_l, active_r)
-        m = _match_counts(valid_l, ids_l, cnt_r, cap_c)
         if is_semi:
             return active_l & (m > 0)
         return active_l & (m == 0)
@@ -266,7 +270,7 @@ def device_join(left: DeviceBatch, right: DeviceBatch,
     if count_fn is None:
         count_fn = _build_count_fn(lk, rk, join_type)
         _COUNT_CACHE[ckey] = count_fn
-    (total_pairs, n_extra, m, offsets, ids_l, order_r, starts_r,
+    (total_pairs, n_extra, m, offsets, base, order_r,
      extra_order) = count_fn(left.columns, left.active, lits_l,
                              right.columns, right.active, lits_r)
     total = int(total_pairs) + int(n_extra)  # ONE host sync for sizing
@@ -276,8 +280,7 @@ def device_join(left: DeviceBatch, right: DeviceBatch,
                     for c in left.columns for a in c.arrays()),
               tuple((a.shape, str(a.dtype))
                     for c in right.columns for a in c.arrays()))
-    gkey = (shapes, out_cap, join_type, m.shape, order_r.shape,
-            starts_r.shape)
+    gkey = (shapes, out_cap, join_type, m.shape, order_r.shape)
     gather_fn = _GATHER_CACHE.get(gkey)
     if gather_fn is None:
         gather_fn = _build_gather_fn(out_cap, join_type)
@@ -285,9 +288,9 @@ def device_join(left: DeviceBatch, right: DeviceBatch,
     if join_type in ("right", "rightouter", "full", "fullouter"):
         out_l, out_r, active, _lv, _rv = gather_fn(
             left.columns, right.columns, total_pairs, n_extra, m, offsets,
-            ids_l, order_r, starts_r, extra_order)
+            base, order_r, extra_order)
     else:
         out_l, out_r, active, _lv, _rv = gather_fn(
             left.columns, right.columns, total_pairs, n_extra, m, offsets,
-            ids_l, order_r, starts_r)
+            base, order_r)
     return DeviceBatch(out_schema, list(out_l) + list(out_r), active, total)

@@ -53,13 +53,15 @@ def sum_count_step(mesh: Mesh) -> Callable:
         kc = DeviceColumn(T.LongT, keys, active)
         vc = DeviceColumn(T.LongT, vals, active)
         # local partial aggregation (segment kernel)
-        seg = G.build_segments([kc], active)
-        psum = G.seg_sum(seg, vc, T.LongT, null_when_empty=True)
-        pcnt = G.seg_count(seg, vc)
-        rep = G.representative_rows(seg)
-        pkeys = keys[rep]
-        pact = seg.seg_active
-        pkeys = jnp.where(pact, pkeys, jnp.int64(0))
+        seg = G.build_segments([kc], active,
+                               payload=(keys, vals, active))
+        keys_s, vals_s, act_s = seg.payload
+        vc_s = DeviceColumn(T.LongT, vals_s, act_s)
+        psum = G.seg_sum(seg, vc_s, T.LongT, null_when_empty=True)
+        pcnt = G.seg_count(seg, vc_s)
+        # results live at segment-END rows (scatter-free layout)
+        pact = seg.out_active
+        pkeys = jnp.where(pact, keys_s, jnp.int64(0))
         # route partial rows by bit-exact Spark murmur3 of the key
         kcol = DeviceColumn(T.LongT, pkeys, pact)
         hv = hashing.murmur3_columns([kcol], cap, 42)
@@ -73,16 +75,18 @@ def sum_count_step(mesh: Mesh) -> Callable:
         ract = recv_act.reshape(n_dev * cap)
         # final merge: segment-sum the partial buffers per key
         fkc = DeviceColumn(T.LongT, rkeys, ract)
-        fseg = G.build_segments([fkc], ract)
-        fsum = G.seg_sum(fseg, DeviceColumn(T.LongT, rsum, rsum_valid & ract),
+        fseg = G.build_segments(
+            [fkc], ract,
+            payload=(rkeys, rsum, rsum_valid & ract, rcnt, ract))
+        rkeys_s, rsum_s, rsumv_s, rcnt_s, ract_s = fseg.payload
+        fsum = G.seg_sum(fseg, DeviceColumn(T.LongT, rsum_s, rsumv_s),
                          T.LongT, null_when_empty=True)
-        fcnt = G.seg_sum(fseg, DeviceColumn(T.LongT, rcnt, ract), T.LongT,
-                         null_when_empty=False)
-        frep = G.representative_rows(fseg)
-        fkeys = jnp.where(fseg.seg_active, rkeys[frep], jnp.int64(0))
+        fcnt = G.seg_sum(fseg, DeviceColumn(T.LongT, rcnt_s, ract_s),
+                         T.LongT, null_when_empty=False)
+        fact = fseg.out_active
+        fkeys = jnp.where(fact, rkeys_s, jnp.int64(0))
         add = lambda a: a[None]
-        return (add(fkeys), add(fsum.data), add(fcnt.data),
-                add(fseg.seg_active))
+        return (add(fkeys), add(fsum.data), add(fcnt.data), add(fact))
 
     sm = shard_map(per_shard, mesh=mesh,
                    in_specs=(P(SHUFFLE_AXIS), P(SHUFFLE_AXIS),

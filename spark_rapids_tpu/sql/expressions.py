@@ -726,13 +726,18 @@ class Coalesce(Expression):
         return self.children[0].data_type
 
     def eval(self, batch: HostBatch) -> HostColumn:
-        cols = [c.eval(batch) for c in self.children]
-        data = cols[0].data.copy()
-        validity = cols[0].validity.copy()
-        for c in cols[1:]:
-            fill = (~validity) & c.validity
-            data[fill] = c.data[fill]
-            validity |= c.validity
+        """Later arguments evaluate only where every earlier one was null
+        (short-circuit; matches the device handler's ANSI scoping)."""
+        first = self.children[0].eval(batch)
+        data = first.data.copy()
+        validity = first.validity.copy()
+        for child in self.children[1:]:
+            idx = np.nonzero(~validity)[0]
+            if not len(idx):
+                break
+            c = child.eval(batch.take(idx))
+            data[idx] = np.where(c.validity, c.data, data[idx])
+            validity[idx] = c.validity
         return HostColumn(self.data_type, data, validity).normalized()
 
 
@@ -746,12 +751,22 @@ class If(Expression):
         return self.children[1].data_type
 
     def eval(self, batch: HostBatch) -> HostColumn:
+        """Arms evaluate only on their taken rows (Spark's lazy
+        branches), so ANSI errors in the untaken arm never fire."""
         p = self.children[0].eval(batch)
-        tv = self.children[1].eval(batch)
-        fv = self.children[2].eval(batch)
-        cond = p.validity & p.data.astype(bool)  # null predicate -> false arm
-        data = np.where(cond, tv.data, fv.data)
-        validity = np.where(cond, tv.validity, fv.validity)
+        cond = p.validity & p.data.astype(bool)  # null predicate -> false
+        n = batch.num_rows
+        np_dt = T.numpy_dtype(self.data_type)
+        data = (np.full(n, "", dtype=object)
+                if np_dt == np.dtype(object) else np.zeros(n, dtype=np_dt))
+        validity = np.zeros(n, dtype=bool)
+        for mask, child in ((cond, self.children[1]),
+                            (~cond, self.children[2])):
+            idx = np.nonzero(mask)[0]
+            if len(idx):
+                v = child.eval(batch.take(idx))
+                data[idx] = v.data
+                validity[idx] = v.validity
         return HostColumn(self.data_type, data,
                           validity.astype(bool)).normalized()
 
@@ -773,6 +788,9 @@ class CaseWhen(Expression):
         return self.children[1].data_type
 
     def eval(self, batch: HostBatch) -> HostColumn:
+        """Branches evaluate only on the rows that REACH them (Spark's
+        first-match short-circuit), so ANSI errors inside an untaken
+        branch never fire."""
         n = batch.num_rows
         np_dt = T.numpy_dtype(self.data_type)
         data = (np.full(n, "", dtype=object)
@@ -781,17 +799,23 @@ class CaseWhen(Expression):
         decided = np.zeros(n, dtype=bool)
         pairs = (self.children[:-1] if self.has_else else self.children)
         for i in range(0, len(pairs), 2):
-            p = pairs[i].eval(batch)
-            v = pairs[i + 1].eval(batch)
-            hit = (~decided) & p.validity & p.data.astype(bool)
-            data[hit] = v.data[hit]
-            validity[hit] = v.validity[hit]
-            decided |= hit
+            und = np.nonzero(~decided)[0]
+            if not len(und):
+                break
+            sub = batch.take(und)
+            p = pairs[i].eval(sub)
+            hit_idx = und[p.validity & p.data.astype(bool)]
+            if len(hit_idx):
+                v = pairs[i + 1].eval(batch.take(hit_idx))
+                data[hit_idx] = v.data
+                validity[hit_idx] = v.validity
+                decided[hit_idx] = True
         if self.has_else:
-            e = self.children[-1].eval(batch)
-            rest = ~decided
-            data[rest] = e.data[rest]
-            validity[rest] = e.validity[rest]
+            rest = np.nonzero(~decided)[0]
+            if len(rest):
+                e = self.children[-1].eval(batch.take(rest))
+                data[rest] = e.data
+                validity[rest] = e.validity
         return HostColumn(self.data_type, data, validity).normalized()
 
 
@@ -1430,7 +1454,12 @@ def _cast_numeric(c: HostColumn, to: T.DataType, ansi: bool) -> HostColumn:
         as_long = _java_double_to_long(np.trunc(src))
         data = np.clip(as_long, info.min, info.max).astype(np_to)
         if ansi:
-            bad = np.isnan(src) | (data.astype(np.float64) != np.trunc(src))
+            # bound compares (exact 2^k floats) — round-trip compares
+            # miss values that round back onto the clipped result (2^63)
+            with np.errstate(all="ignore"):
+                t = np.trunc(src)
+                bad = (np.isnan(src) | (t >= np.float64(info.max) + 1.0)
+                       | (t < np.float64(info.min)))
             if (bad & validity).any():
                 raise ArithmeticError("Cast overflow in ANSI mode")
     else:

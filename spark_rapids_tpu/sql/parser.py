@@ -113,6 +113,9 @@ class _Parser:
     # -- query -------------------------------------------------------------
 
     def query(self):
+        """select_core (UNION [ALL] select_core)* [ORDER BY] [LIMIT] —
+        a trailing ORDER BY/LIMIT binds to the WHOLE union (SQL spec),
+        not the last branch."""
         df = self.select_stmt()
         while self.kw("union"):
             all_ = self.kw("all")
@@ -120,6 +123,12 @@ class _Parser:
             df = df.union(right)
             if not all_:
                 df = df.distinct()
+        if self.kw("order", "by"):
+            df = df.orderBy(*self._order_list())
+        if self.kw("limit"):
+            kind, val = self.next()
+            assert kind == "num", f"LIMIT expects a number, got {val!r}"
+            df = df.limit(int(val))
         return df
 
     def select_stmt(self):
@@ -150,15 +159,10 @@ class _Parser:
                 group.append(self.expr())
         having = self.expr() if self.kw("having") else None
         df = self._project(df, items, group, having)
-        # DISTINCT applies to the projected rows, BEFORE ordering/limit
+        # DISTINCT applies to the projected rows (ORDER BY/LIMIT are
+        # parsed by query(), after any UNION branches)
         if distinct:
             df = df.distinct()
-        if self.kw("order", "by"):
-            df = df.orderBy(*self._order_list())
-        if self.kw("limit"):
-            kind, val = self.next()
-            assert kind == "num", f"LIMIT expects a number, got {val!r}"
-            df = df.limit(int(val))
         return df
 
     def _opt_alias(self) -> Optional[str]:
@@ -403,6 +407,12 @@ class _Parser:
 
     def _literal_value(self):
         kind, val = self.next()
+        if val in ("-", "+"):
+            sign = -1 if val == "-" else 1
+            kind, val = self.next()
+            assert kind == "num", f"expected number after {val!r}"
+            return sign * (float(val) if any(c in val for c in ".eE")
+                           else int(val))
         if kind == "num":
             return float(val) if any(c in val for c in ".eE") else int(val)
         if kind == "str":

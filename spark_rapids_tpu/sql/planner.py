@@ -178,7 +178,18 @@ class Planner:
                     al = E.Alias(c, f"_d{len(child_attr)}")
                     child_attr[key] = al.to_attribute()
                     inner_items.append(al)
-        inner = L.Aggregate(list(inner_items), list(inner_items), p.child)
+        # aggregates list carries attribute refs (aliases stay in the
+        # grouping for the pre-projection), mirroring GroupedData.agg
+        inner_aggs = [g if isinstance(g, E.AttributeReference)
+                      else g.to_attribute() for g in inner_items]
+        inner = L.Aggregate(list(inner_items), inner_aggs, p.child)
+        # the outer aggregate sees the inner's OUTPUT attributes: aliased
+        # grouping expressions become their attribute references
+        grouping_attr = {id(g): (g if isinstance(g, E.AttributeReference)
+                                 else g.to_attribute())
+                         for g in p.grouping}
+        outer_grouping = [grouping_attr[id(g)] for g in p.grouping]
+        grouping_ids = {a.expr_id for a in outer_grouping}
         outer_aggs: List[E.Expression] = []
         for e in p.aggregates:
             if e in distinct:
@@ -189,9 +200,11 @@ class Planner:
                 outer_aggs.append(E.Alias(
                     E.AggregateExpression(new_func, is_distinct=False),
                     e.name, expr_id=e.expr_id))
+            elif isinstance(e, E.Alias) and e.expr_id in grouping_ids:
+                outer_aggs.append(e.to_attribute())
             else:
                 outer_aggs.append(e)
-        return L.Aggregate(list(p.grouping), outer_aggs, inner)
+        return L.Aggregate(outer_grouping, outer_aggs, inner)
 
     # -- join --------------------------------------------------------------
     def _plan_join(self, p: L.Join) -> P.PhysicalPlan:

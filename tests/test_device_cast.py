@@ -1,0 +1,203 @@
+"""Device cast matrix tests (GpuCast.scala:1338 / CastChecks coverage):
+every supported from->to leg must bit-match the CPU oracle; unsupported
+legs must fall back with a recorded reason; ANSI overflow must raise.
+"""
+
+import pytest
+
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql import expressions as E
+from spark_rapids_tpu.sql import types as T
+from spark_rapids_tpu.sql.functions import Column
+from spark_rapids_tpu.sql.session import TpuSparkSession
+
+from tests.datagen import (BooleanGen, DateGen, DoubleGen, IntegerGen,
+                           LongGen, ShortGen, SmallIntGen, StringGen,
+                           gen_batch)
+from tests.harness import (assert_tpu_and_cpu_equal_collect,
+                           assert_tpu_fallback_collect)
+
+N = 300
+
+
+def _df(spark, gens, n=N, seed=29, parts=2):
+    return spark.createDataFrame(gen_batch(gens, n, seed),
+                                 num_partitions=parts)
+
+
+def _cast(name, to):
+    return Column(E.Cast(F.col(name).expr, to)).alias("c")
+
+
+NUMERIC_TARGETS = [("byte", T.ByteT), ("short", T.ShortT),
+                   ("int", T.IntegerT), ("long", T.LongT),
+                   ("double", T.DoubleT), ("float", T.FloatT)]
+
+
+@pytest.mark.parametrize("to_name,to", NUMERIC_TARGETS,
+                         ids=[n for n, _ in NUMERIC_TARGETS])
+@pytest.mark.parametrize("gen", [IntegerGen(), LongGen(), DoubleGen()],
+                         ids=["int", "long", "double"])
+def test_numeric_to_numeric(gen, to_name, to):
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: _df(s, [("v", gen)]).select(_cast("v", to)),
+        expect_execs=["TpuProject"])
+
+
+def test_bool_numeric_legs():
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: _df(s, [("b", BooleanGen()), ("v", IntegerGen())])
+        .select(_cast("b", T.IntegerT), _cast("v", T.BooleanT).alias("c2")),
+        expect_execs=["TpuProject"])
+
+
+@pytest.mark.parametrize("gen,name", [
+    (IntegerGen(), "int"), (LongGen(), "long"), (SmallIntGen(), "small"),
+    (BooleanGen(), "bool"), (DateGen(), "date")])
+def test_to_string(gen, name):
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: _df(s, [("v", gen)]).select(_cast("v", T.StringT)),
+        expect_execs=["TpuProject"])
+
+
+def test_string_to_int_parsing():
+    vals = ["12", "-7", "+5", "  42  ", "99999999999999999999", "12.5",
+            "abc", "", "  ", None, "9223372036854775807",
+            "-9223372036854775808", "0012", "1 2"]
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: s.createDataFrame({"v": vals}, "v string",
+                                    num_partitions=2)
+        .select(_cast("v", T.LongT), _cast("v", T.IntegerT).alias("c2"),
+                _cast("v", T.ShortT).alias("c3")),
+        expect_execs=["TpuProject"])
+
+
+def test_string_to_bool_parsing():
+    vals = ["true", "FALSE", "t", "N", "yes", "no", "1", "0", "maybe",
+            " True ", "", None]
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: s.createDataFrame({"v": vals}, "v string",
+                                    num_partitions=2)
+        .select(_cast("v", T.BooleanT)),
+        expect_execs=["TpuProject"])
+
+
+def test_string_to_date_parsing():
+    vals = ["2021-03-05", "1999-12-31", "2020-02-29", "2019-02-29",
+            "2021-13-01", "2021-00-10", "2021-3-5", " 2021-03-05 ",
+            "2021", "garbage", "", None, "0001-01-01", "9999-12-31"]
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: s.createDataFrame({"v": vals}, "v string",
+                                    num_partitions=2)
+        .select(_cast("v", T.DateT)),
+        expect_execs=["TpuProject"])
+
+
+def test_date_string_roundtrip():
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: _df(s, [("d", DateGen())])
+        .select(Column(E.Cast(E.Cast(F.col("d").expr, T.StringT),
+                              T.DateT)).alias("rt")),
+        expect_execs=["TpuProject"])
+
+
+def test_unsupported_cast_falls_back():
+    # float -> string has Java Double.toString semantics; device declines
+    assert_tpu_fallback_collect(
+        lambda s: _df(s, [("v", DoubleGen())]).select(_cast("v", T.StringT)),
+        fallback_exec="CpuProjectExec")
+
+
+def test_ansi_cast_overflow_raises_on_device():
+    def q(s):
+        return s.createDataFrame({"v": [1.0, 1e300]}, "v double") \
+            .select(Column(E.Cast(F.col("v").expr, T.IntegerT,
+                                  ansi=True)).alias("c"))
+    for enabled in ("false", "true"):
+        s = TpuSparkSession({"spark.rapids.sql.enabled": enabled})
+        try:
+            with pytest.raises(ArithmeticError):
+                q(s).collect()
+        finally:
+            s.stop()
+    # and the device path really ran it (no silent fallback)
+    s = TpuSparkSession({"spark.rapids.sql.enabled": "true",
+                         "spark.rapids.sql.test.forceDevice": "true"})
+    try:
+        with pytest.raises(ArithmeticError):
+            q(s).collect()
+    finally:
+        s.stop()
+
+
+def test_ansi_cast_ok_values_pass():
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: s.createDataFrame({"v": [1.5, -2.5, None]}, "v double")
+        .select(Column(E.Cast(F.col("v").expr, T.IntegerT,
+                              ansi=True)).alias("c")),
+        expect_execs=["TpuProject"])
+
+
+def test_ansi_cast_in_sort_key_falls_back():
+    # small values: no overflow — the point is placement, not the error
+    assert_tpu_fallback_collect(
+        lambda s: _df(s, [("v", SmallIntGen())])
+        .orderBy(Column(E.SortOrder(E.Cast(F.col("v").expr, T.LongT,
+                                           ansi=True)))),
+        fallback_exec="CpuSortExec")
+
+
+def test_ansi_error_scoped_to_taken_branch():
+    """CASE guards: the untaken branch's overflow must not raise."""
+    def q(s):
+        return s.createDataFrame({"v": [1.0, 1e300]}, "v double") \
+            .select(Column(E.CaseWhen(
+                [(E.LessThan(F.col("v").expr, E.Literal(100.0)),
+                  E.Cast(F.col("v").expr, T.IntegerT, ansi=True))],
+                E.Literal(0))).alias("c"))
+    assert_tpu_and_cpu_equal_collect(q, expect_execs=["TpuProject"])
+
+
+def test_ansi_overflow_exact_boundary():
+    """2^63 rounds back onto int64 max in float space; must still raise."""
+    def q(s):
+        return s.createDataFrame({"v": [9.223372036854775808e18]},
+                                 "v double") \
+            .select(Column(E.Cast(F.col("v").expr, T.LongT,
+                                  ansi=True)).alias("c"))
+    for enabled in ("false", "true"):
+        s = TpuSparkSession({"spark.rapids.sql.enabled": enabled})
+        try:
+            with pytest.raises(ArithmeticError):
+                q(s).collect()
+        finally:
+            s.stop()
+
+
+def test_sql_in_negative_literals_and_union_order():
+    s = TpuSparkSession({"spark.rapids.sql.enabled": "true"})
+    try:
+        s.createDataFrame({"k": [-1, 2, 5]}, "k int") \
+            .createOrReplaceTempView("neg")
+        got = sorted(r.k for r in s.sql(
+            "SELECT k FROM neg WHERE k IN (-1, 2)").collect())
+        assert got == [-1, 2]
+        ordered = [r.k for r in s.sql(
+            "SELECT k FROM neg WHERE k > 0 UNION ALL "
+            "SELECT k FROM neg WHERE k < 0 ORDER BY k LIMIT 2").collect()]
+        assert ordered == [-1, 2]
+    finally:
+        s.stop()
+
+
+def test_distinct_agg_with_expression_grouping():
+    s = TpuSparkSession({"spark.rapids.sql.enabled": "true"})
+    try:
+        s.createDataFrame({"k": [1, 2, 3, 4], "v": [7, 7, 9, 9]},
+                          "k int, v int").createOrReplaceTempView("eg")
+        got = sorted((r.g, r.cv) for r in s.sql(
+            "SELECT k % 2 AS g, count(DISTINCT v) AS cv FROM eg "
+            "GROUP BY k % 2").collect())
+        assert got == [(0, 2), (1, 2)]
+    finally:
+        s.stop()
