@@ -215,3 +215,47 @@ class TpuGlobalLimitExec(TpuLocalLimitExec):
 
     def simple_string(self):
         return f"TpuGlobalLimit {self.n}"
+
+
+class TpuExpandExec(TpuExec):
+    """Grouping-sets expansion (GpuExpandExec.scala twin): each input
+    batch is projected once per grouping set and the results concat on
+    device (one fused program per projection + the jitted concat)."""
+
+    def __init__(self, projections: List[List[E.Expression]],
+                 output, child: TpuExec, conf: TpuConf):
+        super().__init__(conf)
+        self.children = [child]
+        self.projections = projections
+        self._output = output
+
+    @property
+    def child(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def output(self):
+        return self._output
+
+    def device_partitions(self) -> List[DevicePartitionThunk]:
+        from spark_rapids_tpu.columnar.device import concat_device
+        bound = [P.bind_list(proj, self.child.output)
+                 for proj in self.projections]
+        schema = self.schema
+        metrics = self.metrics
+
+        def make(thunk: DevicePartitionThunk) -> DevicePartitionThunk:
+            def run() -> Iterator[DeviceBatch]:
+                for b in thunk():
+                    outs = []
+                    for proj in bound:
+                        with metrics.timed(M.OP_TIME):
+                            cols = X.run_project(proj, b)
+                        outs.append(b.with_columns(schema, cols))
+                    if outs:
+                        yield concat_device(outs)
+            return run
+        return [make(t) for t in device_channel(self.child)]
+
+    def simple_string(self):
+        return f"TpuExpand [{len(self.projections)} sets]"

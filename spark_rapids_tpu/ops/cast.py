@@ -64,6 +64,15 @@ def parse_string_to_long(chars: jax.Array, lengths: jax.Array,
     int_ok = jnp.where(in_tok, is_digit, True).all(axis=1)
     n_dig = end - int_start
     ok = validity & (end > start) & (n_dig > 0) & int_ok
+    # leading zeros don't count toward the magnitude's digit budget
+    # ('0000000000000000000001' is 1, not an overflow)
+    nz = in_tok & is_digit & (chars != ord("0"))
+    any_nz = nz.any(axis=1)
+    first_nz = jnp.where(any_nz,
+                         jnp.argmax(nz, axis=1).astype(jnp.int32), end)
+    int_start = jnp.where(any_nz, first_nz, jnp.maximum(end - 1,
+                                                        int_start))
+    n_dig = end - int_start
     # magnitude via Horner over up to 19 left-aligned digits
     k = jnp.arange(19, dtype=jnp.int32)
     gidx = int_start[:, None] + k[None, :]
@@ -156,7 +165,9 @@ def parse_string_to_date(chars: jax.Array, lengths: jax.Array,
     m, m_ok = seg_value(d1 + 1, jnp.minimum(d2, end), 1, 2)
     d, d_ok = seg_value(d2 + 1, end, 1, 2)
     shape_ok = y_ok & m_ok & d_ok & (n_dash == 2) & (end > start)
-    y = jnp.where(neg_year, -y, y)
+    # datetime/Spark date range: years 1..9999, no negative years (the
+    # CPU oracle's datetime.date enforces the same)
+    shape_ok = shape_ok & ~neg_year & (y >= 1) & (y <= 9999)
     leap = ((jnp.remainder(y, 4) == 0) & (jnp.remainder(y, 100) != 0)) \
         | (jnp.remainder(y, 400) == 0)
     dim = jnp.select(
@@ -243,23 +254,41 @@ def bool_to_string(data: jax.Array, validity: jax.Array
 
 def date_to_string(days: jax.Array, validity: jax.Array
                    ) -> Tuple[jax.Array, jax.Array]:
-    """yyyy-MM-dd (years 0..9999 render 4-digit zero-padded, Spark's
-    DateFormatter default)."""
+    """Variable-width year like Python's f"{y:04d}" (the CPU oracle):
+    4-digit zero-padded up to 9999, wider beyond, '-' sign for negative
+    years (3+ digits after the sign)."""
     y, m, d = civil_from_days(days)
     cap = days.shape[0]
-
-    def two(v):
-        return jnp.stack([ord("0") + v // 10, ord("0") + v % 10],
-                         axis=1).astype(jnp.uint8)
-
-    y4 = jnp.stack([ord("0") + jnp.remainder(y // 1000, 10),
-                    ord("0") + jnp.remainder(y // 100, 10),
-                    ord("0") + jnp.remainder(y // 10, 10),
-                    ord("0") + jnp.remainder(y, 10)],
-                   axis=1).astype(jnp.uint8)
-    dash = jnp.full((cap, 1), ord("-"), dtype=jnp.uint8)
-    ch = jnp.concatenate([y4, dash, two(m), dash, two(d)], axis=1)
-    ch = jnp.pad(ch, ((0, 0), (0, 6)))  # width 16 (8-aligned)
-    ch = jnp.where(validity[:, None], ch, jnp.uint8(0))
-    length = jnp.where(validity, 10, 0).astype(jnp.int32)
-    return ch, length
+    neg = y < 0
+    ay = jnp.abs(y)
+    p10 = jnp.asarray(_POW10[:8], dtype=jnp.int64)
+    ydig = jnp.remainder(ay[:, None] // p10[None, :], 10)  # [cap, 8]
+    nd = jnp.maximum(
+        jnp.max(jnp.where(ydig > 0,
+                          jnp.arange(8, dtype=jnp.int32)[None, :] + 1, 0),
+                axis=1), 1)
+    ylen = jnp.maximum(nd, 4 - neg.astype(jnp.int32))  # {y:04d} shape
+    yfield = ylen + neg.astype(jnp.int32)
+    length = yfield + 6
+    width = 16
+    p = jnp.arange(width, dtype=jnp.int32)[None, :]
+    # year digits (zero-padded to ylen), right after the optional sign
+    digit_idx = ylen[:, None] - 1 - (p - neg.astype(jnp.int32)[:, None])
+    ych = (ord("0") + jnp.take_along_axis(
+        ydig, jnp.clip(digit_idx, 0, 7), axis=1)).astype(jnp.uint8)
+    ych = jnp.where((p == 0) & neg[:, None], jnp.uint8(ord("-")), ych)
+    # month/day positions relative to the year field
+    rel = p - yfield[:, None]
+    md = jnp.select(
+        [rel == 0, rel == 1, rel == 2, rel == 3, rel == 4, rel == 5],
+        [jnp.full((cap, width), ord("-"), jnp.int64),
+         (ord("0") + m // 10)[:, None] + jnp.zeros((1, width), jnp.int64),
+         (ord("0") + m % 10)[:, None] + jnp.zeros((1, width), jnp.int64),
+         jnp.full((cap, width), ord("-"), jnp.int64),
+         (ord("0") + d // 10)[:, None] + jnp.zeros((1, width), jnp.int64),
+         (ord("0") + d % 10)[:, None] + jnp.zeros((1, width), jnp.int64)],
+        0).astype(jnp.uint8)
+    ch = jnp.where(rel < 0, ych, md)
+    in_str = (p < length[:, None]) & validity[:, None]
+    ch = jnp.where(in_str, ch, jnp.uint8(0))
+    return ch, jnp.where(validity, length, 0).astype(jnp.int32)

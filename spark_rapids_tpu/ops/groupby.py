@@ -176,32 +176,15 @@ def build_segments(key_cols: Sequence[AnyDeviceColumn],
     subkeys: List[jax.Array] = []
     for c in key_cols:
         subkeys.extend(grouping_subkeys(c))
+    from spark_rapids_tpu.columnar.device import sort_with_payload
     pos = jnp.arange(cap, dtype=jnp.int32)
     # ONE multi-operand sort: ~active primary (live rows first), then the
-    # sub-keys, with the row index as the last key (total order = stable)
-    # and the caller's payload co-permuted for free.
-    keys = tuple([~active] + subkeys + [pos])
-    flat_payload = []
-    payload_2d = []
-    for a in payload:
-        if a.ndim == 2:  # lax.sort wants rank-1 operands of equal shape
-            payload_2d.append(len(flat_payload))
-        flat_payload.append(a)
-    operands = keys + tuple(a for a in payload if a.ndim == 1)
-    sorted_out = jax.lax.sort(operands, num_keys=len(keys))
-    inactive_s = sorted_out[0]
-    active_s = ~inactive_s
-    sorted_keys = sorted_out[1:1 + len(subkeys)]
-    order = sorted_out[len(keys) - 1]
-    payload_1d = list(sorted_out[len(keys):])
-    # 2-D payloads (string byte matrices) ride via an order gather
-    payload_sorted: List[jax.Array] = []
-    it = iter(payload_1d)
-    for a in payload:
-        if a.ndim == 2:
-            payload_sorted.append(jnp.take(a, order, axis=0))
-        else:
-            payload_sorted.append(next(it))
+    # sub-keys (row index appended by sort_with_payload = stable), with
+    # the caller's payload co-permuted for free.
+    sorted_keys_all, order, payload_sorted = sort_with_payload(
+        [~active] + subkeys, payload)
+    active_s = ~sorted_keys_all[0]
+    sorted_keys = sorted_keys_all[1:]
     prev_differs = jnp.zeros(cap, dtype=bool)
     for k in sorted_keys:
         d = k[1:] != k[:-1]

@@ -253,6 +253,9 @@ def _tag_exchange(meta: ExecMeta) -> None:
             r = check_expr_tree(e, meta.conf)
             if r:
                 meta.will_not_work(r)
+            if X.contains_ansi_cast(e):
+                meta.will_not_work(
+                    "ANSI casts in partition keys run on CPU")
             dt = getattr(e, "data_type", None)
             if dt is not None and isinstance(dt, T.DecimalType) \
                     and dt.precision > 18:
@@ -268,6 +271,15 @@ def _tag_exchange(meta: ExecMeta) -> None:
     else:
         meta.will_not_work(
             f"{type(p).__name__} is not supported on TPU yet")
+
+
+def _tag_expand(meta: ExecMeta) -> None:
+    for proj in meta.wrapped.projections:
+        for e in proj:
+            r = check_expr_tree(e, meta.conf)
+            if r:
+                meta.will_not_work(r)
+                return
 
 
 def _tag_sort(meta: ExecMeta) -> None:
@@ -321,14 +333,29 @@ def _tag_aggregate(meta: ExecMeta) -> None:
 
 # -- converters -------------------------------------------------------------
 
+def _coalesced(kid, conf):
+    """Insert TpuCoalesceBatches over a device exchange so narrow
+    per-batch operators see goal-sized batches instead of the exchange's
+    per-input splits (GpuTransitionOverrides' coalesce-insertion role;
+    ops that concat whole partitions anyway — agg/sort/join/window —
+    skip it)."""
+    from spark_rapids_tpu.exec.base import TpuCoalesceBatchesExec
+    from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+    if isinstance(kid, TpuShuffleExchangeExec):
+        return TpuCoalesceBatchesExec(kid, conf)
+    return kid
+
+
 def _conv_project(meta, kids):
     from spark_rapids_tpu.exec.basic import TpuProjectExec
-    return TpuProjectExec(meta.wrapped.project_list, kids[0], meta.conf)
+    return TpuProjectExec(meta.wrapped.project_list,
+                          _coalesced(kids[0], meta.conf), meta.conf)
 
 
 def _conv_filter(meta, kids):
     from spark_rapids_tpu.exec.basic import TpuFilterExec
-    return TpuFilterExec(meta.wrapped.condition, kids[0], meta.conf)
+    return TpuFilterExec(meta.wrapped.condition,
+                         _coalesced(kids[0], meta.conf), meta.conf)
 
 
 def _conv_range(meta, kids):
@@ -370,6 +397,12 @@ def _conv_aggregate(meta, kids):
     w = meta.wrapped
     return TpuHashAggregateExec(w.grouping, w.aggregates, w.mode, kids[0],
                                 w.slots, meta.conf)
+
+
+def _conv_expand(meta, kids):
+    from spark_rapids_tpu.exec.basic import TpuExpandExec
+    w = meta.wrapped
+    return TpuExpandExec(w.projections, w.output, kids[0], meta.conf)
 
 
 def _conv_sort(meta, kids):
@@ -417,6 +450,8 @@ exec_rule(P.CpuShuffleExchangeExec, "device-partitioned exchange",
           tag_fn=_tag_exchange, convert_fn=_conv_exchange)
 exec_rule(P.CpuHashAggregateExec, "sort-segmented device aggregation",
           tag_fn=_tag_aggregate, convert_fn=_conv_aggregate)
+exec_rule(P.CpuExpandExec, "device grouping-sets expansion",
+          tag_fn=_tag_expand, convert_fn=_conv_expand)
 exec_rule(P.CpuSortExec, "device lexsort over encoded sort keys",
           tag_fn=_tag_sort, convert_fn=_conv_sort)
 from spark_rapids_tpu.sql.window_exec import CpuWindowExec  # noqa: E402

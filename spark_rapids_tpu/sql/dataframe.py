@@ -149,6 +149,17 @@ class DataFrame:
         grouping = [self._resolve(c) for c in cols]
         return GroupedData(self, grouping)
 
+    def rollup(self, *cols) -> "GroupedData":
+        """Hierarchical grouping sets: (a,b,c), (a,b), (a), () — the
+        Aggregate-over-Expand shape Spark's analyzer produces."""
+        grouping = [self._resolve(c) for c in cols]
+        return GroupedData(self, grouping, sets_mode="rollup")
+
+    def cube(self, *cols) -> "GroupedData":
+        """All 2^n grouping-set combinations."""
+        grouping = [self._resolve(c) for c in cols]
+        return GroupedData(self, grouping, sets_mode="cube")
+
     def agg(self, *cols) -> "DataFrame":
         return self.groupBy().agg(*cols)
 
@@ -327,11 +338,69 @@ class DataFrame:
 
 
 class GroupedData:
-    def __init__(self, df: DataFrame, grouping: List[E.Expression]):
+    def __init__(self, df: DataFrame, grouping: List[E.Expression],
+                 sets_mode: Optional[str] = None):
         self.df = df
         self.grouping = grouping
+        self.sets_mode = sets_mode  # None | "rollup" | "cube"
+
+    def _expand_sets(self, agg_cols) -> DataFrame:
+        """rollup/cube -> Aggregate over Expand with a grouping-id column
+        (Spark's ResolveGroupingAnalytics shape; device twin:
+        GpuExpandExec). The gid keeps 'key absent from this set' groups
+        apart from genuine null-key groups."""
+        df = self.df
+        # 1. make every key an attribute (pre-project aliased exprs)
+        base_items = list(df.plan.output)
+        key_attrs: List[E.AttributeReference] = []
+        need_proj = False
+        for g in self.grouping:
+            if isinstance(g, E.AttributeReference):
+                key_attrs.append(g)
+            else:
+                alias = g if isinstance(g, E.Alias) else \
+                    E.Alias(g, _auto_name(g))
+                base_items.append(alias)
+                key_attrs.append(alias.to_attribute())
+                need_proj = True
+        plan = (L.Project(base_items, df.plan) if need_proj else df.plan)
+        child_out = list(plan.output)
+        # 2. grouping sets
+        n = len(key_attrs)
+        if self.sets_mode == "rollup":
+            sets = [frozenset(range(k)) for k in range(n, -1, -1)]
+        else:  # cube
+            sets = [frozenset(i for i in range(n) if mask & (1 << i))
+                    for mask in range((1 << n) - 1, -1, -1)]
+        # 3. expanded output: child cols + one fresh attr per key + gid
+        out_keys = [E.AttributeReference(a.name, a.data_type, True)
+                    for a in key_attrs]
+        gid = E.AttributeReference("spark_grouping_id", T.LongT, False)
+        expand_out = child_out + out_keys + [gid]
+        projections: List[List[E.Expression]] = []
+        for si, s in enumerate(sets):
+            proj: List[E.Expression] = list(child_out)
+            for i, a in enumerate(key_attrs):
+                proj.append(a if i in s
+                            else E.Literal(None, a.data_type))
+            proj.append(E.Literal(si, T.LongT))
+            projections.append(proj)
+        expanded = DataFrame(
+            L.Expand(projections, expand_out, plan), df.session)
+        # 4. aggregate over (expanded keys, gid); gid stays internal
+        aggs: List[E.Expression] = list(out_keys)
+        for c in agg_cols:
+            e = expanded._resolve(c)
+            if not isinstance(e, (E.Alias, E.AttributeReference)):
+                e = E.Alias(e, _auto_name(e))
+            aggs.append(e)
+        return DataFrame(
+            L.Aggregate(out_keys + [gid], aggs, expanded.plan),
+            df.session)
 
     def agg(self, *cols) -> DataFrame:
+        if self.sets_mode is not None:
+            return self._expand_sets(cols)
         # Non-attribute grouping keys get a single shared Alias so the
         # planner's pre-projection and the result column refer to the same
         # attribute id (Spark aliases grouping expressions the same way).

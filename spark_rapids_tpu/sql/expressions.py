@@ -1601,16 +1601,20 @@ def _cast_from_string(c: HostColumn, to: T.DataType, ansi: bool
     if isinstance(to, T.DateType):
         data = np.zeros(n, dtype=np.int32)
         import datetime
+        import re as _re
+        pat = _re.compile(r"[+]?(\d{1,7})-(\d{1,2})-(\d{1,2})\Z")
         for i in range(n):
             if not validity[i]:
                 continue
-            s = c.data[i].strip()
+            m = pat.match(c.data[i].strip())
+            if m is None:
+                validity[i] = False
+                continue
             try:
-                parts = s.split("-")
-                d = datetime.date(int(parts[0]), int(parts[1]),
-                                  int(parts[2][:2]))
+                d = datetime.date(int(m.group(1)), int(m.group(2)),
+                                  int(m.group(3)))
                 data[i] = d.toordinal() - _EPOCH_ORD
-            except (ValueError, IndexError):
+            except ValueError:
                 validity[i] = False
         return HostColumn(to, data, validity)
     if isinstance(to, T.TimestampType):

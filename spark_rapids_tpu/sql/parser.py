@@ -407,7 +407,7 @@ class _Parser:
 
     def _literal_value(self):
         kind, val = self.next()
-        if val in ("-", "+"):
+        if kind == "op" and val in ("-", "+"):
             sign = -1 if val == "-" else 1
             kind, val = self.next()
             assert kind == "num", f"expected number after {val!r}"

@@ -201,3 +201,14 @@ def test_distinct_agg_with_expression_grouping():
         assert got == [(0, 2), (1, 2)]
     finally:
         s.stop()
+
+
+def test_string_cast_edge_regressions():
+    """Leading-zero big digit strings, strict date grammar, wide years."""
+    vals = ["0000000000000000000001", "000", "12345-01-01", "+2021-03-05",
+            "2021-03-05x", "-2021-03-05", "0000-01-01"]
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: s.createDataFrame({"v": vals}, "v string",
+                                    num_partitions=1)
+        .select(_cast("v", T.LongT), _cast("v", T.DateT).alias("c2")),
+        expect_execs=["TpuProject"])

@@ -294,3 +294,58 @@ def test_full_pipeline_on_device():
         conf={"spark.rapids.sql.test.forceDevice": "true"},
         expect_execs=["TpuFilter", "TpuProject", "TpuHashAggregate",
                       "TpuExchange"])
+
+
+# ---------------------------------------------------------------------------
+# Rollup / cube (Aggregate over TpuExpand)
+# ---------------------------------------------------------------------------
+
+def test_rollup_on_device():
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: _df(s, [("k1", SmallIntGen()), ("k2", BooleanGen()),
+                          ("v", LongGen())], n=600)
+        .rollup("k1", "k2").agg(F.sum("v").alias("s"),
+                                F.count("*").alias("c")),
+        expect_execs=["TpuExpand", "TpuHashAggregate"])
+
+
+def test_cube_on_device():
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: _df(s, [("k1", SmallIntGen()), ("k2", BooleanGen()),
+                          ("v", IntegerGen())], n=400)
+        .cube("k1", "k2").agg(F.min("v").alias("mn"),
+                              F.max("v").alias("mx")),
+        expect_execs=["TpuExpand", "TpuHashAggregate"])
+
+
+def test_rollup_exact_values():
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    s = TpuSparkSession({"spark.rapids.sql.enabled": "true"})
+    try:
+        df = s.createDataFrame(
+            {"k": ["a", "a", "b"], "v": [1, 2, 4]}, "k string, v int")
+        rows = {(r.k, r.s) for r in
+                df.rollup("k").agg(F.sum("v").alias("s")).collect()}
+        assert rows == {("a", 3), ("b", 4), (None, 7)}
+    finally:
+        s.stop()
+
+
+def test_coalesce_batches_inserted_after_exchange():
+    """Project over a repartition sees TpuCoalesceBatches in the plan."""
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    s = TpuSparkSession({"spark.rapids.sql.enabled": "true"})
+    try:
+        df = _df(s, [("k", SmallIntGen()), ("v", IntegerGen())], n=500,
+                 parts=4)
+        out = df.repartition(4, "k").select(
+            (F.col("v") + 1).alias("v1"))
+        plan = s.plan_physical(out.plan)
+        assert "TpuCoalesceBatches" in s.explain_string(out.plan), \
+            s.explain_string(out.plan)
+        got = {r.v1 for r in out.collect()}
+        want = {r.v1 for r in df.select((F.col("v") + 1).alias("v1"))
+                .collect()}
+        assert got == want
+    finally:
+        s.stop()
