@@ -387,10 +387,20 @@ class GroupedData:
             projections.append(proj)
         expanded = DataFrame(
             L.Expand(projections, expand_out, plan), df.session)
-        # 4. aggregate over (expanded keys, gid); gid stays internal
+        # 4. aggregate over (expanded keys, gid); gid stays internal.
+        # Aggregates referencing a grouping column resolve to the
+        # EXPANDED (nulled) key, like Spark — so resolve against the
+        # non-key child columns + the fresh key attrs only.
+        key_ids = {a.expr_id for a in key_attrs}
+        resolve_attrs = [a for a in child_out
+                         if a.expr_id not in key_ids] + out_keys
+        case_sensitive = df.session.conf.get(
+            "spark.sql.caseSensitive", False)
         aggs: List[E.Expression] = list(out_keys)
         for c in agg_cols:
-            e = expanded._resolve(c)
+            e = _coerce_resolved(L.resolve(
+                c.expr if isinstance(c, Column) else c,
+                resolve_attrs, bool(case_sensitive)))
             if not isinstance(e, (E.Alias, E.AttributeReference)):
                 e = E.Alias(e, _auto_name(e))
             aggs.append(e)

@@ -1602,7 +1602,9 @@ def _cast_from_string(c: HostColumn, to: T.DataType, ansi: bool
         data = np.zeros(n, dtype=np.int32)
         import datetime
         import re as _re
-        pat = _re.compile(r"[+]?(\d{1,7})-(\d{1,2})-(\d{1,2})\Z")
+        # ASCII digits only (\d matches Unicode digits, which the device
+        # byte-matrix parser rightly rejects)
+        pat = _re.compile(r"[+]?([0-9]{1,7})-([0-9]{1,2})-([0-9]{1,2})\Z")
         for i in range(n):
             if not validity[i]:
                 continue
@@ -2098,3 +2100,44 @@ class WindowExpression(Expression):
     def __repr__(self) -> str:
         return (f"{self.func!r} OVER (PARTITION BY {self.partition_spec} "
                 f"ORDER BY {self.order_spec} {self.frame!r})")
+
+
+# ---------------------------------------------------------------------------
+# Python UDFs (sql/core PythonUDF; the reference routes these to its
+# python worker pool — here they evaluate on the host row loop and the
+# rewrite engine tags them NOT_ON_GPU, same placement the reference
+# reports for un-compiled UDFs)
+# ---------------------------------------------------------------------------
+
+class PythonUDF(Expression):
+    def __init__(self, fn, name: str, dtype: T.DataType,
+                 children: List[Expression]):
+        self.children = list(children)
+        self.fn = fn
+        self.name = name
+        self._dtype = dtype
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self._dtype
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        cols = [c.eval(batch) for c in self.children]
+        n = batch.num_rows
+        np_dt = T.numpy_dtype(self._dtype)
+        data = (np.full(n, "", dtype=object)
+                if np_dt == np.dtype(object) else np.zeros(n, dtype=np_dt))
+        validity = np.zeros(n, dtype=bool)
+        for i in range(n):
+            args = [None if not c.validity[i]
+                    else (c.data[i].item() if isinstance(c.data[i],
+                                                         np.generic)
+                          else c.data[i]) for c in cols]
+            out = self.fn(*args)
+            if out is not None:
+                data[i] = out
+                validity[i] = True
+        return HostColumn(self._dtype, data, validity).normalized()
+
+    def __repr__(self) -> str:
+        return f"{self.name}({self.children})"

@@ -535,3 +535,26 @@ def lead(c, offset: int = 1, default=None) -> Column:
     e = _to_expr(col(c) if isinstance(c, str) else c)
     d = None if default is None else _to_expr(lit(default))
     return Column(E.Lead(e, int(offset), d))
+
+
+def udf(f=None, returnType=None):
+    """pyspark.sql.functions.udf twin: a host-evaluated Python UDF. The
+    plan rewrite reports it NOT_ON_GPU (same placement the reference
+    gives un-compiled UDFs; its udf-compiler translates a Scala subset —
+    arbitrary Python bodies stay on the CPU here too)."""
+    # pyspark form @udf("int"): a non-callable first positional arg is
+    # the return type
+    if f is not None and not callable(f):
+        f, returnType = None, f
+    rt = _parse_type(returnType) if returnType is not None else T.StringT
+
+    def wrap(fn):
+        def call(*cols) -> Column:
+            exprs = [_to_expr(col(c) if isinstance(c, str) else c)
+                     for c in cols]
+            return Column(E.PythonUDF(fn, getattr(fn, "__name__", "udf"),
+                                      rt, exprs))
+        return call
+    if f is not None:
+        return wrap(f)
+    return wrap

@@ -340,7 +340,6 @@ def test_coalesce_batches_inserted_after_exchange():
                  parts=4)
         out = df.repartition(4, "k").select(
             (F.col("v") + 1).alias("v1"))
-        plan = s.plan_physical(out.plan)
         assert "TpuCoalesceBatches" in s.explain_string(out.plan), \
             s.explain_string(out.plan)
         got = {r.v1 for r in out.collect()}

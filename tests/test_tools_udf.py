@@ -1,0 +1,93 @@
+"""Qualification/profiling tools + Python UDF surface tests
+(reference `tools` module + PythonUDF placement)."""
+
+from spark_rapids_tpu import tools
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.session import TpuSparkSession
+
+from tests.harness import (assert_tpu_and_cpu_equal_collect,
+                           assert_tpu_fallback_collect)
+from tests.datagen import IntegerGen, SmallIntGen, gen_batch
+
+
+def _session():
+    return TpuSparkSession({"spark.rapids.sql.enabled": "true"})
+
+
+def test_qualify_reports_placement_and_reasons():
+    s = _session()
+    try:
+        df = s.createDataFrame({"k": [1, 2], "v": [1.0, 2.0]},
+                               "k int, v double")
+        q = df.filter(F.col("k") > 0).groupBy("k").agg(
+            F.sum("v").alias("sv"))  # float sum falls back by default
+        rep = tools.qualify(s, q)
+        assert "TpuFilter" in rep.device_ops
+        assert any("HashAggregate" in n for n, _ in rep.cpu_ops)
+        assert 0.0 < rep.op_coverage < 1.0
+        assert "Qualification" in rep.format()
+    finally:
+        s.stop()
+
+
+def test_profile_surfaces_metrics():
+    s = _session()
+    try:
+        df = s.createDataFrame({"k": [1, 2, 1], "v": [10, 20, 30]},
+                               "k int, v int")
+        prof = tools.profile(s, df.filter(F.col("v") > 5).groupBy("k")
+                             .agg(F.count("*").alias("c")))
+        assert prof.rows == 2
+        names = [n for n, _ in prof.operators]
+        assert any("TpuHashAggregate" in n for n in names)
+        all_metrics = {k for _n, m in prof.operators for k in m}
+        assert "numOutputRows" in all_metrics
+    finally:
+        s.stop()
+
+
+def test_udf_executes_and_falls_back():
+    double_it = F.udf(lambda x: None if x is None else x * 2, "bigint")
+    assert_tpu_fallback_collect(
+        lambda s: s.createDataFrame(
+            gen_batch([("a", IntegerGen())], 100, 3))
+        .select(double_it("a").alias("d")),
+        fallback_exec="CpuProjectExec")
+
+
+def test_udf_values():
+    s = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        up = F.udf(lambda a, b: (a or 0) + (b or 0), "bigint")
+        df = s.createDataFrame({"a": [1, None, 3], "b": [10, 20, None]},
+                               "a int, b int")
+        got = [r.s for r in df.select(up("a", "b").alias("s")).collect()]
+        assert got == [11, 20, 3]
+    finally:
+        s.stop()
+
+
+def test_udf_decorator_with_type():
+    @F.udf("bigint")
+    def plus1(x):
+        return None if x is None else x + 1
+    s = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        df = s.createDataFrame({"v": [1, None, 3]}, "v int")
+        got = [r.p for r in df.select(plus1("v").alias("p")).collect()]
+        assert got == [2, None, 4]
+    finally:
+        s.stop()
+
+
+def test_rollup_agg_over_grouping_column():
+    s = TpuSparkSession({"spark.rapids.sql.enabled": "true"})
+    try:
+        df = s.createDataFrame({"k": [1, 1, 2], "v": [5, 6, 7]},
+                               "k int, v int")
+        rows = {(r.k, r.mk) for r in
+                df.rollup("k").agg(F.max("k").alias("mk")).collect()}
+        # the max(k) resolves to the EXPANDED key (null in the total row)
+        assert rows == {(1, 1), (2, 2), (None, None)}
+    finally:
+        s.stop()
