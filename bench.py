@@ -97,6 +97,8 @@ def main():
     tpu = TpuSparkSession({
         "spark.rapids.sql.enabled": "true",
         "spark.rapids.sql.test.forceDevice": "true",  # fail on any fallback
+        # overlap per-task host round trips with device compute
+        "spark.rapids.sql.taskParallelism": "4",
     })
     q_tpu = build_query(tpu, batch)
     run_once(q_tpu)  # jit compile warm-up

@@ -126,6 +126,13 @@ CONCURRENT_TPU_TASKS = conf("spark.rapids.sql.concurrentGpuTasks").doc(
     "Number of tasks that may use the TPU concurrently; bounds HBM pressure "
     "(GpuSemaphore.scala:27).").integer(2)
 
+TASK_PARALLELISM = conf("spark.rapids.sql.taskParallelism").doc(
+    "Driver-side partition-execution threads (the executor-cores "
+    "analogue): partitions run concurrently so host syncs of one task "
+    "overlap device compute of another; concurrentGpuTasks still bounds "
+    "simultaneous device use. Default 1 (sequential); raise on real "
+    "TPU backends where per-task host round trips dominate.").integer(1)
+
 BATCH_SIZE_BYTES = conf("spark.rapids.sql.batchSizeBytes").doc(
     "Target size in bytes of columnar batches fed to TPU operators "
     "(RapidsConf.scala GPU_BATCH_SIZE_BYTES).").bytes(128 << 20)

@@ -193,6 +193,8 @@ class TpuShuffleExchangeExec(TpuExec):
         self.children = [child]
         self.partitioning = partitioning
         self._cache: Optional[List[List[DeviceBatch]]] = None
+        import threading
+        self._lock = threading.Lock()
 
     @property
     def child(self) -> TpuExec:
@@ -202,9 +204,57 @@ class TpuShuffleExchangeExec(TpuExec):
     def output(self):
         return self.child.output
 
+    def _task_threads(self) -> int:
+        from spark_rapids_tpu.conf import TASK_PARALLELISM
+        return int(self.conf.get(TASK_PARALLELISM))
+
+    def _pull_split(self, thunks, split_one) -> List[List]:
+        """Drain the child's partitions (concurrently when configured)
+        and split each batch; results keep (input partition, batch)
+        order so first/last semantics stay deterministic. ``split_one``
+        must REGISTER whatever it retains (spillable handles) itself, so
+        batches become demotable the moment they exist — not after the
+        whole child is drained."""
+        from spark_rapids_tpu.resource import get_semaphore
+        n_threads = self._task_threads()
+        sem = get_semaphore(self.conf)
+
+        def pull(thunk):
+            try:
+                return [split_one(b) for b in thunk()]
+            finally:
+                # pool threads acquire the TpuSemaphore inside the child
+                # pipeline (R2C upload) but never reach a root C2R —
+                # release here or the permits leak and later tasks hang
+                sem.release_if_necessary()
+
+        if n_threads > 1 and len(thunks) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+            # this thread may already hold a semaphore permit (acquired
+            # while draining an earlier subtree); release it before
+            # blocking on the pool or the pull threads can starve of
+            # permits and deadlock (the throttle is re-acquired on the
+            # next device touch)
+            sem.release_if_necessary()
+            with ThreadPoolExecutor(
+                    min(n_threads, len(thunks)),
+                    thread_name_prefix="srt-shuffle") as pool:
+                return list(pool.map(pull, thunks))
+        return [pull(t) for t in thunks]
+
     def _materialize(self) -> List[List]:
-        if self._cache is not None:
+        # release any held permit BEFORE blocking on the lock: if every
+        # task thread parked here while holding one, the materializer's
+        # pull threads could never acquire and the job would hang
+        from spark_rapids_tpu.resource import get_semaphore
+        get_semaphore(self.conf).release_if_necessary()
+        with self._lock:  # consumers race here under taskParallelism
+            if self._cache is not None:
+                return self._cache
+            self._cache = self._materialize_inner()
             return self._cache
+
+    def _materialize_inner(self) -> List[List]:
         from spark_rapids_tpu.memory import get_device_store
         store = get_device_store(self.conf)
         p = self.partitioning
@@ -226,19 +276,29 @@ class TpuShuffleExchangeExec(TpuExec):
             out = self._materialize_mesh(p, n)
         elif isinstance(p, P.HashPartitioning):
             bound = P.bind_list(p.exprs, self.child.output)
-            for thunk in device_channel(self.child):
-                for b in thunk():
-                    with self.metrics.timed(M.PARTITION_TIME):
-                        pids = hash_partition_ids(bound, b, n)
-                        parts = split_by_pid(b, pids, n)
-                    for pid, part in enumerate(parts):
-                        if part is not None:
-                            keep(pid, part)
+
+            def split_one(b):
+                with self.metrics.timed(M.PARTITION_TIME):
+                    pids = hash_partition_ids(bound, b, n)
+                    parts = split_by_pid(b, pids, n)
+                # register IMMEDIATELY (store is thread-safe) so the
+                # spill budget applies during the drain, not after
+                return [store.register(part) if part is not None else None
+                        for part in parts]
+            for per_part in self._pull_split(device_channel(self.child),
+                                             split_one):
+                for handles in per_part:
+                    for pid, h in enumerate(handles):
+                        if h is not None:
+                            out[pid].append(h)
         elif isinstance(p, P.SinglePartitioning):
-            for thunk in device_channel(self.child):
-                for b in thunk():
-                    if b.row_count():
-                        keep(0, b)
+            for per_part in self._pull_split(
+                    device_channel(self.child),
+                    lambda b: store.register(b) if b.row_count()
+                    else None):
+                for h in per_part:
+                    if h is not None:
+                        out[0].append(h)
         elif isinstance(p, P.RoundRobinPartitioning):
             start = 0
             for thunk in device_channel(self.child):
@@ -255,7 +315,6 @@ class TpuShuffleExchangeExec(TpuExec):
             self._materialize_range(p, n, store, keep)
         else:
             raise NotImplementedError(repr(p))
-        self._cache = out
         return out
 
     def _materialize_range(self, p: P.RangePartitioning, n: int, store,

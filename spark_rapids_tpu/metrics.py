@@ -8,6 +8,7 @@ surfaced by ``TpuExec.metrics``. Timers are wall-clock nanoseconds.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, Iterator
@@ -38,15 +39,22 @@ COPY_FROM_DEVICE_TIME = "copyFromDeviceTime"
 
 @dataclass
 class TpuMetric:
+    """Thread-safe counter: task threads (taskParallelism/shuffle pools)
+    update the same operator's metrics concurrently."""
+
     name: str
     level: int = MODERATE
     value: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False, compare=False)
 
     def add(self, v: int) -> None:
-        self.value += int(v)
+        with self._lock:
+            self.value += int(v)
 
     def set_max(self, v: int) -> None:
-        self.value = max(self.value, int(v))
+        with self._lock:
+            self.value = max(self.value, int(v))
 
 
 class MetricRegistry:
@@ -56,14 +64,16 @@ class MetricRegistry:
     def __init__(self, conf_level: str = "MODERATE"):
         self.enabled_level = _LEVELS.get(conf_level.upper(), MODERATE)
         self.metrics: Dict[str, TpuMetric] = {}
+        self._lock = threading.Lock()
 
     def create(self, name: str, level: int = MODERATE) -> TpuMetric:
-        m = self.metrics.get(name)
-        if m is None:
-            m = TpuMetric(name, level)
-            if level <= self.enabled_level:
-                self.metrics[name] = m
-        return m
+        with self._lock:  # check-then-set must be atomic across tasks
+            m = self.metrics.get(name)
+            if m is None:
+                m = TpuMetric(name, level)
+                if level <= self.enabled_level:
+                    self.metrics[name] = m
+            return m
 
     def __getitem__(self, name: str) -> TpuMetric:
         return self.metrics.get(name) or TpuMetric(name)

@@ -80,10 +80,22 @@ class PhysicalPlan:
     def partitions(self) -> List[PartitionThunk]:
         raise NotImplementedError
 
-    def execute_collect(self) -> HostBatch:
-        batches: List[HostBatch] = []
-        for thunk in self.partitions():
-            batches.extend(thunk())
+    def execute_collect(self, parallelism: int = 1) -> HostBatch:
+        """Drain all partitions (optionally with a task thread pool — the
+        executor-cores analogue; the TpuSemaphore bounds how many tasks
+        touch the device at once). Partition ORDER is preserved."""
+        thunks = self.partitions()
+        if parallelism > 1 and len(thunks) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(
+                    min(parallelism, len(thunks)),
+                    thread_name_prefix="srt-task") as pool:
+                per_part = list(pool.map(lambda t: list(t()), thunks))
+            batches = [b for part in per_part for b in part]
+        else:
+            batches = []
+            for thunk in thunks:
+                batches.extend(thunk())
         if not batches:
             return HostBatch.empty(self.schema)
         return HostBatch.concat(batches)
@@ -307,6 +319,8 @@ class CpuShuffleExchangeExec(PhysicalPlan):
         self.children = [child]
         self.partitioning = partitioning
         self._cache: Optional[List[List[HostBatch]]] = None
+        import threading
+        self._lock = threading.Lock()
 
     @property
     def child(self):
@@ -317,8 +331,13 @@ class CpuShuffleExchangeExec(PhysicalPlan):
         return self.child.output
 
     def _materialize(self) -> List[List[HostBatch]]:
-        if self._cache is not None:
-            return self._cache
+        with self._lock:  # consumers race under taskParallelism
+            if self._cache is not None:
+                return self._cache
+            self._cache = out = self._materialize_inner()
+            return out
+
+    def _materialize_inner(self) -> List[List[HostBatch]]:
         p = self.partitioning
         n = p.num_partitions
         out: List[List[HostBatch]] = [[] for _ in range(n)]
@@ -349,7 +368,6 @@ class CpuShuffleExchangeExec(PhysicalPlan):
             out = self._range_partition(p, n)
         else:
             raise NotImplementedError(repr(p))
-        self._cache = out
         return out
 
     def _range_partition(self, p: RangePartitioning, n: int

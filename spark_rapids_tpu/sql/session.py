@@ -131,7 +131,9 @@ class TpuSparkSession:
         return physical
 
     def execute_plan(self, plan: L.LogicalPlan) -> HostBatch:
-        return self.plan_physical(plan).execute_collect()
+        from spark_rapids_tpu.conf import TASK_PARALLELISM
+        return self.plan_physical(plan).execute_collect(
+            int(self.conf_obj.get(TASK_PARALLELISM)))
 
     def explain_string(self, plan: L.LogicalPlan) -> str:
         physical = self.plan_physical(plan)

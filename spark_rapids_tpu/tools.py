@@ -54,7 +54,8 @@ def qualify(session, df) -> QualificationReport:
     from spark_rapids_tpu.exec.base import TpuExec
     physical = session.plan_physical(df.plan)
     report = QualificationReport(
-        plan_string=session.explain_string(df.plan))
+        plan_string=f"== Logical ==\n{df.plan!r}"
+                    f"\n== Physical ==\n{physical!r}")
     rewrite = session.last_rewrite_report
     if rewrite is not None:
         for name, reasons in rewrite.fallbacks:
