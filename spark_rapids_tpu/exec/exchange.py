@@ -15,6 +15,8 @@ partition-id kernel.
 
 from __future__ import annotations
 
+import threading
+from functools import partial
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 import jax
@@ -52,6 +54,13 @@ def hash_partition_ids(exprs: List[E.Expression], batch: DeviceBatch,
         fn = jax.jit(_fn)
         _PID_CACHE[key] = fn
     return fn(batch.columns, batch.active, X.literal_values(exprs))
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _round_robin_pids(active: jax.Array, start: jax.Array,
+                      n: int) -> jax.Array:
+    rank = jnp.cumsum(active.astype(jnp.int32)) - 1
+    return jnp.mod(rank + start, n).astype(jnp.int32)
 
 
 def range_key_columns(order: List[E.Expression],
@@ -193,7 +202,6 @@ class TpuShuffleExchangeExec(TpuExec):
         self.children = [child]
         self.partitioning = partitioning
         self._cache: Optional[List[List[DeviceBatch]]] = None
-        import threading
         self._lock = threading.Lock()
 
     @property
@@ -303,8 +311,10 @@ class TpuShuffleExchangeExec(TpuExec):
             start = 0
             for thunk in device_channel(self.child):
                 for b in thunk():
-                    rank = jnp.cumsum(b.active.astype(jnp.int32)) - 1
-                    pids = jnp.mod(rank + start, n).astype(jnp.int32)
+                    # jitted (eager ops pay a ~100ms dispatch handshake
+                    # on tunneled backends)
+                    pids = _round_robin_pids(b.active, jnp.int32(start),
+                                             n)
                     with self.metrics.timed(M.PARTITION_TIME):
                         parts = split_by_pid(b, pids, n)
                     for pid, part in enumerate(parts):

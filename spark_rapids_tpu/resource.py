@@ -98,3 +98,11 @@ def get_semaphore(conf) -> TpuSemaphore:
         from spark_rapids_tpu.conf import CONCURRENT_TPU_TASKS
         _SEMAPHORE = TpuSemaphore(conf.get(CONCURRENT_TPU_TASKS))
     return _SEMAPHORE
+
+
+def release_current_thread() -> None:
+    """Release the calling thread's semaphore hold if the singleton
+    exists (used before blocking on task pools/locks — a parked thread
+    must not pin a device permit). No-op when no semaphore was built."""
+    if _SEMAPHORE is not None:
+        _SEMAPHORE.release_if_necessary()

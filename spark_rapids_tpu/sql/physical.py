@@ -9,6 +9,7 @@ each operator exposes `partitions()` -> list of thunks yielding HostBatch.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -87,6 +88,13 @@ class PhysicalPlan:
         thunks = self.partitions()
         if parallelism > 1 and len(thunks) > 1:
             from concurrent.futures import ThreadPoolExecutor
+
+            from spark_rapids_tpu.resource import release_current_thread
+            # partitions() may have eagerly drained device subtrees on
+            # this thread (broadcast build sides), leaving a semaphore
+            # permit held; release it before blocking on the pool or the
+            # task threads can starve of permits and hang
+            release_current_thread()
             with ThreadPoolExecutor(
                     min(parallelism, len(thunks)),
                     thread_name_prefix="srt-task") as pool:
@@ -319,7 +327,6 @@ class CpuShuffleExchangeExec(PhysicalPlan):
         self.children = [child]
         self.partitioning = partitioning
         self._cache: Optional[List[List[HostBatch]]] = None
-        import threading
         self._lock = threading.Lock()
 
     @property
@@ -331,6 +338,10 @@ class CpuShuffleExchangeExec(PhysicalPlan):
         return self.child.output
 
     def _materialize(self) -> List[List[HostBatch]]:
+        # same hazard as the TPU exchange: parking on the lock while
+        # holding a device-semaphore permit can starve the materializer
+        from spark_rapids_tpu.resource import release_current_thread
+        release_current_thread()
         with self._lock:  # consumers race under taskParallelism
             if self._cache is not None:
                 return self._cache

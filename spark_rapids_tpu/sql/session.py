@@ -135,8 +135,9 @@ class TpuSparkSession:
         return self.plan_physical(plan).execute_collect(
             int(self.conf_obj.get(TASK_PARALLELISM)))
 
-    def explain_string(self, plan: L.LogicalPlan) -> str:
-        physical = self.plan_physical(plan)
+    def explain_string(self, plan: L.LogicalPlan, physical=None) -> str:
+        if physical is None:
+            physical = self.plan_physical(plan)
         return f"== Logical ==\n{plan!r}\n== Physical ==\n{physical!r}"
 
     # -- plan capture (ExecutionPlanCaptureCallback, Plugin.scala:268-390)

@@ -54,8 +54,7 @@ def qualify(session, df) -> QualificationReport:
     from spark_rapids_tpu.exec.base import TpuExec
     physical = session.plan_physical(df.plan)
     report = QualificationReport(
-        plan_string=f"== Logical ==\n{df.plan!r}"
-                    f"\n== Physical ==\n{physical!r}")
+        plan_string=session.explain_string(df.plan, physical=physical))
     rewrite = session.last_rewrite_report
     if rewrite is not None:
         for name, reasons in rewrite.fallbacks:
