@@ -46,10 +46,20 @@ def _count_active(active: jax.Array) -> jax.Array:
 
 
 def bucket_capacity(n: int) -> int:
-    """Round up to the next power of two, floored at MIN_CAPACITY."""
+    """Smallest {1, 1.25, 1.5, 1.75} x 2^k capacity >= n, floored at
+    MIN_CAPACITY. Quarter-step buckets bound padding waste at 25% (pure
+    powers of two waste up to 100% — the round-2 bench put 1.25M rows in
+    a 2M bucket) for 4x the program-cache keys."""
     if n <= MIN_CAPACITY:
         return MIN_CAPACITY
-    return 1 << math.ceil(math.log2(n))
+    base = 1 << (n.bit_length() - 1)
+    if base == n:
+        return n
+    for num in (5, 6, 7):
+        cap = (base >> 2) * num
+        if cap >= n:
+            return cap
+    return base << 1
 
 
 def bucket_char_cap(max_len: int) -> int:
@@ -191,24 +201,11 @@ class DeviceBatch:
                   device: Optional[jax.Device] = None) -> "DeviceBatch":
         cap = capacity or bucket_capacity(max(1, batch.num_rows))
         assert cap >= batch.num_rows, (cap, batch.num_rows)
-        # stage every buffer on the host first, then ONE device_put for
-        # the whole batch (per-array uploads pay a ~100ms dispatch
-        # handshake each on tunneled TPU backends)
-        np_arrays: List[np.ndarray] = []
-        spec: List[Tuple[T.DataType, int]] = []
-        for f, c in zip(batch.schema.fields, batch.columns):
-            parts = _host_col_np(c, f.data_type, cap)
-            spec.append((f.data_type, len(parts)))
-            np_arrays.extend(parts)
-        active_np = np.zeros(cap, dtype=bool)
-        active_np[:batch.num_rows] = True
-        np_arrays.append(active_np)
-        if device is not None:
-            dev = jax.device_put(np_arrays, device)
-        else:
-            dev = jax.device_put(np_arrays)
-        cols = rebuild_columns(spec, dev[:-1])
-        return DeviceBatch(batch.schema, cols, dev[-1], batch.num_rows)
+        # packed codec: narrowed/bit-packed columns ride ONE int32
+        # staging buffer + ONE device_put; a single jitted program
+        # decodes to full-width padded columns in HBM (transfer.py)
+        from spark_rapids_tpu.columnar.transfer import upload_batch
+        return upload_batch(batch, cap, device)
 
     def to_host(self) -> HostBatch:
         """Gather active rows back to a HostBatch (device -> host copy).
@@ -250,38 +247,6 @@ def _put(arr: np.ndarray, device: Optional[jax.Device]) -> jax.Array:
     if device is not None:
         return jax.device_put(arr, device)
     return jnp.asarray(arr)
-
-
-def _host_col_np(c: HostColumn, dt: T.DataType,
-                 cap: int) -> List[np.ndarray]:
-    """Host-side staging buffers for one column (uploaded in one batch
-    by from_host)."""
-    n = len(c)
-    validity = np.zeros(cap, dtype=bool)
-    validity[:n] = c.validity
-    if is_string_like(dt):
-        encoded: List[bytes] = []
-        max_len = 1
-        for i in range(n):
-            if c.validity[i]:
-                v = c.data[i]
-                b = v.encode("utf-8") if isinstance(v, str) else bytes(v)
-            else:
-                b = b""
-            encoded.append(b)
-            max_len = max(max_len, len(b))
-        char_cap = bucket_char_cap(max_len)
-        chars = np.zeros((cap, char_cap), dtype=np.uint8)
-        lengths = np.zeros(cap, dtype=np.int32)
-        for i, b in enumerate(encoded):
-            chars[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
-            lengths[i] = len(b)
-        return [chars, lengths, validity]
-    np_dt = T.numpy_dtype(dt)
-    data = np.zeros(cap, dtype=np_dt)
-    # normalized() zeroes invalid slots on the host side already
-    data[:n] = c.normalized().data
-    return [data, validity]
 
 
 def _device_col_to_host(c: AnyDeviceColumn, dt: T.DataType,
@@ -407,21 +372,22 @@ def mask_col(c: AnyDeviceColumn, keep: jax.Array) -> AnyDeviceColumn:
 
 def sort_with_payload(keys: Sequence[jax.Array],
                       payload: Sequence[jax.Array]):
-    """ONE multi-operand lax.sort: lexicographic by `keys` (row index
-    appended as the final key, so the sort is total/stable) with
-    `payload` arrays co-permuted. Returns (sorted_keys, order,
-    sorted_payload). On TPU this is ~16x cheaper than sorting an index
-    and gathering each payload array (random gathers are HBM-bound).
-    2-D payloads (string byte matrices) fall back to one order-gather."""
+    """Lexicographic sort by `keys` (row index appended as the final key,
+    so the sort is total/stable); `payload` arrays follow via gathers on
+    the resulting order. Returns (sorted_keys, order, sorted_payload).
+
+    Payloads deliberately do NOT ride the lax.sort as extra operands:
+    XLA's sort compile time on this TPU stack grows superlinearly with
+    operand count (measured round 3: 2-operand sort ~30s, 6-operand
+    ~135s, wider sorts effectively hang the compiler), while a
+    keys-only sort plus N gathers compiles in ~35s flat and runs at the
+    same speed."""
     cap = keys[0].shape[0]
     pos = jnp.arange(cap, dtype=jnp.int32)
     ks = tuple(keys) + (pos,)
-    one_d = tuple(a for a in payload if a.ndim == 1)
-    out = jax.lax.sort(ks + one_d, num_keys=len(ks))
-    order = out[len(ks) - 1]
-    it = iter(out[len(ks):])
-    sorted_payload = [jnp.take(a, order, axis=0) if a.ndim == 2
-                      else next(it) for a in payload]
+    out = jax.lax.sort(ks, num_keys=len(ks))
+    order = out[-1]
+    sorted_payload = [jnp.take(a, order, axis=0) for a in payload]
     return out[:len(keys)], order, sorted_payload
 
 

@@ -43,11 +43,16 @@ class HostColumn:
         return int((~self.validity).sum())
 
     def to_pylist(self) -> List[Any]:
+        import datetime
         import decimal
         out: List[Any] = []
         is_bool = isinstance(self.dtype, T.BooleanType)
+        is_date = isinstance(self.dtype, T.DateType)
+        is_ts = isinstance(self.dtype, T.TimestampType)
         dec_scale = (self.dtype.scale
                      if isinstance(self.dtype, T.DecimalType) else None)
+        epoch = datetime.date(1970, 1, 1)
+        ts_epoch = datetime.datetime(1970, 1, 1)
         for i in range(len(self.data)):
             if not self.validity[i]:
                 out.append(None)
@@ -57,6 +62,18 @@ class HostColumn:
                     v = v.item()
                 if is_bool:
                     v = bool(v)
+                elif is_date:
+                    # pyspark returns datetime.date for DateType; days
+                    # outside datetime's year range stay raw ints
+                    try:
+                        v = epoch + datetime.timedelta(days=v)
+                    except OverflowError:
+                        pass
+                elif is_ts:
+                    try:
+                        v = ts_epoch + datetime.timedelta(microseconds=v)
+                    except OverflowError:
+                        pass
                 elif dec_scale is not None:
                     v = decimal.Decimal(v).scaleb(-dec_scale)
                 out.append(v)

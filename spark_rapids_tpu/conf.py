@@ -133,6 +133,14 @@ TASK_PARALLELISM = conf("spark.rapids.sql.taskParallelism").doc(
     "simultaneous device use. Default 1 (sequential); raise on real "
     "TPU backends where per-task host round trips dominate.").integer(1)
 
+AUTO_BROADCAST_JOIN_THRESHOLD = conf(
+    "spark.rapids.sql.autoBroadcastJoinThreshold").doc(
+    "Maximum estimated build-side size in bytes for a join to use a "
+    "broadcast exchange instead of a shuffled hash join; -1 disables "
+    "broadcast selection (spark.sql.autoBroadcastJoinThreshold "
+    "semantics; the reference consumes Spark's decision via "
+    "GpuBroadcastHashJoinExec).").bytes(10 << 20)
+
 BATCH_SIZE_BYTES = conf("spark.rapids.sql.batchSizeBytes").doc(
     "Target size in bytes of columnar batches fed to TPU operators "
     "(RapidsConf.scala GPU_BATCH_SIZE_BYTES).").bytes(128 << 20)

@@ -195,6 +195,20 @@ def split_by_pid(batch: DeviceBatch, pids: jax.Array, n: int
     return out
 
 
+def realign_spilled_pids(handle, pids: jax.Array, act: jax.Array
+                         ) -> Tuple[DeviceBatch, jax.Array]:
+    """Re-promote a spillable handle whose per-slot ``pids`` were computed
+    against the pre-spill layout. A spill round-trip compacts the batch
+    (active rows become a prefix, original order kept), so the pids are
+    remapped through the same compaction permutation. Shared by the range
+    exchange and the out-of-core sort."""
+    b = handle.get()
+    if handle.ever_spilled or b.capacity != act.shape[0]:
+        comp = jnp.argsort(~act, stable=True)
+        pids = pids[comp][:b.capacity]
+    return b, pids
+
+
 class TpuShuffleExchangeExec(TpuExec):
     def __init__(self, partitioning: P.Partitioning, child: TpuExec,
                  conf: TpuConf):
@@ -348,13 +362,7 @@ class TpuShuffleExchangeExec(TpuExec):
         with self.metrics.timed(M.PARTITION_TIME):
             pids_per_batch = global_range_pids(p.order, keycols, actives, n)
         for h, pids, act in zip(handles, pids_per_batch, actives):
-            b = h.get()
-            if h.ever_spilled or b.capacity != act.shape[0]:
-                # a spill round-trip compacted the batch: active rows are
-                # now a prefix, in original order — remap the per-slot
-                # pids through the same compaction permutation
-                comp = jnp.argsort(~act, stable=True)
-                pids = pids[comp][:b.capacity]
+            b, pids = realign_spilled_pids(h, pids, act)
             with self.metrics.timed(M.PARTITION_TIME):
                 parts = split_by_pid(b, pids, n)
             h.close()

@@ -125,18 +125,58 @@ class TpuShuffledHashJoinExec(TpuExec):
         cols = [pair.columns[have[w]] for w in want]
         return DeviceBatch(self.schema, cols, pair.active, pair._num_rows)
 
+    # join types whose per-left-row results are independent of other left
+    # rows — the stream (left) side may be processed in bounded chunks
+    # against the whole build side (JoinGatherer.scala:55 chunked-gather
+    # role; right/full outer need cross-chunk matched-right tracking and
+    # keep the single-batch path for now)
+    _LEFT_STREAM_TYPES = ("inner", "cross", "left", "leftouter",
+                          "leftsemi", "leftanti")
+
     def device_partitions(self) -> List[DevicePartitionThunk]:
         lparts = device_channel(self.left)
         rparts = device_channel(self.right)
         assert len(lparts) == len(rparts), \
             "join children must be co-partitioned"
+        goal = self.conf.batch_size_rows
 
         def make(lt: DevicePartitionThunk, rt: DevicePartitionThunk
                  ) -> DevicePartitionThunk:
             def run() -> Iterator[DeviceBatch]:
-                lb = [b for b in lt() if b.row_count()]
+                from spark_rapids_tpu.memory import get_device_store
+                store = get_device_store(self.conf)
+                # stream side drains into spillable handles first, so a
+                # skewed partition never pins both sides at once
+                lhandles = [store.register(b) for b in lt()
+                            if b.row_count()]
                 rb = [b for b in rt() if b.row_count()]
-                yield from self._join_one(lb, rb)
+                total_l = sum(h.rows for h in lhandles)
+                if (self.join_type not in self._LEFT_STREAM_TYPES
+                        or total_l <= goal):
+                    lb = [h.get() for h in lhandles]
+                    for h in lhandles:
+                        h.close()
+                    yield from self._join_one(lb, rb)
+                    return
+                # chunked stream: build side concatenated once, left
+                # handles re-promoted and joined goal-rows at a time
+                rwhole = (concat_device(rb) if len(rb) > 1 else
+                          rb[0] if rb else
+                          DeviceBatch.empty(self.right.schema))
+                i = 0
+                while i < len(lhandles):
+                    chunk = [lhandles[i]]
+                    rows = lhandles[i].rows
+                    i += 1
+                    while i < len(lhandles) and \
+                            rows + lhandles[i].rows <= goal:
+                        rows += lhandles[i].rows
+                        chunk.append(lhandles[i])
+                        i += 1
+                    lb = [h.get() for h in chunk]
+                    for h in chunk:
+                        h.close()
+                    yield from self._join_one(lb, [rwhole])
             return run
         return [make(lt, rt) for lt, rt in zip(lparts, rparts)]
 

@@ -1,15 +1,21 @@
 """TpuSortExec / TpuTopNExec: device sort (GpuSortExec.scala:68 twin).
 
-Per-partition sort, matching the CPU engine's semantics: the partition's
-batches are concatenated on device, sort keys are evaluated as fused
-device expressions, and one jitted program (cached on expression
-structure + capacity bucket) produces the permuted batch. Global sorts
-rely on the planner's range-partitioning exchange for cross-partition
-order, exactly like Spark.
+Per-partition sort matching the CPU engine's semantics. Partitions that
+fit the batch-row goal are concatenated and sorted in one fused program.
+Larger partitions take the OUT-OF-CORE path (GpuOutOfCoreSortIterator,
+GpuSortExec.scala:231, re-imagined for the static-shape model): input
+batches become spillable handles while only their order-encoded KEY
+columns stay resident; global sort ranks split every batch into
+rank-contiguous sub-ranges (the same exact-rank machinery as the range
+exchange), and each sub-range — bounded by the batch-row goal — is then
+concatenated, sorted, and emitted in order. The partition is never fully
+resident in HBM; stable rank splitting keeps the result bit-identical to
+the CPU engine's stable lexsort.
 
 TpuTopNExec is the TakeOrderedAndProject analogue (GpuTopN,
 limit.scala:123): sort then keep the first ``n`` rows via the active
-mask — no data movement beyond the sort's own gather.
+mask — no data movement beyond the sort's own gather; per-batch TopN
+bounds memory by construction, so it never needs the out-of-core path.
 """
 
 from __future__ import annotations
@@ -25,6 +31,7 @@ from spark_rapids_tpu.columnar.device import (DeviceBatch, concat_device,
 from spark_rapids_tpu.conf import TpuConf
 from spark_rapids_tpu.exec.base import (DevicePartitionThunk, TpuExec,
                                         device_channel)
+from spark_rapids_tpu.exec.exchange import range_key_columns
 from spark_rapids_tpu.ops import exprs as X
 from spark_rapids_tpu.ops import sort as S
 from spark_rapids_tpu.sql import expressions as E
@@ -116,22 +123,91 @@ class TpuSortExec(TpuExec):
         bound = P.bind_list([o.child for o in self.order],
                             self.child.output)
         metrics = self.metrics
+        limit = self._limit()
+        goal = self.conf.batch_size_rows
 
         def make(thunk: DevicePartitionThunk) -> DevicePartitionThunk:
             def run() -> Iterator[DeviceBatch]:
-                batches = [b for b in thunk() if b.row_count()]
-                if not batches:
+                if limit >= 0:
+                    # TopN: memory-bounded by construction (per-batch
+                    # sort+limit, then one bounded merge)
+                    batches = [b for b in thunk() if b.row_count()]
+                    if not batches:
+                        return
+                    whole = (batches[0] if len(batches) == 1
+                             else concat_device(batches))
+                    with metrics.timed(M.SORT_TIME):
+                        out = sorted_batch(self.order, bound, whole, limit)
+                    metrics.create(M.NUM_OUTPUT_ROWS, M.ESSENTIAL).add(
+                        out.row_count())
+                    yield out
                     return
-                whole = (batches[0] if len(batches) == 1
-                         else concat_device(batches))
-                with metrics.timed(M.SORT_TIME):
-                    out = sorted_batch(self.order, bound, whole,
-                                       self._limit())
-                metrics.create(M.NUM_OUTPUT_ROWS, M.ESSENTIAL).add(
-                    out.row_count())
-                yield out
+                from spark_rapids_tpu.memory import get_device_store
+                store = get_device_store(self.conf)
+                handles, keycols, actives = [], [], []
+                for b in thunk():
+                    if b.row_count() == 0:
+                        continue
+                    with metrics.timed(M.SORT_TIME):
+                        keycols.append(
+                            range_key_columns(self.order, bound, b))
+                    actives.append(b.active)
+                    handles.append(store.register(b))
+                if not handles:
+                    return
+                total = sum(h.rows for h in handles)
+                if total <= goal or len(handles) == 1:
+                    keycols.clear()
+                    whole = concat_device([h.get() for h in handles])
+                    for h in handles:
+                        h.close()
+                    with metrics.timed(M.SORT_TIME):
+                        out = sorted_batch(self.order, bound, whole, -1)
+                    metrics.create(M.NUM_OUTPUT_ROWS, M.ESSENTIAL).add(
+                        out.row_count())
+                    yield out
+                    return
+                yield from self._out_of_core(
+                    store, handles, keycols, actives, total, goal, bound,
+                    metrics)
             return run
         return [make(t) for t in device_channel(self.child)]
+
+    def _out_of_core(self, store, handles, keycols, actives, total: int,
+                     goal: int, bound, metrics) -> Iterator[DeviceBatch]:
+        """Rank-split external sort: exact global ranks over the resident
+        key columns assign each row to a rank-contiguous sub-range of at
+        most ``goal`` rows; each sub-range is concatenated, sorted, and
+        emitted in order (GpuSortExec.scala:231 role)."""
+        from spark_rapids_tpu.exec.exchange import (global_range_pids,
+                                                    realign_spilled_pids,
+                                                    split_by_pid)
+        n_sub = (total + goal - 1) // goal
+        with metrics.timed(M.SORT_TIME):
+            pids_per_batch = global_range_pids(self.order, keycols,
+                                               actives, n_sub)
+        keycols.clear()
+        buckets: List[List] = [[] for _ in range(n_sub)]
+        for h, pids, act in zip(handles, pids_per_batch, actives):
+            b, pids = realign_spilled_pids(h, pids, act)
+            with metrics.timed(M.SORT_TIME):
+                parts = split_by_pid(b, pids, n_sub)
+            h.close()
+            for pid, part in enumerate(parts):
+                if part is not None:
+                    buckets[pid].append(store.register(part))
+        for pid in range(n_sub):
+            parts = [h.get() for h in buckets[pid]]
+            if not parts:
+                continue
+            whole = parts[0] if len(parts) == 1 else concat_device(parts)
+            for h in buckets[pid]:
+                h.close()
+            with metrics.timed(M.SORT_TIME):
+                out = sorted_batch(self.order, bound, whole, -1)
+            metrics.create(M.NUM_OUTPUT_ROWS, M.ESSENTIAL).add(
+                out.row_count())
+            yield out
 
     def simple_string(self):
         return f"TpuSort {self.order} global={self.is_global}"

@@ -78,6 +78,13 @@ def expr_key(e: E.Expression) -> Tuple:
         parts.append(("to", repr(e.data_type), e.ansi))
     elif isinstance(e, E.Murmur3Hash):
         parts.append(("seed", e.seed))
+    elif isinstance(e, E.XxHash64):
+        parts.append(("seed", e.seed))
+    elif isinstance(e, (E.StringRepeat, E.StringLPad, E.StringRPad)):
+        # numeric literal counts drive static output widths at trace
+        # time, so they are structural, not traced (like Round's scale)
+        n = e.children[1]
+        parts.append(("n", n.value if isinstance(n, E.Literal) else None))
     elif isinstance(e, E.CaseWhen):
         parts.append(("has_else", e.has_else))
     elif isinstance(e, E.SortOrder):
@@ -226,10 +233,12 @@ def dev_eval(e: E.Expression, ctx: Ctx) -> AnyDeviceColumn:
 # which lowers division to reciprocal+Newton). Grouped for platform_gate.
 _FLOAT_DIV_LIKE = (E.Divide, E.Sqrt, E.Exp, E.Sin, E.Cos, E.Tan, E.Asin,
                    E.Acos, E.Atan, E.Sinh, E.Cosh, E.Tanh, E.Log, E.Log10,
-                   E.Pow, E.Round)
+                   E.Pow, E.Round, E.Log2, E.Log1p, E.Expm1, E.Cbrt,
+                   E.Atan2, E.Hypot, E.MonthsBetween)
 # UnaryMinus/Abs are excluded: negation and |x| are sign-bit operations,
 # bit-exact even where f64 arithmetic is emulated.
-_FLOAT_ARITH = (E.Add, E.Subtract, E.Multiply, E.Remainder, E.Pmod)
+_FLOAT_ARITH = (E.Add, E.Subtract, E.Multiply, E.Remainder, E.Pmod,
+                E.ToDegrees, E.ToRadians, E.Rint)
 
 
 def platform_gate(e: E.Expression) -> Optional[str]:
@@ -1249,3 +1258,823 @@ def run_filter(cond: E.Expression, batch: DeviceBatch) -> DeviceBatch:
                          literal_values([cond]))
     _raise_if_errors(err)
     return DeviceBatch(batch.schema, batch.columns, new_active, None)
+
+
+# ---------------------------------------------------------------------------
+# Bitwise (arithmetic.scala GpuBitwise* / GpuShift* twins)
+# ---------------------------------------------------------------------------
+
+@handles(E.BitwiseAnd, E.BitwiseOr, E.BitwiseXor)
+def _h_bitwise(e, ctx: Ctx) -> DeviceColumn:
+    lc, rc = _binary_cols(e, ctx)
+    validity = _valid_and([lc, rc])
+    dt = storage_jnp_dtype(e.data_type)
+    a, b = lc.data.astype(dt), rc.data.astype(dt)
+    if isinstance(e, E.BitwiseAnd):
+        data = a & b
+    elif isinstance(e, E.BitwiseOr):
+        data = a | b
+    else:
+        data = a ^ b
+    return _normalized(e.data_type, data, validity)
+
+
+@handles(E.BitwiseNot)
+def _h_bitwise_not(e: E.BitwiseNot, ctx: Ctx) -> DeviceColumn:
+    c = dev_eval(e.children[0], ctx)
+    return _normalized(e.data_type, ~c.data, c.validity)
+
+
+@handles(E.ShiftLeft, E.ShiftRight, E.ShiftRightUnsigned)
+def _h_shift(e, ctx: Ctx) -> DeviceColumn:
+    lc, rc = _binary_cols(e, ctx)
+    validity = _valid_and([lc, rc])
+    is_long = isinstance(e.data_type, T.LongType)
+    mask = 63 if is_long else 31
+    dt = storage_jnp_dtype(e.data_type)
+    a = lc.data.astype(dt)
+    n = (rc.data.astype(dt) & dt.type(mask))
+    if isinstance(e, E.ShiftLeft):
+        data = a << n
+    elif isinstance(e, E.ShiftRight):
+        data = a >> n  # arithmetic on signed, like Java
+    else:
+        udt = jnp.uint64 if is_long else jnp.uint32
+        data = (a.view(udt) >> n.view(udt)).view(dt)
+    return _normalized(e.data_type, data, validity)
+
+
+@handles(E.Greatest, E.Least)
+def _h_greatest_least(e, ctx: Ctx) -> AnyDeviceColumn:
+    """Null-skipping row-wise extreme; NaN ranks greatest (Spark)."""
+    cols = [dev_eval(c, ctx) for c in e.children]
+    is_min = isinstance(e, E.Least)
+    dt = storage_jnp_dtype(e.data_type)
+    is_float = jnp.issubdtype(dt, jnp.floating)
+    data = cols[0].data.astype(dt)
+    have = cols[0].validity
+    validity = cols[0].validity
+    for c in cols[1:]:
+        d = c.data.astype(dt)
+        if is_float:
+            if is_min:
+                better = (~jnp.isnan(d)) & ((d < data) | jnp.isnan(data))
+            else:
+                better = jnp.isnan(d) | (d > data)
+        else:
+            better = (d < data) if is_min else (d > data)
+        take = c.validity & (~have | better)
+        data = jnp.where(take, d, data)
+        have = have | c.validity
+        validity = validity | c.validity
+    return _normalized(e.data_type, data, validity)
+
+
+# ---------------------------------------------------------------------------
+# Extra math (mathExpressions.scala twins)
+# ---------------------------------------------------------------------------
+
+@handles(E.Expm1, E.Cbrt, E.Rint, E.ToDegrees, E.ToRadians)
+def _h_math2(e, ctx: Ctx) -> DeviceColumn:
+    fns = {E.Expm1: jnp.expm1, E.Cbrt: jnp.cbrt, E.Rint: jnp.rint,
+           E.ToDegrees: jnp.degrees, E.ToRadians: jnp.radians}
+    c = dev_eval(e.children[0], ctx)
+    data = fns[type(e)](c.data.astype(jnp.float64))
+    return _normalized(T.DoubleT, data, c.validity)
+
+
+@handles(E.Log2)
+def _h_log2(e: E.Log2, ctx: Ctx) -> DeviceColumn:
+    c = dev_eval(e.children[0], ctx)
+    x = c.data.astype(jnp.float64)
+    validity = c.validity & (x > 0)
+    data = jnp.log2(jnp.where(x > 0, x, 1.0))
+    return _normalized(T.DoubleT, data, validity)
+
+
+@handles(E.Log1p)
+def _h_log1p(e: E.Log1p, ctx: Ctx) -> DeviceColumn:
+    c = dev_eval(e.children[0], ctx)
+    x = c.data.astype(jnp.float64)
+    validity = c.validity & (x > -1.0)
+    data = jnp.log1p(jnp.where(x > -1.0, x, 0.0))
+    return _normalized(T.DoubleT, data, validity)
+
+
+@handles(E.Atan2, E.Hypot)
+def _h_binmath(e, ctx: Ctx) -> DeviceColumn:
+    fns = {E.Atan2: jnp.arctan2, E.Hypot: jnp.hypot}
+    lc, rc = _binary_cols(e, ctx)
+    validity = _valid_and([lc, rc])
+    data = fns[type(e)](lc.data.astype(jnp.float64),
+                        rc.data.astype(jnp.float64))
+    return _normalized(T.DoubleT, data, validity)
+
+
+# ---------------------------------------------------------------------------
+# Extra strings (stringFunctions.scala twins)
+# ---------------------------------------------------------------------------
+
+@handles(E.ConcatWs)
+def _h_concat_ws(e: E.ConcatWs, ctx: Ctx) -> DeviceStringColumn:
+    """Null args are skipped; a separator is placed between every pair of
+    RETAINED args; null only when the separator is null."""
+    cols = [dev_eval(c, ctx) for c in e.children]
+    sep, args = cols[0], cols[1:]
+    validity = sep.validity
+    total = sum(c.char_cap for c in args) + \
+        sep.char_cap * max(0, len(args) - 1)
+    out_cap = bucket_char_cap(max(8, total))
+    pos = jnp.arange(out_cap)[None, :]
+    out = jnp.zeros((ctx.capacity, out_cap), dtype=jnp.uint8)
+    off = jnp.zeros(ctx.capacity, dtype=jnp.int32)
+    any_prev = jnp.zeros(ctx.capacity, dtype=jnp.bool_)
+    for c in args:
+        live = c.validity
+        # separator first (where a previous piece exists)
+        sep_live = live & any_prev
+        rel = pos - off[:, None]
+        sep_len = jnp.where(sep_live, sep.lengths, 0)
+        in_sep = (rel >= 0) & (rel < sep_len[:, None])
+        src = jnp.clip(rel, 0, max(sep.char_cap - 1, 0))
+        piece = jnp.take_along_axis(
+            _pad_chars(sep, max(sep.char_cap, 1)), src, axis=1)
+        out = jnp.where(in_sep, piece, out)
+        off = off + sep_len
+        rel = pos - off[:, None]
+        c_len = jnp.where(live, c.lengths, 0)
+        in_piece = (rel >= 0) & (rel < c_len[:, None])
+        src = jnp.clip(rel, 0, max(c.char_cap - 1, 0))
+        piece = jnp.take_along_axis(
+            _pad_chars(c, max(c.char_cap, 1)), src, axis=1)
+        out = jnp.where(in_piece, piece, out)
+        off = off + c_len
+        any_prev = any_prev | live
+    lengths = jnp.where(validity, off, 0)
+    out = jnp.where(validity[:, None], out, 0)
+    return DeviceStringColumn(T.StringT, out, lengths, validity)
+
+
+def _lit_int(e: E.Expression) -> Optional[int]:
+    if isinstance(e, E.Literal) and e.value is not None and \
+            not isinstance(e.data_type, (T.StringType, T.BinaryType)):
+        return int(e.value)
+    return None
+
+
+def _lit_str(e: E.Expression) -> Optional[str]:
+    if isinstance(e, E.Literal) and isinstance(e.data_type, T.StringType) \
+            and e.value is not None:
+        return str(e.value)
+    return None
+
+
+@extra_check(E.StringRepeat)
+def _c_repeat(e: E.StringRepeat):
+    if _lit_int(e.children[1]) is None:
+        return "repeat count must be a literal on device (static width)"
+    return None
+
+
+@handles(E.StringRepeat)
+def _h_repeat(e: E.StringRepeat, ctx: Ctx) -> DeviceStringColumn:
+    c = dev_eval(e.children[0], ctx)
+    nc = dev_eval(e.children[1], ctx)
+    times = max(0, _lit_int(e.children[1]))
+    validity = _valid_and([c, nc])
+    if times == 0 or c.char_cap == 0:
+        z = jnp.zeros((ctx.capacity, 8), dtype=jnp.uint8)
+        return DeviceStringColumn(
+            T.StringT, z, jnp.zeros(ctx.capacity, jnp.int32), validity)
+    out_cap = bucket_char_cap(c.char_cap * times)
+    pos = jnp.arange(out_cap)[None, :]
+    slen = jnp.maximum(c.lengths, 1)[:, None]
+    src = jnp.clip(jnp.mod(pos, slen), 0, c.char_cap - 1)
+    chars = jnp.take_along_axis(_pad_chars(c, out_cap), src, axis=1)
+    new_len = (c.lengths * times).astype(jnp.int32)
+    keep = pos < new_len[:, None]
+    chars = jnp.where(keep & validity[:, None], chars, 0)
+    return DeviceStringColumn(T.StringT, chars,
+                              jnp.where(validity, new_len, 0), validity)
+
+
+@extra_check(E.StringLPad, E.StringRPad)
+def _c_pad(e):
+    if _lit_int(e.children[1]) is None or _lit_str(e.children[2]) is None:
+        return "lpad/rpad length and pad must be literals on device"
+    return None
+
+
+@handles(E.StringLPad, E.StringRPad)
+def _h_pad(e, ctx: Ctx) -> DeviceStringColumn:
+    c = dev_eval(e.children[0], ctx)
+    ln = dev_eval(e.children[1], ctx)
+    pc = dev_eval(e.children[2], ctx)
+    n = _lit_int(e.children[1])
+    pad = _lit_str(e.children[2]).encode("utf-8")
+    validity = _valid_and([c, ln, pc])
+    left = e.left_side  # StringRPad subclasses StringLPad
+    if n <= 0:
+        z = jnp.zeros((ctx.capacity, 8), dtype=jnp.uint8)
+        return DeviceStringColumn(
+            T.StringT, z, jnp.zeros(ctx.capacity, jnp.int32), validity)
+    out_cap = bucket_char_cap(max(n, c.char_cap))
+    slen = c.lengths.astype(jnp.int32)
+    if not pad:
+        new_len = jnp.minimum(slen, n)
+        pos = jnp.arange(out_cap)[None, :]
+        chars = _pad_chars(c, out_cap)
+        keep = pos < new_len[:, None]
+        chars = jnp.where(keep & validity[:, None], chars, 0)
+        return DeviceStringColumn(T.StringT, chars,
+                                  jnp.where(validity, new_len, 0), validity)
+    fill_len = jnp.clip(n - slen, 0, None)
+    new_len = jnp.where(slen >= n, n, slen + fill_len).astype(jnp.int32)
+    pos = jnp.arange(out_cap)[None, :]
+    pad_arr = jnp.asarray(
+        np.frombuffer(pad * (n // len(pad) + 1), dtype=np.uint8)[:n]
+        .astype(np.int32))
+    sc = _pad_chars(c, out_cap)
+    if left:
+        # first fill_len positions from pad, then the string
+        from_pad = pos < fill_len[:, None]
+        pad_idx = jnp.clip(pos, 0, n - 1)
+        str_idx = jnp.clip(pos - fill_len[:, None], 0, out_cap - 1)
+    else:
+        from_pad = (pos >= slen[:, None]) & (pos < new_len[:, None])
+        pad_idx = jnp.clip(pos - slen[:, None], 0, n - 1)
+        str_idx = jnp.clip(pos, 0, out_cap - 1)
+    pad_vals = pad_arr[pad_idx].astype(jnp.uint8)
+    str_vals = jnp.take_along_axis(
+        sc, jnp.broadcast_to(str_idx, (ctx.capacity, out_cap)), axis=1)
+    chars = jnp.where(from_pad, jnp.broadcast_to(
+        pad_vals, (ctx.capacity, out_cap)), str_vals)
+    keep = pos < new_len[:, None]
+    chars = jnp.where(keep & validity[:, None], chars, 0)
+    return DeviceStringColumn(T.StringT, chars,
+                              jnp.where(validity, new_len, 0), validity)
+
+
+@extra_check(E.StringTranslate)
+def _c_translate(e: E.StringTranslate):
+    m, r = _lit_str(e.children[1]), _lit_str(e.children[2])
+    if m is None or r is None:
+        return "translate match/replace must be literals on device"
+    if any(ord(ch) > 127 for ch in m + r):
+        return "non-ASCII translate runs on CPU (byte-level mapping)"
+    return None
+
+
+@handles(E.StringTranslate)
+def _h_translate(e: E.StringTranslate, ctx: Ctx) -> DeviceStringColumn:
+    """ASCII translate via a 256-entry lookup: map each byte, then
+    compact deleted positions with a stable sort on kept-rank."""
+    c = dev_eval(e.children[0], ctx)
+    _m = dev_eval(e.children[1], ctx)
+    _r = dev_eval(e.children[2], ctx)
+    m, r = _lit_str(e.children[1]), _lit_str(e.children[2])
+    table = np.arange(256, dtype=np.int32)
+    delete = np.zeros(256, dtype=bool)
+    seen = set()
+    for j, ch in enumerate(m):
+        if ch in seen:
+            continue
+        seen.add(ch)
+        if j < len(r):
+            table[ord(ch)] = ord(r[j])
+        else:
+            delete[ord(ch)] = True
+    validity = _valid_and([c, _m, _r])
+    cap = max(c.char_cap, 1)
+    mapped = jnp.asarray(table)[c.chars.astype(jnp.int32)]
+    deleted = jnp.asarray(delete)[c.chars.astype(jnp.int32)]
+    in_str = jnp.arange(cap)[None, :] < c.lengths[:, None]
+    keep = in_str & ~deleted
+    # stable-sort each row by (dropped, position): kept bytes compact left
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    chars = jnp.take_along_axis(mapped, order, axis=1).astype(jnp.uint8)
+    new_len = keep.sum(axis=1).astype(jnp.int32)
+    pos = jnp.arange(cap)[None, :]
+    chars = jnp.where((pos < new_len[:, None]) & validity[:, None],
+                      chars, 0)
+    return DeviceStringColumn(T.StringT, chars,
+                              jnp.where(validity, new_len, 0), validity)
+
+
+@handles(E.StringInstr)
+def _h_instr(e: E.StringInstr, ctx: Ctx) -> DeviceColumn:
+    sc = dev_eval(e.children[0], ctx)
+    pc = dev_eval(e.children[1], ctx)
+    validity = _valid_and([sc, pc])
+    found = _first_match_at_or_after(
+        sc, pc, jnp.zeros(ctx.capacity, jnp.int32))
+    return _normalized(T.IntegerT, (found + 1).astype(jnp.int32), validity)
+
+
+@handles(E.StringLocate)
+def _h_locate(e: E.StringLocate, ctx: Ctx) -> DeviceColumn:
+    pc = dev_eval(e.children[0], ctx)
+    sc = dev_eval(e.children[1], ctx)
+    posc = dev_eval(e.children[2], ctx)
+    validity = _valid_and([pc, sc, posc])
+    start = posc.data.astype(jnp.int32) - 1
+    found = _first_match_at_or_after(sc, pc, jnp.maximum(start, 0))
+    res = jnp.where(posc.data.astype(jnp.int32) < 1,
+                    jnp.int32(0), (found + 1).astype(jnp.int32))
+    return _normalized(T.IntegerT, res, validity)
+
+
+def _first_match_at_or_after(s: DeviceStringColumn, pat: DeviceStringColumn,
+                             start: jax.Array) -> jax.Array:
+    """Per-row first byte offset >= start where pat occurs in s, or -1.
+    O(char_cap) rounds of vectorized window compares."""
+    cap = max(s.char_cap, 1)
+    best = jnp.full(s.lengths.shape[0], -1, dtype=jnp.int32)
+    for p in range(cap):
+        at = jnp.full_like(start, p)
+        hit = _sliding_match(s, pat, at) & (p >= start)
+        best = jnp.where((best < 0) & hit, jnp.int32(p), best)
+    # empty pattern matches at `start` when start <= len(s)
+    empty_hit = (pat.lengths == 0) & (start <= s.lengths)
+    return jnp.where(empty_hit, start, best)
+
+
+@handles(E.InitCap)
+def _h_initcap(e: E.InitCap, ctx: Ctx) -> DeviceStringColumn:
+    c = dev_eval(e.children[0], ctx)
+    cap = max(c.char_cap, 1)
+    prev = jnp.concatenate(
+        [jnp.full((ctx.capacity, 1), 32, jnp.uint8), c.chars[:, :-1]],
+        axis=1)
+    word_start = prev == 32
+    lower = (c.chars >= 97) & (c.chars <= 122)
+    upper = (c.chars >= 65) & (c.chars <= 90)
+    chars = jnp.where(word_start & lower, c.chars - 32,
+                      jnp.where(~word_start & upper, c.chars + 32,
+                                c.chars))
+    in_str = jnp.arange(cap)[None, :] < c.lengths[:, None]
+    chars = jnp.where(in_str, chars, 0)
+    return DeviceStringColumn(T.StringT, chars, c.lengths, c.validity)
+
+
+@handles(E.StringReverse)
+def _h_str_reverse(e: E.StringReverse, ctx: Ctx) -> DeviceStringColumn:
+    c = dev_eval(e.children[0], ctx)
+    cap = max(c.char_cap, 1)
+    pos = jnp.arange(cap)[None, :]
+    idx = jnp.clip(c.lengths[:, None] - 1 - pos, 0, cap - 1)
+    chars = jnp.take_along_axis(_pad_chars(c, cap), idx, axis=1)
+    in_str = pos < c.lengths[:, None]
+    chars = jnp.where(in_str, chars, 0)
+    return DeviceStringColumn(T.StringT, chars, c.lengths, c.validity)
+
+
+@handles(E.StringTrimLeft, E.StringTrimRight)
+def _h_trim_side(e, ctx: Ctx) -> DeviceStringColumn:
+    c = dev_eval(e.children[0], ctx)
+    cap = max(c.char_cap, 1)
+    pos = jnp.arange(cap)[None, :]
+    in_str = pos < c.lengths[:, None]
+    is_space = (c.chars == 32) & in_str
+    if isinstance(e, E.StringTrimLeft):
+        lead = jnp.cumprod(jnp.where(in_str, is_space, True), axis=1)
+        n_lead = jnp.sum(lead & in_str, axis=1).astype(jnp.int32)
+        new_len = c.lengths - n_lead
+        idx = jnp.clip(pos + n_lead[:, None], 0, cap - 1)
+        chars = jnp.take_along_axis(c.chars, idx, axis=1)
+    else:
+        rev_idx = jnp.clip(c.lengths[:, None] - 1 - pos, 0, cap - 1)
+        rev_space = jnp.take_along_axis(is_space, rev_idx, axis=1)
+        trail = jnp.cumprod(jnp.where(in_str, rev_space, True), axis=1)
+        n_trail = jnp.sum(trail & in_str, axis=1).astype(jnp.int32)
+        new_len = c.lengths - n_trail
+        chars = c.chars
+    keep = pos < new_len[:, None]
+    chars = jnp.where(keep & c.validity[:, None], chars, 0)
+    return DeviceStringColumn(T.StringT, chars,
+                              jnp.where(c.validity, new_len, 0),
+                              c.validity)
+
+
+@handles(E.Ascii)
+def _h_ascii(e: E.Ascii, ctx: Ctx) -> DeviceColumn:
+    """Codepoint of the first character, decoding UTF-8 lead sequences."""
+    c = dev_eval(e.children[0], ctx)
+    cap = max(c.char_cap, 1)
+    ch = _pad_chars(c, max(cap, 4)).astype(jnp.int32)
+    b0, b1 = ch[:, 0], ch[:, 1] if cap > 1 else jnp.zeros_like(ch[:, 0])
+    b2 = ch[:, 2] if cap > 2 else jnp.zeros_like(b0)
+    b3 = ch[:, 3] if cap > 3 else jnp.zeros_like(b0)
+    one = b0 < 0x80
+    two = (b0 >= 0xC0) & (b0 < 0xE0)
+    three = (b0 >= 0xE0) & (b0 < 0xF0)
+    cp = jnp.where(
+        one, b0,
+        jnp.where(two, ((b0 & 0x1F) << 6) | (b1 & 0x3F),
+                  jnp.where(three,
+                            ((b0 & 0x0F) << 12) | ((b1 & 0x3F) << 6)
+                            | (b2 & 0x3F),
+                            ((b0 & 0x07) << 18) | ((b1 & 0x3F) << 12)
+                            | ((b2 & 0x3F) << 6) | (b3 & 0x3F))))
+    cp = jnp.where(c.lengths > 0, cp, 0)
+    return _normalized(T.IntegerT, cp.astype(jnp.int32), c.validity)
+
+
+@handles(E.Chr)
+def _h_chr(e: E.Chr, ctx: Ctx) -> DeviceStringColumn:
+    """chr(n % 256) as UTF-8 (codepoints 128-255 encode to 2 bytes)."""
+    c = dev_eval(e.children[0], ctx)
+    n = c.data.astype(jnp.int64)
+    cp = jnp.mod(n, 256).astype(jnp.int32)
+    neg = n < 0
+    two_byte = cp >= 0x80
+    b0 = jnp.where(two_byte, 0xC0 | (cp >> 6), cp).astype(jnp.uint8)
+    b1 = jnp.where(two_byte, 0x80 | (cp & 0x3F), 0).astype(jnp.uint8)
+    lengths = jnp.where(neg, 0, jnp.where(two_byte, 2, 1)).astype(
+        jnp.int32)
+    lengths = jnp.where(c.validity, lengths, 0)
+    chars = jnp.zeros((ctx.capacity, 8), dtype=jnp.uint8)
+    chars = chars.at[:, 0].set(jnp.where(lengths >= 1, b0, 0))
+    chars = chars.at[:, 1].set(jnp.where(lengths >= 2, b1, 0))
+    return DeviceStringColumn(T.StringT, chars, lengths, c.validity)
+
+
+@extra_check(E.StringReplace)
+def _c_replace(e: E.StringReplace):
+    if _lit_str(e.children[1]) is None or _lit_str(e.children[2]) is None:
+        return "replace search/replacement must be literals on device"
+    return None
+
+
+@handles(E.StringReplace)
+def _h_replace(e: E.StringReplace, ctx: Ctx) -> DeviceStringColumn:
+    """Literal search/replace. Greedy non-overlapping matches come from a
+    lax.scan over byte positions; the output is built scatter-free by
+    EXPANDING each input byte into max(1, len(repl)) output slots (its
+    replacement bytes at a match start, itself when kept, gaps when
+    covered) and compacting gaps with a stable sort — the same trick the
+    translate kernel uses for deletions."""
+    c = dev_eval(e.children[0], ctx)
+    _s = dev_eval(e.children[1], ctx)
+    _r = dev_eval(e.children[2], ctx)
+    search = _lit_str(e.children[1]).encode("utf-8")
+    repl = _lit_str(e.children[2]).encode("utf-8")
+    validity = _valid_and([c, _s, _r])
+    slen, rlen = len(search), len(repl)
+    if slen == 0 or c.char_cap == 0:
+        return DeviceStringColumn(T.StringT, c.chars, c.lengths, validity)
+    cap = c.char_cap
+    pos = jnp.arange(cap)[None, :]
+    pat = jnp.asarray(np.frombuffer(search, dtype=np.uint8))
+    padded = _pad_chars(c, cap + slen)
+    match = jnp.ones((ctx.capacity, cap), dtype=jnp.bool_)
+    for k in range(slen):
+        match = match & (padded[:, k:k + cap] == pat[k])
+    match = match & (pos + slen <= c.lengths[:, None])
+
+    def step(carry, col):
+        free = carry >= slen
+        take = col & free
+        return jnp.where(take, 1, carry + 1), take
+    init = jnp.full(ctx.capacity, slen, dtype=jnp.int32)
+    _carry, taken_t = jax.lax.scan(step, init, match.T)
+    taken = taken_t.T
+    covered = jnp.zeros((ctx.capacity, cap), dtype=jnp.bool_)
+    for k in range(slen):
+        covered = covered | jnp.pad(taken, ((0, 0), (k, 0)))[:, :cap]
+    in_str = pos < c.lengths[:, None]
+    emit = max(1, rlen)
+    # slots[:, p, j]: replacement byte j at match starts; the original
+    # byte at j == 0 for kept bytes; -1 (gap) otherwise
+    rp = (jnp.asarray(np.frombuffer(repl, dtype=np.uint8).astype(np.int32))
+          if rlen else jnp.zeros(1, jnp.int32))
+    slots = jnp.full((ctx.capacity, cap, emit), -1, dtype=jnp.int32)
+    keep_b = in_str & ~covered
+    slots = slots.at[:, :, 0].set(
+        jnp.where(keep_b, c.chars.astype(jnp.int32), -1))
+    for j in range(rlen):
+        slots = slots.at[:, :, j].set(
+            jnp.where(taken, rp[j], slots[:, :, j]))
+    flat = slots.reshape(ctx.capacity, cap * emit)
+    order = jnp.argsort(flat < 0, axis=1, stable=True)
+    compacted = jnp.take_along_axis(flat, order, axis=1)
+    new_len = (flat >= 0).sum(axis=1).astype(jnp.int32)
+    out_cap = bucket_char_cap(cap * emit)
+    out_pos = jnp.arange(cap * emit)[None, :]
+    keep = (out_pos < new_len[:, None]) & validity[:, None]
+    chars = jnp.where(keep, compacted, 0).astype(jnp.uint8)
+    if chars.shape[1] < out_cap:
+        chars = jnp.pad(chars, ((0, 0), (0, out_cap - chars.shape[1])))
+    return DeviceStringColumn(T.StringT, chars,
+                              jnp.where(validity, new_len, 0), validity)
+
+
+# ---------------------------------------------------------------------------
+# Extra datetime (datetimeExpressions.scala twins)
+# ---------------------------------------------------------------------------
+
+def _ymd_to_days_dev(y: jax.Array, m: jax.Array, d: jax.Array) -> jax.Array:
+    """Inverse of _days_to_ymd_dev (Hinnant days-from-civil)."""
+    y = y.astype(jnp.int64) - (m <= 2)
+    era = jnp.floor_divide(jnp.where(y >= 0, y, y - 399), 400)
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = jnp.floor_divide(153 * mp + 2, 5) + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+_MONTH_LEN = np.array([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31],
+                      dtype=np.int64)
+
+
+def _days_in_month_dev(y: jax.Array, m: jax.Array) -> jax.Array:
+    leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+    return jnp.asarray(_MONTH_LEN)[m - 1] + ((m == 2) & leap)
+
+
+def _field_days(e, c, ctx: Ctx) -> jax.Array:
+    if isinstance(e.children[0].data_type, T.TimestampType):
+        return jnp.floor_divide(c.data.astype(jnp.int64), 86_400_000_000)
+    return c.data.astype(jnp.int64)
+
+
+@handles(E.Quarter)
+def _h_quarter(e: E.Quarter, ctx: Ctx) -> DeviceColumn:
+    c = dev_eval(e.children[0], ctx)
+    _y, m, _d = _days_to_ymd_dev(_field_days(e, c, ctx))
+    return _normalized(T.IntegerT, ((m - 1) // 3 + 1).astype(jnp.int32),
+                       c.validity)
+
+
+@handles(E.DayOfWeek)
+def _h_dayofweek(e: E.DayOfWeek, ctx: Ctx) -> DeviceColumn:
+    c = dev_eval(e.children[0], ctx)
+    days = _field_days(e, c, ctx)
+    return _normalized(T.IntegerT,
+                       (jnp.mod(days + 4, 7) + 1).astype(jnp.int32),
+                       c.validity)
+
+
+@handles(E.WeekDay)
+def _h_weekday(e: E.WeekDay, ctx: Ctx) -> DeviceColumn:
+    c = dev_eval(e.children[0], ctx)
+    days = _field_days(e, c, ctx)
+    return _normalized(T.IntegerT, jnp.mod(days + 3, 7).astype(jnp.int32),
+                       c.validity)
+
+
+@handles(E.DayOfYear)
+def _h_dayofyear(e: E.DayOfYear, ctx: Ctx) -> DeviceColumn:
+    c = dev_eval(e.children[0], ctx)
+    days = _field_days(e, c, ctx)
+    y, _m, _d = _days_to_ymd_dev(days)
+    jan1 = _ymd_to_days_dev(y, jnp.ones_like(y), jnp.ones_like(y))
+    return _normalized(T.IntegerT, (days - jan1 + 1).astype(jnp.int32),
+                       c.validity)
+
+
+@handles(E.WeekOfYear)
+def _h_weekofyear(e: E.WeekOfYear, ctx: Ctx) -> DeviceColumn:
+    c = dev_eval(e.children[0], ctx)
+    days = _field_days(e, c, ctx)
+    thursday = days + 3 - jnp.mod(days + 3, 7)
+    ty, _m, _d = _days_to_ymd_dev(thursday)
+    jan1 = _ymd_to_days_dev(ty, jnp.ones_like(ty), jnp.ones_like(ty))
+    return _normalized(T.IntegerT,
+                       ((thursday - jan1) // 7 + 1).astype(jnp.int32),
+                       c.validity)
+
+
+@handles(E.LastDay)
+def _h_lastday(e: E.LastDay, ctx: Ctx) -> DeviceColumn:
+    c = dev_eval(e.children[0], ctx)
+    y, m, _d = _days_to_ymd_dev(c.data.astype(jnp.int64))
+    data = _ymd_to_days_dev(y, m, _days_in_month_dev(y, m))
+    return _normalized(T.DateT, data.astype(jnp.int32), c.validity)
+
+
+@handles(E.AddMonths)
+def _h_addmonths(e: E.AddMonths, ctx: Ctx) -> DeviceColumn:
+    sc, mc = _binary_cols(e, ctx)
+    validity = _valid_and([sc, mc])
+    y, m, d = _days_to_ymd_dev(sc.data.astype(jnp.int64))
+    total = (y * 12 + (m - 1)) + mc.data.astype(jnp.int64)
+    ny = jnp.floor_divide(total, 12)  # floor division: negatives correct
+    nm = total - ny * 12 + 1
+    nd = jnp.minimum(d, _days_in_month_dev(ny, nm))
+    data = _ymd_to_days_dev(ny, nm, nd)
+    return _normalized(T.DateT, data.astype(jnp.int32), validity)
+
+
+@handles(E.MonthsBetween)
+def _h_months_between(e: E.MonthsBetween, ctx: Ctx) -> DeviceColumn:
+    ec, sc = _binary_cols(e, ctx)
+    validity = _valid_and([ec, sc])
+
+    def parts(col, dt):
+        if isinstance(dt, T.TimestampType):
+            micros = col.data.astype(jnp.int64)
+            days = jnp.floor_divide(micros, 86_400_000_000)
+            sec = (micros - days * 86_400_000_000).astype(jnp.float64) / 1e6
+        else:
+            days = col.data.astype(jnp.int64)
+            sec = jnp.zeros_like(days, dtype=jnp.float64)
+        y, m, d = _days_to_ymd_dev(days)
+        return y, m, d, sec
+    y1, m1, d1, s1 = parts(ec, e.children[0].data_type)
+    y2, m2, d2, s2 = parts(sc, e.children[1].data_type)
+    month_diff = ((y1 - y2) * 12 + (m1 - m2)).astype(jnp.float64)
+    both_last = (d1 == _days_in_month_dev(y1, m1)) & \
+                (d2 == _days_in_month_dev(y2, m2))
+    aligned = (d1 == d2) | both_last
+    frac = ((d1 - d2).astype(jnp.float64) * 86400.0 + (s1 - s2)) \
+        / (31.0 * 86400.0)
+    data = jnp.where(aligned, month_diff, month_diff + frac)
+    # round to 8 places (Spark roundOff): scale/rint/unscale
+    data = jnp.rint(data * 1e8) / 1e8
+    return _normalized(T.DoubleT, data, validity)
+
+
+@extra_check(E.TruncDate)
+def _c_truncdate(e: E.TruncDate):
+    f = _lit_str(e.children[1])
+    if f is None:
+        return "trunc format must be a literal on device"
+    return None
+
+
+@handles(E.TruncDate)
+def _h_truncdate(e: E.TruncDate, ctx: Ctx) -> DeviceColumn:
+    c = dev_eval(e.children[0], ctx)
+    fc = dev_eval(e.children[1], ctx)
+    f = _lit_str(e.children[1]).lower()
+    validity = _valid_and([c, fc])
+    days = c.data.astype(jnp.int64)
+    y, m, _d = _days_to_ymd_dev(days)
+    ones = jnp.ones_like(y)
+    if f in ("year", "yyyy", "yy"):
+        data = _ymd_to_days_dev(y, ones, ones)
+    elif f in ("month", "mon", "mm"):
+        data = _ymd_to_days_dev(y, m, ones)
+    elif f == "quarter":
+        data = _ymd_to_days_dev(y, ((m - 1) // 3) * 3 + 1, ones)
+    elif f == "week":
+        data = days - jnp.mod(days + 3, 7)
+    else:
+        data = days
+        validity = validity & False
+    return _normalized(T.DateT, data.astype(jnp.int32), validity)
+
+
+def _format_pattern_check(e, fmt_idx: int):
+    f = _lit_str(e.children[fmt_idx])
+    if f is None:
+        return "datetime pattern must be a literal on device"
+    if E.parse_dt_pattern(f) is None:
+        return f"datetime pattern {f!r} is outside the supported subset"
+    return None
+
+
+@extra_check(E.DateFormatClass, E.FromUnixTime, E.GetTimestamp)
+def _c_dtpattern(e):
+    return _format_pattern_check(e, 1)
+
+
+@extra_check(E.UnixTimestamp)
+def _c_unixts(e: E.UnixTimestamp):
+    if isinstance(e.children[0].data_type, (T.DateType, T.TimestampType)):
+        return None
+    return _format_pattern_check(e, 1)
+
+
+def _format_micros_dev(micros: jax.Array, validity: jax.Array,
+                       parts) -> DeviceStringColumn:
+    """Digit-math datetime formatting into a byte matrix (years 0-9999;
+    fixed token widths)."""
+    cap = micros.shape[0]
+    days = jnp.floor_divide(micros, 86_400_000_000)
+    sec_of_day = jnp.floor_divide(micros - days * 86_400_000_000,
+                                  1_000_000)
+    y, m, d = _days_to_ymd_dev(days)
+    # years outside 0-9999 null out, matching the host _format_micros
+    validity = validity & (y >= 0) & (y <= 9999)
+    fields = {
+        "yyyy": (y, 4), "MM": (m, 2), "dd": (d, 2),
+        "HH": (sec_of_day // 3600, 2), "mm": (sec_of_day // 60 % 60, 2),
+        "ss": (sec_of_day % 60, 2),
+    }
+    cols = []
+    for kind, text in parts:
+        if kind == "lit":
+            cols.append(jnp.full((cap, 1), ord(text), jnp.uint8))
+        else:
+            v, width = fields[kind]
+            v = v.astype(jnp.int64)
+            for k in range(width - 1, -1, -1):
+                digit = jnp.mod(jnp.floor_divide(v, 10 ** k), 10)
+                cols.append((digit + 48).astype(jnp.uint8)[:, None])
+    chars = jnp.concatenate(cols, axis=1)
+    total = chars.shape[1]
+    char_cap = 8 * ((total + 7) // 8)
+    if char_cap > total:
+        chars = jnp.pad(chars, ((0, 0), (0, char_cap - total)))
+    chars = jnp.where(validity[:, None], chars, 0)
+    lengths = jnp.where(validity, total, 0).astype(jnp.int32)
+    return DeviceStringColumn(T.StringT, chars, lengths, validity)
+
+
+def _parse_pattern_dev(col: DeviceStringColumn, validity: jax.Array,
+                       parts):
+    """Fixed-position parse per the token subset; returns (micros, ok)."""
+    total = sum(4 if kind == "yyyy" else (1 if kind == "lit" else 2)
+                for kind, _ in parts)
+    cap = col.lengths.shape[0]
+    chars = _pad_chars(col, max(col.char_cap, total)).astype(jnp.int32)
+    ok = validity & (col.lengths == total)
+    vals = {"yyyy": jnp.full(cap, 1970, jnp.int64),
+            "MM": jnp.ones(cap, jnp.int64), "dd": jnp.ones(cap, jnp.int64),
+            "HH": jnp.zeros(cap, jnp.int64),
+            "mm": jnp.zeros(cap, jnp.int64),
+            "ss": jnp.zeros(cap, jnp.int64)}
+    pos = 0
+    for kind, text in parts:
+        if kind == "lit":
+            ok = ok & (chars[:, pos] == ord(text))
+            pos += 1
+            continue
+        width = 4 if kind == "yyyy" else 2
+        v = jnp.zeros(cap, jnp.int64)
+        for k in range(width):
+            ch = chars[:, pos + k]
+            ok = ok & (ch >= 48) & (ch <= 57)
+            v = v * 10 + (ch - 48)
+        vals[kind] = v
+        pos += width
+    ok = ok & (vals["MM"] >= 1) & (vals["MM"] <= 12) \
+        & (vals["dd"] >= 1) & (vals["dd"] <= 31) \
+        & (vals["HH"] < 24) & (vals["mm"] < 60) & (vals["ss"] < 60)
+    day = _ymd_to_days_dev(vals["yyyy"], vals["MM"], vals["dd"])
+    micros = ((day * 86400 + vals["HH"] * 3600 + vals["mm"] * 60
+               + vals["ss"]) * 1_000_000)
+    return jnp.where(ok, micros, 0), ok
+
+
+@handles(E.DateFormatClass)
+def _h_date_format(e: E.DateFormatClass, ctx: Ctx) -> DeviceStringColumn:
+    c = dev_eval(e.children[0], ctx)
+    fc = dev_eval(e.children[1], ctx)
+    parts = E.parse_dt_pattern(_lit_str(e.children[1]))
+    validity = _valid_and([c, fc])
+    if isinstance(e.children[0].data_type, T.DateType):
+        micros = c.data.astype(jnp.int64) * 86_400_000_000
+    else:
+        micros = c.data.astype(jnp.int64)
+    return _format_micros_dev(micros, validity, parts)
+
+
+@handles(E.FromUnixTime)
+def _h_from_unixtime(e: E.FromUnixTime, ctx: Ctx) -> DeviceStringColumn:
+    c = dev_eval(e.children[0], ctx)
+    fc = dev_eval(e.children[1], ctx)
+    parts = E.parse_dt_pattern(_lit_str(e.children[1]))
+    validity = _valid_and([c, fc])
+    return _format_micros_dev(c.data.astype(jnp.int64) * 1_000_000,
+                              validity, parts)
+
+
+@handles(E.UnixTimestamp)
+def _h_unix_timestamp(e: E.UnixTimestamp, ctx: Ctx) -> DeviceColumn:
+    c = dev_eval(e.children[0], ctx)
+    src = e.children[0].data_type
+    if isinstance(src, T.DateType):
+        return _normalized(T.LongT, c.data.astype(jnp.int64) * 86400,
+                           c.validity)
+    if isinstance(src, T.TimestampType):
+        return _normalized(
+            T.LongT,
+            jnp.floor_divide(c.data.astype(jnp.int64), 1_000_000),
+            c.validity)
+    fc = dev_eval(e.children[1], ctx)
+    parts = E.parse_dt_pattern(_lit_str(e.children[1]))
+    validity = _valid_and([c, fc])
+    micros, ok = _parse_pattern_dev(c, validity, parts)
+    return _normalized(T.LongT, jnp.floor_divide(micros, 1_000_000), ok)
+
+
+@handles(E.GetTimestamp)
+def _h_get_timestamp(e: E.GetTimestamp, ctx: Ctx) -> DeviceColumn:
+    c = dev_eval(e.children[0], ctx)
+    fc = dev_eval(e.children[1], ctx)
+    parts = E.parse_dt_pattern(_lit_str(e.children[1]))
+    validity = _valid_and([c, fc])
+    micros, ok = _parse_pattern_dev(c, validity, parts)
+    return _normalized(T.TimestampT, micros, ok)
+
+
+@handles(E.XxHash64)
+def _h_xxhash64(e: E.XxHash64, ctx: Ctx) -> DeviceColumn:
+    from spark_rapids_tpu.ops import hashing
+    cols = [dev_eval(c, ctx) for c in e.children]
+    h = hashing.xxhash64_columns(cols, ctx.capacity, e.seed)
+    return DeviceColumn(T.LongT, h, jnp.ones(ctx.capacity, jnp.bool_))

@@ -69,6 +69,17 @@ expr_rule(E.Substring, incompat="byte-positioned substring is exact only "
           "for ASCII strings")
 expr_rule(E.Upper, incompat="case conversion is ASCII-only")
 expr_rule(E.Lower, incompat="case conversion is ASCII-only")
+expr_rule(E.InitCap, incompat="case conversion is ASCII-only")
+expr_rule(E.StringInstr, incompat="byte positions are exact only for "
+          "ASCII strings")
+expr_rule(E.StringLocate, incompat="byte positions are exact only for "
+          "ASCII strings")
+expr_rule(E.StringLPad, incompat="byte-counted padding is exact only "
+          "for ASCII strings")
+expr_rule(E.StringRPad, incompat="byte-counted padding is exact only "
+          "for ASCII strings")
+expr_rule(E.StringReverse, incompat="byte reversal is exact only for "
+          "ASCII strings")
 
 # leaves that are valid in any device expression tree without a handler
 _LEAF_OK = (E.AttributeReference,)

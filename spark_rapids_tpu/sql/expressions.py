@@ -431,6 +431,130 @@ class Pmod(BinaryArithmetic):
                           validity).normalized()
 
 
+class BitwiseAnd(BinaryArithmetic):
+    """& over integral types (GpuBitwiseAnd, arithmetic.scala role)."""
+    symbol = "&"
+
+    def op(self, a, b):
+        return a & b
+
+
+class BitwiseOr(BinaryArithmetic):
+    symbol = "|"
+
+    def op(self, a, b):
+        return a | b
+
+
+class BitwiseXor(BinaryArithmetic):
+    symbol = "^"
+
+    def op(self, a, b):
+        return a ^ b
+
+
+class BitwiseNot(UnaryExpression):
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.child.data_type
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.child.eval(batch)
+        return HostColumn(self.data_type, ~c.data,
+                          c.validity.copy()).normalized()
+
+
+class _Shift(BinaryExpression):
+    """Java shift semantics: the amount is masked to the value width
+    (x << 65 == x << 1 for long), like the JVM bytecodes Spark compiles
+    to (GpuShiftLeft/Right/RightUnsigned twins)."""
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = [left, right]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.left.data_type
+
+    def _mask(self) -> int:
+        return 63 if isinstance(self.data_type, T.LongType) else 31
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        lc, rc = self.left.eval(batch), self.right.eval(batch)
+        validity = _combined_validity([lc, rc])
+        n = (rc.data.astype(np.int64) & self._mask()).astype(np.int64)
+        data = self.shift(lc.data, n)
+        np_dt = T.numpy_dtype(self.data_type)
+        return HostColumn(self.data_type, data.astype(np_dt),
+                          validity).normalized()
+
+    def shift(self, a: np.ndarray, n: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+
+class ShiftLeft(_Shift):
+    def shift(self, a, n):
+        return a << n
+
+
+class ShiftRight(_Shift):
+    def shift(self, a, n):
+        return a >> n  # numpy >> on signed ints is arithmetic, like Java
+
+
+class ShiftRightUnsigned(_Shift):
+    def shift(self, a, n):
+        if a.dtype == np.dtype(np.int64):
+            return (a.view(np.uint64) >> n.astype(np.uint64)).view(np.int64)
+        return (a.astype(np.int32).view(np.uint32)
+                >> n.astype(np.uint32)).view(np.int32)
+
+
+class Greatest(Expression):
+    """Row-wise max skipping nulls; null only when every input is null
+    (Spark Greatest; NaN is greatest among floats)."""
+    is_min = False
+
+    def __init__(self, children: List[Expression]):
+        self.children = list(children)
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.children[0].data_type
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        cols = [c.eval(batch) for c in self.children]
+        np_dt = T.numpy_dtype(self.data_type)
+        validity = np.zeros(batch.num_rows, dtype=bool)
+        for c in cols:
+            validity |= c.validity
+        is_float = np.issubdtype(np_dt, np.floating)
+        data = None
+        for c in cols:
+            d = c.data.astype(np_dt)
+            if data is None:
+                data, have = d.copy(), c.validity.copy()
+                continue
+            if is_float:
+                # NaN ranks greatest (Spark total order)
+                better = (np.isnan(d) | (d > data)) if not self.is_min \
+                    else ((~np.isnan(d)) & ((d < data) | np.isnan(data)))
+            else:
+                better = (d > data) if not self.is_min else (d < data)
+            take = c.validity & (~have | better)
+            data = np.where(take, d, data)
+            have |= c.validity
+        return HostColumn(self.data_type, data, validity).normalized()
+
+
+class Least(Greatest):
+    """Row-wise min skipping nulls (NaN still sorts greatest)."""
+    is_min = True
+
+
 class UnaryMinus(UnaryExpression):
     def __init__(self, child: Expression):
         self.children = [child]
@@ -914,6 +1038,73 @@ class Signum(UnaryMath):
         return np.where(x == 0.0, x, np.sign(x))
 
 
+class Log2(UnaryMath):
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.child.eval(batch)
+        x = c.data.astype(np.float64)
+        validity = c.validity & (x > 0)
+        with np.errstate(all="ignore"):
+            data = np.log2(np.where(x > 0, x, 1.0))
+        return HostColumn(T.DoubleT, data, validity).normalized()
+
+
+class Log1p(UnaryMath):
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.child.eval(batch)
+        x = c.data.astype(np.float64)
+        validity = c.validity & (x > -1.0)
+        with np.errstate(all="ignore"):
+            data = np.log1p(np.where(x > -1.0, x, 0.0))
+        return HostColumn(T.DoubleT, data, validity).normalized()
+
+
+class Expm1(UnaryMath):
+    np_fn = np.expm1
+
+
+class Cbrt(UnaryMath):
+    np_fn = np.cbrt
+
+
+class Rint(UnaryMath):
+    np_fn = np.rint  # Math.rint = round-half-even, same as IEEE rint
+
+
+class ToDegrees(UnaryMath):
+    np_fn = np.degrees
+
+
+class ToRadians(UnaryMath):
+    np_fn = np.radians
+
+
+class BinaryMath(BinaryExpression):
+    np_fn: Callable = None
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = [left, right]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.DoubleT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        lc, rc = self.left.eval(batch), self.right.eval(batch)
+        validity = _combined_validity([lc, rc])
+        with np.errstate(all="ignore"):
+            data = type(self).np_fn(lc.data.astype(np.float64),
+                                    rc.data.astype(np.float64))
+        return HostColumn(T.DoubleT, data, validity).normalized()
+
+
+class Atan2(BinaryMath):
+    np_fn = np.arctan2
+
+
+class Hypot(BinaryMath):
+    np_fn = np.hypot
+
+
 class Floor(UnaryExpression):
     def __init__(self, child: Expression):
         self.children = [child]
@@ -1188,6 +1379,257 @@ def _like_to_regex(pattern: str) -> str:
     return "".join(out)
 
 
+class ConcatWs(Expression):
+    """concat_ws(sep, ...): null arguments are SKIPPED; null only when
+    the separator itself is null (stringFunctions.scala GpuConcatWs)."""
+
+    def __init__(self, children: List[Expression]):
+        self.children = list(children)  # [sep, arg0, arg1, ...]
+
+    @property
+    def pretty_name(self) -> str:
+        return "concat_ws"
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.StringT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        cols = [c.eval(batch) for c in self.children]
+        sep, args = cols[0], cols[1:]
+        validity = sep.validity.copy()
+        out = np.full(batch.num_rows, "", dtype=object)
+        for i in range(batch.num_rows):
+            if validity[i]:
+                out[i] = sep.data[i].join(
+                    c.data[i] for c in args if c.validity[i])
+        return HostColumn(T.StringT, out, validity)
+
+
+class StringRepeat(BinaryExpression):
+    def __init__(self, left: Expression, right: Expression):
+        self.children = [left, right]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.StringT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        sc, nc = self.left.eval(batch), self.right.eval(batch)
+        validity = _combined_validity([sc, nc])
+        out = np.full(batch.num_rows, "", dtype=object)
+        for i in range(batch.num_rows):
+            if validity[i]:
+                out[i] = sc.data[i] * max(0, int(nc.data[i]))
+        return HostColumn(T.StringT, out, validity)
+
+
+class StringLPad(Expression):
+    """lpad/rpad with Spark semantics: result is exactly `len` chars
+    (truncating when longer); an empty pad leaves the string as-is."""
+    left_side = True
+
+    def __init__(self, child: Expression, length: Expression,
+                 pad: Expression):
+        self.children = [child, length, pad]
+
+    @property
+    def pretty_name(self) -> str:
+        return "lpad" if self.left_side else "rpad"
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.StringT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        cols = [c.eval(batch) for c in self.children]
+        validity = _combined_validity(cols)
+        out = np.full(batch.num_rows, "", dtype=object)
+        for i in range(batch.num_rows):
+            if not validity[i]:
+                continue
+            s, n, p = cols[0].data[i], int(cols[1].data[i]), cols[2].data[i]
+            if n <= 0:
+                out[i] = ""
+            elif len(s) >= n:
+                out[i] = s[:n]
+            elif not p:
+                out[i] = s
+            else:
+                fill = (p * ((n - len(s)) // len(p) + 1))[:n - len(s)]
+                out[i] = fill + s if self.left_side else s + fill
+        return HostColumn(T.StringT, out, validity)
+
+
+class StringRPad(StringLPad):
+    left_side = False
+
+
+class StringTranslate(Expression):
+    """translate(src, match, replace): per-char mapping; match chars
+    beyond len(replace) are deleted."""
+
+    def __init__(self, child: Expression, match: Expression,
+                 replace: Expression):
+        self.children = [child, match, replace]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.StringT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        cols = [c.eval(batch) for c in self.children]
+        validity = _combined_validity(cols)
+        out = np.full(batch.num_rows, "", dtype=object)
+        for i in range(batch.num_rows):
+            if not validity[i]:
+                continue
+            m, r = cols[1].data[i], cols[2].data[i]
+            table = {ord(ch): (r[j] if j < len(r) else None)
+                     for j, ch in enumerate(m)}
+            out[i] = cols[0].data[i].translate(table)
+        return HostColumn(T.StringT, out, validity)
+
+
+class StringReplace(Expression):
+    """replace(str, search, replace): empty search returns the input."""
+
+    def __init__(self, child: Expression, search: Expression,
+                 replace: Expression):
+        self.children = [child, search, replace]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.StringT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        cols = [c.eval(batch) for c in self.children]
+        validity = _combined_validity(cols)
+        out = np.full(batch.num_rows, "", dtype=object)
+        for i in range(batch.num_rows):
+            if validity[i]:
+                s, f, r = (cols[0].data[i], cols[1].data[i],
+                           cols[2].data[i])
+                out[i] = s.replace(f, r) if f else s
+        return HostColumn(T.StringT, out, validity)
+
+
+class StringInstr(BinaryExpression):
+    """instr(str, substr): 1-based position of first occurrence, 0 when
+    absent, 1 for the empty substring."""
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = [left, right]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.IntegerT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        sc, pc = self.left.eval(batch), self.right.eval(batch)
+        validity = _combined_validity([sc, pc])
+        out = np.zeros(batch.num_rows, dtype=np.int32)
+        for i in range(batch.num_rows):
+            if validity[i]:
+                out[i] = sc.data[i].find(pc.data[i]) + 1
+        return HostColumn(T.IntegerT, out, validity).normalized()
+
+
+class StringLocate(Expression):
+    """locate(substr, str, pos): search from 1-based `pos`; pos < 1
+    yields 0 (Spark StringLocate)."""
+
+    def __init__(self, substr: Expression, child: Expression,
+                 pos: Expression):
+        self.children = [substr, child, pos]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.IntegerT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        cols = [c.eval(batch) for c in self.children]
+        validity = _combined_validity(cols)
+        out = np.zeros(batch.num_rows, dtype=np.int32)
+        for i in range(batch.num_rows):
+            if not validity[i]:
+                continue
+            sub, s, pos = cols[0].data[i], cols[1].data[i], int(
+                cols[2].data[i])
+            if pos < 1:
+                out[i] = 0
+            else:
+                out[i] = s.find(sub, pos - 1) + 1
+        return HostColumn(T.IntegerT, out, validity).normalized()
+
+
+class InitCap(StringUnary):
+    """First character of each space-separated word uppercased, the rest
+    lowercased (UTF8String.toTitleCase semantics)."""
+
+    def fn(self, s: str) -> str:
+        out = []
+        prev_space = True
+        for ch in s:
+            out.append(ch.upper() if prev_space else ch.lower())
+            prev_space = ch == " "
+        return "".join(out)
+
+
+class StringReverse(StringUnary):
+    def fn(self, s: str) -> str:
+        return s[::-1]
+
+
+class StringTrimLeft(StringUnary):
+    def fn(self, s: str) -> str:
+        return s.lstrip(" ")
+
+
+class StringTrimRight(StringUnary):
+    def fn(self, s: str) -> str:
+        return s.rstrip(" ")
+
+
+class Ascii(UnaryExpression):
+    """Codepoint of the first character (0 for the empty string)."""
+
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.IntegerT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.child.eval(batch)
+        out = np.zeros(len(c.data), dtype=np.int32)
+        for i in range(len(c.data)):
+            if c.validity[i] and c.data[i]:
+                out[i] = ord(c.data[i][0])
+        return HostColumn(T.IntegerT, out, c.validity.copy()).normalized()
+
+
+class Chr(UnaryExpression):
+    """chr(n): the character of codepoint n % 256 (empty for n < 0)."""
+
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.StringT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.child.eval(batch)
+        out = np.full(len(c.data), "", dtype=object)
+        for i in range(len(c.data)):
+            if c.validity[i]:
+                n = int(c.data[i])
+                out[i] = "" if n < 0 else chr(n % 256)
+        return HostColumn(T.StringT, out, c.validity.copy())
+
+
 # ---------------------------------------------------------------------------
 # Date/time (DateType = days since epoch; TimestampType = micros UTC;
 # mirrors datetimeExpressions.scala)
@@ -1320,6 +1762,420 @@ class DateDiff(BinaryExpression):
         return HostColumn(T.IntegerT, data, validity).normalized()
 
 
+def _ymd_to_days(y: np.ndarray, m: np.ndarray, d: np.ndarray) -> np.ndarray:
+    """Inverse of _days_to_ymd (Hinnant's days-from-civil), vectorized."""
+    y = y.astype(np.int64) - (m <= 2)
+    era = np.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = np.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _days_in_month(y: np.ndarray, m: np.ndarray) -> np.ndarray:
+    lengths = np.array([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31],
+                       dtype=np.int64)
+    leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+    return lengths[m - 1] + ((m == 2) & leap)
+
+
+class Quarter(DateTimeField):
+    field = "quarter"
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.child.eval(batch)
+        _y, m, _d = _days_to_ymd(self._days(c))
+        data = (m - 1) // 3 + 1
+        return HostColumn(T.IntegerT, data.astype(np.int32),
+                          c.validity.copy()).normalized()
+
+
+class DayOfWeek(DateTimeField):
+    """1 = Sunday .. 7 = Saturday (Spark DayOfWeek)."""
+    field = "dayofweek"
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.child.eval(batch)
+        days = self._days(c)
+        data = np.mod(days + 4, 7) + 1  # epoch day 0 was a Thursday
+        return HostColumn(T.IntegerT, data.astype(np.int32),
+                          c.validity.copy()).normalized()
+
+
+class WeekDay(DateTimeField):
+    """0 = Monday .. 6 = Sunday (Spark WeekDay)."""
+    field = "weekday"
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.child.eval(batch)
+        days = self._days(c)
+        data = np.mod(days + 3, 7)
+        return HostColumn(T.IntegerT, data.astype(np.int32),
+                          c.validity.copy()).normalized()
+
+
+class DayOfYear(DateTimeField):
+    field = "dayofyear"
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.child.eval(batch)
+        days = self._days(c)
+        y, _m, _d = _days_to_ymd(days)
+        jan1 = _ymd_to_days(y, np.ones_like(y), np.ones_like(y))
+        data = days - jan1 + 1
+        return HostColumn(T.IntegerT, data.astype(np.int32),
+                          c.validity.copy()).normalized()
+
+
+class WeekOfYear(DateTimeField):
+    """ISO-8601 week number (Spark WeekOfYear)."""
+    field = "weekofyear"
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.child.eval(batch)
+        days = self._days(c)
+        # the Thursday of this date's ISO week decides the week-year
+        thursday = days + 3 - np.mod(days + 3, 7)
+        ty, _m, _d = _days_to_ymd(thursday)
+        jan1 = _ymd_to_days(ty, np.ones_like(ty), np.ones_like(ty))
+        data = (thursday - jan1) // 7 + 1
+        return HostColumn(T.IntegerT, data.astype(np.int32),
+                          c.validity.copy()).normalized()
+
+
+class LastDay(UnaryExpression):
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.DateT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.child.eval(batch)
+        days = c.data.astype(np.int64)
+        y, m, _d = _days_to_ymd(days)
+        data = _ymd_to_days(y, m, _days_in_month(y, m)).astype(np.int32)
+        return HostColumn(T.DateT, data, c.validity.copy()).normalized()
+
+
+class AddMonths(BinaryExpression):
+    """add_months: day-of-month clamps to the target month's last day."""
+
+    def __init__(self, start: Expression, months: Expression):
+        self.children = [start, months]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.DateT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        sc, mc = self.left.eval(batch), self.right.eval(batch)
+        validity = _combined_validity([sc, mc])
+        y, m, d = _days_to_ymd(sc.data.astype(np.int64))
+        total = (y * 12 + (m - 1)) + mc.data.astype(np.int64)
+        ny = total // 12  # numpy // already floors for negatives
+        nm = total - ny * 12 + 1
+        nd = np.minimum(d, _days_in_month(ny, nm))
+        data = _ymd_to_days(ny, nm, nd).astype(np.int32)
+        return HostColumn(T.DateT, data, validity).normalized()
+
+
+class MonthsBetween(BinaryExpression):
+    """months_between(end, start): whole months when both fall on the
+    same day-of-month or both on month-ends, else 31-day fractional
+    months; result rounded to 8 places (Spark roundOff default)."""
+
+    def __init__(self, end: Expression, start: Expression):
+        self.children = [end, start]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.DoubleT
+
+    @staticmethod
+    def _parts(col: HostColumn, dtype: T.DataType):
+        if isinstance(dtype, T.TimestampType):
+            micros = col.data.astype(np.int64)
+            days = np.floor_divide(micros, 86_400_000_000)
+            sec = (micros - days * 86_400_000_000) / 1e6
+        else:
+            days = col.data.astype(np.int64)
+            sec = np.zeros(len(col.data))
+        y, m, d = _days_to_ymd(days)
+        return y, m, d, sec
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        ec, sc = self.left.eval(batch), self.right.eval(batch)
+        validity = _combined_validity([ec, sc])
+        y1, m1, d1, s1 = self._parts(ec, self.left.data_type)
+        y2, m2, d2, s2 = self._parts(sc, self.right.data_type)
+        month_diff = (y1 - y2) * 12.0 + (m1 - m2)
+        both_last = (d1 == _days_in_month(y1, m1)) & \
+                    (d2 == _days_in_month(y2, m2))
+        aligned = (d1 == d2) | both_last
+        frac = ((d1 - d2) * 86400.0 + (s1 - s2)) / (31.0 * 86400.0)
+        data = np.where(aligned, month_diff, month_diff + frac)
+        data = np.round(data, 8)
+        return HostColumn(T.DoubleT, data, validity).normalized()
+
+
+class TruncDate(BinaryExpression):
+    """trunc(date, fmt): fmt in year/yyyy/yy, quarter, month/mon/mm,
+    week; unknown fmt -> null (Spark TruncDate)."""
+
+    def __init__(self, child: Expression, fmt: Expression):
+        self.children = [child, fmt]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.DateT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c, fc = self.left.eval(batch), self.right.eval(batch)
+        days = c.data.astype(np.int64)
+        y, m, _d = _days_to_ymd(days)
+        out = np.zeros(len(days), dtype=np.int64)
+        validity = _combined_validity([c, fc])
+        ones = np.ones_like(y)
+        year_start = _ymd_to_days(y, ones, ones)
+        month_start = _ymd_to_days(y, m, ones)
+        q_month = ((m - 1) // 3) * 3 + 1
+        quarter_start = _ymd_to_days(y, q_month, ones)
+        week_start = days - np.mod(days + 3, 7)  # Monday
+        for i in range(len(days)):
+            if not validity[i]:
+                continue
+            f = fc.data[i].lower()
+            if f in ("year", "yyyy", "yy"):
+                out[i] = year_start[i]
+            elif f in ("month", "mon", "mm"):
+                out[i] = month_start[i]
+            elif f == "quarter":
+                out[i] = quarter_start[i]
+            elif f == "week":
+                out[i] = week_start[i]
+            else:
+                validity[i] = False
+        return HostColumn(T.DateT, out.astype(np.int32),
+                          validity).normalized()
+
+
+# Restricted datetime pattern support shared by CPU and device paths:
+# literal text plus the unambiguous numeric tokens. Anything else falls
+# back (device tags to CPU; CPU raises).
+_DT_TOKENS = ("yyyy", "MM", "dd", "HH", "mm", "ss")
+
+
+def parse_dt_pattern(fmt: str) -> Optional[List[Tuple[str, str]]]:
+    """[(kind, text)] where kind is 'lit' or a token; None when the
+    pattern uses anything outside the supported subset."""
+    out: List[Tuple[str, str]] = []
+    i = 0
+    while i < len(fmt):
+        for tok in _DT_TOKENS:
+            if fmt.startswith(tok, i):
+                out.append((tok, tok))
+                i += len(tok)
+                break
+        else:
+            ch = fmt[i]
+            if ch.isalpha():
+                return None  # unsupported pattern letter
+            out.append(("lit", ch))
+            i += 1
+    return out
+
+
+DEFAULT_TS_FMT = "yyyy-MM-dd HH:mm:ss"
+
+
+def _format_micros(micros: np.ndarray, validity: np.ndarray,
+                   parts: List[Tuple[str, str]]) -> np.ndarray:
+    days = np.floor_divide(micros, 86_400_000_000)
+    sec_of_day = np.floor_divide(micros - days * 86_400_000_000, 1_000_000)
+    y, m, d = _days_to_ymd(days)
+    # fixed-width digit formatting only represents years 0-9999; rows
+    # outside become null on BOTH engines so CPU and device agree
+    # (documented deviation from Spark's signed 5+-digit year output)
+    validity = validity & (y >= 0) & (y <= 9999)
+    fields = {
+        "yyyy": (y, 4), "MM": (m, 2), "dd": (d, 2),
+        "HH": (sec_of_day // 3600, 2), "mm": (sec_of_day // 60 % 60, 2),
+        "ss": (sec_of_day % 60, 2),
+    }
+    n = len(micros)
+    out = np.full(n, "", dtype=object)
+    pieces = []
+    for kind, text in parts:
+        if kind == "lit":
+            pieces.append(np.full(n, text, dtype=object))
+        else:
+            vals, width = fields[kind]
+            pieces.append(np.char.zfill(
+                vals.astype(np.int64).astype("U16"), width).astype(object))
+    for i in range(n):
+        if validity[i]:
+            out[i] = "".join(p[i] for p in pieces)
+    return out
+
+
+def _parse_with_pattern(strings: np.ndarray, validity: np.ndarray,
+                        parts: List[Tuple[str, str]]):
+    """Parse per the token list; returns (micros, ok). Lenient like
+    Spark's legacy parser about trailing text only when the pattern
+    consumed everything."""
+    n = len(strings)
+    micros = np.zeros(n, dtype=np.int64)
+    ok = validity.copy()
+    for i in range(n):
+        if not ok[i]:
+            continue
+        s = str(strings[i])
+        pos = 0
+        vals = {"yyyy": 1970, "MM": 1, "dd": 1, "HH": 0, "mm": 0, "ss": 0}
+        good = True
+        for kind, text in parts:
+            if kind == "lit":
+                if pos < len(s) and s[pos] == text:
+                    pos += 1
+                else:
+                    good = False
+                    break
+            else:
+                width = 4 if kind == "yyyy" else 2
+                chunk = s[pos:pos + width]
+                if len(chunk) == width and chunk.isdigit():
+                    vals[kind] = int(chunk)
+                    pos += width
+                else:
+                    good = False
+                    break
+        if not good or pos != len(s):
+            ok[i] = False
+            continue
+        if not (1 <= vals["MM"] <= 12 and 1 <= vals["dd"] <= 31
+                and vals["HH"] < 24 and vals["mm"] < 60
+                and vals["ss"] < 60):
+            ok[i] = False
+            continue
+        day = _ymd_to_days(np.array([vals["yyyy"]]), np.array([vals["MM"]]),
+                           np.array([vals["dd"]]))[0]
+        micros[i] = ((day * 86400 + vals["HH"] * 3600 + vals["mm"] * 60
+                      + vals["ss"]) * 1_000_000)
+    return micros, ok
+
+
+class DateFormatClass(BinaryExpression):
+    """date_format(ts, fmt) over the supported token subset."""
+
+    def __init__(self, child: Expression, fmt: Expression):
+        self.children = [child, fmt]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.StringT
+
+    def _micros(self, c: HostColumn) -> np.ndarray:
+        if isinstance(self.left.data_type, T.DateType):
+            return c.data.astype(np.int64) * 86_400_000_000
+        return c.data.astype(np.int64)
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c, fc = self.left.eval(batch), self.right.eval(batch)
+        assert isinstance(self.right, Literal), \
+            "date_format pattern must be a literal"
+        parts = parse_dt_pattern(self.right.value)
+        if parts is None:
+            raise NotImplementedError(
+                f"unsupported datetime pattern {fc.data[0]!r}")
+        validity = _combined_validity([c, fc])
+        out = _format_micros(self._micros(c), validity, parts)
+        return HostColumn(T.StringT, out, validity)
+
+
+class UnixTimestamp(BinaryExpression):
+    """unix_timestamp(col, fmt) -> long seconds; strings parse with the
+    pattern (null on failure), dates/timestamps convert directly."""
+    pretty = "unix_timestamp"
+
+    def __init__(self, child: Expression, fmt: Expression):
+        self.children = [child, fmt]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.LongT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c, fc = self.left.eval(batch), self.right.eval(batch)
+        src = self.left.data_type
+        if isinstance(src, T.DateType):
+            data = c.data.astype(np.int64) * 86400
+            return HostColumn(T.LongT, data, c.validity.copy()).normalized()
+        if isinstance(src, T.TimestampType):
+            data = np.floor_divide(c.data.astype(np.int64), 1_000_000)
+            return HostColumn(T.LongT, data, c.validity.copy()).normalized()
+        assert isinstance(self.right, Literal), \
+            "unix_timestamp pattern must be a literal"
+        parts = parse_dt_pattern(self.right.value)
+        if parts is None:
+            raise NotImplementedError(
+                f"unsupported datetime pattern {fc.data[0]!r}")
+        validity = _combined_validity([c, fc])
+        micros, ok = _parse_with_pattern(c.data, validity, parts)
+        return HostColumn(T.LongT, np.floor_divide(micros, 1_000_000),
+                          ok).normalized()
+
+
+class FromUnixTime(BinaryExpression):
+    """from_unixtime(seconds, fmt) -> formatted string (UTC session)."""
+
+    def __init__(self, child: Expression, fmt: Expression):
+        self.children = [child, fmt]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.StringT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c, fc = self.left.eval(batch), self.right.eval(batch)
+        assert isinstance(self.right, Literal), \
+            "from_unixtime pattern must be a literal"
+        parts = parse_dt_pattern(self.right.value)
+        if parts is None:
+            raise NotImplementedError(
+                f"unsupported datetime pattern {fc.data[0]!r}")
+        validity = _combined_validity([c, fc])
+        out = _format_micros(c.data.astype(np.int64) * 1_000_000,
+                             validity, parts)
+        return HostColumn(T.StringT, out, validity)
+
+
+class GetTimestamp(BinaryExpression):
+    """to_date/to_timestamp(col, fmt): pattern-parse to TimestampType
+    (to_date wraps this in a Cast to date, like Spark's ParseToDate)."""
+
+    def __init__(self, child: Expression, fmt: Expression):
+        self.children = [child, fmt]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.TimestampT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c, fc = self.left.eval(batch), self.right.eval(batch)
+        assert isinstance(self.right, Literal), \
+            "to_date/to_timestamp pattern must be a literal"
+        parts = parse_dt_pattern(self.right.value)
+        if parts is None:
+            raise NotImplementedError(
+                f"unsupported datetime pattern {fc.data[0]!r}")
+        validity = _combined_validity([c, fc])
+        micros, ok = _parse_with_pattern(c.data, validity, parts)
+        return HostColumn(T.TimestampT, micros, ok).normalized()
+
+
 # ---------------------------------------------------------------------------
 # Hash
 # ---------------------------------------------------------------------------
@@ -1374,6 +2230,58 @@ def _hash_column(c: HostColumn, seed: np.ndarray) -> np.ndarray:
         h = murmur3.hash_long(c.data.astype(np.int64), seed)
     else:
         raise TypeError(f"cannot hash {dt}")
+    return np.where(c.validity, h, seed)
+
+
+class XxHash64(Expression):
+    """Spark XxHash64(seed=42L) over columns left-to-right (reference:
+    GpuXxHash64, HashFunctions.scala); device twin in ops/hashing.py."""
+
+    def __init__(self, children: List[Expression], seed: int = 42):
+        self.children = list(children)
+        self.seed = seed
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.LongT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        from spark_rapids_tpu.columnar import xxhash64
+        n = batch.num_rows
+        h = np.full(n, self.seed, dtype=np.int64)
+        for child in self.children:
+            c = child.eval(batch)
+            h = _xx_hash_column(c, h, xxhash64)
+        return HostColumn.all_valid(h, T.LongT)
+
+
+def _xx_hash_column(c: HostColumn, seed: np.ndarray, xx) -> np.ndarray:
+    dt = c.dtype
+    if isinstance(dt, (T.StringType, T.BinaryType)):
+        out = seed.copy()
+        for i in range(len(c.data)):
+            if c.validity[i]:
+                raw = (c.data[i].encode("utf-8")
+                       if isinstance(c.data[i], str) else bytes(c.data[i]))
+                out[i] = xx.hash_bytes_one(raw, int(seed[i]))
+        return out
+    if isinstance(dt, (T.BooleanType, T.ByteType, T.ShortType,
+                       T.IntegerType, T.DateType)):
+        h = xx.hash_int(c.data.astype(np.int32), seed)
+    elif isinstance(dt, (T.LongType, T.TimestampType)):
+        h = xx.hash_long(c.data.astype(np.int64), seed)
+    elif isinstance(dt, T.FloatType):
+        h = xx.hash_float(c.data, seed)
+    elif isinstance(dt, T.DoubleType):
+        h = xx.hash_double(c.data, seed)
+    elif isinstance(dt, T.DecimalType) and dt.precision <= 18:
+        h = xx.hash_long(c.data.astype(np.int64), seed)
+    else:
+        raise TypeError(f"cannot xxhash {dt}")
     return np.where(c.validity, h, seed)
 
 

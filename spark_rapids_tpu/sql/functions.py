@@ -103,6 +103,15 @@ class Column:
                   and isinstance(values[0], (list, tuple)) else values)]
         return Column(E.In(self.expr, items))
 
+    def bitwiseAND(self, other) -> "Column":
+        return Column(E.BitwiseAnd(self.expr, _to_expr(other)))
+
+    def bitwiseOR(self, other) -> "Column":
+        return Column(E.BitwiseOr(self.expr, _to_expr(other)))
+
+    def bitwiseXOR(self, other) -> "Column":
+        return Column(E.BitwiseXor(self.expr, _to_expr(other)))
+
     # -- casts & misc
     def cast(self, dtype: Union[T.DataType, str]) -> "Column":
         return Column(E.Cast(self.expr, _parse_type(dtype)))
@@ -442,6 +451,205 @@ def datediff(end, start) -> Column:
 
 def hash(*cols) -> Column:  # noqa: A001
     return Column(E.Murmur3Hash([_to_col_expr(c) for c in cols]))
+
+
+def xxhash64(*cols) -> Column:
+    return Column(E.XxHash64([_to_col_expr(c) for c in cols]))
+
+
+# bitwise
+def shiftleft(c, n) -> Column:
+    return Column(E.ShiftLeft(_to_col_expr(c), _to_expr(n)))
+
+
+def shiftright(c, n) -> Column:
+    return Column(E.ShiftRight(_to_col_expr(c), _to_expr(n)))
+
+
+def shiftrightunsigned(c, n) -> Column:
+    return Column(E.ShiftRightUnsigned(_to_col_expr(c), _to_expr(n)))
+
+
+def bitwise_not(c) -> Column:
+    return Column(E.BitwiseNot(_to_col_expr(c)))
+
+
+# more math
+def log2(c) -> Column:
+    return Column(E.Log2(_to_col_expr(c)))
+
+
+def log1p(c) -> Column:
+    return Column(E.Log1p(_to_col_expr(c)))
+
+
+def expm1(c) -> Column:
+    return Column(E.Expm1(_to_col_expr(c)))
+
+
+def cbrt(c) -> Column:
+    return Column(E.Cbrt(_to_col_expr(c)))
+
+
+def rint(c) -> Column:
+    return Column(E.Rint(_to_col_expr(c)))
+
+
+def degrees(c) -> Column:
+    return Column(E.ToDegrees(_to_col_expr(c)))
+
+
+def radians(c) -> Column:
+    return Column(E.ToRadians(_to_col_expr(c)))
+
+
+def atan2(a, b) -> Column:
+    return Column(E.Atan2(E.Cast(_to_col_expr(a), T.DoubleT),
+                          E.Cast(_to_col_expr(b), T.DoubleT)))
+
+
+def hypot(a, b) -> Column:
+    return Column(E.Hypot(E.Cast(_to_col_expr(a), T.DoubleT),
+                          E.Cast(_to_col_expr(b), T.DoubleT)))
+
+
+def greatest(*cols) -> Column:
+    return Column(E.Greatest([_to_col_expr(c) for c in cols]))
+
+
+def least(*cols) -> Column:
+    return Column(E.Least([_to_col_expr(c) for c in cols]))
+
+
+# more strings
+def concat_ws(sep: str, *cols) -> Column:
+    return Column(E.ConcatWs([E.Literal(sep)]
+                             + [_to_col_expr(c) for c in cols]))
+
+
+def repeat(c, n: int) -> Column:
+    return Column(E.StringRepeat(_to_col_expr(c), E.Literal(n)))
+
+
+def lpad(c, length_: int, pad: str) -> Column:
+    return Column(E.StringLPad(_to_col_expr(c), E.Literal(length_),
+                               E.Literal(pad)))
+
+
+def rpad(c, length_: int, pad: str) -> Column:
+    return Column(E.StringRPad(_to_col_expr(c), E.Literal(length_),
+                               E.Literal(pad)))
+
+
+def translate(c, matching: str, replace: str) -> Column:
+    return Column(E.StringTranslate(_to_col_expr(c), E.Literal(matching),
+                                    E.Literal(replace)))
+
+
+def regexp_replace(c, pattern: str, replacement: str) -> Column:
+    # literal (non-regex) patterns only would be StringReplace; the
+    # regex engine is not implemented yet
+    raise NotImplementedError("regexp_replace is not implemented")
+
+
+def replace(c, search, replacement="") -> Column:
+    return Column(E.StringReplace(_to_col_expr(c), _to_expr(search),
+                                  _to_expr(replacement)))
+
+
+def instr(c, substr: str) -> Column:
+    return Column(E.StringInstr(_to_col_expr(c), E.Literal(substr)))
+
+
+def locate(substr: str, c, pos: int = 1) -> Column:
+    return Column(E.StringLocate(E.Literal(substr), _to_col_expr(c),
+                                 E.Literal(pos)))
+
+
+def initcap(c) -> Column:
+    return Column(E.InitCap(_to_col_expr(c)))
+
+
+def reverse(c) -> Column:
+    return Column(E.StringReverse(_to_col_expr(c)))
+
+
+def ltrim(c) -> Column:
+    return Column(E.StringTrimLeft(_to_col_expr(c)))
+
+
+def rtrim(c) -> Column:
+    return Column(E.StringTrimRight(_to_col_expr(c)))
+
+
+def ascii(c) -> Column:
+    return Column(E.Ascii(_to_col_expr(c)))
+
+
+def chr(c) -> Column:  # noqa: A001
+    return Column(E.Chr(_to_col_expr(c)))
+
+
+# more datetime
+def quarter(c) -> Column:
+    return Column(E.Quarter(_to_col_expr(c)))
+
+
+def dayofweek(c) -> Column:
+    return Column(E.DayOfWeek(_to_col_expr(c)))
+
+
+def weekday(c) -> Column:
+    return Column(E.WeekDay(_to_col_expr(c)))
+
+
+def dayofyear(c) -> Column:
+    return Column(E.DayOfYear(_to_col_expr(c)))
+
+
+def weekofyear(c) -> Column:
+    return Column(E.WeekOfYear(_to_col_expr(c)))
+
+
+def last_day(c) -> Column:
+    return Column(E.LastDay(_to_col_expr(c)))
+
+
+def add_months(c, months) -> Column:
+    return Column(E.AddMonths(_to_col_expr(c), _to_expr(months)))
+
+
+def months_between(end, start) -> Column:
+    return Column(E.MonthsBetween(_to_col_expr(end), _to_col_expr(start)))
+
+
+def trunc(c, fmt: str) -> Column:
+    return Column(E.TruncDate(_to_col_expr(c), E.Literal(fmt)))
+
+
+def date_format(c, fmt: str) -> Column:
+    return Column(E.DateFormatClass(_to_col_expr(c), E.Literal(fmt)))
+
+
+def unix_timestamp(c, fmt: str = "yyyy-MM-dd HH:mm:ss") -> Column:
+    return Column(E.UnixTimestamp(_to_col_expr(c), E.Literal(fmt)))
+
+
+def from_unixtime(c, fmt: str = "yyyy-MM-dd HH:mm:ss") -> Column:
+    return Column(E.FromUnixTime(_to_col_expr(c), E.Literal(fmt)))
+
+
+def to_date(c, fmt: Optional[str] = None) -> Column:
+    if fmt is None:
+        return Column(E.Cast(_to_col_expr(c), T.DateT))
+    return Column(E.Cast(E.GetTimestamp(_to_col_expr(c), E.Literal(fmt)),
+                         T.DateT))
+
+
+def to_timestamp(c, fmt: Optional[str] = None) -> Column:
+    if fmt is None:
+        return Column(E.Cast(_to_col_expr(c), T.TimestampT))
+    return Column(E.GetTimestamp(_to_col_expr(c), E.Literal(fmt)))
 
 
 # ---------------------------------------------------------------------------

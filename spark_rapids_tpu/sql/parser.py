@@ -493,6 +493,15 @@ class _Parser:
             return F.lit(low == "true")
         if low == "case":
             return self._case()
+        if low in ("date", "timestamp") and self.peek(1)[0] == "str":
+            # ANSI typed literals: DATE '1998-09-02' (Spark AstBuilder
+            # visitTypeConstructor semantics = cast of the string)
+            self.next()
+            s = self._string_lit()
+            from spark_rapids_tpu.sql import types as T
+            return Column(E.Cast(
+                E.Literal(s),
+                T.DateT if low == "date" else T.TimestampT))
         if low == "cast":
             self.next()
             self.expect("(")
@@ -657,7 +666,38 @@ _FUNCTIONS = {
     "isnan": F.isnan, "year": F.year, "month": F.month,
     "dayofmonth": F.dayofmonth, "hour": F.hour, "minute": F.minute,
     "second": F.second, "date_add": F.date_add, "date_sub": F.date_sub,
-    "datediff": F.datediff, "hash": F.hash,
+    "datediff": F.datediff, "hash": F.hash, "xxhash64": F.xxhash64,
+    "shiftleft": F.shiftleft, "shiftright": F.shiftright,
+    "shiftrightunsigned": F.shiftrightunsigned,
+    "log2": F.log2, "log1p": F.log1p, "expm1": F.expm1, "cbrt": F.cbrt,
+    "rint": F.rint, "degrees": F.degrees, "radians": F.radians,
+    "atan2": F.atan2, "hypot": F.hypot,
+    "greatest": F.greatest, "least": F.least,
+    "concat_ws": lambda sep, *cols: F.concat_ws(_lit_value(sep), *cols),
+    "repeat": lambda c, n: F.repeat(c, int(_lit_value(n))),
+    "lpad": lambda c, n, p: F.lpad(c, int(_lit_value(n)), _lit_value(p)),
+    "rpad": lambda c, n, p: F.rpad(c, int(_lit_value(n)), _lit_value(p)),
+    "translate": lambda c, m, r: F.translate(c, _lit_value(m),
+                                             _lit_value(r)),
+    "replace": F.replace, "instr": lambda c, s: F.instr(c, _lit_value(s)),
+    "locate": lambda s, c, *p: F.locate(
+        _lit_value(s), c, *[int(_lit_value(x)) for x in p]),
+    "initcap": F.initcap, "reverse": F.reverse,
+    "ltrim": F.ltrim, "rtrim": F.rtrim,
+    "ascii": F.ascii, "char": F.chr, "chr": F.chr,
+    "quarter": F.quarter, "dayofweek": F.dayofweek,
+    "weekday": F.weekday, "dayofyear": F.dayofyear,
+    "weekofyear": F.weekofyear, "last_day": F.last_day,
+    "add_months": F.add_months, "months_between": F.months_between,
+    "trunc": lambda c, f: F.trunc(c, _lit_value(f)),
+    "date_format": lambda c, f: F.date_format(c, _lit_value(f)),
+    "unix_timestamp": lambda c, *f: F.unix_timestamp(
+        c, *[_lit_value(x) for x in f]),
+    "from_unixtime": lambda c, *f: F.from_unixtime(
+        c, *[_lit_value(x) for x in f]),
+    "to_date": lambda c, *f: F.to_date(c, *[_lit_value(x) for x in f]),
+    "to_timestamp": lambda c, *f: F.to_timestamp(
+        c, *[_lit_value(x) for x in f]),
     "row_number": F.row_number, "rank": F.rank,
     "dense_rank": F.dense_rank, "ntile": lambda n: F.ntile(
         int(_lit_value(n))),

@@ -16,13 +16,38 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
-from spark_rapids_tpu.conf import TpuConf, SHUFFLE_PARTITIONS
+from spark_rapids_tpu.conf import (AUTO_BROADCAST_JOIN_THRESHOLD, TpuConf,
+                                   SHUFFLE_PARTITIONS)
 from spark_rapids_tpu.sql import expressions as E
 from spark_rapids_tpu.sql import logical as L
 from spark_rapids_tpu.sql import physical as P
 from spark_rapids_tpu.sql import types as T
 
-BROADCAST_THRESHOLD_ROWS = 100_000
+
+def estimate_plan_bytes(p: L.LogicalPlan) -> Optional[int]:
+    """Best-effort size estimate of a logical subtree's output, for
+    broadcast selection (the sizeInBytes statistic Spark's JoinSelection
+    consults). LocalRelations measure their host batches, FileScans their
+    on-disk footprint; row-preserving/reducing unary nodes pass the child
+    estimate through (an upper bound). None = unknown (never broadcast).
+    """
+    if isinstance(p, L.LocalRelation):
+        from spark_rapids_tpu.memory import _host_sizeof
+        return sum(_host_sizeof(b) for b in p.batches)
+    if isinstance(p, L.FileScan):
+        import os
+        total = 0
+        for path in p.paths:
+            if os.path.isdir(path):
+                for root, _dirs, files in os.walk(path):
+                    total += sum(os.path.getsize(os.path.join(root, f))
+                                 for f in files)
+            elif os.path.exists(path):
+                total += os.path.getsize(path)
+        return total
+    if isinstance(p, (L.Project, L.Filter, L.Limit, L.Sort)):
+        return estimate_plan_bytes(p.child)
+    return None
 
 
 class Planner:
@@ -218,8 +243,10 @@ class Planner:
             raise NotImplementedError(
                 f"non-equi {p.join_type} join not supported yet")
 
-        small_right = isinstance(p.right, L.LocalRelation) and sum(
-            b.num_rows for b in p.right.batches) < BROADCAST_THRESHOLD_ROWS
+        threshold = int(self.conf.get(AUTO_BROADCAST_JOIN_THRESHOLD))
+        est = estimate_plan_bytes(p.right)
+        small_right = (threshold >= 0 and est is not None
+                       and est <= threshold)
         if small_right and p.join_type in ("inner", "left", "leftouter",
                                            "leftsemi", "leftanti", "cross"):
             return P.CpuBroadcastHashJoinExec(

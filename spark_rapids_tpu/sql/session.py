@@ -45,8 +45,10 @@ class TpuSparkSession:
     def __init__(self, conf: Optional[Dict[str, Any]] = None):
         self.conf_obj = TpuConf(conf)
         if self.conf_obj.sql_enabled:
+            import spark_rapids_tpu
             from spark_rapids_tpu import device_manager
             device_manager.initialize(self.conf_obj)
+            spark_rapids_tpu._enable_compile_cache()
         self.conf = RuntimeConfApi(self.conf_obj)
         self.catalog_views: Dict[str, L.LogicalPlan] = {}
         self._plan_capture: List = []  # ExecutionPlanCaptureCallback twin

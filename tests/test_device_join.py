@@ -98,8 +98,11 @@ def test_self_join():
         other = df.select(F.col("k").alias("k2"),
                           F.col("v").alias("v2"))
         return df.join(other, F.col("k") == F.col("k2"), "inner")
+    # threshold -1 pins the shuffled path (the projected LocalRelation
+    # would otherwise be size-estimated under the broadcast threshold)
     assert_tpu_and_cpu_equal_collect(
-        fn, expect_execs=["TpuShuffledHashJoin"])
+        fn, conf={"spark.rapids.sql.autoBroadcastJoinThreshold": "-1"},
+        expect_execs=["TpuShuffledHashJoin"])
 
 
 def test_join_all_null_keys():

@@ -107,6 +107,49 @@ def test_final_agg_bounded_merge():
         expect_execs=["TpuHashAggregate"])
 
 
+def test_out_of_core_sort_emits_bounded_sorted_batches():
+    """A sort partition far beyond batchSizeRows takes the rank-split
+    out-of-core path (multiple bounded output batches, spills under the
+    tiny budget) and stays bit-identical, including key ties."""
+    conf = {
+        "spark.rapids.sql.batchSizeRows": "512",
+        "spark.rapids.memory.tpu.poolSize": str(64 << 10),
+    }
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: s.createDataFrame(
+            gen_batch([("a", SmallIntGen()), ("b", LongGen()),
+                       ("c", IntegerGen())], 6000, 41),
+            num_partitions=2).sortWithinPartitions("a", "b"),
+        conf=conf, ignore_order=False,
+        expect_execs=["TpuSort"])
+    store = MEM.get_device_store.__globals__["_STORE"]
+    assert store is not None and store.spill_count > 0
+
+
+def test_chunked_join_under_tiny_budget():
+    """A join whose stream side exceeds batchSizeRows joins in chunks
+    against the resident build side; spills happen and results match."""
+    conf = {
+        "spark.rapids.sql.batchSizeRows": "512",
+        "spark.rapids.memory.tpu.poolSize": str(64 << 10),
+        # force the shuffled (chunked-stream) path
+        "spark.rapids.sql.autoBroadcastJoinThreshold": "-1",
+    }
+
+    def fn(s):
+        left = s.createDataFrame(
+            gen_batch([("k", SmallIntGen()), ("v", LongGen())], 6000, 42),
+            num_partitions=3)
+        right = s.createDataFrame(
+            gen_batch([("k", SmallIntGen()), ("w", IntegerGen())], 700, 43),
+            num_partitions=3)
+        return left.join(right, on="k", how="left")
+    assert_tpu_and_cpu_equal_collect(
+        fn, conf=conf, expect_execs=["TpuShuffledHashJoin"])
+    store = MEM.get_device_store.__globals__["_STORE"]
+    assert store is not None and store.spill_count > 0
+
+
 def test_range_partition_ragged_string_keys():
     """Batches whose longest strings land in different char-cap buckets
     must still rank globally (per-batch subkey word counts differ)."""
