@@ -1,0 +1,3 @@
+#!/bin/bash
+pkill -9 -f "python _[p]robe" 2>/dev/null; sleep 1; cd /root/repo; nohup python _probe.py > _probe.out 2>&1 &
+echo launched

@@ -139,11 +139,58 @@ class DeviceStringColumn:
         return DeviceStringColumn(dtype, chars, lengths, validity)
 
 
-AnyDeviceColumn = Union[DeviceColumn, DeviceStringColumn]
+@dataclass
+class DeviceArrayColumn:
+    """Array column: per-row (start, length) views into a shared element
+    pool (the offsets+child model of Arrow/cudf list columns, made
+    gather-friendly: after a row gather, starts may alias/point anywhere
+    in the pool, so no contiguity is assumed).
+
+    ``child`` is the element pool (a device column of its own capacity);
+    its validity marks null ELEMENTS. ``validity`` marks null arrays.
+    Nested columns are confined to upload -> project/filter ->
+    generate/collect paths; exchanges, sorts, joins, and aggregations
+    tag nested inputs back to CPU (TpuOverrides).
+    """
+
+    dtype: T.ArrayType
+    starts: jax.Array   # int32[capacity]
+    lengths: jax.Array  # int32[capacity]
+    child: "AnyDeviceColumn"
+    validity: jax.Array
+
+    @property
+    def capacity(self) -> int:
+        return self.starts.shape[0]
+
+    def arrays(self) -> Tuple[jax.Array, ...]:
+        return (self.starts, self.lengths) + self.child.arrays() \
+            + (self.validity,)
+
+    @staticmethod
+    def from_arrays(dtype: T.ArrayType, arrs: Sequence[jax.Array]
+                    ) -> "DeviceArrayColumn":
+        child = make_column(dtype.element_type, arrs[2:-1])
+        return DeviceArrayColumn(dtype, arrs[0], arrs[1], child, arrs[-1])
+
+
+AnyDeviceColumn = Union[DeviceColumn, DeviceStringColumn,
+                        "DeviceArrayColumn"]
+
+
+def column_arity(dtype: T.DataType) -> int:
+    """Number of flat arrays a device column of `dtype` carries."""
+    if isinstance(dtype, T.ArrayType):
+        return 3 + column_arity(dtype.element_type)
+    if is_string_like(dtype):
+        return 3
+    return 2
 
 
 def make_column(dtype: T.DataType, arrs: Sequence[jax.Array]
                 ) -> AnyDeviceColumn:
+    if isinstance(dtype, T.ArrayType):
+        return DeviceArrayColumn.from_arrays(dtype, arrs)
     if is_string_like(dtype):
         return DeviceStringColumn.from_arrays(dtype, arrs)
     return DeviceColumn.from_arrays(dtype, arrs)
@@ -209,19 +256,19 @@ class DeviceBatch:
 
     def to_host(self) -> HostBatch:
         """Gather active rows back to a HostBatch (device -> host copy).
-        All buffers are prefetched CONCURRENTLY: on tunneled backends
-        each fetch is a ~45ms round trip, so serial per-array fetches
-        dominate wall clock; jax caches the host copy, making the
-        per-column np.asarray below free."""
-        _prefetch_host([self.active]
-                       + [a for c in self.columns for a in c.arrays()])
-        active = np.asarray(self.active)
+        Buffers ride per-dtype concatenated transfers: each uncached
+        D2H fetch costs ~100ms flat on tunneled backends, so a batch of
+        N arrays moves in len(distinct dtypes) fetches, not N."""
+        flat, spec = flatten_batch(self)
+        np_arrs = _fetch_arrays([self.active] + flat)
+        active = np_arrs[0]
         idx = np.nonzero(active)[0]
         cols: List[HostColumn] = []
-        for f, c in zip(self.schema.fields, self.columns):
-            cols.append(_device_col_to_host(c, f.data_type, idx))
-        b = HostBatch(self.schema, cols, len(idx))
-        return b
+        i = 1
+        for f, (dt, n_arr) in zip(self.schema.fields, spec):
+            cols.append(_np_col_to_host(dt, np_arrs[i:i + n_arr], idx))
+            i += n_arr
+        return HostBatch(self.schema, cols, len(idx))
 
     @staticmethod
     def empty(schema: T.StructType, capacity: int = MIN_CAPACITY
@@ -243,18 +290,74 @@ def _prefetch_host(arrays: List[jax.Array]) -> None:
     list(_FETCH_POOL.map(np.asarray, arrays))
 
 
-def _put(arr: np.ndarray, device: Optional[jax.Device]) -> jax.Array:
-    if device is not None:
-        return jax.device_put(arr, device)
-    return jnp.asarray(arr)
+_FETCH_PACK_CACHE: dict = {}
 
 
-def _device_col_to_host(c: AnyDeviceColumn, dt: T.DataType,
-                        idx: np.ndarray) -> HostColumn:
-    if isinstance(c, DeviceStringColumn):
-        chars = np.asarray(c.chars)
-        lengths = np.asarray(c.lengths)
-        validity = np.asarray(c.validity)[idx]
+def _fetch_arrays(arrays: List[jax.Array]) -> List[np.ndarray]:
+    """Fetch device arrays with per-dtype concatenation: one transfer
+    per distinct dtype (plus a jitted flatten/concat program, cached on
+    the shape-set) instead of one per array."""
+    key = tuple((a.shape, str(a.dtype)) for a in arrays)
+    if len(arrays) <= 2:
+        _prefetch_host(list(arrays))
+        return [np.asarray(a) for a in arrays]
+    cached = _FETCH_PACK_CACHE.get(key)
+    if cached is None:
+        groups: dict = {}
+        for i, (_shape, dt) in enumerate(key):
+            groups.setdefault(dt, []).append(i)
+        order = list(groups.items())
+
+        def _fn(*arrs):
+            return tuple(
+                jnp.concatenate([arrs[i].reshape(-1) for i in idxs])
+                if len(idxs) > 1 else arrs[idxs[0]].reshape(-1)
+                for _dt, idxs in order)
+        cached = (jax.jit(_fn), order)
+        _FETCH_PACK_CACHE[key] = cached
+    jfn, order = cached
+    packed = jfn(*arrays)
+    _prefetch_host(list(packed))
+    out: List[Optional[np.ndarray]] = [None] * len(arrays)
+    for (_dt, idxs), buf in zip(order, packed):
+        b = np.asarray(buf)
+        off = 0
+        for i in idxs:
+            shape = arrays[i].shape
+            size = int(np.prod(shape))
+            out[i] = b[off:off + size].reshape(shape)
+            off += size
+    return out
+
+
+def _np_col_to_host(dt: T.DataType, arrs: List[np.ndarray],
+                    idx: np.ndarray) -> HostColumn:
+    """Numpy twin of _device_col_to_host over already-fetched arrays."""
+    if isinstance(dt, T.ArrayType):
+        starts, lengths, validity = arrs[0], arrs[1], arrs[-1]
+        child_arrs = arrs[2:-1]
+        pool_n = child_arrs[0].shape[0]
+        pc = _np_col_to_host(dt.element_type, list(child_arrs),
+                             np.arange(pool_n))
+        # storage-form pool values (to_pylist would convert dates etc.,
+        # diverging from the CPU engine's canonical element form)
+        pool = [pc.data[i].item() if isinstance(pc.data[i], np.generic)
+                else pc.data[i]
+                for i in range(len(pc.data))]
+        pool = [v if ok else None
+                for v, ok in zip(pool, pc.validity.tolist())]
+        validity = validity[idx]
+        data = np.empty(len(idx), dtype=object)
+        for out_i, i in enumerate(idx):
+            if validity[out_i]:
+                s, ln = int(starts[i]), int(lengths[i])
+                data[out_i] = tuple(pool[s:s + ln])
+            else:
+                data[out_i] = ()
+        return HostColumn(dt, data, validity)
+    if is_string_like(dt):
+        chars, lengths, validity = arrs
+        validity = validity[idx]
         data = np.empty(len(idx), dtype=object)
         is_binary = isinstance(dt, T.BinaryType)
         for out_i, i in enumerate(idx):
@@ -265,9 +368,15 @@ def _device_col_to_host(c: AnyDeviceColumn, dt: T.DataType,
                 data[out_i] = (raw.decode("utf-8", errors="replace")
                                if validity[out_i] else "")
         return HostColumn(dt, data, validity)
-    data = np.asarray(c.data)[idx]
-    validity = np.asarray(c.validity)[idx]
-    return HostColumn(dt, data.copy(), validity.copy()).normalized()
+    data, validity = arrs
+    return HostColumn(dt, data[idx].copy(),
+                      validity[idx].copy()).normalized()
+
+
+def _put(arr: np.ndarray, device: Optional[jax.Device]) -> jax.Array:
+    if device is not None:
+        return jax.device_put(arr, device)
+    return jnp.asarray(arr)
 
 
 # One fused program per (input shape-set, output capacity): eager
@@ -370,25 +479,73 @@ def mask_col(c: AnyDeviceColumn, keep: jax.Array) -> AnyDeviceColumn:
                                            jnp.zeros((), c.data.dtype)), v)
 
 
+_SORT_SIGN64 = 0x8000000000000000
+
+
+def _order_u64(a: jax.Array) -> Optional[jax.Array]:
+    """Order-preserving uint64 encoding of one sort-key array, or None
+    when the dtype has no such encoding on this backend (f64: 64-bit
+    float bitcasts do not lower)."""
+    if a.dtype == jnp.bool_:
+        return a.astype(jnp.uint64)
+    if a.dtype == jnp.uint64:
+        return a
+    if jnp.issubdtype(a.dtype, jnp.unsignedinteger):
+        return a.astype(jnp.uint64)
+    if a.dtype == jnp.float64:
+        return None
+    if a.dtype == jnp.float32:
+        u = jax.lax.bitcast_convert_type(a, jnp.int32).view(jnp.uint32)
+        u = jnp.where(a < 0, ~u, u | jnp.uint32(0x80000000))
+        return u.astype(jnp.uint64)
+    return a.astype(jnp.int64).view(jnp.uint64) ^ jnp.uint64(_SORT_SIGN64)
+
+
 def sort_with_payload(keys: Sequence[jax.Array],
                       payload: Sequence[jax.Array]):
-    """Lexicographic sort by `keys` (row index appended as the final key,
-    so the sort is total/stable); `payload` arrays follow via gathers on
-    the resulting order. Returns (sorted_keys, order, sorted_payload).
+    """Stable lexicographic sort by `keys`; `payload` arrays follow via
+    gathers on the resulting order. Returns (sorted_keys, order,
+    sorted_payload), `order` total/stable (original index tiebreak).
 
-    Payloads deliberately do NOT ride the lax.sort as extra operands:
     XLA's sort compile time on this TPU stack grows superlinearly with
-    operand count (measured round 3: 2-operand sort ~30s, 6-operand
-    ~135s, wider sorts effectively hang the compiler), while a
-    keys-only sort plus N gathers compiles in ~35s flat and runs at the
-    same speed."""
+    operand count (measured round 3: a 2-operand sort compiles in ~30s,
+    6 operands in ~135s, 8+ operands effectively hangs the compiler).
+    So multi-key sorts run as LSD radix passes: each key is encoded as
+    an order-preserving uint64 word and a ``lax.scan`` performs one
+    STABLE 2-operand sort per key, least-significant first — exactly
+    one compiled sort instance regardless of key count. f64 keys (no
+    order-preserving 64-bit encoding without a float bitcast) fall back
+    to per-key unrolled passes."""
     cap = keys[0].shape[0]
     pos = jnp.arange(cap, dtype=jnp.int32)
-    ks = tuple(keys) + (pos,)
-    out = jax.lax.sort(ks, num_keys=len(ks))
-    order = out[-1]
+    enc = [_order_u64(k) for k in keys]
+
+    def stable_pass(k, order):
+        kp = jnp.take(k, order)
+        _s, o2 = jax.lax.sort((kp, order), num_keys=1, is_stable=True)
+        return o2.astype(jnp.int32)
+
+    if all(e is not None for e in enc):
+        if len(enc) == 1:
+            order = stable_pass(enc[0], pos)
+        else:
+            rev = enc[::-1]  # least significant first
+            # first pass outside the scan: its output carries the vma
+            # (varying-manual-axes) type the scan carry needs when this
+            # runs inside a shard_map
+            order0 = stable_pass(rev[0], pos)
+            stacked = jnp.stack(rev[1:])
+
+            def body(order, k):
+                return stable_pass(k, order), None
+            order, _ = jax.lax.scan(body, order0, stacked)
+    else:
+        order = pos
+        for k in reversed(keys):
+            order = stable_pass(k, order)
+    sorted_keys = tuple(jnp.take(k, order) for k in keys)
     sorted_payload = [jnp.take(a, order, axis=0) for a in payload]
-    return out[:len(keys)], order, sorted_payload
+    return sorted_keys, order, sorted_payload
 
 
 def _compaction_order(active: jax.Array) -> jax.Array:
@@ -404,7 +561,18 @@ def take_columns(columns: Sequence[AnyDeviceColumn], idx: jax.Array,
     False become null (outer-join style null rows use idx clamped to 0)."""
     out: List[AnyDeviceColumn] = []
     for c in columns:
-        if isinstance(c, DeviceStringColumn):
+        if isinstance(c, DeviceArrayColumn):
+            starts = c.starts[idx]
+            lengths = c.lengths[idx]
+            validity = c.validity[idx]
+            if valid_at is not None:
+                validity = validity & valid_at
+            starts = jnp.where(validity, starts, 0)
+            lengths = jnp.where(validity, lengths, 0)
+            # the element pool is shared, not gathered
+            out.append(DeviceArrayColumn(c.dtype, starts, lengths,
+                                         c.child, validity))
+        elif isinstance(c, DeviceStringColumn):
             chars = c.chars[idx]
             lengths = c.lengths[idx]
             validity = c.validity[idx]

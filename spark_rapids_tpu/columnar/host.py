@@ -51,6 +51,7 @@ class HostColumn:
         is_ts = isinstance(self.dtype, T.TimestampType)
         dec_scale = (self.dtype.scale
                      if isinstance(self.dtype, T.DecimalType) else None)
+        is_array = isinstance(self.dtype, T.ArrayType)
         epoch = datetime.date(1970, 1, 1)
         ts_epoch = datetime.datetime(1970, 1, 1)
         for i in range(len(self.data)):
@@ -60,6 +61,10 @@ class HostColumn:
                 v = self.data[i]
                 if isinstance(v, np.generic):
                     v = v.item()
+                if is_array:
+                    out.append([_from_storage(x, self.dtype.element_type)
+                                for x in v])
+                    continue
                 if is_bool:
                     v = bool(v)
                 elif is_date:
@@ -95,7 +100,16 @@ class HostColumn:
         n = len(values)
         validity = np.array([v is not None for v in values], dtype=bool)
         np_dt = T.numpy_dtype(dtype)
-        if np_dt == np.dtype(object):
+        if isinstance(dtype, T.ArrayType):
+            # canonical element representation is STORAGE form (date ->
+            # days, timestamp -> micros, decimal -> unscaled int), like
+            # every other column; to_pylist converts back
+            et = dtype.element_type
+            data = np.empty(n, dtype=object)
+            for i, v in enumerate(values):
+                data[i] = () if v is None else tuple(
+                    None if x is None else _to_storage(x, et) for x in v)
+        elif np_dt == np.dtype(object):
             data = np.empty(n, dtype=object)
             for i, v in enumerate(values):
                 data[i] = v if v is not None else ""
@@ -123,7 +137,10 @@ class HostColumn:
         """Zero out invalid slots for deterministic comparison/hashing."""
         out = self.copy()
         inv = ~out.validity
-        if out.data.dtype == np.dtype(object):
+        if isinstance(self.dtype, T.ArrayType):
+            for i in np.nonzero(inv)[0]:
+                out.data[i] = ()
+        elif out.data.dtype == np.dtype(object):
             out.data[inv] = ""
         else:
             out.data[inv] = _zero_for(self.dtype)
@@ -135,6 +152,8 @@ def _zero_for(dtype: T.DataType) -> Any:
         return False
     if isinstance(dtype, (T.FloatType, T.DoubleType)):
         return 0.0
+    if isinstance(dtype, T.ArrayType):
+        return ()
     return 0
 
 
@@ -154,6 +173,34 @@ def _to_storage(v: Any, dtype: T.DataType) -> Any:
         q = d.quantize(decimal.Decimal(1).scaleb(-dtype.scale),
                        rounding=decimal.ROUND_HALF_UP)
         return int(q.scaleb(dtype.scale))
+    return v
+
+
+def _from_storage(v: Any, dtype: T.DataType) -> Any:
+    """Inverse of _to_storage for collect(): storage ints back to
+    python date/datetime/Decimal/bool values (None passes through)."""
+    import datetime
+    import decimal
+    if v is None:
+        return None
+    if isinstance(v, np.generic):
+        v = v.item()
+    if isinstance(dtype, T.BooleanType):
+        return bool(v)
+    if isinstance(dtype, T.DateType):
+        try:
+            return (datetime.date(1970, 1, 1)
+                    + datetime.timedelta(days=v))
+        except OverflowError:
+            return v
+    if isinstance(dtype, T.TimestampType):
+        try:
+            return (datetime.datetime(1970, 1, 1)
+                    + datetime.timedelta(microseconds=v))
+        except OverflowError:
+            return v
+    if isinstance(dtype, T.DecimalType):
+        return decimal.Decimal(v).scaleb(-dtype.scale)
     return v
 
 

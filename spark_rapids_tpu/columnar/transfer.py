@@ -144,9 +144,10 @@ def pack_batch(batch) -> Tuple[np.ndarray, List[np.ndarray], Tuple]:
             # invalid slots already zeroed by _encode_strings
             char_cap = chars.shape[1] if n else 8
             c_off = pk.add(chars)
-            lk = "i8" if char_cap <= 127 else "i16"  # lengths fit
+            lk = ("i8" if char_cap <= 127 else
+                  "i16" if char_cap <= 32767 else "i32")
             l_off = pk.add(lengths.astype(
-                np.int8 if lk == "i8" else np.int16))
+                {"i8": np.int8, "i16": np.int16, "i32": np.int32}[lk]))
             layout.append(("str", char_cap, c_off, lk, l_off, vdesc))
             continue
         np_dt = T.numpy_dtype(dt)
@@ -270,33 +271,57 @@ def _build_decode(layout: Tuple, n: int, cap: int) -> Callable:
 PACKED_MIN_ROWS = 1 << 16
 
 
+def _stage_column(c, dt: T.DataType, cap: int) -> List[np.ndarray]:
+    """Full-width staging buffers for one column, matching the device
+    column's arrays() layout; recurses into array element pools."""
+    from spark_rapids_tpu.columnar import device as D
+    from spark_rapids_tpu.columnar.host import HostColumn
+    n = len(c)
+    validity = np.zeros(cap, dtype=bool)
+    validity[:n] = c.validity
+    if isinstance(dt, T.ArrayType):
+        starts = np.zeros(cap, dtype=np.int32)
+        lengths = np.zeros(cap, dtype=np.int32)
+        elems: List = []
+        off = 0
+        for i in range(n):
+            if c.validity[i]:
+                row = c.data[i]
+                starts[i] = off
+                lengths[i] = len(row)
+                elems.extend(row)
+                off += len(row)
+        child_cap = D.bucket_capacity(max(1, off))
+        child_col = HostColumn.from_pylist(elems, dt.element_type)
+        return [starts, lengths] + \
+            _stage_column(child_col, dt.element_type, child_cap) + \
+            [validity]
+    if D.is_string_like(dt):
+        ch, ln = _encode_strings(c.data, c.validity, n,
+                                 isinstance(dt, T.BinaryType))
+        char_cap = ch.shape[1] if n else 8
+        chars = np.zeros((cap, char_cap), dtype=np.uint8)
+        chars[:n] = ch
+        lengths = np.zeros(cap, dtype=np.int32)
+        lengths[:n] = ln
+        return [chars, lengths, validity]
+    np_dt = T.numpy_dtype(dt)
+    data = np.zeros(cap, dtype=np_dt)
+    data[:n] = c.normalized().data
+    return [data, validity]
+
+
 def _direct_upload(batch, cap: int, device: Optional[jax.Device]):
-    """Small-batch path: stage padded full-width buffers, one device_put,
-    zero compiled programs."""
+    """Small-batch (and nested-column) path: stage padded full-width
+    buffers, one device_put, zero compiled programs."""
     from spark_rapids_tpu.columnar import device as D
     n = batch.num_rows
     np_arrays: List[np.ndarray] = []
     spec: List[Tuple[T.DataType, int]] = []
     for f, c in zip(batch.schema.fields, batch.columns):
-        dt = f.data_type
-        validity = np.zeros(cap, dtype=bool)
-        validity[:n] = c.validity
-        if D.is_string_like(dt):
-            ch, ln = _encode_strings(c.data, c.validity, n,
-                                     isinstance(dt, T.BinaryType))
-            char_cap = ch.shape[1] if n else 8
-            chars = np.zeros((cap, char_cap), dtype=np.uint8)
-            chars[:n] = ch
-            lengths = np.zeros(cap, dtype=np.int32)
-            lengths[:n] = ln
-            spec.append((dt, 3))
-            np_arrays.extend([chars, lengths, validity])
-        else:
-            np_dt = T.numpy_dtype(dt)
-            data = np.zeros(cap, dtype=np_dt)
-            data[:n] = c.normalized().data
-            spec.append((dt, 2))
-            np_arrays.extend([data, validity])
+        parts = _stage_column(c, f.data_type, cap)
+        spec.append((f.data_type, len(parts)))
+        np_arrays.extend(parts)
     active_np = np.zeros(cap, dtype=bool)
     active_np[:n] = True
     np_arrays.append(active_np)
@@ -313,7 +338,9 @@ def upload_batch(batch, cap: int, device: Optional[jax.Device] = None):
     one decode program); small batches skip the codec."""
     from spark_rapids_tpu.columnar import device as D
     n = batch.num_rows
-    if n < PACKED_MIN_ROWS:
+    if n < PACKED_MIN_ROWS or any(
+            isinstance(f.data_type, T.ArrayType)
+            for f in batch.schema.fields):
         return _direct_upload(batch, cap, device)
     words, extras, layout = pack_batch(batch)
     key = (layout, n, cap, words.nbytes)

@@ -133,6 +133,22 @@ TASK_PARALLELISM = conf("spark.rapids.sql.taskParallelism").doc(
     "simultaneous device use. Default 1 (sequential); raise on real "
     "TPU backends where per-task host round trips dominate.").integer(1)
 
+EVENT_LOG_DIR = conf("spark.rapids.sql.eventLog.dir").doc(
+    "Directory for per-query JSON event logs (empty = disabled); the "
+    "offline qualification/profiling tools read these "
+    "(Qualification.scala:34 / Profiler.scala:31 data source).").string("")
+
+SHUFFLE_MODE = conf("spark.rapids.shuffle.mode").doc(
+    "Exchange transport: 'inprocess' (materialized partition lists, the "
+    "JVM sort-shuffle analogue) or 'ici' (HBM-resident all-to-all over "
+    "the active jax device mesh — the RapidsShuffleManager/UCX "
+    "replacement, GpuShuffleEnv.scala:26 role). 'ici' activates a mesh "
+    "over all visible devices at session start.").string("inprocess")
+
+SHUFFLE_ICI_DEVICES = conf("spark.rapids.shuffle.ici.devices").doc(
+    "Number of devices in the ICI shuffle mesh (0 = all visible "
+    "devices).").integer(0)
+
 AUTO_BROADCAST_JOIN_THRESHOLD = conf(
     "spark.rapids.sql.autoBroadcastJoinThreshold").doc(
     "Maximum estimated build-side size in bytes for a join to use a "

@@ -1,0 +1,96 @@
+"""Per-query JSON event logs + offline readers (the reference tools/
+module's data source: Spark event logs parsed by Qualification.scala:34
+and Profiler.scala:31; here the engine writes its own compact format).
+
+Enabled by ``spark.rapids.sql.eventLog.dir``: each completed collect()
+appends ONE JSON line to ``events-<pid>-<session>.jsonl`` in that
+directory with the plan, per-operator device placement and fallback
+reasons, per-operator metrics, spill-store stats, wall time, and row
+counts. ``read_events`` loads a log (or a directory of logs) back for
+the offline qualification/profiling tools in tools.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+_LOCK = threading.Lock()
+_SEQ = [0]
+
+
+def _collect_ops(physical) -> List[Dict[str, Any]]:
+    from spark_rapids_tpu.exec.base import TpuExec
+    ops: List[Dict[str, Any]] = []
+
+    def walk(p, depth=0):
+        entry: Dict[str, Any] = {
+            "op": type(p).__name__,
+            "depth": depth,
+            "device": isinstance(p, TpuExec),
+        }
+        m = getattr(p, "metrics", None)
+        if m is not None:
+            vals = {k: v.value for k, v in m.metrics.items() if v.value}
+            if vals:
+                entry["metrics"] = vals
+        ops.append(entry)
+        for c in getattr(p, "children", []):
+            walk(c, depth + 1)
+    walk(physical)
+    return ops
+
+
+def write_event(log_dir: str, session_id: int, physical,
+                rewrite_report, wall_s: float, rows: int,
+                store_stats: Optional[Dict[str, int]] = None) -> None:
+    """Append one query-completion event; failures never break the
+    query (observability must not take down execution)."""
+    try:
+        os.makedirs(log_dir, exist_ok=True)
+        with _LOCK:
+            _SEQ[0] += 1
+            qid = _SEQ[0]
+        rec: Dict[str, Any] = {
+            "event": "queryCompleted",
+            "ts": time.time(),
+            "queryId": qid,
+            "wallSeconds": round(wall_s, 6),
+            "outputRows": rows,
+            "plan": repr(physical),
+            "ops": _collect_ops(physical),
+        }
+        if rewrite_report is not None:
+            rec["replacedAny"] = rewrite_report.replaced_any
+            rec["fallbacks"] = [
+                {"op": name, "reasons": list(reasons)}
+                for name, reasons in rewrite_report.fallbacks]
+        if store_stats:
+            rec["storeStats"] = store_stats
+        path = os.path.join(
+            log_dir, f"events-{os.getpid()}-{session_id}.jsonl")
+        with _LOCK, open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except Exception:
+        pass
+
+
+def read_events(path: str) -> Iterator[Dict[str, Any]]:
+    """Load events from one .jsonl file or every events-*.jsonl in a
+    directory."""
+    files: List[str]
+    if os.path.isdir(path):
+        files = sorted(
+            os.path.join(path, f) for f in os.listdir(path)
+            if f.startswith("events-") and f.endswith(".jsonl"))
+    else:
+        files = [path]
+    for fp in files:
+        with open(fp) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield json.loads(line)

@@ -352,20 +352,18 @@ class TpuHashAggregateExec(TpuExec):
         def make(thunk: DevicePartitionThunk) -> DevicePartitionThunk:
             def run() -> Iterator[DeviceBatch]:
                 if self.mode == "partial":
-                    # per-batch partial aggregation, no concat needed.
-                    # Empty INPUTS aggregate harmlessly (zero segments);
-                    # checking the OUTPUT count instead avoids one host
-                    # sync per batch (shrink syncs anyway to size its
-                    # bucket).
+                    # per-batch partial aggregation, no concat and NO
+                    # host sync: results stay mask-scattered at the
+                    # input capacity; the downstream exchange split is
+                    # the next (and only) sizing sync. Each D2H sync
+                    # costs ~100ms on tunneled backends.
                     for b in thunk():
-                        out = shrink_to_bucket(self._aggregate_batch(b))
-                        if out.row_count():
-                            yield out
+                        yield self._aggregate_batch(b)
                     return
                 from spark_rapids_tpu.memory import get_device_store
                 store = get_device_store(self.conf)
                 handles = [store.register(b) for b in thunk()
-                           if b.row_count()]
+                           if b._num_rows != 0]
                 if not handles:
                     if not grouped and self.mode in ("final", "complete"):
                         yield self._empty_global_result()
@@ -376,7 +374,16 @@ class TpuHashAggregateExec(TpuExec):
                     whole = concat_device([h.get() for h in handles])
                     for h in handles:
                         h.close()
-                yield shrink_to_bucket(self._aggregate_batch(whole))
+                # no shrink: results stay mask-scattered (caps here are
+                # already small post-exchange; skipping saves a sync)
+                out = self._aggregate_batch(whole)
+                if not grouped and self.mode in ("final", "complete") \
+                        and out.row_count() == 0:
+                    # inputs existed but every row was filtered/inactive:
+                    # a global aggregate still returns its one row
+                    yield self._empty_global_result()
+                    return
+                yield out
             return run
         return [make(t) for t in device_channel(self.child)]
 

@@ -117,7 +117,11 @@ def global_range_pids(order: List[E.Expression],
     combined = [jnp.concatenate([ks[i] for ks in keysets])
                 for i in range(len(keysets[0]))]
     active = jnp.concatenate(actives)
-    perm = jnp.lexsort(tuple(reversed(combined)) + (~active,))
+    from spark_rapids_tpu.columnar.device import sort_with_payload
+    # most-significant first: live rows, then the order words (the LSD
+    # helper replaces jnp.lexsort, whose many-operand sorts hang the
+    # TPU compiler — see sort_with_payload)
+    _k, perm, _p = sort_with_payload([~active] + combined, [])
     cap = active.shape[0]
     # rank of row p = its sorted position = inverse permutation (a sort,
     # not a scatter — scatters serialize on TPU)
@@ -316,7 +320,7 @@ class TpuShuffleExchangeExec(TpuExec):
         elif isinstance(p, P.SinglePartitioning):
             for per_part in self._pull_split(
                     device_channel(self.child),
-                    lambda b: store.register(b) if b.row_count()
+                    lambda b: store.register(b) if b._num_rows != 0
                     else None):
                 for h in per_part:
                     if h is not None:
@@ -351,7 +355,7 @@ class TpuShuffleExchangeExec(TpuExec):
         handles, keycols, actives = [], [], []
         for thunk in device_channel(self.child):
             for b in thunk():
-                if b.row_count() == 0:
+                if b._num_rows == 0:  # skip only KNOWN-empty (no sync)
                     continue
                 with self.metrics.timed(M.PARTITION_TIME):
                     keycols.append(range_key_columns(p.order, bound, b))
@@ -395,6 +399,7 @@ class TpuShuffleExchangeExec(TpuExec):
         slot_batches = [
             concat_device(bs) if bs else DeviceBatch.empty(schema)
             for bs in slots]
+        self.metrics.create("numIciExchanges", M.ESSENTIAL).add(1)
         with self.metrics.timed(M.PARTITION_TIME):
             return mesh_exchange(slot_batches, bound, n, mesh)
 

@@ -148,8 +148,8 @@ class TpuShuffledHashJoinExec(TpuExec):
                 # stream side drains into spillable handles first, so a
                 # skewed partition never pins both sides at once
                 lhandles = [store.register(b) for b in lt()
-                            if b.row_count()]
-                rb = [b for b in rt() if b.row_count()]
+                            if b._num_rows != 0]
+                rb = [b for b in rt() if b._num_rows != 0]
                 total_l = sum(h.rows for h in lhandles)
                 if (self.join_type not in self._LEFT_STREAM_TYPES
                         or total_l <= goal):

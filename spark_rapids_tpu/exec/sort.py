@@ -146,7 +146,7 @@ class TpuSortExec(TpuExec):
                 store = get_device_store(self.conf)
                 handles, keycols, actives = [], [], []
                 for b in thunk():
-                    if b.row_count() == 0:
+                    if b._num_rows == 0:  # skip only KNOWN-empty
                         continue
                     with metrics.timed(M.SORT_TIME):
                         keycols.append(

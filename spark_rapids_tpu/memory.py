@@ -64,7 +64,10 @@ class _State:
         self.device_bytes = batch.sizeof()
         self.host_bytes = 0
         self.closed = False
-        self.rows = batch.row_count()
+        # lazy: forcing a D2H count here costs a ~100ms sync per
+        # registration on tunneled backends; producers that know their
+        # counts (splits) attach them, others resolve on first use
+        self.rows: Optional[int] = batch._num_rows
         self.ever_spilled = False
 
 
@@ -84,8 +87,17 @@ class SpillableBatch:
 
     @property
     def rows(self) -> int:
-        """Row count (cached at registration; never touches the tiers)."""
-        return self._state.rows
+        """Row count; cached when the producer attached one, resolved
+        (one D2H sync, or free from the host tier) otherwise."""
+        st = self._state
+        if st.rows is None:
+            if st.tier == TIER_DEVICE:
+                st.rows = st.device.row_count()
+            elif st.tier == TIER_HOST:
+                st.rows = st.host.num_rows
+            else:
+                st.rows = self._store._access(self._id).row_count()
+        return st.rows
 
     @property
     def ever_spilled(self) -> bool:
@@ -189,6 +201,7 @@ class DeviceStore:
 
     def _spill_to_host(self, st: _State) -> None:
         st.host = st.device.to_host()
+        st.rows = st.host.num_rows
         st.device = None
         self.device_bytes -= st.device_bytes
         st.host_bytes = _host_sizeof(st.host)

@@ -52,6 +52,13 @@ jax.tree_util.register_pytree_node(
     lambda c: ((c.chars, c.lengths, c.validity), c.dtype),
     lambda dt, ch: DeviceStringColumn(dt, *ch))
 
+from spark_rapids_tpu.columnar.device import DeviceArrayColumn  # noqa: E402
+
+jax.tree_util.register_pytree_node(
+    DeviceArrayColumn,
+    lambda c: ((c.starts, c.lengths, c.child, c.validity), c.dtype),
+    lambda dt, ch: DeviceArrayColumn(dt, ch[0], ch[1], ch[2], ch[3]))
+
 
 # ---------------------------------------------------------------------------
 # Structural keys for the compile cache
@@ -263,6 +270,32 @@ def platform_gate(e: E.Expression) -> Optional[str]:
     return None
 
 
+# expressions whose listed child ordinals may be ARRAY-typed attribute
+# references (the consumer validates the element type itself); arrays are
+# otherwise rejected as expression leaves
+_ARRAY_ARG_OK: Dict[type, Tuple[int, ...]] = {}
+
+
+def _array_leaf_ok(e: E.Expression) -> Optional[str]:
+    from spark_rapids_tpu import typesig as TS
+    dt = e.data_type
+    if isinstance(dt.element_type, (T.ArrayType, T.MapType, T.StructType)):
+        return "nested-of-nested arrays run on CPU"
+    r = TS.common_tpu.support(dt.element_type)
+    if r:
+        return f"array element: {r}"
+    return None
+
+
+def _child_ok(parent: E.Expression, i: int, c: E.Expression,
+              conf) -> Optional[str]:
+    if i in _ARRAY_ARG_OK.get(type(parent), ()) and \
+            isinstance(c, (E.AttributeReference, E.BoundReference)) and \
+            isinstance(c.data_type, T.ArrayType):
+        return _array_leaf_ok(c)
+    return is_device_expr(c, conf)
+
+
 def is_device_expr(e: E.Expression, conf=None) -> Optional[str]:
     """None if the whole tree can run on device, else a reason string
     (the willNotWorkOnGpu message of the reference's tagging).
@@ -284,8 +317,8 @@ def is_device_expr(e: E.Expression, conf=None) -> Optional[str]:
         r = extra(e)
         if r:
             return r
-    for c in e.children:
-        r = is_device_expr(c, conf)
+    for i, c in enumerate(e.children):
+        r = _child_ok(e, i, c, conf)
         if r:
             return r
     return None
@@ -1304,6 +1337,13 @@ def _h_shift(e, ctx: Ctx) -> DeviceColumn:
     return _normalized(e.data_type, data, validity)
 
 
+@extra_check(E.Greatest, E.Least)
+def _c_greatest_least(e):
+    if isinstance(e.data_type, (T.StringType, T.BinaryType)):
+        return "greatest/least over strings runs on CPU"
+    return None
+
+
 @handles(E.Greatest, E.Least)
 def _h_greatest_least(e, ctx: Ctx) -> AnyDeviceColumn:
     """Null-skipping row-wise extreme; NaN ranks greatest (Spark)."""
@@ -2078,3 +2118,112 @@ def _h_xxhash64(e: E.XxHash64, ctx: Ctx) -> DeviceColumn:
     cols = [dev_eval(c, ctx) for c in e.children]
     h = hashing.xxhash64_columns(cols, ctx.capacity, e.seed)
     return DeviceColumn(T.LongT, h, jnp.ones(ctx.capacity, jnp.bool_))
+
+
+# ---------------------------------------------------------------------------
+# Collections (collectionOperations.scala twins over segmented arrays)
+# ---------------------------------------------------------------------------
+
+_ARRAY_ARG_OK.update({E.Size: (0,), E.ElementAt: (0,),
+                      E.GetArrayItem: (0,), E.ArrayContains: (0,)})
+
+
+@handles(E.Size)
+def _h_size(e: E.Size, ctx: Ctx) -> DeviceColumn:
+    c = dev_eval(e.children[0], ctx)
+    data = jnp.where(c.validity, c.lengths,
+                     jnp.int32(E.Size.LEGACY_NULL)).astype(jnp.int32)
+    return DeviceColumn(T.IntegerT, data,
+                        jnp.ones(ctx.capacity, dtype=jnp.bool_))
+
+
+@handles(E.ElementAt, E.GetArrayItem)
+def _h_element_at(e, ctx: Ctx) -> AnyDeviceColumn:
+    from spark_rapids_tpu.columnar.device import take_columns
+    ac = dev_eval(e.children[0], ctx)
+    ic = dev_eval(e.children[1], ctx)
+    idx = ic.data.astype(jnp.int32)
+    n = ac.lengths
+    if type(e) is E.GetArrayItem:  # 0-based ordinal
+        in_range = (idx >= 0) & (idx < n)
+        off = idx
+    else:  # 1-based, negative from the end
+        in_range = (idx != 0) & (jnp.abs(idx) <= n)
+        off = jnp.where(idx > 0, idx - 1, n + idx)
+    pool_cap = ac.child.capacity
+    src = jnp.clip(ac.starts + jnp.clip(off, 0, None), 0, pool_cap - 1)
+    valid = ac.validity & ic.validity & in_range
+    return take_columns([ac.child], src, valid_at=valid)[0]
+
+
+@extra_check(E.ArrayContains)
+def _c_array_contains(e: E.ArrayContains):
+    if not isinstance(e.children[1], E.Literal):
+        return ("array_contains with a non-literal search value runs "
+                "on CPU")
+    return None
+
+
+@handles(E.ArrayContains)
+def _h_array_contains(e: E.ArrayContains, ctx: Ctx) -> DeviceColumn:
+    """Literal search value: pool-wide equality + per-row slice counts
+    via prefix sums (scatter-free, layout-independent)."""
+    ac = dev_eval(e.children[0], ctx)
+    lit = e.children[1]
+    pool = ac.child
+    if lit.value is None:
+        z = jnp.zeros(ctx.capacity, dtype=jnp.bool_)
+        return DeviceColumn(T.BooleanT, z, z)
+    if isinstance(pool, DeviceStringColumn):
+        b = str(lit.value).encode("utf-8")
+        eq = pool.lengths == len(b)
+        for k, byte in enumerate(b):
+            if k < pool.char_cap:
+                eq = eq & (pool.chars[:, k] == byte)
+        if len(b) > pool.char_cap:
+            eq = eq & False
+    else:
+        target = ctx.literal_scalar(lit)
+        if target is None:
+            from spark_rapids_tpu.columnar.host import _to_storage
+            target = jnp.asarray(_to_storage(lit.value, lit.data_type),
+                                 dtype=pool.data.dtype)
+        eq = pool.data == target.astype(pool.data.dtype)
+    hit = eq & pool.validity
+    nulls = ~pool.validity
+    pref_hit = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                jnp.cumsum(hit.astype(jnp.int32))])
+    pref_null = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                 jnp.cumsum(nulls.astype(jnp.int32))])
+    lo = jnp.clip(ac.starts, 0, pool.capacity)
+    hi = jnp.clip(ac.starts + ac.lengths, 0, pool.capacity)
+    cnt = pref_hit[hi] - pref_hit[lo]
+    ncnt = pref_null[hi] - pref_null[lo]
+    found = cnt > 0
+    validity = ac.validity & (found | (ncnt == 0))
+    return _normalized(T.BooleanT, found, validity)
+
+
+@handles(E.CreateArray)
+def _h_create_array(e: E.CreateArray, ctx: Ctx) -> AnyDeviceColumn:
+    from spark_rapids_tpu.columnar.device import DeviceArrayColumn
+    cols = [dev_eval(c, ctx) for c in e.children]
+    k = len(cols)
+    cap = ctx.capacity
+    et = e.data_type.element_type
+    if isinstance(cols[0], DeviceStringColumn):
+        cc = max(c.char_cap for c in cols)
+        chars = jnp.stack([_pad_chars(c, cc) for c in cols],
+                          axis=1).reshape(cap * k, cc)
+        lens = jnp.stack([c.lengths for c in cols], axis=1).reshape(-1)
+        ev = jnp.stack([c.validity for c in cols], axis=1).reshape(-1)
+        pool: AnyDeviceColumn = DeviceStringColumn(et, chars, lens, ev)
+    else:
+        data = jnp.stack([c.data for c in cols], axis=1).reshape(-1)
+        ev = jnp.stack([c.validity for c in cols], axis=1).reshape(-1)
+        pool = DeviceColumn(et, jnp.where(ev, data,
+                                          _zero(data.dtype)), ev)
+    starts = (jnp.arange(cap, dtype=jnp.int32) * k)
+    lengths = jnp.full(cap, k, dtype=jnp.int32)
+    validity = jnp.ones(cap, dtype=jnp.bool_)
+    return DeviceArrayColumn(e.data_type, starts, lengths, pool, validity)

@@ -80,6 +80,17 @@ expr_rule(E.StringRPad, incompat="byte-counted padding is exact only "
           "for ASCII strings")
 expr_rule(E.StringReverse, incompat="byte reversal is exact only for "
           "ASCII strings")
+# array consumers/producers: the array side of their signature is nested
+expr_rule(E.Size, checks=TS.expr_checks(TS.common_tpu,
+                                        TS.common_tpu_nested))
+expr_rule(E.ElementAt, checks=TS.expr_checks(TS.common_tpu,
+                                             TS.common_tpu_nested))
+expr_rule(E.GetArrayItem, checks=TS.expr_checks(TS.common_tpu,
+                                                TS.common_tpu_nested))
+expr_rule(E.ArrayContains, checks=TS.expr_checks(TS.common_tpu,
+                                                 TS.common_tpu_nested))
+expr_rule(E.CreateArray, checks=TS.expr_checks(TS.common_tpu_nested,
+                                               TS.common_tpu))
 
 # leaves that are valid in any device expression tree without a handler
 _LEAF_OK = (E.AttributeReference,)
@@ -113,7 +124,14 @@ def check_expr_tree(e: E.Expression, conf: TpuConf) -> Optional[str]:
         r = extra(e)
         if r:
             return f"expression {type(e).__name__}: {r}"
-    for c in e.children:
+    for i, c in enumerate(e.children):
+        if i in X._ARRAY_ARG_OK.get(type(e), ()) and \
+                isinstance(c, E.AttributeReference) and \
+                isinstance(c.data_type, T.ArrayType):
+            r = X._array_leaf_ok(c)
+            if r:
+                return f"expression {type(e).__name__}: {r}"
+            continue
         r = check_expr_tree(c, conf)
         if r:
             return r
@@ -131,6 +149,9 @@ class ExecRule:
     checks: TS.ExecChecks
     tag_fn: Optional[Callable[["ExecMeta"], None]] = None
     convert_fn: Optional[Callable] = None  # (meta, device_children) -> plan
+    # types the exec can CONSUME (child outputs); project/filter/generate
+    # pass nested columns through, the heavy operators do not
+    input_sig: Optional[TS.TypeSig] = None
 
     @property
     def conf_key(self) -> str:
@@ -142,10 +163,10 @@ _EXEC_RULES: Dict[Type, ExecRule] = {}
 
 def exec_rule(cls: Type, desc: str,
               checks: Optional[TS.ExecChecks] = None,
-              tag_fn=None, convert_fn=None) -> None:
+              tag_fn=None, convert_fn=None, input_sig=None) -> None:
     _EXEC_RULES[cls] = ExecRule(cls.__name__.replace("Cpu", ""), desc,
                                 checks or TS.ExecChecks(TS.common_tpu),
-                                tag_fn, convert_fn)
+                                tag_fn, convert_fn, input_sig)
 
 
 # CPU data sources that legitimately feed the device through a
@@ -197,8 +218,9 @@ class ExecMeta:
         if r:
             self.will_not_work(r)
         # inputs must be representable too (transitions carry data)
+        in_sig = self.rule.input_sig or TS.common_tpu
         for c in self.wrapped.children:
-            r = TS.common_tpu.supports_all(
+            r = in_sig.supports_all(
                 [f.data_type for f in c.schema.fields])
             if r:
                 self.will_not_work(f"input: {r}")
@@ -445,10 +467,31 @@ def _conv_broadcast_join(meta, kids):
                                     meta.conf)
 
 
+def _tag_generate(meta: ExecMeta) -> None:
+    from spark_rapids_tpu.exec.generate import is_device_generate
+    r = is_device_generate(meta.wrapped.generator, meta.conf)
+    if r:
+        meta.will_not_work(r)
+
+
+def _conv_generate(meta, kids):
+    from spark_rapids_tpu.exec.generate import TpuGenerateExec
+    w = meta.wrapped
+    return TpuGenerateExec(w.generator, w.gen_output, kids[0], meta.conf)
+
+
 exec_rule(P.CpuProjectExec, "projection onto device columns",
-          tag_fn=_tag_project, convert_fn=_conv_project)
+          checks=TS.ExecChecks(TS.common_tpu_nested),
+          tag_fn=_tag_project, convert_fn=_conv_project,
+          input_sig=TS.common_tpu_nested)
 exec_rule(P.CpuFilterExec, "device predicate filter (mask update)",
-          tag_fn=_tag_filter, convert_fn=_conv_filter)
+          checks=TS.ExecChecks(TS.common_tpu_nested),
+          tag_fn=_tag_filter, convert_fn=_conv_filter,
+          input_sig=TS.common_tpu_nested)
+exec_rule(P.CpuGenerateExec, "device explode over segmented arrays",
+          checks=TS.ExecChecks(TS.common_tpu_nested),
+          tag_fn=_tag_generate, convert_fn=_conv_generate,
+          input_sig=TS.common_tpu_nested)
 exec_rule(P.CpuRangeExec, "device iota range source",
           convert_fn=_conv_range)
 exec_rule(P.CpuUnionExec, "union of device partitions",

@@ -70,7 +70,8 @@ class DataFrame:
                 items.extend(self.plan.output)
                 continue
             e = self._resolve(c)
-            if not isinstance(e, (E.AttributeReference, E.Alias)):
+            if not isinstance(e, (E.AttributeReference, E.Alias)) and \
+                    not getattr(e, "is_generator", False):
                 e = E.Alias(e, _auto_name(e))
             items.append(e)
         return DataFrame(self._project_plan(items), self.session)
@@ -78,7 +79,27 @@ class DataFrame:
     def _project_plan(self, items: List[E.Expression]) -> L.LogicalPlan:
         """Project, extracting window expressions into L.Window nodes
         grouped by (partition, order) spec — the analyzer's
-        ExtractWindowExpressions role."""
+        ExtractWindowExpressions role — and generators (explode/
+        posexplode) into L.Generate (ExtractGenerator role)."""
+        gens = [e for e in items
+                if e.collect(lambda x: getattr(x, "is_generator", False))]
+        if gens:
+            assert len(gens) == 1, \
+                "only one generator per select clause is allowed"
+            item = gens[0]
+            gen = (item.child if isinstance(item, E.Alias) else item)
+            assert getattr(gen, "is_generator", False), \
+                "generators must be top-level select items"
+            col_name = item.name if isinstance(item, E.Alias) else "col"
+            gen_out = gen.generator_output(col_name)
+            child = L.Generate(gen, gen_out, self.plan)
+            new_items: List[E.Expression] = []
+            for e in items:
+                if e is item:
+                    new_items.extend(gen_out)
+                else:
+                    new_items.append(e)
+            return L.Project(new_items, child)
         if not any(e.collect(lambda x: isinstance(x, E.WindowExpression))
                    for e in items):
             return L.Project(items, self.plan)

@@ -2233,6 +2233,185 @@ def _hash_column(c: HostColumn, seed: np.ndarray) -> np.ndarray:
     return np.where(c.validity, h, seed)
 
 
+# ---------------------------------------------------------------------------
+# Collections (collectionOperations.scala, complexTypeCreator/Extractor
+# twins) + generators (GpuGenerateExec.scala:440)
+# ---------------------------------------------------------------------------
+
+class CreateArray(Expression):
+    """array(e1, e2, ...): never null; null inputs become null elements."""
+
+    def __init__(self, children: List[Expression]):
+        self.children = list(children)
+
+    @property
+    def data_type(self) -> T.DataType:
+        et = self.children[0].data_type if self.children else T.NullT
+        return T.ArrayType(et)
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        cols = [c.eval(batch) for c in self.children]
+        out = np.empty(batch.num_rows, dtype=object)
+        for i in range(batch.num_rows):
+            out[i] = tuple(
+                (c.data[i].item() if isinstance(c.data[i], np.generic)
+                 else c.data[i]) if c.validity[i] else None
+                for c in cols)
+        return HostColumn(self.data_type, out,
+                          np.ones(batch.num_rows, dtype=bool))
+
+
+class Size(UnaryExpression):
+    """size(array): element count; null input -> -1 (legacy Spark
+    default spark.sql.legacy.sizeOfNull=true semantics)."""
+
+    LEGACY_NULL = -1
+
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.IntegerT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.child.eval(batch)
+        out = np.full(len(c.data), self.LEGACY_NULL, dtype=np.int32)
+        for i in range(len(c.data)):
+            if c.validity[i]:
+                out[i] = len(c.data[i])
+        return HostColumn.all_valid(out, T.IntegerT)
+
+
+class ElementAt(BinaryExpression):
+    """element_at(array, i): 1-based, negative from the end; null when
+    out of range (non-ANSI)."""
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = [left, right]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.left.data_type.element_type
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        ac, ic = self.left.eval(batch), self.right.eval(batch)
+        n = len(ac.data)
+        np_dt = T.numpy_dtype(self.data_type)
+        validity = np.zeros(n, dtype=bool)
+        fill = "" if np_dt == np.dtype(object) else _zero_for_np(np_dt)
+        data = np.full(n, fill, dtype=np_dt)
+        for i in range(n):
+            if not (ac.validity[i] and ic.validity[i]):
+                continue
+            arr, idx = ac.data[i], int(ic.data[i])
+            if idx == 0 or abs(idx) > len(arr):
+                continue
+            v = arr[idx - 1] if idx > 0 else arr[idx]
+            if v is not None:
+                validity[i] = True
+                data[i] = v
+        return HostColumn(self.data_type, data, validity).normalized()
+
+
+class GetArrayItem(ElementAt):
+    """array[i]: 0-based ordinal access (null when out of range)."""
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        ac, ic = self.left.eval(batch), self.right.eval(batch)
+        n = len(ac.data)
+        np_dt = T.numpy_dtype(self.data_type)
+        validity = np.zeros(n, dtype=bool)
+        fill = "" if np_dt == np.dtype(object) else _zero_for_np(np_dt)
+        data = np.full(n, fill, dtype=np_dt)
+        for i in range(n):
+            if not (ac.validity[i] and ic.validity[i]):
+                continue
+            arr, idx = ac.data[i], int(ic.data[i])
+            if idx < 0 or idx >= len(arr):
+                continue
+            v = arr[idx]
+            if v is not None:
+                validity[i] = True
+                data[i] = v
+        return HostColumn(self.data_type, data, validity).normalized()
+
+
+class ArrayContains(BinaryExpression):
+    """array_contains(array, value): 3-valued like IN (null when absent
+    but null elements exist)."""
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = [left, right]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.BooleanT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        ac, vc = self.left.eval(batch), self.right.eval(batch)
+        n = len(ac.data)
+        validity = np.zeros(n, dtype=bool)
+        data = np.zeros(n, dtype=bool)
+        for i in range(n):
+            if not (ac.validity[i] and vc.validity[i]):
+                continue
+            arr = ac.data[i]
+            target = vc.data[i]
+            if isinstance(target, np.generic):
+                target = target.item()
+            found = any(x is not None and x == target for x in arr)
+            has_null = any(x is None for x in arr)
+            if found:
+                validity[i], data[i] = True, True
+            elif not has_null:
+                validity[i] = True
+        return HostColumn(T.BooleanT, data, validity).normalized()
+
+
+def _zero_for_np(np_dt) -> Any:
+    if np_dt == np.dtype(bool):
+        return False
+    if np.issubdtype(np_dt, np.floating):
+        return 0.0
+    return 0
+
+
+class Explode(UnaryExpression):
+    """Generator: one output row per array element (GpuGenerateExec
+    role). ``position`` adds the pos column (posexplode); ``outer``
+    keeps empty/null arrays as one null row."""
+
+    is_generator = True
+
+    def __init__(self, child: Expression, position: bool = False,
+                 outer: bool = False):
+        self.children = [child]
+        self.position = position
+        self.outer = outer
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.child.data_type.element_type
+
+    def generator_output(self, col_name: str = "col"
+                         ) -> List["AttributeReference"]:
+        out = []
+        if self.position:
+            out.append(AttributeReference("pos", T.IntegerT,
+                                          nullable=False))
+        out.append(AttributeReference(col_name, self.data_type))
+        return out
+
+
 class XxHash64(Expression):
     """Spark XxHash64(seed=42L) over columns left-to-right (reference:
     GpuXxHash64, HashFunctions.scala); device twin in ops/hashing.py."""

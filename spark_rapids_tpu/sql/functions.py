@@ -103,6 +103,12 @@ class Column:
                   and isinstance(values[0], (list, tuple)) else values)]
         return Column(E.In(self.expr, items))
 
+    def getItem(self, key) -> "Column":
+        return Column(E.GetArrayItem(self.expr, _to_expr(key)))
+
+    def __getitem__(self, key) -> "Column":
+        return self.getItem(key)
+
     def bitwiseAND(self, other) -> "Column":
         return Column(E.BitwiseAnd(self.expr, _to_expr(other)))
 
@@ -242,6 +248,8 @@ def _parse_type(dt: Union[T.DataType, str]) -> T.DataType:
             p, sc = inner.split(",")
             return T.DecimalType(int(p), int(sc))
         return T.DecimalType(10, 0)
+    if s.startswith("array<") and s.endswith(">"):
+        return T.ArrayType(_parse_type(s[6:-1]))
     raise ValueError(f"unknown type string {dt!r}")
 
 
@@ -455,6 +463,39 @@ def hash(*cols) -> Column:  # noqa: A001
 
 def xxhash64(*cols) -> Column:
     return Column(E.XxHash64([_to_col_expr(c) for c in cols]))
+
+
+# collections / generators
+def array(*cols) -> Column:
+    return Column(E.CreateArray([_to_col_expr(c) for c in cols]))
+
+
+def size(c) -> Column:
+    return Column(E.Size(_to_col_expr(c)))
+
+
+def element_at(c, idx) -> Column:
+    return Column(E.ElementAt(_to_col_expr(c), _to_expr(idx)))
+
+
+def array_contains(c, value) -> Column:
+    return Column(E.ArrayContains(_to_col_expr(c), _to_expr(value)))
+
+
+def explode(c) -> Column:
+    return Column(E.Explode(_to_col_expr(c)))
+
+
+def explode_outer(c) -> Column:
+    return Column(E.Explode(_to_col_expr(c), outer=True))
+
+
+def posexplode(c) -> Column:
+    return Column(E.Explode(_to_col_expr(c), position=True))
+
+
+def posexplode_outer(c) -> Column:
+    return Column(E.Explode(_to_col_expr(c), position=True, outer=True))
 
 
 # bitwise

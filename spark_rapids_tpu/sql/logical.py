@@ -275,6 +275,30 @@ class Repartition(LogicalPlan):
         return self.child.output
 
 
+class Generate(LogicalPlan):
+    """Generator application: child rows x generator output
+    (Spark Generate / GpuGenerateExec.scala:440 logical twin). Output =
+    child output + the generator's attributes (pre-allocated so
+    downstream references bind by expr_id)."""
+
+    def __init__(self, generator: Expression,
+                 gen_output: List[AttributeReference], child: LogicalPlan):
+        self.children = [child]
+        self.generator = generator
+        self.gen_output = gen_output
+
+    @property
+    def child(self) -> LogicalPlan:
+        return self.children[0]
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return list(self.child.output) + list(self.gen_output)
+
+    def simple_string(self) -> str:
+        return f"Generate {self.generator!r}"
+
+
 class Expand(LogicalPlan):
     """Grouping-sets expansion (GpuExpandExec's logical twin)."""
 

@@ -667,6 +667,10 @@ _FUNCTIONS = {
     "dayofmonth": F.dayofmonth, "hour": F.hour, "minute": F.minute,
     "second": F.second, "date_add": F.date_add, "date_sub": F.date_sub,
     "datediff": F.datediff, "hash": F.hash, "xxhash64": F.xxhash64,
+    "array": F.array, "size": F.size, "element_at": F.element_at,
+    "array_contains": F.array_contains, "explode": F.explode,
+    "explode_outer": F.explode_outer, "posexplode": F.posexplode,
+    "posexplode_outer": F.posexplode_outer,
     "shiftleft": F.shiftleft, "shiftright": F.shiftright,
     "shiftrightunsigned": F.shiftrightunsigned,
     "log2": F.log2, "log1p": F.log1p, "expm1": F.expm1, "cbrt": F.cbrt,

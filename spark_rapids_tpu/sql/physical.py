@@ -226,6 +226,84 @@ class CpuProjectExec(PhysicalPlan):
         return f"Project {self.project_list}"
 
 
+class CpuGenerateExec(PhysicalPlan):
+    """Explode/posexplode (+outer): child rows repeated per array
+    element, with pos/col generated columns (GpuGenerateExec.scala:440
+    CPU oracle)."""
+
+    def __init__(self, generator: E.Expression,
+                 gen_output: List[E.AttributeReference],
+                 child: PhysicalPlan):
+        self.children = [child]
+        self.generator = generator
+        self.gen_output = gen_output
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def output(self):
+        return list(self.child.output) + list(self.gen_output)
+
+    def partitions(self) -> List[PartitionThunk]:
+        import numpy as np
+        gen = self.generator
+        bound = E.bind_references(gen.children[0], self.child.output)
+        schema = self.schema
+        elem_t = gen.data_type
+        np_elem = T.numpy_dtype(elem_t)
+
+        def explode_batch(b: HostBatch) -> HostBatch:
+            arr_col = bound.eval(b)
+            counts = np.zeros(b.num_rows, dtype=np.int64)
+            for i in range(b.num_rows):
+                if arr_col.validity[i]:
+                    counts[i] = len(arr_col.data[i])
+            if gen.outer:
+                counts = np.maximum(counts, 1)
+            parent = np.repeat(np.arange(b.num_rows), counts)
+            total = int(counts.sum())
+            pos = np.zeros(total, dtype=np.int32)
+            # outer's pad rows carry NULL in every generated column,
+            # pos included (Spark Generate outer join semantics)
+            is_real = np.zeros(total, dtype=bool)
+            if np_elem == np.dtype(object):
+                elems = np.full(total, "", dtype=object)
+            else:
+                elems = np.zeros(total, dtype=np_elem)
+            evalid = np.zeros(total, dtype=bool)
+            o = 0
+            for i in range(b.num_rows):
+                n = int(counts[i])
+                if n == 0:
+                    continue
+                row = (arr_col.data[i] if arr_col.validity[i] else ())
+                for j in range(len(row)):
+                    pos[o + j] = j
+                    is_real[o + j] = True
+                    if row[j] is not None:
+                        elems[o + j] = row[j]
+                        evalid[o + j] = True
+                o += n
+            from spark_rapids_tpu.columnar.host import HostColumn
+            cols = [c.take(parent) for c in b.columns]
+            if gen.position:
+                cols.append(HostColumn(T.IntegerT, pos, is_real.copy()))
+            cols.append(HostColumn(elem_t, elems, evalid).normalized())
+            return HostBatch(schema, cols, total)
+
+        def make(thunk: PartitionThunk) -> PartitionThunk:
+            def run() -> Iterator[HostBatch]:
+                for b in thunk():
+                    yield explode_batch(b)
+            return run
+        return [make(t) for t in self.child.partitions()]
+
+    def simple_string(self):
+        return f"Generate {self.generator!r}"
+
+
 class CpuFilterExec(PhysicalPlan):
     def __init__(self, condition: E.Expression, child: PhysicalPlan):
         self.children = [child]

@@ -44,11 +44,23 @@ class TpuSparkSession:
 
     def __init__(self, conf: Optional[Dict[str, Any]] = None):
         self.conf_obj = TpuConf(conf)
+        self._owns_mesh = False
         if self.conf_obj.sql_enabled:
             import spark_rapids_tpu
             from spark_rapids_tpu import device_manager
             device_manager.initialize(self.conf_obj)
             spark_rapids_tpu._enable_compile_cache()
+            from spark_rapids_tpu.conf import (SHUFFLE_ICI_DEVICES,
+                                               SHUFFLE_MODE)
+            if str(self.conf_obj.get(SHUFFLE_MODE)).lower() == "ici":
+                # executor-plugin-init analogue: activate the shuffle
+                # mesh once per session (GpuShuffleEnv.initShuffleManager
+                # role; jax already knows the topology)
+                from spark_rapids_tpu.parallel import mesh as PM
+                if PM.get_active_mesh() is None:
+                    n = int(self.conf_obj.get(SHUFFLE_ICI_DEVICES)) or None
+                    PM.set_active_mesh(PM.build_mesh(n))
+                    self._owns_mesh = True
         self.conf = RuntimeConfApi(self.conf_obj)
         self.catalog_views: Dict[str, L.LogicalPlan] = {}
         self._plan_capture: List = []  # ExecutionPlanCaptureCallback twin
@@ -133,9 +145,23 @@ class TpuSparkSession:
         return physical
 
     def execute_plan(self, plan: L.LogicalPlan) -> HostBatch:
-        from spark_rapids_tpu.conf import TASK_PARALLELISM
-        return self.plan_physical(plan).execute_collect(
+        import time as _time
+
+        from spark_rapids_tpu.conf import EVENT_LOG_DIR, TASK_PARALLELISM
+        physical = self.plan_physical(plan)
+        t0 = _time.perf_counter()
+        result = physical.execute_collect(
             int(self.conf_obj.get(TASK_PARALLELISM)))
+        log_dir = str(self.conf_obj.get(EVENT_LOG_DIR))
+        if log_dir:
+            from spark_rapids_tpu import event_log, memory
+            store = memory._STORE
+            event_log.write_event(
+                log_dir, id(self) & 0xFFFF, physical,
+                self.last_rewrite_report,
+                _time.perf_counter() - t0, result.num_rows,
+                store.stats() if store is not None else None)
+        return result
 
     def explain_string(self, plan: L.LogicalPlan, physical=None) -> str:
         if physical is None:
@@ -152,6 +178,10 @@ class TpuSparkSession:
         return list(self._plan_capture)
 
     def stop(self) -> None:
+        if self._owns_mesh:
+            from spark_rapids_tpu.parallel import mesh as PM
+            PM.set_active_mesh(None)
+            self._owns_mesh = False
         with TpuSparkSession._lock:
             if TpuSparkSession._active is self:
                 TpuSparkSession._active = None

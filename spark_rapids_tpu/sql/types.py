@@ -266,6 +266,9 @@ def numpy_dtype(dt: DataType) -> np.dtype:
         raise TypeError(f"decimal > 18 digits not fixed-width-64: {dt}")
     if isinstance(dt, (StringType, BinaryType)):
         return np.dtype(object)
+    if isinstance(dt, (ArrayType, MapType, StructType)):
+        # host representation: object array of python lists/dicts/tuples
+        return np.dtype(object)
     if isinstance(dt, NullType):
         return np.dtype(np.int8)
     nd = getattr(dt, "np_dtype", None)

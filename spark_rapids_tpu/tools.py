@@ -110,20 +110,116 @@ def profile(session, df) -> ProfileReport:
     return out
 
 
+# -- offline (event-log) tools ---------------------------------------------
+# (Qualification.scala:34 / Profiler.scala:31 roles: score and profile a
+# PAST workload from its logs, no live session required)
+
+def qualify_log(log_path: str) -> str:
+    """Score logged queries for device suitability: per-query operator
+    coverage + a histogram of fallback reasons."""
+    from spark_rapids_tpu.event_log import read_events
+    lines = ["=== TPU Qualification Report (offline) ===",
+             f"log: {log_path}", ""]
+    reason_counts: Dict[str, int] = {}
+    n_q = 0
+    covs: List[float] = []
+    for ev in read_events(log_path):
+        if ev.get("event") != "queryCompleted":
+            continue
+        n_q += 1
+        ops = ev.get("ops", [])
+        rated = [o for o in ops
+                 if not o["op"].startswith(("TpuRowToColumnar",
+                                            "TpuColumnarToRow"))]
+        dev = sum(1 for o in rated if o.get("device"))
+        total = len(rated) or 1
+        cov = dev / total
+        covs.append(cov)
+        lines.append(f"query {ev.get('queryId')}: "
+                     f"{cov:.0%} of operators on TPU, "
+                     f"{ev.get('wallSeconds', 0):.3f}s, "
+                     f"{ev.get('outputRows', 0)} rows")
+        for fb in ev.get("fallbacks", []):
+            for r in fb.get("reasons", []):
+                reason_counts[r] = reason_counts.get(r, 0) + 1
+    if not n_q:
+        lines.append("no queryCompleted events found")
+        return "\n".join(lines)
+    score = sum(covs) / len(covs)
+    lines += ["", f"queries: {n_q}",
+              f"mean operator coverage: {score:.0%}",
+              ("recommendation: ACCELERATE" if score >= 0.5 else
+               "recommendation: investigate fallbacks first")]
+    if reason_counts:
+        lines += ["", "fallback reasons (by frequency):"]
+        for r, c in sorted(reason_counts.items(), key=lambda kv: -kv[1]):
+            lines.append(f"  {c:4d}x {r}")
+    return "\n".join(lines)
+
+
+def profile_log(log_path: str) -> str:
+    """Aggregate per-operator metrics + a text timeline across logged
+    queries (GenerateTimeline.scala's role, in text)."""
+    from spark_rapids_tpu.event_log import read_events
+    lines = ["=== TPU Profile Report (offline) ===",
+             f"log: {log_path}", ""]
+    op_metrics: Dict[str, Dict[str, int]] = {}
+    events = [ev for ev in read_events(log_path)
+              if ev.get("event") == "queryCompleted"]
+    if not events:
+        lines.append("no queryCompleted events found")
+        return "\n".join(lines)
+    t0 = min(ev["ts"] - ev.get("wallSeconds", 0) for ev in events)
+    span = max(max(ev["ts"] for ev in events) - t0, 1e-9)
+    lines.append("timeline (each bar spans the query's wall time):")
+    width = 50
+    for ev in events:
+        start = ev["ts"] - ev.get("wallSeconds", 0) - t0
+        dur = ev.get("wallSeconds", 0)
+        a = int(start / span * width)
+        b = max(a + 1, int((start + dur) / span * width))
+        bar = " " * a + "#" * (b - a)
+        lines.append(f"  q{ev.get('queryId'):>3} |{bar:<{width}}| "
+                     f"{dur:.3f}s")
+        for o in ev.get("ops", []):
+            for k, v in o.get("metrics", {}).items():
+                d = op_metrics.setdefault(o["op"], {})
+                d[k] = d.get(k, 0) + v
+        st = ev.get("storeStats")
+        if st and st.get("spillCount"):
+            lines.append(f"       spills: {st['spillCount']} "
+                         f"({st.get('spilledDeviceBytes', 0)} bytes)")
+    lines += ["", "aggregate operator metrics:"]
+    for op, ms in sorted(op_metrics.items()):
+        lines.append(f"  {op}")
+        for k, v in sorted(ms.items()):
+            lines.append(f"      {k}: {v}")
+    return "\n".join(lines)
+
+
 def _main(argv: List[str]) -> int:
     import argparse
-
-    from spark_rapids_tpu.sql.session import TpuSparkSession
 
     ap = argparse.ArgumentParser(
         prog="spark_rapids_tpu.tools",
         description="TPU qualification/profiling tools")
     ap.add_argument("command", choices=["qualify", "profile"])
-    ap.add_argument("sql", help="SQL text to analyze")
+    ap.add_argument("sql", nargs="?", help="SQL text to analyze (live "
+                    "mode; omit when using --log)")
     ap.add_argument("--view", action="append", default=[],
                     help="name=path parquet view registrations")
+    ap.add_argument("--log", help="offline mode: event-log file or "
+                    "directory (spark.rapids.sql.eventLog.dir output)")
     args = ap.parse_args(argv)
 
+    if args.log:
+        print(qualify_log(args.log) if args.command == "qualify"
+              else profile_log(args.log))
+        return 0
+    if not args.sql:
+        ap.error("provide SQL text or --log <path>")
+
+    from spark_rapids_tpu.sql.session import TpuSparkSession
     spark = TpuSparkSession({"spark.rapids.sql.enabled": "true"})
     try:
         for v in args.view:

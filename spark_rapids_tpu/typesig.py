@@ -97,6 +97,10 @@ class TypeSig:
             return None
         if tag not in self.tags:
             return f"{tag.lower()} is not supported"
+        if tag == ARRAY:
+            r = self.support(dt.element_type)
+            if r:
+                return f"array element: {r}"
         return None
 
     def supports_all(self, dts) -> Optional[str]:
@@ -124,6 +128,9 @@ ordered = comparable
 # matrices, no nested types yet) — the `commonCudfTypes` analogue
 common_tpu = numeric + _sig(BOOLEAN, DATE, TIMESTAMP, STRING, BINARY)
 common_tpu_with_null = common_tpu + _sig(NULL)
+# transitional operators (project/filter/generate/transitions) can CARRY
+# array columns whose elements are common; the heavy operators cannot
+common_tpu_nested = common_tpu + _sig(ARRAY)
 all_types = common_tpu + DECIMAL_128 + _sig(NULL, ARRAY, MAP, STRUCT)
 
 

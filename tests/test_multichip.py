@@ -139,3 +139,64 @@ def test_mesh_matches_inprocess_path(mesh8):
         assert_tpu_and_cpu_equal_collect(q)
     # no mesh: in-process path
     assert_tpu_and_cpu_equal_collect(q)
+
+
+def test_shuffle_mode_ici_conf_activates_mesh():
+    """spark.rapids.shuffle.mode=ici wires the mesh at SESSION start —
+    no test-side active_mesh — and the exchange takes the ICI path
+    (RapidsShuffleManager configuration wiring, GpuShuffleEnv.scala:26
+    role)."""
+    from spark_rapids_tpu.parallel.mesh import get_active_mesh
+    from tests.harness import assert_tpu_and_cpu_equal_collect
+    from tests.datagen import LongGen, SmallIntGen, gen_batch
+    from spark_rapids_tpu.sql import functions as F
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+
+    assert get_active_mesh() is None
+    conf = {
+        "spark.rapids.sql.enabled": "true",
+        "spark.rapids.shuffle.mode": "ici",
+    }
+    spark = TpuSparkSession(conf)
+    try:
+        assert get_active_mesh() is not None
+        spark.start_capture()
+        df = spark.createDataFrame(
+            gen_batch([("k", SmallIntGen()), ("v", LongGen())], 3000, 77),
+            num_partitions=4)
+        got = (df.groupBy("k")
+               .agg(F.sum("v").alias("s"), F.count("*").alias("c"))
+               .collect())
+        plans = spark.get_captured_plans()
+        ici = 0
+        def walk(p):
+            nonlocal ici
+            m = getattr(p, "metrics", None)
+            if m is not None:
+                ici += m.metrics["numIciExchanges"].value \
+                    if "numIciExchanges" in m.metrics else 0
+            for c in getattr(p, "children", []):
+                walk(c)
+        for p in plans:
+            walk(p)
+        assert ici > 0, "exchange did not take the ICI path"
+    finally:
+        spark.stop()
+    assert get_active_mesh() is None  # stop() tears the mesh down
+
+    cpu = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        df = cpu.createDataFrame(
+            gen_batch([("k", SmallIntGen()), ("v", LongGen())], 3000, 77),
+            num_partitions=4)
+        want = (df.groupBy("k")
+                .agg(F.sum("v").alias("s"), F.count("*").alias("c"))
+                .collect())
+    finally:
+        cpu.stop()
+    def canon(rows):
+        return sorted((tuple(r) for r in rows),
+                      key=lambda t: tuple((v is None,
+                                           0 if v is None else v)
+                                          for v in t))
+    assert canon(got) == canon(want)

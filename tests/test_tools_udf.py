@@ -91,3 +91,49 @@ def test_rollup_agg_over_grouping_column():
         assert rows == {(1, 1), (2, 2), (None, None)}
     finally:
         s.stop()
+
+
+def test_event_log_and_offline_tools(tmp_path):
+    """Per-query event logs + offline qualify/profile with NO live
+    session (Qualification.scala:34 / Profiler.scala:31 roles)."""
+    import subprocess
+    import sys
+
+    from spark_rapids_tpu import event_log
+    from spark_rapids_tpu.sql import functions as F
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    from spark_rapids_tpu import tools
+
+    log_dir = str(tmp_path / "events")
+    spark = TpuSparkSession({
+        "spark.rapids.sql.enabled": "true",
+        "spark.rapids.sql.eventLog.dir": log_dir,
+    })
+    try:
+        df = spark.createDataFrame(
+            {"k": [1, 2, 1, 3], "v": [10, 20, 30, 40]},
+            "k int, v bigint")
+        df.groupBy("k").agg(F.sum("v").alias("s")).collect()
+        df.filter(F.col("v") > 15).collect()
+    finally:
+        spark.stop()
+
+    events = list(event_log.read_events(log_dir))
+    assert len(events) == 2
+    assert all(e["event"] == "queryCompleted" for e in events)
+    assert events[0]["outputRows"] == 3
+    assert any(o.get("device") for o in events[0]["ops"])
+
+    q = tools.qualify_log(log_dir)
+    assert "queries: 2" in q and "operator coverage" in q
+    p = tools.profile_log(log_dir)
+    assert "timeline" in p and "aggregate operator metrics" in p
+
+    # CLI entry, offline (no session)
+    out = subprocess.run(
+        [sys.executable, "-m", "spark_rapids_tpu.tools", "qualify",
+         "--log", log_dir],
+        capture_output=True, text=True, timeout=120,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr
+    assert "Qualification Report (offline)" in out.stdout
