@@ -26,7 +26,9 @@ ride the same device_put as extra raw buffers.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+import threading
+from collections import OrderedDict
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -181,7 +183,12 @@ def pack_batch(batch) -> Tuple[np.ndarray, List[np.ndarray], Tuple]:
 
 # -- device-side decode ----------------------------------------------------
 
-_DECODE_CACHE: Dict[Tuple, Callable] = {}
+# Bounded LRU: every distinct (layout, n, cap, nbytes) compiles its own
+# decode program; long sessions with varying batch sizes must not retain
+# them all.
+_DECODE_CACHE: "OrderedDict[Tuple, Callable]" = OrderedDict()
+_DECODE_CACHE_MAX = 64
+_DECODE_CACHE_LOCK = threading.Lock()
 
 
 def _pad_cap(x: jax.Array, n: int, cap: int) -> jax.Array:
@@ -344,10 +351,16 @@ def upload_batch(batch, cap: int, device: Optional[jax.Device] = None):
         return _direct_upload(batch, cap, device)
     words, extras, layout = pack_batch(batch)
     key = (layout, n, cap, words.nbytes)
-    fn = _DECODE_CACHE.get(key)
+    with _DECODE_CACHE_LOCK:
+        fn = _DECODE_CACHE.get(key)
+        if fn is not None:
+            _DECODE_CACHE.move_to_end(key)
     if fn is None:
         fn = _build_decode(layout, n, cap)
-        _DECODE_CACHE[key] = fn
+        with _DECODE_CACHE_LOCK:
+            _DECODE_CACHE[key] = fn
+            while len(_DECODE_CACHE) > _DECODE_CACHE_MAX:
+                _DECODE_CACHE.popitem(last=False)
     bufs = [words] + extras
     if device is not None:
         dev = jax.device_put(bufs, device)

@@ -1485,8 +1485,11 @@ class StringTranslate(Expression):
             if not validity[i]:
                 continue
             m, r = cols[1].data[i], cols[2].data[i]
-            table = {ord(ch): (r[j] if j < len(r) else None)
-                     for j, ch in enumerate(m)}
+            # first occurrence of a duplicated matching char wins
+            # (Spark/Hive semantics; mirrors the device kernel)
+            table = {}
+            for j, ch in enumerate(m):
+                table.setdefault(ord(ch), r[j] if j < len(r) else None)
             out[i] = cols[0].data[i].translate(table)
         return HostColumn(T.StringT, out, validity)
 

@@ -130,6 +130,17 @@ def test_translate_replace():
         expect_execs=["TpuProject"])
 
 
+def test_translate_duplicate_matching_chars():
+    """First occurrence of a duplicated char in `matching` wins
+    (Spark/Hive semantics) on both engines: translate('aab','aba','xyz')
+    must be 'xxy' everywhere."""
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: _df(s, [("a", ASCII_GEN)])
+        .select(F.translate(F.col("a"), "aba", "xyz").alias("tr"),
+                F.translate(F.col("a"), "aa", "xy").alias("tr2")),
+        expect_execs=["TpuProject"])
+
+
 def test_instr_locate():
     assert_tpu_and_cpu_equal_collect(
         lambda s: _df(s, [("a", ASCII_GEN)])
