@@ -653,24 +653,41 @@ def compact(batch: DeviceBatch) -> DeviceBatch:
 _SHRINK_CACHE: dict = {}
 
 
-def shrink_to_bucket(batch: DeviceBatch) -> DeviceBatch:
-    """Compact, then if the active count fits a smaller capacity bucket,
-    slice down to it (keeps shuffle payloads tight). Compaction + slice
-    run as ONE jitted program per (shape-set, target capacity)."""
-    n = batch.row_count()  # the one necessary host sync (sizes the bucket)
+def _shrink_impl(batch: DeviceBatch, n: int, compact_first: bool
+                 ) -> DeviceBatch:
+    """Slice down to n's capacity bucket as ONE jitted program per
+    (shape-set, target capacity, compact?), compacting first unless the
+    caller guarantees active rows already form a prefix."""
     cap = bucket_capacity(max(1, n))
     if cap >= batch.capacity:
-        return compact(batch)
+        return compact(batch) if compact_first else batch
     flat, spec = flatten_batch(batch)
-    key = (tuple((a.shape, str(a.dtype)) for a in flat), cap)
+    key = (tuple((a.shape, str(a.dtype)) for a in flat), cap,
+           compact_first)
     fn = _SHRINK_CACHE.get(key)
     if fn is None:
         def _fn(active, *arrs):
-            new_active, outs = _compact_body(active, arrs)
-            return new_active[:cap], tuple(
-                (a[:cap] if a.ndim == 1 else a[:cap, :]) for a in outs)
+            if compact_first:
+                active, arrs = _compact_body(active, arrs)
+            return active[:cap], tuple(
+                (a[:cap] if a.ndim == 1 else a[:cap, :]) for a in arrs)
         fn = jax.jit(_fn)
         _SHRINK_CACHE[key] = fn
     new_active, outs = fn(batch.active, *flat)
     return DeviceBatch(batch.schema, rebuild_columns(spec, outs),
                        new_active, n)
+
+
+def shrink_to_bucket(batch: DeviceBatch) -> DeviceBatch:
+    """Compact, then if the active count fits a smaller capacity bucket,
+    slice down to it (keeps shuffle payloads tight)."""
+    n = batch.row_count()  # the one necessary host sync (sizes the bucket)
+    return _shrink_impl(batch, n, compact_first=True)
+
+
+def slice_compacted_to_bucket(batch: DeviceBatch) -> DeviceBatch:
+    """Slice an ALREADY-COMPACTED batch (active rows form a prefix,
+    ``_num_rows`` known) down to its capacity bucket — a pure static
+    slice, no sort and no host sync (unlike shrink_to_bucket)."""
+    n = batch.row_count()  # cached: caller set _num_rows
+    return _shrink_impl(batch, n, compact_first=False)

@@ -164,9 +164,11 @@ def pack_batch(batch) -> Tuple[np.ndarray, List[np.ndarray], Tuple]:
                 data.astype(bool), bitorder="little")), vdesc))
         elif np_dt == np.dtype(np.float64):
             layout.append(("f64", len(extras), vdesc))
-            extras.append(data.astype(np.float64))
+            # asarray: already-f64 contiguous data ships without a copy
+            extras.append(np.asarray(data, np.float64))
         elif np_dt == np.dtype(np.float32):
-            layout.append(("f32", pk.add(data.astype(np.float32)), vdesc))
+            layout.append(("f32", pk.add(np.asarray(data, np.float32)),
+                           vdesc))
         else:
             if n:
                 mn, mx = int(data.min()), int(data.max())
@@ -318,10 +320,8 @@ def _stage_column(c, dt: T.DataType, cap: int) -> List[np.ndarray]:
     return [data, validity]
 
 
-def _direct_upload(batch, cap: int, device: Optional[jax.Device]):
-    """Small-batch (and nested-column) path: stage padded full-width
-    buffers, one device_put, zero compiled programs."""
-    from spark_rapids_tpu.columnar import device as D
+def _stage_direct(batch, cap: int):
+    """Host staging for the small-batch / nested-column path."""
     n = batch.num_rows
     np_arrays: List[np.ndarray] = []
     spec: List[Tuple[T.DataType, int]] = []
@@ -332,24 +332,35 @@ def _direct_upload(batch, cap: int, device: Optional[jax.Device]):
     active_np = np.zeros(cap, dtype=bool)
     active_np[:n] = True
     np_arrays.append(active_np)
-    if device is not None:
-        dev = jax.device_put(np_arrays, device)
-    else:
-        dev = jax.device_put(np_arrays)
-    return D.DeviceBatch(batch.schema, D.rebuild_columns(spec, dev[:-1]),
-                         dev[-1], n)
+    return ("direct", batch.schema, n, spec, np_arrays)
 
 
-def upload_batch(batch, cap: int, device: Optional[jax.Device] = None):
-    """HostBatch -> DeviceBatch via the packed codec (one device_put,
-    one decode program); small batches skip the codec."""
-    from spark_rapids_tpu.columnar import device as D
+def prepare_upload(batch, cap: int):
+    """Host-side half of an upload (pack/stage, NO device touch): the
+    returned opaque token feeds finish_upload. Splitting the phases lets
+    a producer thread pack batch k+1 while batch k's bytes move."""
     n = batch.num_rows
     if n < PACKED_MIN_ROWS or any(
             isinstance(f.data_type, T.ArrayType)
             for f in batch.schema.fields):
-        return _direct_upload(batch, cap, device)
+        return _stage_direct(batch, cap)
     words, extras, layout = pack_batch(batch)
+    return ("packed", batch.schema, n, cap, words, extras, layout)
+
+
+def finish_upload(staged, device: Optional[jax.Device] = None):
+    """Device-side half: one device_put (+ one decode program on the
+    packed path)."""
+    from spark_rapids_tpu.columnar import device as D
+    if staged[0] == "direct":
+        _tag, schema, n, spec, np_arrays = staged
+        if device is not None:
+            dev = jax.device_put(np_arrays, device)
+        else:
+            dev = jax.device_put(np_arrays)
+        return D.DeviceBatch(schema, D.rebuild_columns(spec, dev[:-1]),
+                             dev[-1], n)
+    _tag, schema, n, cap, words, extras, layout = staged
     key = (layout, n, cap, words.nbytes)
     with _DECODE_CACHE_LOCK:
         fn = _DECODE_CACHE.get(key)
@@ -368,6 +379,12 @@ def upload_batch(batch, cap: int, device: Optional[jax.Device] = None):
         dev = jax.device_put(bufs)
     active, outs = fn(dev[0], *dev[1:])
     spec = [(f.data_type, 3 if D.is_string_like(f.data_type) else 2)
-            for f in batch.schema.fields]
-    return D.DeviceBatch(batch.schema, D.rebuild_columns(spec, outs),
+            for f in schema.fields]
+    return D.DeviceBatch(schema, D.rebuild_columns(spec, outs),
                          active, n)
+
+
+def upload_batch(batch, cap: int, device: Optional[jax.Device] = None):
+    """HostBatch -> DeviceBatch via the packed codec (one device_put,
+    one decode program); small batches skip the codec."""
+    return finish_upload(prepare_upload(batch, cap), device)

@@ -183,10 +183,6 @@ INCOMPATIBLE_OPS = conf("spark.rapids.sql.incompatibleOps.enabled").doc(
     "Enable ops that are not 100%% compatible with Spark semantics "
     "(RapidsConf.scala INCOMPATIBLE_OPS).").boolean(False)
 
-IMPROVED_FLOAT_OPS = conf("spark.rapids.sql.improvedFloatOps.enabled").doc(
-    "Enable float ops that differ in edge rounding from the CPU "
-    "(RapidsConf.scala).").boolean(False)
-
 ANSI_ENABLED = conf("spark.sql.ansi.enabled").doc(
     "ANSI SQL mode: overflow/invalid-cast raise instead of null/wrap "
     "(Spark conf honored by the rewrite like GpuOverrides does).").boolean(False)
@@ -199,6 +195,15 @@ SESSION_TIMEZONE = conf("spark.sql.session.timeZone").doc(
 
 SHUFFLE_PARTITIONS = conf("spark.sql.shuffle.partitions").doc(
     "Default partition count for exchanges (Spark SQLConf).").integer(8)
+
+DEVICE_SHUFFLE_PARTITIONS = conf(
+    "spark.rapids.sql.shuffle.devicePartitions").doc(
+    "Partition count for DEVICE hash/range exchanges; 0 = auto (the "
+    "active ICI mesh size, or 1 in-process). One chip executes all "
+    "partitions' programs serially anyway, so extra in-process "
+    "partitions only add split programs and count syncs — the AQE "
+    "coalesce-shuffle-partitions decision made statically for the TPU "
+    "(GpuShuffleExchangeExecBase partitioning role).").integer(0)
 
 METRICS_LEVEL = conf("spark.rapids.sql.metrics.level").doc(
     "ESSENTIAL, MODERATE or DEBUG op metric verbosity "
@@ -223,17 +228,9 @@ SPILL_DIR = conf("spark.rapids.memory.spillDirectory").doc(
 MEMORY_DEBUG = conf("spark.rapids.memory.tpu.debug").doc(
     "Log device allocation/free events (RapidsConf.scala:307).").boolean(False)
 
-SHUFFLE_TRANSPORT = conf("spark.rapids.shuffle.transport.mode").doc(
-    "Shuffle transport: HOST (serialize to host, sort-shuffle style), "
-    "ICI (device-resident all-to-all over the mesh; the UCX analogue, "
-    "SURVEY.md 2.3), or AUTO.").string("AUTO")
-
 SHUFFLE_COMPRESSION_CODEC = conf("spark.rapids.shuffle.compression.codec").doc(
     "Codec for shuffle payloads on the host-staged path: none, lz4 "
     "(TableCompressionCodec framework analogue).").string("none")
-
-STABLE_SORT = conf("spark.rapids.sql.stableSort.enabled").doc(
-    "Force stable sorts (RapidsConf.scala STABLE_SORT).").boolean(False)
 
 ALLOW_DISABLE_ENTIRE_PLAN = conf(
     "spark.rapids.allowDisableEntirePlan").internal().doc(

@@ -20,10 +20,12 @@ from typing import Callable, Dict, Iterator, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+import numpy as np
+
 from spark_rapids_tpu import metrics as M
 from spark_rapids_tpu.columnar.device import (
     AnyDeviceColumn, DeviceBatch, DeviceColumn, concat_device, mask_col,
-    shrink_to_bucket, take_columns)
+    shrink_to_bucket, slice_compacted_to_bucket, take_columns)
 from spark_rapids_tpu.columnar.host import HostColumn
 from spark_rapids_tpu.conf import TpuConf
 from spark_rapids_tpu.exec.base import (DevicePartitionThunk, TpuExec,
@@ -36,7 +38,8 @@ from spark_rapids_tpu.sql import types as T
 
 
 def apply_prim_device(prim: str, seg: G.Segments, col: AnyDeviceColumn,
-                      out_type: T.DataType) -> AnyDeviceColumn:
+                      out_type: T.DataType,
+                      has_nans: Optional[bool] = None) -> AnyDeviceColumn:
     """Device twin of physical.apply_update_prim (same prim vocabulary)."""
     if prim == E.PRIM_COUNT:
         return G.seg_count(seg, col)
@@ -45,9 +48,9 @@ def apply_prim_device(prim: str, seg: G.Segments, col: AnyDeviceColumn,
     if prim == E.PRIM_SUM_NONNULL:
         return G.seg_sum(seg, col, out_type, null_when_empty=False)
     if prim == E.PRIM_MIN:
-        return G.seg_extreme(seg, col, is_min=True)
+        return G.seg_extreme(seg, col, is_min=True, has_nans=has_nans)
     if prim == E.PRIM_MAX:
-        return G.seg_extreme(seg, col, is_min=False)
+        return G.seg_extreme(seg, col, is_min=False, has_nans=has_nans)
     if prim == E.PRIM_FIRST:
         return G.seg_first_last(seg, col, is_first=True, ignore_nulls=True)
     if prim == E.PRIM_LAST:
@@ -136,6 +139,8 @@ def is_device_agg(grouping: List[E.AttributeReference],
 # (every collect() builds fresh exec instances) reuse XLA executables.
 _AGG_FN_CACHE: Dict[Tuple, Callable] = {}
 
+_stack_counts = jax.jit(lambda cs: jnp.stack(cs))
+
 
 class TpuHashAggregateExec(TpuExec):
     def __init__(self, grouping: List[E.AttributeReference],
@@ -170,11 +175,12 @@ class TpuHashAggregateExec(TpuExec):
                 if isinstance(e, E.Alias)
                 and isinstance(e.child, E.AggregateExpression)]
 
-    def _bound_slot_sources(self, mode: str
+    def _bound_slot_sources(self, mode: str, child_out=None
                             ) -> Tuple[List[E.Expression],
                                        List[Tuple[str, T.DataType]]]:
         """Per-slot (bound source expr, (prim, out_type)) for `mode`."""
-        child_out = self.child.output
+        if child_out is None:
+            child_out = self.child.output
         srcs: List[E.Expression] = []
         prims: List[Tuple[str, T.DataType]] = []
         for alias in self._agg_aliases():
@@ -189,7 +195,8 @@ class TpuHashAggregateExec(TpuExec):
 
     def _build_fn(self, mode: str, key_bound: List[E.Expression],
                   slot_srcs: List[E.Expression],
-                  prims: List[Tuple[str, T.DataType]]) -> Callable:
+                  prims: List[Tuple[str, T.DataType]],
+                  has_nans: bool) -> Callable:
         aliases = self._agg_aliases()
         slot_counts = [len(self.slots[a.expr_id]) for a in aliases]
         grouping = self.grouping
@@ -207,11 +214,12 @@ class TpuHashAggregateExec(TpuExec):
             # multi-operand lax.sort; sort-then-gather is ~16x slower on
             # TPU for wide rows)
             flat, spec = flatten_columns(key_cols + slot_vals)
-            seg = G.build_segments(key_cols, active, payload=flat)
+            seg = G.build_segments(key_cols, active, payload=flat,
+                                   has_nans=has_nans)
             sorted_cols = rebuild_columns(spec, seg.payload)
             keys_s = sorted_cols[:len(key_cols)]
             vals_s = sorted_cols[len(key_cols):]
-            buffers = [apply_prim_device(p, seg, v, dt)
+            buffers = [apply_prim_device(p, seg, v, dt, has_nans)
                        for (p, dt), v in zip(prims, vals_s)]
             # results live at segment-END rows of the sorted layout;
             # the keys are ALREADY in that layout — just mask them
@@ -219,11 +227,20 @@ class TpuHashAggregateExec(TpuExec):
             key_out = [mask_col(c, out_active) for c in keys_s] \
                 if grouping else []
 
-            if mode in ("partial", "merge"):
+            if mode in ("partial", "merge", "merge_partial"):
                 # merge: buffer-space -> buffer-space (the bounded
-                # concat+merge staging of aggregate.scala:224-245)
+                # concat+merge staging of aggregate.scala:224-245).
+                # Compact results to a prefix IN-PROGRAM and emit the
+                # group count as a device scalar: downstream sizing then
+                # needs one tiny (async-overlappable) fetch instead of a
+                # blocking count sync per batch (each D2H roundtrip is
+                # ~0.2-0.7s flat on tunneled backends).
+                from spark_rapids_tpu.columnar.device import _compact_body
                 out_cols = list(key_out) + list(buffers)
-                return out_cols, out_active
+                cnt = jnp.sum(out_active)
+                flat2, spec2 = flatten_columns(out_cols)
+                new_active, outs2 = _compact_body(out_active, flat2)
+                return rebuild_columns(spec2, outs2), new_active, cnt
 
             # final / complete: evaluate results
             by_alias: Dict[int, List[AnyDeviceColumn]] = {}
@@ -271,12 +288,22 @@ class TpuHashAggregateExec(TpuExec):
         return tuple(desc)
 
     def _aggregate_batch(self, batch: DeviceBatch,
-                         mode: Optional[str] = None) -> DeviceBatch:
+                         mode: Optional[str] = None):
+        """Run one aggregation program. Returns ``(DeviceBatch, cnt)``
+        where ``cnt`` is the device-scalar group count for partial/merge
+        modes (compacted output) and None for final/complete."""
         mode = mode or self.mode
-        child_out = self.child.output
+        if mode == "merge_partial":
+            # merge-within-partial: inputs are in THIS exec's buffer
+            # layout (self.output), not the child's raw rows
+            bind_out = self.output
+        else:
+            bind_out = self.child.output
+        child_out = bind_out
         key_bound = [E.bind_references(g, child_out) for g in self.grouping]
-        slot_srcs, prims = self._bound_slot_sources(mode)
-        key = (mode,
+        slot_srcs, prims = self._bound_slot_sources(mode, child_out)
+        salt = G.kernel_salt()  # snapshot: key AND trace use this value
+        key = (mode, salt,
                tuple(X.expr_key(e) for e in key_bound),
                tuple(X.expr_key(e) for e in slot_srcs),
                tuple(p for p, _ in prims),
@@ -286,18 +313,26 @@ class TpuHashAggregateExec(TpuExec):
                self._out_desc())
         fn = _AGG_FN_CACHE.get(key)
         if fn is None:
-            fn = self._build_fn(mode, key_bound, slot_srcs, prims)
+            fn = self._build_fn(mode, key_bound, slot_srcs, prims,
+                                has_nans=salt[0])
             _AGG_FN_CACHE[key] = fn
         lit_vals = X.literal_values(list(key_bound) + list(slot_srcs))
+        cnt = None
         with self.metrics.timed(M.AGG_TIME):
-            out_cols, out_active = fn(batch.columns, batch.active, lit_vals)
-        if mode == "merge":  # buffer layout keeps the child's schema
+            if mode in ("partial", "merge", "merge_partial"):
+                out_cols, out_active, cnt = fn(batch.columns, batch.active,
+                                               lit_vals)
+            else:
+                out_cols, out_active = fn(batch.columns, batch.active,
+                                          lit_vals)
+        if mode in ("merge", "merge_partial"):
+            # buffer layout keeps the input's schema
             schema = T.StructType(
                 [T.StructField(a.name, a.data_type, a.nullable)
                  for a in child_out])
         else:
             schema = self.schema
-        return DeviceBatch(schema, list(out_cols), out_active, None)
+        return DeviceBatch(schema, list(out_cols), out_active, None), cnt
 
     def _empty_global_result(self) -> DeviceBatch:
         cols: List[HostColumn] = []
@@ -336,8 +371,9 @@ class TpuHashAggregateExec(TpuExec):
                     merged.append(chunk[0])
                     continue
                 whole = concat_device([h.get() for h in chunk])
-                out = shrink_to_bucket(
-                    self._aggregate_batch(whole, mode="merge"))
+                out, cnt = self._aggregate_batch(whole, mode="merge")
+                out._num_rows = int(cnt)  # sizes the bucket slice
+                out = slice_compacted_to_bucket(out)
                 for h in chunk:
                     h.close()
                 merged.append(store.register(out))
@@ -351,17 +387,11 @@ class TpuHashAggregateExec(TpuExec):
 
         def make(thunk: DevicePartitionThunk) -> DevicePartitionThunk:
             def run() -> Iterator[DeviceBatch]:
-                if self.mode == "partial":
-                    # per-batch partial aggregation, no concat and NO
-                    # host sync: results stay mask-scattered at the
-                    # input capacity; the downstream exchange split is
-                    # the next (and only) sizing sync. Each D2H sync
-                    # costs ~100ms on tunneled backends.
-                    for b in thunk():
-                        yield self._aggregate_batch(b)
-                    return
                 from spark_rapids_tpu.memory import get_device_store
                 store = get_device_store(self.conf)
+                if self.mode == "partial":
+                    yield from self._run_partial(thunk, store)
+                    return
                 handles = [store.register(b) for b in thunk()
                            if b._num_rows != 0]
                 if not handles:
@@ -376,7 +406,7 @@ class TpuHashAggregateExec(TpuExec):
                         h.close()
                 # no shrink: results stay mask-scattered (caps here are
                 # already small post-exchange; skipping saves a sync)
-                out = self._aggregate_batch(whole)
+                out, _cnt = self._aggregate_batch(whole)
                 if not grouped and self.mode in ("final", "complete") \
                         and out.row_count() == 0:
                     # inputs existed but every row was filtered/inactive:
@@ -386,6 +416,49 @@ class TpuHashAggregateExec(TpuExec):
                 yield out
             return run
         return [make(t) for t in device_channel(self.child)]
+
+    def _run_partial(self, thunk: DevicePartitionThunk, store
+                     ) -> Iterator[DeviceBatch]:
+        """Partial mode, sync-lean: each batch's program compacts its
+        groups and emits the count as a device scalar whose host copy is
+        started immediately (overlapping the next batch's work). After
+        the drain, outputs are sliced to their buckets using the by-then
+        arrived counts, and — when the reduced data is small — merged ON
+        DEVICE into one batch per partition, so the exchange ships one
+        small batch with zero extra syncs (the pre-shuffle reduction of
+        aggregate.scala:224-245, restructured for a ~0.2-0.7s-per-D2H-
+        roundtrip backend)."""
+        pending = []
+        for b in thunk():
+            out, cnt = self._aggregate_batch(b)
+            pending.append((store.register(out), cnt))
+        if not pending:
+            return
+        # ONE roundtrip for every batch's group count (each separate
+        # fetch costs ~0.2-1s flat on tunneled backends)
+        counts = np.asarray(_stack_counts([c for _h, c in pending]))
+        shrunk = []
+        for (h, _c), cnt in zip(pending, counts):
+            b = h.get()
+            b._num_rows = int(cnt)
+            b = slice_compacted_to_bucket(b)
+            h.close()
+            shrunk.append(store.register(b))
+        total = sum(h.rows for h in shrunk)
+        if len(shrunk) > 1 and total <= self.conf.batch_size_rows:
+            whole = concat_device([h.get() for h in shrunk])
+            for h in shrunk:
+                h.close()
+            out, _cnt = self._aggregate_batch(whole, mode="merge_partial")
+            # leave _num_rows lazy: the output is compacted at a small
+            # concat capacity already, and fetching the count here would
+            # cost one more roundtrip nothing downstream needs
+            yield out
+            return
+        for h in shrunk:
+            b = h.get()
+            h.close()
+            yield b
 
     def simple_string(self):
         return (f"TpuHashAggregate mode={self.mode} keys={self.grouping} "

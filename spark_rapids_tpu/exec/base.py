@@ -87,29 +87,56 @@ class TpuRowToColumnarExec(TpuExec):
 
         def make(thunk: P.PartitionThunk) -> DevicePartitionThunk:
             def run() -> Iterator[DeviceBatch]:
+                # 1-deep upload pipeline: a helper thread packs/stages
+                # batch k+1 (host-only work) while this thread runs
+                # batch k's device_put — pack and wire transfer overlap
+                from concurrent.futures import ThreadPoolExecutor
                 pending: List[HostBatch] = []
                 rows = 0
-                for b in thunk():
-                    if b.num_rows == 0:
-                        continue
-                    pending.append(b)
-                    rows += b.num_rows
-                    if rows >= self.goal_rows:
-                        yield self._upload(pending, sem, metrics)
-                        pending, rows = [], 0
-                if pending:
-                    yield self._upload(pending, sem, metrics)
+                staged = None  # in-flight prepare future
+                with ThreadPoolExecutor(
+                        1, thread_name_prefix="srt-pack") as pool:
+                    for b in thunk():
+                        if b.num_rows == 0:
+                            continue
+                        pending.append(b)
+                        rows += b.num_rows
+                        if rows >= self.goal_rows:
+                            prev, staged = staged, pool.submit(
+                                self._prepare, pending, metrics)
+                            pending, rows = [], 0
+                            if prev is not None:
+                                yield self._finish(prev.result(), sem,
+                                                   metrics)
+                    if pending:
+                        prev, staged = staged, pool.submit(
+                            self._prepare, pending, metrics)
+                        if prev is not None:
+                            yield self._finish(prev.result(), sem, metrics)
+                    if staged is not None:
+                        yield self._finish(staged.result(), sem, metrics)
             return run
         return [make(t) for t in self.child.partitions()]
 
-    def _upload(self, batches: List[HostBatch], sem, metrics) -> DeviceBatch:
+    def _prepare(self, batches: List[HostBatch], metrics):
+        from spark_rapids_tpu.columnar.transfer import prepare_upload
         whole = batches[0] if len(batches) == 1 else HostBatch.concat(batches)
+        cap = bucket_capacity(max(1, whole.num_rows))
+        # separate metric: pack overlaps the previous batch's transfer,
+        # so folding it into copyToDeviceTime would double-count wall
+        with metrics.timed(M.PACK_TIME):
+            return whole.num_rows, prepare_upload(whole, cap)
+
+    def _finish(self, prepared, sem, metrics) -> DeviceBatch:
+        from spark_rapids_tpu.columnar.transfer import finish_upload
+        num_rows, staged = prepared
         sem.acquire_if_necessary(metrics)
         with metrics.timed(M.COPY_TO_DEVICE_TIME):
-            d = DeviceBatch.from_host(whole)
-        metrics.create(M.NUM_OUTPUT_ROWS, M.ESSENTIAL).add(whole.num_rows)
+            d = finish_upload(staged)
+        metrics.create(M.NUM_OUTPUT_ROWS, M.ESSENTIAL).add(num_rows)
         metrics.create(M.NUM_OUTPUT_BATCHES, M.ESSENTIAL).add(1)
         return d
+
 
     def simple_string(self):
         return "TpuRowToColumnar"

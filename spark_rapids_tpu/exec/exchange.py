@@ -293,7 +293,24 @@ class TpuShuffleExchangeExec(TpuExec):
             held batch must be demotable (SpillableColumnarBatch role)."""
             out[pid].append(store.register(part))
 
-        if isinstance(p, P.HashPartitioning) and self._mesh_eligible():
+        single_out = isinstance(p, P.SinglePartitioning) or (
+            n == 1 and isinstance(p, (P.HashPartitioning,
+                                      P.RangePartitioning,
+                                      P.RoundRobinPartitioning))
+            and not self._mesh_eligible())
+        if single_out:
+            # one output partition trivially satisfies any required
+            # distribution: pass batches through with NO partition-id
+            # program and NO count sync (the split exists only to route
+            # rows between partitions)
+            for per_part in self._pull_split(
+                    device_channel(self.child),
+                    lambda b: store.register(b) if b._num_rows != 0
+                    else None):
+                for h in per_part:
+                    if h is not None:
+                        out[0].append(h)
+        elif isinstance(p, P.HashPartitioning) and self._mesh_eligible():
             # mesh batches are sharded jax arrays pinned per chip; the
             # spill tiers (host numpy round-trip) would gather them
             # cross-device, so the ICI path manages residency itself —
@@ -317,14 +334,6 @@ class TpuShuffleExchangeExec(TpuExec):
                     for pid, h in enumerate(handles):
                         if h is not None:
                             out[pid].append(h)
-        elif isinstance(p, P.SinglePartitioning):
-            for per_part in self._pull_split(
-                    device_channel(self.child),
-                    lambda b: store.register(b) if b._num_rows != 0
-                    else None):
-                for h in per_part:
-                    if h is not None:
-                        out[0].append(h)
         elif isinstance(p, P.RoundRobinPartitioning):
             start = 0
             for thunk in device_channel(self.child):

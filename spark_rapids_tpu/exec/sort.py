@@ -61,13 +61,16 @@ def sorted_batch(order: List[E.SortOrder], bound: List[E.Expression],
                  batch: DeviceBatch, limit: int = -1) -> DeviceBatch:
     """Sort one device batch by `order` (keys pre-bound); optionally keep
     only the first `limit` rows. One fused jitted program."""
+    from spark_rapids_tpu.ops import groupby as G
+    salt = G.kernel_salt()  # snapshot: key AND trace use this value
     key = (tuple(X.expr_key(e) for e in bound),
            tuple((o.ascending, o.nulls_first) for o in order),
-           limit)
+           limit, salt)
     fn = _SORT_FN_CACHE.get(key)
     if fn is None:
         orders = list(order)
         bound_t = tuple(bound)
+        has_nans = salt[0]
 
         def _fn(cols, active, lit_vals):
             from spark_rapids_tpu.columnar.device import (
@@ -80,7 +83,8 @@ def sorted_batch(order: List[E.SortOrder], bound: List[E.Expression],
             subkeys: list = [~active]
             for c, o in zip(key_cols, orders):
                 subkeys.extend(
-                    S.order_subkeys(c, o.ascending, o.nulls_first))
+                    S.order_subkeys(c, o.ascending, o.nulls_first,
+                                    has_nans))
             flat, spec = flatten_columns(cols)
             _k, _order, sorted_flat = sort_with_payload(subkeys, flat)
             n = jnp.sum(active)
@@ -155,21 +159,28 @@ class TpuSortExec(TpuExec):
                     handles.append(store.register(b))
                 if not handles:
                     return
-                total = sum(h.rows for h in handles)
-                if total <= goal or len(handles) == 1:
+                # len check FIRST: a single handle sorts in-core no
+                # matter its size, and skipping h.rows avoids a count
+                # sync (the common single-batch case post-aggregation)
+                if len(handles) == 1 or \
+                        sum(h.rows for h in handles) <= goal:
                     keycols.clear()
                     whole = concat_device([h.get() for h in handles])
                     for h in handles:
                         h.close()
                     with metrics.timed(M.SORT_TIME):
                         out = sorted_batch(self.order, bound, whole, -1)
-                    metrics.create(M.NUM_OUTPUT_ROWS, M.ESSENTIAL).add(
-                        out.row_count())
+                    if out._num_rows is not None:
+                        # known counts only: fetching one here would be
+                        # a blocking D2H roundtrip purely for the metric
+                        metrics.create(M.NUM_OUTPUT_ROWS,
+                                       M.ESSENTIAL).add(out._num_rows)
                     yield out
                     return
                 yield from self._out_of_core(
-                    store, handles, keycols, actives, total, goal, bound,
-                    metrics)
+                    store, handles, keycols, actives,
+                    sum(h.rows for h in handles),  # cached after the gate
+                    goal, bound, metrics)
             return run
         return [make(t) for t in device_channel(self.child)]
 

@@ -584,17 +584,18 @@ class TpuWindowExec(TpuExec):
     def _run_batch(self, batch: DeviceBatch) -> DeviceBatch:
         (part_bound, order_bound, items, all_exprs, out_types
          ) = self._plan_items()
+        salt = G.kernel_salt()  # snapshot: key AND trace use this value
         key = (tuple(X.expr_key(e) for e in all_exprs),
                len(part_bound),
                tuple((o.ascending, o.nulls_first) for o in self.order_spec),
-               self._item_key(items))
+               self._item_key(items), salt)
         fn = _WINDOW_FN_CACHE.get(key)
         if fn is None:
             fn = _build_window_fn(part_bound, tuple(self.order_spec),
                                   order_bound, tuple(items), all_exprs)
             _WINDOW_FN_CACHE[key] = fn
         lit_vals = X.literal_values(list(all_exprs))
-        with self.metrics.timed(M.OP_TIME):
+        with self.metrics.timed(M.OP_TIME), G.nan_scope(salt[0]):
             outs = fn(batch.columns, batch.active, lit_vals)
         new_cols: List[AnyDeviceColumn] = list(batch.columns)
         for (arrs, validity), dt in zip(outs, out_types):

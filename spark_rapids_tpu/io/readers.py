@@ -375,6 +375,13 @@ class CpuFileScanExec(P.PhysicalPlan):
                 yield arrow_to_host_batch(
                     tbl.slice(lo, max_rows), schema)
 
+        def decode_host(u: ScanUnit) -> List[HostBatch]:
+            # arrow->HostBatch conversion (string object arrays, casts)
+            # runs IN the pool thread so the consumer thread only
+            # packs/uploads (MultiFileCloudParquetPartitionReader keeps
+            # its host-side decode off the task thread the same way)
+            return list(emit(decode(u)))
+
         def make(units: List[ScanUnit]):
             def run() -> Iterator[HostBatch]:
                 if reader_type == "COALESCING" and len(units) > 1:
@@ -382,11 +389,24 @@ class CpuFileScanExec(P.PhysicalPlan):
                     tbl = pa.concat_tables([decode(u) for u in units])
                     yield from emit(tbl)
                 elif reader_type == "MULTITHREADED" and len(units) > 1:
-                    pool = _shared_pool(
-                        int(self.conf.get(MULTITHREADED_READ_NUM_THREADS)))
-                    futures = [pool.submit(decode, u) for u in units]
-                    for f in futures:
-                        yield from emit(f.result())
+                    n_threads = int(
+                        self.conf.get(MULTITHREADED_READ_NUM_THREADS))
+                    pool = _shared_pool(n_threads)
+                    # sliding prefetch window: decoded-and-converted
+                    # HostBatches are several times their arrow size, so
+                    # bound in-flight units instead of materializing the
+                    # whole partition's decode output at once
+                    from collections import deque
+                    from itertools import islice
+                    it = iter(units)
+                    futures = deque(pool.submit(decode_host, u)
+                                    for u in islice(it, n_threads + 2))
+                    while futures:
+                        f = futures.popleft()
+                        nxt = next(it, None)
+                        if nxt is not None:
+                            futures.append(pool.submit(decode_host, nxt))
+                        yield from f.result()
                 else:  # PERFILE
                     for u in units:
                         yield from emit(decode(u))

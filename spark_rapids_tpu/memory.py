@@ -28,6 +28,7 @@ bytes that matter.
 
 from __future__ import annotations
 
+import logging
 import os
 import pickle
 import threading
@@ -39,8 +40,13 @@ from typing import Dict, Optional
 from spark_rapids_tpu.columnar.device import DeviceBatch
 from spark_rapids_tpu.columnar.host import HostBatch
 from spark_rapids_tpu.conf import (DEVICE_MEMORY_LIMIT,
-                                   HOST_SPILL_STORAGE_SIZE, SPILL_DIR,
-                                   TpuConf)
+                                   HOST_SPILL_STORAGE_SIZE, MEMORY_DEBUG,
+                                   SPILL_DIR, TpuConf)
+
+# spark.rapids.memory.tpu.debug: log every store transition
+# (register/spill/promote/release) the way the reference's
+# MEMORY_DEBUG logs RMM allocation events (RapidsConf.scala:307)
+_log = logging.getLogger("spark_rapids_tpu.memory")
 
 _DEFAULT_BUDGET = 8 << 30  # when the backend reports no memory stats
 
@@ -124,10 +130,11 @@ class DeviceStore:
     spill, and accounts host-tier bytes against the host budget."""
 
     def __init__(self, device_budget: int, host_budget: int,
-                 spill_dir: str):
+                 spill_dir: str, debug: bool = False):
         self.device_budget = device_budget
         self.host_budget = host_budget
         self.spill_dir = spill_dir
+        self.debug = debug
         self._lock = threading.RLock()
         self._states: "OrderedDict[int, _State]" = OrderedDict()
         self._next_id = 0
@@ -169,6 +176,9 @@ class DeviceStore:
                 st.host_bytes = _host_sizeof(st.host)
                 self.host_bytes += st.host_bytes
             if st.tier == TIER_HOST:
+                if self.debug:
+                    _log.info("promote host->device: %d bytes",
+                              st.host_bytes)
                 st.device = DeviceBatch.from_host(st.host)
                 self.host_bytes -= st.host_bytes
                 st.host, st.host_bytes = None, 0
@@ -200,6 +210,10 @@ class DeviceStore:
                     self._spill_to_disk(st)
 
     def _spill_to_host(self, st: _State) -> None:
+        if self.debug:
+            _log.info("spill device->host: %d bytes (pool %d/%d)",
+                      st.device_bytes, self.device_bytes,
+                      self.device_budget)
         st.host = st.device.to_host()
         st.rows = st.host.num_rows
         st.device = None
@@ -212,6 +226,9 @@ class DeviceStore:
         self.spilled_device_bytes += st.device_bytes
 
     def _spill_to_disk(self, st: _State) -> None:
+        if self.debug:
+            _log.info("spill host->disk: %d bytes (host %d/%d)",
+                      st.host_bytes, self.host_bytes, self.host_budget)
         os.makedirs(self.spill_dir, exist_ok=True)
         path = os.path.join(self.spill_dir,
                             f"spill-{uuid.uuid4().hex[:16]}.bin")
@@ -292,4 +309,7 @@ def get_device_store(conf: TpuConf) -> DeviceStore:
         if _STORE is None or _STORE_KEY != key:
             _STORE = DeviceStore(budget, host_budget, spill_dir)
             _STORE_KEY = key
+        # logging-only: toggled in place so a debug flip never replaces
+        # the live store (two stores would account one HBM independently)
+        _STORE.debug = bool(conf.get(MEMORY_DEBUG))
         return _STORE

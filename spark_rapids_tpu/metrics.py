@@ -34,6 +34,7 @@ JOIN_TIME = "joinTime"
 CONCAT_TIME = "concatTime"
 PARTITION_TIME = "partitionTime"
 COPY_TO_DEVICE_TIME = "copyToDeviceTime"
+PACK_TIME = "packBatchTime"  # host-side staging half of an upload
 COPY_FROM_DEVICE_TIME = "copyFromDeviceTime"
 
 

@@ -25,7 +25,8 @@ free. Compaction (an argsort) happens later at shrink/shuffle points.
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+import threading
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -51,25 +52,82 @@ def rank_u64(col: DeviceColumn) -> jax.Array:
     return data.astype(jnp.int64).view(jnp.uint64) ^ _SIGN64
 
 
-def rank_words(col: DeviceColumn) -> List[jax.Array]:
+# spark.rapids.sql.hasNans: when the user asserts NaN-free data, float
+# key encodings drop their is-NaN word — one fewer radix-sort pass per
+# float key in every sort/group/join program (RapidsConf HAS_NANS role,
+# re-purposed as a kernel hint on this NaN-exact engine). Set at session
+# start; kernel_salt() feeds the compiled-program caches so a flip never
+# reuses a stale trace.
+_HAS_NANS = True
+
+
+def set_has_nans(v: bool) -> None:
+    global _HAS_NANS
+    _HAS_NANS = bool(v)
+
+
+def kernel_salt() -> tuple:
+    """Session-level kernel flags that compiled-program cache keys must
+    include (they change traced structure, not argument shapes)."""
+    return (_HAS_NANS,)
+
+
+_NAN_SCOPE = threading.local()
+
+
+class nan_scope:
+    """Pin has_nans for the current thread while a salted program is
+    (possibly) traced: the value baked into the trace then always
+    matches the salt its cache key was computed with, even if another
+    session flips the module global concurrently."""
+
+    def __init__(self, value: bool):
+        self.value = bool(value)
+
+    def __enter__(self):
+        self.prev = getattr(_NAN_SCOPE, "value", None)
+        _NAN_SCOPE.value = self.value
+        return self
+
+    def __exit__(self, *exc):
+        _NAN_SCOPE.value = self.prev
+        return False
+
+
+def rank_words(col: DeviceColumn,
+               has_nans: Optional[bool] = None) -> List[jax.Array]:
     """Order+equality words (most significant first) whose joint
     ascending lexicographic order is Spark's total order, using only
     native-dtype comparisons: floats become [is_nan, nan-zeroed value]
     (NaN greatest + all NaNs equal; IEEE compare folds -0.0 == 0.0;
-    ``+0.0`` normalizes any -0.0 so equality words match bitwise)."""
+    ``+0.0`` normalizes any -0.0 so equality words match bitwise).
+
+    ``has_nans`` resolution: explicit param (build-time snapshot) >
+    thread-local nan_scope (set by salted call sites) > module global.
+    Inside cached/jitted programs one of the first two MUST be in
+    effect — reading only the global at trace time could disagree with
+    the salt the program was cached under if another session flips the
+    flag concurrently."""
+    if has_nans is None:
+        has_nans = getattr(_NAN_SCOPE, "value", None)
+        if has_nans is None:
+            has_nans = _HAS_NANS
     data = col.data
     if jnp.issubdtype(data.dtype, jnp.floating):
         zero = jnp.zeros((), data.dtype)
+        if not has_nans:
+            return [data + zero]  # -0.0 still normalized
         nanf = jnp.isnan(data)
         return [nanf, jnp.where(nanf, zero, data) + zero]
     return [rank_u64(col)]
 
 
-def value_words(col: AnyDeviceColumn) -> List[jax.Array]:
+def value_words(col: AnyDeviceColumn,
+                has_nans: Optional[bool] = None) -> List[jax.Array]:
     """Comparison words for ANY column type (strings included)."""
     if isinstance(col, DeviceStringColumn):
         return pack_string_words(col) + [col.lengths.astype(jnp.uint64)]
-    return rank_words(col)
+    return rank_words(col, has_nans)
 
 
 def pack_string_words(c: DeviceStringColumn) -> List[jax.Array]:
@@ -91,13 +149,14 @@ def pack_string_words(c: DeviceStringColumn) -> List[jax.Array]:
     return words
 
 
-def grouping_subkeys(col: AnyDeviceColumn) -> List[jax.Array]:
+def grouping_subkeys(col: AnyDeviceColumn,
+                     has_nans: Optional[bool] = None) -> List[jax.Array]:
     """Sub-key arrays whose joint equality == Spark group-key equality.
     Validity is included so null forms its own group; invalid slots hold
     normalized zeros so their data words tie."""
     if isinstance(col, DeviceStringColumn):
         return [col.validity, col.lengths] + pack_string_words(col)
-    return [col.validity] + rank_words(col)
+    return [col.validity] + rank_words(col, has_nans)
 
 
 def word_sentinel(dtype, is_min: bool):
@@ -171,11 +230,12 @@ class Segments:
 
 def build_segments(key_cols: Sequence[AnyDeviceColumn],
                    active: jax.Array,
-                   payload: Sequence[jax.Array] = ()) -> Segments:
+                   payload: Sequence[jax.Array] = (),
+                   has_nans: Optional[bool] = None) -> Segments:
     cap = active.shape[0]
     subkeys: List[jax.Array] = []
     for c in key_cols:
-        subkeys.extend(grouping_subkeys(c))
+        subkeys.extend(grouping_subkeys(c, has_nans))
     from spark_rapids_tpu.columnar.device import sort_with_payload
     pos = jnp.arange(cap, dtype=jnp.int32)
     # ONE multi-operand sort: ~active primary (live rows first), then the
@@ -265,11 +325,11 @@ def _winner_gather(seg: Segments, col_s: AnyDeviceColumn,
     return take_columns([col_s], safe, valid_at=won)[0]
 
 
-def seg_extreme(seg: Segments, col_s: AnyDeviceColumn, is_min: bool
-                ) -> AnyDeviceColumn:
+def seg_extreme(seg: Segments, col_s: AnyDeviceColumn, is_min: bool,
+                has_nans: Optional[bool] = None) -> AnyDeviceColumn:
     """min/max by winning-row-position so values round-trip untouched."""
     valid_s = col_s.validity & seg.active_sorted
-    words = value_words(col_s)
+    words = value_words(col_s, has_nans)
     win, has = seg_scan_best(seg.start_of_row, words, valid_s, is_min)
     won = has & seg.out_active
     return _winner_gather(seg, col_s, win, won)

@@ -247,8 +247,9 @@ def device_join(left: DeviceBatch, right: DeviceBatch,
     right columns) or, for semi/anti, the masked left batch."""
     lk = tuple(lkeys)
     rk = tuple(rkeys)
+    salt = G.kernel_salt()  # snapshot: key AND trace use this value
     struct = (tuple(X.expr_key(e) for e in lk),
-              tuple(X.expr_key(e) for e in rk))
+              tuple(X.expr_key(e) for e in rk), salt)
     lits_l = X.literal_values(list(lk))
     lits_r = X.literal_values(list(rk))
 
@@ -258,8 +259,9 @@ def device_join(left: DeviceBatch, right: DeviceBatch,
         if fn is None:
             fn = _build_mask_fn(lk, rk, join_type)
             _MASK_CACHE[key] = fn
-        new_active = fn(left.columns, left.active, lits_l,
-                        right.columns, right.active, lits_r)
+        with G.nan_scope(salt[0]):
+            new_active = fn(left.columns, left.active, lits_l,
+                            right.columns, right.active, lits_r)
         return DeviceBatch(left.schema, left.columns, new_active, None)
 
     if join_type not in PAIR_JOINS:
@@ -270,9 +272,10 @@ def device_join(left: DeviceBatch, right: DeviceBatch,
     if count_fn is None:
         count_fn = _build_count_fn(lk, rk, join_type)
         _COUNT_CACHE[ckey] = count_fn
-    (total_pairs, n_extra, m, offsets, base, order_r,
-     extra_order) = count_fn(left.columns, left.active, lits_l,
-                             right.columns, right.active, lits_r)
+    with G.nan_scope(salt[0]):
+        (total_pairs, n_extra, m, offsets, base, order_r,
+         extra_order) = count_fn(left.columns, left.active, lits_l,
+                                 right.columns, right.active, lits_r)
     total = int(total_pairs) + int(n_extra)  # ONE host sync for sizing
     out_cap = bucket_capacity(max(1, total))
 

@@ -35,7 +35,8 @@ from spark_rapids_tpu.ops.groupby import pack_string_words, rank_words
 
 
 def order_subkeys(col: AnyDeviceColumn, ascending: bool,
-                  nulls_first: bool) -> List[jax.Array]:
+                  nulls_first: bool,
+                  has_nans: "bool | None" = None) -> List[jax.Array]:
     """Subkeys (most-significant first) whose joint ascending order equals
     the SortOrder's ordering of this column. The validity key is most
     significant so the null group separates cleanly; null slots hold
@@ -51,7 +52,7 @@ def order_subkeys(col: AnyDeviceColumn, ascending: bool,
         if not ascending:
             data_keys = [~k for k in data_keys]
     else:
-        data_keys = rank_words(col)
+        data_keys = rank_words(col, has_nans)
         if not ascending:
             inverted = []
             for k in data_keys:

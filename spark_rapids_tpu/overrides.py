@@ -419,10 +419,38 @@ def _conv_global_limit(meta, kids):
     return TpuGlobalLimitExec(meta.wrapped.n, kids[0], meta.conf)
 
 
+def _device_shuffle_partitions(conf, n: int) -> int:
+    """Coalesced partition count for device hash/range exchanges: the
+    planner's spark.sql.shuffle.partitions sizes CPU-core parallelism,
+    but one chip runs every partition's programs serially — extra
+    in-process partitions only add split programs and count syncs. Auto
+    (0) = ICI mesh size when the mesh shuffle is active, else 1."""
+    from spark_rapids_tpu.conf import DEVICE_SHUFFLE_PARTITIONS
+    want = int(conf.get(DEVICE_SHUFFLE_PARTITIONS))
+    if want <= 0:
+        from spark_rapids_tpu.parallel.mesh import (get_active_mesh,
+                                                    mesh_size)
+        want = mesh_size() if get_active_mesh() is not None else 1
+    return max(1, min(n, want))
+
+
 def _conv_exchange(meta, kids):
     from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
-    return TpuShuffleExchangeExec(meta.wrapped.partitioning, kids[0],
-                                  meta.conf)
+    p = meta.wrapped.partitioning
+    # user-explicit repartition(n, ...) keeps its count (planner marks
+    # it user_specified); planner-inserted hash/range distribution
+    # requirements are satisfied by ANY partition count, so those
+    # coalesce to the device-friendly one
+    if not getattr(p, "user_specified", False):
+        if isinstance(p, P.HashPartitioning):
+            n = _device_shuffle_partitions(meta.conf, p.num_partitions)
+            if n != p.num_partitions:
+                p = P.HashPartitioning(p.exprs, n)
+        elif isinstance(p, P.RangePartitioning):
+            n = _device_shuffle_partitions(meta.conf, p.num_partitions)
+            if n != p.num_partitions:
+                p = P.RangePartitioning(p.order, n)
+    return TpuShuffleExchangeExec(p, kids[0], meta.conf)
 
 
 def _conv_aggregate(meta, kids):

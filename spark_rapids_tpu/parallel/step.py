@@ -42,7 +42,7 @@ def sum_count_step(mesh: Mesh) -> Callable:
     """
     from spark_rapids_tpu.parallel.mesh import mesh_key
     n_dev = mesh.shape[SHUFFLE_AXIS]
-    key = (mesh_key(mesh), "sum_count")
+    key = (mesh_key(mesh), "sum_count", G.kernel_salt())
     fn = _STEP_CACHE.get(key)
     if fn is not None:
         return fn

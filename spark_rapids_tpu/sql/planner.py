@@ -110,6 +110,9 @@ class Planner:
             part = P.HashPartitioning(p.by, p.num_partitions)
         else:
             part = P.RoundRobinPartitioning(p.num_partitions)
+        # df.repartition(n, ...) is an explicit user ask: the device
+        # rewrite must not coalesce it like a planner-inserted exchange
+        part.user_specified = True
         return P.CpuShuffleExchangeExec(part, child)
 
     def _plan_expand(self, p: L.Expand) -> P.PhysicalPlan:

@@ -50,8 +50,11 @@ class TpuSparkSession:
             from spark_rapids_tpu import device_manager
             device_manager.initialize(self.conf_obj)
             spark_rapids_tpu._enable_compile_cache()
-            from spark_rapids_tpu.conf import (SHUFFLE_ICI_DEVICES,
+            from spark_rapids_tpu.conf import (HAS_NANS,
+                                               SHUFFLE_ICI_DEVICES,
                                                SHUFFLE_MODE)
+            from spark_rapids_tpu.ops import groupby as _G
+            _G.set_has_nans(bool(self.conf_obj.get(HAS_NANS)))
             if str(self.conf_obj.get(SHUFFLE_MODE)).lower() == "ici":
                 # executor-plugin-init analogue: activate the shuffle
                 # mesh once per session (GpuShuffleEnv.initShuffleManager
@@ -148,6 +151,14 @@ class TpuSparkSession:
         import time as _time
 
         from spark_rapids_tpu.conf import EVENT_LOG_DIR, TASK_PARALLELISM
+        if self.conf_obj.sql_enabled:
+            # re-assert THIS session's kernel flags before executing:
+            # another session constructed since __init__ may have set a
+            # different hasNans (kernel_salt keys the program caches, so
+            # flips only change which cached trace is used)
+            from spark_rapids_tpu.conf import HAS_NANS
+            from spark_rapids_tpu.ops import groupby as _G
+            _G.set_has_nans(bool(self.conf_obj.get(HAS_NANS)))
         physical = self.plan_physical(plan)
         t0 = _time.perf_counter()
         result = physical.execute_collect(
