@@ -16,12 +16,10 @@ Prints ONE JSON line:
    "vs_baseline": device_speedup_over_cpu / 4.0}
 
 so vs_baseline >= 1.0 means matching the reference's typical published
-speedup on its own terms. Correctness is asserted before timing:
-long/string columns must match exactly; double aggregates compare at
-1e-9 relative tolerance (the documented float-aggregation carve-out the
-reference also makes, docs/compatibility.md — device sums run as
-segmented scans whose order differs from the CPU's sequential fold,
-enabled via spark.rapids.sql.variableFloatAgg.enabled).
+speedup on its own terms. Correctness is asserted before timing: with
+the real decimal(15,2) money columns (round 4), every aggregate is
+exact integer arithmetic, so ALL columns must match bit-for-bit —
+no float tolerance carve-out applies to q1 anymore.
 """
 
 from __future__ import annotations
@@ -41,7 +39,7 @@ N_ROWS = int(os.environ.get("BENCH_ROWS", SF1_ROWS))
 N_PARTITIONS = 8
 REFERENCE_TYPICAL_SPEEDUP = 4.0
 DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        ".bench-data", f"lineitem_{N_ROWS}")
+                        ".bench-data", f"lineitem_dec_{N_ROWS}")
 
 Q1 = """
 SELECT
@@ -63,17 +61,19 @@ ORDER BY l_returnflag, l_linestatus
 
 
 def make_lineitem():
-    """Seeded SF1-shaped lineitem: TPC-H column domains (dbgen 4.2.2.13),
-    uniform draws."""
+    """Seeded SF1-shaped lineitem with the REAL TPC-H schema: the money
+    columns are decimal(15,2) (dbgen 4.2.2.13 domains), generated as
+    unscaled int64 directly."""
     from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
     from spark_rapids_tpu.sql import types as T
 
+    DEC = T.DecimalType(15, 2)
     rng = np.random.default_rng(20260730)
     n = N_ROWS
-    quantity = rng.integers(1, 51, n).astype(np.float64)
-    extendedprice = np.round(rng.uniform(900.0, 105000.0, n), 2)
-    discount = np.round(rng.uniform(0.0, 0.10, n), 2)
-    tax = np.round(rng.uniform(0.0, 0.08, n), 2)
+    quantity = rng.integers(1, 51, n) * 100          # 1.00 .. 50.00
+    extendedprice = rng.integers(90100, 10494951, n)  # 901.00..104949.50
+    discount = rng.integers(0, 11, n)                 # 0.00 .. 0.10
+    tax = rng.integers(0, 9, n)                       # 0.00 .. 0.08
     returnflag = np.array(["A", "N", "R"], dtype=object)[
         rng.integers(0, 3, n)]
     linestatus = np.array(["O", "F"], dtype=object)[rng.integers(0, 2, n)]
@@ -82,10 +82,10 @@ def make_lineitem():
     hi = (np.datetime64("1998-12-01") - np.datetime64("1970-01-01")).astype(int)
     shipdate = rng.integers(lo, hi + 1, n).astype(np.int32)
     schema = T.StructType([
-        T.StructField("l_quantity", T.DoubleT),
-        T.StructField("l_extendedprice", T.DoubleT),
-        T.StructField("l_discount", T.DoubleT),
-        T.StructField("l_tax", T.DoubleT),
+        T.StructField("l_quantity", DEC),
+        T.StructField("l_extendedprice", DEC),
+        T.StructField("l_discount", DEC),
+        T.StructField("l_tax", DEC),
         T.StructField("l_returnflag", T.StringT),
         T.StructField("l_linestatus", T.StringT),
         T.StructField("l_shipdate", T.DateT),

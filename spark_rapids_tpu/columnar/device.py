@@ -101,6 +101,39 @@ class DeviceColumn:
 
 
 @dataclass
+class DeviceDecimal128Column:
+    """DECIMAL128 device column: unscaled value as two int64 limbs
+    (``hi`` signed high, ``lo`` holding the uint64 low bit pattern) —
+    the ops/int128 representation, resident in HBM. The reference keeps
+    these as cudf DECIMAL128 columns (decimalExpressions.scala); two
+    plain int64 arrays are the XLA-friendly shape of the same idea."""
+
+    dtype: T.DataType  # DecimalType, precision > 18
+    hi: jax.Array      # int64[capacity]
+    lo: jax.Array      # int64[capacity] (uint64 bit pattern)
+    validity: jax.Array
+
+    @property
+    def capacity(self) -> int:
+        return self.hi.shape[0]
+
+    @property
+    def data(self) -> jax.Array:
+        # sort/compact payload convenience: callers that need the limbs
+        # use .hi/.lo; generic code paths must go through arrays()
+        raise AttributeError("DeviceDecimal128Column has limbs, not data")
+
+    def arrays(self) -> Tuple[jax.Array, ...]:
+        return (self.hi, self.lo, self.validity)
+
+    @staticmethod
+    def from_arrays(dtype: T.DataType, arrs: Sequence[jax.Array]
+                    ) -> "DeviceDecimal128Column":
+        hi, lo, validity = arrs
+        return DeviceDecimal128Column(dtype, hi, lo, validity)
+
+
+@dataclass
 class DeviceStringColumn:
     """String/binary device column: padded byte matrix + lengths.
 
@@ -175,15 +208,15 @@ class DeviceArrayColumn:
 
 
 AnyDeviceColumn = Union[DeviceColumn, DeviceStringColumn,
-                        "DeviceArrayColumn"]
+                        DeviceDecimal128Column, "DeviceArrayColumn"]
 
 
 def column_arity(dtype: T.DataType) -> int:
     """Number of flat arrays a device column of `dtype` carries."""
     if isinstance(dtype, T.ArrayType):
         return 3 + column_arity(dtype.element_type)
-    if is_string_like(dtype):
-        return 3
+    if is_string_like(dtype) or T.is_limb_decimal(dtype):
+        return 3  # (chars, lengths, validity) / (hi, lo, validity)
     return 2
 
 
@@ -193,6 +226,8 @@ def make_column(dtype: T.DataType, arrs: Sequence[jax.Array]
         return DeviceArrayColumn.from_arrays(dtype, arrs)
     if is_string_like(dtype):
         return DeviceStringColumn.from_arrays(dtype, arrs)
+    if T.is_limb_decimal(dtype):
+        return DeviceDecimal128Column.from_arrays(dtype, arrs)
     return DeviceColumn.from_arrays(dtype, arrs)
 
 
@@ -368,6 +403,10 @@ def _np_col_to_host(dt: T.DataType, arrs: List[np.ndarray],
                 data[out_i] = (raw.decode("utf-8", errors="replace")
                                if validity[out_i] else "")
         return HostColumn(dt, data, validity)
+    if T.is_limb_decimal(dt):
+        hi, lo, validity = arrs
+        data = np.stack([hi[idx], lo[idx]], axis=1)
+        return HostColumn(dt, data, validity[idx].copy()).normalized()
     data, validity = arrs
     return HostColumn(dt, data[idx].copy(),
                       validity[idx].copy()).normalized()
@@ -469,11 +508,21 @@ def concat_device(batches: Sequence[DeviceBatch]) -> DeviceBatch:
 
 def mask_col(c: AnyDeviceColumn, keep: jax.Array) -> AnyDeviceColumn:
     """Null out rows outside `keep` (normalized zeros underneath)."""
+    if isinstance(c, DeviceArrayColumn):
+        v = c.validity & keep
+        z = jnp.zeros((), c.starts.dtype)
+        return DeviceArrayColumn(c.dtype, jnp.where(v, c.starts, z),
+                                 jnp.where(v, c.lengths, z), c.child, v)
     if isinstance(c, DeviceStringColumn):
         v = c.validity & keep
         return DeviceStringColumn(
             c.dtype, jnp.where(v[:, None], c.chars, 0),
             jnp.where(v, c.lengths, 0), v)
+    if isinstance(c, DeviceDecimal128Column):
+        v = c.validity & keep
+        z = jnp.zeros((), jnp.int64)
+        return DeviceDecimal128Column(c.dtype, jnp.where(v, c.hi, z),
+                                      jnp.where(v, c.lo, z), v)
     v = c.validity & keep
     return DeviceColumn(c.dtype, jnp.where(v, c.data,
                                            jnp.zeros((), c.data.dtype)), v)
@@ -581,6 +630,15 @@ def take_columns(columns: Sequence[AnyDeviceColumn], idx: jax.Array,
                 lengths = jnp.where(validity, lengths, 0)
                 chars = jnp.where(validity[:, None], chars, 0)
             out.append(DeviceStringColumn(c.dtype, chars, lengths, validity))
+        elif isinstance(c, DeviceDecimal128Column):
+            hi, lo = c.hi[idx], c.lo[idx]
+            validity = c.validity[idx]
+            if valid_at is not None:
+                validity = validity & valid_at
+                z = jnp.zeros((), jnp.int64)
+                hi = jnp.where(validity, hi, z)
+                lo = jnp.where(validity, lo, z)
+            out.append(DeviceDecimal128Column(c.dtype, hi, lo, validity))
         else:
             data = c.data[idx]
             validity = c.validity[idx]

@@ -54,6 +54,12 @@ class HostColumn:
         is_array = isinstance(self.dtype, T.ArrayType)
         epoch = datetime.date(1970, 1, 1)
         ts_epoch = datetime.datetime(1970, 1, 1)
+        if T.is_limb_decimal(self.dtype):
+            from spark_rapids_tpu.ops import int128 as I
+            ints = I.to_pyints(self.data[:, 0], self.data[:, 1])
+            return [decimal.Decimal(int(u)).scaleb(-dec_scale)
+                    if ok else None
+                    for u, ok in zip(ints, self.validity)]
         for i in range(len(self.data)):
             if not self.validity[i]:
                 out.append(None)
@@ -99,6 +105,12 @@ class HostColumn:
     def from_pylist(values: Sequence[Any], dtype: T.DataType) -> "HostColumn":
         n = len(values)
         validity = np.array([v is not None for v in values], dtype=bool)
+        if T.is_limb_decimal(dtype):
+            from spark_rapids_tpu.ops import int128 as I
+            ints = [0 if v is None else _to_storage(v, dtype)
+                    for v in values]
+            hi, lo = I.from_pyints(ints)
+            return HostColumn(dtype, np.stack([hi, lo], axis=1), validity)
         np_dt = T.numpy_dtype(dtype)
         if isinstance(dtype, T.ArrayType):
             # canonical element representation is STORAGE form (date ->
@@ -126,6 +138,9 @@ class HostColumn:
 
     @staticmethod
     def nulls(n: int, dtype: T.DataType) -> "HostColumn":
+        if T.is_limb_decimal(dtype):
+            return HostColumn(dtype, np.zeros((n, 2), dtype=np.int64),
+                              np.zeros(n, dtype=bool))
         np_dt = T.numpy_dtype(dtype)
         if np_dt == np.dtype(object):
             data = np.full(n, "", dtype=object)
@@ -140,6 +155,8 @@ class HostColumn:
         if isinstance(self.dtype, T.ArrayType):
             for i in np.nonzero(inv)[0]:
                 out.data[i] = ()
+        elif T.is_limb_decimal(self.dtype):
+            out.data[inv] = 0  # broadcasts over both limbs
         elif out.data.dtype == np.dtype(object):
             out.data[inv] = ""
         else:

@@ -6,13 +6,18 @@ Measured on the tunneled TPU backend (round-3 probe): H2D moves at
 container-dtype effect; the tunnel does not compress. So the upload path
 
   (a) narrows integer columns to the smallest int dtype that holds their
-      value range (Parquet-style bit-width reduction),
-  (b) bit-packs booleans and validity masks, and skips all-valid masks
-      entirely,
+      value range (Parquet-style bit-width reduction), shipping each as
+      its own buffer — the decode is then a pure elementwise astype.
+      (Weaving them into the staging words would decode via (n,2)
+      reshapes, whose TPU tiling pads the minor dim 2 -> 128: a 64x HBM
+      blowup that OOMs wide batches.)
+  (b) bit-packs booleans and validity masks into the int32 staging
+      words (skipping all-valid masks entirely), alongside the string
+      byte matrices,
   (c) ships only the real rows (no capacity padding on the wire), and
-  (d) concatenates every column into ONE int32 staging buffer moved by
-      ONE device_put, which a single jitted program decodes back into
-      full-width, capacity-padded columns in HBM.
+  (d) moves the staging words + raw buffers in ONE device_put, with a
+      single jitted program rebuilding full-width, capacity-padded
+      columns in HBM.
 
 The reference's scan path uses the same idea at the file level: copy the
 compact encoded bytes to the device once, decode there
@@ -148,9 +153,26 @@ def pack_batch(batch) -> Tuple[np.ndarray, List[np.ndarray], Tuple]:
             c_off = pk.add(chars)
             lk = ("i8" if char_cap <= 127 else
                   "i16" if char_cap <= 32767 else "i32")
-            l_off = pk.add(lengths.astype(
+            l_idx = len(extras)
+            extras.append(lengths.astype(
                 {"i8": np.int8, "i16": np.int16, "i32": np.int32}[lk]))
-            layout.append(("str", char_cap, c_off, lk, l_off, vdesc))
+            layout.append(("str", char_cap, c_off, lk, l_idx, vdesc))
+            continue
+        if T.is_limb_decimal(dt):
+            limbs = c.data[:n]
+            if not validity.all():
+                limbs = limbs.copy()
+                limbs[~validity] = 0
+            ent = ["dec128"]
+            for li in range(2):  # hi then lo, each narrowed like an int
+                ld = np.ascontiguousarray(limbs[:, li])
+                mn, mx = (int(ld.min()), int(ld.max())) if n else (0, 0)
+                kind = _narrow_kind(mn, mx)
+                ent.append(len(extras))
+                extras.append(ld.astype(
+                    np.dtype(kind.replace("i", "int"))))
+            ent.append(vdesc)
+            layout.append(tuple(ent))
             continue
         np_dt = T.numpy_dtype(dt)
         data = np.ascontiguousarray(c.data[:n])
@@ -179,7 +201,13 @@ def pack_batch(batch) -> Tuple[np.ndarray, List[np.ndarray], Tuple]:
             kind = kind if _KIND_WIDTH[kind] <= np_dt.itemsize else \
                 {1: "i8", 2: "i16", 4: "i32", 8: "i64"}[np_dt.itemsize]
             narrow = data.astype(np.dtype(kind.replace("i", "int")))
-            layout.append((kind, str(np_dt), pk.add(narrow), vdesc))
+            # narrowed ints ride as their OWN buffers: widening back is
+            # a pure elementwise astype. Weaving them through the int32
+            # staging words would decode via (n,2)-shaped reshapes whose
+            # TPU tiling pads the minor dim 2 -> 128 (a 64x HBM blowup
+            # that OOMs multi-column batches).
+            layout.append(("int", str(np_dt), len(extras), vdesc))
+            extras.append(narrow)
     return pk.words(), extras, tuple(layout)
 
 
@@ -220,23 +248,6 @@ def _build_decode(layout: Tuple, n: int, cap: int) -> Callable:
             bits = ((b[:, None] >> jnp.arange(8, dtype=jnp.int32)) & 1)
             return bits.reshape(-1)[:count].astype(jnp.bool_)
 
-        def decode_int(kind: str, off: int, count: int) -> jax.Array:
-            if kind == "i8":
-                b = jax.lax.slice(get_bytes(), (off,), (off + count,))
-                return (b ^ 0x80) - 0x80
-            if kind == "i16":
-                b = jax.lax.slice(get_bytes(), (off,), (off + 2 * count,))
-                p = b.reshape(count, 2)
-                v = p[:, 0] | (p[:, 1] << 8)
-                return (v ^ 0x8000) - 0x8000
-            w = off // 4
-            if kind == "i32":
-                return jax.lax.slice(words, (w,), (w + count,))
-            p = jax.lax.slice(words, (w,), (w + 2 * count,)
-                              ).reshape(count, 2).astype(jnp.int64)
-            lo = p[:, 0] & 0xFFFFFFFF
-            return (p[:, 1] << 32) | lo
-
         active = jnp.arange(cap) < n
         outs: List[jax.Array] = []
         for ent in layout:
@@ -247,14 +258,20 @@ def _build_decode(layout: Tuple, n: int, cap: int) -> Callable:
                 validity = _pad_cap(decode_bits(vdesc[1], n), n, cap)
             kind = ent[0]
             if kind == "str":
-                _, char_cap, c_off, lk, l_off, _ = ent
+                _, char_cap, c_off, lk, l_idx, _ = ent
                 chars = _pad_cap(
                     jax.lax.slice(get_bytes(), (c_off,),
                                   (c_off + n * char_cap,))
                     .reshape(n, char_cap).astype(jnp.uint8), n, cap)
                 lengths = _pad_cap(
-                    decode_int(lk, l_off, n).astype(jnp.int32), n, cap)
+                    extras[l_idx].astype(jnp.int32), n, cap)
                 outs.extend([chars, lengths, validity])
+            elif kind == "dec128":
+                _, i_hi, i_lo, _v = ent
+                hi = extras[i_hi].astype(jnp.int64)
+                lo = extras[i_lo].astype(jnp.int64)
+                outs.extend([_pad_cap(hi, n, cap), _pad_cap(lo, n, cap),
+                             validity])
             elif kind == "bool":
                 outs.extend([_pad_cap(decode_bits(ent[1], n), n, cap),
                              validity])
@@ -265,9 +282,9 @@ def _build_decode(layout: Tuple, n: int, cap: int) -> Callable:
                 raw = jax.lax.slice(words, (w,), (w + n,))
                 outs.extend([_pad_cap(jax.lax.bitcast_convert_type(
                     raw, jnp.float32), n, cap), validity])
-            else:
-                _, np_dt, off, _ = ent
-                data = decode_int(kind, off, n).astype(jnp.dtype(np_dt))
+            else:  # "int": own narrowed buffer, widen elementwise
+                _, np_dt, idx, _v = ent
+                data = extras[idx].astype(jnp.dtype(np_dt))
                 outs.extend([_pad_cap(data, n, cap), validity])
         return active, tuple(outs)
 
@@ -314,6 +331,11 @@ def _stage_column(c, dt: T.DataType, cap: int) -> List[np.ndarray]:
         lengths = np.zeros(cap, dtype=np.int32)
         lengths[:n] = ln
         return [chars, lengths, validity]
+    if T.is_limb_decimal(dt):
+        limbs = np.zeros((cap, 2), dtype=np.int64)
+        limbs[:n] = c.normalized().data
+        return [np.ascontiguousarray(limbs[:, 0]),
+                np.ascontiguousarray(limbs[:, 1]), validity]
     np_dt = T.numpy_dtype(dt)
     data = np.zeros(cap, dtype=np_dt)
     data[:n] = c.normalized().data
@@ -378,7 +400,9 @@ def finish_upload(staged, device: Optional[jax.Device] = None):
     else:
         dev = jax.device_put(bufs)
     active, outs = fn(dev[0], *dev[1:])
-    spec = [(f.data_type, 3 if D.is_string_like(f.data_type) else 2)
+    spec = [(f.data_type,
+             3 if (D.is_string_like(f.data_type)
+                   or T.is_limb_decimal(f.data_type)) else 2)
             for f in schema.fields]
     return D.DeviceBatch(schema, D.rebuild_columns(spec, outs),
                          active, n)

@@ -76,6 +76,27 @@ def dev_evaluate(func: E.AggregateFunction,
     if isinstance(func, E.Average):
         s, cnt = buffers[0], buffers[1]
         count = jnp.where(cnt.validity, cnt.data, jnp.int64(0))
+        dec = func._child_decimal()
+        if dec is not None:
+            # HALF_UP(sum * 10^(s_res - s) / count) in 128-bit limbs —
+            # the twin of the host Average.evaluate decimal path
+            from spark_rapids_tpu.columnar.device import (
+                DeviceDecimal128Column)
+            from spark_rapids_tpu.ops import decimal_ops as DD
+            from spark_rapids_tpu.ops import int128 as I
+            res = func.data_type
+            if isinstance(s, DeviceDecimal128Column):
+                hi, lo = s.hi, s.lo
+            else:
+                hi, lo = I.from_i64(jnp, s.data.astype(jnp.int64))
+            hi, lo, over = DD.rescale_up(jnp, hi, lo,
+                                         max(res.scale - dec.scale, 0))
+            nz = count > 0
+            qh, ql = I.div_halfup(jnp, hi, lo,
+                                  jnp.where(nz, count, jnp.int64(1)))
+            validity = s.validity & nz & out_active & ~over \
+                & I.fits_precision(jnp, qh, ql, res.precision)
+            return X._limbs_to_devcol(qh, ql, validity, res)
         validity = (count > 0) & out_active
         data = s.data.astype(jnp.float64) / jnp.where(
             count > 0, count, jnp.int64(1)).astype(jnp.float64)
@@ -98,8 +119,6 @@ def is_device_agg(grouping: List[E.AttributeReference],
     """Tagging helper: None if the whole aggregate can run on device."""
     from spark_rapids_tpu import device_caps as DC
     for g in grouping:
-        if isinstance(g.data_type, T.DecimalType):
-            return "decimal grouping keys run on CPU"
         if isinstance(g.data_type, (T.ArrayType, T.MapType, T.StructType)):
             return "nested grouping keys are not supported on TPU"
     for e in aggregates:
@@ -112,8 +131,12 @@ def is_device_agg(grouping: List[E.AttributeReference],
                                      E.Average, E.First, E.Last)):
                 return (f"aggregate {type(func).__name__} has no device "
                         "implementation")
-            if isinstance(func, E.Average) and not DC.float_div_exact() \
+            if isinstance(func, E.Average) \
+                    and func._child_decimal() is None \
+                    and not DC.float_div_exact() \
                     and not _float_agg_allowed(conf):
+                # decimal averages divide in exact integer limbs and
+                # never hit the emulated-f64 concern
                 # the final sum/count division is emulated on this backend;
                 # same knob as ordering-variable float aggs (the reference's
                 # spark.rapids.sql.variableFloatAgg.enabled semantics:
@@ -122,6 +145,9 @@ def is_device_agg(grouping: List[E.AttributeReference],
                         "CPU on this backend (TPU f64 is emulated); set "
                         "spark.rapids.sql.variableFloatAgg.enabled=true "
                         "to allow")
+            # decimal Average's adjusted result scale can never drop
+            # below the child's (38 - (p - s) >= s for every p <= 38),
+            # so its rescale is always an exact scale-UP — no gate needed
             for s in func.buffer_slots():
                 r = X.is_device_expr(s[3], conf) if isinstance(
                     s[3], E.Expression) else None
@@ -129,9 +155,7 @@ def is_device_agg(grouping: List[E.AttributeReference],
                     return r
                 if isinstance(s[3], E.Expression) and \
                         X.contains_ansi_cast(s[3]):
-                    return "ANSI casts in aggregate inputs run on CPU" 
-                if isinstance(s[1], T.DecimalType):
-                    return "decimal aggregate buffers run on CPU"
+                    return "ANSI casts in aggregate inputs run on CPU"
     return None
 
 

@@ -43,8 +43,6 @@ def is_device_join(join_type: str, left_keys: List[E.Expression],
     for lk, rk in zip(left_keys, right_keys):
         for e in (lk, rk):
             dt = e.data_type
-            if isinstance(dt, T.DecimalType):
-                return "decimal join keys run on CPU"
             if isinstance(dt, (T.ArrayType, T.MapType, T.StructType)):
                 return "nested join keys are not supported on TPU"
             r = X.is_device_expr(e, conf)

@@ -45,8 +45,6 @@ def is_device_sort(order: List[E.SortOrder], conf: TpuConf):
     from spark_rapids_tpu.sql import types as T
     for o in order:
         dt = o.child.data_type
-        if isinstance(dt, T.DecimalType):
-            return "decimal sort keys run on CPU"
         if isinstance(dt, (T.ArrayType, T.MapType, T.StructType)):
             return "nested sort keys are not supported on TPU"
         r = X.is_device_expr(o.child, conf)

@@ -55,8 +55,7 @@ def is_device_window(window_exprs: List[E.Expression],
     """Tagging helper (GpuWindowExpression tagging rules)."""
     for e in partition_spec:
         dt = e.data_type
-        if isinstance(dt, (T.DecimalType, T.ArrayType, T.MapType,
-                           T.StructType)):
+        if isinstance(dt, (T.ArrayType, T.MapType, T.StructType)):
             return f"window partition key type {dt} runs on CPU"
         r = X.is_device_expr(e, conf)
         if r:
@@ -65,8 +64,7 @@ def is_device_window(window_exprs: List[E.Expression],
             return "ANSI casts in window partition keys run on CPU"
     for o in order_spec:
         dt = o.child.data_type
-        if isinstance(dt, (T.DecimalType, T.ArrayType, T.MapType,
-                           T.StructType)):
+        if isinstance(dt, (T.ArrayType, T.MapType, T.StructType)):
             return f"window order key type {dt} runs on CPU"
         r = X.is_device_expr(o.child, conf)
         if r:
@@ -82,6 +80,8 @@ def is_device_window(window_exprs: List[E.Expression],
         if isinstance(func, (E.RowNumber, E.Rank, E.DenseRank, E.NTile)):
             continue
         if isinstance(func, E.Lag):  # covers Lead
+            if T.is_limb_decimal(func.input.data_type):
+                return "lag/lead over decimal128 columns runs on CPU"
             r = X.is_device_expr(func.input, conf)
             if r:
                 return r

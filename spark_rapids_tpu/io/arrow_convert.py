@@ -109,11 +109,30 @@ def arrow_column_to_host(arr: pa.ChunkedArray | pa.Array,
     if isinstance(arr, pa.ChunkedArray):
         arr = arr.combine_chunks()
     n = len(arr)
-    np_dt = T.numpy_dtype(dt)
     if arr.null_count:
         validity = np.asarray(arr.is_valid())
     else:
         validity = np.ones(n, dtype=bool)
+    if isinstance(dt, T.DecimalType):
+        # vectorized: decimal128 buffers ARE 16-byte little-endian
+        # two's-complement ints — view them as (lo, hi) int64 limb
+        # pairs (the engine's unscaled storage) with no per-row loop
+        a = arr
+        want = pa.decimal128(dt.precision, dt.scale)
+        if a.type != want:
+            a = a.cast(want)
+        buf = a.buffers()[1]
+        raw = np.frombuffer(buf, dtype=np.int64,
+                            count=2 * (a.offset + n))[2 * a.offset:]
+        lo = raw[0::2].copy()
+        hi = raw[1::2].copy()
+        if arr.null_count:
+            lo[~validity] = 0
+            hi[~validity] = 0
+        if T.is_limb_decimal(dt):
+            return HostColumn(dt, np.stack([hi, lo], axis=1), validity)
+        return HostColumn(dt, lo, validity)
+    np_dt = T.numpy_dtype(dt)
     if isinstance(dt, T.ArrayType):
         la = arr
         if pa.types.is_large_list(la.type):
@@ -138,15 +157,6 @@ def arrow_column_to_host(arr: pa.ChunkedArray | pa.Array,
         if arr.null_count:
             data = data.copy()
             data[~validity] = ""
-        return HostColumn(dt, data, validity)
-    if isinstance(dt, T.DecimalType):
-        # unscaled int64 storage
-        py = arr.to_pylist()
-        data = np.zeros(n, dtype=np.int64)
-        scale = dt.scale
-        for i, v in enumerate(py):
-            if v is not None:
-                data[i] = int(v.scaleb(scale))
         return HostColumn(dt, data, validity)
     if isinstance(dt, T.TimestampType):
         arr = arr.cast(pa.timestamp("us"))
@@ -207,10 +217,23 @@ def host_column_to_arrow(c: HostColumn) -> pa.Array:
             pa.array(offsets, type=pa.int32()), child,
             mask=pa.array(mask) if mask is not None else None)
     if isinstance(dt, T.DecimalType):
-        import decimal
-        vals = [decimal.Decimal(int(v)).scaleb(-dt.scale) if ok else None
-                for v, ok in zip(c.data.tolist(), c.validity.tolist())]
-        return pa.array(vals, type=at)
+        # limbs/int64 -> raw 16-byte decimal128 buffer, no per-row loop
+        if T.is_limb_decimal(dt):
+            hi = np.ascontiguousarray(c.data[:, 0])
+            lo = np.ascontiguousarray(c.data[:, 1])
+        else:
+            lo = c.data.astype(np.int64)
+            hi = lo >> np.int64(63)  # sign extension
+        pairs = np.empty((len(lo), 2), dtype=np.int64)
+        pairs[:, 0] = lo
+        pairs[:, 1] = hi
+        buf = pa.py_buffer(np.ascontiguousarray(pairs).tobytes())
+        if mask is not None:
+            vbits = pa.array(~np.asarray(mask), type=pa.bool_()) \
+                .buffers()[1]
+            return pa.Array.from_buffers(at, len(lo), [vbits, buf],
+                                         null_count=int(mask.sum()))
+        return pa.Array.from_buffers(at, len(lo), [None, buf])
     if isinstance(dt, T.TimestampType):
         a = pa.array(c.data.astype(np.int64), type=pa.int64(), mask=mask)
         return a.cast(at)

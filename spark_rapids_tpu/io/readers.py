@@ -125,12 +125,18 @@ def _infer_part_type(raw: List[str]) -> T.DataType:
 @dataclass
 class ScanUnit:
     """One decode unit: a file, or a row-group range of a parquet file
-    (the reference's filtered-block unit, GpuParquetScanBase.scala:1363)."""
+    (the reference's filtered-block unit, GpuParquetScanBase.scala:1363).
+
+    ``stats`` holds per-column footer statistics for predicate pushdown:
+    {name: (min, max, null_count, num_rows)} with None min/max when the
+    footer has none (the reference prunes on the same footer stats,
+    GpuParquetScanBase filterBlocks)."""
 
     path: str
     size_bytes: int
     row_groups: Optional[List[int]] = None  # parquet only; None = whole file
     part_values: Optional[Dict[str, str]] = None  # Hive dir values
+    stats: Optional[Dict[str, tuple]] = None
 
 
 # Footer-parse results memoized per (fmt, file set), invalidated by the
@@ -162,8 +168,22 @@ def plan_scan_units(fmt: str, files: List[tuple]) -> List[ScanUnit]:
                                       part_values=pv))
                 continue
             for rg in range(meta.num_row_groups):
+                rgm = meta.row_group(rg)
+                stats: Dict[str, tuple] = {}
+                for ci in range(rgm.num_columns):
+                    col = rgm.column(ci)
+                    st = col.statistics
+                    name = col.path_in_schema.split(".")[0]
+                    if st is None:
+                        stats[name] = (None, None, None, rgm.num_rows)
+                    else:
+                        stats[name] = (
+                            st.min if st.has_min_max else None,
+                            st.max if st.has_min_max else None,
+                            st.null_count if st.has_null_count else None,
+                            rgm.num_rows)
                 units.append(ScanUnit(
-                    f, meta.row_group(rg).total_byte_size, [rg], pv))
+                    f, rgm.total_byte_size, [rg], pv, stats))
             if meta.num_row_groups == 0:
                 units.append(ScanUnit(f, 0, [], pv))
     else:
@@ -323,6 +343,62 @@ def _shared_pool(n_threads: int) -> ThreadPoolExecutor:
         return _READ_POOL
 
 
+def _stat_storage(v, dt: T.DataType):
+    """Footer stat value -> the engine's storage form (days/micros/
+    unscaled int); None when not convertible (disables pruning)."""
+    from spark_rapids_tpu.columnar.host import _to_storage
+    try:
+        out = _to_storage(v, dt)
+        if isinstance(out, (int, float, str)):
+            return out
+        return None
+    except Exception:
+        return None
+
+
+def unit_can_match(u: ScanUnit, preds: List[tuple],
+                   fields: Dict[str, T.DataType]) -> bool:
+    """False when this row-group's footer stats PRECLUDE any row
+    matching every pushed conjunct (GpuParquetScanBase filterBlocks /
+    parquet-mr StatisticsFilter shape). Conservative: missing stats or
+    unconvertible values keep the unit."""
+    if u.stats is None:
+        return True
+    for name, op, val in preds:
+        st = u.stats.get(name)
+        if st is None:
+            continue
+        mn, mx, nulls, n_rows = st
+        dt = fields.get(name)
+        if op == "notnull":
+            if nulls is not None and n_rows and nulls == n_rows:
+                return False
+            continue
+        if op == "isnull":
+            if nulls is not None and nulls == 0 and n_rows:
+                return False
+            continue
+        if mn is None or mx is None or dt is None:
+            continue
+        lo, hi = _stat_storage(mn, dt), _stat_storage(mx, dt)
+        if lo is None or hi is None:
+            continue
+        try:
+            if op == "eq" and (val < lo or val > hi):
+                return False
+            if op == "lt" and lo >= val:
+                return False
+            if op == "le" and lo > val:
+                return False
+            if op == "gt" and hi <= val:
+                return False
+            if op == "ge" and hi < val:
+                return False
+        except TypeError:
+            continue  # cross-type compare: keep the unit
+    return True
+
+
 class CpuFileScanExec(P.PhysicalPlan):
     """File source scan; feeds the device through the transparent R2C
     transition (GpuFileSourceScanExec's role, host-decode variant)."""
@@ -340,18 +416,42 @@ class CpuFileScanExec(P.PhysicalPlan):
         part_names = {k for _f, pv in listed for k in pv}
         self._part_fields = [f for f in self.schema.fields
                              if f.name in part_names]
-        max_bytes = int(conf.get_key("spark.sql.files.maxPartitionBytes",
-                                     DEFAULT_MAX_PARTITION_BYTES))
-        self._parts = pack_partitions(
-            plan_scan_units(fmt, listed), max_bytes)
+        self._max_bytes = int(
+            conf.get_key("spark.sql.files.maxPartitionBytes",
+                         DEFAULT_MAX_PARTITION_BYTES))
+        self._units = plan_scan_units(fmt, listed)
+        self._pushed: List[tuple] = []  # (col, op, storage value)
+        self.pruned_units = 0  # observability (tools/tests)
+        self._parts = pack_partitions(self._units, self._max_bytes)
+
+    def set_pushdown(self, preds: List[tuple]) -> None:
+        """Install pushed-down predicates (name, op, storage-value) and
+        prune row-group units whose footer stats preclude matches. The
+        enclosing Filter node still runs, so pruning is free to be
+        conservative."""
+        self._pushed = preds
+        if not preds or self.fmt != "parquet":
+            return
+        fields = {f.name: f.data_type for f in self.schema.fields}
+        kept = [u for u in self._units
+                if unit_can_match(u, preds, fields)]
+        self.pruned_units = len(self._units) - len(kept)
+        # always at least one (possibly empty) partition so global
+        # aggregates still see a partition to produce their one row in
+        self._parts = pack_partitions(kept, self._max_bytes) \
+            if kept else [[]]
 
     @property
     def output(self):
         return self._output
 
     def simple_string(self):
-        return (f"FileScan {self.fmt} [{len(self.files)} files, "
-                f"{len(self._parts)} partitions]")
+        s = (f"FileScan {self.fmt} [{len(self.files)} files, "
+             f"{len(self._parts)} partitions")
+        if self._pushed:
+            s += (f", pushed {len(self._pushed)} filters, "
+                  f"pruned {self.pruned_units} units")
+        return s + "]"
 
     def partitions(self):
         reader_type = str(self.conf.get(PARQUET_READER_TYPE)).upper()

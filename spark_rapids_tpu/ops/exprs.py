@@ -53,11 +53,18 @@ jax.tree_util.register_pytree_node(
     lambda dt, ch: DeviceStringColumn(dt, *ch))
 
 from spark_rapids_tpu.columnar.device import DeviceArrayColumn  # noqa: E402
+from spark_rapids_tpu.columnar.device import (  # noqa: E402
+    DeviceDecimal128Column)
 
 jax.tree_util.register_pytree_node(
     DeviceArrayColumn,
     lambda c: ((c.starts, c.lengths, c.child, c.validity), c.dtype),
     lambda dt, ch: DeviceArrayColumn(dt, ch[0], ch[1], ch[2], ch[3]))
+
+jax.tree_util.register_pytree_node(
+    DeviceDecimal128Column,
+    lambda c: ((c.hi, c.lo, c.validity), c.dtype),
+    lambda dt, ch: DeviceDecimal128Column(dt, *ch))
 
 
 # ---------------------------------------------------------------------------
@@ -308,6 +315,9 @@ def is_device_expr(e: E.Expression, conf=None) -> Optional[str]:
         return leaf_support(e)
     if type(e) not in _HANDLERS:
         return f"expression {type(e).__name__} is not supported on TPU"
+    r = _limb_decimal_gate(e)
+    if r:
+        return r
     if not _incompat_allowed(conf):
         r = platform_gate(e)
         if r:
@@ -321,6 +331,34 @@ def is_device_expr(e: E.Expression, conf=None) -> Optional[str]:
         r = _child_ok(e, i, c, conf)
         if r:
             return r
+    return None
+
+
+# DECIMAL128 limb columns flow only through the expressions with
+# limb-aware device kernels; anything else would touch .data and crash,
+# so it is tagged back to CPU here (TypeChecks DECIMAL128 gating role).
+_LIMB_OK_EXPRS = None
+
+
+def _limb_decimal_gate(e: E.Expression) -> Optional[str]:
+    global _LIMB_OK_EXPRS
+    if _LIMB_OK_EXPRS is None:
+        _LIMB_OK_EXPRS = {
+            E.Add, E.Subtract, E.Multiply, E.Divide, E.UnaryMinus,
+            E.Abs, E.Cast, E.EqualTo, E.EqualNullSafe, E.LessThan,
+            E.LessThanOrEqual, E.GreaterThan, E.GreaterThanOrEqual,
+            E.IsNull, E.IsNotNull, E.Alias,
+        }
+    if type(e) in _LIMB_OK_EXPRS:
+        return None
+    for c in e.children:
+        dt = getattr(c, "data_type", None)
+        if dt is not None and T.is_limb_decimal(dt):
+            return (f"{type(e).__name__} over decimal128 columns runs "
+                    "on CPU")
+    dt = getattr(e, "data_type", None)
+    if dt is not None and T.is_limb_decimal(dt):
+        return f"{type(e).__name__} producing decimal128 runs on CPU"
     return None
 
 
@@ -453,10 +491,47 @@ def _binary_cols(e: E.Expression, ctx: Ctx):
     return dev_eval(e.children[0], ctx), dev_eval(e.children[1], ctx)
 
 
+def _dec_limbs_dev(c: AnyDeviceColumn):
+    """Device column (decimal) -> (hi, lo) int64 limb arrays."""
+    from spark_rapids_tpu.columnar.device import DeviceDecimal128Column
+    from spark_rapids_tpu.ops import int128 as I
+    if isinstance(c, DeviceDecimal128Column):
+        return c.hi, c.lo
+    return I.from_i64(jnp, c.data.astype(jnp.int64))
+
+
+def _limbs_to_devcol(hi, lo, validity, dt: T.DataType):
+    from spark_rapids_tpu.columnar.device import DeviceDecimal128Column
+    z = jnp.int64(0)
+    hi = jnp.where(validity, hi, z)
+    lo = jnp.where(validity, lo, z)
+    if T.is_limb_decimal(dt):
+        return DeviceDecimal128Column(dt, hi, lo, validity)
+    return DeviceColumn(dt, lo, validity)  # <=18 digits: lo IS the value
+
+
+def _h_dec_arith(e, lc, rc, validity) -> AnyDeviceColumn:
+    """Device +,-,* on decimals (ops/decimal_ops limb kernels; the
+    GpuDecimalMultiply/AddSub twins, decimalExpressions.scala)."""
+    from spark_rapids_tpu.ops import decimal_ops as D
+    lt, rt = lc.dtype, rc.dtype
+    res = e.data_type
+    ahi, alo = _dec_limbs_dev(lc)
+    bhi, blo = _dec_limbs_dev(rc)
+    if isinstance(e, E.Multiply):
+        hi, lo, ok = D.mul(jnp, ahi, alo, bhi, blo, lt, rt, res)
+    else:
+        sym = "+" if isinstance(e, E.Add) else "-"
+        hi, lo, ok = D.add_sub(jnp, sym, ahi, alo, bhi, blo, lt, rt, res)
+    return _limbs_to_devcol(hi, lo, validity & ok, res)
+
+
 @handles(E.Add, E.Subtract, E.Multiply)
 def _h_addmul(e, ctx: Ctx) -> DeviceColumn:
     lc, rc = _binary_cols(e, ctx)
     validity = _valid_and([lc, rc])
+    if isinstance(e.data_type, T.DecimalType):
+        return _h_dec_arith(e, lc, rc, validity)
     op = {E.Add: jnp.add, E.Subtract: jnp.subtract,
           E.Multiply: jnp.multiply}[type(e)]
     data = op(lc.data, rc.data)
@@ -468,14 +543,42 @@ def _h_addmul(e, ctx: Ctx) -> DeviceColumn:
 
 @extra_check(E.Add, E.Subtract, E.Multiply, E.UnaryMinus, E.Abs)
 def _c_arith(e) -> Optional[str]:
-    if isinstance(e.data_type, T.DecimalType):
-        return "decimal arithmetic runs on CPU until the decimal pass"
+    dt = e.data_type
+    if isinstance(dt, T.DecimalType) and isinstance(
+            e, (E.Add, E.Subtract, E.Multiply)):
+        from spark_rapids_tpu.ops import decimal_ops as D
+        lt = e.children[0].data_type
+        rt = e.children[1].data_type
+        if not (isinstance(lt, T.DecimalType)
+                and isinstance(rt, T.DecimalType)):
+            return "mixed decimal arithmetic operands run on CPU"
+        if isinstance(e, E.Multiply):
+            if not D.mul_supported(lt, rt):
+                return ("decimal multiply beyond the 128-bit envelope "
+                        "runs on CPU")
+        elif not D.add_sub_supported(lt, rt):
+            return ("decimal add/sub with a deep capped rescale runs "
+                    "on CPU")
     return None
 
 
 @handles(E.Divide)
 def _h_divide(e: E.Divide, ctx: Ctx) -> DeviceColumn:
     lc, rc = _binary_cols(e, ctx)
+    if isinstance(e.data_type, T.DecimalType):
+        from spark_rapids_tpu.ops import decimal_ops as D
+        from spark_rapids_tpu.columnar.device import DeviceDecimal128Column
+        res = e.data_type
+        # div_supported (the _c_divide gate) caps the divisor at 18
+        # digits, so it is always a plain int64 column here
+        assert not isinstance(rc, DeviceDecimal128Column), rc.dtype
+        d = rc.data.astype(jnp.int64)
+        nonzero = d != 0
+        validity = _valid_and([lc, rc]) & nonzero
+        ahi, alo = _dec_limbs_dev(lc)
+        d_safe = jnp.where(nonzero, d, jnp.int64(1))
+        hi, lo, ok = D.div(jnp, ahi, alo, d_safe, lc.dtype, rc.dtype, res)
+        return _limbs_to_devcol(hi, lo, validity & ok, res)
     validity = _valid_and([lc, rc]) & (rc.data != 0)
     safe = jnp.where(rc.data != 0, rc.data, jnp.ones((), rc.data.dtype))
     data = jnp.divide(lc.data, safe)
@@ -488,7 +591,14 @@ def _h_divide(e: E.Divide, ctx: Ctx) -> DeviceColumn:
 @extra_check(E.Divide)
 def _c_divide(e) -> Optional[str]:
     if isinstance(e.data_type, T.DecimalType):
-        return "decimal division runs on CPU"
+        from spark_rapids_tpu.ops import decimal_ops as D
+        lt = e.children[0].data_type
+        rt = e.children[1].data_type
+        if not (isinstance(lt, T.DecimalType)
+                and isinstance(rt, T.DecimalType)
+                and D.div_supported(lt, rt)):
+            return ("decimal division beyond the 128-bit envelope "
+                    "runs on CPU")
     return None
 
 
@@ -534,12 +644,20 @@ def _h_pmod(e: E.Pmod, ctx: Ctx) -> DeviceColumn:
 @handles(E.UnaryMinus)
 def _h_neg(e: E.UnaryMinus, ctx: Ctx) -> DeviceColumn:
     c = dev_eval(e.child, ctx)
+    if T.is_limb_decimal(e.data_type):
+        from spark_rapids_tpu.ops import int128 as I
+        hi, lo = I.neg(jnp, *_dec_limbs_dev(c))
+        return _limbs_to_devcol(hi, lo, c.validity, e.data_type)
     return DeviceColumn(e.data_type, -c.data, c.validity)
 
 
 @handles(E.Abs)
 def _h_abs(e: E.Abs, ctx: Ctx) -> DeviceColumn:
     c = dev_eval(e.child, ctx)
+    if T.is_limb_decimal(e.data_type):
+        from spark_rapids_tpu.ops import int128 as I
+        hi, lo = I.abs_(jnp, *_dec_limbs_dev(c))
+        return _limbs_to_devcol(hi, lo, c.validity, e.data_type)
     return DeviceColumn(e.data_type, jnp.abs(c.data), c.validity)
 
 
@@ -556,6 +674,17 @@ _CMP_OPS = {
 def _compare(op: str, lc: AnyDeviceColumn, rc: AnyDeviceColumn) -> jax.Array:
     if isinstance(lc, DeviceStringColumn):
         lt, eq = _str_compare(lc, rc)
+        gt = ~(lt | eq)
+        return {"eq": eq, "lt": lt, "le": lt | eq, "gt": gt,
+                "ge": gt | eq}[op]
+    from spark_rapids_tpu.columnar.device import DeviceDecimal128Column
+    if isinstance(lc, DeviceDecimal128Column) or \
+            isinstance(rc, DeviceDecimal128Column):
+        from spark_rapids_tpu.ops import int128 as I
+        ahi, alo = _dec_limbs_dev(lc)
+        bhi, blo = _dec_limbs_dev(rc)
+        lt = I.cmp_lt(jnp, ahi, alo, bhi, blo)
+        eq = I.eq(jnp, ahi, alo, bhi, blo)
         gt = ~(lt | eq)
         return {"eq": eq, "lt": lt, "le": lt | eq, "gt": gt,
                 "ge": gt | eq}[op]
@@ -1092,6 +1221,19 @@ def device_cast_supported(frm: T.DataType, to: T.DataType,
     shape): None when the from->to leg runs on device."""
     if frm == to:
         return None
+    if isinstance(frm, T.DecimalType) or isinstance(to, T.DecimalType):
+        from spark_rapids_tpu.ops import decimal_ops as DD
+        if isinstance(frm, T.DecimalType) and isinstance(to, T.DecimalType):
+            return None if DD.cast_supported(frm, to) else \
+                "deep decimal down-rescale runs on CPU"
+        if isinstance(to, T.DecimalType) and (
+                T.is_integral(frm) or isinstance(frm, T.BooleanType)):
+            return None
+        if isinstance(frm, T.DecimalType) and (
+                T.is_integral(to) or T.is_floating(to)):
+            return None
+        return (f"cast {frm.simple_string} -> {to.simple_string} "
+                "on TPU")
     is_plain_num = (lambda t: T.is_numeric(t)
                     and not isinstance(t, T.DecimalType))
     ok_num = is_plain_num(frm) and is_plain_num(to)
@@ -1125,12 +1267,77 @@ def contains_ansi_cast(e: E.Expression) -> bool:
     return bool(e.collect(lambda x: isinstance(x, E.Cast) and x.ansi))
 
 
+def _cast_decimal_device(c: AnyDeviceColumn, to: T.DataType, ctx: Ctx,
+                         ansi: bool) -> AnyDeviceColumn:
+    """Decimal device cast legs (GpuCast decimal rows of the matrix):
+    decimal<->decimal rescale, integral->decimal, decimal->floating,
+    decimal->integral. Gating in device_cast_supported keeps the rest
+    off-device."""
+    from spark_rapids_tpu.ops import decimal_ops as D
+    from spark_rapids_tpu.ops import int128 as I
+    frm = c.dtype
+    if isinstance(frm, T.DecimalType) and isinstance(to, T.DecimalType):
+        hi, lo = _dec_limbs_dev(c)
+        hi, lo, ok = D.cast_decimal(jnp, hi, lo, frm, to)
+        if ansi:
+            ctx.record_error(~ok & c.validity,
+                             "Decimal overflow in ANSI mode")
+        return _limbs_to_devcol(hi, lo, c.validity & ok, to)
+    if isinstance(to, T.DecimalType):  # integral/boolean source
+        src = c.data.astype(jnp.int64)
+        hi, lo = I.from_i64(jnp, src)
+        hi, lo, over = D.rescale_up(jnp, hi, lo, to.scale)
+        ok = ~over & I.fits_precision(jnp, hi, lo, to.precision)
+        if ansi:
+            ctx.record_error(~ok & c.validity,
+                             "Decimal overflow in ANSI mode")
+        return _limbs_to_devcol(hi, lo, c.validity & ok, to)
+    # decimal source -> floating / integral
+    hi, lo = _dec_limbs_dev(c)
+    if T.is_floating(to):
+        from spark_rapids_tpu.ops import int128 as I
+        # values fitting int64 convert exactly; the 2-term wide path
+        # would cancel catastrophically for small negatives (hi=-1)
+        v64, small = I.to_i64(jnp, hi, lo)
+        ulo = lo.view(jnp.uint64).astype(jnp.float64)
+        wide = hi.astype(jnp.float64) * jnp.float64(2.0 ** 64) + ulo
+        # reciprocal multiply == what XLA folds constant division into;
+        # the host legs use the same form so results match bit-for-bit
+        data = jnp.where(small, v64.astype(jnp.float64), wide) \
+            * jnp.float64(1.0 / 10.0 ** frm.scale)
+        return DeviceColumn(to, data.astype(storage_jnp_dtype(to)),
+                            c.validity)
+    # integral target: truncate toward zero (exact two-step floor on
+    # magnitudes; floor division composes, unlike HALF_UP)
+    mhi, mlo = I.abs_(jnp, hi, lo)
+    d1 = jnp.int64(10 ** min(frm.scale, 18))
+    qh, ql, _r = I.divmod_u128_by_u64(jnp, mhi, mlo, d1)
+    if frm.scale > 18:
+        qh, ql, _r2 = I.divmod_u128_by_u64(
+            jnp, qh, ql, jnp.int64(10 ** (frm.scale - 18)))
+    neg = I.is_neg(jnp, hi, lo)
+    nh, nl = I.neg(jnp, qh, ql)
+    qh = jnp.where(neg, nh, qh)
+    ql = jnp.where(neg, nl, ql)
+    v, fits = I.to_i64(jnp, qh, ql)
+    info = np.iinfo(np.dtype(str(storage_jnp_dtype(to))))
+    ok = fits & (v >= info.min) & (v <= info.max)
+    if ansi:
+        ctx.record_error(~ok & c.validity, "Cast overflow in ANSI mode")
+    validity = c.validity & ok
+    data = jnp.where(validity, v, jnp.int64(0)).astype(
+        storage_jnp_dtype(to))
+    return DeviceColumn(to, data, validity)
+
+
 def cast_device_column(c: AnyDeviceColumn, to: T.DataType, ctx: Ctx,
                        ansi: bool = False) -> AnyDeviceColumn:
     from spark_rapids_tpu.ops import cast as CK
     frm = c.dtype
     if frm == to:
         return c
+    if isinstance(frm, T.DecimalType) or isinstance(to, T.DecimalType):
+        return _cast_decimal_device(c, to, ctx, ansi)
     if isinstance(frm, T.StringType) and not isinstance(to, T.StringType):
         return _cast_string_device(c, to, ctx)
     if isinstance(to, T.StringType):
@@ -1227,20 +1434,11 @@ _PROJECT_CACHE: Dict[Tuple, Callable] = {}
 def _build_project(exprs: Tuple[E.Expression, ...]) -> Callable:
     def fn(cols, active, lit_vals):
         ctx = Ctx(cols, active.shape[0], exprs, lit_vals)
+        from spark_rapids_tpu.columnar.device import mask_col
         outs = []
         for e in exprs:
-            out = dev_eval(e, ctx)
             # padding rows must stay normalized for determinism
-            if isinstance(out, DeviceStringColumn):
-                v = out.validity & active
-                outs.append(DeviceStringColumn(
-                    out.dtype, jnp.where(v[:, None], out.chars, 0),
-                    jnp.where(v, out.lengths, 0), v))
-            else:
-                v = out.validity & active
-                outs.append(DeviceColumn(
-                    out.dtype, jnp.where(v, out.data,
-                                         _zero(out.data.dtype)), v))
+            outs.append(mask_col(dev_eval(e, ctx), active))
         # ANSI errors collapse into ONE scalar (one host sync max, only
         # when ANSI casts exist), masked to still-active rows
         err = (jnp.any(jnp.stack([jnp.any(f & active)

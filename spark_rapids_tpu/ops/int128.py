@@ -1,0 +1,294 @@
+"""Vectorized signed 128-bit integer arithmetic on two int64 limbs.
+
+The decimal engine's math core: Spark's DecimalType computations beyond
+18 digits (DECIMAL128) run on unscaled 128-bit integers. The reference
+does this in libcudf's fixed_point on GPU (decimalExpressions.scala ->
+cudf DECIMAL128 columns); here the same math is written ONCE against
+the array-API surface shared by numpy and jax.numpy, so the CPU engine
+(numpy) and the TPU kernels (jnp, lowered by XLA onto 32-bit emulated
+u64 ops) are bit-identical by construction.
+
+Representation: ``(hi, lo)`` — ``hi`` int64 signed high limb, ``lo``
+int64 holding the LOW limb's uint64 bit pattern. value = hi * 2**64 +
+uint64(lo). All functions take/return this pair of same-shape arrays.
+
+No data-dependent Python control flow: every correction step is a
+``where`` — the functions trace under jax.jit and vectorize under
+numpy identically.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+Pair = Tuple  # (hi: int64 array, lo: int64-as-uint64-bits array)
+
+_B32 = 0xFFFFFFFF
+
+
+def _u(xp, a):
+    return a.astype(xp.uint64)
+
+
+def _s(xp, a):
+    return a.astype(xp.int64)
+
+
+def from_i64(xp, x) -> Pair:
+    """Sign-extend an int64 array to a 128-bit pair."""
+    return (x >> xp.int64(63)), _s(xp, _u(xp, x))
+
+
+def to_i64(xp, hi, lo):
+    """(value as int64, fits flag): fits iff hi is lo's sign extension."""
+    lo_s = lo
+    return lo_s, hi == (lo_s >> xp.int64(63))
+
+
+def is_neg(xp, hi, lo):
+    return hi < xp.int64(0)
+
+
+def add(xp, ahi, alo, bhi, blo) -> Pair:
+    lo = _s(xp, _u(xp, alo) + _u(xp, blo))
+    carry = _u(xp, lo) < _u(xp, alo)
+    return ahi + bhi + carry.astype(xp.int64), lo
+
+
+def neg(xp, hi, lo) -> Pair:
+    nlo = _s(xp, ~_u(xp, lo) + xp.uint64(1))
+    nhi = ~hi + (nlo == xp.int64(0)).astype(xp.int64)
+    return nhi, nlo
+
+
+def sub(xp, ahi, alo, bhi, blo) -> Pair:
+    nh, nl = neg(xp, bhi, blo)
+    return add(xp, ahi, alo, nh, nl)
+
+
+def abs_(xp, hi, lo) -> Pair:
+    n = is_neg(xp, hi, lo)
+    nh, nl = neg(xp, hi, lo)
+    return xp.where(n, nh, hi), xp.where(n, nl, lo)
+
+
+def cmp_lt(xp, ahi, alo, bhi, blo):
+    """a < b, signed."""
+    return (ahi < bhi) | ((ahi == bhi) & (_u(xp, alo) < _u(xp, blo)))
+
+
+def eq(xp, ahi, alo, bhi, blo):
+    return (ahi == bhi) & (alo == blo)
+
+
+def _umul64(xp, a, b) -> Pair:
+    """Unsigned 64x64 -> 128 on uint64 bit patterns (as int64 arrays)."""
+    au, bu = _u(xp, a), _u(xp, b)
+    m = xp.uint64(_B32)
+    a0, a1 = au & m, au >> xp.uint64(32)
+    b0, b1 = bu & m, bu >> xp.uint64(32)
+    p00 = a0 * b0
+    p01 = a0 * b1
+    p10 = a1 * b0
+    p11 = a1 * b1
+    mid = (p00 >> xp.uint64(32)) + (p01 & m) + (p10 & m)
+    lo = (p00 & m) | (mid << xp.uint64(32))
+    hi = p11 + (p01 >> xp.uint64(32)) + (p10 >> xp.uint64(32)) \
+        + (mid >> xp.uint64(32))
+    return _s(xp, hi), _s(xp, lo)
+
+
+def mul_i64(xp, a, b) -> Pair:
+    """Signed 64x64 -> exact 128."""
+    hi, lo = _umul64(xp, a, b)
+    # signed adjustment: uhi - (a<0 ? b : 0) - (b<0 ? a : 0)
+    hi = hi - xp.where(a < xp.int64(0), b, xp.int64(0)) \
+        - xp.where(b < xp.int64(0), a, xp.int64(0))
+    return hi, lo
+
+
+def mul_by_i64(xp, hi, lo, b):
+    """Signed 128 x signed 64 -> (hi, lo, overflowed): low 128 bits of
+    the exact product, plus a flag set when the true value does not fit
+    a signed 128."""
+    sa = is_neg(xp, hi, lo)
+    sb = b < xp.int64(0)
+    mhi, mlo = abs_(xp, hi, lo)
+    mb = xp.where(sb, -b, b)  # int64.min excluded by decimal bounds
+    # magnitude product: (mhi*2^64 + mlo) * mb
+    p_lo_hi, p_lo_lo = _umul64(xp, mlo, mb)
+    p_hi_hi, p_hi_lo = _umul64(xp, mhi, mb)
+    rhi_u = _u(xp, p_lo_hi) + _u(xp, p_hi_lo)
+    carry_out = (_u(xp, p_hi_hi) != xp.uint64(0)) | (rhi_u < _u(xp, p_lo_hi))
+    rhi, rlo = _s(xp, rhi_u), p_lo_lo
+    # signed-128 magnitude limit: 2^127 (the sign flip below restores
+    # -2^127; decimal bounds (10^38 < 2^127) make the edge unreachable)
+    over = carry_out | (rhi < xp.int64(0))
+    sneg = sa ^ sb
+    nh, nl = neg(xp, rhi, rlo)
+    return (xp.where(sneg, nh, rhi), xp.where(sneg, nl, rlo), over)
+
+
+POW10_I64 = [10 ** k for k in range(19)]
+
+
+def _udivmod_small(xp, hi, lo, d):
+    """Unsigned 128 / uint64 d where d < 2^31: chunked long division in
+    uint64 intermediates. Returns (qhi, qlo, rem<d as int64)."""
+    du = _u(xp, d)
+    m = xp.uint64(_B32)
+    u = [_u(xp, lo) & m, _u(xp, lo) >> xp.uint64(32),
+         _u(xp, hi) & m, _u(xp, hi) >> xp.uint64(32)]
+    r = xp.zeros_like(du)
+    q = [None] * 4
+    for j in (3, 2, 1, 0):
+        cur = (r << xp.uint64(32)) | u[j]
+        q[j] = cur // du
+        r = cur - q[j] * du
+    qlo = (q[0] & m) | (q[1] << xp.uint64(32))
+    qhi = (q[2] & m) | (q[3] << xp.uint64(32))
+    return _s(xp, qhi), _s(xp, qlo), _s(xp, r)
+
+
+def _nlz32_of_hi(xp, v1):
+    """Count leading zeros of a uint64 whose value is >= 2^32 is not
+    required here: v1 is the divisor's high 32-bit digit (1..2^32-1);
+    returns leading zeros within 32 bits."""
+    n = xp.zeros_like(v1)
+    x = v1
+    for shift in (16, 8, 4, 2, 1):
+        t = x < (xp.uint64(1) << xp.uint64(32 - shift))
+        n = n + xp.where(t, xp.uint64(shift), xp.uint64(0))
+        x = xp.where(t, x << xp.uint64(shift), x)
+    return n
+
+
+def _udivmod_knuth(xp, hi, lo, d):
+    """Unsigned 128 / uint64 d where d >= 2^32 (two 32-bit digits),
+    Knuth algorithm D with base 2^32. Returns (qhi=0-ish, qlo, rem)."""
+    du = _u(xp, d)
+    m = xp.uint64(_B32)
+    # normalize so the divisor's high digit >= 2^31
+    v1 = du >> xp.uint64(32)
+    sh = _nlz32_of_hi(xp, v1)
+    dn = du << sh
+    v1n = dn >> xp.uint64(32)
+    v0n = dn & m
+    # dividend digits after the same shift (dividend < d * 2^64 assumed
+    # by callers, so a 5-digit window suffices)
+    uhi = _u(xp, hi)
+    ulo = _u(xp, lo)
+    # 128-bit left shift by sh (sh < 32)
+    sh64 = xp.uint64(64) - sh
+    big = sh > xp.uint64(0)
+    hi_n = xp.where(big, (uhi << sh) | (ulo >> sh64), uhi)
+    lo_n = xp.where(big, ulo << sh, ulo)
+    u4 = xp.where(big, uhi >> sh64, xp.uint64(0))
+    u = [lo_n & m, lo_n >> xp.uint64(32), hi_n & m, hi_n >> xp.uint64(32),
+         u4]
+    qd = [None, None, None]
+    # r tracks the remainder's top two digits across steps
+    for j in (2, 1, 0):
+        num = (u[j + 2] << xp.uint64(32)) | u[j + 1]
+        qhat = num // v1n
+        # clamp to b-1 first (Knuth D3: qhat <= true digit + 2 once
+        # normalized, so a bounded correction loop follows); computing
+        # qhat*v0n before clamping would overflow uint64
+        qhat = xp.where(qhat > m, m, qhat)
+        rhat = num - qhat * v1n
+        for _ in range(3):  # qhat <= q+2 after clamp: 3 steps suffice
+            # when rhat >= b the RHS >= 2^64 > any qhat*v0n: not too big
+            too_big = (rhat <= m) & (
+                (qhat * v0n) > ((rhat << xp.uint64(32)) | u[j]))
+            qhat = xp.where(too_big, qhat - xp.uint64(1), qhat)
+            rhat = xp.where(too_big, rhat + v1n, rhat)
+        # multiply-subtract: u[j..j+2] -= qhat * dn  (3-digit window)
+        p = qhat * v0n
+        t0 = u[j] - (p & m)
+        u_j = t0 & m
+        carry = (p >> xp.uint64(32)) + xp.where(
+            t0 > m, xp.uint64(1), xp.uint64(0))
+        p1 = qhat * v1n + carry
+        t1 = u[j + 1] - (p1 & m)
+        u_j1 = t1 & m
+        carry1 = (p1 >> xp.uint64(32)) + xp.where(
+            t1 > m, xp.uint64(1), xp.uint64(0))
+        t2 = u[j + 2] - carry1
+        u_j2 = t2 & m
+        went_neg = t2 > m  # borrow out of the window -> qhat one too big
+        # add back dn once if negative
+        ab0 = u_j + v0n
+        ab1 = u_j1 + v1n + (ab0 >> xp.uint64(32))
+        ab2 = u_j2 + (ab1 >> xp.uint64(32))
+        u[j] = xp.where(went_neg, ab0 & m, u_j)
+        u[j + 1] = xp.where(went_neg, ab1 & m, u_j1)
+        u[j + 2] = xp.where(went_neg, ab2 & m, u_j2)
+        qd[j] = xp.where(went_neg, qhat - xp.uint64(1), qhat) & m
+    rem = (((u[1] << xp.uint64(32)) | u[0]) >> sh)
+    qlo = (qd[0] & m) | (qd[1] << xp.uint64(32))
+    qhi = qd[2] & m
+    return _s(xp, qhi), _s(xp, qlo), _s(xp, rem)
+
+
+def divmod_u128_by_u64(xp, hi, lo, d):
+    """Unsigned 128 / unsigned 64 -> (qhi, qlo, rem). Requires the
+    quotient to fit 128 bits (always true). d must be >= 1."""
+    small = _u(xp, d) < (xp.uint64(1) << xp.uint64(32))
+    d_small = xp.where(small, _u(xp, d), xp.uint64(3))
+    d_big = xp.where(small, (xp.uint64(1) << xp.uint64(32)), _u(xp, d))
+    qh_s, ql_s, r_s = _udivmod_small(xp, hi, lo, _s(xp, d_small))
+    qh_b, ql_b, r_b = _udivmod_knuth(xp, hi, lo, _s(xp, d_big))
+    return (xp.where(small, qh_s, qh_b), xp.where(small, ql_s, ql_b),
+            xp.where(small, r_s, r_b))
+
+
+def div_halfup(xp, hi, lo, d):
+    """Signed 128 / signed 64 with HALF_UP (round half away from zero;
+    java.math.BigDecimal/Spark Decimal semantics). d != 0."""
+    sa = is_neg(xp, hi, lo)
+    sb = d < xp.int64(0)
+    mhi, mlo = abs_(xp, hi, lo)
+    md = xp.where(sb, -d, d)
+    qh, ql, r = divmod_u128_by_u64(xp, mhi, mlo, md)
+    round_up = _u(xp, r) * xp.uint64(2) >= _u(xp, md)
+    qh2, ql2 = add(xp, qh, ql,
+                   xp.zeros_like(qh),
+                   _s(xp, round_up.astype(xp.uint64)))
+    sneg = sa ^ sb
+    nh, nl = neg(xp, qh2, ql2)
+    return xp.where(sneg, nh, qh2), xp.where(sneg, nl, ql2)
+
+
+def _const_pair(v: int) -> Tuple[int, int]:
+    lo = v & 0xFFFFFFFFFFFFFFFF
+    if lo >= 1 << 63:
+        lo -= 1 << 64
+    return (v >> 64), lo
+
+
+def fits_precision(xp, hi, lo, precision: int):
+    """|x| < 10^precision (Spark CheckOverflow bound)."""
+    bound = 10 ** precision
+    bh, bl = _const_pair(bound)
+    mhi, mlo = abs_(xp, hi, lo)
+    return cmp_lt(xp, mhi, mlo,
+                  xp.full_like(hi, bh), xp.full_like(lo, bl))
+
+
+def to_pyints(hi, lo) -> np.ndarray:
+    """(numpy only) object array of exact Python ints."""
+    hi_o = np.asarray(hi).astype(object)
+    lo_o = (np.asarray(lo).astype(np.uint64)).astype(object)
+    return hi_o * (1 << 64) + lo_o
+
+
+def from_pyints(vals) -> Tuple[np.ndarray, np.ndarray]:
+    """(numpy only) exact Python ints -> limb pair arrays."""
+    vals = [int(v) for v in vals]
+    hi = np.array([v >> 64 for v in vals], dtype=np.int64)
+    lo_u = [(v & 0xFFFFFFFFFFFFFFFF) for v in vals]
+    lo = np.array([u - (1 << 64) if u >= (1 << 63) else u for u in lo_u],
+                  dtype=np.int64)
+    return hi, lo

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 
 from spark_rapids_tpu.columnar.device import (AnyDeviceColumn, DeviceBatch,
                                               DeviceColumn,
+                                              DeviceDecimal128Column,
                                               DeviceStringColumn,
                                               bucket_capacity, make_column,
                                               take_columns)
@@ -68,6 +69,11 @@ def _concat_key_columns(kl: Sequence[AnyDeviceColumn],
             out.append(DeviceStringColumn(
                 a.dtype, jnp.concatenate([ac, bc]),
                 jnp.concatenate([a.lengths, b.lengths]),
+                jnp.concatenate([a.validity, b.validity])))
+        elif isinstance(a, DeviceDecimal128Column):
+            out.append(DeviceDecimal128Column(
+                a.dtype, jnp.concatenate([a.hi, b.hi]),
+                jnp.concatenate([a.lo, b.lo]),
                 jnp.concatenate([a.validity, b.validity])))
         else:
             out.append(DeviceColumn(

@@ -47,8 +47,14 @@ def order_subkeys(col: AnyDeviceColumn, ascending: bool,
     IEEE negation for the float value word (exact, and every zero in that
     word is already normalized to +0.0 so negation keeps them tied) —
     no 64-bit float bitcasts (unsupported on some TPU compile stacks)."""
+    from spark_rapids_tpu.columnar.device import DeviceDecimal128Column
     if isinstance(col, DeviceStringColumn):
         data_keys = pack_string_words(col) + [col.lengths.astype(jnp.uint64)]
+        if not ascending:
+            data_keys = [~k for k in data_keys]
+    elif isinstance(col, DeviceDecimal128Column):
+        from spark_rapids_tpu.ops.groupby import limb_words
+        data_keys = limb_words(col)
         if not ascending:
             data_keys = [~k for k in data_keys]
     else:

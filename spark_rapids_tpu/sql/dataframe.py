@@ -508,15 +508,31 @@ def _coerce_resolved(e: E.Expression) -> E.Expression:
             except Exception:
                 return None
             if lt != rt:
-                a, b = _coerce_pair(node.left, node.right)
+                a, b = _coerce_pair(
+                    node.left, node.right,
+                    arith=isinstance(node, E.BinaryArithmetic))
                 return type(node)(a, b)
         if isinstance(node, E.Divide):
             try:
                 lt, rt = node.left.data_type, node.right.data_type
             except Exception:
                 return None
-            if not isinstance(lt, (T.DoubleType, T.DecimalType)) or \
-                    not isinstance(rt, (T.DoubleType, T.DecimalType)):
+            if isinstance(lt, T.DecimalType) or \
+                    isinstance(rt, T.DecimalType):
+                # decimal division unless a fractional side forces double
+                if isinstance(lt, (T.FloatType, T.DoubleType)) or \
+                        isinstance(rt, (T.FloatType, T.DoubleType)):
+                    return E.Divide(
+                        node.left if isinstance(lt, T.DoubleType)
+                        else E.Cast(node.left, T.DoubleT),
+                        node.right if isinstance(rt, T.DoubleType)
+                        else E.Cast(node.right, T.DoubleT))
+                a, b = _coerce_pair(node.left, node.right, arith=True)
+                if a is not node.left or b is not node.right:
+                    return E.Divide(a, b)
+                return None
+            if not isinstance(lt, T.DoubleType) or \
+                    not isinstance(rt, T.DoubleType):
                 a = node.left if isinstance(lt, T.DoubleType) \
                     else E.Cast(node.left, T.DoubleT)
                 b = node.right if isinstance(rt, T.DoubleType) \

@@ -313,7 +313,13 @@ class BinaryArithmetic(BinaryExpression):
 
     @property
     def data_type(self) -> T.DataType:
-        return self.left.data_type
+        lt = self.left.data_type
+        if self.symbol in ("+", "-", "*", "/") \
+                and isinstance(lt, T.DecimalType) \
+                and isinstance(self.right.data_type, T.DecimalType):
+            return T.decimal_binary_result(self.symbol, lt,
+                                           self.right.data_type)
+        return lt
 
     def op(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
         raise NotImplementedError
@@ -322,6 +328,10 @@ class BinaryArithmetic(BinaryExpression):
         lc = self.left.eval(batch)
         rc = self.right.eval(batch)
         validity = _combined_validity([lc, rc])
+        if self.symbol in ("+", "-", "*") and \
+                isinstance(self.data_type, T.DecimalType):
+            return _decimal_arith(self.symbol, lc, rc, validity,
+                                  self.data_type)
         with np.errstate(all="ignore"):
             data = self.op(lc.data, rc.data)
         np_dt = T.numpy_dtype(self.data_type)
@@ -565,6 +575,10 @@ class UnaryMinus(UnaryExpression):
 
     def eval(self, batch: HostBatch) -> HostColumn:
         c = self.child.eval(batch)
+        if T.is_limb_decimal(self.data_type):
+            from spark_rapids_tpu.ops import int128 as I
+            hi, lo = I.neg(np, *_dec_limbs(c))
+            return _limbs_to_col(hi, lo, c.validity.copy(), self.data_type)
         with np.errstate(all="ignore"):
             return HostColumn(self.data_type, -c.data, c.validity.copy())
 
@@ -579,13 +593,131 @@ class Abs(UnaryExpression):
 
     def eval(self, batch: HostBatch) -> HostColumn:
         c = self.child.eval(batch)
+        if T.is_limb_decimal(self.data_type):
+            from spark_rapids_tpu.ops import int128 as I
+            hi, lo = I.abs_(np, *_dec_limbs(c))
+            return _limbs_to_col(hi, lo, c.validity.copy(), self.data_type)
         with np.errstate(all="ignore"):
             return HostColumn(self.data_type, np.abs(c.data),
                               c.validity.copy())
 
 
+def _dec_limbs(col: HostColumn):
+    """HostColumn (decimal storage) -> (hi, lo) int64 limb arrays."""
+    from spark_rapids_tpu.ops import int128 as I
+    if T.is_limb_decimal(col.dtype):
+        return np.ascontiguousarray(col.data[:, 0]), \
+            np.ascontiguousarray(col.data[:, 1])
+    return I.from_i64(np, col.data.astype(np.int64))
+
+
+def _limbs_to_col(hi, lo, validity, dt: T.DecimalType) -> HostColumn:
+    from spark_rapids_tpu.ops import decimal_ops as D
+    if T.is_limb_decimal(dt):
+        hi = np.where(validity, hi, 0)
+        lo = np.where(validity, lo, 0)
+        return HostColumn(dt, np.stack([hi, lo], axis=1), validity)
+    v = D.to_i64_unscaled(np, hi, lo)
+    return HostColumn(dt, np.where(validity, v, 0), validity)
+
+
+def _decimal_arith(sym: str, lc: HostColumn, rc: HostColumn,
+                   validity: np.ndarray, res: T.DecimalType) -> HostColumn:
+    """Host +,-,* on decimals: vectorized limb math when the shapes are
+    in the supported envelope, exact Python-int fallback otherwise
+    (CheckOverflow -> NULL, non-ANSI)."""
+    from spark_rapids_tpu.ops import decimal_ops as D
+    lt, rt = lc.dtype, rc.dtype
+    if sym in ("+", "-"):
+        if not D.add_sub_supported(lt, rt):
+            return _decimal_slow(sym, lc, rc, validity, res)
+        ahi, alo = _dec_limbs(lc)
+        bhi, blo = _dec_limbs(rc)
+        hi, lo, ok = D.add_sub(np, sym, ahi, alo, bhi, blo, lt, rt, res)
+    elif D.mul_supported(lt, rt):
+        ahi, alo = _dec_limbs(lc)
+        bhi, blo = _dec_limbs(rc)
+        hi, lo, ok = D.mul(np, ahi, alo, bhi, blo, lt, rt, res)
+    else:  # exact slow path (both operands wide, or deep rescale)
+        return _decimal_slow(sym, lc, rc, validity, res)
+    return _limbs_to_col(hi, lo, validity & ok, res)
+
+
+def _decimal_slow(sym: str, lc: HostColumn, rc: HostColumn,
+                  validity: np.ndarray, res: T.DecimalType) -> HostColumn:
+    from spark_rapids_tpu.ops import int128 as I
+    a = I.to_pyints(*_dec_limbs(lc))
+    b = I.to_pyints(*_dec_limbs(rc))
+    s1, s2 = lc.dtype.scale, rc.dtype.scale
+    out = []
+    bound = 10 ** res.precision
+
+    def _to_scale(v: int, s_from: int) -> int:
+        # per-operand cast to the result scale (HALF_UP on reduction),
+        # matching Spark's PromotePrecision(Cast(operand, resultType))
+        d = res.scale - s_from
+        if d >= 0:
+            return v * 10 ** d
+        q, r = divmod(abs(v), 10 ** -d)
+        if 2 * r >= 10 ** -d:
+            q += 1
+        return q if v >= 0 else -q
+
+    for x, y, ok in zip(a, b, validity):
+        if not ok:
+            out.append(None)
+            continue
+        if sym == "+":
+            v = _to_scale(x, s1) + _to_scale(y, s2)
+        elif sym == "-":
+            v = _to_scale(x, s1) - _to_scale(y, s2)
+        elif sym == "*":
+            v = x * y
+            down = (s1 + s2) - res.scale
+            if down > 0:
+                d = 10 ** down
+                q, r = divmod(abs(v), d)
+                if 2 * r >= d:
+                    q += 1
+                v = q if v >= 0 else -q
+        else:  # "/"
+            if y == 0:
+                out.append(None)
+                continue
+            num = x * 10 ** (res.scale - s1 + s2)
+            q, r = divmod(abs(num), abs(y))
+            if 2 * r >= abs(y):
+                q += 1
+            v = q if (num >= 0) == (y >= 0) else -q
+        out.append(None if abs(v) >= bound else v)
+    from decimal import Decimal
+    return HostColumn.from_pylist(
+        [None if v is None else Decimal(v).scaleb(-res.scale)
+         for v in out], res)
+
+
 def _decimal_divide(node: Divide, batch: HostBatch) -> HostColumn:
-    raise NotImplementedError("decimal division lands with the decimal pass")
+    """Spark decimal division: HALF_UP at the DecimalPrecision result
+    scale, NULL on zero divisor (non-ANSI) or overflow."""
+    from spark_rapids_tpu.ops import decimal_ops as D
+    lc = node.left.eval(batch)
+    rc = node.right.eval(batch)
+    res = node.data_type
+    lt, rt = lc.dtype, rc.dtype
+    if T.is_limb_decimal(rt):
+        bhi, blo = _dec_limbs(rc)
+        nonzero = (bhi != 0) | (blo != 0)
+    else:
+        nonzero = rc.data.astype(np.int64) != 0
+    validity = _combined_validity([lc, rc]) & nonzero
+    if not D.div_supported(lt, rt):
+        return _decimal_slow("/", lc, rc, validity, res)
+    ahi, alo = _dec_limbs(lc)
+    # div_supported caps the divisor at 18 digits -> plain int64 storage
+    assert not T.is_limb_decimal(rt), rt
+    d_safe = np.where(nonzero, rc.data.astype(np.int64), 1)
+    hi, lo, ok = D.div(np, ahi, alo, d_safe, lt, rt, res)
+    return _limbs_to_col(hi, lo, validity & ok, res)
 
 
 # ---------------------------------------------------------------------------
@@ -614,6 +746,17 @@ class BinaryComparison(BinaryExpression):
 
     def _compare(self, lc: HostColumn, rc: HostColumn) -> np.ndarray:
         a, b = lc.data, rc.data
+        if T.is_limb_decimal(lc.dtype) or T.is_limb_decimal(rc.dtype):
+            # coercion aligned both sides to one (wide) decimal type:
+            # reduce the limb comparison to a sign surrogate so every
+            # operator reuses its scalar cmp
+            from spark_rapids_tpu.ops import int128 as I
+            ahi, alo = _dec_limbs(lc)
+            bhi, blo = _dec_limbs(rc)
+            lt = I.cmp_lt(np, ahi, alo, bhi, blo)
+            eqm = I.eq(np, ahi, alo, bhi, blo)
+            sign = np.where(lt, -1, np.where(eqm, 0, 1)).astype(np.int8)
+            return self.cmp(sign, np.zeros_like(sign))
         if a.dtype == np.dtype(object):
             n = len(a)
             out = np.zeros(n, dtype=bool)
@@ -2231,6 +2374,24 @@ def _hash_column(c: HostColumn, seed: np.ndarray) -> np.ndarray:
         h = murmur3.hash_double(c.data, seed)
     elif isinstance(dt, T.DecimalType) and dt.precision <= 18:
         h = murmur3.hash_long(c.data.astype(np.int64), seed)
+    elif isinstance(dt, T.DecimalType):
+        # Spark hashes a big decimal as the minimal big-endian
+        # two's-complement bytes of its unscaled value
+        # (Murmur3Hash.computeHash on Decimal, hash.scala)
+        from spark_rapids_tpu.ops import int128 as I
+        ints = I.to_pyints(np.ascontiguousarray(c.data[:, 0]),
+                           np.ascontiguousarray(c.data[:, 1]))
+        out = seed.copy()
+        for i in range(len(ints)):
+            if c.validity[i]:
+                v = int(ints[i])
+                # BigInteger.toByteArray length: bitLength/8 + 1, where
+                # bitLength excludes the sign bit (negatives count the
+                # bits of minimal two's complement)
+                bl = v.bit_length() if v >= 0 else (-v - 1).bit_length()
+                raw = v.to_bytes(bl // 8 + 1, "big", signed=True)
+                out[i] = murmur3.hash_bytes_one(raw, int(seed[i]))
+        return out
     else:
         raise TypeError(f"cannot hash {dt}")
     return np.where(c.validity, h, seed)
@@ -2749,48 +2910,119 @@ def _cast_from_string(c: HostColumn, to: T.DataType, ansi: bool
 
 def _cast_to_decimal(c: HostColumn, to: T.DecimalType, ansi: bool
                      ) -> HostColumn:
-    assert to.precision <= 18, "decimal128 lands later"
+    from spark_rapids_tpu.ops import decimal_ops as D
     validity = c.validity.copy()
     frm = c.dtype
-    bound = 10 ** to.precision
     if isinstance(frm, T.DecimalType):
-        # rescale
-        diff = to.scale - frm.scale
-        src = c.data.astype(np.int64)
-        if diff >= 0:
-            data = src * (10 ** diff)
-        else:
-            p = 10 ** (-diff)
-            half = p // 2
-            data = (np.abs(src) + half) // p * np.sign(src)
-        over = np.abs(data) >= bound
-    elif T.is_integral(frm) or isinstance(frm, T.BooleanType):
-        data = c.data.astype(np.int64) * (10 ** to.scale)
-        over = np.abs(data) >= bound
-    elif T.is_floating(frm):
+        if D.cast_supported(frm, to):
+            hi, lo = _dec_limbs(c)
+            hi, lo, ok = D.cast_decimal(np, hi, lo, frm, to)
+            if ansi and (~ok & validity).any():
+                raise ArithmeticError("Decimal overflow in ANSI mode")
+            return _limbs_to_col(hi, lo, validity & ok, to)
+        # deep down-rescale: exact Python ints (rare)
+        from spark_rapids_tpu.ops import int128 as I
+        vals = I.to_pyints(*_dec_limbs(c))
+        d = 10 ** (frm.scale - to.scale)
+        bound_i = 10 ** to.precision
+        out = []
+        for v, okv in zip(vals, validity):
+            if not okv:
+                out.append(None)
+                continue
+            q, r = divmod(abs(v), d)
+            if 2 * r >= d:
+                q += 1
+            q = q if v >= 0 else -q
+            out.append(None if abs(q) >= bound_i else q)
+        if ansi and any(v is None for v, okv in zip(out, validity) if okv):
+            raise ArithmeticError("Decimal overflow in ANSI mode")
+        from decimal import Decimal
+        return HostColumn.from_pylist(
+            [None if v is None else Decimal(v).scaleb(-to.scale)
+             for v in out], to)
+    if T.is_integral(frm) or isinstance(frm, T.BooleanType):
+        from spark_rapids_tpu.ops import int128 as I
+        hi, lo = I.from_i64(np, c.data.astype(np.int64))
+        hi, lo, over = D.rescale_up(np, hi, lo, to.scale)
+        ok = ~over & I.fits_precision(np, hi, lo, to.precision)
+        if ansi and (~ok & validity).any():
+            raise ArithmeticError("Decimal overflow in ANSI mode")
+        return _limbs_to_col(np.where(ok, hi, 0), np.where(ok, lo, 0),
+                             validity & ok, to)
+    if T.is_floating(frm):
+        bound = 10 ** to.precision
         with np.errstate(all="ignore"):
             scaled = c.data.astype(np.float64) * (10.0 ** to.scale)
             data = (np.sign(scaled) * np.floor(np.abs(scaled) + 0.5))
             over = (np.isnan(scaled) | np.isinf(scaled)
-                    | (np.abs(data) >= bound))
+                    | (np.abs(data) >= float(bound)))
             data = np.nan_to_num(data, nan=0.0, posinf=0.0,
-                                 neginf=0.0).astype(np.int64)
-    else:
-        raise TypeError(f"cast {frm} -> {to}")
-    if ansi and (over & validity).any():
-        raise ArithmeticError("Decimal overflow in ANSI mode")
-    validity &= ~over
-    return HostColumn(to, np.asarray(data, dtype=np.int64), validity
-                      ).normalized()
+                                 neginf=0.0)
+            data = np.where(over, 0.0, data)
+        if ansi and (over & validity).any():
+            raise ArithmeticError("Decimal overflow in ANSI mode")
+        validity &= ~over
+        # exact limb extraction from the (integral-valued) float: the
+        # split v = hi*2^64 + lo is exact float arithmetic, so values
+        # beyond 2^63 but within the precision survive (Spark keeps
+        # e.g. 1e20 in a decimal(38,0))
+        with np.errstate(all="ignore"):
+            hi_f = np.floor(data * 2.0 ** -64)
+            lo_f = data - hi_f * 2.0 ** 64
+        hi = hi_f.astype(np.int64)
+        lo = lo_f.astype(np.uint64).astype(np.int64)
+        if T.is_limb_decimal(to):
+            return _limbs_to_col(hi, lo, validity, to)
+        return HostColumn(to, np.where(validity, lo, 0), validity
+                          ).normalized()
+    raise TypeError(f"cast {frm} -> {to}")
 
 
 def _cast_from_decimal(c: HostColumn, to: T.DataType, ansi: bool
                        ) -> HostColumn:
     frm = c.dtype
     assert isinstance(frm, T.DecimalType)
+    if T.is_limb_decimal(frm):
+        from spark_rapids_tpu.ops import int128 as I
+        hi, lo = _dec_limbs(c)
+        if T.is_floating(to):
+            # exact int64 path when the value fits; the 2-term wide sum
+            # (within ~1 ulp of correctly rounded) only beyond 64 bits.
+            # Multiply by the reciprocal rather than divide: XLA folds a
+            # constant-divisor division into exactly this multiply, so
+            # doing the same here keeps CPU == device bit-identical
+            v64, small = I.to_i64(np, hi, lo)
+            ulo = np.asarray(lo).astype(np.uint64).astype(np.float64)
+            wide = hi.astype(np.float64) * 2.0 ** 64 + ulo
+            data = np.where(small, v64.astype(np.float64), wide) \
+                * (1.0 / 10.0 ** frm.scale)
+            return HostColumn(to, data.astype(T.numpy_dtype(to)),
+                              c.validity.copy())
+        if T.is_integral(to):
+            d = np.int64(10 ** min(frm.scale, 18))
+            mhi, mlo = I.abs_(np, hi, lo)
+            qh, ql, _r = I.divmod_u128_by_u64(np, mhi, mlo, d)
+            if frm.scale > 18:
+                qh, ql, _r2 = I.divmod_u128_by_u64(
+                    np, qh, ql, np.int64(10 ** (frm.scale - 18)))
+            neg = I.is_neg(np, hi, lo)
+            nh, nl = I.neg(np, qh, ql)
+            qh = np.where(neg, nh, qh)
+            ql = np.where(neg, nl, ql)
+            v, fits = I.to_i64(np, qh, ql)
+            info = np.iinfo(T.numpy_dtype(to))
+            validity = c.validity & fits & (v >= info.min) & (v <= info.max)
+            if ansi and (~validity & c.validity).any():
+                raise ArithmeticError("Cast overflow in ANSI mode")
+            return HostColumn(to, v.astype(T.numpy_dtype(to)),
+                              validity).normalized()
+        raise TypeError(f"cast {frm} -> {to}")
     scale_div = 10 ** frm.scale
     if T.is_floating(to):
-        data = (c.data.astype(np.float64) / scale_div).astype(
+        # reciprocal multiply, matching XLA's constant-divisor folding
+        # on the device leg (see the limb branch above)
+        data = (c.data.astype(np.float64) * (1.0 / scale_div)).astype(
             T.numpy_dtype(to))
         return HostColumn(to, data, c.validity.copy())
     if T.is_integral(to):
@@ -2920,12 +3152,17 @@ class Average(AggregateFunction):
     def __init__(self, child: Expression):
         self.children = [child]
 
+    def _child_decimal(self) -> Optional[T.DecimalType]:
+        dt = self.children[0].data_type
+        return dt if isinstance(dt, T.DecimalType) else None
+
     @property
     def data_type(self) -> T.DataType:
-        # Spark returns decimal(p+4, s+4) for decimal input; until decimal
-        # average lands, declare the double we actually produce so schema
-        # and data agree (the TypeSig gate routes decimal avg to CPU... and
-        # the CPU engine computes it in double too — documented incompat).
+        dec = self._child_decimal()
+        if dec is not None:
+            # Spark Average for decimal: adjusted (p+4, s+4)
+            return T.adjust_precision_scale(dec.precision + 4,
+                                            dec.scale + 4)
         return T.DoubleT
 
     @property
@@ -2934,6 +3171,11 @@ class Average(AggregateFunction):
 
     def buffer_slots(self):
         child = self.children[0]
+        dec = self._child_decimal()
+        if dec is not None:
+            sum_t = T.DecimalType(min(dec.precision + 10, 38), dec.scale)
+            return [("sum", sum_t, PRIM_SUM, child, PRIM_SUM),
+                    ("count", T.LongT, PRIM_COUNT, child, PRIM_SUM_NONNULL)]
         if not isinstance(child.data_type, T.DoubleType):
             child_d = Cast(child, T.DoubleT)
         else:
@@ -2943,7 +3185,23 @@ class Average(AggregateFunction):
 
     def evaluate(self, buffers):
         s, cnt = buffers[0], buffers[1]
-        count = np.where(cnt.validity, cnt.data, 0).astype(np.float64)
+        count = np.where(cnt.validity, cnt.data, 0)
+        dec = self._child_decimal()
+        if dec is not None:
+            # HALF_UP(sum * 10^4 / count) at the adjusted result scale
+            from spark_rapids_tpu.ops import decimal_ops as D
+            from spark_rapids_tpu.ops import int128 as I
+            res = self.data_type
+            hi, lo = _dec_limbs(s)
+            up = res.scale - dec.scale
+            hi, lo, over = D.rescale_up(np, hi, lo, max(up, 0))
+            nz = count.astype(np.int64) > 0
+            qh, ql = I.div_halfup(np, hi, lo,
+                                  np.where(nz, count, 1).astype(np.int64))
+            validity = s.validity & nz & ~over & I.fits_precision(
+                np, qh, ql, res.precision)
+            return _limbs_to_col(qh, ql, validity, res)
+        count = count.astype(np.float64)
         validity = count > 0
         with np.errstate(all="ignore"):
             data = s.data.astype(np.float64) / np.where(count > 0, count, 1)

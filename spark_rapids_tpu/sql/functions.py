@@ -25,7 +25,8 @@ class Column:
     def _bin(self, other: Any, cls, swap: bool = False) -> "Column":
         o = _to_expr(other)
         a, b = (o, self.expr) if swap else (self.expr, o)
-        a, b = _coerce_pair(a, b)
+        a, b = _coerce_pair(a, b, arith=issubclass(cls,
+                                                  E.BinaryArithmetic))
         return Column(cls(a, b))
 
     def __add__(self, other):
@@ -197,9 +198,25 @@ def _expr_type(e: E.Expression) -> Optional[T.DataType]:
         return None  # unresolved; coercion re-checked at plan build
 
 
-def _coerce_pair(a: E.Expression, b: E.Expression):
+def _coerce_pair(a: E.Expression, b: E.Expression, arith: bool = False):
     ta, tb = _expr_type(a), _expr_type(b)
     if ta is None or tb is None or ta == tb:
+        return a, b
+    if arith and (isinstance(ta, T.DecimalType)
+                  or isinstance(tb, T.DecimalType)):
+        # Spark DecimalPrecision: arithmetic operands are NOT widened to
+        # a common decimal (that would change mul/div result types);
+        # integrals lift to their exact decimal, fractionals win whole
+        if isinstance(ta, (T.FloatType, T.DoubleType)) or \
+                isinstance(tb, (T.FloatType, T.DoubleType)):
+            return (a if isinstance(ta, T.DoubleType)
+                    else E.Cast(a, T.DoubleT),
+                    b if isinstance(tb, T.DoubleType)
+                    else E.Cast(b, T.DoubleT))
+        if not isinstance(ta, T.DecimalType) and T.is_integral(ta):
+            a = E.Cast(a, T.decimal_for_integral(ta))
+        if not isinstance(tb, T.DecimalType) and T.is_integral(tb):
+            b = E.Cast(b, T.decimal_for_integral(tb))
         return a, b
     common = T.tightest_common_type(ta, tb)
     if common is None:
@@ -214,8 +231,12 @@ def _coerce_pair(a: E.Expression, b: E.Expression):
 def _divide(a: E.Expression, b: E.Expression) -> Column:
     """Spark: `/` on non-decimal operands is double division."""
     ta, tb = _expr_type(a), _expr_type(b)
+    if ta is None or tb is None:
+        # unresolved: the post-resolution coercion pass (dataframe
+        # _coerce_resolved) applies the double-vs-decimal rule
+        return Column(E.Divide(a, b))
     if isinstance(ta, T.DecimalType) or isinstance(tb, T.DecimalType):
-        a2, b2 = _coerce_pair(a, b)
+        a2, b2 = _coerce_pair(a, b, arith=True)
         return Column(E.Divide(a2, b2))
     if not isinstance(ta, T.DoubleType):
         a = E.Cast(a, T.DoubleT)

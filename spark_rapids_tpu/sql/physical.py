@@ -504,7 +504,14 @@ def _composite_key(c: HostColumn, o: E.SortOrder) -> np.ndarray:
     overkill here; produce a float64 key with nulls mapped to +/-inf and
     direction applied. Exact for int53; object/large-int fall back to
     rank-based keys."""
-    if c.data.dtype == np.dtype(object):
+    if T.is_limb_decimal(c.dtype):
+        from spark_rapids_tpu.ops import int128 as I
+        ints = I.to_pyints(*E._dec_limbs(c))
+        uniq = np.sort(np.unique(ints[c.validity])) if c.validity.any() \
+            else np.array([], dtype=object)
+        r = np.searchsorted(uniq, ints).astype(np.float64)
+        base = np.where(c.validity, r, np.nan)
+    elif c.data.dtype == np.dtype(object):
         vals = c.to_pylist()
         uniq = sorted({v for v in vals if v is not None})
         ranks = {v: i + 1 for i, v in enumerate(uniq)}
@@ -603,8 +610,74 @@ def group_ids(key_cols: List[HostColumn], n: int
     return gids, len(table), np.array(reps, dtype=np.int64)
 
 
+def _limb_update_prim(prim: str, col: HostColumn, gids: np.ndarray,
+                      ngroups: int, out_type: T.DataType) -> HostColumn:
+    """Group primitives over DECIMAL128 limb columns. Sums accumulate
+    four 32-bit parts with np.add.at (each part sum fits int64 for
+    < 2^31 rows) and recombine exactly per group."""
+    from spark_rapids_tpu.ops import int128 as I
+    valid = col.validity
+    hi, lo = E._dec_limbs(col)
+    if prim in (E.PRIM_SUM, E.PRIM_SUM_NONNULL):
+        ulo = lo.astype(np.uint64)
+        parts = [
+            (ulo & np.uint64(0xFFFFFFFF)).astype(np.int64),
+            (ulo >> np.uint64(32)).astype(np.int64),
+            (hi.astype(np.uint64) & np.uint64(0xFFFFFFFF)).astype(np.int64),
+            hi >> np.int64(32),  # signed top part
+        ]
+        accs = [np.zeros(ngroups, dtype=np.int64) for _ in parts]
+        for acc, part in zip(accs, parts):
+            np.add.at(acc, gids[valid], part[valid])
+        has = np.zeros(ngroups, dtype=bool)
+        has[gids[valid]] = True
+        bound = 10 ** out_type.precision
+        totals = []
+        for g in range(ngroups):
+            t = (((int(accs[3][g]) << 32) + int(accs[2][g])) << 64) \
+                + (int(accs[1][g]) << 32) + int(accs[0][g])
+            totals.append(0 if abs(t) >= bound else t)
+            if abs(t) >= bound:
+                has[g] = False  # overflow -> null (non-ANSI Sum)
+        rhi, rlo = I.from_pyints(totals)
+        data = np.stack([rhi, rlo], axis=1)
+        if prim == E.PRIM_SUM_NONNULL:
+            return HostColumn.all_valid(data, out_type)
+        return HostColumn(out_type, data, has).normalized()
+    # first/last/min/max: exact ints, per-row walk (host engine style)
+    ints = I.to_pyints(hi, lo)
+    best = [None] * ngroups
+    has = np.zeros(ngroups, dtype=bool)
+    touched = np.zeros(ngroups, dtype=bool)
+    for i in range(len(ints)):
+        g = gids[i]
+        if prim in (E.PRIM_FIRST_ANY, E.PRIM_LAST_ANY):
+            if prim == E.PRIM_FIRST_ANY and touched[g]:
+                continue
+            touched[g] = True
+            has[g] = valid[i]
+            best[g] = int(ints[i]) if valid[i] else None
+            continue
+        if not valid[i]:
+            continue
+        v = int(ints[i])
+        if not has[g]:
+            has[g], best[g] = True, v
+        elif prim == E.PRIM_LAST:
+            best[g] = v
+        elif prim == E.PRIM_MIN and v < best[g]:
+            best[g] = v
+        elif prim == E.PRIM_MAX and v > best[g]:
+            best[g] = v
+    rhi, rlo = I.from_pyints([0 if b is None else b for b in best])
+    return HostColumn(out_type, np.stack([rhi, rlo], axis=1), has
+                      ).normalized()
+
+
 def apply_update_prim(prim: str, col: HostColumn, gids: np.ndarray,
                       ngroups: int, out_type: T.DataType) -> HostColumn:
+    if T.is_limb_decimal(out_type) and prim != E.PRIM_COUNT:
+        return _limb_update_prim(prim, col, gids, ngroups, out_type)
     np_dt = T.numpy_dtype(out_type)
     valid = col.validity
     if prim == E.PRIM_COUNT:

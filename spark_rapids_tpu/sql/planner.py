@@ -84,7 +84,17 @@ class Planner:
         return P.CpuProjectExec(p.project_list, self.plan(p.child))
 
     def _plan_filter(self, p: L.Filter) -> P.PhysicalPlan:
-        return P.CpuFilterExec(p.condition, self.plan(p.child))
+        child = self.plan(p.child)
+        # predicate pushdown: attribute-vs-literal conjuncts reach the
+        # parquet scan for footer-stats row-group pruning (the planner
+        # half of GpuParquetScanBase's filterBlocks; the Filter node
+        # stays, so pruning may be conservative)
+        from spark_rapids_tpu.io.readers import CpuFileScanExec
+        if isinstance(child, CpuFileScanExec):
+            preds = _pushable_predicates(p.condition)
+            if preds:
+                child.set_pushdown(preds)
+        return P.CpuFilterExec(p.condition, child)
 
     def _plan_union(self, p: L.Union) -> P.PhysicalPlan:
         return P.CpuUnionExec([self.plan(c) for c in p.children], p.output)
@@ -322,3 +332,59 @@ def split_conjuncts(e: E.Expression) -> List[E.Expression]:
     if isinstance(e, E.And):
         return split_conjuncts(e.left) + split_conjuncts(e.right)
     return [e]
+
+
+_PUSH_OPS = {E.EqualTo: "eq", E.LessThan: "lt", E.LessThanOrEqual: "le",
+             E.GreaterThan: "gt", E.GreaterThanOrEqual: "ge"}
+_PUSH_SWAP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq"}
+
+
+def _fold_literal(e: E.Expression):
+    """Storage value of a literal-only subtree (e.g. Cast('1998-09-02'
+    as date)), or None when it references columns or fails to fold."""
+    def has_attr(x) -> bool:
+        if isinstance(x, (E.AttributeReference, E.BoundReference)):
+            return True
+        return any(has_attr(c) for c in x.children)
+    if has_attr(e):
+        return None
+    try:
+        from spark_rapids_tpu.columnar.host import HostBatch
+        col = e.eval(HostBatch(T.StructType([]), [], 1))
+        if not col.validity[0]:
+            return None
+        v = col.data[0]
+        if hasattr(v, "item"):
+            v = v.item()
+        return v if isinstance(v, (int, float, str)) else None
+    except Exception:
+        return None
+
+
+def _pushable_predicates(condition: E.Expression) -> List[tuple]:
+    """(column, op, storage-value) conjuncts a parquet footer can rule
+    on: plain attribute vs foldable literal comparisons, IsNull and
+    IsNotNull (ParquetFilters.createFilter's pushable subset)."""
+    out: List[tuple] = []
+    for conj in split_conjuncts(condition):
+        if isinstance(conj, E.IsNotNull) and isinstance(
+                conj.child, E.AttributeReference):
+            out.append((conj.child.name, "notnull", None))
+            continue
+        if isinstance(conj, E.IsNull) and isinstance(
+                conj.child, E.AttributeReference):
+            out.append((conj.child.name, "isnull", None))
+            continue
+        op = _PUSH_OPS.get(type(conj))
+        if op is None:
+            continue
+        left, right = conj.left, conj.right
+        if isinstance(left, E.AttributeReference):
+            v = _fold_literal(right)
+            if v is not None:
+                out.append((left.name, op, v))
+        elif isinstance(right, E.AttributeReference):
+            v = _fold_literal(left)
+            if v is not None:
+                out.append((right.name, _PUSH_SWAP[op], v))
+    return out

@@ -283,6 +283,65 @@ _NUMERIC_ORDER = [ByteType(), ShortType(), IntegerType(), LongType(),
                   FloatType(), DoubleType()]
 
 
+def is_limb_decimal(dt: DataType) -> bool:
+    """True for DECIMAL128 storage: unscaled value kept as two int64
+    limbs (precision beyond DecimalType.MAX_LONG_DIGITS)."""
+    return (isinstance(dt, DecimalType)
+            and dt.precision > DecimalType.MAX_LONG_DIGITS)
+
+
+def decimal_for_integral(dt: DataType) -> DecimalType:
+    """Spark DecimalType.forType: the exact decimal an integral fits."""
+    if isinstance(dt, ByteType):
+        return DecimalType(3, 0)
+    if isinstance(dt, ShortType):
+        return DecimalType(5, 0)
+    if isinstance(dt, IntegerType):
+        return DecimalType(10, 0)
+    return DecimalType(20, 0)  # long / boolean-as-int never reaches here
+
+
+def adjust_precision_scale(p: int, s: int) -> DecimalType:
+    """Spark DecimalPrecision.adjustPrecisionScale with
+    spark.sql.decimalOperations.allowPrecisionLoss=true (the default):
+    cap at 38 digits, sacrificing scale but keeping at least 6
+    fractional digits when possible."""
+    if p <= DecimalType.MAX_PRECISION:
+        return DecimalType(max(p, 1), s)
+    int_digits = p - s
+    min_scale = min(s, 6)
+    adjusted = max(DecimalType.MAX_PRECISION - int_digits, min_scale)
+    return DecimalType(DecimalType.MAX_PRECISION, adjusted)
+
+
+def decimal_binary_result(op: str, lt: DecimalType, rt: DecimalType
+                          ) -> DecimalType:
+    """Spark DecimalPrecision result types for +,-,*,/ (arithmetic.scala
+    / DecimalPrecision.scala; the reference re-checks these in
+    GpuDecimalMultiply etc., decimalExpressions.scala)."""
+    p1, s1, p2, s2 = lt.precision, lt.scale, rt.precision, rt.scale
+    if op in ("+", "-"):
+        s = max(s1, s2)
+        p = max(p1 - s1, p2 - s2) + s + 1
+    elif op == "*":
+        p = p1 + p2 + 1
+        s = s1 + s2
+    elif op == "/":
+        s = max(6, s1 + p2 + 1)
+        p = p1 - s1 + s2 + s
+    else:
+        raise ValueError(op)
+    return adjust_precision_scale(p, s)
+
+
+def wider_decimal(a: DecimalType, b: DecimalType) -> DecimalType:
+    """Loss-free common type for comparisons/set ops (Spark
+    DecimalPrecision.widerDecimalType), 38-capped."""
+    s = max(a.scale, b.scale)
+    rng = max(a.precision - a.scale, b.precision - b.scale)
+    return DecimalType(min(rng + s, DecimalType.MAX_PRECISION), s)
+
+
 def tightest_common_type(a: DataType, b: DataType) -> Optional[DataType]:
     if a == b:
         return a
@@ -293,8 +352,17 @@ def tightest_common_type(a: DataType, b: DataType) -> Optional[DataType]:
     if a in _NUMERIC_ORDER and b in _NUMERIC_ORDER:
         return _NUMERIC_ORDER[max(_NUMERIC_ORDER.index(a),
                                   _NUMERIC_ORDER.index(b))]
-    if isinstance(a, DecimalType) and b in _NUMERIC_ORDER[:4]:
-        return a  # simplified; real Spark computes a wider decimal
-    if isinstance(b, DecimalType) and a in _NUMERIC_ORDER[:4]:
-        return b
+    if isinstance(a, DecimalType) or isinstance(b, DecimalType):
+        # fractional side wins entirely (Spark: decimal + float/double
+        # -> double); integral side is lifted to its exact decimal and
+        # widened loss-free
+        if isinstance(a, (FloatType, DoubleType)) or \
+                isinstance(b, (FloatType, DoubleType)):
+            return DoubleT
+        if isinstance(a, DecimalType) and isinstance(b, DecimalType):
+            return wider_decimal(a, b)
+        other = b if isinstance(a, DecimalType) else a
+        dec = a if isinstance(a, DecimalType) else b
+        if other in _NUMERIC_ORDER[:4]:
+            return wider_decimal(dec, decimal_for_integral(other))
     return None

@@ -125,8 +125,10 @@ numeric_and_decimal = numeric + DECIMAL_64
 comparable = numeric + _sig(BOOLEAN, DATE, TIMESTAMP, STRING)
 ordered = comparable
 # what the device columnar layer can represent today (strings as byte
-# matrices, no nested types yet) — the `commonCudfTypes` analogue
-common_tpu = numeric + _sig(BOOLEAN, DATE, TIMESTAMP, STRING, BINARY)
+# matrices, decimals as unscaled int64 / two-limb int128, no nested
+# types yet) — the `commonCudfTypes` analogue
+common_tpu = numeric + DECIMAL_128 + _sig(BOOLEAN, DATE, TIMESTAMP,
+                                          STRING, BINARY)
 common_tpu_with_null = common_tpu + _sig(NULL)
 # transitional operators (project/filter/generate/transitions) can CARRY
 # array columns whose elements are common; the heavy operators cannot

@@ -254,13 +254,15 @@ def test_fallback_disabled_expression():
         conf={"spark.rapids.sql.expression.Add": "false"})
 
 
-def test_fallback_decimal_input():
+def test_decimal_project_on_device():
+    """Round 4: decimal arithmetic runs on device (limb kernels); this
+    used to assert a CPU fallback."""
     import decimal
-    assert_tpu_fallback_collect(
+    assert_tpu_and_cpu_equal_collect(
         lambda s: s.createDataFrame(
             {"d": [decimal.Decimal("1.23"), decimal.Decimal("4.56"), None]},
             "d decimal(10,2)").select((0 - F.col("d")).alias("n")),
-        fallback_exec="CpuProjectExec")
+        expect_execs=["TpuProject"])
 
 
 def test_incompat_substring_gated():

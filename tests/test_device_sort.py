@@ -106,13 +106,16 @@ def test_sort_after_filter_keeps_masked_rows_out():
         expect_execs=["TpuFilter", "TpuSort"])
 
 
-def test_sort_decimal_falls_back():
+def test_sort_decimal_on_device():
+    """Round 4: decimal sort keys run on device (unscaled int64 /
+    limb-word radix keys); this used to assert a CPU fallback."""
     import decimal
-    assert_tpu_fallback_collect(
+    assert_tpu_and_cpu_equal_collect(
         lambda s: s.createDataFrame(
             {"d": [decimal.Decimal("1.23"), None, decimal.Decimal("-4.5")]},
             "d decimal(10,2)").orderBy("d"),
-        fallback_exec="CpuSortExec")
+        ignore_order=False,
+        expect_execs=["TpuSort"])
 
 
 def test_sort_empty_input():

@@ -1,0 +1,139 @@
+"""Exactness of the shared 128-bit limb kernels against Python ints,
+under BOTH numpy (CPU engine) and jax.numpy (device programs)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.ops import int128 as I
+
+
+def _rand_vals(rng, n, bits):
+    out = []
+    for _ in range(n):
+        b = int(rng.integers(1, bits + 1))
+        v = int(rng.integers(0, 1 << min(b, 62)))
+        for _ in range(b // 62):
+            v = (v << 62) | int(rng.integers(0, 1 << 62))
+        v &= (1 << b) - 1
+        if rng.random() < 0.5:
+            v = -v
+        lim = (1 << 127) - 1
+        out.append(max(-lim, min(lim, v)))
+    out.extend([0, 1, -1, (1 << 126), -(1 << 126), 10 ** 38 - 1,
+                -(10 ** 38 - 1)])
+    return out
+
+
+def _xps():
+    import jax.numpy as jnp
+    return [np, jnp]
+
+
+def _half_up_div(v: int, d: int) -> int:
+    """Exact integer HALF_UP (round half away from zero) reference."""
+    q, r = divmod(abs(v), abs(d))
+    if 2 * r >= abs(d):
+        q += 1
+    return q if (v < 0) == (d < 0) else -q
+
+
+@pytest.mark.parametrize("xp_i", [0, 1])
+def test_roundtrip_add_sub_neg_cmp(xp_i):
+    xp = _xps()[xp_i]
+    rng = np.random.default_rng(42)
+    vals = _rand_vals(rng, 200, 126)
+    hi, lo = I.from_pyints(vals)
+    hi, lo = xp.asarray(hi), xp.asarray(lo)
+    assert I.to_pyints(np.asarray(hi), np.asarray(lo)).tolist() == vals
+    v2 = list(reversed(vals))
+    h2, l2 = I.from_pyints(v2)
+    h2, l2 = xp.asarray(h2), xp.asarray(l2)
+    sh, sl = I.add(xp, hi, lo, h2, l2)
+    expect = [(a + b) for a, b in zip(vals, v2)]
+    # wrap to signed 128 like the kernel does
+    expect = [((e + (1 << 127)) % (1 << 128)) - (1 << 127) for e in expect]
+    assert I.to_pyints(np.asarray(sh), np.asarray(sl)).tolist() == expect
+    dh, dl = I.sub(xp, hi, lo, h2, l2)
+    exp2 = [((a - b + (1 << 127)) % (1 << 128)) - (1 << 127)
+            for a, b in zip(vals, v2)]
+    assert I.to_pyints(np.asarray(dh), np.asarray(dl)).tolist() == exp2
+    lt = I.cmp_lt(xp, hi, lo, h2, l2)
+    assert np.asarray(lt).tolist() == [a < b for a, b in zip(vals, v2)]
+
+
+@pytest.mark.parametrize("xp_i", [0, 1])
+def test_mul_i64_exact(xp_i):
+    xp = _xps()[xp_i]
+    rng = np.random.default_rng(7)
+    a = rng.integers(-(1 << 62), 1 << 62, 300)
+    b = rng.integers(-(1 << 62), 1 << 62, 300)
+    a[:4] = [0, -1, (1 << 62), -(1 << 62)]
+    b[:4] = [(1 << 62), -(1 << 62), -1, 0]
+    hi, lo = I.mul_i64(xp, xp.asarray(a), xp.asarray(b))
+    got = I.to_pyints(np.asarray(hi), np.asarray(lo)).tolist()
+    assert got == [int(x) * int(y) for x, y in zip(a, b)]
+
+
+@pytest.mark.parametrize("xp_i", [0, 1])
+def test_mul_by_i64_and_overflow(xp_i):
+    xp = _xps()[xp_i]
+    rng = np.random.default_rng(9)
+    vals = _rand_vals(rng, 200, 120)
+    mult = [int(rng.integers(-(10 ** 15), 10 ** 15)) or 3
+            for _ in vals]
+    hi, lo = I.from_pyints(vals)
+    rh, rl, over = I.mul_by_i64(xp, xp.asarray(hi), xp.asarray(lo),
+                                xp.asarray(np.array(mult, np.int64)))
+    got = I.to_pyints(np.asarray(rh), np.asarray(rl)).tolist()
+    ov = np.asarray(over).tolist()
+    for g, o, v, m in zip(got, ov, vals, mult):
+        exact = v * m
+        if -(1 << 127) <= exact < (1 << 127):
+            assert not o and g == exact, (v, m, g, exact)
+        else:
+            assert o, (v, m)
+
+
+@pytest.mark.parametrize("xp_i", [0, 1])
+@pytest.mark.parametrize("dbits", [5, 31, 40, 63])
+def test_div_halfup_exact(xp_i, dbits):
+    xp = _xps()[xp_i]
+    rng = np.random.default_rng(13 + dbits)
+    vals = _rand_vals(rng, 200, 120)
+    ds = [int(rng.integers(1, 1 << dbits)) for _ in vals]
+    ds = [d if rng2 % 2 else -d for d, rng2 in zip(ds, range(len(ds)))]
+    hi, lo = I.from_pyints(vals)
+    qh, ql = I.div_halfup(xp, xp.asarray(hi), xp.asarray(lo),
+                          xp.asarray(np.array(ds, np.int64)))
+    got = I.to_pyints(np.asarray(qh), np.asarray(ql)).tolist()
+    for g, v, d in zip(got, vals, ds):
+        exact = _half_up_div(v, d)
+        assert g == exact, (v, d, g, exact)
+
+
+@pytest.mark.parametrize("xp_i", [0, 1])
+def test_rescale_and_bounds(xp_i):
+    xp = _xps()[xp_i]
+    rng = np.random.default_rng(21)
+    vals = _rand_vals(rng, 100, 90)
+    hi, lo = I.from_pyints(vals)
+    hi, lo = xp.asarray(hi), xp.asarray(lo)
+    for delta in (0, 3, 18, -1, -6, -18):
+        from spark_rapids_tpu.ops import decimal_ops as D
+        rh, rl, over = D.rescale_to(xp, hi, lo, delta)
+        got = I.to_pyints(np.asarray(rh), np.asarray(rl)).tolist()
+        for g, o, v in zip(got, np.asarray(over).tolist(), vals):
+            if delta >= 0:
+                exact = v * 10 ** delta
+                if -(1 << 127) <= exact < (1 << 127):
+                    assert not o and g == exact
+                else:
+                    assert o
+            else:
+                exact = _half_up_div(v, 10 ** -delta)
+                assert g == exact, (v, delta, g, exact)
+    fits = I.fits_precision(xp, hi, lo, 20)
+    for f, v in zip(np.asarray(fits).tolist(), vals):
+        assert f == (abs(v) < 10 ** 20)

@@ -360,3 +360,63 @@ def test_partition_value_not_loosely_numeric(tmpdir_path):
             ("1_0", 1.0), ("2_5", 2.0)}
     finally:
         spark.stop()
+
+
+# -- round 4: parquet row-group predicate pushdown -------------------------
+
+def _write_sorted_parquet(spark, tmp_path, n=20000, parts=4):
+    import numpy as np
+    df = spark.createDataFrame(
+        {"x": list(range(n)),
+         "d": [18000 + (i % 1000) for i in range(n)],
+         "s": [f"k{i:06d}" for i in range(n)]},
+        "x long, d date, s string", num_partitions=parts)
+    path = str(tmp_path / "push.parquet")
+    df.write.mode("overwrite").parquet(path)
+    return path
+
+
+def test_pushdown_prunes_row_groups(tmp_path):
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    from spark_rapids_tpu.sql import functions as F
+    spark = TpuSparkSession({"spark.rapids.sql.enabled": "true"})
+    try:
+        path = _write_sorted_parquet(spark, tmp_path)
+        q = (spark.read.parquet(path).where(F.col("x") >= 15000)
+             .groupBy().agg(F.count("*").alias("c")))
+        spark.start_capture()
+        res = q.collect()
+        pstr = "\n".join(p.tree_string()
+                         for p in spark.get_captured_plans())
+        assert res[0][0] == 5000
+        # x is globally sorted across files: low row groups must go
+        assert "pushed 1 filters" in pstr and "pruned" in pstr, pstr
+        assert "pruned 0 units" not in pstr, pstr
+    finally:
+        spark.stop()
+
+
+def test_pushdown_all_pruned_keeps_global_agg(tmp_path):
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    from spark_rapids_tpu.sql import functions as F
+    spark = TpuSparkSession({"spark.rapids.sql.enabled": "true"})
+    try:
+        path = _write_sorted_parquet(spark, tmp_path)
+        res = (spark.read.parquet(path).where(F.col("x") > 10 ** 9)
+               .groupBy().agg(F.count("*").alias("c"))).collect()
+        assert res[0][0] == 0  # one global-agg row even with 0 units
+    finally:
+        spark.stop()
+
+
+def test_pushdown_equality_and_strings_correct(tmp_path):
+    from tests.harness import assert_tpu_and_cpu_equal_collect
+    from spark_rapids_tpu.sql import functions as F
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    gen = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    path = _write_sorted_parquet(gen, tmp_path)
+    gen.stop()
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: s.read.parquet(path)
+        .where((F.col("s") == "k000042") & F.col("x").isNotNull()),
+        expect_execs=["TpuFilter"])
