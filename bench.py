@@ -134,6 +134,100 @@ def assert_rows_match(cpu_rows, tpu_rows):
                 assert vc == vt, (vc, vt)
 
 
+TPCDS_Q3 = """
+SELECT d_year, i_brand_id brand_id, i_brand brand,
+       sum(ss_ext_sales_price) sum_agg
+FROM store_sales
+JOIN date_dim ON d_date_sk = ss_sold_date_sk
+JOIN item ON ss_item_sk = i_item_sk
+WHERE i_manufact_id = 128 AND d_moy = 11
+GROUP BY d_year, i_brand_id, i_brand
+ORDER BY d_year, sum_agg DESC, brand_id
+LIMIT 100
+"""
+
+TPCDS_ROWS = int(os.environ.get("BENCH_TPCDS_ROWS", 2_000_000))
+TPCDS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".bench-data", f"tpcds_{TPCDS_ROWS}")
+
+
+def ensure_tpcds_data(spark) -> None:
+    """Synthetic TPC-DS star-schema slice for q3 (BASELINE config 2):
+    store_sales fact + item/date_dim dimensions, decimal money."""
+    marker = os.path.join(TPCDS_DIR, "_SUCCESS.bench")
+    if os.path.exists(marker):
+        return
+    if os.path.exists(TPCDS_DIR):
+        shutil.rmtree(TPCDS_DIR)
+    from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+    from spark_rapids_tpu.sql import types as T
+    rng = np.random.default_rng(20260731)
+    DEC = T.DecimalType(7, 2)
+
+    n_item = 20_000
+    item = HostBatch(T.StructType([
+        T.StructField("i_item_sk", T.LongT),
+        T.StructField("i_brand_id", T.IntegerT),
+        T.StructField("i_brand", T.StringT),
+        T.StructField("i_manufact_id", T.IntegerT),
+    ]), [
+        HostColumn.all_valid(np.arange(1, n_item + 1), T.LongT),
+        HostColumn.all_valid(
+            rng.integers(1, 1000, n_item).astype(np.int32), T.IntegerT),
+        HostColumn.all_valid(np.array(
+            [f"brand#{i % 997:03d}" for i in range(n_item)],
+            dtype=object), T.StringT),
+        HostColumn.all_valid(
+            rng.integers(1, 1001, n_item).astype(np.int32), T.IntegerT),
+    ], n_item)
+
+    n_date = 73_049
+    date_dim = HostBatch(T.StructType([
+        T.StructField("d_date_sk", T.LongT),
+        T.StructField("d_year", T.IntegerT),
+        T.StructField("d_moy", T.IntegerT),
+    ]), [
+        HostColumn.all_valid(np.arange(1, n_date + 1), T.LongT),
+        HostColumn.all_valid(
+            (1998 + (np.arange(n_date) // 365) % 7).astype(np.int32),
+            T.IntegerT),
+        HostColumn.all_valid(
+            (1 + (np.arange(n_date) // 30) % 12).astype(np.int32),
+            T.IntegerT),
+    ], n_date)
+
+    n = TPCDS_ROWS
+    store_sales = HostBatch(T.StructType([
+        T.StructField("ss_sold_date_sk", T.LongT),
+        T.StructField("ss_item_sk", T.LongT),
+        T.StructField("ss_ext_sales_price", DEC),
+    ]), [
+        HostColumn.all_valid(rng.integers(1, n_date + 1, n), T.LongT),
+        HostColumn.all_valid(rng.integers(1, n_item + 1, n), T.LongT),
+        HostColumn.all_valid(rng.integers(100, 1_000_000, n), DEC),
+    ], n)
+
+    for name, batch, parts in (("item", item, 1), ("date_dim", date_dim, 1),
+                               ("store_sales", store_sales, 8)):
+        spark.createDataFrame(batch, num_partitions=parts).write \
+            .mode("overwrite").parquet(os.path.join(TPCDS_DIR, name))
+    with open(marker, "w") as f:
+        f.write("ok\n")
+
+
+def run_tpcds_q3(spark):
+    for name in ("item", "date_dim", "store_sales"):
+        spark.read.parquet(os.path.join(TPCDS_DIR, name)) \
+            .createOrReplaceTempView(name)
+    q = spark.sql(TPCDS_Q3)
+    run_once(q)  # warm
+    times, rows = [], None
+    for _ in range(2):
+        dt, rows = run_once(q)
+        times.append(dt)
+    return min(times), rows
+
+
 def stage_breakdown(plans) -> dict:
     """Aggregate per-operator time metrics from the captured physical
     plan of the LAST timed run (VERDICT r3 weak #10: publish where the
@@ -161,6 +255,7 @@ def main():
 
     gen = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
     ensure_data(gen)
+    ensure_tpcds_data(gen)
     gen.stop()
 
     cpu = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
@@ -170,6 +265,7 @@ def main():
     for _ in range(3):
         dt, cpu_rows = run_once(q_cpu)
         cpu_times.append(dt)
+    q3_cpu_t, q3_cpu_rows = run_tpcds_q3(cpu)
     cpu.stop()
 
     tpu = TpuSparkSession({
@@ -194,9 +290,11 @@ def main():
         dt, tpu_rows = run_once(q_tpu)
         tpu_times.append(dt)
     stages = stage_breakdown(tpu.get_captured_plans())
+    q3_tpu_t, q3_tpu_rows = run_tpcds_q3(tpu)
     tpu.stop()
 
     assert_rows_match(cpu_rows, tpu_rows)
+    assert_rows_match(q3_cpu_rows, q3_tpu_rows)
 
     cpu_t = min(cpu_times)
     tpu_t = min(tpu_times)
@@ -213,6 +311,12 @@ def main():
             "backend": __import__("jax").default_backend(),
             "rows": N_ROWS,
             "stages": stages,
+            "tpcds_q3": {
+                "device_wall_s": round(q3_tpu_t, 4),
+                "cpu_engine_wall_s": round(q3_cpu_t, 4),
+                "speedup_vs_cpu_engine": round(q3_cpu_t / q3_tpu_t, 4),
+                "rows": TPCDS_ROWS,
+            },
         },
     }))
 

@@ -185,11 +185,15 @@ def _to_storage(v: Any, dtype: T.DataType) -> Any:
             v = v.replace(tzinfo=datetime.timezone.utc)
         return int((v - epoch).total_seconds() * 1_000_000)
     if isinstance(dtype, T.DecimalType):
-        # unscaled int64 storage (DECIMAL64): value * 10^scale
+        # unscaled int storage: value * 10^scale. A widened local
+        # context: the default 28-digit precision rejects 38-digit
+        # DECIMAL128 values (InvalidOperation on quantize).
         d = v if isinstance(v, decimal.Decimal) else decimal.Decimal(str(v))
-        q = d.quantize(decimal.Decimal(1).scaleb(-dtype.scale),
-                       rounding=decimal.ROUND_HALF_UP)
-        return int(q.scaleb(dtype.scale))
+        with decimal.localcontext() as ctx:
+            ctx.prec = 80
+            q = d.quantize(decimal.Decimal(1).scaleb(-dtype.scale),
+                           rounding=decimal.ROUND_HALF_UP)
+            return int(q.scaleb(dtype.scale))
     return v
 
 

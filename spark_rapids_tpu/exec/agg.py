@@ -73,6 +73,22 @@ def dev_evaluate(func: E.AggregateFunction,
         data = jnp.where(b.validity, b.data, jnp.int64(0))
         data = jnp.where(out_active, data, jnp.int64(0))
         return DeviceColumn(T.LongT, data, out_active)
+    if isinstance(func, E.CentralMomentAgg):
+        # device twin of CentralMomentAgg._finish: M2 = sumsq - sum^2/n
+        n = jnp.where(buffers[0].validity, buffers[0].data, jnp.int64(0))
+        s = buffers[1].data.astype(jnp.float64)
+        sq = buffers[2].data.astype(jnp.float64)
+        nf = n.astype(jnp.float64)
+        m2 = jnp.maximum(
+            sq - (s * s) / jnp.where(n > 0, nf, jnp.float64(1.0)), 0.0)
+        div = nf - 1.0 if func.is_sample else nf
+        out = m2 / div  # n==1 sample: 0/0 -> NaN (Spark semantics)
+        if func.is_stddev:
+            out = jnp.sqrt(out)
+        validity = (n > 0) & out_active
+        return DeviceColumn(T.DoubleT,
+                            jnp.where(validity, out, jnp.float64(0.0)),
+                            validity)
     if isinstance(func, E.Average):
         s, cnt = buffers[0], buffers[1]
         count = jnp.where(cnt.validity, cnt.data, jnp.int64(0))
@@ -128,7 +144,8 @@ def is_device_agg(grouping: List[E.AttributeReference],
             if e.child.is_distinct:
                 return "DISTINCT aggregates are not supported"
             if not isinstance(func, (E.Sum, E.Count, E.Min, E.Max,
-                                     E.Average, E.First, E.Last)):
+                                     E.Average, E.First, E.Last,
+                                     E.CentralMomentAgg)):
                 return (f"aggregate {type(func).__name__} has no device "
                         "implementation")
             if isinstance(func, E.Average) \

@@ -66,7 +66,8 @@ def add_sub_supported(lt: T.DecimalType, rt: T.DecimalType) -> bool:
     an operand scale (the per-operand HALF_UP rescale would need a
     deeper-than-one-step division — slow path)."""
     res = T.decimal_binary_result("+", lt, rt)
-    return res.scale - min(lt.scale, rt.scale) >= -18
+    # the HIGHEST operand scale needs the deepest down-rescale
+    return res.scale - max(lt.scale, rt.scale) >= -18
 
 
 def add_sub(xp, op: str, ahi, alo, bhi, blo,

@@ -112,8 +112,11 @@ def expr_key(e: E.Expression) -> Tuple:
 # ---------------------------------------------------------------------------
 
 def _is_traced_literal(e: E.Literal) -> bool:
-    """Numeric non-null literals become runtime scalar inputs."""
+    """Numeric non-null literals become runtime scalar inputs (limb
+    decimals stay trace-time constants: their unscaled value exceeds an
+    int64 scalar)."""
     return (e.value is not None
+            and not T.is_limb_decimal(e.data_type)
             and not isinstance(e.data_type, (T.StringType, T.BinaryType,
                                              T.BooleanType, T.NullType)))
 
@@ -347,7 +350,7 @@ def _limb_decimal_gate(e: E.Expression) -> Optional[str]:
             E.Add, E.Subtract, E.Multiply, E.Divide, E.UnaryMinus,
             E.Abs, E.Cast, E.EqualTo, E.EqualNullSafe, E.LessThan,
             E.LessThanOrEqual, E.GreaterThan, E.GreaterThanOrEqual,
-            E.IsNull, E.IsNotNull, E.Alias,
+            E.IsNull, E.IsNotNull, E.Alias, E.Literal,
         }
     if type(e) in _LIMB_OK_EXPRS:
         return None
@@ -461,8 +464,23 @@ def _h_literal(e: E.Literal, ctx: Ctx) -> AnyDeviceColumn:
             return DeviceStringColumn(
                 dt, jnp.zeros((cap, 8), dtype=jnp.uint8),
                 jnp.zeros(cap, dtype=jnp.int32), jnp.zeros(cap, dtype=bool))
+        if T.is_limb_decimal(dt):
+            from spark_rapids_tpu.columnar.device import (
+                DeviceDecimal128Column)
+            z = jnp.zeros(cap, dtype=jnp.int64)
+            return DeviceDecimal128Column(dt, z, z,
+                                          jnp.zeros(cap, dtype=bool))
         return DeviceColumn(dt, jnp.zeros(cap, dtype=storage_jnp_dtype(dt)),
                             jnp.zeros(cap, dtype=bool))
+    if T.is_limb_decimal(dt):
+        from spark_rapids_tpu.columnar.device import DeviceDecimal128Column
+        from spark_rapids_tpu.columnar.host import _to_storage
+        from spark_rapids_tpu.ops import int128 as I
+        hi, lo = I.from_pyints([_to_storage(e.value, dt)])
+        return DeviceDecimal128Column(
+            dt, jnp.full(cap, int(hi[0]), dtype=jnp.int64),
+            jnp.full(cap, int(lo[0]), dtype=jnp.int64),
+            jnp.ones(cap, dtype=bool))
     if isinstance(dt, (T.StringType, T.BinaryType)):
         raw = (e.value.encode("utf-8") if isinstance(e.value, str)
                else bytes(e.value))

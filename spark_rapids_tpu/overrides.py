@@ -105,6 +105,9 @@ def check_expr_tree(e: E.Expression, conf: TpuConf) -> Optional[str]:
     rule = _EXPR_RULES.get(type(e))
     if rule is None:
         return (f"expression {type(e).__name__} is not supported on TPU")
+    r = X._limb_decimal_gate(e)
+    if r:
+        return r
     if not conf.is_op_enabled(rule.conf_key):
         return (f"expression {type(e).__name__} has been disabled "
                 f"({rule.conf_key}=false)")
@@ -360,6 +363,13 @@ def _tag_aggregate(meta: ExecMeta) -> None:
                         func.children[0].data_type):
                     meta.will_not_work(
                         "device float sum/average may differ from CPU due "
+                        "to addition ordering "
+                        "(spark.rapids.sql.variableFloatAgg.enabled=false)")
+                if isinstance(func, E.CentralMomentAgg):
+                    # stddev/variance sums floats (sum + sum-of-squares
+                    # buffers) regardless of the input dtype
+                    meta.will_not_work(
+                        "device stddev/variance may differ from CPU due "
                         "to addition ordering "
                         "(spark.rapids.sql.variableFloatAgg.enabled=false)")
 

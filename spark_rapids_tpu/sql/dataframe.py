@@ -458,12 +458,70 @@ class GroupedData:
         from spark_rapids_tpu.sql import functions as F
         return self.agg(F.count("*").alias("count"))
 
+    def pivot(self, col: str, values: Optional[list] = None
+              ) -> "PivotedData":
+        """groupBy(...).pivot(c, [v...]).agg(f): rewritten to one
+        conditional aggregate per pivot value — sum(when(c = v, x)) —
+        so the whole pivot rides the existing device aggregation path
+        (Spark's PivotFirst lowered to its CASE WHEN equivalent; the
+        reference device-codegens the same shape via GpuPivotFirst,
+        aggregate.scala:1059). Without explicit values the distinct
+        values are collected first (Spark does the same extra job)."""
+        from spark_rapids_tpu.sql import functions as F
+        if values is None:
+            rows = (self.df.select(F.col(col)).distinct()
+                    .orderBy(F.col(col)).collect())
+            values = [r[0] for r in rows if r[0] is not None]
+        return PivotedData(self, col, list(values))
+
     def _simple(self, fn, *cols) -> DataFrame:
         from spark_rapids_tpu.sql import functions as F
         targets = cols or [a.name for a in self.df.plan.output
                            if T.is_numeric(a.data_type)]
         return self.agg(*[fn(F.col(c)).alias(f"{fn.__name__}({c})")
                           for c in targets])
+
+
+class PivotedData:
+    """groupBy().pivot() staging: agg() fans each aggregate out across
+    the pivot values as conditional aggregates."""
+
+    def __init__(self, grouped: GroupedData, col: str, values: list):
+        self._grouped = grouped
+        self._col = col
+        self._values = values
+
+    def agg(self, *cols) -> DataFrame:
+        from spark_rapids_tpu.sql import functions as F
+        out = []
+        for c in cols:
+            e = self._grouped.df._resolve(c)
+            base_name = e.name if isinstance(e, E.Alias) else None
+            agg_expr = e.child if isinstance(e, E.Alias) else e
+            assert isinstance(agg_expr, E.AggregateExpression), (
+                "pivot agg expects aggregate expressions")
+            func = agg_expr.func
+            for v in self._values:
+                # sum(x) FILTER (WHERE p = v) == sum(when(p = v, x))
+                src = func.children[0] if func.children else E.Literal(1)
+                gated = E.CaseWhen(
+                    [(E.EqualTo(E.UnresolvedAttribute(self._col),
+                                E.Literal(v)), src)], None)
+                if isinstance(func, E.Count):
+                    fn2: E.AggregateFunction = E.Count([gated])
+                elif isinstance(func, (E.First, E.Last)):
+                    fn2 = type(func)(gated, func.ignore_nulls)
+                else:
+                    fn2 = type(func)(gated)
+                if len(cols) == 1:
+                    name = str(v)
+                else:
+                    suffix = base_name or _auto_name(agg_expr)
+                    name = f"{v}_{suffix}"
+                out.append(Column(E.Alias(
+                    E.AggregateExpression(fn2, agg_expr.is_distinct),
+                    name)))
+        return self._grouped.agg(*out)
 
     def sum(self, *cols) -> DataFrame:
         from spark_rapids_tpu.sql import functions as F
@@ -508,9 +566,12 @@ def _coerce_resolved(e: E.Expression) -> E.Expression:
             except Exception:
                 return None
             if lt != rt:
+                # +,-,* take DecimalPrecision's no-widen rule; %/pmod
+                # and comparisons widen to a common decimal
                 a, b = _coerce_pair(
                     node.left, node.right,
-                    arith=isinstance(node, E.BinaryArithmetic))
+                    arith=isinstance(node, (E.Add, E.Subtract,
+                                            E.Multiply)))
                 return type(node)(a, b)
         if isinstance(node, E.Divide):
             try:

@@ -110,6 +110,14 @@ class Literal(Expression):
         n = batch.num_rows
         if self.value is None:
             return HostColumn.nulls(n, self._dtype)
+        if T.is_limb_decimal(self._dtype):
+            from spark_rapids_tpu.ops import int128 as I
+            u = _to_storage(self.value, self._dtype)
+            hi, lo = I.from_pyints([u])
+            data = np.empty((n, 2), dtype=np.int64)
+            data[:, 0] = hi[0]
+            data[:, 1] = lo[0]
+            return HostColumn.all_valid(data, self._dtype)
         np_dt = T.numpy_dtype(self._dtype)
         if np_dt == np.dtype(object):
             data = np.full(n, self.value, dtype=object)
@@ -3240,6 +3248,79 @@ class Last(AggregateFunction):
 
     def evaluate(self, buffers):
         return buffers[0]
+
+
+class CentralMomentAgg(AggregateFunction):
+    """stddev/variance family over (count, sum, sum-of-squares) buffers.
+
+    Spark's CentralMomentAgg (AggregateFunctions twin) keeps a Welford
+    (n, avg, M2) buffer; this engine uses the algebraically equal
+    moment sums so the update/merge primitives stay the shared
+    sum/count vocabulary: M2 = sumsq - sum^2/n, clamped at 0 against
+    float cancellation (a constant column must give stddev 0, not
+    sqrt(-1e-18)). Both engines evaluate the SAME formula, so
+    CPU == device holds bit-for-bit wherever their sums do."""
+
+    is_sample = False   # /(n-1) vs /n
+    is_stddev = False   # sqrt at the end
+
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.DoubleT
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def buffer_slots(self):
+        child = self.children[0]
+        child_d = child if isinstance(child.data_type, T.DoubleType) \
+            else Cast(child, T.DoubleT)
+        sq = Multiply(child_d, child_d)
+        return [("n", T.LongT, PRIM_COUNT, child, PRIM_SUM_NONNULL),
+                ("sum", T.DoubleT, PRIM_SUM, child_d, PRIM_SUM),
+                ("sumsq", T.DoubleT, PRIM_SUM, sq, PRIM_SUM)]
+
+    def _finish(self, n, s, sq):
+        """Shared (numpy) finisher; the device twin mirrors it in
+        exec/agg.dev_evaluate."""
+        nf = n.astype(np.float64)
+        with np.errstate(all="ignore"):
+            m2 = np.maximum(sq - (s * s) / np.where(n > 0, nf, 1.0), 0.0)
+            div = nf - 1.0 if self.is_sample else nf
+            out = m2 / div  # n==1 sample: 0/0 -> NaN (Spark semantics)
+            if self.is_stddev:
+                out = np.sqrt(out)
+        return out
+
+    def evaluate(self, buffers):
+        n = np.where(buffers[0].validity, buffers[0].data, 0)
+        s = buffers[1].data.astype(np.float64)
+        sq = buffers[2].data.astype(np.float64)
+        validity = n > 0
+        out = self._finish(n, s, sq)
+        return HostColumn(T.DoubleT, np.where(validity, out, 0.0),
+                          validity).normalized()
+
+
+class VariancePop(CentralMomentAgg):
+    pass
+
+
+class VarianceSamp(CentralMomentAgg):
+    is_sample = True
+
+
+class StddevPop(CentralMomentAgg):
+    is_stddev = True
+
+
+class StddevSamp(CentralMomentAgg):
+    is_sample = True
+    is_stddev = True
 
 
 class AggregateExpression(Expression):

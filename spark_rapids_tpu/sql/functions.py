@@ -25,8 +25,10 @@ class Column:
     def _bin(self, other: Any, cls, swap: bool = False) -> "Column":
         o = _to_expr(other)
         a, b = (o, self.expr) if swap else (self.expr, o)
-        a, b = _coerce_pair(a, b, arith=issubclass(cls,
-                                                  E.BinaryArithmetic))
+        # ONLY +,-,*,/ use DecimalPrecision's no-widen operand rule;
+        # %/pmod (and comparisons) coerce to a common wider decimal
+        a, b = _coerce_pair(a, b, arith=issubclass(
+            cls, (E.Add, E.Subtract, E.Multiply, E.Divide)))
         return Column(cls(a, b))
 
     def __add__(self, other):
@@ -338,6 +340,28 @@ def avg(c) -> Column:
 
 
 mean = avg
+
+
+def stddev_samp(c) -> Column:
+    return _agg(E.StddevSamp(_to_col_expr(c)))
+
+
+stddev = stddev_samp
+
+
+def stddev_pop(c) -> Column:
+    return _agg(E.StddevPop(_to_col_expr(c)))
+
+
+def var_samp(c) -> Column:
+    return _agg(E.VarianceSamp(_to_col_expr(c)))
+
+
+variance = var_samp
+
+
+def var_pop(c) -> Column:
+    return _agg(E.VariancePop(_to_col_expr(c)))
 
 
 def min(c) -> Column:  # noqa: A001

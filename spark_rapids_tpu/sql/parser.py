@@ -656,6 +656,10 @@ def _sql_name(e: E.Expression) -> str:
 _FUNCTIONS = {
     "sum": F.sum, "avg": F.avg, "min": F.min, "max": F.max,
     "first": F.first, "last": F.last,
+    "stddev": F.stddev_samp, "stddev_samp": F.stddev_samp,
+    "std": F.stddev_samp, "stddev_pop": F.stddev_pop,
+    "variance": F.var_samp, "var_samp": F.var_samp,
+    "var_pop": F.var_pop,
     "abs": F.abs, "sqrt": F.sqrt, "exp": F.exp, "log": F.log,
     "ln": F.log, "log10": F.log10, "floor": F.floor, "ceil": F.ceil,
     "ceiling": F.ceil, "pow": F.pow, "round": F.round,

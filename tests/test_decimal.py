@@ -175,3 +175,46 @@ def test_decimal_distinct_dedup():
     assert_tpu_and_cpu_equal_collect(
         lambda s: _df(s).select("d").distinct().orderBy("d"),
         expect_execs=["TpuHashAggregate"])
+
+
+def test_tpcds_q3_shape_force_device():
+    """Star join + decimal sum + TopN (TPC-DS q3 shape, BASELINE
+    config 2) placed fully on device."""
+    def q(s):
+        import numpy as np
+        rng = np.random.default_rng(3)
+        n = 4000
+        s.createDataFrame(
+            {"ss_sold_date_sk": rng.integers(1, 400, n).tolist(),
+             "ss_item_sk": rng.integers(1, 200, n).tolist(),
+             "ss_ext_sales_price":
+                 [Decimal(int(v)).scaleb(-2)
+                  for v in rng.integers(100, 100000, n)]},
+            "ss_sold_date_sk long, ss_item_sk long, "
+            "ss_ext_sales_price decimal(7,2)",
+            num_partitions=2).createOrReplaceTempView("store_sales")
+        s.createDataFrame(
+            {"d_date_sk": list(range(1, 400)),
+             "d_year": [1998 + i % 5 for i in range(399)],
+             "d_moy": [1 + i % 12 for i in range(399)]},
+            "d_date_sk long, d_year int, d_moy int") \
+            .createOrReplaceTempView("date_dim")
+        s.createDataFrame(
+            {"i_item_sk": list(range(1, 200)),
+             "i_brand_id": [i % 37 for i in range(199)],
+             "i_brand": [f"b{i % 37}" for i in range(199)],
+             "i_manufact_id": [i % 10 for i in range(199)]},
+            "i_item_sk long, i_brand_id int, i_brand string, "
+            "i_manufact_id int").createOrReplaceTempView("item")
+        return s.sql(
+            "SELECT d_year, i_brand_id brand_id, i_brand brand, "
+            "sum(ss_ext_sales_price) sum_agg "
+            "FROM store_sales "
+            "JOIN date_dim ON d_date_sk = ss_sold_date_sk "
+            "JOIN item ON ss_item_sk = i_item_sk "
+            "WHERE i_manufact_id = 3 AND d_moy = 11 "
+            "GROUP BY d_year, i_brand_id, i_brand "
+            "ORDER BY d_year, sum_agg DESC, brand_id LIMIT 100")
+    assert_tpu_and_cpu_equal_collect(
+        q, ignore_order=False,
+        expect_execs=["TpuHashAggregate", "TpuTopN"])

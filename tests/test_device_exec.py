@@ -350,3 +350,48 @@ def test_coalesce_batches_inserted_after_exchange():
         assert got == want
     finally:
         s.stop()
+
+
+def test_stddev_variance_device():
+    """Round 4: stddev/variance family on device (CentralMomentAgg via
+    count/sum/sumsq buffers; n==1 sample -> NaN)."""
+    import numpy as np
+    rng = np.random.default_rng(8)
+    rows = {"k": [f"g{i % 5}" for i in range(300)] + ["solo"],
+            "v": rng.uniform(-100, 100, 301).tolist()}
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: s.createDataFrame(rows, "k string, v double")
+        .groupBy("k").agg(F.stddev("v").alias("sd"),
+                          F.stddev_pop("v").alias("sp"),
+                          F.var_samp("v").alias("vs"),
+                          F.var_pop("v").alias("vp")).orderBy("k"),
+        conf={"spark.rapids.sql.incompatibleOps.enabled": "true",
+              "spark.rapids.sql.variableFloatAgg.enabled": "true"},
+        approx=True,  # float sum order differs (variableFloatAgg)
+        expect_execs=["TpuHashAggregate"])
+
+
+def test_pivot_device():
+    """groupBy().pivot().agg() lowers to conditional aggregates on the
+    device path (GpuPivotFirst's CASE WHEN equivalent)."""
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: s.createDataFrame(
+            {"k": ["a", "b", "a", "a", "b", None],
+             "p": ["x", "x", "y", "y", "x", "y"],
+             "v": [1, 2, 3, 4, 5, 6]}, "k string, p string, v int")
+        .groupBy("k").pivot("p", ["x", "y", "z"])
+        .agg(F.sum("v").alias("s")).orderBy("k"),
+        expect_execs=["TpuHashAggregate"])
+
+
+def test_count_distinct_device():
+    """count(DISTINCT x) runs device-placed via the dedup-then-count
+    rewrite (RewriteDistinctAggregates single-group shape)."""
+    def q(s):
+        s.createDataFrame(
+            {"k": ["a", "b", "a", "a", "b"], "v": [1, 2, 2, 3, 2]},
+            "k string, v int").createOrReplaceTempView("cd")
+        return s.sql("SELECT k, count(DISTINCT v) c FROM cd "
+                     "GROUP BY k ORDER BY k")
+    assert_tpu_and_cpu_equal_collect(
+        q, ignore_order=False, expect_execs=["TpuHashAggregate"])
