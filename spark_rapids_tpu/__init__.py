@@ -36,25 +36,18 @@ import jax as _jax
 _jax.config.update("jax_enable_x64", True)
 
 # Persistent XLA compilation cache: on tunneled TPU backends a single
-# program compile costs ~30-40s (measured round 3); cached reloads cost
-# ~0.1s, across processes. CPU backends are excluded — XLA:CPU AOT cache
-# entries pin machine features and reloads warn of possible SIGILL.
-_cache_dir = _os.environ.get(
-    "SRT_XLA_CACHE_DIR",
-    _os.path.expanduser("~/.cache/spark_rapids_tpu/xla"))
+# program compile costs ~30-240s (measured rounds 3-4); cached reloads
+# cost ~0.1s, across processes. Policy (off switch, per-config
+# directory fingerprint) lives in device_manager.initialize.
 
 
 def _enable_compile_cache() -> None:
     """Called once a backend is live (session start / first device use);
-    cheap and idempotent."""
-    if not _cache_dir:
-        return
-    try:
-        if _jax.default_backend() == "cpu":
-            return
-    except Exception:
-        return
-    _jax.config.update("jax_compilation_cache_dir", _cache_dir)
-    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    cheap and idempotent. Delegates to device_manager.initialize, the
+    single owner of the persistent-cache policy (off switch + the
+    config-fingerprinted directory — mixing configs in one directory
+    deserializes foreign XLA:CPU AOT entries into SIGSEGV)."""
+    from spark_rapids_tpu import device_manager
+    device_manager.initialize()
 
 from spark_rapids_tpu.conf import TpuConf  # noqa: F401,E402

@@ -229,7 +229,8 @@ MEMORY_DEBUG = conf("spark.rapids.memory.tpu.debug").doc(
     "Log device allocation/free events (RapidsConf.scala:307).").boolean(False)
 
 SHUFFLE_COMPRESSION_CODEC = conf("spark.rapids.shuffle.compression.codec").doc(
-    "Codec for shuffle payloads on the host-staged path: none, lz4 "
+    "Codec for serialized batch payloads (disk spill tier and any "
+    "host-staged shuffle leg): none, zlib or zstd "
     "(TableCompressionCodec framework analogue).").string("none")
 
 ALLOW_DISABLE_ENTIRE_PLAN = conf(

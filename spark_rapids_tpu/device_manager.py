@@ -37,6 +37,21 @@ def initialize(conf=None) -> None:
                                    DEFAULT_CACHE_DIR)
         if cache_dir and cache_dir.lower() != "off":
             try:
+                # partition by backend + interpreter + jaxlib: XLA:CPU
+                # AOT entries pin the compiling process's machine
+                # features, and a different venv sharing one directory
+                # deserializes them into SIGSEGV/SIGILL (observed: a
+                # python 3.13 terminal's entries crashing the 3.12 test
+                # venv). Distinct subdirs keep every config safe while
+                # still caching within each.
+                import sys
+
+                import jaxlib
+                fingerprint = "{}-py{}.{}-jaxlib{}".format(
+                    jax.default_backend(), sys.version_info[0],
+                    sys.version_info[1],
+                    getattr(jaxlib, "__version__", "x"))
+                cache_dir = os.path.join(cache_dir, fingerprint)
                 os.makedirs(cache_dir, exist_ok=True)
                 jax.config.update("jax_compilation_cache_dir", cache_dir)
                 jax.config.update(

@@ -135,9 +135,19 @@ def is_device_window(window_exprs: List[E.Expression],
                 if X.contains_ansi_cast(src):
                     return "ANSI casts in window aggregates run on CPU"
             bounded = not (frame.is_unbounded_whole or frame.is_running)
-            if bounded and not isinstance(agg, (E.Sum, E.Count, E.Average)):
+            if bounded and not isinstance(agg, (E.Sum, E.Count, E.Average,
+                                                E.Min, E.Max)):
                 return (f"bounded {frame.frame_type} frames are device-"
-                        "supported for sum/count/avg only")
+                        "supported for sum/count/avg/min/max only")
+            if bounded and frame.frame_type == "range":
+                if len(order_spec) != 1:
+                    return ("value-bounded RANGE frames need exactly one "
+                            "ORDER BY expression")
+                odt = order_spec[0].child.data_type
+                if not (T.is_integral(odt) or T.is_floating(odt)
+                        or isinstance(odt, (T.DateType, T.TimestampType))):
+                    return ("value-bounded RANGE frames need a numeric/"
+                            "date/timestamp ORDER BY expression")
             continue
         return f"window function {type(func).__name__} is not supported"
     return None
@@ -348,6 +358,80 @@ def _winner_value(val: DeviceColumn, lay: _SortedLayout,
     return data, validity
 
 
+def _frame_bounds(lay: _SortedLayout, frame: E.WindowFrame, cap: int
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Per-row inclusive [lo, hi] sorted-position bounds of a BOUNDED
+    frame. ROWS frames are position offsets; value-bounded RANGE frames
+    resolve [ov+lower, ov+upper] with a vectorized binary search over
+    the partition-sorted order values (GpuWindowExec's bounded-range
+    resolution). Null-ordered rows frame their null peer block."""
+    if frame.frame_type == "rows":
+        lo = (lay.start_of_row if frame.lower is None
+              else jnp.maximum(lay.pos + frame.lower, lay.start_of_row))
+        hi = (lay.end_of_row if frame.upper is None
+              else jnp.minimum(lay.pos + frame.upper, lay.end_of_row))
+        return lo, hi
+    ov_s, ook, asc, nulls_first = lay.order_val
+    # sign-normalize so values ASCEND with sorted position; the offsets
+    # apply unnegated in this space (see the CPU twin, window_exec.py).
+    # Widen BEFORE negating: -int32.min overflows in int32
+    if jnp.issubdtype(ov_s.dtype, jnp.floating):
+        sgn = ov_s.astype(jnp.float64)
+        off_cast = float
+    else:
+        sgn = ov_s.astype(jnp.int64)
+        off_cast = int
+    if not asc:
+        sgn = -sgn
+
+    def gallop(pred_at) -> jax.Array:
+        """Last position p in [start-1, end] whose prefix predicate is
+        still True (monotone True->False within the partition)."""
+        idx = lay.start_of_row - 1
+        k = cap.bit_length()
+        for step in (1 << j for j in reversed(range(k + 1))):
+            nxt = idx + step
+            ok = (nxt <= lay.end_of_row) & pred_at(
+                jnp.clip(nxt, 0, cap - 1))
+            idx = jnp.where(ok, nxt, idx)
+        return idx
+
+    # null order values sort to one contiguous peer block; treat them
+    # as -inf (nulls first) / +inf (nulls last) so the searches stay
+    # monotone and never include them in a value frame
+    def lt(p, t):
+        v = jnp.take(sgn, p)
+        nl = ~jnp.take(ook, p)
+        return jnp.where(nl, jnp.bool_(nulls_first), v < t)
+
+    def le(p, t):
+        v = jnp.take(sgn, p)
+        nl = ~jnp.take(ook, p)
+        return jnp.where(nl, jnp.bool_(nulls_first), v <= t)
+
+    if frame.lower is None:
+        # unbounded preceding but EXCLUDING a leading null block
+        lo = gallop(lambda p: ~jnp.take(ook, p)
+                    if nulls_first else jnp.zeros(cap, dtype=bool)) + 1
+    else:
+        t_lo = sgn + off_cast(frame.lower)
+        lo = gallop(lambda p: lt(p, t_lo)) + 1
+    if frame.upper is None:
+        if nulls_first:  # nulls lead; the frame runs to partition end
+            hi = lay.end_of_row
+        else:  # exclude the TRAILING null block (valid: True..False)
+            hi = gallop(lambda p: jnp.take(ook, p))
+    else:
+        t_hi = sgn + off_cast(frame.upper)
+        hi = gallop(lambda p: le(p, t_hi))
+    # null rows frame their whole peer block instead
+    peer_first = jax.lax.cummax(jnp.where(lay.new_peer, lay.pos, -1))
+    is_null_row = ~ook
+    lo = jnp.where(is_null_row, peer_first, lo)
+    hi = jnp.where(is_null_row, lay.peer_last, hi)
+    return lo, hi
+
+
 def _agg_window(agg: E.AggregateFunction, frame: E.WindowFrame,
                 val: Optional[DeviceColumn], lay: _SortedLayout,
                 out_type: T.DataType) -> Tuple[jax.Array, jax.Array]:
@@ -375,12 +459,7 @@ def _agg_window(agg: E.AggregateFunction, frame: E.WindowFrame,
 
     def bounded(x):
         pp = _prefix_in_part(x, lay.start_of_row)
-        lower = frame.lower
-        upper = frame.upper
-        lo = (lay.start_of_row if lower is None
-              else jnp.maximum(lay.pos + lower, lay.start_of_row))
-        hi = (lay.end_of_row if upper is None
-              else jnp.minimum(lay.pos + upper, lay.end_of_row))
+        lo, hi = _frame_bounds(lay, frame, cap)
         nonempty = hi >= lo
         hi_v = jnp.take(pp, jnp.clip(hi, 0, cap - 1))
         lo_base = jnp.where(
@@ -416,6 +495,12 @@ def _agg_window(agg: E.AggregateFunction, frame: E.WindowFrame,
     if isinstance(agg, (E.Min, E.Max)):
         is_min = isinstance(agg, E.Min)
         words = G.rank_words(DeviceColumn(val.dtype, data_s, valid_s))
+        bounded_frame = not (frame.is_unbounded_whole or frame.is_running)
+        if bounded_frame:
+            lo, hi = _frame_bounds(lay, frame, cap)
+            win, has = _sparse_table_extreme(words, valid_s, lo, hi,
+                                             cap, is_min)
+            return _winner_value(val, lay, win, has)
         win, has = _seg_running_extreme(lay.part_id, words, valid_s,
                                         is_min)
         if frame.is_unbounded_whole:
@@ -457,9 +542,123 @@ def _agg_window(agg: E.AggregateFunction, frame: E.WindowFrame,
     raise X.DeviceUnsupported(type(agg).__name__)
 
 
+def _sparse_table_extreme(words: List[jax.Array], valid: jax.Array,
+                          lo: jax.Array, hi: jax.Array, cap: int,
+                          is_min: bool) -> Tuple[jax.Array, jax.Array]:
+    """Bounded-interval min/max: winner POSITION per row over the
+    per-row inclusive interval [lo, hi] in sorted space, via a sparse
+    table of winner positions (O(cap log cap) build, two gathers per
+    query — the XLA shape of sliding-window RMQ; the reference's
+    GpuWindowExec does the same bounded frames via cudf windowed
+    reductions, GpuWindowExec.scala:283). Intervals never cross
+    partition boundaries because callers clamp lo/hi to the row's
+    partition. Returns (winner position, has-winner)."""
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    sentinel = jnp.int32(cap)  # loses to every real candidate
+
+    def better(p1: jax.Array, p2: jax.Array) -> jax.Array:
+        """Pick the winning position (ties -> earlier position, which
+        keeps results deterministic and matches the CPU fold)."""
+        a_ok = p1 < sentinel
+        b_ok = p2 < sentinel
+        c1 = jnp.clip(p1, 0, cap - 1)
+        c2 = jnp.clip(p2, 0, cap - 1)
+        a_wins = jnp.zeros(p1.shape, dtype=bool)
+        decided = jnp.zeros(p1.shape, dtype=bool)
+        for w in words:
+            w1 = jnp.take(w, c1)
+            w2 = jnp.take(w, c2)
+            gt = (w1 > w2) if not is_min else (w1 < w2)
+            lt = (w1 < w2) if not is_min else (w1 > w2)
+            a_wins = jnp.where(~decided & gt, True, a_wins)
+            decided = decided | gt | lt
+        a_wins = jnp.where(~decided, p1 <= p2, a_wins)  # tie: earlier
+        a_wins = jnp.where(~b_ok, True, jnp.where(~a_ok, False, a_wins))
+        return jnp.where(a_wins, p1, p2)
+
+    level = jnp.where(valid, pos, sentinel)
+    levels = [level]
+    k = 1
+    while (1 << k) <= cap:
+        half = 1 << (k - 1)
+        shifted = jnp.concatenate(
+            [level[half:], jnp.full(half, sentinel, dtype=jnp.int32)])
+        level = better(level, shifted)
+        levels.append(level)
+        k += 1
+    tbl = jnp.stack(levels)  # (L, cap): winner over [i, i + 2^k)
+
+    length = jnp.maximum(hi - lo + 1, 1)
+    # floor(log2(len)): exact in f64 for every len <= cap
+    kq = jnp.floor(jnp.log2(length.astype(jnp.float64))).astype(jnp.int32)
+    c_lo = jnp.clip(lo, 0, cap - 1)
+    c_hi = jnp.clip(hi - (1 << kq) + 1, 0, cap - 1)
+    w1 = tbl[kq, c_lo]
+    w2 = tbl[kq, c_hi]
+    win = better(w1, w2)
+    nonempty = hi >= lo
+    has = nonempty & (win < sentinel)
+    return jnp.where(has, win, jnp.int32(0)), has
+
+
 # ---------------------------------------------------------------------------
 # Program builder + exec
 # ---------------------------------------------------------------------------
+
+def _key_chunk_ids(keycols_per_batch: List[List], actives: List[jax.Array],
+                   goal: int, n_chunks: int) -> List[jax.Array]:
+    """Per-batch chunk ids that NEVER split a partition-key group: rows
+    are ranked by key (one stable sort over the resident key columns,
+    the global_range_pids discipline), each group's chunk is decided by
+    the row count preceding its FIRST row, and ids map back through the
+    inverse permutation. A single group larger than ``goal`` stays in
+    one chunk (same contract as GpuKeyBatchingIterator)."""
+    from spark_rapids_tpu.columnar.device import (DeviceStringColumn,
+                                                  sort_with_payload)
+    from spark_rapids_tpu.ops import sort as S
+    n_keys = len(keycols_per_batch[0])
+    for ki in range(n_keys):
+        cols = [kc[ki] for kc in keycols_per_batch]
+        if isinstance(cols[0], DeviceStringColumn):
+            cc = max(c.char_cap for c in cols)
+            for bi, c in enumerate(cols):
+                if c.char_cap < cc:
+                    keycols_per_batch[bi][ki] = DeviceStringColumn(
+                        c.dtype,
+                        jnp.pad(c.chars, ((0, 0), (0, cc - c.char_cap))),
+                        c.lengths, c.validity)
+    keysets = []
+    for kc in keycols_per_batch:
+        subkeys: List[jax.Array] = []
+        for c in kc:
+            subkeys.extend(S.order_subkeys(c, True, True))
+        keysets.append(tuple(subkeys))
+    combined = [jnp.concatenate([ks[i] for ks in keysets])
+                for i in range(len(keysets[0]))]
+    active = jnp.concatenate(actives)
+    cap = active.shape[0]
+    sorted_all, perm, _p = sort_with_payload([~active] + combined, [])
+    active_s = ~sorted_all[0]
+    sorted_keys = sorted_all[1:]
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    differs = jnp.zeros(cap, dtype=bool)
+    for k in sorted_keys:
+        d = k[1:] != k[:-1]
+        differs = differs.at[1:].set(differs[1:] | d)
+    boundary = differs.at[0].set(True)
+    group_start = jax.lax.cummax(jnp.where(boundary, pos, 0))
+    chunk_sorted = jnp.minimum(group_start // jnp.int32(goal),
+                               jnp.int32(n_chunks - 1)).astype(jnp.int32)
+    chunk_sorted = jnp.where(active_s, chunk_sorted, jnp.int32(0))
+    inv = jnp.argsort(perm)
+    chunk_orig = jnp.take(chunk_sorted, inv)
+    out: List[jax.Array] = []
+    off = 0
+    for a in actives:
+        out.append(chunk_orig[off:off + a.shape[0]])
+        off += a.shape[0]
+    return out
+
 
 def _build_window_fn(part_bound: Tuple[E.Expression, ...],
                      order_specs: Tuple[E.SortOrder, ...],
@@ -475,6 +674,17 @@ def _build_window_fn(part_bound: Tuple[E.Expression, ...],
         part_cols = [X.dev_eval(e, ctx) for e in part_bound]
         order_cols = [X.dev_eval(e, ctx) for e in order_bound]
         lay = _layout(part_cols, list(order_specs), order_cols, active)
+        needs_ov = any(
+            it[0] == "agg" and it[2].frame_type == "range"
+            and not (it[2].is_unbounded_whole or it[2].is_running)
+            for it in items)
+        if needs_ov:
+            oc = order_cols[0]
+            lay.order_val = (jnp.take(oc.data, lay.perm),
+                             jnp.take(oc.validity, lay.perm)
+                             & lay.active_s,
+                             order_specs[0].ascending,
+                             order_specs[0].nulls_first)
         inv = jnp.argsort(lay.perm)  # original row -> sorted pos
         outs = []
         for item in items:
@@ -604,14 +814,63 @@ class TpuWindowExec(TpuExec):
                            batch._num_rows)
 
     def device_partitions(self) -> List[DevicePartitionThunk]:
+        goal = self.conf.batch_size_rows
+
         def make(thunk: DevicePartitionThunk) -> DevicePartitionThunk:
             def run() -> Iterator[DeviceBatch]:
-                batches = [b for b in thunk() if b.row_count()]
-                if not batches:
+                from spark_rapids_tpu.exec.exchange import (
+                    range_key_columns, realign_spilled_pids, split_by_pid)
+                from spark_rapids_tpu.memory import get_device_store
+                store = get_device_store(self.conf)
+                part_bound = P.bind_list(self.partition_spec,
+                                         self.child.output)
+                part_orders = [E.SortOrder(e, ascending=True)
+                               for e in self.partition_spec]
+                handles, keycols, actives = [], [], []
+                for b in thunk():
+                    if b._num_rows == 0:
+                        continue
+                    if part_bound:
+                        keycols.append(range_key_columns(
+                            part_orders, part_bound, b))
+                    actives.append(b.active)
+                    handles.append(store.register(b))
+                if not handles:
                     return
-                whole = (batches[0] if len(batches) == 1
-                         else concat_device(batches))
-                yield self._run_batch(whole)
+                total = sum(h.rows for h in handles)
+                if total <= goal or len(handles) == 1 or not part_bound:
+                    # small partition (or global window): one program
+                    whole = concat_device([h.get() for h in handles])
+                    for h in handles:
+                        h.close()
+                    yield self._run_batch(whole)
+                    return
+                # KEY-BATCHING (GpuKeyBatchingIterator.scala:35 role):
+                # chunk the stream so every partition-key GROUP lands
+                # whole in exactly one chunk; chunks stay near the
+                # batch-row goal and inputs are spillable handles, so
+                # the partition never has to fit HBM at once
+                n_chunks = max(1, (total + goal - 1) // goal)
+                pids_per_batch = _key_chunk_ids(keycols, actives, goal,
+                                                n_chunks)
+                keycols.clear()
+                buckets: List[List] = [[] for _ in range(n_chunks)]
+                for h, pids, act in zip(handles, pids_per_batch, actives):
+                    b, pids = realign_spilled_pids(h, pids, act)
+                    parts = split_by_pid(b, pids, n_chunks)
+                    h.close()
+                    for pid, part in enumerate(parts):
+                        if part is not None:
+                            buckets[pid].append(store.register(part))
+                for pid in range(n_chunks):
+                    parts = [h.get() for h in buckets[pid]]
+                    if not parts:
+                        continue
+                    whole = parts[0] if len(parts) == 1 \
+                        else concat_device(parts)
+                    for h in buckets[pid]:
+                        h.close()
+                    yield self._run_batch(whole)
             return run
         return [make(t) for t in device_channel(self.child)]
 

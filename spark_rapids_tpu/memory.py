@@ -5,7 +5,9 @@ A byte-budget pool over HBM-resident batches. Operators that hold batches
 across yields (exchange materialization, aggregation staging) register
 them as ``SpillableBatch`` handles; when the pool exceeds its budget the
 least-recently-used handles are demoted device -> host (numpy) -> disk
-(pickle under spark.rapids.memory.spillDirectory), and transparently
+(the columnar/serde.py format under spark.rapids.memory.spillDirectory,
+optionally compressed per spark.rapids.shuffle.compression.codec), and
+transparently
 re-promoted on access — the reference's 3-tier store collapsed onto the
 JAX transfer primitives (to_host/from_host ARE the spill copies).
 
@@ -30,7 +32,6 @@ from __future__ import annotations
 
 import logging
 import os
-import pickle
 import threading
 import uuid
 import weakref
@@ -130,11 +131,13 @@ class DeviceStore:
     spill, and accounts host-tier bytes against the host budget."""
 
     def __init__(self, device_budget: int, host_budget: int,
-                 spill_dir: str, debug: bool = False):
+                 spill_dir: str, debug: bool = False,
+                 codec: str = "none"):
         self.device_budget = device_budget
         self.host_budget = host_budget
         self.spill_dir = spill_dir
         self.debug = debug
+        self.codec = codec
         self._lock = threading.RLock()
         self._states: "OrderedDict[int, _State]" = OrderedDict()
         self._next_id = 0
@@ -168,8 +171,9 @@ class DeviceStore:
             assert st is not None and not st.closed, \
                 "SpillableBatch used after close"
             if st.tier == TIER_DISK:
+                from spark_rapids_tpu.columnar import serde
                 with open(st.disk_path, "rb") as f:
-                    st.host = pickle.load(f)
+                    st.host = serde.deserialize_batch(f.read())
                 os.unlink(st.disk_path)
                 st.disk_path = None
                 st.tier = TIER_HOST
@@ -232,8 +236,9 @@ class DeviceStore:
         os.makedirs(self.spill_dir, exist_ok=True)
         path = os.path.join(self.spill_dir,
                             f"spill-{uuid.uuid4().hex[:16]}.bin")
+        from spark_rapids_tpu.columnar import serde
         with open(path, "wb") as f:
-            pickle.dump(st.host, f, protocol=pickle.HIGHEST_PROTOCOL)
+            f.write(serde.serialize_batch(st.host, self.codec))
         self.host_bytes -= st.host_bytes
         st.host, st.host_bytes = None, 0
         st.disk_path = path
@@ -301,13 +306,21 @@ def get_device_store(conf: TpuConf) -> DeviceStore:
     """Process-wide store (GpuDeviceManager owns one RMM pool per
     executor); rebuilt when the configured budget changes (tests)."""
     global _STORE, _STORE_KEY
+    from spark_rapids_tpu.conf import SHUFFLE_COMPRESSION_CODEC
     budget = int(conf.get(DEVICE_MEMORY_LIMIT)) or _default_budget()
     host_budget = int(conf.get(HOST_SPILL_STORAGE_SIZE))
     spill_dir = str(conf.get(SPILL_DIR))
-    key = (budget, host_budget, spill_dir)
+    codec = str(conf.get(SHUFFLE_COMPRESSION_CODEC)).lower()
+    from spark_rapids_tpu.columnar import serde
+    if codec not in serde._CODECS:
+        raise ValueError(
+            f"spark.rapids.shuffle.compression.codec={codec!r}: "
+            f"supported codecs are {sorted(serde._CODECS)}")
+    key = (budget, host_budget, spill_dir, codec)
     with _STORE_LOCK:
         if _STORE is None or _STORE_KEY != key:
-            _STORE = DeviceStore(budget, host_budget, spill_dir)
+            _STORE = DeviceStore(budget, host_budget, spill_dir,
+                                 codec=codec)
             _STORE_KEY = key
         # logging-only: toggled in place so a debug flip never replaces
         # the live store (two stores would account one HBM independently)

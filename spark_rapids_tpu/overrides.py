@@ -600,9 +600,59 @@ def apply_overrides(physical: P.PhysicalPlan, conf: TpuConf,
         report.replaced_any = True
     else:
         report.replaced_any = _has_device_op(new_plan)
+    from spark_rapids_tpu.conf import CBO_ENABLED
+    if conf.get(CBO_ENABLED) and not conf.get(TEST_FORCE_DEVICE):
+        new_plan = _revert_small_islands(new_plan, report)
+        report.replaced_any = _has_device_op(new_plan)
     if conf.explain in ("ALL", "NOT_ON_GPU") and report.fallbacks:
         print(report.format())
     return new_plan
+
+
+def _revert_small_islands(plan: P.PhysicalPlan, report: RewriteReport
+                          ) -> P.PhysicalPlan:
+    """Cost-based optimizer v0 (CostBasedOptimizer.scala:52 role):
+    revert CPU-sandwiched device islands whose compute cannot repay the
+    transitions. The cost model: an island pays upload + download of
+    every batch byte (the R2C/C2R pair) while an elementwise op saves at
+    most one CPU pass over the same bytes — so an island with at most
+    ONE cheap (project/filter) operator always loses and goes back to
+    CPU. Wider islands (aggregates, joins, sorts, multiple ops) stay."""
+    from spark_rapids_tpu.exec.base import (TpuColumnarToRowExec,
+                                            TpuCoalesceBatchesExec,
+                                            TpuRowToColumnarExec)
+    from spark_rapids_tpu.exec.basic import TpuFilterExec, TpuProjectExec
+
+    new_children = [_revert_small_islands(c, report)
+                    for c in plan.children]
+    if new_children != plan.children:
+        plan = plan.with_new_children(new_children)
+    if not isinstance(plan, TpuColumnarToRowExec):
+        return plan
+    island: List[P.PhysicalPlan] = []
+    cur = plan.child
+    while isinstance(cur, (TpuProjectExec, TpuFilterExec,
+                           TpuCoalesceBatchesExec)):
+        island.append(cur)
+        cur = cur.children[0]
+    if not isinstance(cur, TpuRowToColumnarExec):
+        return plan
+    compute = [n for n in island
+               if not isinstance(n, TpuCoalesceBatchesExec)]
+    if len(compute) > 1:
+        return plan
+    cpu = cur.children[0]
+    for n in reversed(island):
+        if isinstance(n, TpuProjectExec):
+            cpu = P.CpuProjectExec(n.project_list, cpu)
+        elif isinstance(n, TpuFilterExec):
+            cpu = P.CpuFilterExec(n.condition, cpu)
+        # coalesce nodes have no CPU-side meaning: drop
+    report.fallbacks.append((
+        type(compute[0]).__name__ if compute else "TpuRowToColumnar",
+        ["the transition cost outweighs the device speedup "
+         "(spark.rapids.sql.optimizer.enabled)"]))
+    return cpu
 
 
 def _has_device_op(plan: P.PhysicalPlan) -> bool:

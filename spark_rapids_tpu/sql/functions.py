@@ -768,15 +768,14 @@ class WindowSpec:
                           E.WindowFrame("rows", lo, hi))
 
     def rangeBetween(self, start: int, end: int) -> "WindowSpec":
-        if start <= Window.unboundedPreceding and end == 0:
-            frame = E.WindowFrame("range", None, 0)
-        elif start <= Window.unboundedPreceding \
-                and end >= Window.unboundedFollowing:
-            frame = E.WindowFrame("range", None, None)
-        else:
-            raise NotImplementedError(
-                "only UNBOUNDED PRECEDING range frames are supported")
-        return WindowSpec(self._partition, self._order, frame)
+        lo = None if start <= Window.unboundedPreceding else int(start)
+        hi = None if end >= Window.unboundedFollowing else int(end)
+        # (None, 0) is the running-with-peers frame; any finite offset
+        # makes a VALUE-bounded range frame (requires a single numeric
+        # order expression, checked at evaluation like Spark's
+        # RangeFrame resolution)
+        return WindowSpec(self._partition, self._order,
+                          E.WindowFrame("range", lo, hi))
 
 
 class Window:

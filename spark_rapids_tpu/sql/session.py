@@ -135,6 +135,8 @@ class TpuSparkSession:
     # -- execution ---------------------------------------------------------
     def plan_physical(self, plan: L.LogicalPlan):
         """CPU physical plan, then the plugin rewrite when enabled."""
+        from spark_rapids_tpu import udf_compiler
+        plan = udf_compiler.rewrite_plan(plan, self.conf_obj)
         physical = Planner(self.conf_obj).plan(plan)
         self.last_rewrite_report = None
         if self.conf_obj.sql_enabled:

@@ -84,6 +84,28 @@ class CpuWindowExec(P.PhysicalPlan):
         dt = wx.data_type
         func = wx.func
         frame = wx.frame
+        # order VALUES for value-bounded range frames (Spark RangeFrame:
+        # exactly one numeric/date/timestamp order expression)
+        order_vals: Optional[HostColumn] = None
+        asc = True
+        if frame.frame_type == "range" and not frame.is_unbounded_whole \
+                and not frame.is_running:
+            if len(self.order_spec) != 1:
+                raise ValueError(
+                    "RANGE frame with value offsets requires exactly "
+                    "one ORDER BY expression")
+            o = self.order_spec[0]
+            odt = o.child.data_type
+            # decimals rejected outright: int offsets against unscaled
+            # storage would silently land at the wrong scale
+            if not (T.is_integral(odt) or T.is_floating(odt)
+                    or isinstance(odt, (T.DateType, T.TimestampType))):
+                raise ValueError(
+                    "RANGE frame offsets require a numeric/date/"
+                    "timestamp ORDER BY expression, got "
+                    f"{odt.simple_string}")
+            order_vals = E.bind_references(o.child, child_out).eval(batch)
+            asc = o.ascending
         # input values for aggregate/offset functions
         vals: Optional[HostColumn] = None
         if isinstance(func, E.AggregateExpression):
@@ -124,7 +146,7 @@ class CpuWindowExec(P.PhysicalPlan):
                     eq &= cv[1:] == cv[:-1]
                 new_peer[1:] = ~eq
             d, v = self._func_over_group(func, frame, vals, sorted_rows,
-                                         new_peer, dt)
+                                         new_peer, dt, order_vals, asc)
             out_data[sorted_rows] = d
             out_valid[sorted_rows] = v
         return HostColumn(dt, out_data, out_valid).normalized()
@@ -132,7 +154,9 @@ class CpuWindowExec(P.PhysicalPlan):
     def _func_over_group(self, func, frame: E.WindowFrame,
                          vals: Optional[HostColumn],
                          sorted_rows: np.ndarray, new_peer: np.ndarray,
-                         dt: T.DataType) -> Tuple[np.ndarray, np.ndarray]:
+                         dt: T.DataType,
+                         order_vals: Optional[HostColumn] = None,
+                         asc: bool = True) -> Tuple[np.ndarray, np.ndarray]:
         """Result (data, validity) in SORTED group order."""
         m = len(sorted_rows)
         if isinstance(func, E.RowNumber):
@@ -169,13 +193,16 @@ class CpuWindowExec(P.PhysicalPlan):
             return gd.astype(T.numpy_dtype(dt)), gv
         if isinstance(func, E.AggregateExpression):
             return self._agg_over_group(func.func, frame, vals,
-                                        sorted_rows, new_peer, dt)
+                                        sorted_rows, new_peer, dt,
+                                        order_vals, asc)
         raise NotImplementedError(type(func).__name__)
 
     def _agg_over_group(self, agg: E.AggregateFunction,
                         frame: E.WindowFrame, vals: HostColumn,
                         sorted_rows: np.ndarray, new_peer: np.ndarray,
-                        dt: T.DataType) -> Tuple[np.ndarray, np.ndarray]:
+                        dt: T.DataType,
+                        order_vals: Optional[HostColumn] = None,
+                        asc: bool = True) -> Tuple[np.ndarray, np.ndarray]:
         m = len(sorted_rows)
         v = vals.data[sorted_rows]
         ok = vals.validity[sorted_rows].astype(bool)
@@ -184,13 +211,51 @@ class CpuWindowExec(P.PhysicalPlan):
         if frame.is_unbounded_whole:
             lo = np.zeros(m, dtype=np.int64)
             hi = np.full(m, m - 1, dtype=np.int64)
-        elif frame.frame_type == "range":
+        elif frame.frame_type == "range" and frame.is_running:
             # running with peers: frame end = last row of the peer group
             peer_id = np.cumsum(new_peer) - 1
             last_of_peer = np.zeros(peer_id.max() + 1, dtype=np.int64)
             np.maximum.at(last_of_peer, peer_id, pos)
             lo = np.zeros(m, dtype=np.int64)
             hi = last_of_peer[peer_id]
+        elif frame.frame_type == "range":
+            # VALUE-bounded range: [ov + lower, ov + upper] resolved by
+            # binary search over the (partition-sorted) order values;
+            # null-ordered rows frame their null peer block (Spark
+            # RangeFrame semantics)
+            ov = order_vals.data[sorted_rows].astype(np.float64) \
+                if np.issubdtype(order_vals.data.dtype, np.floating) \
+                else order_vals.data[sorted_rows].astype(np.int64)
+            ook = order_vals.validity[sorted_rows].astype(bool)
+            sgn = ov if asc else -ov
+            nn = np.nonzero(ook)[0]  # contiguous block by sort order
+            nn_start = int(nn[0]) if len(nn) else 0
+            nn_vals = sgn[nn]  # ascending within the block
+            low_off = frame.lower
+            up_off = frame.upper
+            lo = np.zeros(m, dtype=np.int64)
+            hi = np.full(m, -1, dtype=np.int64)
+            if len(nn):
+                # offsets apply UNNEGATED in sign-normalized space: for
+                # DESC, sgn = -ov ascends with sort position, and
+                # [sgn+lower, sgn+upper] is exactly Spark's value frame
+                if low_off is None:
+                    lo_nn = np.full(len(nn), nn_start, dtype=np.int64)
+                else:
+                    lo_nn = nn_start + np.searchsorted(
+                        nn_vals, nn_vals + low_off, "left")
+                if up_off is None:
+                    hi_nn = np.full(len(nn), nn_start + len(nn) - 1,
+                                    dtype=np.int64)
+                else:
+                    hi_nn = nn_start + np.searchsorted(
+                        nn_vals, nn_vals + up_off, "right") - 1
+                lo[nn] = lo_nn
+                hi[nn] = hi_nn
+            nulls = np.nonzero(~ook)[0]
+            if len(nulls):  # null rows frame the whole null block
+                lo[nulls] = nulls[0]
+                hi[nulls] = nulls[-1]
         else:  # rows frame
             lo = pos + (-(1 << 62) if frame.lower is None else frame.lower)
             hi = pos + ((1 << 62) if frame.upper is None else frame.upper)

@@ -1,0 +1,202 @@
+"""udf-compiler: translate simple Python lambdas into Catalyst-style
+expression trees (the reference's udf-compiler module,
+udf-compiler/src/main/scala/com/nvidia/spark/udf/
+CatalystExpressionBuilder.scala:29-43, re-based on CPython bytecode).
+
+A tiny symbolic executor walks ``dis`` instructions with a stack of
+Column objects, so every arithmetic/comparison/conditional the lambda
+performs is rebuilt through the SAME operator overloads user queries go
+through — type coercion (decimal rules included) comes for free, and
+the resulting tree runs wherever any expression runs, device included.
+
+Scope (v0): arithmetic (+ - * / % **-free), comparisons, boolean
+and/or/not, ternary conditionals, and constants over the UDF's
+arguments. Anything else (calls, globals, loops, subscripts) makes
+``compile_udf`` return None and the UDF stays a row-at-a-time Python
+evaluation — the same silent-fallback contract as the reference
+(Plugin.scala:27-37).
+
+Note the documented semantic shift the reference also makes: a compiled
+UDF gets SQL NULL semantics (null propagates through operators) instead
+of Python's None handling inside the lambda.
+"""
+
+from __future__ import annotations
+
+import dis
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.sql import expressions as E
+from spark_rapids_tpu.sql import types as T
+
+
+class _Unsupported(Exception):
+    pass
+
+
+_SKIP_OPS = {"RESUME", "CACHE", "NOP", "PRECALL", "COPY_FREE_VARS",
+             "MAKE_CELL", "TO_BOOL", "NOT_TAKEN"}
+
+
+def compile_udf(fn, arg_exprs: List[E.Expression],
+                return_type: T.DataType) -> Optional[E.Expression]:
+    """Expression tree equivalent of ``fn(*arg_exprs)``, or None when
+    the lambda uses anything beyond the supported subset."""
+    from spark_rapids_tpu.sql.functions import Column
+    try:
+        code = fn.__code__
+    except AttributeError:
+        return None
+    if code.co_argcount != len(arg_exprs) or code.co_kwonlyargcount:
+        return None
+    params: Dict[str, Column] = {
+        name: Column(e)
+        for name, e in zip(code.co_varnames, arg_exprs)}
+    instrs = list(dis.get_instructions(fn))
+    by_offset = {ins.offset: i for i, ins in enumerate(instrs)}
+    try:
+        out = _exec(instrs, by_offset, 0, [], params)
+    except (_Unsupported, IndexError, KeyError, TypeError):
+        return None
+    if out is None:
+        return None
+    expr = out.expr
+    try:
+        if expr.data_type != return_type:
+            expr = E.Cast(expr, return_type)
+    except Exception:
+        return None
+    return expr
+
+
+def _exec(instrs, by_offset, i: int, stack: List, params) -> Optional:
+    from spark_rapids_tpu.sql import functions as F
+    from spark_rapids_tpu.sql.functions import Column
+
+    def lit(v) -> Column:
+        if v is None:
+            return Column(E.Literal(None, T.NullT))
+        return F.lit(v)
+
+    while i < len(instrs):
+        ins = instrs[i]
+        op = ins.opname
+        if op in _SKIP_OPS:
+            i += 1
+            continue
+        if op in ("LOAD_FAST", "LOAD_FAST_CHECK", "LOAD_FAST_BORROW"):
+            stack.append(params[ins.argval])
+        elif op == "LOAD_CONST":
+            stack.append(lit(ins.argval))
+        elif op == "RETURN_CONST":
+            return lit(ins.argval)
+        elif op == "RETURN_VALUE":
+            return stack.pop()
+        elif op == "BINARY_OP":
+            r = stack.pop()
+            a = stack.pop()
+            sym = ins.argrepr.replace("=", "")
+            if sym == "+":
+                stack.append(a + r)
+            elif sym == "-":
+                stack.append(a - r)
+            elif sym == "*":
+                stack.append(a * r)
+            elif sym == "/":
+                stack.append(a / r)
+            elif sym == "%":
+                stack.append(a % r)
+            else:
+                raise _Unsupported(sym)
+        elif op == "COMPARE_OP":
+            r = stack.pop()
+            a = stack.pop()
+            sym = ins.argval if isinstance(ins.argval, str) else \
+                ins.argrepr
+            sym = sym.replace("bool(", "").replace(")", "").strip()
+            ops = {"<": a < r, "<=": a <= r, ">": a > r, ">=": a >= r,
+                   "==": a == r, "!=": a != r}
+            if sym not in ops:
+                raise _Unsupported(sym)
+            stack.append(ops[sym])
+        elif op == "UNARY_NEGATIVE":
+            stack.append(-stack.pop())
+        elif op == "UNARY_NOT":
+            stack.append(~stack.pop())
+        elif op in ("POP_JUMP_IF_FALSE", "POP_JUMP_IF_TRUE",
+                    "POP_JUMP_FORWARD_IF_FALSE",
+                    "POP_JUMP_FORWARD_IF_TRUE"):
+            cond = stack.pop()
+            tgt = by_offset[ins.argval]
+            taken_first = op.endswith("IF_FALSE")
+            then_v = _exec(instrs, by_offset, i + 1, list(stack), params)
+            else_v = _exec(instrs, by_offset, tgt, list(stack), params)
+            if then_v is None or else_v is None:
+                raise _Unsupported(op)
+            if not taken_first:
+                then_v, else_v = else_v, then_v
+            return F.when(cond, then_v).otherwise(else_v)
+        elif op in ("JUMP_IF_FALSE_OR_POP", "JUMP_IF_TRUE_OR_POP"):
+            # `and` / `or`: left kept on one path, popped on the other
+            cond = stack.pop()
+            tgt = by_offset[ins.argval]
+            rest = _exec(instrs, by_offset, i + 1, list(stack), params)
+            if rest is None:
+                raise _Unsupported(op)
+            if op == "JUMP_IF_FALSE_OR_POP":
+                short = _exec(instrs, by_offset, tgt,
+                              list(stack) + [cond], params)
+                return F.when(cond, rest).otherwise(short)
+            short = _exec(instrs, by_offset, tgt,
+                          list(stack) + [cond], params)
+            return F.when(cond, short).otherwise(rest)
+        else:
+            raise _Unsupported(op)
+        i += 1
+    raise _Unsupported("fell off the end")
+
+
+def rewrite_plan(plan, conf) -> object:
+    """Replace compilable PythonUDF expressions across a RESOLVED
+    logical plan (both engines see the same rewrite, so dual-session
+    parity holds). Returns the (possibly) rewritten plan."""
+    from spark_rapids_tpu.conf import UDF_COMPILER_ENABLED
+    if not conf.get(UDF_COMPILER_ENABLED):
+        return plan
+
+    def fix_expr(e: E.Expression) -> Optional[E.Expression]:
+        if isinstance(e, E.PythonUDF):
+            compiled = compile_udf(e.fn, e.children, e.data_type)
+            if compiled is not None:
+                return compiled
+        return None
+
+    def walk(node):
+        import copy
+        if node.children:
+            new_kids = [walk(c) for c in node.children]
+            if any(a is not b for a, b in zip(new_kids, node.children)):
+                node = copy.copy(node)
+                node.children = new_kids
+        changed = False
+        updates = {}
+        for attr, val in list(vars(node).items()):
+            if isinstance(val, E.Expression):
+                nv = val.transform(fix_expr)
+                if nv is not val:
+                    updates[attr] = nv
+                    changed = True
+            elif isinstance(val, list) and val and all(
+                    isinstance(x, E.Expression) for x in val):
+                nv = [x.transform(fix_expr) for x in val]
+                if any(a is not b for a, b in zip(nv, val)):
+                    updates[attr] = nv
+                    changed = True
+        if changed:
+            import copy
+            node = copy.copy(node)
+            for attr, nv in updates.items():
+                setattr(node, attr, nv)
+        return node
+
+    return walk(plan)

@@ -12,6 +12,11 @@ platform with jax.config (which works even after jax was imported).
 import os
 import sys
 
+# No persistent XLA cache under pytest: XLA:CPU AOT entries have
+# repeatedly deserialized into SIGSEGV (machine-feature pinning +
+# concurrent-writer corruption); CPU compiles are fast enough to redo
+os.environ["SPARK_RAPIDS_TPU_XLA_CACHE"] = "off"
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -25,3 +30,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 assert jax.default_backend() == "cpu", jax.default_backend()
 assert len(jax.devices()) == 8, jax.devices()
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Bound in-process XLA:CPU executable accumulation: hundreds of
+    tests x fresh program shapes have repeatedly ended in a SIGSEGV
+    inside backend_compile late in the run (LLVM JIT state corruption
+    after thousands of live executables). Dropping JAX's traces and
+    executables between modules keeps the process small; modules
+    recompile what they reuse."""
+    yield
+    import jax
+    jax.clear_caches()

@@ -75,3 +75,53 @@ def test_device_partitions_conf_controls_exchange():
             .groupBy("i").agg(F.count("*").alias("c")).orderBy("i"),
             conf=dict(conf),
             expect_execs=["TpuExchange", "TpuHashAggregate"])
+
+
+def test_cbo_reverts_small_device_island():
+    """spark.rapids.sql.optimizer.enabled: a CPU-sandwiched single
+    project island loses its transition cost and reverts to CPU; with
+    the optimizer off the island stays on device (CostBasedOptimizer
+    v0)."""
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+
+    def plan_for(cbo: str):
+        sp = TpuSparkSession({
+            "spark.rapids.sql.enabled": "true",
+            "spark.rapids.sql.optimizer.enabled": cbo,
+            # make the island minimal: a single device-able projection
+            # over a CPU source, collected straight back to rows
+        })
+        try:
+            df = sp.createDataFrame(
+                {"a": list(range(64))}, "a int").select(
+                (F.col("a") + 1).alias("b"))
+            sp.start_capture()
+            df.collect()
+            return "\n".join(p.tree_string()
+                             for p in sp.get_captured_plans())
+        finally:
+            sp.stop()
+
+    on = plan_for("true")
+    off = plan_for("false")
+    assert "TpuProject" in off, off
+    assert "TpuProject" not in on and "Project" in on, on
+
+
+def test_cbo_keeps_wide_islands():
+    """Aggregation islands repay their transitions and must survive the
+    optimizer pass."""
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    sp = TpuSparkSession({"spark.rapids.sql.enabled": "true",
+                          "spark.rapids.sql.optimizer.enabled": "true"})
+    try:
+        df = sp.createDataFrame(
+            {"k": [i % 5 for i in range(64)], "v": list(range(64))},
+            "k int, v long").groupBy("k").agg(F.sum("v").alias("s"))
+        sp.start_capture()
+        df.collect()
+        pstr = "\n".join(p.tree_string()
+                         for p in sp.get_captured_plans())
+        assert "TpuHashAggregate" in pstr, pstr
+    finally:
+        sp.stop()

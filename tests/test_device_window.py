@@ -140,12 +140,15 @@ def test_float_window_sum_falls_back():
         fallback_exec="CpuWindowExec")
 
 
-def test_bounded_min_falls_back():
-    assert_tpu_fallback_collect(
+def test_bounded_min_on_device():
+    """Round 4: bounded-frame min/max runs on device (sparse-table RMQ);
+    this used to assert a CPU fallback."""
+    assert_tpu_and_cpu_equal_collect(
         lambda s: _df(s, [("k", SmallIntGen()), ("o", IntegerGen()),
                           ("v", LongGen())])
-        .select("k", F.min("v").over(_w().rowsBetween(-1, 1)).alias("m")),
-        fallback_exec="CpuWindowExec")
+        .select("k", "o", "v",
+                F.min("v").over(_w().rowsBetween(-1, 1)).alias("m")),
+        expect_execs=["TpuWindow"])
 
 
 def test_window_then_filter_pipeline():
@@ -164,4 +167,61 @@ def test_lag_string_with_default():
                           ("v", KeyStringGen())])
         .select("k", "o", F.lag("v", 1, "DFLT").over(_w()).alias("lg"),
                 F.row_number().over(_w()).alias("rn")),
+        expect_execs=["TpuWindow"])
+
+
+# -- round 4: bounded min/max, value-bounded RANGE, key batching -----------
+
+def test_bounded_rows_min_max():
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: _df(s, [("k", KeyStringGen()), ("o", IntegerGen()),
+                          ("v", IntegerGen())])
+        .select("k", "o", "v",
+                F.min("v").over(Window.partitionBy("k").orderBy("o", "v")
+                                .rowsBetween(-3, 2)).alias("mn"),
+                F.max("v").over(Window.partitionBy("k").orderBy("o", "v")
+                                .rowsBetween(0, 4)).alias("mx")),
+        expect_execs=["TpuWindow"])
+
+
+def test_value_bounded_range_frames():
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: _df(s, [("k", KeyStringGen()), ("o", IntegerGen()),
+                          ("v", IntegerGen())])
+        .select("k", "o", "v",
+                F.sum("v").over(Window.partitionBy("k").orderBy("o")
+                                .rangeBetween(-10, 10)).alias("s"),
+                F.count("v").over(Window.partitionBy("k").orderBy("o")
+                                  .rangeBetween(0, 25)).alias("c"),
+                F.min("v").over(Window.partitionBy("k").orderBy("o")
+                                .rangeBetween(-50, 0)).alias("mn")),
+        expect_execs=["TpuWindow"])
+
+
+def test_value_bounded_range_desc_and_nulls():
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: _df(s, [("k", KeyStringGen()),
+                          ("o", IntegerGen(null_prob=0.2)),
+                          ("v", IntegerGen())])
+        .select("k", "o", "v",
+                F.max("v").over(Window.partitionBy("k")
+                                .orderBy(F.col("o").desc())
+                                .rangeBetween(-7, 3)).alias("mx")),
+        expect_execs=["TpuWindow"])
+
+
+def test_window_key_batching_over_budget():
+    """Giant partitions stream through the key-batching iterator (chunks
+    split only at partition-key boundaries) under a tiny batch goal and
+    HBM budget — GpuKeyBatchingIterator + spill-framework contract."""
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: _df(s, [("k", KeyStringGen()), ("o", IntegerGen()),
+                          ("v", LongGen())], n=2000)
+        .select("k", "o", "v",
+                F.row_number().over(Window.partitionBy("k").orderBy("o", "v"))
+                .alias("rn"),
+                F.sum("v").over(Window.partitionBy("k").orderBy("o", "v"))
+                .alias("rs")),
+        conf={"spark.rapids.sql.batchSizeRows": "256",
+              "spark.rapids.memory.tpu.poolSize": str(1 << 16)},
         expect_execs=["TpuWindow"])

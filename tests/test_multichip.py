@@ -200,3 +200,77 @@ def test_shuffle_mode_ici_conf_activates_mesh():
                                            0 if v is None else v)
                                           for v in t))
     assert canon(got) == canon(want)
+
+
+def test_join_over_mesh(mesh8):
+    """Shuffled hash join with BOTH sides' exchanges riding the ICI
+    all-to-all (shuffle.mode=ici) matches the CPU engine."""
+    from tests.harness import assert_tpu_and_cpu_equal_collect
+    from spark_rapids_tpu.sql import functions as F
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: s.createDataFrame(
+            {"k": [i % 13 for i in range(400)],
+             "v": list(range(400))}, "k long, v long", num_partitions=4)
+        .join(s.createDataFrame(
+            {"k2": [i % 13 for i in range(60)],
+             "w": list(range(60))}, "k2 long, w long", num_partitions=2),
+            F.col("k") == F.col("k2"), "inner")
+        .groupBy("k").agg(F.count("*").alias("c"),
+                          F.sum("w").alias("sw")).orderBy("k"),
+        conf={"spark.rapids.shuffle.mode": "ici",
+              "spark.rapids.sql.autoBroadcastJoinThreshold": "-1"},
+        expect_execs=["TpuShuffledHashJoin"])
+
+
+def test_sort_over_mesh(mesh8):
+    """Global orderBy with ici mode active: hash exchanges ride the
+    mesh, the range exchange stays in-process; results match."""
+    from tests.harness import assert_tpu_and_cpu_equal_collect
+    from spark_rapids_tpu.sql import functions as F
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: s.createDataFrame(
+            {"k": [i % 7 for i in range(500)],
+             "v": [(i * 37) % 211 for i in range(500)]},
+            "k long, v long", num_partitions=4)
+        .groupBy("k").agg(F.sum("v").alias("s"))
+        .orderBy(F.col("s").desc(), "k"),
+        conf={"spark.rapids.shuffle.mode": "ici"},
+        ignore_order=False,
+        expect_execs=["TpuSort", "TpuHashAggregate"])
+
+
+def test_q1_shape_over_mesh(mesh8):
+    """The full q1 shape (filter -> decimal aggregate -> orderBy) with
+    shuffle.mode=ici on the 8-device mesh, bit-identical to CPU."""
+    from decimal import Decimal
+    from tests.harness import assert_tpu_and_cpu_equal_collect
+
+    def q(s):
+        import numpy as np
+        rng = np.random.default_rng(12)
+        n = 1200
+        s.createDataFrame(
+            {"l_returnflag": [["A", "N", "R"][i % 3] for i in range(n)],
+             "l_linestatus": [["O", "F"][i % 2] for i in range(n)],
+             "l_quantity": [Decimal(int(v)) for v in
+                            rng.integers(1, 51, n)],
+             "l_extendedprice": [Decimal(int(v)).scaleb(-2) for v in
+                                 rng.integers(90100, 10494951, n)],
+             "l_discount": [Decimal(int(v)).scaleb(-2) for v in
+                            rng.integers(0, 11, n)],
+             "l_shipdate": rng.integers(8000, 10500, n).tolist()},
+            "l_returnflag string, l_linestatus string, "
+            "l_quantity decimal(15,2), l_extendedprice decimal(15,2), "
+            "l_discount decimal(15,2), l_shipdate int",
+            num_partitions=4).createOrReplaceTempView("lineitem")
+        return s.sql(
+            "SELECT l_returnflag, l_linestatus, sum(l_quantity) sq, "
+            "sum(l_extendedprice * (1 - l_discount)) sd, "
+            "avg(l_discount) ad, count(*) c FROM lineitem "
+            "WHERE l_shipdate <= 10000 "
+            "GROUP BY l_returnflag, l_linestatus "
+            "ORDER BY l_returnflag, l_linestatus")
+    assert_tpu_and_cpu_equal_collect(
+        q, conf={"spark.rapids.shuffle.mode": "ici"},
+        ignore_order=False,
+        expect_execs=["TpuHashAggregate", "TpuSort"])
