@@ -107,8 +107,11 @@ class TpuShuffledHashJoinExec(TpuExec):
             if self.condition is not None:
                 cond = E.bind_references(self.condition, self._pair_attrs())
                 out = X.run_filter(cond, out)
-        self.metrics.create(M.NUM_OUTPUT_ROWS, M.ESSENTIAL).add(
-            out.row_count())
+        if out._num_rows is not None:
+            # known counts only: fetching one here would be a blocking
+            # roundtrip per joined batch purely for the metric
+            self.metrics.create(M.NUM_OUTPUT_ROWS, M.ESSENTIAL).add(
+                out._num_rows)
         # the exec's declared output may prune/reorder pair columns
         if self.join_type not in MASK_JOINS:
             out = self._project_output(out)
@@ -189,16 +192,19 @@ class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
     the device residency — no per-partition re-upload)."""
 
     def device_partitions(self) -> List[DevicePartitionThunk]:
+        # skip only KNOWN-empty batches: a row_count() here costs a
+        # blocking roundtrip per batch; concat_device syncs counts once
+        # when it actually has to stitch
         rbatches: List[DeviceBatch] = []
         for t in device_channel(self.right):
-            rbatches.extend(b for b in t() if b.row_count())
+            rbatches.extend(b for b in t() if b._num_rows != 0)
         # concat the build side ONCE; every stream partition reuses it
         if len(rbatches) > 1:
             rbatches = [concat_device(rbatches)]
 
         def make(lt: DevicePartitionThunk) -> DevicePartitionThunk:
             def run() -> Iterator[DeviceBatch]:
-                lb = [b for b in lt() if b.row_count()]
+                lb = [b for b in lt() if b._num_rows != 0]
                 yield from self._join_one(lb, list(rbatches))
             return run
         return [make(lt) for lt in device_channel(self.left)]

@@ -132,8 +132,10 @@ class TpuSortExec(TpuExec):
             def run() -> Iterator[DeviceBatch]:
                 if limit >= 0:
                     # TopN: memory-bounded by construction (per-batch
-                    # sort+limit, then one bounded merge)
-                    batches = [b for b in thunk() if b.row_count()]
+                    # sort+limit, then one bounded merge). Skip only
+                    # KNOWN-empty batches: a row_count() here would be a
+                    # blocking roundtrip per input batch
+                    batches = [b for b in thunk() if b._num_rows != 0]
                     if not batches:
                         return
                     whole = (batches[0] if len(batches) == 1

@@ -398,37 +398,62 @@ def _frame_bounds(lay: _SortedLayout, frame: E.WindowFrame, cap: int
 
     # null order values sort to one contiguous peer block; treat them
     # as -inf (nulls first) / +inf (nulls last) so the searches stay
-    # monotone and never include them in a value frame
+    # monotone and never include them in a value frame. NaNs form their
+    # OWN peer block (Spark total order: NaN greatest, all NaNs equal):
+    # last under ASC (+inf-like), first under DESC (-inf-like) — NaN
+    # comparisons being natively false handles ASC, DESC needs the
+    # explicit before-range treatment.
+    if jnp.issubdtype(sgn.dtype, jnp.floating):
+        is_nan_v = jnp.isnan(sgn)
+    else:
+        is_nan_v = jnp.zeros(cap, dtype=bool)
+
     def lt(p, t):
         v = jnp.take(sgn, p)
         nl = ~jnp.take(ook, p)
-        return jnp.where(nl, jnp.bool_(nulls_first), v < t)
+        nn = jnp.take(is_nan_v, p)
+        base = jnp.where(nn, jnp.bool_(not asc), v < t)
+        return jnp.where(nl, jnp.bool_(nulls_first), base)
 
     def le(p, t):
         v = jnp.take(sgn, p)
         nl = ~jnp.take(ook, p)
-        return jnp.where(nl, jnp.bool_(nulls_first), v <= t)
+        nn = jnp.take(is_nan_v, p)
+        base = jnp.where(nn, jnp.bool_(not asc), v <= t)
+        return jnp.where(nl, jnp.bool_(nulls_first), base)
+
+    # the engine's bounded-range convention (CPU twin identical): value
+    # frames of searchable rows span searchable positions only — the
+    # leading block (nulls when nulls-first, NaNs under DESC) and
+    # trailing block (nulls when nulls-last, NaNs under ASC) stay out
+    def leading(p):
+        nl = ~jnp.take(ook, p)
+        nn = jnp.take(is_nan_v, p)
+        return (nl & jnp.bool_(nulls_first)) | (nn & jnp.bool_(not asc))
+
+    def keep(p):
+        nl = ~jnp.take(ook, p)
+        nn = jnp.take(is_nan_v, p)
+        trailing = (nl & jnp.bool_(not nulls_first)) \
+            | (nn & jnp.bool_(asc))
+        return ~trailing
 
     if frame.lower is None:
-        # unbounded preceding but EXCLUDING a leading null block
-        lo = gallop(lambda p: ~jnp.take(ook, p)
-                    if nulls_first else jnp.zeros(cap, dtype=bool)) + 1
+        lo = gallop(leading) + 1
     else:
         t_lo = sgn + off_cast(frame.lower)
         lo = gallop(lambda p: lt(p, t_lo)) + 1
     if frame.upper is None:
-        if nulls_first:  # nulls lead; the frame runs to partition end
-            hi = lay.end_of_row
-        else:  # exclude the TRAILING null block (valid: True..False)
-            hi = gallop(lambda p: jnp.take(ook, p))
+        hi = gallop(keep)
     else:
         t_hi = sgn + off_cast(frame.upper)
         hi = gallop(lambda p: le(p, t_hi))
-    # null rows frame their whole peer block instead
+    # null rows AND valid-NaN rows frame their whole peer block instead
+    # (each is its own contiguous peer group under Spark's total order)
     peer_first = jax.lax.cummax(jnp.where(lay.new_peer, lay.pos, -1))
-    is_null_row = ~ook
-    lo = jnp.where(is_null_row, peer_first, lo)
-    hi = jnp.where(is_null_row, lay.peer_last, hi)
+    peer_framed = ~ook | is_nan_v
+    lo = jnp.where(peer_framed, peer_first, lo)
+    hi = jnp.where(peer_framed, lay.peer_last, hi)
     return lo, hi
 
 

@@ -228,6 +228,18 @@ class CpuWindowExec(P.PhysicalPlan):
                 else order_vals.data[sorted_rows].astype(np.int64)
             ook = order_vals.validity[sorted_rows].astype(bool)
             sgn = ov if asc else -ov
+            # NaN order values: all NaNs are ordering-peers (Spark total
+            # order), so NaN rows frame their peer block like nulls do
+            # (a SEPARATE block — nulls and NaNs sort apart), and finite
+            # rows' searches exclude them (NaN never falls in a finite
+            # value interval; inside the search array it would break
+            # searchsorted's sorted contract).
+            orig_ook = ook
+            if np.issubdtype(ov.dtype, np.floating):
+                is_nan_row = orig_ook & np.isnan(ov)
+                ook = ook & ~np.isnan(ov)
+            else:
+                is_nan_row = np.zeros(m, dtype=bool)
             nn = np.nonzero(ook)[0]  # contiguous block by sort order
             nn_start = int(nn[0]) if len(nn) else 0
             nn_vals = sgn[nn]  # ascending within the block
@@ -252,10 +264,14 @@ class CpuWindowExec(P.PhysicalPlan):
                         nn_vals, nn_vals + up_off, "right") - 1
                 lo[nn] = lo_nn
                 hi[nn] = hi_nn
-            nulls = np.nonzero(~ook)[0]
+            nulls = np.nonzero(~orig_ook)[0]
             if len(nulls):  # null rows frame the whole null block
                 lo[nulls] = nulls[0]
                 hi[nulls] = nulls[-1]
+            nans = np.nonzero(is_nan_row)[0]
+            if len(nans):  # NaN rows frame the whole NaN block
+                lo[nans] = nans[0]
+                hi[nans] = nans[-1]
         else:  # rows frame
             lo = pos + (-(1 << 62) if frame.lower is None else frame.lower)
             hi = pos + ((1 << 62) if frame.upper is None else frame.upper)

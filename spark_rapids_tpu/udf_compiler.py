@@ -104,8 +104,9 @@ def _exec(instrs, by_offset, i: int, stack: List, params) -> Optional:
                 stack.append(a * r)
             elif sym == "/":
                 stack.append(a / r)
-            elif sym == "%":
-                stack.append(a % r)
+            # '%' is NOT translated: Python's sign-follows-divisor
+            # remainder differs from SQL Remainder on negative
+            # operands, so modulo lambdas stay row-at-a-time Python
             else:
                 raise _Unsupported(sym)
         elif op == "COMPARE_OP":

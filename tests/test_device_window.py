@@ -225,3 +225,27 @@ def test_window_key_batching_over_budget():
         conf={"spark.rapids.sql.batchSizeRows": "256",
               "spark.rapids.memory.tpu.poolSize": str(1 << 16)},
         expect_execs=["TpuWindow"])
+
+
+def test_value_bounded_range_nan_order_values():
+    """NaN order values form their own peer block (Spark total order:
+    all NaNs equal, greatest): NaN rows frame the NaN block, finite
+    rows' value frames exclude it — on both engines, ASC and DESC."""
+    nan = float("nan")
+    rows = {"k": ["a"] * 10 + ["b"] * 6,
+            "o": [1.0, 2.0, 3.0, nan, nan, None, 4.0, 5.0, nan, None,
+                  2.0, nan, 1.0, None, 3.0, nan],
+            "v": list(range(16))}
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: s.createDataFrame(rows, "k string, o double, v int")
+        .select("k", "o", "v",
+                F.sum("v").over(Window.partitionBy("k").orderBy("o")
+                                .rangeBetween(-1, 1)).alias("s"),
+                F.sum("v").over(Window.partitionBy("k")
+                                .orderBy(F.col("o").desc())
+                                .rangeBetween(-1, 1)).alias("sd"),
+                F.count("v").over(
+                    Window.partitionBy("k").orderBy("o")
+                    .rangeBetween(Window.unboundedPreceding, 0))
+                .alias("cu")),
+        expect_execs=["TpuWindow"])
