@@ -31,7 +31,8 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence
 from spark_rapids_tpu.columnar.host import HostBatch
 from spark_rapids_tpu.conf import (MAX_READER_BATCH_SIZE_ROWS,
                                    MULTITHREADED_READ_NUM_THREADS,
-                                   PARQUET_READER_TYPE, TpuConf)
+                                   PARQUET_READER_TYPE, TASK_PARALLELISM,
+                                   TpuConf)
 from spark_rapids_tpu.io.arrow_convert import (arrow_schema_to_sql,
                                                arrow_to_host_batch,
                                                sql_type_to_arrow)
@@ -195,18 +196,20 @@ def plan_scan_units(fmt: str, files: List[tuple]) -> List[ScanUnit]:
     return units
 
 
-def pack_partitions(units: List[ScanUnit],
-                    max_bytes: int) -> List[List[ScanUnit]]:
-    """Bin-pack units into partitions (FilePartition.getFilePartitions)."""
+def pack_partitions(units: List[ScanUnit], max_bytes: int,
+                    open_cost: int = 0) -> List[List[ScanUnit]]:
+    """Bin-pack units into partitions (FilePartition.getFilePartitions;
+    each unit weighs its bytes PLUS openCostInBytes, like Spark)."""
     parts: List[List[ScanUnit]] = []
     cur: List[ScanUnit] = []
     cur_bytes = 0
     for u in units:
-        if cur and cur_bytes + u.size_bytes > max_bytes:
+        w = u.size_bytes + open_cost
+        if cur and cur_bytes + w > max_bytes:
             parts.append(cur)
             cur, cur_bytes = [], 0
         cur.append(u)
-        cur_bytes += u.size_bytes
+        cur_bytes += w
     if cur:
         parts.append(cur)
     return parts
@@ -416,13 +419,28 @@ class CpuFileScanExec(P.PhysicalPlan):
         part_names = {k for _f, pv in listed for k in pv}
         self._part_fields = [f for f in self.schema.fields
                              if f.name in part_names]
-        self._max_bytes = int(
+        max_bytes = int(
             conf.get_key("spark.sql.files.maxPartitionBytes",
                          DEFAULT_MAX_PARTITION_BYTES))
+        open_cost = int(
+            conf.get_key("spark.sql.files.openCostInBytes", 4 << 20))
         self._units = plan_scan_units(fmt, listed)
+        # Spark's FilePartition.maxSplitBytes: size splits so the scan
+        # fans out across the configured task parallelism instead of
+        # packing one giant partition — bytesPerCore floored by
+        # openCostInBytes, capped by maxPartitionBytes. Without this a
+        # 60MB dataset became ONE partition and serialized the whole
+        # decode/upload/compute pipeline on a single task thread.
+        parallelism = max(1, int(conf.get(TASK_PARALLELISM)))
+        total = sum(u.size_bytes for u in self._units) \
+            + open_cost * len(self._units)
+        self._max_bytes = min(max_bytes,
+                              max(open_cost, total // parallelism))
         self._pushed: List[tuple] = []  # (col, op, storage value)
         self.pruned_units = 0  # observability (tools/tests)
-        self._parts = pack_partitions(self._units, self._max_bytes)
+        self._open_cost = open_cost
+        self._parts = pack_partitions(self._units, self._max_bytes,
+                                      open_cost)
 
     def set_pushdown(self, preds: List[tuple]) -> None:
         """Install pushed-down predicates (name, op, storage-value) and
@@ -438,7 +456,8 @@ class CpuFileScanExec(P.PhysicalPlan):
         self.pruned_units = len(self._units) - len(kept)
         # always at least one (possibly empty) partition so global
         # aggregates still see a partition to produce their one row in
-        self._parts = pack_partitions(kept, self._max_bytes) \
+        self._parts = pack_partitions(kept, self._max_bytes,
+                              self._open_cost) \
             if kept else [[]]
 
     @property

@@ -9,9 +9,9 @@ performs is rebuilt through the SAME operator overloads user queries go
 through — type coercion (decimal rules included) comes for free, and
 the resulting tree runs wherever any expression runs, device included.
 
-Scope (v0): arithmetic (+ - * / % **-free), comparisons, boolean
-and/or/not, ternary conditionals, and constants over the UDF's
-arguments. Anything else (calls, globals, loops, subscripts) makes
+Scope (v0): arithmetic (+ - * / — NOT %, whose Python sign semantics
+differ from SQL Remainder), comparisons, boolean and/or/not, ternary
+conditionals, and constants over the UDF's arguments. Anything else (calls, globals, loops, subscripts) makes
 ``compile_udf`` return None and the UDF stays a row-at-a-time Python
 evaluation — the same silent-fallback contract as the reference
 (Plugin.scala:27-37).

@@ -420,3 +420,36 @@ def test_pushdown_equality_and_strings_correct(tmp_path):
         lambda s: s.read.parquet(path)
         .where((F.col("s") == "k000042") & F.col("x").isNotNull()),
         expect_execs=["TpuFilter"])
+
+
+def test_scan_fans_out_across_task_parallelism(tmp_path):
+    """FilePartition.maxSplitBytes: with taskParallelism > 1 a multi-
+    file dataset splits into multiple scan partitions (openCostInBytes
+    weighs each unit); with the default parallelism it packs as before."""
+    import re
+    from spark_rapids_tpu.sql import functions as F
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    gen = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    path = str(tmp_path / "fan.parquet")
+    gen.createDataFrame({"x": list(range(40000))}, "x long",
+                        num_partitions=8).write.mode("overwrite") \
+        .parquet(path)
+    gen.stop()
+
+    def nparts(conf):
+        sp = TpuSparkSession(conf)
+        try:
+            sp.start_capture()
+            out = sp.read.parquet(path).groupBy().agg(
+                F.count("*").alias("c")).collect()
+            assert out[0][0] == 40000
+            pstr = "\n".join(p.tree_string()
+                             for p in sp.get_captured_plans())
+            line = [ln for ln in pstr.splitlines() if "FileScan" in ln][0]
+            return int(re.search(r"(\d+) partitions", line).group(1))
+        finally:
+            sp.stop()
+
+    wide = nparts({"spark.rapids.sql.enabled": "true",
+                   "spark.rapids.sql.taskParallelism": "4"})
+    assert wide > 1, wide
