@@ -592,8 +592,12 @@ def sort_with_payload(keys: Sequence[jax.Array],
         order = pos
         for k in reversed(keys):
             order = stable_pass(k, order)
-    sorted_keys = tuple(jnp.take(k, order) for k in keys)
-    sorted_payload = [jnp.take(a, order, axis=0) for a in payload]
+    from spark_rapids_tpu.ops.lanes import fused_take
+    # ONE lane-matrix gather for keys + payload together (each separate
+    # gather costs a flat ~25-40ms on the tunneled backend)
+    gathered = fused_take(list(keys) + list(payload), order)
+    sorted_keys = tuple(gathered[:len(keys)])
+    sorted_payload = gathered[len(keys):]
     return sorted_keys, order, sorted_payload
 
 
@@ -651,11 +655,18 @@ def take_columns(columns: Sequence[AnyDeviceColumn], idx: jax.Array,
 
 
 def _compact_body(active: jax.Array, flat):
-    _keys, _order, sorted_flat = sort_with_payload([~active], flat)
-    n = jnp.sum(active)
-    new_active = jnp.arange(active.shape[0]) < n
+    """Stable compaction (active rows to the front): ONE 2-operand sort
+    pass for the permutation + ONE fused lane-matrix gather for all
+    arrays. (A searchsorted-based variant was tried in round 5: XLA
+    lowers searchsorted to ~log2(cap) gather iterations on this backend,
+    costing more than the sort pass it saved.)"""
+    from spark_rapids_tpu.ops.lanes import fused_take
+    cap = active.shape[0]
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    _k, idx = jax.lax.sort((~active, pos), num_keys=1, is_stable=True)
+    new_active = pos < jnp.sum(active)
     outs = []
-    for g in sorted_flat:
+    for g in fused_take(list(flat), idx):
         # zero out the padding tail for determinism
         if g.ndim == 2:
             g = jnp.where(new_active[:, None], g, 0)

@@ -244,24 +244,62 @@ class TpuHashAggregateExec(TpuExec):
         aggregates = self.aggregates
         all_exprs = tuple(key_bound) + tuple(slot_srcs)
 
+        # partial/merge outputs feed a re-grouping stage downstream, so
+        # hash-fragmented groups are fine and the 1-pass hash sort
+        # applies; final/complete emit user-facing rows and need the
+        # exact multi-word sort (build_segments_hashed docstring)
+        hashed = mode in ("partial", "merge", "merge_partial")
+        _SUM_KINDS = {E.PRIM_COUNT: "count", E.PRIM_SUM: "sum",
+                      E.PRIM_SUM_NONNULL: "sum_nonnull"}
+
         def fn(cols, active, lit_vals):
             from spark_rapids_tpu.columnar.device import (flatten_columns,
                                                           rebuild_columns)
             cap = active.shape[0]
             ctx = X.Ctx(cols, cap, all_exprs, lit_vals)
             key_cols = [X.dev_eval(e, ctx) for e in key_bound]
-            slot_vals = [X.dev_eval(e, ctx) for e in slot_srcs]
+            # dedupe slot sources (sum(x) + avg(x) share x): each unique
+            # expression is evaluated, sorted, and lane-packed ONCE
+            uniq_srcs: List[E.Expression] = []
+            uniq_of: Dict[tuple, int] = {}
+            src_map: List[int] = []
+            for e in slot_srcs:
+                k = X.expr_key(e)
+                if k not in uniq_of:
+                    uniq_of[k] = len(uniq_srcs)
+                    uniq_srcs.append(e)
+                src_map.append(uniq_of[k])
+            slot_vals = [X.dev_eval(e, ctx) for e in uniq_srcs]
             # keys AND slot values ride the segment sort as payload (one
-            # multi-operand lax.sort; sort-then-gather is ~16x slower on
-            # TPU for wide rows)
+            # fused lane-matrix gather; sorting each array separately is
+            # a flat ~25-40ms per op on this backend)
             flat, spec = flatten_columns(key_cols + slot_vals)
-            seg = G.build_segments(key_cols, active, payload=flat,
-                                   has_nans=has_nans)
+            if hashed:
+                seg = G.build_segments_hashed(
+                    key_cols, active, payload=flat, has_nans=has_nans,
+                    sorted_keys_from_payload=lambda ps:
+                        rebuild_columns(spec, ps)[:len(key_cols)])
+            else:
+                seg = G.build_segments(key_cols, active, payload=flat,
+                                       has_nans=has_nans)
             sorted_cols = rebuild_columns(spec, seg.payload)
             keys_s = sorted_cols[:len(key_cols)]
-            vals_s = sorted_cols[len(key_cols):]
-            buffers = [apply_prim_device(p, seg, v, dt, has_nans)
-                       for (p, dt), v in zip(prims, vals_s)]
+            uniq_s = sorted_cols[len(key_cols):]
+            vals_s = [uniq_s[j] for j in src_map]
+            # sum/count-family slots batch into ONE cumsum/scan pass;
+            # min/max/first/last keep their per-slot scans
+            buffers: List[Optional[AnyDeviceColumn]] = [None] * len(prims)
+            entries, entry_pos = [], []
+            for i, ((p, dt), v) in enumerate(zip(prims, vals_s)):
+                if p in _SUM_KINDS:
+                    entries.append((v, _SUM_KINDS[p], dt))
+                    entry_pos.append(i)
+                else:
+                    buffers[i] = apply_prim_device(p, seg, v, dt,
+                                                   has_nans)
+            for i, c in zip(entry_pos,
+                            G.seg_sums_batched(seg, entries, has_nans)):
+                buffers[i] = c
             # results live at segment-END rows of the sorted layout;
             # the keys are ALREADY in that layout — just mask them
             out_active = seg.out_active

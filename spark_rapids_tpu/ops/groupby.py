@@ -144,19 +144,13 @@ def pack_string_words(c: DeviceStringColumn) -> List[jax.Array]:
     """Big-endian packed uint64 words: numeric word order == byte
     lexicographic order, so word-wise compare/sort matches UTF-8 binary
     order (with the lengths column as tiebreak for zero padding)."""
-    cap, char_cap = c.chars.shape
-    n_words = (char_cap + 7) // 8
+    from spark_rapids_tpu.ops.lanes import chars_to_u64_words
+    char_cap = c.chars.shape[1]
     chars = c.chars
     if char_cap % 8:
+        n_words = (char_cap + 7) // 8
         chars = jnp.pad(chars, ((0, 0), (0, 8 * n_words - char_cap)))
-    words: List[jax.Array] = []
-    c64 = chars.astype(jnp.uint64)
-    for w in range(n_words):
-        word = jnp.zeros(cap, dtype=jnp.uint64)
-        for k in range(8):
-            word = word | (c64[:, 8 * w + k] << jnp.uint64(56 - 8 * k))
-        words.append(word)
-    return words
+    return chars_to_u64_words(chars)
 
 
 def grouping_subkeys(col: AnyDeviceColumn,
@@ -222,23 +216,62 @@ def seg_scan_best(seg_marker: jax.Array, words: Sequence[jax.Array],
 class Segments:
     """Sorted-row-space segmentation. Aggregates read their per-segment
     result at the segment's END row; ``out_active`` marks those rows.
-    ``payload`` holds the caller's arrays co-permuted by the SAME sort
-    (lax.sort payload operands — far cheaper on TPU than sorting an
-    index and gathering each array separately)."""
+    ``payload`` holds the caller's arrays co-permuted by the SAME sort.
+    ``start_of_row``/``end_of_row``/``seg_ids`` are computed lazily —
+    each is a fusion-breaking scan this backend pays ~25-40ms for, so
+    programs that never touch them never emit them."""
 
     def __init__(self, order, active_sorted, boundary, is_end,
-                 start_of_row, end_of_row, seg_ids, capacity: int,
-                 payload: Tuple[jax.Array, ...] = ()):
+                 capacity: int, payload: Tuple[jax.Array, ...] = ()):
         self.order = order                  # sorted pos -> original row
         self.active_sorted = active_sorted
         self.boundary = boundary            # first row of its segment
         self.is_end = is_end                # last row of its segment
-        self.start_of_row = start_of_row    # own segment's first pos
-        self.end_of_row = end_of_row        # own segment's last pos
-        self.seg_ids = seg_ids              # dense id per sorted row
         self.capacity = capacity
         self.out_active = is_end & active_sorted
         self.payload = payload              # co-sorted caller arrays
+        self._start = None
+        self._end = None
+        self._seg_ids = None
+
+    @property
+    def start_of_row(self):
+        """Own segment's first sorted position, per row."""
+        if self._start is None:
+            pos = jnp.arange(self.capacity, dtype=jnp.int32)
+            self._start = jax.lax.cummax(
+                jnp.where(self.boundary, pos, -1))
+        return self._start
+
+    @property
+    def end_of_row(self):
+        """Own segment's last sorted position (inclusive), per row."""
+        if self._end is None:
+            pos = jnp.arange(self.capacity, dtype=jnp.int32)
+            self._end = jnp.flip(jax.lax.cummin(
+                jnp.flip(jnp.where(self.is_end, pos, self.capacity))))
+        return self._end
+
+    @property
+    def seg_ids(self):
+        """Dense segment id per sorted row."""
+        if self._seg_ids is None:
+            self._seg_ids = jnp.cumsum(
+                self.boundary.astype(jnp.int32)) - 1
+        return self._seg_ids
+
+
+def _boundaries_from_words(sorted_keys: Sequence[jax.Array],
+                           active_s: jax.Array, cap: int):
+    prev_differs = jnp.zeros(cap, dtype=bool)
+    for k in sorted_keys:
+        d = k[1:] != k[:-1]
+        prev_differs = prev_differs.at[1:].set(prev_differs[1:] | d)
+    prev_differs = prev_differs.at[1:].set(
+        prev_differs[1:] | (active_s[1:] != active_s[:-1]))
+    boundary = prev_differs.at[0].set(True)
+    is_end = jnp.concatenate([boundary[1:], jnp.ones(1, dtype=bool)])
+    return boundary, is_end
 
 
 def build_segments(key_cols: Sequence[AnyDeviceColumn],
@@ -250,7 +283,6 @@ def build_segments(key_cols: Sequence[AnyDeviceColumn],
     for c in key_cols:
         subkeys.extend(grouping_subkeys(c, has_nans))
     from spark_rapids_tpu.columnar.device import sort_with_payload
-    pos = jnp.arange(cap, dtype=jnp.int32)
     # ONE multi-operand sort: ~active primary (live rows first), then the
     # sub-keys (row index appended by sort_with_payload = stable), with
     # the caller's payload co-permuted for free.
@@ -258,21 +290,95 @@ def build_segments(key_cols: Sequence[AnyDeviceColumn],
         [~active] + subkeys, payload)
     active_s = ~sorted_keys_all[0]
     sorted_keys = sorted_keys_all[1:]
-    prev_differs = jnp.zeros(cap, dtype=bool)
-    for k in sorted_keys:
-        d = k[1:] != k[:-1]
-        prev_differs = prev_differs.at[1:].set(prev_differs[1:] | d)
-    prev_differs = prev_differs.at[1:].set(
-        prev_differs[1:] | (active_s[1:] != active_s[:-1]))
-    boundary = prev_differs.at[0].set(True)
-    is_end = jnp.concatenate(
-        [boundary[1:], jnp.ones(1, dtype=bool)])
-    start_of_row = jax.lax.cummax(jnp.where(boundary, pos, -1))
-    end_of_row = jnp.flip(jax.lax.cummin(
-        jnp.flip(jnp.where(is_end, pos, cap))))
-    seg_ids = jnp.cumsum(boundary.astype(jnp.int32)) - 1
-    return Segments(order, active_s, boundary, is_end, start_of_row,
-                    end_of_row, seg_ids, cap, tuple(payload_sorted))
+    boundary, is_end = _boundaries_from_words(sorted_keys, active_s, cap)
+    return Segments(order, active_s, boundary, is_end, cap,
+                    tuple(payload_sorted))
+
+
+_FNV64 = jnp.uint64(0xcbf29ce484222325)
+_PRIME64 = jnp.uint64(0x00000100000001B3)
+_MIX64 = jnp.uint64(0x9E3779B97F4A7C15)
+
+
+def _hash_word_u64(w: jax.Array) -> jax.Array:
+    """Deterministic u64 image of one equality word. Equal words MUST
+    map equal; collisions only fragment groups (harmless for partial
+    aggregates — see build_segments_hashed)."""
+    if w.dtype == jnp.bool_:
+        return w.astype(jnp.uint64)
+    if w.dtype == jnp.uint64:
+        return w
+    if w.dtype == jnp.float32:
+        from spark_rapids_tpu.ops.lanes import _as_u64_bits
+        return _as_u64_bits(w)
+    if w.dtype == jnp.float64:
+        # no 64-bit float bitcast on this stack: build a value image
+        # from integer conversions (saturating, deterministic; equal
+        # values -> equal images)
+        a = w.astype(jnp.int64)
+        b = (w * jnp.float64(65536.0)).astype(jnp.int64)
+        return (a.view(jnp.uint64) * _MIX64) ^ b.view(jnp.uint64)
+    return w.astype(jnp.int64).view(jnp.uint64)
+
+
+def hash_subkey_words(words: Sequence[jax.Array]) -> jax.Array:
+    """FNV-style fold of equality words into one u64 (elementwise —
+    fuses into neighbouring ops)."""
+    h = jnp.full(words[0].shape, _FNV64, dtype=jnp.uint64)
+    for w in words:
+        h = (h ^ _hash_word_u64(w)) * _PRIME64
+    h = h ^ (h >> jnp.uint64(29))
+    h = h * _MIX64
+    h = h ^ (h >> jnp.uint64(32))
+    return h
+
+
+def build_segments_hashed(key_cols: Sequence[AnyDeviceColumn],
+                          active: jax.Array,
+                          payload: Sequence[jax.Array] = (),
+                          has_nans: Optional[bool] = None,
+                          sorted_keys_from_payload=None) -> Segments:
+    """Hash-sorted segmentation: ONE radix pass (63-bit key hash with
+    the inactive flag on top) instead of one pass per subkey word, then
+    exact boundaries from the co-gathered REAL key words.
+
+    Hash collisions between different keys can interleave their rows
+    within a hash run, FRAGMENTING a group into several segments — but
+    never merge two groups (boundaries compare the real words).
+    Fragmented partial aggregates are correct by construction: the
+    merge/final stage re-groups them. Use ONLY where duplicate group
+    rows are acceptable (partial/merge modes); final/complete must use
+    the exact :func:`build_segments`."""
+    cap = active.shape[0]
+    subkeys: List[jax.Array] = []
+    for c in key_cols:
+        subkeys.extend(grouping_subkeys(c, has_nans))
+    if subkeys:
+        h = hash_subkey_words(subkeys) >> jnp.uint64(1)
+    else:  # global aggregate: one segment, sort only compacts live rows
+        h = jnp.zeros(cap, dtype=jnp.uint64)
+    word = jnp.where(active, h, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    _sw, order = jax.lax.sort((word, pos), num_keys=1, is_stable=True)
+    from spark_rapids_tpu.ops.lanes import fused_take
+    if sorted_keys_from_payload is not None:
+        # the key columns already ride the payload: recompute their
+        # equality words AFTER the gather (elementwise, fuses) instead of
+        # widening the lane matrix with a second copy of the keys
+        gathered = fused_take(list(payload) + [active], order)
+        payload_sorted = gathered[:-1]
+        active_s = gathered[-1]
+        sorted_keys = []
+        for c in sorted_keys_from_payload(payload_sorted):
+            sorted_keys.extend(grouping_subkeys(c, has_nans))
+    else:
+        gathered = fused_take(list(payload) + subkeys + [active], order)
+        payload_sorted = gathered[:len(payload)]
+        sorted_keys = gathered[len(payload):-1]
+        active_s = gathered[-1]
+    boundary, is_end = _boundaries_from_words(sorted_keys, active_s, cap)
+    return Segments(order, active_s, boundary, is_end, cap,
+                    tuple(payload_sorted))
 
 
 def seg_running_sum(seg_marker: jax.Array, x: jax.Array) -> jax.Array:
@@ -364,6 +470,143 @@ def _seg_sum_limb(seg: Segments, col_s: AnyDeviceColumn, valid_s,
     rhi = jnp.where(validity, rhi, z)
     rlo = jnp.where(validity, rlo, z)
     return DeviceDecimal128Column(out_type, rhi, rlo, validity)
+
+
+def seg_sums_batched(seg: Segments, entries, has_nans=None):
+    """All of a program's sum/count-family aggregates in ONE pass: every
+    slot contributes int64 lanes to a single ``(cap, P)`` matrix (one
+    cumsum + one base gather) and float slots to a single f64 matrix
+    (one segmented associative scan). Replaces per-slot seg_sum/seg_count
+    chains — each separate cumsum/gather costs a flat ~25-40ms on this
+    backend regardless of width, so lane-batching is a near-P-fold win.
+
+    ``entries``: list of ``(col_s, kind, out_type)`` with ``kind`` in
+    {"count", "sum", "sum_nonnull"}; ``col_s`` already in sorted row
+    space. Returns one device column per entry (same semantics as
+    seg_count / seg_sum)."""
+    from spark_rapids_tpu.columnar.device import (
+        DeviceColumn as DC, DeviceDecimal128Column, storage_jnp_dtype)
+    from spark_rapids_tpu.ops import int128 as I
+    if not entries:
+        return []
+    ilanes: List[jax.Array] = []
+    flanes: List[jax.Array] = []
+    specs: List[Tuple] = []
+    m32 = jnp.uint64(0xFFFFFFFF)
+    z64 = jnp.int64(0)
+    lane_of: dict = {}  # (id(array), tag) -> existing lane index
+
+    def _ilane(arr, tag, a) -> int:
+        key = (id(arr), tag)
+        li = lane_of.get(key)
+        if li is None:
+            li = len(ilanes)
+            ilanes.append(a)
+            lane_of[key] = li
+        return li
+
+    for col, kind, out_type in entries:
+        valid = col.validity & seg.active_sorted
+        if kind == "count":
+            specs.append(("count",
+                          _ilane(col.validity, "valid",
+                                 valid.astype(jnp.int64))))
+            continue
+        nwe = kind == "sum"  # null_when_empty
+        has_lane = None
+        if nwe:
+            has_lane = _ilane(col.validity, "valid",
+                              valid.astype(jnp.int64))
+        if T.is_limb_decimal(out_type):
+            if isinstance(col, DeviceDecimal128Column):
+                hi, lo = col.hi, col.lo
+            else:
+                hi, lo = I.from_i64(jnp, col.data.astype(jnp.int64))
+            hi = jnp.where(valid, hi, z64)
+            lo = jnp.where(valid, lo, z64)
+            ulo = lo.view(jnp.uint64)
+            l0 = _ilane(col, "dec0", (ulo & m32).astype(jnp.int64))
+            l1 = _ilane(col, "dec1",
+                        (ulo >> jnp.uint64(32)).astype(jnp.int64))
+            # hi accumulates with int64 wraparound == mod-2^128 on the
+            # high limb (carries from lo re-added at recombine)
+            lh = _ilane(col, "dechi", hi)
+            specs.append(("dec", (l0, l1, lh), has_lane, out_type))
+        elif jnp.issubdtype(storage_jnp_dtype(out_type), jnp.floating):
+            key = (id(col), "fval")
+            fl = lane_of.get(key)
+            if fl is None:
+                fl = len(flanes)
+                flanes.append(jnp.where(
+                    valid, col.data.astype(jnp.float64),
+                    jnp.float64(0.0)))
+                lane_of[key] = fl
+            specs.append(("float", fl, has_lane, out_type))
+        else:
+            specs.append(("int",
+                          _ilane(col, "ival",
+                                 jnp.where(valid,
+                                           col.data.astype(jnp.int64),
+                                           z64)),
+                          has_lane, out_type))
+    start = seg.start_of_row
+    itot = None
+    if ilanes:
+        imat = (jnp.stack(ilanes, axis=1) if len(ilanes) > 1
+                else ilanes[0][:, None])
+        pp = jnp.cumsum(imat, axis=0)
+        base = jnp.where((start > 0)[:, None],
+                         jnp.take(pp, jnp.maximum(start - 1, 0), axis=0),
+                         z64)
+        itot = pp - base
+    ftot = None
+    if flanes:
+        fmat = (jnp.stack(flanes, axis=1) if len(flanes) > 1
+                else flanes[0][:, None])
+
+        def combine(a, b):
+            a_id, a_v = a
+            b_id, b_v = b
+            same = b_id == a_id
+            return (b_id, jnp.where(same[:, None], a_v + b_v, b_v))
+        _ids, ftot = jax.lax.associative_scan(combine, (start, fmat))
+    out = []
+    out_active = seg.out_active
+    for spec in specs:
+        if spec[0] == "count":
+            run = itot[:, spec[1]]
+            out.append(DC(T.LongT, jnp.where(out_active, run, z64),
+                          out_active))
+            continue
+        kind, lane, has_lane, out_type = spec
+        validity = out_active
+        if has_lane is not None:
+            validity = validity & (itot[:, has_lane] > 0)
+        if kind == "dec":
+            l0, l1, lh = lane
+            s0, s1, shi = itot[:, l0], itot[:, l1], itot[:, lh]
+            rhi, rlo = I.from_i64(jnp, s0)
+            h1, l1 = I.mul_i64(jnp, s1, jnp.full_like(s1, 1 << 32))
+            rhi, rlo = I.add(jnp, rhi, rlo, h1, l1)
+            rhi = rhi + shi
+            ok = I.fits_precision(jnp, rhi, rlo, out_type.precision)
+            validity = validity & ok
+            rhi = jnp.where(validity, rhi, z64)
+            rlo = jnp.where(validity, rlo, z64)
+            out.append(DeviceDecimal128Column(out_type, rhi, rlo, validity))
+        elif kind == "float":
+            run = ftot[:, lane]
+            acc = storage_jnp_dtype(out_type)
+            out.append(DC(out_type,
+                          jnp.where(validity, run.astype(acc),
+                                    jnp.zeros((), acc)), validity))
+        else:
+            run = itot[:, lane]
+            acc = storage_jnp_dtype(out_type)
+            out.append(DC(out_type,
+                          jnp.where(validity, run.astype(acc),
+                                    jnp.zeros((), acc)), validity))
+    return out
 
 
 def seg_count(seg: Segments, col_s: AnyDeviceColumn) -> DeviceColumn:
