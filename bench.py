@@ -215,17 +215,21 @@ def ensure_tpcds_data(spark) -> None:
         f.write("ok\n")
 
 
-def run_tpcds_q3(spark):
+def run_tpcds_q3(spark, capture=False):
     for name in ("item", "date_dim", "store_sales"):
         spark.read.parquet(os.path.join(TPCDS_DIR, name)) \
             .createOrReplaceTempView(name)
     q = spark.sql(TPCDS_Q3)
     run_once(q)  # warm
-    times, rows = [], None
-    for _ in range(2):
+    times, rows, stages = [], None, None
+    for i in range(2):
+        if capture and i == 1:
+            spark.start_capture()
         dt, rows = run_once(q)
         times.append(dt)
-    return min(times), rows
+    if capture:
+        stages = stage_breakdown(spark.get_captured_plans())
+    return min(times), rows, stages
 
 
 def stage_breakdown(plans) -> dict:
@@ -265,7 +269,7 @@ def main():
     for _ in range(3):
         dt, cpu_rows = run_once(q_cpu)
         cpu_times.append(dt)
-    q3_cpu_t, q3_cpu_rows = run_tpcds_q3(cpu)
+    q3_cpu_t, q3_cpu_rows, _ = run_tpcds_q3(cpu)
     cpu.stop()
 
     tpu = TpuSparkSession({
@@ -290,7 +294,7 @@ def main():
         dt, tpu_rows = run_once(q_tpu)
         tpu_times.append(dt)
     stages = stage_breakdown(tpu.get_captured_plans())
-    q3_tpu_t, q3_tpu_rows = run_tpcds_q3(tpu)
+    q3_tpu_t, q3_tpu_rows, q3_stages = run_tpcds_q3(tpu, capture=True)
     tpu.stop()
 
     assert_rows_match(cpu_rows, tpu_rows)
@@ -316,6 +320,7 @@ def main():
                 "cpu_engine_wall_s": round(q3_cpu_t, 4),
                 "speedup_vs_cpu_engine": round(q3_cpu_t / q3_tpu_t, 4),
                 "rows": TPCDS_ROWS,
+                "stages": q3_stages,
             },
         },
     }))

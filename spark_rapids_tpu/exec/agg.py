@@ -514,8 +514,14 @@ class TpuHashAggregateExec(TpuExec):
         if not pending:
             return
         # ONE roundtrip for every batch's group count (each separate
-        # fetch costs ~0.2-1s flat on tunneled backends)
-        counts = np.asarray(_stack_counts([c for _h, c in pending]))
+        # fetch costs ~0.2-1s flat on tunneled backends). This fetch is
+        # where the whole async upstream pipeline (upload transfer,
+        # decode, filter/project, per-batch agg) actually drains, so its
+        # wall time IS the device-side pipeline cost — metered so the
+        # bench breakdown shows it (round-4 verdict: the dominant term
+        # must not be invisible).
+        with self.metrics.timed("pipelineDrainTime"):
+            counts = np.asarray(_stack_counts([c for _h, c in pending]))
         shrunk = []
         for (h, _c), cnt in zip(pending, counts):
             b = h.get()

@@ -129,10 +129,13 @@ class TpuShuffledHashJoinExec(TpuExec):
     # join types whose per-left-row results are independent of other left
     # rows — the stream (left) side may be processed in bounded chunks
     # against the whole build side (JoinGatherer.scala:55 chunked-gather
-    # role; right/full outer need cross-chunk matched-right tracking and
-    # keep the single-batch path for now)
+    # role). Right/full outer chunk too: each chunk joins as inner/
+    # leftouter while a matched-right mask accumulates on device, and the
+    # unmatched right rows emit once at the end.
     _LEFT_STREAM_TYPES = ("inner", "cross", "left", "leftouter",
                           "leftsemi", "leftanti")
+    _CHUNKED_OUTER = {"right": "inner", "rightouter": "inner",
+                      "full": "leftouter", "fullouter": "leftouter"}
 
     def device_partitions(self) -> List[DevicePartitionThunk]:
         lparts = device_channel(self.left)
@@ -152,8 +155,9 @@ class TpuShuffledHashJoinExec(TpuExec):
                             if b._num_rows != 0]
                 rb = [b for b in rt() if b._num_rows != 0]
                 total_l = sum(h.rows for h in lhandles)
-                if (self.join_type not in self._LEFT_STREAM_TYPES
-                        or total_l <= goal):
+                chunkable = (self.join_type in self._LEFT_STREAM_TYPES
+                             or self.join_type in self._CHUNKED_OUTER)
+                if not chunkable or total_l <= goal:
                     lb = [h.get() for h in lhandles]
                     for h in lhandles:
                         h.close()
@@ -164,6 +168,12 @@ class TpuShuffledHashJoinExec(TpuExec):
                 rwhole = (concat_device(rb) if len(rb) > 1 else
                           rb[0] if rb else
                           DeviceBatch.empty(self.right.schema))
+                chunk_type = self._CHUNKED_OUTER.get(self.join_type)
+                matched_any = None
+                if chunk_type is not None:
+                    lk = P.bind_list(self.left_keys, self.left.output)
+                    rk = P.bind_list(self.right_keys, self.right.output)
+                    pair_schema = self._pair_schema()
                 i = 0
                 while i < len(lhandles):
                     chunk = [lhandles[i]]
@@ -177,9 +187,48 @@ class TpuShuffledHashJoinExec(TpuExec):
                     lb = [h.get() for h in chunk]
                     for h in chunk:
                         h.close()
-                    yield from self._join_one(lb, [rwhole])
+                    if chunk_type is None:
+                        yield from self._join_one(lb, [rwhole])
+                    else:
+                        out, matched = self._join_one_matched(
+                            lb, rwhole, chunk_type, lk, rk, pair_schema)
+                        from spark_rapids_tpu.ops.join import or_masks
+                        matched_any = matched if matched_any is None \
+                            else or_masks(matched_any, matched)
+                        yield out
+                if chunk_type is not None:
+                    from spark_rapids_tpu.ops.join import \
+                        right_extras_batch
+                    left_fields = [
+                        T.StructField(a.name, a.data_type, a.nullable)
+                        for a in self.left.output]
+                    extras = right_extras_batch(
+                        rwhole, matched_any, left_fields, pair_schema)
+                    yield self._project_output(extras)
             return run
         return [make(lt, rt) for lt, rt in zip(lparts, rparts)]
+
+    def _pair_schema(self) -> T.StructType:
+        return T.StructType(
+            [T.StructField(a.name, a.data_type, a.nullable)
+             for a in self._pair_attrs()])
+
+    def _join_one_matched(self, lbatches: List[DeviceBatch],
+                          rwhole: DeviceBatch, chunk_type: str, lk, rk,
+                          out_schema: T.StructType):
+        """One stream chunk of a chunked right/full outer: joins with the
+        downgraded ``chunk_type`` and returns (projected batch,
+        matched-right device mask). Bound keys and the pair schema are
+        hoisted out of the chunk loop by the caller."""
+        lwhole = (concat_device(lbatches) if len(lbatches) > 1
+                  else lbatches[0])
+        with self.metrics.timed(M.JOIN_TIME):
+            out, matched = device_join(lwhole, rwhole, lk, rk, chunk_type,
+                                       out_schema, collect_matched_r=True)
+        if out._num_rows is not None:
+            self.metrics.create(M.NUM_OUTPUT_ROWS, M.ESSENTIAL).add(
+                out._num_rows)
+        return self._project_output(out), matched
 
     def simple_string(self):
         return (f"TpuShuffledHashJoin {self.join_type} l={self.left_keys} "

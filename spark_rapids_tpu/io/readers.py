@@ -408,12 +408,17 @@ class CpuFileScanExec(P.PhysicalPlan):
 
     def __init__(self, output, fmt: str, paths: List[str],
                  options: Dict[str, Any], conf: TpuConf):
+        from spark_rapids_tpu import metrics as M
+        from spark_rapids_tpu.conf import METRICS_LEVEL
         self.children = []
         self._output = output
         self.fmt = fmt
         self.paths = paths
         self.options = options or {}
         self.conf = conf
+        # decodeTime/convertTime surface in the bench stage breakdown —
+        # round-4 verdict: the dominant cost must never be invisible
+        self.metrics = M.MetricRegistry(str(conf.get(METRICS_LEVEL)))
         listed = list_files(paths)
         self.files = [f for f, _ in listed]
         part_names = {k for _f, pv in listed for k in pv}
@@ -481,18 +486,23 @@ class CpuFileScanExec(P.PhysicalPlan):
         data_schema = T.StructType(
             [f for f in schema.fields if f.name not in part_names])
 
+        metrics = self.metrics
+
         def decode(u: ScanUnit):
-            tbl = _read_unit(self.fmt, u, data_schema, self.options)
-            if part_fields:
-                tbl = _append_partition_columns(tbl, part_fields,
-                                                u.part_values or {})
-                tbl = tbl.select([f.name for f in schema.fields])
+            with metrics.timed("decodeTime"):
+                tbl = _read_unit(self.fmt, u, data_schema, self.options)
+                if part_fields:
+                    tbl = _append_partition_columns(tbl, part_fields,
+                                                    u.part_values or {})
+                    tbl = tbl.select([f.name for f in schema.fields])
             return tbl
 
         def emit(tbl) -> Iterator[HostBatch]:
             for lo in range(0, max(1, tbl.num_rows), max_rows):
-                yield arrow_to_host_batch(
-                    tbl.slice(lo, max_rows), schema)
+                with metrics.timed("convertTime"):
+                    hb = arrow_to_host_batch(tbl.slice(lo, max_rows),
+                                             schema)
+                yield hb
 
         def decode_host(u: ScanUnit) -> List[HostBatch]:
             # arrow->HostBatch conversion (string object arrays, casts)

@@ -49,7 +49,7 @@ _COUNT_CACHE: Dict[Tuple, Callable] = {}
 _GATHER_CACHE: Dict[Tuple, Callable] = {}
 _MASK_CACHE: Dict[Tuple, Callable] = {}
 
-_stack2 = jax.jit(lambda a, b: jnp.stack([a, b]))
+_stack3 = jax.jit(lambda a, b, c: jnp.stack([a, b, c]))
 
 # join types that expand to (left, right) pairs
 PAIR_JOINS = ("inner", "cross", "left", "leftouter", "right", "rightouter",
@@ -91,7 +91,10 @@ def _key_plan(lkeys: Sequence[E.Expression], rkeys: Sequence[E.Expression],
     """Shared by both phases: evaluate keys, segment the combined key
     set, and derive per-row match counts/offsets with prefix sums over
     the sorted layout — NO scatter-based segment ops (XLA scatters
-    serialize on TPU)."""
+    serialize on TPU), and op-count-lean: the key sort skips validity
+    words (invalid-key rows are masked out of the sort's active set
+    entirely), the two prefix sums ride one 2-lane cumsum, and all
+    back-to-original-row gathers ride one fused lane gather."""
     kl = [X.dev_eval(e, ctx_l) for e in lkeys]
     kr = [X.dev_eval(e, ctx_r) for e in rkeys]
     valid_l = active_l
@@ -105,34 +108,48 @@ def _key_plan(lkeys: Sequence[E.Expression], rkeys: Sequence[E.Expression],
     cap_c = cap_l + cap_r
     combined = _concat_key_columns(kl, kr)
     valid_c = jnp.concatenate([valid_l, valid_r])
-    seg = G.build_segments(combined, valid_c)
-    inv = jnp.argsort(seg.order)  # original combined row -> sorted pos
-    is_left_s = seg.order < cap_l
-    left_valid_s = is_left_s & seg.active_sorted
-    right_valid_s = (~is_left_s) & seg.active_sorted
-    prefL = jnp.cumsum(left_valid_s.astype(jnp.int64))
-    prefR = jnp.cumsum(right_valid_s.astype(jnp.int64))
-    start, end = seg.start_of_row, seg.end_of_row
-
-    def seg_range(pref):
-        before = jnp.where(start > 0,
-                           jnp.take(pref, jnp.maximum(start - 1, 0)),
-                           jnp.int64(0))
-        total = jnp.take(pref, jnp.clip(end, 0, cap_c - 1)) - before
-        return before, total
-
-    base_r_s, cnt_r_s = seg_range(prefR)
-    _base_l_s, cnt_l_s = seg_range(prefL)
-    sp_l, sp_r = inv[:cap_l], inv[cap_l:]
-    m = jnp.where(valid_l, jnp.take(cnt_r_s, sp_l), jnp.int64(0))
-    base = jnp.where(valid_l, jnp.take(base_r_s, sp_l), jnp.int64(0))
-    cnt_l_at_r = jnp.where(valid_r, jnp.take(cnt_l_s, sp_r), jnp.int64(0))
+    words: List[jax.Array] = []
+    for c in combined:
+        words.extend(G.value_words(c))
+    from spark_rapids_tpu.columnar.device import sort_with_payload
+    sorted_all, order, _p = sort_with_payload([~valid_c] + words, [])
+    active_s = ~sorted_all[0]
+    boundary, is_end = G._boundaries_from_words(sorted_all[1:], active_s,
+                                                cap_c)
+    pos_c = jnp.arange(cap_c, dtype=jnp.int32)
+    start = jax.lax.cummax(jnp.where(boundary, pos_c, -1))
+    end = jnp.flip(jax.lax.cummin(
+        jnp.flip(jnp.where(is_end, pos_c, cap_c))))
+    is_left_s = order < cap_l
+    left_valid_s = is_left_s & active_s
+    right_valid_s = (~is_left_s) & active_s
+    # both prefix sums in ONE 2-lane cumsum
+    pref = jnp.cumsum(jnp.stack(
+        [left_valid_s.astype(jnp.int64), right_valid_s.astype(jnp.int64)],
+        axis=1), axis=0)
+    before = jnp.where((start > 0)[:, None],
+                       jnp.take(pref, jnp.maximum(start - 1, 0), axis=0),
+                       jnp.int64(0))
+    at_end = jnp.take(pref, jnp.clip(end, 0, cap_c - 1), axis=0)
+    cnt_l_s = at_end[:, 0] - before[:, 0]
+    cnt_r_s = at_end[:, 1] - before[:, 1]
+    base_r_s = before[:, 1]
+    # original combined row -> sorted pos (one stable sort pass), then
+    # ONE fused gather brings every per-sorted-row stat back to
+    # original row order
+    _o, inv = jax.lax.sort((order, pos_c), num_keys=1, is_stable=True)
+    from spark_rapids_tpu.ops.lanes import fused_take
+    g = fused_take([cnt_r_s, base_r_s, cnt_l_s], inv)
+    m = jnp.where(valid_l, g[0][:cap_l], jnp.int64(0))
+    base = jnp.where(valid_l, g[1][:cap_l], jnp.int64(0))
+    cnt_l_at_r = jnp.where(valid_r, g[2][cap_l:], jnp.int64(0))
     # order_r[j] = original right index of the j-th valid right row in
     # key-sorted order (base/cnt index into this)
-    pos_c = jnp.arange(cap_c, dtype=jnp.int32)
     rkey_sorted = jnp.where(right_valid_s, pos_c, jnp.int32(cap_c))
-    ord2 = jnp.argsort(rkey_sorted, stable=True)[:cap_r]
-    order_r = jnp.clip(jnp.take(seg.order, ord2) - cap_l, 0, cap_r - 1)
+    _k2, ord2 = jax.lax.sort((rkey_sorted, pos_c), num_keys=1,
+                             is_stable=True)
+    order_r = jnp.clip(jnp.take(order, ord2[:cap_r]) - cap_l, 0,
+                       cap_r - 1)
     return kl, kr, valid_l, valid_r, m, base, order_r, cnt_l_at_r
 
 
@@ -156,8 +173,12 @@ def _build_count_fn(lkeys: Tuple[E.Expression, ...],
         m_eff = m_eff.astype(jnp.int64)
         offsets = jnp.cumsum(m_eff) - m_eff  # exclusive
         total_pairs = jnp.sum(m_eff)
+        max_m = jnp.max(m)
+        # matched-right mask: consumed by the right/full-outer extras
+        # here, and accumulated across stream chunks by the exec's
+        # chunked outer path (JoinGatherer.scala:55 role)
+        matched_r = valid_r & (cnt_l_at_r > 0)
         if right_outer:
-            matched_r = valid_r & (cnt_l_at_r > 0)
             extra_r = active_r & ~matched_r
             n_extra = jnp.sum(extra_r.astype(jnp.int64))
             pos = jnp.arange(cap_r, dtype=jnp.int32)
@@ -166,8 +187,28 @@ def _build_count_fn(lkeys: Tuple[E.Expression, ...],
         else:
             n_extra = jnp.int64(0)
             extra_order = jnp.zeros(cap_r, dtype=jnp.int32)
-        return (total_pairs, n_extra, m, offsets, base, order_r,
-                extra_order)
+        return (total_pairs, n_extra, max_m, m, offsets, base, order_r,
+                extra_order, matched_r)
+    return jax.jit(fn)
+
+
+def _build_fast_gather_fn(join_type: str) -> Callable:
+    """max_m <= 1 path (FK/star-schema joins: every stream row matches at
+    most one build row). The output keeps the LEFT batch's capacity and
+    row order: left columns pass through untouched, the matched right row
+    arrives by ONE fused gather, and inner joins just shrink the active
+    mask. No searchsorted expansion, no output-capacity bucket, no
+    per-total recompile."""
+    inner = join_type in ("inner", "cross")
+
+    def fn(cols_l, cols_r, active_l, m, base, order_r):
+        cap_r = order_r.shape[0]
+        has = m > 0
+        ri = jnp.take(order_r,
+                      jnp.clip(base, 0, cap_r - 1).astype(jnp.int32))
+        out_r = take_columns(cols_r, jnp.where(has, ri, 0), valid_at=has)
+        active = (active_l & has) if inner else active_l
+        return out_r, active
     return jax.jit(fn)
 
 
@@ -220,6 +261,11 @@ def _build_gather_fn(out_cap: int, join_type: str) -> Callable:
                     jnp.where(is_extra[:, None], b.chars, a.chars),
                     jnp.where(is_extra, b.lengths, a.lengths),
                     jnp.where(is_extra, b.validity, a.validity)))
+            elif isinstance(a, DeviceDecimal128Column):
+                merged.append(DeviceDecimal128Column(
+                    a.dtype, jnp.where(is_extra, b.hi, a.hi),
+                    jnp.where(is_extra, b.lo, a.lo),
+                    jnp.where(is_extra, b.validity, a.validity)))
             else:
                 merged.append(DeviceColumn(
                     a.dtype, jnp.where(is_extra, b.data, a.data),
@@ -248,13 +294,88 @@ def _build_mask_fn(lkeys: Tuple[E.Expression, ...],
     return jax.jit(fn)
 
 
+_EXTRAS_CACHE: Dict[Tuple, Callable] = {}
+_OR = jax.jit(lambda a, b: a | b)
+
+
+def or_masks(a, b):
+    """Accumulate matched-right masks across stream chunks (jitted —
+    eager ops pay a per-op dispatch handshake on tunneled backends)."""
+    return _OR(a, b)
+
+
+def right_extras_batch(right: DeviceBatch, matched_any: jax.Array,
+                       left_fields, out_schema: T.StructType
+                       ) -> DeviceBatch:
+    """Pair-layout batch of the UNMATCHED right rows (null left side) —
+    the final emission of a chunked right/full outer join, after every
+    stream chunk ORed its matched mask into ``matched_any``."""
+    from spark_rapids_tpu.columnar.device import (flatten_batch,
+                                                  rebuild_columns)
+    flat, spec = flatten_batch(right)
+    cap_r = right.capacity
+    shapes = tuple((a.shape, str(a.dtype)) for a in flat)
+    ldts = tuple(repr(f.data_type) for f in left_fields)
+    key = (shapes, ldts)
+    fn = _EXTRAS_CACHE.get(key)
+    if fn is None:
+        ltypes = [f.data_type for f in left_fields]
+
+        def build(matched, active_r, *rflat):
+            keep = active_r & ~matched
+            outs = []
+            for a in rflat:
+                if a.dtype == jnp.bool_ and a.ndim == 1:
+                    outs.append(a & keep)
+                elif a.ndim == 2:
+                    outs.append(jnp.where(keep[:, None], a, 0))
+                else:
+                    outs.append(jnp.where(keep, a,
+                                          jnp.zeros((), a.dtype)))
+            lefts = []
+            fv = jnp.zeros(cap_r, dtype=jnp.bool_)
+            for dt in ltypes:
+                if isinstance(dt, T.ArrayType):
+                    raise X.DeviceUnsupported(
+                        "array columns in outer join output")
+                if T.is_limb_decimal(dt):
+                    z = jnp.zeros(cap_r, dtype=jnp.int64)
+                    lefts += [z, z, fv]
+                elif isinstance(dt, (T.StringType, T.BinaryType)):
+                    lefts += [jnp.zeros((cap_r, 8), dtype=jnp.uint8),
+                              jnp.zeros(cap_r, dtype=jnp.int32), fv]
+                else:
+                    from spark_rapids_tpu.columnar.device import \
+                        storage_jnp_dtype
+                    lefts += [jnp.zeros(cap_r,
+                                        dtype=storage_jnp_dtype(dt)), fv]
+            return tuple(lefts), tuple(outs), keep
+        fn = jax.jit(build)
+        _EXTRAS_CACHE[key] = fn
+    lefts, routs, keep = fn(matched_any, right.active, *flat)
+    from spark_rapids_tpu.columnar.device import column_arity, make_column
+    lcols = []
+    off = 0
+    for f in left_fields:
+        k = column_arity(f.data_type)
+        lcols.append(make_column(f.data_type, lefts[off:off + k]))
+        off += k
+    rcols = rebuild_columns(spec, routs)
+    return DeviceBatch(out_schema, lcols + rcols, keep, None)
+
+
 def device_join(left: DeviceBatch, right: DeviceBatch,
                 lkeys: List[E.Expression], rkeys: List[E.Expression],
                 join_type: str,
-                out_schema: T.StructType) -> DeviceBatch:
+                out_schema: T.StructType,
+                collect_matched_r: bool = False):
     """Run the equi-join of two device batches; keys are pre-bound device
     expressions. Returns the joined batch (pair layout: left columns then
-    right columns) or, for semi/anti, the masked left batch."""
+    right columns) or, for semi/anti, the masked left batch. With
+    ``collect_matched_r`` returns ``(batch, matched_r)`` where
+    ``matched_r`` is the device bool mask of right rows that matched any
+    left row — the exec's chunked right/full-outer path ORs these across
+    stream chunks (JoinGatherer.scala:55 role)."""
     lk = tuple(lkeys)
     rk = tuple(rkeys)
     salt = G.kernel_salt()  # snapshot: key AND trace use this value
@@ -272,7 +393,8 @@ def device_join(left: DeviceBatch, right: DeviceBatch,
         with G.nan_scope(salt[0]):
             new_active = fn(left.columns, left.active, lits_l,
                             right.columns, right.active, lits_r)
-        return DeviceBatch(left.schema, left.columns, new_active, None)
+        out = DeviceBatch(left.schema, left.columns, new_active, None)
+        return (out, None) if collect_matched_r else out
 
     if join_type not in PAIR_JOINS:
         raise X.DeviceUnsupported(f"join type {join_type}")
@@ -283,19 +405,34 @@ def device_join(left: DeviceBatch, right: DeviceBatch,
         count_fn = _build_count_fn(lk, rk, join_type)
         _COUNT_CACHE[ckey] = count_fn
     with G.nan_scope(salt[0]):
-        (total_pairs, n_extra, m, offsets, base, order_r,
-         extra_order) = count_fn(left.columns, left.active, lits_l,
-                                 right.columns, right.active, lits_r)
-    # ONE host sync for sizing: both scalars ride one stacked fetch
+        (total_pairs, n_extra, max_m, m, offsets, base, order_r,
+         extra_order, matched_r) = count_fn(
+             left.columns, left.active, lits_l,
+             right.columns, right.active, lits_r)
+    # ONE host sync for sizing: all scalars ride one stacked fetch
     # (each roundtrip costs ~0.2-0.6s flat on tunneled backends)
-    both = np.asarray(_stack2(total_pairs, n_extra))
-    total = int(both[0]) + int(both[1])
+    sc = np.asarray(_stack3(total_pairs, n_extra, max_m))
+    total = int(sc[0]) + int(sc[1])
     out_cap = bucket_capacity(max(1, total))
 
     shapes = (tuple((a.shape, str(a.dtype))
                     for c in left.columns for a in c.arrays()),
               tuple((a.shape, str(a.dtype))
                     for c in right.columns for a in c.arrays()))
+    if int(sc[2]) <= 1 and join_type in ("inner", "left", "leftouter"):
+        # FK fast path: at most one match per stream row -> output stays
+        # in the left batch's own layout; no expansion program at all
+        fkey = (shapes, join_type, "fast")
+        fast_fn = _GATHER_CACHE.get(fkey)
+        if fast_fn is None:
+            fast_fn = _build_fast_gather_fn(join_type)
+            _GATHER_CACHE[fkey] = fast_fn
+        out_r, active = fast_fn(left.columns, right.columns, left.active,
+                                m, base, order_r)
+        out = DeviceBatch(out_schema, list(left.columns) + list(out_r),
+                          active, total)
+        return (out, matched_r) if collect_matched_r else out
+
     gkey = (shapes, out_cap, join_type, m.shape, order_r.shape)
     gather_fn = _GATHER_CACHE.get(gkey)
     if gather_fn is None:
@@ -309,4 +446,5 @@ def device_join(left: DeviceBatch, right: DeviceBatch,
         out_l, out_r, active, _lv, _rv = gather_fn(
             left.columns, right.columns, total_pairs, n_extra, m, offsets,
             base, order_r)
-    return DeviceBatch(out_schema, list(out_l) + list(out_r), active, total)
+    out = DeviceBatch(out_schema, list(out_l) + list(out_r), active, total)
+    return (out, matched_r) if collect_matched_r else out
