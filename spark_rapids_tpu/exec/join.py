@@ -61,7 +61,8 @@ class TpuShuffledHashJoinExec(TpuExec):
                  right_keys: List[E.Expression], join_type: str,
                  condition: Optional[E.Expression], left: TpuExec,
                  right: TpuExec, output: List[E.AttributeReference],
-                 conf: TpuConf):
+                 conf: TpuConf,
+                 null_safe: Optional[List[bool]] = None):
         super().__init__(conf)
         self.children = [left, right]
         self.left_keys = left_keys
@@ -69,6 +70,7 @@ class TpuShuffledHashJoinExec(TpuExec):
         self.join_type = join_type
         self.condition = condition
         self._output = output
+        self.null_safe = list(null_safe or [False] * len(left_keys))
 
     @property
     def left(self) -> TpuExec:
@@ -98,12 +100,10 @@ class TpuShuffledHashJoinExec(TpuExec):
         if self.join_type in MASK_JOINS:
             out_schema = lschema
         else:
-            out_schema = T.StructType(
-                [T.StructField(a.name, a.data_type, a.nullable)
-                 for a in self._pair_attrs()])
+            out_schema = self._pair_schema()
         with self.metrics.timed(M.JOIN_TIME):
             out = device_join(lwhole, rwhole, lk, rk, self.join_type,
-                              out_schema)
+                              out_schema, null_safe=self.null_safe)
             if self.condition is not None:
                 cond = E.bind_references(self.condition, self._pair_attrs())
                 out = X.run_filter(cond, out)
@@ -224,7 +224,8 @@ class TpuShuffledHashJoinExec(TpuExec):
                   else lbatches[0])
         with self.metrics.timed(M.JOIN_TIME):
             out, matched = device_join(lwhole, rwhole, lk, rk, chunk_type,
-                                       out_schema, collect_matched_r=True)
+                                       out_schema, collect_matched_r=True,
+                                       null_safe=self.null_safe)
         if out._num_rows is not None:
             self.metrics.create(M.NUM_OUTPUT_ROWS, M.ESSENTIAL).add(
                 out._num_rows)

@@ -43,7 +43,12 @@ class CachedRelation(L.LogicalPlan):
     def materialize(self) -> List[List[bytes]]:
         with self._lock:
             if self._payloads is None:
+                # nested planning must not clobber the OUTER query's
+                # rewrite report / plan capture (materialize runs lazily
+                # inside the outer collect)
+                saved = self.session.last_rewrite_report
                 physical = self.session.plan_physical(self.child_plan)
+                self.session.last_rewrite_report = saved
                 payloads: List[List[bytes]] = []
                 for thunk in physical.partitions():
                     part: List[bytes] = []

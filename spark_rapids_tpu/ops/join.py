@@ -87,7 +87,8 @@ def _concat_key_columns(kl: Sequence[AnyDeviceColumn],
 
 
 def _key_plan(lkeys: Sequence[E.Expression], rkeys: Sequence[E.Expression],
-              ctx_l: X.Ctx, ctx_r: X.Ctx, active_l, active_r):
+              ctx_l: X.Ctx, ctx_r: X.Ctx, active_l, active_r,
+              null_safe: Sequence[bool] = ()):
     """Shared by both phases: evaluate keys, segment the combined key
     set, and derive per-row match counts/offsets with prefix sums over
     the sorted layout — NO scatter-based segment ops (XLA scatters
@@ -95,21 +96,26 @@ def _key_plan(lkeys: Sequence[E.Expression], rkeys: Sequence[E.Expression],
     words (invalid-key rows are masked out of the sort's active set
     entirely), the two prefix sums ride one 2-lane cumsum, and all
     back-to-original-row gathers ride one fused lane gather."""
+    ns = list(null_safe) or [False] * len(lkeys)
     kl = [X.dev_eval(e, ctx_l) for e in lkeys]
     kr = [X.dev_eval(e, ctx_r) for e in rkeys]
     valid_l = active_l
-    for c in kl:
-        valid_l = valid_l & c.validity
+    for c, nsf in zip(kl, ns):
+        if not nsf:  # <=> keys keep null rows in the match set
+            valid_l = valid_l & c.validity
     valid_r = active_r
-    for c in kr:
-        valid_r = valid_r & c.validity
+    for c, nsf in zip(kr, ns):
+        if not nsf:
+            valid_r = valid_r & c.validity
     cap_l = active_l.shape[0]
     cap_r = active_r.shape[0]
     cap_c = cap_l + cap_r
     combined = _concat_key_columns(kl, kr)
     valid_c = jnp.concatenate([valid_l, valid_r])
     words: List[jax.Array] = []
-    for c in combined:
+    for c, nsf in zip(combined, ns):
+        if nsf:  # null forms its own key group, matching other nulls
+            words.append(c.validity)
         words.extend(G.value_words(c))
     from spark_rapids_tpu.columnar.device import sort_with_payload
     sorted_all, order, _p = sort_with_payload([~valid_c] + words, [])
@@ -155,7 +161,8 @@ def _key_plan(lkeys: Sequence[E.Expression], rkeys: Sequence[E.Expression],
 
 def _build_count_fn(lkeys: Tuple[E.Expression, ...],
                     rkeys: Tuple[E.Expression, ...],
-                    join_type: str) -> Callable:
+                    join_type: str,
+                    null_safe: Tuple[bool, ...] = ()) -> Callable:
     left_outer = join_type in ("left", "leftouter", "full", "fullouter")
     right_outer = join_type in ("right", "rightouter", "full", "fullouter")
 
@@ -165,7 +172,8 @@ def _build_count_fn(lkeys: Tuple[E.Expression, ...],
         ctx_l = X.Ctx(cols_l, cap_l, lkeys, lits_l)
         ctx_r = X.Ctx(cols_r, cap_r, rkeys, lits_r)
         (_kl, _kr, _valid_l, valid_r, m, base, order_r, cnt_l_at_r
-         ) = _key_plan(lkeys, rkeys, ctx_l, ctx_r, active_l, active_r)
+         ) = _key_plan(lkeys, rkeys, ctx_l, ctx_r, active_l,
+                      active_r, null_safe)
         if left_outer:
             m_eff = jnp.where(active_l, jnp.maximum(m, 1), 0)
         else:
@@ -278,7 +286,8 @@ def _build_gather_fn(out_cap: int, join_type: str) -> Callable:
 
 def _build_mask_fn(lkeys: Tuple[E.Expression, ...],
                    rkeys: Tuple[E.Expression, ...],
-                   join_type: str) -> Callable:
+                   join_type: str,
+                   null_safe: Tuple[bool, ...] = ()) -> Callable:
     is_semi = join_type == "leftsemi"
 
     def fn(cols_l, active_l, lits_l, cols_r, active_r, lits_r):
@@ -287,7 +296,8 @@ def _build_mask_fn(lkeys: Tuple[E.Expression, ...],
         ctx_l = X.Ctx(cols_l, cap_l, lkeys, lits_l)
         ctx_r = X.Ctx(cols_r, cap_r, rkeys, lits_r)
         (_kl, _kr, _valid_l, _valid_r, m, _base, _order_r, _cnt_l_at_r
-         ) = _key_plan(lkeys, rkeys, ctx_l, ctx_r, active_l, active_r)
+         ) = _key_plan(lkeys, rkeys, ctx_l, ctx_r, active_l,
+                      active_r, null_safe)
         if is_semi:
             return active_l & (m > 0)
         return active_l & (m == 0)
@@ -368,7 +378,8 @@ def device_join(left: DeviceBatch, right: DeviceBatch,
                 lkeys: List[E.Expression], rkeys: List[E.Expression],
                 join_type: str,
                 out_schema: T.StructType,
-                collect_matched_r: bool = False):
+                collect_matched_r: bool = False,
+                null_safe: Sequence[bool] = ()):
     """Run the equi-join of two device batches; keys are pre-bound device
     expressions. Returns the joined batch (pair layout: left columns then
     right columns) or, for semi/anti, the masked left batch. With
@@ -378,9 +389,10 @@ def device_join(left: DeviceBatch, right: DeviceBatch,
     stream chunks (JoinGatherer.scala:55 role)."""
     lk = tuple(lkeys)
     rk = tuple(rkeys)
+    nst = tuple(null_safe) or (False,) * len(lk)
     salt = G.kernel_salt()  # snapshot: key AND trace use this value
     struct = (tuple(X.expr_key(e) for e in lk),
-              tuple(X.expr_key(e) for e in rk), salt)
+              tuple(X.expr_key(e) for e in rk), nst, salt)
     lits_l = X.literal_values(list(lk))
     lits_r = X.literal_values(list(rk))
 
@@ -388,7 +400,7 @@ def device_join(left: DeviceBatch, right: DeviceBatch,
         key = (struct, join_type)
         fn = _MASK_CACHE.get(key)
         if fn is None:
-            fn = _build_mask_fn(lk, rk, join_type)
+            fn = _build_mask_fn(lk, rk, join_type, nst)
             _MASK_CACHE[key] = fn
         with G.nan_scope(salt[0]):
             new_active = fn(left.columns, left.active, lits_l,
@@ -402,7 +414,7 @@ def device_join(left: DeviceBatch, right: DeviceBatch,
     ckey = (struct, join_type)
     count_fn = _COUNT_CACHE.get(ckey)
     if count_fn is None:
-        count_fn = _build_count_fn(lk, rk, join_type)
+        count_fn = _build_count_fn(lk, rk, join_type, nst)
         _COUNT_CACHE[ckey] = count_fn
     with G.nan_scope(salt[0]):
         (total_pairs, n_extra, max_m, m, offsets, base, order_r,

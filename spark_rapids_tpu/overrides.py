@@ -494,7 +494,7 @@ def _conv_shuffled_join(meta, kids):
     w = meta.wrapped
     return TpuShuffledHashJoinExec(w.left_keys, w.right_keys, w.join_type,
                                    w.condition, kids[0], kids[1], w.output,
-                                   meta.conf)
+                                   meta.conf, null_safe=w.null_safe)
 
 
 def _conv_broadcast_join(meta, kids):
@@ -502,7 +502,7 @@ def _conv_broadcast_join(meta, kids):
     w = meta.wrapped
     return TpuBroadcastHashJoinExec(w.left_keys, w.right_keys, w.join_type,
                                     w.condition, kids[0], kids[1], w.output,
-                                    meta.conf)
+                                    meta.conf, null_safe=w.null_safe)
 
 
 def _tag_generate(meta: ExecMeta) -> None:

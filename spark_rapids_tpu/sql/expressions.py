@@ -3064,6 +3064,8 @@ PRIM_LAST_ANY = "last_any"    # sound at merge: partial rows exist only for
                               # non-empty groups, so a null buffer slot means
                               # "first value was null", never "no rows"
 PRIM_SUM_NONNULL = "sum_nonnull"  # null-skipping sum that yields 0, not null
+PRIM_COLLECT = "collect"          # gather valid values per group into a tuple
+PRIM_COLLECT_MERGE = "collect_merge"  # concatenate gathered tuples
 
 
 class AggregateFunction(Expression):
@@ -3248,6 +3250,62 @@ class Last(AggregateFunction):
 
     def evaluate(self, buffers):
         return buffers[0]
+
+
+class CollectList(AggregateFunction):
+    """collect_list: per-group array of the non-null values, in row
+    order (GpuCollectList, AggregateFunctions.scala:953). Empty groups
+    yield an empty array, never null (Spark TypedImperativeAggregate
+    createAggregationBuffer semantics)."""
+
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.ArrayType(self.children[0].data_type)
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def buffer_slots(self):
+        return [("collect", self.data_type, PRIM_COLLECT,
+                 self.children[0], PRIM_COLLECT_MERGE)]
+
+    def evaluate(self, buffers):
+        b = buffers[0]
+        data = np.empty(len(b.data), dtype=object)
+        for i in range(len(b.data)):
+            data[i] = tuple(b.data[i]) if b.validity[i] else ()
+        return HostColumn.all_valid(data, self.data_type)
+
+
+class CollectSet(CollectList):
+    """collect_set: collect_list deduplicated at evaluation, first
+    occurrence kept (GpuCollectSet role); NaNs deduplicate as one
+    value and 0.0/-0.0 stay distinct (JVM Double.equals semantics of
+    Spark's OpenHashSet buffer)."""
+
+    def evaluate(self, buffers):
+        b = buffers[0]
+        data = np.empty(len(b.data), dtype=object)
+        for i in range(len(b.data)):
+            if not b.validity[i]:
+                data[i] = ()
+                continue
+            seen = set()
+            out = []
+            for v in b.data[i]:
+                k = ("<nan>",) if isinstance(v, float) and v != v else \
+                    (v, math.copysign(1.0, v)) if isinstance(v, float) \
+                    else v
+                if k in seen:
+                    continue
+                seen.add(k)
+                out.append(v)
+            data[i] = tuple(out)
+        return HostColumn.all_valid(data, self.data_type)
 
 
 class CentralMomentAgg(AggregateFunction):

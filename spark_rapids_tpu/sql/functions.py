@@ -342,6 +342,14 @@ def avg(c) -> Column:
 mean = avg
 
 
+def collect_list(c) -> Column:
+    return _agg(E.CollectList(_to_col_expr(c)))
+
+
+def collect_set(c) -> Column:
+    return _agg(E.CollectSet(_to_col_expr(c)))
+
+
 def stddev_samp(c) -> Column:
     return _agg(E.StddevSamp(_to_col_expr(c)))
 

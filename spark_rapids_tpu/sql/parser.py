@@ -656,6 +656,7 @@ def _sql_name(e: E.Expression) -> str:
 _FUNCTIONS = {
     "sum": F.sum, "avg": F.avg, "min": F.min, "max": F.max,
     "first": F.first, "last": F.last,
+    "collect_list": F.collect_list, "collect_set": F.collect_set,
     "stddev": F.stddev_samp, "stddev_samp": F.stddev_samp,
     "std": F.stddev_samp, "stddev_pop": F.stddev_pop,
     "variance": F.var_samp, "var_samp": F.var_samp,

@@ -712,6 +712,31 @@ def apply_update_prim(prim: str, col: HostColumn, gids: np.ndarray,
             if valid[i]:
                 data[g] = col.data[i]
         return HostColumn(out_type, data, validity).normalized()
+    if prim in (E.PRIM_COLLECT, E.PRIM_COLLECT_MERGE):
+        # gather valid values (or concatenate gathered tuples) per group;
+        # buffer rows are ALWAYS valid — an empty group holds ()
+        limb_ints = None
+        if prim == E.PRIM_COLLECT and T.is_limb_decimal(col.dtype):
+            from spark_rapids_tpu.ops import int128 as I
+            # array-element storage form is the unscaled python int
+            limb_ints = I.to_pyints(col.data[:, 0], col.data[:, 1])
+        lists: List[list] = [[] for _ in range(ngroups)]
+        for i in range(len(col.data)):
+            if not valid[i]:
+                continue
+            g = gids[i]
+            if prim == E.PRIM_COLLECT:
+                v = int(limb_ints[i]) if limb_ints is not None \
+                    else col.data[i]
+                if isinstance(v, np.generic):
+                    v = v.item()
+                lists[g].append(v)
+            else:
+                lists[g].extend(col.data[i])
+        data = np.empty(ngroups, dtype=object)
+        for g in range(ngroups):
+            data[g] = tuple(lists[g])
+        return HostColumn.all_valid(data, out_type)
     if prim in (E.PRIM_MIN, E.PRIM_MAX, E.PRIM_FIRST, E.PRIM_LAST):
         if np_dt == np.dtype(object):
             data = np.full(ngroups, "", dtype=object)
@@ -907,13 +932,17 @@ class CpuShuffledHashJoinExec(PhysicalPlan):
                  right_keys: List[E.Expression], join_type: str,
                  condition: Optional[E.Expression],
                  left: PhysicalPlan, right: PhysicalPlan,
-                 output: List[E.AttributeReference]):
+                 output: List[E.AttributeReference],
+                 null_safe: Optional[List[bool]] = None):
         self.children = [left, right]
         self.left_keys = left_keys
         self.right_keys = right_keys
         self.join_type = join_type
         self.condition = condition
         self._output = output
+        # per-key <=> flags: a null-safe key matches null to null
+        # instead of excluding the row (Spark EqualNullSafe join keys)
+        self.null_safe = list(null_safe or [False] * len(left_keys))
 
     @property
     def left(self):
@@ -933,15 +962,21 @@ class CpuShuffledHashJoinExec(PhysicalPlan):
         assert len(lp) == len(rp), "join children must be co-partitioned"
         return [self._make(lt, rt) for lt, rt in zip(lp, rp)]
 
+    _NULL_KEY = "\x00<null-safe-null>\x00"  # sentinel for <=> null keys
+
     def _key_tuples(self, batch: HostBatch, keys: List[E.Expression],
                     inputs) -> List[Optional[Tuple]]:
         cols = [E.bind_references(k, inputs).eval(batch) for k in keys]
+        ns = self.null_safe
         out: List[Optional[Tuple]] = []
         for i in range(batch.num_rows):
             parts = []
             null = False
-            for c in cols:
+            for ki, c in enumerate(cols):
                 if not c.validity[i]:
+                    if ns[ki]:  # <=>: null groups with null
+                        parts.append(self._NULL_KEY)
+                        continue
                     null = True
                     break
                 v = c.data[i]

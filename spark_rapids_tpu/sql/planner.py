@@ -51,8 +51,9 @@ def estimate_plan_bytes(p: L.LogicalPlan) -> Optional[int]:
 
 
 class Planner:
-    def __init__(self, conf: TpuConf):
+    def __init__(self, conf: TpuConf, session=None):
         self.conf = conf
+        self.session = session
         self.shuffle_partitions = conf.shuffle_partitions
 
     def plan(self, plan: L.LogicalPlan) -> P.PhysicalPlan:
@@ -197,9 +198,7 @@ class Planner:
         if not distinct:
             return None
         if len(distinct) != len(aliases):
-            raise NotImplementedError(
-                "mixing DISTINCT and plain aggregates needs the Expand "
-                "rewrite; split the query instead")
+            return self._rewrite_mixed_distinct(p, aliases, distinct)
         child_sets = {tuple(sorted(repr(c) for c in a.child.func.children))
                       for a in distinct}
         if len(child_sets) > 1:
@@ -248,11 +247,75 @@ class Planner:
                 outer_aggs.append(e)
         return L.Aggregate(outer_grouping, outer_aggs, inner)
 
+    def _rewrite_mixed_distinct(self, p: L.Aggregate, aliases,
+                                distinct) -> L.Aggregate:
+        """Mixed DISTINCT + plain aggregates (``count(DISTINCT a),
+        sum(b)``): split into a distinct-only aggregate and a plain
+        aggregate over the same child, then join them on null-safe key
+        equality — both sides have exactly one row per group (incl. the
+        null-key groups, hence ``<=>``), so the join is 1:1. This is the
+        role Spark's RewriteDistinctAggregates Expand plays
+        (aggregate.scala:1059); the two-aggregate join form reuses the
+        engine's existing exact aggregate + join machinery end-to-end on
+        device. Before round 5 this shape raised NotImplementedError.
+
+        The shared child is wrapped in a CachedRelation so the two
+        aggregates read it ONCE (Spark's Expand shape also reads once;
+        without the cache the whole upstream pipeline, scans included,
+        would execute twice)."""
+        distinct_ids = {id(a) for a in distinct}
+        plain = [a for a in aliases if id(a) not in distinct_ids]
+        grouping_attr = {id(g): (g if isinstance(g, E.AttributeReference)
+                                 else g.to_attribute())
+                         for g in p.grouping}
+        g_attrs = [grouping_attr[id(g)] for g in p.grouping]
+        g_ids = {a.expr_id for a in g_attrs}
+        child = p.child
+        if self.session is not None:
+            from spark_rapids_tpu.io.cache import CachedRelation
+            child = CachedRelation(child, self.session)
+        # left: grouping + distinct aggs (recursion hits the pure-distinct
+        # rewrite); right: grouping re-aliased to fresh ids + plain aggs
+        left = L.Aggregate(
+            list(p.grouping),
+            list(g_attrs) + [a for a in p.aggregates
+                             if id(a) in distinct_ids],
+            child)
+        rk_aliases = [E.Alias(a, f"_mdk{i}")
+                      for i, a in enumerate(g_attrs)]
+        right = L.Aggregate(list(p.grouping),
+                            rk_aliases + plain, child)
+        cond = None
+        for la, ra in zip(g_attrs, rk_aliases):
+            eq = E.EqualNullSafe(la, ra.to_attribute())
+            cond = eq if cond is None else E.And(cond, eq)
+        if cond is None:
+            # global aggregates: two single-row sides, cross join
+            joined = L.Join(left, right, "cross", None)
+        else:
+            joined = L.Join(left, right, "inner", cond)
+        # final projection restores the requested output order
+        plain_attr = {id(a): a.to_attribute() for a in plain}
+        out: List[E.Expression] = []
+        for e in p.aggregates:
+            if isinstance(e, E.Alias) and isinstance(
+                    e.child, E.AggregateExpression) \
+                    and id(e) in {id(x) for x in plain}:
+                out.append(plain_attr[id(e)])
+            elif isinstance(e, E.Alias) and e.expr_id in g_ids:
+                out.append(e.to_attribute())
+            elif isinstance(e, E.Alias) and isinstance(
+                    e.child, E.AggregateExpression):
+                out.append(e.to_attribute())
+            else:
+                out.append(e)
+        return L.Project(out, joined)
+
     # -- join --------------------------------------------------------------
     def _plan_join(self, p: L.Join) -> P.PhysicalPlan:
         left = self.plan(p.left)
         right = self.plan(p.right)
-        left_keys, right_keys, residual = split_equi_join(
+        left_keys, right_keys, null_safe, residual = split_equi_join(
             p.condition, p.left.output, p.right.output)
         if not left_keys:
             if p.join_type in ("inner", "cross"):
@@ -268,14 +331,15 @@ class Planner:
                                            "leftsemi", "leftanti", "cross"):
             return P.CpuBroadcastHashJoinExec(
                 left_keys, right_keys, p.join_type, residual, left, right,
-                p.output)
+                p.output, null_safe=null_safe)
         n = self.shuffle_partitions
         lex = P.CpuShuffleExchangeExec(P.HashPartitioning(left_keys, n),
                                        left)
         rex = P.CpuShuffleExchangeExec(P.HashPartitioning(right_keys, n),
                                        right)
         return P.CpuShuffledHashJoinExec(left_keys, right_keys, p.join_type,
-                                         residual, lex, rex, p.output)
+                                         residual, lex, rex, p.output,
+                                         null_safe=null_safe)
 
     def _nested_loop(self, p: L.Join, left: P.PhysicalPlan,
                      right: P.PhysicalPlan) -> P.PhysicalPlan:
@@ -288,11 +352,12 @@ class Planner:
 def split_equi_join(condition: Optional[E.Expression],
                     left_out, right_out
                     ) -> Tuple[List[E.Expression], List[E.Expression],
-                               Optional[E.Expression]]:
-    """Split a join condition into equi-key pairs + residual conjuncts
-    (Spark ExtractEquiJoinKeys)."""
+                               List[bool], Optional[E.Expression]]:
+    """Split a join condition into equi-key pairs (+ per-pair null-safe
+    flags for ``<=>``) and residual conjuncts (Spark
+    ExtractEquiJoinKeys)."""
     if condition is None:
-        return [], [], None
+        return [], [], [], None
     left_ids = {a.expr_id for a in left_out}
     right_ids = {a.expr_id for a in right_out}
 
@@ -309,23 +374,26 @@ def split_equi_join(condition: Optional[E.Expression],
     conjuncts = split_conjuncts(condition)
     lk: List[E.Expression] = []
     rk: List[E.Expression] = []
+    ns: List[bool] = []
     residual: List[E.Expression] = []
     for c in conjuncts:
-        if isinstance(c, E.EqualTo):
+        if isinstance(c, (E.EqualTo, E.EqualNullSafe)):
             sl, sr = side(c.left), side(c.right)
             if sl == "left" and sr == "right":
                 lk.append(c.left)
                 rk.append(c.right)
+                ns.append(isinstance(c, E.EqualNullSafe))
                 continue
             if sl == "right" and sr == "left":
                 lk.append(c.right)
                 rk.append(c.left)
+                ns.append(isinstance(c, E.EqualNullSafe))
                 continue
         residual.append(c)
     res = None
     for r in residual:
         res = r if res is None else E.And(res, r)
-    return lk, rk, res
+    return lk, rk, ns, res
 
 
 def split_conjuncts(e: E.Expression) -> List[E.Expression]:

@@ -137,7 +137,7 @@ class TpuSparkSession:
         """CPU physical plan, then the plugin rewrite when enabled."""
         from spark_rapids_tpu import udf_compiler
         plan = udf_compiler.rewrite_plan(plan, self.conf_obj)
-        physical = Planner(self.conf_obj).plan(plan)
+        physical = Planner(self.conf_obj, session=self).plan(plan)
         self.last_rewrite_report = None
         if self.conf_obj.sql_enabled:
             from spark_rapids_tpu.overrides import (RewriteReport,

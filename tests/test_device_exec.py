@@ -395,3 +395,59 @@ def test_count_distinct_device():
                      "GROUP BY k ORDER BY k")
     assert_tpu_and_cpu_equal_collect(
         q, ignore_order=False, expect_execs=["TpuHashAggregate"])
+
+
+def test_mixed_distinct_and_plain_aggregates_device():
+    """count(DISTINCT a), sum(b) in ONE aggregate: the planner splits
+    into a distinct-only and a plain aggregate joined on null-safe key
+    equality (Spark RewriteDistinctAggregates role, aggregate.scala:1059)
+    — round-4 verdict: this shape must not raise. Device-placed
+    end-to-end (aggs + null-safe join)."""
+    def q(s):
+        s.createDataFrame(
+            {"k": ["a", "b", None, "a", "b", None],
+             "a": [1, 2, 2, None, 2, 1],
+             "v": [10, 20, 30, 40, None, 60]},
+            "k string, a int, v long").createOrReplaceTempView("md")
+        return s.sql(
+            "SELECT k, count(DISTINCT a) cd, sum(v) sv, count(v) cv, "
+            "avg(v) av FROM md GROUP BY k ORDER BY k")
+    assert_tpu_and_cpu_equal_collect(
+        q, ignore_order=False,
+        expect_execs=["TpuHashAggregate", "TpuShuffledHashJoin"])
+
+
+def test_mixed_distinct_global():
+    def q(s):
+        s.createDataFrame({"a": [1, 2, 2, None, 3], "v": [1, 2, 3, 4, 5]},
+                          "a int, v int").createOrReplaceTempView("mg")
+        return s.sql("SELECT count(DISTINCT a) cd, sum(v) sv FROM mg")
+    assert_tpu_and_cpu_equal_collect(q, require_device=False)
+
+
+def test_null_safe_equality_join_keys():
+    """<=> join keys match null to null on BOTH engines (EqualNullSafe
+    extracted as equi-keys, not residual)."""
+    def fn(s):
+        l = s.createDataFrame({"k": [1, None, 2, None], "a": [1, 2, 3, 4]},
+                              "k int, a int")
+        r = s.createDataFrame({"k2": [None, 1, 3], "b": [10, 20, 30]},
+                              "k2 int, b long").repartition(2)
+        return l.join(r, F.col("k").eqNullSafe(F.col("k2")), "inner")
+    assert_tpu_and_cpu_equal_collect(
+        fn, conf={"spark.rapids.sql.autoBroadcastJoinThreshold": "-1"},
+        expect_execs=["TpuShuffledHashJoin"])
+
+
+def test_collect_list_and_set():
+    """collect_list/collect_set (AggregateFunctions.scala:953 role):
+    CPU-engine aggregation with clean device fallback tagging."""
+    def q(s):
+        s.createDataFrame(
+            {"k": ["a", "b", "a", None, "b", "a"],
+             "v": [3, 1, None, 4, 1, 5],
+             "d": ["x", "y", "x", None, "y", "z"]},
+            "k string, v int, d string").createOrReplaceTempView("cl")
+        return s.sql("SELECT k, collect_list(v) lv, collect_set(d) sd, "
+                     "sum(v) sv FROM cl GROUP BY k ORDER BY k")
+    assert_tpu_fallback_collect(q, fallback_exec="CpuHashAggregateExec")
