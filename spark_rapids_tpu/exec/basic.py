@@ -23,6 +23,11 @@ from spark_rapids_tpu.sql import expressions as E
 from spark_rapids_tpu.sql import physical as P
 from spark_rapids_tpu.sql import types as T
 
+import jax
+
+_advance_rows = jax.jit(
+    lambda start, active: start + jnp.sum(active.astype(jnp.int64)))
+
 
 class TpuProjectExec(TpuExec):
     def __init__(self, project_list: List[E.Expression], child: TpuExec,
@@ -43,16 +48,29 @@ class TpuProjectExec(TpuExec):
         bound = P.bind_list(self.project_list, self.child.output)
         schema = self.schema
         metrics = self.metrics
+        needs_part = X._needs_part_ctx(bound)
 
-        def make(thunk: DevicePartitionThunk) -> DevicePartitionThunk:
+        def make(pid: int, thunk: DevicePartitionThunk
+                 ) -> DevicePartitionThunk:
             def run() -> Iterator[DeviceBatch]:
+                # row_start rides as a DEVICE scalar so counting rows
+                # across batches never syncs to host
+                row_start = jnp.int64(0) if needs_part else None
+                pid_d = jnp.int64(pid) if needs_part else None
                 for b in thunk():
                     with metrics.timed(M.OP_TIME):
-                        cols = X.run_project(bound, b)
+                        if needs_part:
+                            cols = X.run_project(
+                                bound, b, part_ctx=(pid_d, row_start))
+                            row_start = _advance_rows(row_start,
+                                                      b.active)
+                        else:
+                            cols = X.run_project(bound, b)
                     metrics.create(M.NUM_OUTPUT_BATCHES, M.ESSENTIAL).add(1)
                     yield b.with_columns(schema, cols)
             return run
-        return [make(t) for t in device_channel(self.child)]
+        return [make(i, t)
+                for i, t in enumerate(device_channel(self.child))]
 
     def simple_string(self):
         return f"TpuProject {self.project_list}"
@@ -76,16 +94,27 @@ class TpuFilterExec(TpuExec):
     def device_partitions(self) -> List[DevicePartitionThunk]:
         bound = E.bind_references(self.condition, self.child.output)
         metrics = self.metrics
+        needs_part = X._needs_part_ctx([bound])
 
-        def make(thunk: DevicePartitionThunk) -> DevicePartitionThunk:
+        def make(pid: int, thunk: DevicePartitionThunk
+                 ) -> DevicePartitionThunk:
             def run() -> Iterator[DeviceBatch]:
+                row_start = jnp.int64(0) if needs_part else None
+                pid_d = jnp.int64(pid) if needs_part else None
                 for b in thunk():
                     with metrics.timed(M.OP_TIME):
-                        out = X.run_filter(bound, b)
+                        if needs_part:
+                            out = X.run_filter(
+                                bound, b, part_ctx=(pid_d, row_start))
+                            row_start = _advance_rows(row_start,
+                                                      b.active)
+                        else:
+                            out = X.run_filter(bound, b)
                     metrics.create(M.NUM_OUTPUT_BATCHES, M.ESSENTIAL).add(1)
                     yield out
             return run
-        return [make(t) for t in device_channel(self.child)]
+        return [make(i, t)
+                for i, t in enumerate(device_channel(self.child))]
 
     def simple_string(self):
         return f"TpuFilter {self.condition!r}"

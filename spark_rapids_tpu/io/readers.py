@@ -446,6 +446,8 @@ class CpuFileScanExec(P.PhysicalPlan):
         self._open_cost = open_cost
         self._parts = pack_partitions(self._units, self._max_bytes,
                                       open_cost)
+        # set by the planner when input_file_name() sits above this scan
+        self.force_perfile = False
 
     def set_pushdown(self, preds: List[tuple]) -> None:
         """Install pushed-down predicates (name, op, storage-value) and
@@ -479,6 +481,8 @@ class CpuFileScanExec(P.PhysicalPlan):
 
     def partitions(self):
         reader_type = str(self.conf.get(PARQUET_READER_TYPE)).upper()
+        if self.force_perfile:
+            reader_type = "PERFILE"
         max_rows = int(self.conf.get(MAX_READER_BATCH_SIZE_ROWS))
         schema = self.schema
         part_fields = self._part_fields
@@ -511,11 +515,19 @@ class CpuFileScanExec(P.PhysicalPlan):
             # its host-side decode off the task thread the same way)
             return list(emit(decode(u)))
 
+        from spark_rapids_tpu.sql import expressions as E
+
+        def _set_file(path: str) -> None:
+            # input_file_name() context: valid for scan-adjacent
+            # projects on this thread (InputFileBlockRule role)
+            E._PART_CTX.input_file = path
+
         def make(units: List[ScanUnit]):
             def run() -> Iterator[HostBatch]:
                 if reader_type == "COALESCING" and len(units) > 1:
                     import pyarrow as pa
                     tbl = pa.concat_tables([decode(u) for u in units])
+                    _set_file("")  # batches span files after the stitch
                     yield from emit(tbl)
                 elif reader_type == "MULTITHREADED" and len(units) > 1:
                     n_threads = int(
@@ -530,15 +542,20 @@ class CpuFileScanExec(P.PhysicalPlan):
                     it = iter(units)
                     futures = deque(pool.submit(decode_host, u)
                                     for u in islice(it, n_threads + 2))
+                    done = iter(units)
                     while futures:
                         f = futures.popleft()
                         nxt = next(it, None)
                         if nxt is not None:
                             futures.append(pool.submit(decode_host, nxt))
-                        yield from f.result()
+                        _set_file(next(done).path)
+                        for hb in f.result():
+                            yield hb
                 else:  # PERFILE
                     for u in units:
-                        yield from emit(decode(u))
+                        tbl = decode(u)
+                        _set_file(u.path)
+                        yield from emit(tbl)
             return run
 
         return [make(us) for us in self._parts]

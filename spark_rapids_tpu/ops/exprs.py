@@ -171,6 +171,8 @@ class Ctx:
                  lit_vals: Optional[Sequence[jax.Array]] = None):
         self.inputs = list(inputs)
         self.capacity = capacity
+        self.part_vals = None       # (pid, row_start) traced scalars
+        self.active_hint = None     # the batch active mask, when known
         # ANSI error channel: (row-flags, message) pairs collected during
         # tracing; run_project/run_filter surface them as raised
         # ArithmeticError after the program executes.
@@ -1148,6 +1150,155 @@ def _h_contains(e: E.Contains, ctx: Ctx) -> DeviceColumn:
     return _normalized(T.BooleanT, found, validity)
 
 
+@handles(E.SparkPartitionID)
+def _h_spark_partition_id(e: E.SparkPartitionID, ctx: Ctx) -> DeviceColumn:
+    pid, _start = ctx.part_vals
+    data = jnp.full(ctx.capacity, 0, dtype=jnp.int32) + pid.astype(
+        jnp.int32)
+    return DeviceColumn(T.IntegerT, data,
+                        jnp.ones(ctx.capacity, dtype=jnp.bool_))
+
+
+@handles(E.MonotonicallyIncreasingID)
+def _h_monotonic_id(e: E.MonotonicallyIncreasingID,
+                    ctx: Ctx) -> DeviceColumn:
+    """partition_id << 33 | row position within the partition
+    (GpuMonotonicallyIncreasingID.scala). Row positions count ACTIVE
+    rows in batch order, continuing across batches via the row_start
+    device scalar the Project exec threads through."""
+    pid, start = ctx.part_vals
+    active = ctx.active_hint
+    rank = jnp.cumsum(active.astype(jnp.int64)) - 1
+    base = (pid.astype(jnp.int64) << jnp.int64(33)) + start
+    data = jnp.where(active, base + rank, jnp.int64(0))
+    return DeviceColumn(T.LongT, data,
+                        jnp.ones(ctx.capacity, dtype=jnp.bool_))
+
+
+def _like_chunks(pattern: str):
+    """LIKE pattern -> list of literal byte chunks split at ``%``
+    (escape ``\\``). The gate rejects ``_`` before this runs."""
+    chunks: List[bytes] = []
+    cur: List[str] = []
+    i = 0
+    while i < len(pattern):
+        ch = pattern[i]
+        if ch == "\\" and i + 1 < len(pattern):
+            cur.append(pattern[i + 1])
+            i += 2
+            continue
+        if ch == "%":
+            chunks.append("".join(cur).encode("utf-8"))
+            cur = []
+        else:
+            cur.append(ch)
+        i += 1
+    chunks.append("".join(cur).encode("utf-8"))
+    return chunks
+
+
+@extra_check(E.Like)
+def _c_like(e: E.Like):
+    r = e.children[1]
+    if not isinstance(r, E.Literal) \
+            or not isinstance(r.data_type, T.StringType) \
+            or r.value is None:
+        return "LIKE with a non-literal pattern runs on CPU"
+    # tokenise once to find unescaped _
+    i, s = 0, r.value
+    while i < len(s):
+        if s[i] == "\\" and i + 1 < len(s):
+            i += 2
+            continue
+        if s[i] == "_":
+            return ("LIKE patterns with _ run on CPU (byte-level "
+                    "matching cannot honor per-character semantics for "
+                    "multi-byte UTF-8 data)")
+        i += 1
+    return None
+
+
+def _match_chunk_at(lc: DeviceStringColumn, seg: bytes,
+                    at: jax.Array) -> jax.Array:
+    """True where `seg` occurs in lc at per-row byte offset `at`."""
+    m = len(seg)
+    seg_a = jnp.asarray(np.frombuffer(seg, dtype=np.uint8))
+    cc = lc.char_cap
+    idx = jnp.clip(at[:, None] + jnp.arange(m)[None, :], 0, cc - 1)
+    window = jnp.take_along_axis(lc.chars, idx, axis=1)
+    return (window == seg_a[None, :]).all(axis=1) \
+        & (at >= 0) & (at + m <= lc.lengths)
+
+
+@handles(E.Like)
+def _h_like(e: E.Like, ctx: Ctx) -> DeviceColumn:
+    """SQL LIKE with a LITERAL %-pattern, compiled to a specialized
+    sliding-compare program over the char matrix (GpuLike,
+    stringFunctions.scala:670 — the reference compiles to a cudf regex;
+    here the %-chunk structure IS the program: anchored prefix/suffix
+    compares plus greedy in-order chunk searches, all fusible
+    elementwise ops). Patterns with _ are tagged to CPU (byte vs
+    character semantics)."""
+    lc = dev_eval(e.children[0], ctx)
+    pattern = e.children[1].value
+    chunks = _like_chunks(pattern)
+    validity = lc.validity
+    n = lc.lengths
+    cap = ctx.capacity
+    if len(chunks) == 1:  # no %: exact match
+        seg = chunks[0]
+        ok = (n == len(seg)) & _match_chunk_at(
+            lc, seg, jnp.zeros(cap, dtype=jnp.int32)) \
+            if seg else (n == 0)
+        return _normalized(T.BooleanT, ok, validity)
+    first, *mid, last = chunks
+    ok = jnp.ones(cap, dtype=bool)
+    pos = jnp.zeros(cap, dtype=jnp.int32)
+    if first:
+        ok = ok & _match_chunk_at(lc, first,
+                                  jnp.zeros(cap, dtype=jnp.int32))
+        pos = jnp.full(cap, len(first), dtype=jnp.int32)
+    for seg in mid:
+        if not seg:
+            continue
+        m = len(seg)
+        seg_a = jnp.asarray(np.frombuffer(seg, dtype=np.uint8))
+        n_off = max(lc.char_cap - m + 1, 0)
+        # earliest occurrence at offset >= pos (greedy, like regex .*)
+        if n_off == 0:
+            found = jnp.full(cap, -1, dtype=jnp.int32)
+        elif n_off * m <= 8192:
+            # one static-index gather evaluates every offset at once
+            offs = jnp.arange(n_off, dtype=jnp.int32)
+            win_idx = (offs[:, None]
+                       + jnp.arange(m, dtype=jnp.int32)[None, :]).reshape(-1)
+            windows = lc.chars[:, win_idx].reshape(cap, n_off, m)
+            match = (windows == seg_a[None, None, :]).all(axis=2)
+            eligible = match & (offs[None, :] >= pos[:, None]) \
+                & (offs[None, :] + m <= n[:, None])
+            has = eligible.any(axis=1)
+            first = jnp.argmax(eligible, axis=1).astype(jnp.int32)
+            found = jnp.where(has, first, jnp.int32(-1))
+        else:
+            # wide char matrices: a fori_loop keeps the program small
+            # (the unrolled/vectorized forms blow compile time / HBM)
+            def body(o, found, _seg=seg_a, _m=m, _pos=pos, _n=n):
+                window = jax.lax.dynamic_slice_in_dim(
+                    lc.chars, o, _m, axis=1)
+                match = (window == _seg[None, :]).all(axis=1) \
+                    & (o + _m <= _n) & (o >= _pos)
+                return jnp.where((found < 0) & match,
+                                 o.astype(jnp.int32), found)
+            found = jax.lax.fori_loop(
+                0, n_off, body, jnp.full(cap, -1, dtype=jnp.int32))
+        ok = ok & (found >= 0)
+        pos = jnp.where(found >= 0, found + m, pos)
+    if last:
+        off = n - len(last)
+        ok = ok & (off >= pos) & _match_chunk_at(lc, last, off)
+    return _normalized(T.BooleanT, ok, validity)
+
+
 # ---------------------------------------------------------------------------
 # Date/time
 # ---------------------------------------------------------------------------
@@ -1450,8 +1601,10 @@ _PROJECT_CACHE: Dict[Tuple, Callable] = {}
 
 
 def _build_project(exprs: Tuple[E.Expression, ...]) -> Callable:
-    def fn(cols, active, lit_vals):
+    def fn(cols, active, lit_vals, part_vals=None):
         ctx = Ctx(cols, active.shape[0], exprs, lit_vals)
+        ctx.part_vals = part_vals  # (pid, row_start) traced scalars
+        ctx.active_hint = active
         from spark_rapids_tpu.columnar.device import mask_col
         outs = []
         for e in exprs:
@@ -1471,16 +1624,31 @@ def _raise_if_errors(err) -> None:
         raise ArithmeticError("Cast overflow in ANSI mode")
 
 
-def run_project(exprs: Sequence[E.Expression], batch: DeviceBatch
-                ) -> List[AnyDeviceColumn]:
+def _needs_part_ctx(exprs) -> bool:
+    def walk(e):
+        if isinstance(e, (E.SparkPartitionID, E.MonotonicallyIncreasingID)):
+            return True
+        return any(walk(c) for c in e.children)
+    return any(walk(e) for e in exprs)
+
+
+def run_project(exprs: Sequence[E.Expression], batch: DeviceBatch,
+                part_ctx=None) -> List[AnyDeviceColumn]:
     """Evaluate bound expressions over a device batch as ONE fused XLA
-    program (cached on expression structure)."""
-    key = tuple(expr_key(e) for e in exprs)
+    program (cached on expression structure). ``part_ctx`` is the
+    optional (partition-id, row-start) pair of traced device scalars
+    consumed by partition-aware expressions."""
+    key = (tuple(expr_key(e) for e in exprs), part_ctx is not None)
     fn = _PROJECT_CACHE.get(key)
     if fn is None:
         fn = _build_project(tuple(exprs))
         _PROJECT_CACHE[key] = fn
-    outs, err = fn(batch.columns, batch.active, literal_values(exprs))
+    if part_ctx is not None:
+        outs, err = fn(batch.columns, batch.active,
+                       literal_values(exprs), part_ctx)
+    else:
+        outs, err = fn(batch.columns, batch.active,
+                       literal_values(exprs))
     _raise_if_errors(err)
     return outs
 
@@ -1488,14 +1656,17 @@ def run_project(exprs: Sequence[E.Expression], batch: DeviceBatch
 _FILTER_CACHE: Dict[Tuple, Callable] = {}
 
 
-def run_filter(cond: E.Expression, batch: DeviceBatch) -> DeviceBatch:
+def run_filter(cond: E.Expression, batch: DeviceBatch,
+               part_ctx=None) -> DeviceBatch:
     """Filter = mask update only; no data movement (compaction is explicit
     and happens at shuffle/concat boundaries)."""
-    key = expr_key(cond)
+    key = (expr_key(cond), part_ctx is not None)
     fn = _FILTER_CACHE.get(key)
     if fn is None:
-        def _fn(cols, active, lit_vals):
+        def _fn(cols, active, lit_vals, part_vals=None):
             ctx = Ctx(cols, active.shape[0], (cond,), lit_vals)
+            ctx.part_vals = part_vals
+            ctx.active_hint = active
             p = dev_eval(cond, ctx)
             err = (jnp.any(jnp.stack([jnp.any(f & active)
                                       for f, _m in ctx.errors]))
@@ -1503,8 +1674,12 @@ def run_filter(cond: E.Expression, batch: DeviceBatch) -> DeviceBatch:
             return active & p.validity & _as_bool(p), err
         fn = jax.jit(_fn)
         _FILTER_CACHE[key] = fn
-    new_active, err = fn(batch.columns, batch.active,
-                         literal_values([cond]))
+    if part_ctx is not None:
+        new_active, err = fn(batch.columns, batch.active,
+                             literal_values([cond]), part_ctx)
+    else:
+        new_active, err = fn(batch.columns, batch.active,
+                             literal_values([cond]))
     _raise_if_errors(err)
     return DeviceBatch(batch.schema, batch.columns, new_active, None)
 

@@ -1530,6 +1530,200 @@ def _like_to_regex(pattern: str) -> str:
     return "".join(out)
 
 
+import threading as _threading
+
+# Per-thread partition context for partition-aware expressions; set by
+# the Project execs (pid, row_start) and the file scan (input_file)
+# right before each batch evaluation.
+_PART_CTX = _threading.local()
+
+
+class SparkPartitionID(Expression):
+    """spark_partition_id() (GpuSparkPartitionID role)."""
+
+    children: List[Expression] = []
+
+    def __init__(self):
+        self.children = []
+
+    @property
+    def pretty_name(self) -> str:
+        return "spark_partition_id"
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.IntegerT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        pid = getattr(_PART_CTX, "pid", 0)
+        return HostColumn.all_valid(
+            np.full(batch.num_rows, pid, dtype=np.int32), T.IntegerT)
+
+
+class MonotonicallyIncreasingID(Expression):
+    """monotonically_increasing_id(): partition id << 33 | row position
+    within the partition (GpuMonotonicallyIncreasingID.scala)."""
+
+    def __init__(self):
+        self.children = []
+
+    @property
+    def pretty_name(self) -> str:
+        return "monotonically_increasing_id"
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.LongT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        pid = getattr(_PART_CTX, "pid", 0)
+        start = getattr(_PART_CTX, "row_start", 0)
+        base = (pid << 33) + start
+        return HostColumn.all_valid(
+            base + np.arange(batch.num_rows, dtype=np.int64), T.LongT)
+
+
+class InputFileName(Expression):
+    """input_file_name(): path of the file the current rows came from;
+    empty string outside a file scan (Spark semantics; the reference's
+    InputFileBlockRule likewise confines it to scan-adjacent projects)."""
+
+    def __init__(self):
+        self.children = []
+
+    @property
+    def pretty_name(self) -> str:
+        return "input_file_name"
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.StringT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        f = getattr(_PART_CTX, "input_file", "")
+        return HostColumn.all_valid(
+            np.full(batch.num_rows, f, dtype=object), T.StringT)
+
+
+class RLike(StartsWith):
+    """RLIKE / regexp: Java-regex search semantics (unanchored), CPU
+    only — the device rewrite tags regexp to CPU (the reference gates
+    GpuRLike behind cudf regex support the same way)."""
+
+    def scalar(self, s: str, p: str) -> bool:
+        import re
+        return re.search(p, s) is not None
+
+
+class RegExpReplace(Expression):
+    """regexp_replace(str, pattern, replacement); CPU only."""
+
+    def __init__(self, child: Expression, pattern: Expression,
+                 replacement: Expression):
+        self.children = [child, pattern, replacement]
+
+    @property
+    def pretty_name(self) -> str:
+        return "regexp_replace"
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.StringT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        import re
+        cols = [c.eval(batch) for c in self.children]
+        validity = _combined_validity(cols)
+        out = np.full(batch.num_rows, "", dtype=object)
+        for i in range(batch.num_rows):
+            if validity[i]:
+                # Java $1 group references map to python \1
+                rep = re.sub(r"\$(\d+)", r"\\\1", cols[2].data[i])
+                out[i] = re.sub(cols[1].data[i], rep, cols[0].data[i])
+        return HostColumn(T.StringT, out, validity)
+
+
+class RegExpExtract(Expression):
+    """regexp_extract(str, pattern, idx): group idx of the FIRST match,
+    empty string when no match (Spark semantics); CPU only."""
+
+    def __init__(self, child: Expression, pattern: Expression,
+                 idx: Expression):
+        self.children = [child, pattern, idx]
+
+    @property
+    def pretty_name(self) -> str:
+        return "regexp_extract"
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.StringT
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        import re
+        cols = [c.eval(batch) for c in self.children]
+        validity = _combined_validity(cols)
+        out = np.full(batch.num_rows, "", dtype=object)
+        for i in range(batch.num_rows):
+            if validity[i]:
+                m = re.search(cols[1].data[i], cols[0].data[i])
+                g = int(cols[2].data[i])
+                out[i] = (m.group(g) or "") if m and g <= len(
+                    m.groups()) else ""
+        return HostColumn(T.StringT, out, validity)
+
+
+class StringSplit(Expression):
+    """split(str, regex[, limit]) -> array<string> (GpuStringSplit,
+    stringFunctions.scala:1014). Java split semantics: limit > 0 caps
+    the parts; limit <= 0 keeps trailing empty strings."""
+
+    def __init__(self, child: Expression, pattern: Expression,
+                 limit: Expression):
+        self.children = [child, pattern, limit]
+
+    @property
+    def pretty_name(self) -> str:
+        return "split"
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.ArrayType(T.StringT)
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        import re
+        cols = [c.eval(batch) for c in self.children]
+        validity = _combined_validity(cols)
+        out = np.empty(batch.num_rows, dtype=object)
+        for i in range(batch.num_rows):
+            if not validity[i]:
+                out[i] = ()
+                continue
+            lim = int(cols[2].data[i])
+            parts = re.split(cols[1].data[i], cols[0].data[i],
+                             maxsplit=lim - 1 if lim > 0 else 0)
+            if lim == 0 and len(parts) > 1:
+                # Java Pattern.split(limit=0) strips trailing empties;
+                # the no-match case returns [input] untouched (so
+                # "".split(",") stays [""])
+                while parts and parts[-1] == "":
+                    parts.pop()
+            out[i] = tuple(parts)
+        return HostColumn(self.data_type, out, validity)
+
+
 class ConcatWs(Expression):
     """concat_ws(sep, ...): null arguments are SKIPPED; null only when
     the separator itself is null (stringFunctions.scala GpuConcatWs)."""

@@ -143,6 +143,9 @@ class Column:
     def like(self, pattern: str):
         return Column(E.Like(self.expr, E.Literal(pattern)))
 
+    def rlike(self, pattern: str):
+        return Column(E.RLike(self.expr, E.Literal(pattern)))
+
     def between(self, low, high):
         return (self >= low) & (self <= high)
 
@@ -340,6 +343,18 @@ def avg(c) -> Column:
 
 
 mean = avg
+
+
+def monotonically_increasing_id() -> Column:
+    return Column(E.MonotonicallyIncreasingID())
+
+
+def spark_partition_id() -> Column:
+    return Column(E.SparkPartitionID())
+
+
+def input_file_name() -> Column:
+    return Column(E.InputFileName())
 
 
 def collect_list(c) -> Column:
@@ -658,6 +673,21 @@ def instr(c, substr: str) -> Column:
 def locate(substr: str, c, pos: int = 1) -> Column:
     return Column(E.StringLocate(E.Literal(substr), _to_col_expr(c),
                                  E.Literal(pos)))
+
+
+def split(c, pattern: str, limit: int = -1) -> Column:
+    return Column(E.StringSplit(_to_col_expr(c), E.Literal(pattern),
+                                E.Literal(limit)))
+
+
+def regexp_replace(c, pattern: str, replacement: str) -> Column:
+    return Column(E.RegExpReplace(_to_col_expr(c), E.Literal(pattern),
+                                  E.Literal(replacement)))
+
+
+def regexp_extract(c, pattern: str, idx: int) -> Column:
+    return Column(E.RegExpExtract(_to_col_expr(c), E.Literal(pattern),
+                                  E.Literal(idx)))
 
 
 def initcap(c) -> Column:

@@ -385,6 +385,10 @@ class _Parser:
                 left = ~left.like(self._string_lit())
             elif self.kw("like"):
                 left = left.like(self._string_lit())
+            elif self.kw("not", "rlike"):
+                left = ~left.rlike(self._string_lit())
+            elif self.kw("rlike") or self.kw("regexp"):
+                left = left.rlike(self._string_lit())
             elif self.kw("not", "between"):
                 lo = self.add_expr()
                 self.expect("and")
@@ -657,6 +661,9 @@ _FUNCTIONS = {
     "sum": F.sum, "avg": F.avg, "min": F.min, "max": F.max,
     "first": F.first, "last": F.last,
     "collect_list": F.collect_list, "collect_set": F.collect_set,
+    "monotonically_increasing_id": F.monotonically_increasing_id,
+    "spark_partition_id": F.spark_partition_id,
+    "input_file_name": F.input_file_name,
     "stddev": F.stddev_samp, "stddev_samp": F.stddev_samp,
     "std": F.stddev_samp, "stddev_pop": F.stddev_pop,
     "variance": F.var_samp, "var_samp": F.var_samp,
@@ -692,6 +699,12 @@ _FUNCTIONS = {
     "locate": lambda s, c, *p: F.locate(
         _lit_value(s), c, *[int(_lit_value(x)) for x in p]),
     "initcap": F.initcap, "reverse": F.reverse,
+    "split": lambda c, p, *l: F.split(c, _lit_value(p),
+                                      *[int(_lit_value(x)) for x in l]),
+    "regexp_replace": lambda c, p, r: F.regexp_replace(
+        c, _lit_value(p), _lit_value(r)),
+    "regexp_extract": lambda c, p, i: F.regexp_extract(
+        c, _lit_value(p), int(_lit_value(i))),
     "ltrim": F.ltrim, "rtrim": F.rtrim,
     "ascii": F.ascii, "char": F.chr, "chr": F.chr,
     "quarter": F.quarter, "dayofweek": F.dayofweek,

@@ -214,13 +214,31 @@ class CpuProjectExec(PhysicalPlan):
         bound = bind_list(self.project_list, self.child.output)
         schema = self.schema
 
-        def make(thunk: PartitionThunk) -> PartitionThunk:
+        def make(pid: int, thunk: PartitionThunk) -> PartitionThunk:
             def run() -> Iterator[HostBatch]:
-                for b in thunk():
+                rows_seen = 0
+                it = iter(thunk())
+                while True:
+                    # input_file resets BEFORE each pull: a scan feeding
+                    # this batch re-sets it while yielding; any other
+                    # producer (exchange, cache) leaves it "" — Spark's
+                    # input_file_name() post-shuffle semantics
+                    E._PART_CTX.input_file = ""
+                    b = next(it, None)
+                    if b is None:
+                        break
+                    # (re)set pid/row_start right before EACH eval:
+                    # interleaved generators on one thread must not see
+                    # each other's context (GpuMonotonicallyIncreasingID
+                    # role)
+                    E._PART_CTX.pid = pid
+                    E._PART_CTX.row_start = rows_seen
                     cols = [e.eval(b) for e in bound]
+                    rows_seen += b.num_rows
                     yield HostBatch(schema, cols, b.num_rows)
             return run
-        return [make(t) for t in self.child.partitions()]
+        return [make(i, t)
+                for i, t in enumerate(self.child.partitions())]
 
     def simple_string(self):
         return f"Project {self.project_list}"
@@ -320,14 +338,24 @@ class CpuFilterExec(PhysicalPlan):
     def partitions(self) -> List[PartitionThunk]:
         bound = E.bind_references(self.condition, self.child.output)
 
-        def make(thunk: PartitionThunk) -> PartitionThunk:
+        def make(pid: int, thunk: PartitionThunk) -> PartitionThunk:
             def run() -> Iterator[HostBatch]:
-                for b in thunk():
+                rows_seen = 0
+                it = iter(thunk())
+                while True:
+                    E._PART_CTX.input_file = ""
+                    b = next(it, None)
+                    if b is None:
+                        break
+                    E._PART_CTX.pid = pid
+                    E._PART_CTX.row_start = rows_seen
+                    rows_seen += b.num_rows
                     p = bound.eval(b)
                     keep = p.validity & p.data.astype(bool)
                     yield b.take(np.nonzero(keep)[0])
             return run
-        return [make(t) for t in self.child.partitions()]
+        return [make(i, t)
+                for i, t in enumerate(self.child.partitions())]
 
     def simple_string(self):
         return f"Filter {self.condition!r}"

@@ -82,7 +82,23 @@ class Planner:
 
     # -- simple unary ------------------------------------------------------
     def _plan_project(self, p: L.Project) -> P.PhysicalPlan:
-        return P.CpuProjectExec(p.project_list, self.plan(p.child))
+        child = self.plan(p.child)
+        # input_file_name() needs per-file batches: downgrade a
+        # COALESCING scan under this project to PERFILE (the reference's
+        # InputFileBlockRule forces the same, GpuOverrides.scala)
+        def has_iff(e):
+            return isinstance(e, E.InputFileName) \
+                or any(has_iff(c) for c in e.children)
+        if any(has_iff(e) for e in p.project_list):
+            from spark_rapids_tpu.io.readers import CpuFileScanExec
+            node = child
+            while node is not None:
+                if isinstance(node, CpuFileScanExec):
+                    node.force_perfile = True
+                    break
+                node = node.children[0] if len(node.children) == 1 \
+                    else None
+        return P.CpuProjectExec(p.project_list, child)
 
     def _plan_filter(self, p: L.Filter) -> P.PhysicalPlan:
         child = self.plan(p.child)

@@ -451,3 +451,41 @@ def test_collect_list_and_set():
         return s.sql("SELECT k, collect_list(v) lv, collect_set(d) sd, "
                      "sum(v) sv FROM cl GROUP BY k ORDER BY k")
     assert_tpu_fallback_collect(q, fallback_exec="CpuHashAggregateExec")
+
+
+def test_monotonically_increasing_id_and_partition_id():
+    """monotonically_increasing_id / spark_partition_id device-placed
+    (GpuMonotonicallyIncreasingID.scala, GpuSparkPartitionID roles):
+    pid << 33 | row-position, row positions continuing across batches
+    via a device row-start scalar."""
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: s.createDataFrame(
+            {"v": list(range(2000))}, "v int", num_partitions=3)
+        .select("v", F.monotonically_increasing_id().alias("id"),
+                F.spark_partition_id().alias("p")),
+        expect_execs=["TpuProject"])
+
+
+def test_monotonic_id_after_filter():
+    def q(s):
+        s.createDataFrame({"v": list(range(500))}, "v int",
+                          num_partitions=2).createOrReplaceTempView("mi")
+        return s.sql("SELECT v, monotonically_increasing_id() i FROM mi "
+                     "WHERE v % 3 = 0")
+    assert_tpu_and_cpu_equal_collect(q, expect_execs=["TpuProject"])
+
+
+def test_input_file_name(tmp_path):
+    """input_file_name() over a parquet scan (InputFileBlockRule role:
+    CPU-confined, scan-adjacent)."""
+    import os
+
+    def q(s):
+        d = os.path.join(str(tmp_path), "iff")
+        if not os.path.exists(d):
+            gen = s.createDataFrame({"v": list(range(100))}, "v int",
+                                    num_partitions=2)
+            gen.write.mode("overwrite").parquet(d)
+        return s.read.parquet(d).select(
+            F.input_file_name().alias("f"), "v")
+    assert_tpu_and_cpu_equal_collect(q, require_device=False)

@@ -266,3 +266,39 @@ def test_xxhash64_strings():
         .select(F.xxhash64("a", "b").alias("h"),
                 F.xxhash64("a").alias("hs")),
         expect_execs=["TpuProject"])
+
+
+# -- LIKE / regexp / split (round 5) ---------------------------------------
+
+@pytest.mark.parametrize("pat", [
+    "app%", "%ple", "%ppl%", "a%e", "%", "a%p%e", "ap\\%%", "%apple%", ""])
+def test_like_literal_patterns_device(pat):
+    """LITERAL %-patterns compile to a device sliding-compare program
+    (GpuLike, stringFunctions.scala:670)."""
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: _df(s, [("a", StringGen(nullable=True))], n=300)
+        .select(F.col("a"), F.col("a").like(pat).alias("m")),
+        expect_execs=["TpuProject"])
+
+
+def test_like_underscore_falls_back():
+    """_ patterns run on CPU (byte vs character semantics)."""
+    from tests.harness import assert_tpu_fallback_collect
+    assert_tpu_fallback_collect(
+        lambda s: _df(s, [("a", StringGen(nullable=True))], n=100)
+        .select(F.col("a").like("a_b").alias("m")),
+        fallback_exec="CpuProjectExec")
+
+
+def test_rlike_regexp_split_cpu_parity():
+    """RLIKE / regexp_extract / regexp_replace / split: CPU
+    implementations with device fallback tagging (stringFunctions.scala
+    :670,1014 roles)."""
+    def q(s):
+        _df(s, [("a", StringGen(nullable=True))],
+            n=200).createOrReplaceTempView("rx")
+        return s.sql(
+            "SELECT a, a RLIKE 'a.b' r, regexp_extract(a, '(\\\\w)(\\\\w+)', 2) g, "
+            "regexp_replace(a, '[aeiou]+', '_') rr, split(a, 'a') sp "
+            "FROM rx")
+    assert_tpu_and_cpu_equal_collect(q, require_device=False)
