@@ -149,6 +149,20 @@ SHUFFLE_ICI_DEVICES = conf("spark.rapids.shuffle.ici.devices").doc(
     "Number of devices in the ICI shuffle mesh (0 = all visible "
     "devices).").integer(0)
 
+AQE_ENABLED = conf("spark.sql.adaptive.enabled").doc(
+    "Adaptive query execution v0: replan at exchange materialization "
+    "using MEASURED output sizes - a shuffled hash join whose build "
+    "side lands under the broadcast threshold flips to a broadcast-"
+    "style join at runtime, and tiny exchange partitions coalesce "
+    "toward the advisory size (GpuOverrides.scala:3550 "
+    "GpuQueryStagePrepOverrides / GpuCustomShuffleReaderExec "
+    "roles).").boolean(True)
+
+AQE_ADVISORY_PARTITION_BYTES = conf(
+    "spark.sql.adaptive.advisoryPartitionSizeInBytes").doc(
+    "Target post-shuffle partition size for AQE partition coalescing "
+    "(Spark's advisoryPartitionSizeInBytes).").bytes(64 << 20)
+
 AUTO_BROADCAST_JOIN_THRESHOLD = conf(
     "spark.rapids.sql.autoBroadcastJoinThreshold").doc(
     "Maximum estimated build-side size in bytes for a join to use a "

@@ -213,6 +213,48 @@ def realign_spilled_pids(handle, pids: jax.Array, act: jax.Array
     return b, pids
 
 
+class TpuBroadcastExchangeExec(TpuExec):
+    """Device-resident reusable broadcast (GpuBroadcastExchangeExec
+    .scala:280): the build side concatenates into HBM ONCE behind a
+    lock; every consumer — all stream partitions, and several joins
+    after the reuse pass deduplicates equal broadcast subtrees — shares
+    the same device batch. ``broadcastBuilds`` pins build-once in
+    tests."""
+
+    def __init__(self, child: TpuExec, conf: TpuConf):
+        super().__init__(conf)
+        self.children = [child]
+        self._lock = threading.Lock()
+        self._built = None
+
+    @property
+    def child(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def materialize_device(self):
+        from spark_rapids_tpu.columnar.device import concat_device
+        with self._lock:
+            if self._built is None:
+                self.metrics.create("broadcastBuilds", M.ESSENTIAL).add(1)
+                batches = [b for t in device_channel(self.child)
+                           for b in t() if b._num_rows != 0]
+                self._built = (
+                    concat_device(batches) if len(batches) > 1 else
+                    batches[0] if batches else
+                    DeviceBatch.empty(self.child.schema))
+            return self._built
+
+    def device_partitions(self) -> List[DevicePartitionThunk]:
+        return [lambda: iter([self.materialize_device()])]
+
+    def simple_string(self):
+        return "TpuBroadcastExchange"
+
+
 class TpuShuffleExchangeExec(TpuExec):
     def __init__(self, partitioning: P.Partitioning, child: TpuExec,
                  conf: TpuConf):
@@ -221,6 +263,9 @@ class TpuShuffleExchangeExec(TpuExec):
         self.partitioning = partitioning
         self._cache: Optional[List[List[DeviceBatch]]] = None
         self._lock = threading.Lock()
+        # set by the rewrite for consumers that accept any partition
+        # count (agg/sort/window) - enables AQE partition coalescing
+        self.allow_aqe_coalesce = False
 
     @property
     def child(self) -> TpuExec:
@@ -415,14 +460,56 @@ class TpuShuffleExchangeExec(TpuExec):
     def device_partitions(self) -> List[DevicePartitionThunk]:
         from spark_rapids_tpu.memory import SpillableBatch
         nparts = self.partitioning.num_partitions
+        groups = [[i] for i in range(nparts)]
+        if self._aqe_coalesce_eligible():
+            groups = self._aqe_partition_groups(nparts)
 
-        def make(pid: int) -> DevicePartitionThunk:
+        def make(pids: List[int]) -> DevicePartitionThunk:
             def run() -> Iterator[DeviceBatch]:
-                for item in self._materialize()[pid]:
-                    yield (item.get() if isinstance(item, SpillableBatch)
-                           else item)
+                mat = self._materialize()
+                for pid in pids:
+                    for item in mat[pid]:
+                        yield (item.get()
+                               if isinstance(item, SpillableBatch)
+                               else item)
             return run
-        return [make(i) for i in range(nparts)]
+        return [make(g) for g in groups]
+
+    def _aqe_coalesce_eligible(self) -> bool:
+        from spark_rapids_tpu.conf import AQE_ENABLED
+        return (self.allow_aqe_coalesce
+                and bool(self.conf.get(AQE_ENABLED))
+                and not getattr(self.partitioning, "user_specified", False)
+                and self.partitioning.num_partitions > 1
+                and not self._mesh_eligible())
+
+    def _aqe_partition_groups(self, nparts: int) -> List[List[int]]:
+        """Merge ADJACENT materialized partitions up to the advisory
+        size (GpuCustomShuffleReaderExec / Spark coalesced-partition-
+        spec role; adjacency preserves range-partition ordering).
+        Only consumers that accept any partition count opt in
+        (allow_aqe_coalesce) — co-partitioned join inputs never do."""
+        from spark_rapids_tpu.conf import AQE_ADVISORY_PARTITION_BYTES
+        from spark_rapids_tpu.memory import SpillableBatch
+        advisory = int(self.conf.get(AQE_ADVISORY_PARTITION_BYTES))
+        mat = self._materialize()
+        sizes = [sum(h.sizeof() for h in part
+                     if isinstance(h, SpillableBatch)) for part in mat]
+        groups: List[List[int]] = []
+        cur: List[int] = []
+        cur_bytes = 0
+        for i, sz in enumerate(sizes):
+            if cur and cur_bytes + sz > advisory:
+                groups.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(i)
+            cur_bytes += sz
+        if cur:
+            groups.append(cur)
+        if len(groups) < nparts:
+            self.metrics.create("aqeCoalescedPartitions",
+                                M.ESSENTIAL).add(nparts - len(groups))
+        return groups
 
     def simple_string(self):
         return f"TpuExchange {self.partitioning!r}"

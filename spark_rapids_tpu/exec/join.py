@@ -137,7 +137,110 @@ class TpuShuffledHashJoinExec(TpuExec):
     _CHUNKED_OUTER = {"right": "inner", "rightouter": "inner",
                       "full": "leftouter", "fullouter": "leftouter"}
 
+    # join types Spark builds broadcast-right for
+    _BROADCASTABLE = ("inner", "cross", "left", "leftouter", "leftsemi",
+                      "leftanti")
+
+    def _aqe_try_broadcast(self) -> Optional[List[DevicePartitionThunk]]:
+        """AQE v0 runtime replan (GpuOverrides.scala:3550
+        GpuQueryStagePrepOverrides role): materialize the build-side
+        exchange, and when its MEASURED bytes land under the broadcast
+        threshold, flip to a broadcast-style join - build side concat
+        once and shared across stream partitions, and the stream side's
+        co-partitioning exchange is bypassed entirely."""
+        from spark_rapids_tpu.conf import (AQE_ENABLED,
+                                           AUTO_BROADCAST_JOIN_THRESHOLD)
+        from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+        from spark_rapids_tpu.memory import SpillableBatch
+        if not bool(self.conf.get(AQE_ENABLED)):
+            return None
+        threshold = int(self.conf.get(AUTO_BROADCAST_JOIN_THRESHOLD))
+        if threshold < 0 or self.join_type not in self._BROADCASTABLE:
+            return None
+        rexch = self.right
+        if not isinstance(rexch, TpuShuffleExchangeExec) \
+                or rexch._mesh_eligible():
+            return None
+        mat = rexch._materialize()
+        handles = [h for part in mat for h in part
+                   if isinstance(h, SpillableBatch)]
+        total = sum(h.sizeof() for h in handles)
+        if total > threshold:
+            # capacity-based bytes over-count mask-filtered batches
+            # (filters only flip the active mask); refine with the
+            # ACTIVE row fraction before giving up - this sync is the
+            # AQE stat read (Spark reads map output sizes the same way).
+            # Spilled handles keep their full size (capacity_hint None):
+            # a build side that spilled is no broadcast candidate, and
+            # probing it would re-promote batches just for a statistic.
+            total = 0
+            for h in handles:
+                cap = h.capacity_hint
+                frac = (h.rows / cap) if cap else 1.0
+                total += int(h.sizeof() * frac)
+                if total > threshold:
+                    return None
+        if total > threshold:
+            return None
+        self.metrics.create("aqeBroadcastFlip", M.ESSENTIAL).add(1)
+        rbatches = [h.get() for h in handles]
+        rwhole = (concat_device(rbatches) if len(rbatches) > 1 else
+                  rbatches[0] if rbatches else
+                  DeviceBatch.empty(self.right.schema))
+        left_src = self.left
+        if isinstance(left_src, TpuShuffleExchangeExec) \
+                and not getattr(left_src.partitioning, "user_specified",
+                                False) \
+                and not left_src._mesh_eligible():
+            # the exchange existed only for this join's co-partitioning
+            left_src = left_src.child
+        return self._broadcast_stream_thunks(left_src, rwhole)
+
+    def _broadcast_stream_thunks(self, left_src: TpuExec,
+                                 rwhole: DeviceBatch
+                                 ) -> List[DevicePartitionThunk]:
+        """Broadcast-style execution: the resident build side is shared
+        by every stream partition, and each stream partition keeps the
+        shuffled path's discipline — batches register as spillable and
+        join goal-rows at a time (skew safety). Shared by
+        TpuBroadcastHashJoinExec and the AQE runtime flip."""
+        goal = self.conf.batch_size_rows
+        chunkable = self.join_type in self._LEFT_STREAM_TYPES
+
+        def make(lt: DevicePartitionThunk) -> DevicePartitionThunk:
+            def run() -> Iterator[DeviceBatch]:
+                from spark_rapids_tpu.memory import get_device_store
+                store = get_device_store(self.conf)
+                lhandles = [store.register(b) for b in lt()
+                            if b._num_rows != 0]
+                total_l = sum(h.rows for h in lhandles)
+                if not chunkable or total_l <= goal:
+                    lb = [h.get() for h in lhandles]
+                    for h in lhandles:
+                        h.close()
+                    yield from self._join_one(lb, [rwhole])
+                    return
+                i = 0
+                while i < len(lhandles):
+                    chunk = [lhandles[i]]
+                    rows = lhandles[i].rows
+                    i += 1
+                    while i < len(lhandles) and \
+                            rows + lhandles[i].rows <= goal:
+                        rows += lhandles[i].rows
+                        chunk.append(lhandles[i])
+                        i += 1
+                    lb = [h.get() for h in chunk]
+                    for h in chunk:
+                        h.close()
+                    yield from self._join_one(lb, [rwhole])
+            return run
+        return [make(t) for t in device_channel(left_src)]
+
     def device_partitions(self) -> List[DevicePartitionThunk]:
+        flipped = self._aqe_try_broadcast()
+        if flipped is not None:
+            return flipped
         lparts = device_channel(self.left)
         rparts = device_channel(self.right)
         assert len(lparts) == len(rparts), \
@@ -248,16 +351,13 @@ class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
         rbatches: List[DeviceBatch] = []
         for t in device_channel(self.right):
             rbatches.extend(b for b in t() if b._num_rows != 0)
-        # concat the build side ONCE; every stream partition reuses it
-        if len(rbatches) > 1:
-            rbatches = [concat_device(rbatches)]
-
-        def make(lt: DevicePartitionThunk) -> DevicePartitionThunk:
-            def run() -> Iterator[DeviceBatch]:
-                lb = [b for b in lt() if b._num_rows != 0]
-                yield from self._join_one(lb, list(rbatches))
-            return run
-        return [make(lt) for lt in device_channel(self.left)]
+        # concat the build side ONCE (a TpuBroadcastExchangeExec child
+        # already yields its single cached batch); every stream
+        # partition shares it, with the common goal-row chunking
+        rwhole = (concat_device(rbatches) if len(rbatches) > 1 else
+                  rbatches[0] if rbatches else
+                  DeviceBatch.empty(self.right.schema))
+        return self._broadcast_stream_thunks(self.left, rwhole)
 
     def simple_string(self):
         return (f"TpuBroadcastHashJoin {self.join_type} l={self.left_keys} "

@@ -107,6 +107,15 @@ class SpillableBatch:
         return st.rows
 
     @property
+    def capacity_hint(self) -> Optional[int]:
+        """Device capacity WITHOUT promoting a spilled batch; None when
+        the data is off-device (callers treat that conservatively)."""
+        st = self._state
+        if st.tier == TIER_DEVICE and st.device is not None:
+            return st.device.capacity
+        return None
+
+    @property
     def ever_spilled(self) -> bool:
         """True once the batch has been demoted at least once — its slot
         layout/capacity may differ from the originally registered batch."""

@@ -463,10 +463,22 @@ def _conv_exchange(meta, kids):
     return TpuShuffleExchangeExec(p, kids[0], meta.conf)
 
 
+def _allow_aqe_coalesce(kid):
+    """Aggregate/sort/window consumers accept ANY partition count, so
+    their exchange child may coalesce tiny partitions at runtime
+    (GpuCustomShuffleReaderExec role); join inputs must stay
+    co-partitioned and never opt in."""
+    from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+    if isinstance(kid, TpuShuffleExchangeExec):
+        kid.allow_aqe_coalesce = True
+    return kid
+
+
 def _conv_aggregate(meta, kids):
     from spark_rapids_tpu.exec.agg import TpuHashAggregateExec
     w = meta.wrapped
-    return TpuHashAggregateExec(w.grouping, w.aggregates, w.mode, kids[0],
+    return TpuHashAggregateExec(w.grouping, w.aggregates, w.mode,
+                                _allow_aqe_coalesce(kids[0]),
                                 w.slots, meta.conf)
 
 
@@ -479,14 +491,15 @@ def _conv_expand(meta, kids):
 def _conv_sort(meta, kids):
     from spark_rapids_tpu.exec.sort import TpuSortExec
     w = meta.wrapped
-    return TpuSortExec(w.order, w.is_global, kids[0], meta.conf)
+    return TpuSortExec(w.order, w.is_global,
+                       _allow_aqe_coalesce(kids[0]), meta.conf)
 
 
 def _conv_window(meta, kids):
     from spark_rapids_tpu.exec.window import TpuWindowExec
     w = meta.wrapped
     return TpuWindowExec(w.window_exprs, w.partition_spec, w.order_spec,
-                         kids[0], meta.conf)
+                         _allow_aqe_coalesce(kids[0]), meta.conf)
 
 
 def _conv_shuffled_join(meta, kids):
@@ -495,6 +508,11 @@ def _conv_shuffled_join(meta, kids):
     return TpuShuffledHashJoinExec(w.left_keys, w.right_keys, w.join_type,
                                    w.condition, kids[0], kids[1], w.output,
                                    meta.conf, null_safe=w.null_safe)
+
+
+def _conv_broadcast_exchange(meta, kids):
+    from spark_rapids_tpu.exec.exchange import TpuBroadcastExchangeExec
+    return TpuBroadcastExchangeExec(kids[0], meta.conf)
 
 
 def _conv_broadcast_join(meta, kids):
@@ -540,6 +558,10 @@ exec_rule(P.CpuGlobalLimitExec, "global limit by mask",
           convert_fn=_conv_global_limit)
 exec_rule(P.CpuShuffleExchangeExec, "device-partitioned exchange",
           tag_fn=_tag_exchange, convert_fn=_conv_exchange)
+exec_rule(P.CpuBroadcastExchangeExec,
+          "device-resident reusable broadcast "
+          "(GpuBroadcastExchangeExec.scala:280)",
+          convert_fn=_conv_broadcast_exchange)
 exec_rule(P.CpuHashAggregateExec, "sort-segmented device aggregation",
           tag_fn=_tag_aggregate, convert_fn=_conv_aggregate)
 exec_rule(P.CpuExpandExec, "device grouping-sets expansion",

@@ -1136,6 +1136,44 @@ def _gather_nullable(c: HostColumn, idx: np.ndarray) -> HostColumn:
     return out.normalized()
 
 
+class CpuBroadcastExchangeExec(PhysicalPlan):
+    """Reusable broadcast exchange (GpuBroadcastExchangeExec.scala:71,
+    280 role): the build side materializes ONCE behind a lock and is
+    shared by every consumer — all stream partitions of one join, and
+    SEVERAL joins when the reuse pass deduplicates structurally equal
+    broadcast subtrees (Spark's ReuseExchange)."""
+
+    def __init__(self, child: PhysicalPlan):
+        self.children = [child]
+        self._lock = threading.Lock()
+        self._built: Optional[HostBatch] = None
+        self.build_count = 0  # observability: reuse tests pin this
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def materialize(self) -> HostBatch:
+        with self._lock:
+            if self._built is None:
+                self.build_count += 1
+                batches = [b for t in self.child.partitions()
+                           for b in t() if b.num_rows]
+                self._built = (HostBatch.concat(batches) if batches
+                               else HostBatch.empty(self.schema))
+            return self._built
+
+    def partitions(self) -> List[PartitionThunk]:
+        return [lambda: iter([self.materialize()])]
+
+    def simple_string(self):
+        return "BroadcastExchange"
+
+
 class CpuBroadcastHashJoinExec(CpuShuffledHashJoinExec):
     """Build side fully materialized and shared across stream partitions
     (GpuBroadcastHashJoinExec twin; build side = right)."""
@@ -1144,11 +1182,14 @@ class CpuBroadcastHashJoinExec(CpuShuffledHashJoinExec):
         rschema = T.StructType([
             T.StructField(a.name, a.data_type, a.nullable)
             for a in self.right.output])
-        rbatches: List[HostBatch] = []
-        for t in self.right.partitions():
-            rbatches.extend(b for b in t() if b.num_rows)
-        rwhole = (HostBatch.concat(rbatches) if rbatches
-                  else HostBatch.empty(rschema))
+        if isinstance(self.right, CpuBroadcastExchangeExec):
+            rwhole = self.right.materialize()
+        else:
+            rbatches: List[HostBatch] = []
+            for t in self.right.partitions():
+                rbatches.extend(b for b in t() if b.num_rows)
+            rwhole = (HostBatch.concat(rbatches) if rbatches
+                      else HostBatch.empty(rschema))
 
         def make(lt: PartitionThunk) -> PartitionThunk:
             def run() -> Iterator[HostBatch]:

@@ -346,7 +346,8 @@ class Planner:
         if small_right and p.join_type in ("inner", "left", "leftouter",
                                            "leftsemi", "leftanti", "cross"):
             return P.CpuBroadcastHashJoinExec(
-                left_keys, right_keys, p.join_type, residual, left, right,
+                left_keys, right_keys, p.join_type, residual, left,
+                P.CpuBroadcastExchangeExec(right),
                 p.output, null_safe=null_safe)
         n = self.shuffle_partitions
         lex = P.CpuShuffleExchangeExec(P.HashPartitioning(left_keys, n),

@@ -145,6 +145,7 @@ class TpuSparkSession:
             report = RewriteReport()
             physical = apply_overrides(physical, self.conf_obj, report)
             self.last_rewrite_report = report
+        physical = _reuse_broadcast_exchanges(physical)
         if self._capture_enabled:
             self._plan_capture.append(physical)
         return physical
@@ -296,3 +297,59 @@ def _parse_ddl_schema(ddl: str) -> T.StructType:
         name, _, tp = part.strip().partition(" ")
         fields.append(T.StructField(name.strip(), _parse_type(tp.strip())))
     return T.StructType(fields)
+
+
+def _reuse_broadcast_exchanges(plan):
+    """ReuseExchange (GpuBroadcastExchangeExec.scala:280 reuse
+    semantics): structurally equal broadcast subtrees in one query plan
+    collapse to ONE shared node instance, so the build side
+    materializes once no matter how many joins consume it."""
+    from spark_rapids_tpu.exec.exchange import TpuBroadcastExchangeExec
+    from spark_rapids_tpu.sql import physical as P
+
+    seen = {}
+
+    def params(p):
+        # node parameters beyond simple_string: limits, ranges, expr
+        # lists (exprs repr with their ids). Unknown object-valued
+        # attrs key by IDENTITY — conservative: equal-content-but-
+        # distinct objects just skip reuse, never alias wrongly.
+        out = []
+        for k in sorted(vars(p)):
+            if k in ("children", "conf", "metrics") or k.startswith("_"):
+                continue
+            v = vars(p)[k]
+            if isinstance(v, (int, str, bool, float, type(None))):
+                out.append((k, v))
+            elif isinstance(v, (list, tuple)) and all(
+                    isinstance(x, (int, str, bool, float)) for x in v):
+                out.append((k, tuple(v)))
+            elif isinstance(v, E.Expression) or (
+                    isinstance(v, (list, tuple)) and v and all(
+                        isinstance(x, E.Expression) for x in v)):
+                out.append((k, repr(v)))
+            else:
+                out.append((k, id(v)))
+        return tuple(out)
+
+    def sig(p):
+        # simple_string alone is NOT identity (two equal-shaped
+        # LocalScans or Limits print identically); output attr EXPR IDS
+        # plus the node's own parameters are
+        return (type(p).__name__, p.simple_string(), params(p),
+                tuple((a.name, a.expr_id, repr(a.data_type))
+                      for a in p.output),
+                tuple(sig(c) for c in p.children))
+
+    def walk(p):
+        p.children = [walk(c) for c in p.children]
+        if isinstance(p, (P.CpuBroadcastExchangeExec,
+                          TpuBroadcastExchangeExec)):
+            key = (type(p).__name__, sig(p.child))
+            hit = seen.get(key)
+            if hit is not None:
+                return hit
+            seen[key] = p
+        return p
+
+    return walk(plan)

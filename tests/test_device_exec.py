@@ -489,3 +489,80 @@ def test_input_file_name(tmp_path):
         return s.read.parquet(d).select(
             F.input_file_name().alias("f"), "v")
     assert_tpu_and_cpu_equal_collect(q, require_device=False)
+
+
+def _find_exec(plan, name):
+    found = []
+
+    def walk(p):
+        if p.simple_string().startswith(name):
+            found.append(p)
+        for c in p.children:
+            walk(c)
+    walk(plan)
+    return found
+
+
+def test_aqe_runtime_broadcast_flip():
+    """AQE v0 (GpuOverrides.scala:3550 role): a shuffled hash join whose
+    build side MEASURES under the broadcast threshold at exchange
+    materialization flips to a broadcast-style join at runtime — the
+    static estimate (pre-filter) kept it shuffled."""
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+
+    def build(extra_conf):
+        conf = {"spark.rapids.sql.enabled": "true",
+                # static estimate of the right side (pre-filter) is far
+                # above this, so the PLANNER picks a shuffled join;
+                # the filtered runtime bytes land far below it
+                "spark.rapids.sql.autoBroadcastJoinThreshold": "4096"}
+        conf.update(extra_conf)
+        s = TpuSparkSession(conf)
+        l = s.createDataFrame(
+            {"k": [i % 97 for i in range(5000)],
+             "a": list(range(5000))}, "k int, a int", num_partitions=2)
+        r = s.createDataFrame(
+            {"k2": list(range(2000)), "b": list(range(2000))},
+            "k2 int, b long").filter(F.col("k2") < 40)
+        q = l.join(r, F.col("k") == F.col("k2"), "inner")
+        s.start_capture()
+        rows = sorted(map(tuple, q.collect()))
+        plan = s.get_captured_plans()[-1]
+        joins = _find_exec(plan, "TpuShuffledHashJoin")
+        assert joins, plan
+        flips = sum(j.metrics.value("aqeBroadcastFlip") for j in joins)
+        s.stop()
+        return rows, flips
+
+    on_rows, on_flips = build({})
+    off_rows, off_flips = build({"spark.sql.adaptive.enabled": "false"})
+    assert on_rows == off_rows
+    assert on_flips >= 1, "AQE did not flip the small build side"
+    assert off_flips == 0
+
+
+def test_aqe_partition_coalescing():
+    """Tiny post-shuffle partitions coalesce toward the advisory size
+    before the final aggregate (GpuCustomShuffleReaderExec role)."""
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    s = TpuSparkSession({
+        "spark.rapids.sql.enabled": "true",
+        "spark.sql.shuffle.partitions": "8",
+        "spark.rapids.sql.shuffle.devicePartitions": "8",
+    })
+    df = s.createDataFrame(
+        {"k": [i % 50 for i in range(1000)], "v": list(range(1000))},
+        "k int, v long", num_partitions=4)
+    q = df.groupBy("k").agg(F.sum("v").alias("s")).orderBy("k")
+    s.start_capture()
+    rows = [tuple(r) for r in q.collect()]
+    plans = s.get_captured_plans()
+    coalesced = 0
+    for p in plans:
+        for ex in _find_exec(p, "TpuExchange"):
+            coalesced += ex.metrics.value("aqeCoalescedPartitions")
+    s.stop()
+    assert coalesced > 0, "no AQE partition coalescing happened"
+    assert rows == sorted(
+        [(k, sum(v for v in range(1000) if v % 50 == k))
+         for k in range(50)])

@@ -175,3 +175,47 @@ def test_chunked_outer_join_skewed_partition(jt):
             "spark.rapids.sql.autoBroadcastJoinThreshold": "-1",
         },
         expect_execs=["TpuShuffledHashJoin"])
+
+
+def test_broadcast_exchange_reuse_builds_once():
+    """One broadcast exchange node feeds two joins and builds ONCE
+    (GpuBroadcastExchangeExec.scala:280 + ReuseExchange role)."""
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+
+    def run(enabled):
+        s = TpuSparkSession({"spark.rapids.sql.enabled": enabled})
+        fact = s.createDataFrame(
+            {"k": [i % 30 for i in range(2000)],
+             "v": list(range(2000))}, "k int, v long", num_partitions=2)
+        dim = s.createDataFrame(
+            {"k2": list(range(20)),
+             "name": [f"d{i}" for i in range(20)]}, "k2 int, name string")
+        cond = F.col("k") == F.col("k2")
+        q = fact.join(dim, cond, "leftsemi").union(
+            fact.join(dim, cond, "leftanti")).orderBy("v")
+        s.start_capture()
+        rows = [tuple(r) for r in q.collect()]
+        plan = s.get_captured_plans()[-1]
+        nodes = []
+
+        def walk(p):
+            nodes.append(p)
+            for c in p.children:
+                walk(c)
+        walk(plan)
+        bx = [n for n in nodes
+              if "BroadcastExchange" in n.simple_string()]
+        distinct = list({id(n): n for n in bx}.values())
+        builds = sum(
+            n.metrics.value("broadcastBuilds") if hasattr(n, "metrics")
+            else getattr(n, "build_count", 0) for n in distinct)
+        s.stop()
+        return rows, len(bx), len(distinct), builds
+
+    cpu = run("false")
+    tpu = run("true")
+    assert cpu[0] == tpu[0]
+    for rows, refs, distinct, builds in (cpu, tpu):
+        assert refs == 2, "both joins must reference a broadcast exchange"
+        assert distinct == 1, "reuse pass must collapse equal broadcasts"
+        assert builds == 1, "the shared build side must build once"
