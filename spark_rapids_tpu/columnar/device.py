@@ -207,14 +207,50 @@ class DeviceArrayColumn:
         return DeviceArrayColumn(dtype, arrs[0], arrs[1], child, arrs[-1])
 
 
+@dataclass
+class DeviceStructColumn:
+    """Struct column as column-of-columns (the Arrow/cudf struct model,
+    GpuColumnVector.java nested handling): each field is its own device
+    column at the SAME capacity; ``validity`` marks null structs (null
+    structs also null every field slot, kept normalized)."""
+
+    dtype: T.StructType
+    fields: List["AnyDeviceColumn"]
+    validity: jax.Array
+
+    @property
+    def capacity(self) -> int:
+        return self.validity.shape[0]
+
+    def arrays(self) -> Tuple[jax.Array, ...]:
+        out: Tuple[jax.Array, ...] = ()
+        for f in self.fields:
+            out = out + f.arrays()
+        return out + (self.validity,)
+
+    @staticmethod
+    def from_arrays(dtype: T.StructType, arrs: Sequence[jax.Array]
+                    ) -> "DeviceStructColumn":
+        fields = []
+        off = 0
+        for f in dtype.fields:
+            k = column_arity(f.data_type)
+            fields.append(make_column(f.data_type, arrs[off:off + k]))
+            off += k
+        return DeviceStructColumn(dtype, fields, arrs[off])
+
+
 AnyDeviceColumn = Union[DeviceColumn, DeviceStringColumn,
-                        DeviceDecimal128Column, "DeviceArrayColumn"]
+                        DeviceDecimal128Column, "DeviceArrayColumn",
+                        DeviceStructColumn]
 
 
 def column_arity(dtype: T.DataType) -> int:
     """Number of flat arrays a device column of `dtype` carries."""
     if isinstance(dtype, T.ArrayType):
         return 3 + column_arity(dtype.element_type)
+    if isinstance(dtype, T.StructType):
+        return 1 + sum(column_arity(f.data_type) for f in dtype.fields)
     if is_string_like(dtype) or T.is_limb_decimal(dtype):
         return 3  # (chars, lengths, validity) / (hi, lo, validity)
     return 2
@@ -224,6 +260,8 @@ def make_column(dtype: T.DataType, arrs: Sequence[jax.Array]
                 ) -> AnyDeviceColumn:
     if isinstance(dtype, T.ArrayType):
         return DeviceArrayColumn.from_arrays(dtype, arrs)
+    if isinstance(dtype, T.StructType):
+        return DeviceStructColumn.from_arrays(dtype, arrs)
     if is_string_like(dtype):
         return DeviceStringColumn.from_arrays(dtype, arrs)
     if T.is_limb_decimal(dtype):
@@ -368,6 +406,18 @@ def _fetch_arrays(arrays: List[jax.Array]) -> List[np.ndarray]:
 def _np_col_to_host(dt: T.DataType, arrs: List[np.ndarray],
                     idx: np.ndarray) -> HostColumn:
     """Numpy twin of _device_col_to_host over already-fetched arrays."""
+    if isinstance(dt, T.StructType):
+        from spark_rapids_tpu.columnar.host import struct_storage_rows
+        validity = arrs[-1][idx].astype(bool)
+        fcols = []
+        off = 0
+        for f in dt.fields:
+            k = column_arity(f.data_type)
+            fcols.append(_np_col_to_host(f.data_type, arrs[off:off + k],
+                                         idx))
+            off += k
+        return HostColumn(dt, struct_storage_rows(fcols, validity),
+                          validity)
     if isinstance(dt, T.ArrayType):
         starts, lengths, validity = arrs[0], arrs[1], arrs[-1]
         child_arrs = arrs[2:-1]
@@ -443,14 +493,6 @@ def concat_device(batches: Sequence[DeviceBatch]) -> DeviceBatch:
     total = sum(counts)
     cap = bucket_capacity(max(1, total))
     compacted = [compact(b) for b in batches]
-    # normalize string char widths per column (static shape property)
-    char_caps: List[int] = []
-    for ci, f in enumerate(schema.fields):
-        if is_string_like(f.data_type):
-            char_caps.append(max(b.columns[ci].char_cap for b in compacted))
-        else:
-            char_caps.append(0)
-
     flats = []
     specs = []
     for b in compacted:
@@ -459,7 +501,7 @@ def concat_device(batches: Sequence[DeviceBatch]) -> DeviceBatch:
         specs.append(spec)
     shapes = tuple(tuple((a.shape, str(a.dtype)) for a in flat)
                    for flat in flats)
-    key = (shapes, cap, tuple(char_caps))
+    key = (shapes, cap)
     fn = _CONCAT_CACHE.get(key)
     if fn is None:
         n_arrays = len(flats[0])
@@ -467,11 +509,14 @@ def concat_device(batches: Sequence[DeviceBatch]) -> DeviceBatch:
         # capacities) and the output bucket (which can exceed it when
         # inputs are fully active)
         caps_sum = max(sum(b.capacity for b in compacted), cap)
-        # per-array target char width (2-D arrays only)
-        arr_widths: List[int] = []
-        for ci, (dt, n_arr) in enumerate(specs[0]):
-            for k in range(n_arr):
-                arr_widths.append(char_caps[ci])
+        # per-FLAT-ARRAY char width: inputs may disagree on a 2-D byte
+        # matrix width (incl. string fields nested in structs); writes
+        # of a narrower input into the max-width zeros matrix leave the
+        # correct zero padding
+        arr_widths = [
+            max(flats[bi][ai].shape[1] for bi in range(len(flats)))
+            if flats[0][ai].ndim == 2 else 0
+            for ai in range(n_arrays)]
 
         def _fn(counts_arr, *all_flat):
             offs = jnp.concatenate([
@@ -508,6 +553,10 @@ def concat_device(batches: Sequence[DeviceBatch]) -> DeviceBatch:
 
 def mask_col(c: AnyDeviceColumn, keep: jax.Array) -> AnyDeviceColumn:
     """Null out rows outside `keep` (normalized zeros underneath)."""
+    if isinstance(c, DeviceStructColumn):
+        v = c.validity & keep
+        return DeviceStructColumn(c.dtype,
+                                  [mask_col(f, v) for f in c.fields], v)
     if isinstance(c, DeviceArrayColumn):
         v = c.validity & keep
         z = jnp.zeros((), c.starts.dtype)
@@ -622,7 +671,7 @@ def take_columns(columns: Sequence[AnyDeviceColumn], idx: jax.Array,
             # the element pool is shared, not gathered
             arrays += [c.starts, c.lengths, c.validity]
         else:
-            arrays += list(c.arrays())
+            arrays += list(c.arrays())  # structs flatten recursively
     g = fused_take(arrays, idx)
     out: List[AnyDeviceColumn] = []
     off = 0
@@ -654,6 +703,13 @@ def take_columns(columns: Sequence[AnyDeviceColumn], idx: jax.Array,
                 hi = jnp.where(validity, hi, z)
                 lo = jnp.where(validity, lo, z)
             out.append(DeviceDecimal128Column(c.dtype, hi, lo, validity))
+        elif isinstance(c, DeviceStructColumn):
+            k = column_arity(c.dtype)
+            sc = DeviceStructColumn.from_arrays(c.dtype, g[off:off + k])
+            off += k
+            if valid_at is not None:
+                sc = mask_col(sc, valid_at)
+            out.append(sc)
         else:
             data, validity = g[off:off + 2]
             off += 2

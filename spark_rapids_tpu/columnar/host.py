@@ -52,6 +52,7 @@ class HostColumn:
         dec_scale = (self.dtype.scale
                      if isinstance(self.dtype, T.DecimalType) else None)
         is_array = isinstance(self.dtype, T.ArrayType)
+        is_struct = isinstance(self.dtype, T.StructType)
         epoch = datetime.date(1970, 1, 1)
         ts_epoch = datetime.datetime(1970, 1, 1)
         if T.is_limb_decimal(self.dtype):
@@ -70,6 +71,9 @@ class HostColumn:
                 if is_array:
                     out.append([_from_storage(x, self.dtype.element_type)
                                 for x in v])
+                    continue
+                if is_struct:
+                    out.append(_from_storage(tuple(v), self.dtype))
                     continue
                 if is_bool:
                     v = bool(v)
@@ -112,6 +116,11 @@ class HostColumn:
             hi, lo = I.from_pyints(ints)
             return HostColumn(dtype, np.stack([hi, lo], axis=1), validity)
         np_dt = T.numpy_dtype(dtype)
+        if isinstance(dtype, T.StructType):
+            data = np.empty(n, dtype=object)
+            for i, v in enumerate(values):
+                data[i] = () if v is None else _to_storage(v, dtype)
+            return HostColumn(dtype, data, validity)
         if isinstance(dtype, T.ArrayType):
             # canonical element representation is STORAGE form (date ->
             # days, timestamp -> micros, decimal -> unscaled int), like
@@ -152,7 +161,7 @@ class HostColumn:
         """Zero out invalid slots for deterministic comparison/hashing."""
         out = self.copy()
         inv = ~out.validity
-        if isinstance(self.dtype, T.ArrayType):
+        if isinstance(self.dtype, (T.ArrayType, T.StructType)):
             for i in np.nonzero(inv)[0]:
                 out.data[i] = ()
         elif T.is_limb_decimal(self.dtype):
@@ -164,12 +173,48 @@ class HostColumn:
         return out
 
 
+def struct_field_values(c: "HostColumn", fi: int) -> List[Any]:
+    """Field ``fi``'s storage values out of a struct HostColumn (None
+    for null fields/structs/short tuples) — the single copy of the
+    subtle guard shared by serde, transfer staging, and hashing."""
+    return [c.data[r][fi]
+            if c.validity[r] and len(c.data[r]) > fi else None
+            for r in range(len(c.data))]
+
+
+def struct_storage_rows(field_cols: List["HostColumn"],
+                        validity: np.ndarray) -> np.ndarray:
+    """Field HostColumns -> object array of struct STORAGE tuples
+    (unscaled ints for limb decimals, None for null fields, () for null
+    structs). The one implementation shared by the device download,
+    CreateNamedStruct, and the arrow conversion."""
+    n = len(validity)
+    field_vals = []
+    for fc in field_cols:
+        if T.is_limb_decimal(fc.dtype):
+            from spark_rapids_tpu.ops import int128 as I
+            ints = I.to_pyints(fc.data[:, 0], fc.data[:, 1])
+            field_vals.append([
+                int(ints[i]) if fc.validity[i] else None
+                for i in range(n)])
+        else:
+            field_vals.append([
+                (fc.data[i].item() if isinstance(fc.data[i], np.generic)
+                 else fc.data[i]) if fc.validity[i] else None
+                for i in range(n)])
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        out[i] = (tuple(fv[i] for fv in field_vals)
+                  if validity[i] else ())
+    return out
+
+
 def _zero_for(dtype: T.DataType) -> Any:
     if isinstance(dtype, T.BooleanType):
         return False
     if isinstance(dtype, (T.FloatType, T.DoubleType)):
         return 0.0
-    if isinstance(dtype, T.ArrayType):
+    if isinstance(dtype, (T.ArrayType, T.StructType)):
         return ()
     return 0
 
@@ -177,6 +222,14 @@ def _zero_for(dtype: T.DataType) -> Any:
 def _to_storage(v: Any, dtype: T.DataType) -> Any:
     import datetime
     import decimal
+    if isinstance(dtype, T.StructType):
+        # storage form: tuple of field storage values (None = null field)
+        if isinstance(v, dict):
+            vals = [v.get(f.name) for f in dtype.fields]
+        else:
+            vals = list(v)
+        return tuple(None if x is None else _to_storage(x, f.data_type)
+                     for x, f in zip(vals, dtype.fields))
     if isinstance(dtype, T.DateType) and isinstance(v, datetime.date):
         return (v - datetime.date(1970, 1, 1)).days
     if isinstance(dtype, T.TimestampType) and isinstance(v, datetime.datetime):
@@ -204,6 +257,11 @@ def _from_storage(v: Any, dtype: T.DataType) -> Any:
     import decimal
     if v is None:
         return None
+    if isinstance(dtype, T.StructType):
+        return tuple(_from_storage(x, f.data_type)
+                     for x, f in zip(v, dtype.fields))
+    if isinstance(dtype, T.ArrayType):
+        return [_from_storage(x, dtype.element_type) for x in v]
     if isinstance(v, np.generic):
         v = v.item()
     if isinstance(dtype, T.BooleanType):

@@ -82,6 +82,15 @@ def _enc_type(dt: T.DataType, out: bytearray) -> None:
         out.append(101)
         _enc_type(dt.element_type, out)
         return
+    if isinstance(dt, T.StructType):
+        out.append(102)
+        out += struct.pack("<H", len(dt.fields))
+        for f in dt.fields:
+            nb = f.name.encode("utf-8")
+            out += struct.pack("<H", len(nb))
+            out += nb
+            _enc_type(f.data_type, out)
+        return
     for i, atom in enumerate(_ATOM_TAGS):
         if dt == atom:
             out.append(i)
@@ -96,6 +105,18 @@ def _dec_type(buf: bytes, i: int) -> Tuple[T.DataType, int]:
     if tag == 101:
         et, j = _dec_type(buf, i + 1)
         return T.ArrayType(et), j
+    if tag == 102:
+        (nf,) = struct.unpack_from("<H", buf, i + 1)
+        j = i + 3
+        fields = []
+        for _ in range(nf):
+            (ln,) = struct.unpack_from("<H", buf, j)
+            j += 2
+            name = bytes(buf[j:j + ln]).decode("utf-8")
+            j += ln
+            ft, j = _dec_type(buf, j)
+            fields.append(T.StructField(name, ft))
+        return T.StructType(fields), j
     return _ATOM_TAGS[tag], i + 1
 
 
@@ -146,6 +167,17 @@ def _enc_column(c: HostColumn, dt: T.DataType, out: List[bytes]) -> None:
         out.append(vbits)
         out.append(lengths.tobytes())
         _enc_column(child, dt.element_type, out)
+        return
+    if isinstance(dt, T.StructType):
+        from spark_rapids_tpu.columnar.host import struct_field_values
+        from spark_rapids_tpu.columnar.transfer import \
+            _col_from_storage_values
+        out.append(struct.pack("<B", 4))
+        out.append(vbits)
+        for fi, f in enumerate(dt.fields):
+            _enc_column(_col_from_storage_values(
+                struct_field_values(c, fi), f.data_type),
+                f.data_type, out)
         return
     if isinstance(dt, (T.StringType, T.BinaryType)):
         is_bin = isinstance(dt, T.BinaryType)
@@ -200,6 +232,20 @@ def _dec_column(buf: memoryview, i: int, n: int, dt: T.DataType
                 for v in child_py[off:off + ln]) if validity[r] else ()
             off += ln
         return HostColumn(dt, data, validity), i
+    if kind == 4:
+        i += 1
+        validity = np.unpackbits(
+            np.frombuffer(buf, np.uint8, nvb, i),
+            bitorder="little")[:n].astype(bool)
+        i += nvb
+        # decoded field columns are ALREADY storage-form: zip directly
+        from spark_rapids_tpu.columnar.host import struct_storage_rows
+        fcols = []
+        for f in dt.fields:
+            fc, i = _dec_column(buf, i, n, f.data_type)
+            fcols.append(fc)
+        return HostColumn(dt, struct_storage_rows(fcols, validity),
+                          validity), i
     if kind == 1:
         (blob_len,) = struct.unpack_from("<I", buf, i + 1)
         i += 5

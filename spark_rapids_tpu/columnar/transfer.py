@@ -297,6 +297,29 @@ def _build_decode(layout: Tuple, n: int, cap: int) -> Callable:
 PACKED_MIN_ROWS = 1 << 16
 
 
+def _col_from_storage_values(vals, dt: T.DataType):
+    """Storage-form python values (None = null) -> HostColumn, without
+    the from_pylist value conversion (dates/decimals already sit in
+    storage ints inside struct tuples)."""
+    from spark_rapids_tpu.columnar.host import HostColumn
+    n = len(vals)
+    validity = np.array([v is not None for v in vals], dtype=bool)
+    if T.is_limb_decimal(dt):
+        from spark_rapids_tpu.ops import int128 as I
+        hi, lo = I.from_pyints([0 if v is None else int(v) for v in vals])
+        return HostColumn(dt, np.stack([hi, lo], axis=1), validity)
+    np_dt = T.numpy_dtype(dt)
+    if np_dt == np.dtype(object):
+        data = np.empty(n, dtype=object)
+        for i, v in enumerate(vals):
+            data[i] = v if v is not None else ""
+        return HostColumn(dt, data, validity)
+    fill = False if np_dt == np.dtype(bool) else np_dt.type(0)
+    data = np.array([fill if v is None else v for v in vals],
+                    dtype=np_dt)
+    return HostColumn(dt, data, validity)
+
+
 def _stage_column(c, dt: T.DataType, cap: int) -> List[np.ndarray]:
     """Full-width staging buffers for one column, matching the device
     column's arrays() layout; recurses into array element pools."""
@@ -322,6 +345,19 @@ def _stage_column(c, dt: T.DataType, cap: int) -> List[np.ndarray]:
         return [starts, lengths] + \
             _stage_column(child_col, dt.element_type, child_cap) + \
             [validity]
+    if isinstance(dt, T.StructType):
+        validity = np.zeros(cap, dtype=bool)
+        validity[:n] = c.validity
+        parts: List[np.ndarray] = []
+        from spark_rapids_tpu.columnar.host import struct_field_values
+        for fi, f in enumerate(dt.fields):
+            # field values are ALREADY storage-form (struct tuples hold
+            # storage ints); build the host column without re-converting
+            parts.extend(_stage_column(
+                _col_from_storage_values(
+                    struct_field_values(c, fi)[:n], f.data_type),
+                f.data_type, cap))
+        return parts + [validity]
     if D.is_string_like(dt):
         ch, ln = _encode_strings(c.data, c.validity, n,
                                  isinstance(dt, T.BinaryType))
@@ -363,7 +399,7 @@ def prepare_upload(batch, cap: int):
     a producer thread pack batch k+1 while batch k's bytes move."""
     n = batch.num_rows
     if n < PACKED_MIN_ROWS or any(
-            isinstance(f.data_type, T.ArrayType)
+            isinstance(f.data_type, (T.ArrayType, T.StructType))
             for f in batch.schema.fields):
         return _stage_direct(batch, cap)
     words, extras, layout = pack_batch(batch)

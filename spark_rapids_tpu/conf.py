@@ -140,10 +140,12 @@ EVENT_LOG_DIR = conf("spark.rapids.sql.eventLog.dir").doc(
 
 SHUFFLE_MODE = conf("spark.rapids.shuffle.mode").doc(
     "Exchange transport: 'inprocess' (materialized partition lists, the "
-    "JVM sort-shuffle analogue) or 'ici' (HBM-resident all-to-all over "
+    "JVM sort-shuffle analogue), 'ici' (HBM-resident all-to-all over "
     "the active jax device mesh — the RapidsShuffleManager/UCX "
-    "replacement, GpuShuffleEnv.scala:26 role). 'ici' activates a mesh "
-    "over all visible devices at session start.").string("inprocess")
+    "replacement, GpuShuffleEnv.scala:26 role; activates a mesh over "
+    "all visible devices at session start), or 'external' (SRTB-"
+    "serialized partitions over a shared directory — the cross-process "
+    "host-staged/DCN transport skeleton).").string("inprocess")
 
 SHUFFLE_ICI_DEVICES = conf("spark.rapids.shuffle.ici.devices").doc(
     "Number of devices in the ICI shuffle mesh (0 = all visible "

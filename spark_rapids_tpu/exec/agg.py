@@ -135,7 +135,14 @@ def is_device_agg(grouping: List[E.AttributeReference],
     """Tagging helper: None if the whole aggregate can run on device."""
     from spark_rapids_tpu import device_caps as DC
     for g in grouping:
-        if isinstance(g.data_type, (T.ArrayType, T.MapType, T.StructType)):
+        dt = g.data_type
+        if isinstance(dt, T.StructType):
+            from spark_rapids_tpu import typesig as TS
+            r = TS.common_tpu_struct.support(dt)
+            if r:
+                return f"grouping key: {r}"
+            continue  # flat-field structs group on device (TimeWindow)
+        if isinstance(dt, (T.ArrayType, T.MapType)):
             return "nested grouping keys are not supported on TPU"
     for e in aggregates:
         if isinstance(e, E.Alias) and isinstance(e.child,

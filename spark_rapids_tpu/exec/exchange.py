@@ -15,6 +15,7 @@ partition-id kernel.
 
 from __future__ import annotations
 
+import os
 import threading
 from functools import partial
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
@@ -322,8 +323,51 @@ class TpuShuffleExchangeExec(TpuExec):
         with self._lock:  # consumers race here under taskParallelism
             if self._cache is not None:
                 return self._cache
-            self._cache = self._materialize_inner()
+            cache = self._materialize_inner()
+            from spark_rapids_tpu.conf import SHUFFLE_MODE
+            if str(self.conf.get(SHUFFLE_MODE)).lower() == "external":
+                cache = self._external_roundtrip(cache)
+            self._cache = cache
             return self._cache
+
+    def _external_roundtrip(self, cache):
+        """shuffle.mode=external: ship every partition through the SRTB
+        cross-process leg (serialize -> shared-fs files -> deserialize ->
+        re-upload). In one process this is a filesystem loopback — the
+        DCN/host-staged transport skeleton
+        (RapidsShuffleInternalManagerBase.scala:76 role)."""
+        from spark_rapids_tpu.columnar.device import DeviceBatch
+        from spark_rapids_tpu.conf import SHUFFLE_COMPRESSION_CODEC
+        from spark_rapids_tpu.memory import SpillableBatch, get_device_store
+        from spark_rapids_tpu.parallel import external_shuffle as XS
+        codec = str(self.conf.get(SHUFFLE_COMPRESSION_CODEC))
+        sdir = XS.new_shuffle_dir()
+        store = get_device_store(self.conf)
+        with self.metrics.timed("externalShuffleWriteTime"):
+            host_parts = []
+            for part in cache:
+                hb = []
+                for item in part:
+                    b = item.get() if isinstance(item, SpillableBatch) \
+                        else item
+                    hb.append(b.to_host())
+                    if isinstance(item, SpillableBatch):
+                        item.close()
+                host_parts.append(hb)
+            XS.write_map_output(sdir, "0", host_parts, codec)
+        out = []
+        with self.metrics.timed("externalShuffleReadTime"):
+            for pid in range(len(cache)):
+                part = []
+                for hb in XS.read_partition(sdir, pid):
+                    part.append(store.register(DeviceBatch.from_host(hb)))
+                out.append(part)
+        self.metrics.create("externalShuffleBytes", M.ESSENTIAL).add(
+            sum(os.path.getsize(os.path.join(sdir, f))
+                for f in os.listdir(sdir)))
+        import shutil
+        shutil.rmtree(sdir, ignore_errors=True)
+        return out
 
     def _materialize_inner(self) -> List[List]:
         from spark_rapids_tpu.memory import get_device_store

@@ -51,6 +51,12 @@ def arrow_type_to_sql(at: pa.DataType) -> T.DataType:
         return T.LongT
     if pa.types.is_list(at) or pa.types.is_large_list(at):
         return T.ArrayType(arrow_type_to_sql(at.value_type))
+    if pa.types.is_struct(at):
+        return T.StructType([
+            T.StructField(at.field(i).name,
+                          arrow_type_to_sql(at.field(i).type),
+                          at.field(i).nullable)
+            for i in range(at.num_fields)])
     raise TypeError(f"unsupported arrow type {at}")
 
 
@@ -81,6 +87,10 @@ def sql_type_to_arrow(dt: T.DataType) -> pa.DataType:
         return pa.decimal128(dt.precision, dt.scale)
     if isinstance(dt, T.ArrayType):
         return pa.list_(sql_type_to_arrow(dt.element_type))
+    if isinstance(dt, T.StructType):
+        return pa.struct([
+            pa.field(f.name, sql_type_to_arrow(f.data_type), f.nullable)
+            for f in dt.fields])
     raise TypeError(f"unsupported sql type {dt}")
 
 
@@ -133,6 +143,13 @@ def arrow_column_to_host(arr: pa.ChunkedArray | pa.Array,
             return HostColumn(dt, np.stack([hi, lo], axis=1), validity)
         return HostColumn(dt, lo, validity)
     np_dt = T.numpy_dtype(dt)
+    if isinstance(dt, T.StructType):
+        # recurse per field, then zip into storage tuples
+        from spark_rapids_tpu.columnar.host import struct_storage_rows
+        fields = [arrow_column_to_host(arr.field(i), f.data_type)
+                  for i, f in enumerate(dt.fields)]
+        return HostColumn(dt, struct_storage_rows(fields, validity),
+                          validity)
     if isinstance(dt, T.ArrayType):
         la = arr
         if pa.types.is_large_list(la.type):
@@ -234,6 +251,23 @@ def host_column_to_arrow(c: HostColumn) -> pa.Array:
             return pa.Array.from_buffers(at, len(lo), [vbits, buf],
                                          null_count=int(mask.sum()))
         return pa.Array.from_buffers(at, len(lo), [None, buf])
+    if isinstance(dt, T.StructType):
+        fields = []
+        for fi, f in enumerate(dt.fields):
+            fvals = [None if (not ok or len(v) <= fi or v[fi] is None)
+                     else v[fi]
+                     for v, ok in zip(c.data.tolist(),
+                                      c.validity.tolist())]
+            fields.append(host_column_to_arrow(
+                HostColumn.from_pylist(
+                    [None if x is None else _storage_to_py(x, f.data_type)
+                     for x in fvals], f.data_type)))
+        if mask is not None:
+            return pa.StructArray.from_arrays(
+                fields, names=[f.name for f in dt.fields],
+                mask=pa.array(mask))
+        return pa.StructArray.from_arrays(
+            fields, names=[f.name for f in dt.fields])
     if isinstance(dt, T.TimestampType):
         a = pa.array(c.data.astype(np.int64), type=pa.int64(), mask=mask)
         return a.cast(at)
@@ -241,6 +275,14 @@ def host_column_to_arrow(c: HostColumn) -> pa.Array:
         a = pa.array(c.data.astype(np.int32), type=pa.int32(), mask=mask)
         return a.cast(at)
     return pa.array(c.data, type=at, mask=mask)
+
+
+def _storage_to_py(v, dt: T.DataType):
+    """storage value -> python value from_pylist re-accepts (dates/
+    decimals stay as storage ints would be double-converted; route
+    through _from_storage for exactness)."""
+    from spark_rapids_tpu.columnar.host import _from_storage
+    return _from_storage(v, dt)
 
 
 def host_batch_to_arrow(b: HostBatch) -> pa.Table:

@@ -66,6 +66,13 @@ jax.tree_util.register_pytree_node(
     lambda c: ((c.hi, c.lo, c.validity), c.dtype),
     lambda dt, ch: DeviceDecimal128Column(dt, *ch))
 
+from spark_rapids_tpu.columnar.device import DeviceStructColumn  # noqa: E402
+
+jax.tree_util.register_pytree_node(
+    DeviceStructColumn,
+    lambda c: ((tuple(c.fields), c.validity), c.dtype),
+    lambda dt, ch: DeviceStructColumn(dt, list(ch[0]), ch[1]))
+
 
 # ---------------------------------------------------------------------------
 # Structural keys for the compile cache
@@ -353,6 +360,8 @@ def _limb_decimal_gate(e: E.Expression) -> Optional[str]:
             E.Abs, E.Cast, E.EqualTo, E.EqualNullSafe, E.LessThan,
             E.LessThanOrEqual, E.GreaterThan, E.GreaterThanOrEqual,
             E.IsNull, E.IsNotNull, E.Alias, E.Literal,
+            # struct create/extract just move limb arrays around
+            E.CreateNamedStruct, E.GetStructField,
         }
     if type(e) in _LIMB_OK_EXPRS:
         return None
@@ -378,7 +387,18 @@ def leaf_support(e: E.Expression) -> Optional[str]:
     """Shared leaf (attribute/bound-reference) type-support check used by
     both tagging sites (overrides.check_expr_tree and is_device_expr)."""
     from spark_rapids_tpu import typesig as TS
-    r = TS.common_tpu.support(e.data_type)
+    from spark_rapids_tpu.sql import types as _T
+    dt = e.data_type
+    if isinstance(dt, _T.StructType):
+        # struct leaves pass through as column-of-columns when every
+        # field is device-representable and non-nested
+        for f in dt.fields:
+            r = TS.common_tpu.support(f.data_type)
+            if r:
+                name = getattr(e, "name", repr(e))
+                return f"attribute {name}: struct field {f.name}: {r}"
+        return None
+    r = TS.common_tpu.support(dt)
     if r:
         name = getattr(e, "name", repr(e))
         return f"attribute {name}: {r}"
@@ -2593,6 +2613,49 @@ def _h_array_contains(e: E.ArrayContains, ctx: Ctx) -> DeviceColumn:
     found = cnt > 0
     validity = ac.validity & (found | (ncnt == 0))
     return _normalized(T.BooleanT, found, validity)
+
+
+@handles(E.TimeWindow)
+def _h_time_window(e: E.TimeWindow, ctx: Ctx) -> AnyDeviceColumn:
+    """Tumbling window assignment as elementwise micros arithmetic ->
+    struct<start, end> (TimeWindow rule role)."""
+    from spark_rapids_tpu.columnar.device import (DeviceColumn as DC,
+                                                  DeviceStructColumn)
+    c = dev_eval(e.children[0], ctx)
+    ts = c.data.astype(jnp.int64)
+    w = jnp.int64(e.window_us)
+    delta = ts - jnp.int64(e.start_us)
+    # floorMod: jnp.mod follows the divisor sign like Math.floorMod
+    start = ts - jnp.mod(delta, w)
+    end = start + w
+    v = c.validity
+    z = jnp.int64(0)
+    fields = [DC(T.TimestampT, jnp.where(v, start, z), v),
+              DC(T.TimestampT, jnp.where(v, end, z), v)]
+    return DeviceStructColumn(e.data_type, fields, v)
+
+
+@handles(E.CreateNamedStruct)
+def _h_create_named_struct(e: E.CreateNamedStruct,
+                           ctx: Ctx) -> AnyDeviceColumn:
+    """struct(...) as column-of-columns (complexTypeCreator.scala
+    GpuCreateNamedStruct role): the evaluated children ARE the field
+    columns; the struct itself is never null."""
+    from spark_rapids_tpu.columnar.device import DeviceStructColumn
+    cols = [dev_eval(c, ctx) for c in e.children]
+    validity = jnp.ones(ctx.capacity, dtype=jnp.bool_)
+    return DeviceStructColumn(e.data_type, cols, validity)
+
+
+@handles(E.GetStructField)
+def _h_get_struct_field(e: E.GetStructField, ctx: Ctx) -> AnyDeviceColumn:
+    """struct.field (complexTypeExtractors.scala GpuGetStructField):
+    the field column masked by the struct's own validity."""
+    from spark_rapids_tpu.columnar.device import (DeviceStructColumn,
+                                                  mask_col)
+    sc = dev_eval(e.children[0], ctx)
+    assert isinstance(sc, DeviceStructColumn)
+    return mask_col(sc.fields[e.ordinal], sc.validity)
 
 
 @handles(E.CreateArray)

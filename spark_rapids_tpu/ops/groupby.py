@@ -132,11 +132,20 @@ def limb_words(col) -> List[jax.Array]:
 def value_words(col: AnyDeviceColumn,
                 has_nans: Optional[bool] = None) -> List[jax.Array]:
     """Comparison words for ANY column type (strings included)."""
-    from spark_rapids_tpu.columnar.device import DeviceDecimal128Column
+    from spark_rapids_tpu.columnar.device import (DeviceDecimal128Column,
+                                                  DeviceStructColumn)
     if isinstance(col, DeviceStringColumn):
         return pack_string_words(col) + [col.lengths.astype(jnp.uint64)]
     if isinstance(col, DeviceDecimal128Column):
         return limb_words(col)
+    if isinstance(col, DeviceStructColumn):
+        # field-wise words (each prefixed by its validity) order structs
+        # field-major, which is also exact equality
+        words: List[jax.Array] = []
+        for f in col.fields:
+            words.append(f.validity)
+            words.extend(value_words(f, has_nans))
+        return words
     return rank_words(col, has_nans)
 
 
@@ -158,11 +167,14 @@ def grouping_subkeys(col: AnyDeviceColumn,
     """Sub-key arrays whose joint equality == Spark group-key equality.
     Validity is included so null forms its own group; invalid slots hold
     normalized zeros so their data words tie."""
-    from spark_rapids_tpu.columnar.device import DeviceDecimal128Column
+    from spark_rapids_tpu.columnar.device import (DeviceDecimal128Column,
+                                                  DeviceStructColumn)
     if isinstance(col, DeviceStringColumn):
         return [col.validity, col.lengths] + pack_string_words(col)
     if isinstance(col, DeviceDecimal128Column):
         return [col.validity] + limb_words(col)
+    if isinstance(col, DeviceStructColumn):
+        return [col.validity] + value_words(col, has_nans)
     return [col.validity] + rank_words(col, has_nans)
 
 

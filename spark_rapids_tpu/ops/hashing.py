@@ -130,6 +130,15 @@ def hash_device_column(col, seed: jax.Array) -> jax.Array:
     elif isinstance(dt, T.DecimalType) and dt.precision <= 18:
         h = hash_long(col.data.astype(jnp.int64), seed)
     else:
+        from spark_rapids_tpu.columnar.device import DeviceStructColumn
+        if isinstance(col, DeviceStructColumn):
+            # fold fields left-to-right with the running hash as seed;
+            # null STRUCT rows keep the incoming seed (twin of the host
+            # _hash_column struct branch)
+            h = seed
+            for f in col.fields:
+                h = hash_device_column(f, h)
+            return jnp.where(col.validity, h, seed)
         raise TypeError(f"cannot hash {dt} on device")
     return jnp.where(col.validity, h, seed)
 

@@ -91,6 +91,12 @@ expr_rule(E.ArrayContains, checks=TS.expr_checks(TS.common_tpu,
                                                  TS.common_tpu_nested))
 expr_rule(E.CreateArray, checks=TS.expr_checks(TS.common_tpu_nested,
                                                TS.common_tpu))
+expr_rule(E.CreateNamedStruct,
+          checks=TS.expr_checks(TS.common_tpu_nested, TS.common_tpu))
+expr_rule(E.GetStructField,
+          checks=TS.expr_checks(TS.common_tpu, TS.common_tpu_nested))
+expr_rule(E.TimeWindow,
+          checks=TS.expr_checks(TS.common_tpu_nested, TS.common_tpu))
 
 # leaves that are valid in any device expression tree without a handler
 _LEAF_OK = (E.AttributeReference,)
@@ -283,9 +289,28 @@ def _tag_filter(meta: ExecMeta) -> None:
 
 
 def _tag_exchange(meta: ExecMeta) -> None:
+    # (struct PAYLOAD columns are vetted by the exchange's
+    # common_tpu_struct signature, which recurses into fields)
     p = meta.wrapped.partitioning
     if isinstance(p, P.HashPartitioning):
         for e in p.exprs:
+            dt = getattr(e, "data_type", None)
+            if isinstance(dt, (T.ArrayType, T.MapType)):
+                meta.will_not_work(
+                    "nested hash partition keys run on CPU")
+            elif isinstance(dt, T.StructType):
+                from spark_rapids_tpu import typesig as TS
+                r = TS.common_tpu_struct.support(dt)
+                if r:
+                    meta.will_not_work(f"hash partition key: {r}")
+                elif any(isinstance(f.data_type, T.DecimalType)
+                         and f.data_type.precision > 18
+                         for f in dt.fields):
+                    # the variable-length big-decimal byte hash has no
+                    # device twin (same gate as top-level decimal128)
+                    meta.will_not_work(
+                        "decimal128 struct fields in hash partition "
+                        "keys run on CPU")
             r = check_expr_tree(e, meta.conf)
             if r:
                 meta.will_not_work(r)
@@ -351,7 +376,8 @@ def _tag_aggregate(meta: ExecMeta) -> None:
         meta.will_not_work(r)
         return
     for g in node.grouping:
-        rr = TS.common_tpu.support(g.data_type)
+        # flat-field structs group on device (TimeWindow keys)
+        rr = TS.common_tpu_struct.support(g.data_type)
         if rr:
             meta.will_not_work(f"grouping key {g.name}: {rr}")
     if not meta.conf.get(ENABLE_FLOAT_AGG):
@@ -557,16 +583,22 @@ exec_rule(P.CpuLocalLimitExec, "per-partition limit by mask",
 exec_rule(P.CpuGlobalLimitExec, "global limit by mask",
           convert_fn=_conv_global_limit)
 exec_rule(P.CpuShuffleExchangeExec, "device-partitioned exchange",
+          checks=TS.ExecChecks(TS.common_tpu_struct),
+          input_sig=TS.common_tpu_struct,
           tag_fn=_tag_exchange, convert_fn=_conv_exchange)
 exec_rule(P.CpuBroadcastExchangeExec,
           "device-resident reusable broadcast "
           "(GpuBroadcastExchangeExec.scala:280)",
           convert_fn=_conv_broadcast_exchange)
 exec_rule(P.CpuHashAggregateExec, "sort-segmented device aggregation",
+          checks=TS.ExecChecks(TS.common_tpu_struct),
+          input_sig=TS.common_tpu_struct,
           tag_fn=_tag_aggregate, convert_fn=_conv_aggregate)
 exec_rule(P.CpuExpandExec, "device grouping-sets expansion",
           tag_fn=_tag_expand, convert_fn=_conv_expand)
 exec_rule(P.CpuSortExec, "device lexsort over encoded sort keys",
+          checks=TS.ExecChecks(TS.common_tpu_struct),
+          input_sig=TS.common_tpu_struct,
           tag_fn=_tag_sort, convert_fn=_conv_sort)
 from spark_rapids_tpu.sql.window_exec import CpuWindowExec  # noqa: E402
 exec_rule(CpuWindowExec, "segment-scan device window functions",

@@ -1,0 +1,92 @@
+"""Cross-process shuffle leg v0 over the SRTB serialized-batch format.
+
+The host-staged / DCN skeleton (RapidsShuffleInternalManagerBase.scala:76
+writer-side, GpuColumnarBatchSerializer.scala:50 format role): map tasks
+write each output partition as SRTB blocks to a SHARED directory
+(`map{m}_part{p}.srtb` + a commit marker, the shuffle-file contract of
+Spark's sort shuffle), and reduce tasks — in ANY process — read every
+map's block for their partition. Atomicity comes from write-to-temp +
+rename; the compression codec (`spark.rapids.shuffle.compression.codec`)
+rides the SRTB header, so readers need no out-of-band config.
+
+`spark.rapids.shuffle.mode=external` routes every device exchange
+through this leg (serialize after the device split, deserialize +
+re-upload on the reduce side). In one process that is a loopback through
+the filesystem — deliberately: it is the transport-correctness skeleton
+a true multi-host DCN backend plugs into, testable without hardware
+(SURVEY.md §2.3 TPU mapping note; the tests drive a REAL second
+process over the same directory).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import uuid
+from typing import List, Optional
+
+from spark_rapids_tpu.columnar.host import HostBatch
+from spark_rapids_tpu.columnar.serde import (deserialize_batch,
+                                             serialize_batch)
+
+
+def write_map_output(shuffle_dir: str, map_id: str,
+                     parts: List[List[HostBatch]],
+                     codec: str = "none") -> None:
+    """Persist one map task's output: one SRTB file per non-empty
+    partition, committed atomically (temp + rename) so concurrent
+    readers never observe torn files."""
+    os.makedirs(shuffle_dir, exist_ok=True)
+    for pid, batches in enumerate(parts):
+        batches = [b for b in batches if b.num_rows]
+        if not batches:
+            continue
+        payload = b"".join(
+            len(blk).to_bytes(4, "little") + blk
+            for blk in (serialize_batch(b, codec) for b in batches))
+        final = os.path.join(shuffle_dir, f"map{map_id}_part{pid}.srtb")
+        tmp = final + f".tmp.{uuid.uuid4().hex[:8]}"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, final)
+    marker = os.path.join(shuffle_dir, f"map{map_id}.done")
+    tmp = marker + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("ok")
+    os.replace(tmp, marker)
+
+
+def map_outputs_done(shuffle_dir: str) -> List[str]:
+    """Committed map ids in the directory."""
+    if not os.path.isdir(shuffle_dir):
+        return []
+    return sorted(f[3:-5] for f in os.listdir(shuffle_dir)
+                  if f.startswith("map") and f.endswith(".done"))
+
+
+def read_partition(shuffle_dir: str, pid: int,
+                   map_ids: Optional[List[str]] = None
+                   ) -> List[HostBatch]:
+    """Every committed map's blocks for partition ``pid`` (the
+    RapidsCachingReader remote-fetch role, filesystem transport)."""
+    out: List[HostBatch] = []
+    for mid in (map_ids if map_ids is not None
+                else map_outputs_done(shuffle_dir)):
+        path = os.path.join(shuffle_dir, f"map{mid}_part{pid}.srtb")
+        if not os.path.exists(path):
+            continue
+        with open(path, "rb") as f:
+            data = f.read()
+        off = 0
+        while off < len(data):
+            ln = int.from_bytes(data[off:off + 4], "little")
+            off += 4
+            out.append(deserialize_batch(data[off:off + ln]))
+            off += ln
+    return out
+
+
+def new_shuffle_dir(base: Optional[str] = None) -> str:
+    root = base or os.path.join(tempfile.gettempdir(), "srt-shuffle")
+    os.makedirs(root, exist_ok=True)
+    return tempfile.mkdtemp(prefix="exch-", dir=root)

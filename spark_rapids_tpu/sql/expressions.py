@@ -2594,6 +2594,21 @@ def _hash_column(c: HostColumn, seed: np.ndarray) -> np.ndarray:
                 raw = v.to_bytes(bl // 8 + 1, "big", signed=True)
                 out[i] = murmur3.hash_bytes_one(raw, int(seed[i]))
         return out
+    elif isinstance(dt, T.StructType):
+        # Spark hashes a struct by folding murmur3 over its fields with
+        # the running hash as each field's seed; null fields keep the
+        # seed (HashExpression.computeHash on struct)
+        out = seed.copy()
+        from spark_rapids_tpu.columnar.host import struct_field_values
+        from spark_rapids_tpu.columnar.transfer import \
+            _col_from_storage_values
+        for fi, f in enumerate(dt.fields):
+            fc = _col_from_storage_values(
+                struct_field_values(c, fi), f.data_type)
+            # only valid STRUCT rows advance their hash
+            nh = _hash_column(fc, out)
+            out = np.where(c.validity, nh, out)
+        return out
     else:
         raise TypeError(f"cannot hash {dt}")
     return np.where(c.validity, h, seed)
@@ -2629,6 +2644,145 @@ class CreateArray(Expression):
                 for c in cols)
         return HostColumn(self.data_type, out,
                           np.ones(batch.num_rows, dtype=bool))
+
+
+class CreateNamedStruct(Expression):
+    """struct(c1, c2, ...) / named_struct: never-null struct whose
+    fields keep the children's names and null-ness
+    (complexTypeCreator.scala GpuCreateNamedStruct role)."""
+
+    def __init__(self, names: List[str], children: List[Expression]):
+        self.names = list(names)
+        self.children = list(children)
+
+    @property
+    def pretty_name(self) -> str:
+        return "named_struct"
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.StructType([
+            T.StructField(n, c.data_type, True)
+            for n, c in zip(self.names, self.children)])
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        from spark_rapids_tpu.columnar.host import struct_storage_rows
+        cols = [c.eval(batch) for c in self.children]
+        n = batch.num_rows
+        validity = np.ones(n, dtype=bool)
+        return HostColumn(self.data_type,
+                          struct_storage_rows(cols, validity), validity)
+
+
+class GetStructField(UnaryExpression):
+    """struct.field extraction (complexTypeExtractors.scala
+    GpuGetStructField role). The ordinal resolves lazily from the field
+    name so the expression can be built over an unresolved column."""
+
+    def __init__(self, child: Expression, ordinal: Optional[int] = None,
+                 name: Optional[str] = None):
+        assert ordinal is not None or name is not None
+        self.children = [child]
+        self._ordinal = ordinal
+        self.field_name = name
+
+    @property
+    def ordinal(self) -> int:
+        if self._ordinal is None:
+            dt = self.children[0].data_type
+            self._ordinal = next(
+                i for i, f in enumerate(dt.fields)
+                if f.name == self.field_name)
+        return self._ordinal
+
+    @property
+    def pretty_name(self) -> str:
+        if self.field_name is not None:
+            return self.field_name
+        return self.children[0].data_type.fields[self.ordinal].name
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.children[0].data_type.fields[self.ordinal].data_type
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.children[0].eval(batch)
+        dt = self.data_type
+        n = batch.num_rows
+        validity = np.zeros(n, dtype=bool)
+        if T.is_limb_decimal(dt):
+            from spark_rapids_tpu.ops import int128 as I
+            ints = []
+            for i in range(n):
+                v = (c.data[i][self.ordinal]
+                     if c.validity[i] and len(c.data[i]) > self.ordinal
+                     else None)
+                validity[i] = v is not None
+                ints.append(0 if v is None else int(v))
+            hi, lo = I.from_pyints(ints)
+            return HostColumn(dt, np.stack([hi, lo], axis=1), validity)
+        np_dt = T.numpy_dtype(dt)
+        if np_dt == np.dtype(object):
+            data = np.full(n, "" if not isinstance(
+                dt, (T.ArrayType, T.StructType)) else None, dtype=object)
+            for i in range(n):
+                if c.validity[i] and len(c.data[i]) > self.ordinal:
+                    v = c.data[i][self.ordinal]
+                    if v is not None:
+                        data[i] = v
+                        validity[i] = True
+                if data[i] is None:
+                    data[i] = ()
+        else:
+            data = np.zeros(n, dtype=np_dt)
+            for i in range(n):
+                if c.validity[i] and len(c.data[i]) > self.ordinal:
+                    v = c.data[i][self.ordinal]
+                    if v is not None:
+                        data[i] = v
+                        validity[i] = True
+        return HostColumn(dt, data, validity).normalized()
+
+
+class TimeWindow(UnaryExpression):
+    """window(ts, duration[, slide, start]) for TUMBLING windows
+    (slide == duration): struct<start:timestamp, end:timestamp> with
+    start = ts - floorMod(ts - startTime, duration) in microseconds
+    (Spark TimeWindow / GpuOverrides TimeWindow rule role). Sliding
+    windows (slide < duration) emit multiple rows per input and are not
+    supported."""
+
+    def __init__(self, child: Expression, window_us: int,
+                 start_us: int = 0):
+        self.children = [child]
+        self.window_us = int(window_us)
+        self.start_us = int(start_us)
+
+    @property
+    def pretty_name(self) -> str:
+        return "window"
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.StructType([T.StructField("start", T.TimestampT, True),
+                             T.StructField("end", T.TimestampT, True)])
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        c = self.children[0].eval(batch)
+        ts = c.data.astype(np.int64)
+        w = np.int64(self.window_us)
+        # numpy % already floor-mods like Spark's Math.floorMod
+        start = ts - np.mod(ts - np.int64(self.start_us), w)
+        end = start + w
+        out = np.empty(batch.num_rows, dtype=object)
+        for i in range(batch.num_rows):
+            out[i] = ((int(start[i]), int(end[i]))
+                      if c.validity[i] else ())
+        return HostColumn(self.data_type, out, c.validity.copy())
 
 
 class Size(UnaryExpression):

@@ -146,6 +146,9 @@ class Column:
     def rlike(self, pattern: str):
         return Column(E.RLike(self.expr, E.Literal(pattern)))
 
+    def getField(self, name: str):
+        return Column(E.GetStructField(self.expr, name=name))
+
     def between(self, low, high):
         return (self >= low) & (self <= high)
 
@@ -343,6 +346,48 @@ def avg(c) -> Column:
 
 
 mean = avg
+
+
+def _parse_duration_us(s: str) -> int:
+    import re as _re
+    m = _re.fullmatch(
+        r"\s*(\d+)\s*(microsecond|millisecond|second|minute|hour|day|"
+        r"week)s?\s*", s)
+    if not m:
+        raise ValueError(f"cannot parse interval {s!r}")
+    n = int(m.group(1))
+    mult = {"microsecond": 1, "millisecond": 1000, "second": 10**6,
+            "minute": 60 * 10**6, "hour": 3600 * 10**6,
+            "day": 86400 * 10**6, "week": 7 * 86400 * 10**6}[m.group(2)]
+    return n * mult
+
+
+def window(c, windowDuration: str, slideDuration=None,
+           startTime=None) -> Column:
+    """Tumbling time window: struct<start, end> (Spark TimeWindow;
+    sliding windows are unsupported)."""
+    w = _parse_duration_us(windowDuration)
+    if w <= 0:
+        raise ValueError("window duration must be positive")
+    if slideDuration is not None and \
+            _parse_duration_us(slideDuration) != w:
+        raise NotImplementedError(
+            "sliding time windows (slide != duration) are not supported")
+    start = _parse_duration_us(startTime) if startTime else 0
+    return Column(E.TimeWindow(_to_col_expr(c), w, start))
+
+
+def struct(*cols) -> Column:
+    exprs = [_to_col_expr(c) for c in cols]
+    names = [getattr(e, "name", None) or f"col{i + 1}"
+             for i, e in enumerate(exprs)]
+    return Column(E.CreateNamedStruct(names, exprs))
+
+
+def named_struct(*name_col_pairs) -> Column:
+    names = [str(x) for x in name_col_pairs[0::2]]
+    exprs = [_to_col_expr(c) for c in name_col_pairs[1::2]]
+    return Column(E.CreateNamedStruct(names, exprs))
 
 
 def monotonically_increasing_id() -> Column:

@@ -662,6 +662,7 @@ _FUNCTIONS = {
     "first": F.first, "last": F.last,
     "collect_list": F.collect_list, "collect_set": F.collect_set,
     "monotonically_increasing_id": F.monotonically_increasing_id,
+    "window": lambda c, *a: F.window(c, *[_lit_value(x) for x in a]),
     "spark_partition_id": F.spark_partition_id,
     "input_file_name": F.input_file_name,
     "stddev": F.stddev_samp, "stddev_samp": F.stddev_samp,

@@ -101,6 +101,17 @@ class TypeSig:
             r = self.support(dt.element_type)
             if r:
                 return f"array element: {r}"
+        if tag == STRUCT:
+            # struct fields must be flat device-representable scalars:
+            # nested fields (array pools are not row-aligned) never ride
+            # the column-of-columns layout
+            for f in dt.fields:
+                if tag_of(f.data_type) in (ARRAY, MAP, STRUCT):
+                    return (f"struct field {f.name}: nested types in "
+                            "structs are not supported")
+                r = self.support(f.data_type)
+                if r:
+                    return f"struct field {f.name}: {r}"
         return None
 
     def supports_all(self, dts) -> Optional[str]:
@@ -132,7 +143,10 @@ common_tpu = numeric + DECIMAL_128 + _sig(BOOLEAN, DATE, TIMESTAMP,
 common_tpu_with_null = common_tpu + _sig(NULL)
 # transitional operators (project/filter/generate/transitions) can CARRY
 # array columns whose elements are common; the heavy operators cannot
-common_tpu_nested = common_tpu + _sig(ARRAY)
+common_tpu_nested = common_tpu + _sig(ARRAY, STRUCT)
+# exchanges can carry STRUCTS (row-aligned flat arrays split cleanly)
+# but not arrays (the shared element pool is not row-aligned)
+common_tpu_struct = common_tpu + _sig(STRUCT)
 all_types = common_tpu + DECIMAL_128 + _sig(NULL, ARRAY, MAP, STRUCT)
 
 

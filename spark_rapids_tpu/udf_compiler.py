@@ -9,16 +9,20 @@ performs is rebuilt through the SAME operator overloads user queries go
 through — type coercion (decimal rules included) comes for free, and
 the resulting tree runs wherever any expression runs, device included.
 
-Scope (v0): arithmetic (+ - * / — NOT %, whose Python sign semantics
-differ from SQL Remainder), comparisons, boolean and/or/not, ternary
-conditionals, and constants over the UDF's arguments. Anything else (calls, globals, loops, subscripts) makes
-``compile_udf`` return None and the UDF stays a row-at-a-time Python
-evaluation — the same silent-fallback contract as the reference
-(Plugin.scala:27-37).
+Scope (v1): arithmetic (+ - * / and % with Python's
+sign-follows-divisor semantics built from SQL Remainder), comparisons,
+boolean and/or/not, ternary conditionals, LOCAL VARIABLES
+(STORE_FAST/LOAD_FAST dataflow, per-branch scoped), builtin calls
+(abs/min/max/len/float), ``math.*`` calls, and string methods
+(upper/lower/strip/lstrip/rstrip/startswith/endswith/replace). Anything
+else (loops, subscripts, other calls) makes ``compile_udf`` return None
+and the UDF stays a row-at-a-time Python evaluation — the same
+silent-fallback contract as the reference (Plugin.scala:27-37).
 
 Note the documented semantic shift the reference also makes: a compiled
-UDF gets SQL NULL semantics (null propagates through operators) instead
-of Python's None handling inside the lambda.
+UDF gets SQL NULL semantics (null propagates through operators; min/max
+become Least/Greatest, which SKIP nulls) instead of Python's None
+handling inside the lambda (which would raise TypeError).
 """
 
 from __future__ import annotations
@@ -55,10 +59,12 @@ def compile_udf(fn, arg_exprs: List[E.Expression],
     instrs = list(dis.get_instructions(fn))
     by_offset = {ins.offset: i for i, ins in enumerate(instrs)}
     try:
-        out = _exec(instrs, by_offset, 0, [], params)
-    except (_Unsupported, IndexError, KeyError, TypeError):
+        out = _exec(instrs, by_offset, 0, [], params,
+                    getattr(fn, "__globals__", {}))
+    except (_Unsupported, IndexError, KeyError, TypeError,
+            AttributeError):
         return None
-    if out is None:
+    if not isinstance(out, Column):
         return None
     expr = out.expr
     try:
@@ -69,7 +75,73 @@ def compile_udf(fn, arg_exprs: List[E.Expression],
     return expr
 
 
-def _exec(instrs, by_offset, i: int, stack: List, params) -> Optional:
+_NULL = object()  # the NULL slot LOAD_GLOBAL/PUSH_NULL leave for CALL
+
+
+def _py_mod(a, b):
+    """Python's sign-follows-divisor ``%`` from SQL Remainder (whose
+    sign follows the dividend): ((a % b) + b) % b — exact for INTEGRAL
+    operands across all sign combinations (the Pmod-style correction).
+    Float operands stay untranslated: the ``r + b`` step can round a
+    tiny remainder away."""
+    for c in (a, b):
+        try:
+            if not T.is_integral(c.expr.data_type):
+                raise _Unsupported("float %")
+        except _Unsupported:
+            raise
+        except Exception:
+            raise _Unsupported("% operand type unknown")
+    return ((a % b) + b) % b
+
+
+def _apply_global(name: str, args):
+    from spark_rapids_tpu.sql import functions as F
+    if name == "abs" and len(args) == 1:
+        return F.abs(args[0])
+    if name == "min" and len(args) >= 2:
+        return F.least(*args)
+    if name == "max" and len(args) >= 2:
+        return F.greatest(*args)
+    if name == "len" and len(args) == 1:
+        return F.length(args[0])
+    if name == "float" and len(args) == 1:
+        from spark_rapids_tpu.sql.functions import Column
+        return Column(E.Cast(args[0].expr, T.DoubleT))
+    raise _Unsupported(f"call to {name}")
+
+
+_MATH_FNS = ("sqrt", "exp", "log", "log10", "log2", "log1p", "expm1",
+             "floor", "ceil", "sin", "cos", "tan", "atan2", "hypot",
+             "pow", "cbrt", "radians", "degrees")
+
+
+def _apply_math(name: str, args):
+    from spark_rapids_tpu.sql import functions as F
+    if name not in _MATH_FNS:
+        raise _Unsupported(f"math.{name}")
+    return getattr(F, name)(*args)
+
+
+def _apply_method(name: str, recv, args):
+    from spark_rapids_tpu.sql import functions as F
+    if name == "upper" and not args:
+        return F.upper(recv)
+    if name == "lower" and not args:
+        return F.lower(recv)
+    # strip/lstrip/rstrip are NOT translated: Python strips all
+    # whitespace, SQL trim strips spaces only
+    if name == "startswith" and len(args) == 1:
+        return recv.startswith(args[0])
+    if name == "endswith" and len(args) == 1:
+        return recv.endswith(args[0])
+    if name == "replace" and len(args) == 2:
+        return F.replace(recv, args[0], args[1])
+    raise _Unsupported(f"method .{name}")
+
+
+def _exec(instrs, by_offset, i: int, stack: List, params,
+          fn_globals=None) -> Optional:
     from spark_rapids_tpu.sql import functions as F
     from spark_rapids_tpu.sql.functions import Column
 
@@ -86,12 +158,71 @@ def _exec(instrs, by_offset, i: int, stack: List, params) -> Optional:
             continue
         if op in ("LOAD_FAST", "LOAD_FAST_CHECK", "LOAD_FAST_BORROW"):
             stack.append(params[ins.argval])
+        elif op == "STORE_FAST":
+            v = stack.pop()
+            if not isinstance(v, Column):
+                raise _Unsupported("STORE_FAST of non-expression")
+            params[ins.argval] = v
         elif op == "LOAD_CONST":
             stack.append(lit(ins.argval))
         elif op == "RETURN_CONST":
             return lit(ins.argval)
         elif op == "RETURN_VALUE":
             return stack.pop()
+        elif op == "PUSH_NULL":
+            stack.append(_NULL)
+        elif op == "LOAD_GLOBAL":
+            # shadowed builtins must NOT silently become SQL builtins:
+            # the name has to resolve to the real object
+            import builtins as _bi
+            import math as _math
+            name = ins.argval
+            resolved = (fn_globals or {}).get(
+                name, getattr(_bi, name, None))
+            expected = _math if name == "math" else \
+                getattr(_bi, name, None)
+            if resolved is not expected or expected is None:
+                raise _Unsupported(f"global {name} is shadowed/unknown")
+            if ins.argrepr.startswith("NULL + "):
+                stack.append(_NULL)
+            stack.append(("global", name))
+        elif op in ("LOAD_ATTR", "LOAD_METHOD"):
+            base = stack.pop()
+            if ins.argrepr.startswith("NULL|self + ") \
+                    or op == "LOAD_METHOD":
+                # method call shape: [..., marker, self]
+                if not isinstance(base, Column):
+                    raise _Unsupported("method on non-expression")
+                stack.append(("method", ins.argval))
+                stack.append(base)
+            else:
+                if not (isinstance(base, tuple) and base[0] == "global"
+                        and base[1] == "math"):
+                    raise _Unsupported(f"attribute {ins.argval}")
+                stack.append(("mathfn", ins.argval))
+        elif op in ("CALL", "CALL_FUNCTION", "CALL_METHOD"):
+            argc = ins.arg or 0
+            args = [stack.pop() for _ in range(argc)][::-1]
+            f = stack.pop()
+            if any(not isinstance(a, Column) for a in args):
+                raise _Unsupported("non-expression call argument")
+            if isinstance(f, Column):
+                # method shape: f is the receiver, marker beneath
+                marker = stack.pop()
+                if not (isinstance(marker, tuple)
+                        and marker[0] == "method"):
+                    raise _Unsupported("unsupported callable")
+                stack.append(_apply_method(marker[1], f, args))
+            elif isinstance(f, tuple) and f[0] == "global":
+                if stack and stack[-1] is _NULL:
+                    stack.pop()
+                stack.append(_apply_global(f[1], args))
+            elif isinstance(f, tuple) and f[0] == "mathfn":
+                if stack and stack[-1] is _NULL:
+                    stack.pop()
+                stack.append(_apply_math(f[1], args))
+            else:
+                raise _Unsupported("unsupported callable")
         elif op == "BINARY_OP":
             r = stack.pop()
             a = stack.pop()
@@ -104,9 +235,10 @@ def _exec(instrs, by_offset, i: int, stack: List, params) -> Optional:
                 stack.append(a * r)
             elif sym == "/":
                 stack.append(a / r)
-            # '%' is NOT translated: Python's sign-follows-divisor
-            # remainder differs from SQL Remainder on negative
-            # operands, so modulo lambdas stay row-at-a-time Python
+            elif sym == "%":
+                stack.append(_py_mod(a, r))
+            # '//' stays untranslated: floor(a / b) via double loses
+            # exactness past 2^53 and returns the wrong TYPE for floats
             else:
                 raise _Unsupported(sym)
         elif op == "COMPARE_OP":
@@ -130,8 +262,10 @@ def _exec(instrs, by_offset, i: int, stack: List, params) -> Optional:
             cond = stack.pop()
             tgt = by_offset[ins.argval]
             taken_first = op.endswith("IF_FALSE")
-            then_v = _exec(instrs, by_offset, i + 1, list(stack), params)
-            else_v = _exec(instrs, by_offset, tgt, list(stack), params)
+            then_v = _exec(instrs, by_offset, i + 1, list(stack),
+                           dict(params), fn_globals)
+            else_v = _exec(instrs, by_offset, tgt, list(stack),
+                           dict(params), fn_globals)
             if then_v is None or else_v is None:
                 raise _Unsupported(op)
             if not taken_first:
@@ -141,15 +275,18 @@ def _exec(instrs, by_offset, i: int, stack: List, params) -> Optional:
             # `and` / `or`: left kept on one path, popped on the other
             cond = stack.pop()
             tgt = by_offset[ins.argval]
-            rest = _exec(instrs, by_offset, i + 1, list(stack), params)
+            rest = _exec(instrs, by_offset, i + 1, list(stack),
+                         dict(params), fn_globals)
             if rest is None:
                 raise _Unsupported(op)
             if op == "JUMP_IF_FALSE_OR_POP":
                 short = _exec(instrs, by_offset, tgt,
-                              list(stack) + [cond], params)
+                              list(stack) + [cond], dict(params),
+                              fn_globals)
                 return F.when(cond, rest).otherwise(short)
             short = _exec(instrs, by_offset, tgt,
-                          list(stack) + [cond], params)
+                          list(stack) + [cond], dict(params),
+                          fn_globals)
             return F.when(cond, short).otherwise(rest)
         else:
             raise _Unsupported(op)

@@ -1,0 +1,96 @@
+"""Cross-process shuffle leg v0 (round-5): SRTB-serialized partitions
+over a shared directory (RapidsShuffleInternalManagerBase.scala:76 +
+GpuColumnarBatchSerializer.scala:50 roles). A REAL second process writes
+the map outputs; this process reads them back — the DCN/host-staged
+transport skeleton, testable without multi-host hardware."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+from spark_rapids_tpu.sql import functions as F
+
+from tests.harness import assert_tpu_and_cpu_equal_collect
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_two_process_shuffle_roundtrip(tmp_path):
+    """Process A partitions rows by the engine's hash partitioning and
+    writes SRTB files (zstd codec); THIS process reads each partition
+    back and verifies the union matches exactly and every row landed in
+    its murmur3 partition."""
+    sdir = str(tmp_path / "shuffle")
+    writer = textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {REPO!r})
+        import jax; jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+        from spark_rapids_tpu.parallel import external_shuffle as XS
+        from spark_rapids_tpu.sql import expressions as E
+        from spark_rapids_tpu.sql import physical as P
+        from spark_rapids_tpu.sql import types as T
+        rng = np.random.default_rng(7)
+        n = 5000
+        schema = T.StructType([T.StructField("k", T.LongT),
+                               T.StructField("s", T.StringT)])
+        k = rng.integers(0, 1000, n)
+        s = np.array([f"v{{i % 37}}" for i in range(n)], dtype=object)
+        batch = HostBatch(schema, [HostColumn.all_valid(k, T.LongT),
+                                   HostColumn.all_valid(s, T.StringT)], n)
+        part = P.HashPartitioning([E.AttributeReference("k", T.LongT)], 4)
+        bound = [E.BoundReference(0, T.LongT, True)]
+        pids = part.partition_ids(batch, bound)
+        parts = [[batch.take(np.nonzero(pids == p)[0])] for p in range(4)]
+        XS.write_map_output({sdir!r}, "A", parts, codec="zstd")
+        print("WROTE", sum(p[0].num_rows for p in parts))
+    """)
+    r = subprocess.run([sys.executable, "-c", writer],
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "WROTE 5000" in r.stdout
+
+    # reduce side (THIS process): read every partition, verify placement
+    # and exact content
+    import numpy as np
+
+    from spark_rapids_tpu.parallel import external_shuffle as XS
+    from spark_rapids_tpu.sql import expressions as E
+    from spark_rapids_tpu.sql import physical as P
+    from spark_rapids_tpu.sql import types as T
+    assert XS.map_outputs_done(sdir) == ["A"]
+    got = []
+    bound = [E.BoundReference(0, T.LongT, True)]
+    for pid in range(4):
+        for hb in XS.read_partition(sdir, pid):
+            pids = P.HashPartitioning(
+                [E.AttributeReference("k", T.LongT)], 4
+            ).partition_ids(hb, bound)
+            assert (pids == pid).all(), f"row in wrong partition {pid}"
+            got.extend(zip(hb.columns[0].data.tolist(),
+                           hb.columns[1].data.tolist()))
+    rng = np.random.default_rng(7)
+    n = 5000
+    k = rng.integers(0, 1000, n)
+    expect = sorted(zip(k.tolist(),
+                        [f"v{i % 37}" for i in range(n)]))
+    assert sorted(got) == expect
+
+
+def test_external_shuffle_mode_dual_session():
+    """shuffle.mode=external routes every device exchange through the
+    SRTB filesystem leg; results stay bit-identical and the codec is
+    exercised (externalShuffleBytes metric present)."""
+    def q(s):
+        df = s.createDataFrame(
+            {"k": [i % 23 for i in range(3000)],
+             "v": list(range(3000))}, "k int, v long", num_partitions=3)
+        return df.groupBy("k").agg(F.sum("v").alias("sv"),
+                                   F.count("v").alias("cv")).orderBy("k")
+    assert_tpu_and_cpu_equal_collect(
+        q,
+        conf={"spark.rapids.shuffle.mode": "external",
+              "spark.rapids.shuffle.compression.codec": "zstd"},
+        expect_execs=["TpuExchange", "TpuHashAggregate"])

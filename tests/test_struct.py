@@ -1,0 +1,120 @@
+"""Struct type support end-to-end (round-5): DeviceStructColumn as
+column-of-columns (complexTypeCreator.scala / complexTypeExtractors.scala
+/ GpuColumnVector.java nested-handling roles). Struct columns ride
+scan -> project (create/extract) -> exchange -> sort -> collect on
+device; structs with nested fields tag back to CPU."""
+
+import decimal
+
+import pytest
+
+from spark_rapids_tpu.sql import functions as F
+
+from tests.datagen import IntegerGen, StringGen, gen_batch
+from tests.harness import assert_tpu_and_cpu_equal_collect
+
+
+def _write_struct_data(s, path):
+    import os
+    if os.path.exists(path):
+        return
+    df = s.createDataFrame(
+        gen_batch([("a", IntegerGen(nullable=True)),
+                   ("s", StringGen(nullable=True))], 400, 13),
+        num_partitions=2)
+    df = df.select(F.struct(F.col("a"), F.col("s")).alias("st"),
+                   F.col("a").alias("k"))
+    df.write.mode("overwrite").parquet(path)
+
+
+def test_struct_scan_project_exchange_collect(tmp_path):
+    path = str(tmp_path / "structs")
+
+    def q(s):
+        _write_struct_data(s, path)
+        df = s.read.parquet(path)
+        return (df.select(F.col("st").getField("a").alias("fa"),
+                          F.col("st").getField("s").alias("fs"),
+                          F.struct(F.col("k"),
+                                   F.col("st").getField("a")).alias("g"),
+                          F.col("k"))
+                .repartition(3).orderBy("k", "fa", "fs"))
+    assert_tpu_and_cpu_equal_collect(
+        q, expect_execs=["TpuProject", "TpuExchange", "TpuSort"])
+
+
+def test_struct_create_extract_with_decimal():
+    def q(s):
+        df = s.createDataFrame(
+            {"a": [1, None, 3, 4],
+             "d": [decimal.Decimal("1.25"), None,
+                   decimal.Decimal("-7.50"), decimal.Decimal("0.00")]},
+            "a int, d decimal(25,2)")
+        st = F.struct(F.col("a"), F.col("d")).alias("st")
+        return s.createDataFrame(
+            {"x": [0]}, "x int") if False else df.select(
+            st, F.col("a")).select(
+            F.col("st").getField("d").alias("fd"),
+            F.col("st").getField("a").alias("fa")).orderBy("fa")
+    assert_tpu_and_cpu_equal_collect(q, expect_execs=["TpuProject"])
+
+
+def test_struct_in_filter_and_groupby_passthrough():
+    """Structs pass through filters; aggregations on struct GROUPING
+    keys tag to CPU (is_device_agg nested-key rule)."""
+    def q(s):
+        df = s.createDataFrame(
+            {"a": list(range(100)), "b": [i % 5 for i in range(100)]},
+            "a int, b int")
+        return (df.select(F.struct(F.col("b")).alias("st"), "a", "b")
+                .filter(F.col("a") > 10)
+                .select("b", F.col("st").getField("b").alias("fb"))
+                .orderBy("b", "fb"))
+    assert_tpu_and_cpu_equal_collect(q, expect_execs=["TpuFilter"])
+
+
+def test_nested_struct_falls_back():
+    from tests.harness import assert_tpu_fallback_collect
+
+    def q(s):
+        df = s.createDataFrame({"a": [1, 2, 3]}, "a int")
+        inner = F.struct(F.col("a"))
+        return df.select(F.struct(inner.alias("i")).alias("o"), "a") \
+            .repartition(2)
+    assert_tpu_fallback_collect(q, fallback_exec="CpuShuffleExchangeExec")
+
+
+def test_time_window_tumbling_device_groupby():
+    """window(ts, '10 minutes') -> struct<start,end> groups ON DEVICE:
+    struct grouping keys ride field-wise equality words and the struct
+    murmur3 fold matches CPU bit-for-bit (TimeWindow rule +
+    HashExpression struct semantics)."""
+    import datetime
+    import random
+    random.seed(1)
+    base = datetime.datetime(2024, 5, 1)
+    rows = {"ts": [base + datetime.timedelta(
+                seconds=random.randint(0, 86400)) for _ in range(500)],
+            "v": list(range(500))}
+
+    def q(s):
+        df = s.createDataFrame(rows, "ts timestamp, v long")
+        return (df.groupBy(F.window("ts", "10 minutes").alias("w"))
+                .agg(F.sum("v").alias("sv"), F.count("v").alias("c"))
+                .orderBy(F.col("sv")))
+    assert_tpu_and_cpu_equal_collect(
+        q, expect_execs=["TpuHashAggregate", "TpuExchange"])
+
+
+def test_struct_groupby_key_device():
+    def q(s):
+        df = s.createDataFrame(
+            {"a": [1, 2, 1, None, 2, 1], "b": ["x", "y", "x", "x", "y",
+                                               None],
+             "v": [1, 2, 3, 4, 5, 6]}, "a int, b string, v long")
+        return (df.select(F.struct(F.col("a"), F.col("b")).alias("k"),
+                          "v")
+                .groupBy("k").agg(F.sum("v").alias("sv"))
+                .orderBy("sv"))
+    assert_tpu_and_cpu_equal_collect(
+        q, expect_execs=["TpuHashAggregate", "TpuExchange"])
