@@ -1,0 +1,70 @@
+"""ML/ETL interop: hand a DataFrame's columns to JAX ML code with the
+data STAYING in HBM (ColumnarRdd.convert role, ColumnarRdd.scala:42 /
+InternalColumnarRddConverter — the reference's XGBoost zero-copy hook).
+
+``to_device_batches(df)`` executes the DataFrame's device plan and
+returns the per-partition ``DeviceBatch`` lists directly — jax arrays an
+ML training step consumes without a host round trip. ``to_jax_arrays``
+flattens further to one dict of column-name -> jax array (concatenated,
+active rows only, fixed-width columns).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.device import (DeviceBatch, DeviceColumn,
+                                              concat_device, compact)
+
+
+def to_device_batches(df) -> List[List[DeviceBatch]]:
+    """Execute ``df``'s plan on device and return HBM-resident batches
+    per partition. Requires the session's TPU rewrite to place the plan
+    root on device (a fallback root raises — mirroring
+    ColumnarRdd.convert's requirement that the plan is columnar)."""
+    from spark_rapids_tpu.exec.base import (TpuColumnarToRowExec, TpuExec)
+    physical = df.session.plan_physical(df.plan)
+    node = physical
+    if isinstance(node, TpuColumnarToRowExec):
+        node = node.child
+    if not isinstance(node, TpuExec):
+        raise ValueError(
+            "plan root is not device-resident; enable "
+            "spark.rapids.sql.enabled and check "
+            "spark.rapids.sql.explain=NOT_ON_GPU for fallbacks")
+    return [list(thunk()) for thunk in node.device_partitions()]
+
+
+def to_jax_arrays(df) -> Dict[str, jax.Array]:
+    """Column-name -> one concatenated jax array of the ACTIVE rows
+    (fixed-width columns only; the compacted prefix is sliced to the
+    exact row count, so shapes are data-dependent but final). Columns
+    containing NULLs raise — their normalized-zero slots would be
+    indistinguishable from real zeros in ML code; filter them out
+    (``col.isNotNull()``) or use to_device_batches, whose validity
+    masks survive."""
+    parts = to_device_batches(df)
+    batches = [b for part in parts for b in part if b.row_count()]
+    if not batches:
+        return {f.name: jnp.zeros(0) for f in df.schema.fields}
+    whole = compact(concat_device(batches) if len(batches) > 1
+                    else batches[0])
+    n = whole.row_count()
+    out: Dict[str, jax.Array] = {}
+    for f, c in zip(whole.schema.fields, whole.columns):
+        if not isinstance(c, DeviceColumn):
+            raise TypeError(
+                f"column {f.name}: only fixed-width columns convert to "
+                "plain jax arrays; use to_device_batches for "
+                "strings/decimals/nested")
+        import numpy as _np
+        if not bool(_np.asarray(jnp.all(c.validity[:n]))):
+            raise ValueError(
+                f"column {f.name} contains NULLs; filter them "
+                "(isNotNull) or use to_device_batches to keep the "
+                "validity mask")
+        out[f.name] = c.data[:n]
+    return out

@@ -252,16 +252,12 @@ def host_column_to_arrow(c: HostColumn) -> pa.Array:
                                          null_count=int(mask.sum()))
         return pa.Array.from_buffers(at, len(lo), [None, buf])
     if isinstance(dt, T.StructType):
-        fields = []
-        for fi, f in enumerate(dt.fields):
-            fvals = [None if (not ok or len(v) <= fi or v[fi] is None)
-                     else v[fi]
-                     for v, ok in zip(c.data.tolist(),
-                                      c.validity.tolist())]
-            fields.append(host_column_to_arrow(
-                HostColumn.from_pylist(
-                    [None if x is None else _storage_to_py(x, f.data_type)
-                     for x in fvals], f.data_type)))
+        from spark_rapids_tpu.columnar.host import struct_field_values
+        from spark_rapids_tpu.columnar.transfer import \
+            _col_from_storage_values
+        fields = [host_column_to_arrow(_col_from_storage_values(
+            struct_field_values(c, fi), f.data_type))
+            for fi, f in enumerate(dt.fields)]
         if mask is not None:
             return pa.StructArray.from_arrays(
                 fields, names=[f.name for f in dt.fields],
@@ -275,14 +271,6 @@ def host_column_to_arrow(c: HostColumn) -> pa.Array:
         a = pa.array(c.data.astype(np.int32), type=pa.int32(), mask=mask)
         return a.cast(at)
     return pa.array(c.data, type=at, mask=mask)
-
-
-def _storage_to_py(v, dt: T.DataType):
-    """storage value -> python value from_pylist re-accepts (dates/
-    decimals stay as storage ints would be double-converted; route
-    through _from_storage for exactness)."""
-    from spark_rapids_tpu.columnar.host import _from_storage
-    return _from_storage(v, dt)
 
 
 def host_batch_to_arrow(b: HostBatch) -> pa.Table:

@@ -187,6 +187,26 @@ def plan_scan_units(fmt: str, files: List[tuple]) -> List[ScanUnit]:
                     f, rgm.total_byte_size, [rg], pv, stats))
             if meta.num_row_groups == 0:
                 units.append(ScanUnit(f, 0, [], pv))
+    elif fmt == "orc":
+        # stripe-granularity units (GpuOrcScanBase.scala:66 stripe-copy
+        # role): each stripe decodes independently, so a multi-stripe
+        # file fans out across the task pool like parquet row groups
+        import pyarrow.orc as po
+        for f, pv in files:
+            try:
+                of = po.ORCFile(f)
+                ns = of.nstripes
+            except Exception:
+                units.append(ScanUnit(f, os.path.getsize(f),
+                                      part_values=pv))
+                continue
+            if ns <= 1:
+                units.append(ScanUnit(f, os.path.getsize(f),
+                                      part_values=pv))
+                continue
+            per = max(1, os.path.getsize(f) // ns)
+            for st in range(ns):
+                units.append(ScanUnit(f, per, [st], pv))
     else:
         for f, pv in files:
             units.append(ScanUnit(f, os.path.getsize(f), part_values=pv))
@@ -236,7 +256,13 @@ def _read_unit(fmt: str, unit: ScanUnit, schema: T.StructType,
         return pf.read(columns=names)
     if fmt == "orc":
         import pyarrow.orc as po
-        return po.ORCFile(unit.path).read(columns=names)
+        of = po.ORCFile(unit.path)
+        if unit.row_groups:  # stripe indices
+            batches = [of.read_stripe(st, columns=names)
+                       for st in unit.row_groups]
+            return pa.Table.from_batches(
+                batches) if batches else of.read(columns=names)
+        return of.read(columns=names)
     if fmt == "csv":
         return _read_csv(unit.path, schema, options)
     if fmt == "json":

@@ -2710,42 +2710,13 @@ class GetStructField(UnaryExpression):
         return self.children[0].data_type.fields[self.ordinal].data_type
 
     def eval(self, batch: HostBatch) -> HostColumn:
+        from spark_rapids_tpu.columnar.host import struct_field_values
+        from spark_rapids_tpu.columnar.transfer import \
+            _col_from_storage_values
         c = self.children[0].eval(batch)
-        dt = self.data_type
-        n = batch.num_rows
-        validity = np.zeros(n, dtype=bool)
-        if T.is_limb_decimal(dt):
-            from spark_rapids_tpu.ops import int128 as I
-            ints = []
-            for i in range(n):
-                v = (c.data[i][self.ordinal]
-                     if c.validity[i] and len(c.data[i]) > self.ordinal
-                     else None)
-                validity[i] = v is not None
-                ints.append(0 if v is None else int(v))
-            hi, lo = I.from_pyints(ints)
-            return HostColumn(dt, np.stack([hi, lo], axis=1), validity)
-        np_dt = T.numpy_dtype(dt)
-        if np_dt == np.dtype(object):
-            data = np.full(n, "" if not isinstance(
-                dt, (T.ArrayType, T.StructType)) else None, dtype=object)
-            for i in range(n):
-                if c.validity[i] and len(c.data[i]) > self.ordinal:
-                    v = c.data[i][self.ordinal]
-                    if v is not None:
-                        data[i] = v
-                        validity[i] = True
-                if data[i] is None:
-                    data[i] = ()
-        else:
-            data = np.zeros(n, dtype=np_dt)
-            for i in range(n):
-                if c.validity[i] and len(c.data[i]) > self.ordinal:
-                    v = c.data[i][self.ordinal]
-                    if v is not None:
-                        data[i] = v
-                        validity[i] = True
-        return HostColumn(dt, data, validity).normalized()
+        return _col_from_storage_values(
+            struct_field_values(c, self.ordinal),
+            self.data_type).normalized()
 
 
 class TimeWindow(UnaryExpression):

@@ -453,3 +453,48 @@ def test_scan_fans_out_across_task_parallelism(tmp_path):
     wide = nparts({"spark.rapids.sql.enabled": "true",
                    "spark.rapids.sql.taskParallelism": "4"})
     assert wide > 1, wide
+
+
+def test_orc_stripe_units(tmp_path):
+    """Multi-stripe ORC files split into stripe-granularity scan units
+    (GpuOrcScanBase.scala:66 stripe-copy role) with identical results."""
+    import pyarrow as pa
+    import pyarrow.orc as po
+
+    from spark_rapids_tpu.io.readers import list_files, plan_scan_units
+    path = str(tmp_path / "t.orc")
+    t = pa.table({"k": [i % 7 for i in range(200000)],
+                  "v": list(range(200000))})
+    po.write_table(t, path, stripe_size=64 << 10)
+    units = plan_scan_units("orc", list_files([path]))
+    assert len(units) == po.ORCFile(path).nstripes > 1
+
+    def q(s):
+        return s.read.orc(path).groupBy("k").agg(
+            F.sum("v").alias("sv")).orderBy("k")
+    assert_tpu_and_cpu_equal_collect(q, require_device=False)
+
+
+def test_ml_interop_device_batches():
+    """ColumnarRdd.convert role (ColumnarRdd.scala:42): a DataFrame's
+    device plan hands its HBM-resident batches / jax arrays straight to
+    ML code, no host round trip."""
+    import numpy as np
+
+    from spark_rapids_tpu import interop
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    s = TpuSparkSession({"spark.rapids.sql.enabled": "true"})
+    df = s.createDataFrame(
+        {"x": [float(i) for i in range(1000)],
+         "y": [i % 5 for i in range(1000)]},
+        "x double, y int", num_partitions=2)
+    df2 = df.filter(F.col("y") > 0)
+    arrs = interop.to_jax_arrays(df2)
+    assert set(arrs) == {"x", "y"}
+    n = int(sum(1 for i in range(1000) if i % 5 > 0))
+    assert arrs["x"].shape == (n,)
+    assert float(np.asarray(arrs["x"]).sum()) == sum(
+        float(i) for i in range(1000) if i % 5 > 0)
+    parts = interop.to_device_batches(df2)
+    assert sum(b.row_count() for p in parts for b in p) == n
+    s.stop()

@@ -51,11 +51,10 @@ def test_struct_create_extract_with_decimal():
                    decimal.Decimal("-7.50"), decimal.Decimal("0.00")]},
             "a int, d decimal(25,2)")
         st = F.struct(F.col("a"), F.col("d")).alias("st")
-        return s.createDataFrame(
-            {"x": [0]}, "x int") if False else df.select(
-            st, F.col("a")).select(
-            F.col("st").getField("d").alias("fd"),
-            F.col("st").getField("a").alias("fa")).orderBy("fa")
+        return (df.select(st, F.col("a"))
+                .select(F.col("st").getField("d").alias("fd"),
+                        F.col("st").getField("a").alias("fa"))
+                .orderBy("fa"))
     assert_tpu_and_cpu_equal_collect(q, expect_execs=["TpuProject"])
 
 
