@@ -30,6 +30,14 @@ class HostColumn:
     dtype: T.DataType
     data: np.ndarray
     validity: np.ndarray  # bool, True = valid
+    # Optional compact representation for string/binary columns decoded
+    # from Arrow: (utf8_bytes uint8[total], lengths int32[n]) where row
+    # i's bytes are the next lengths[i] bytes after sum(lengths[:i]).
+    # The upload codec ships these raw bytes and rebuilds the padded
+    # char matrix ON DEVICE (the reference's copy-compact-bytes pattern,
+    # GpuParquetScanBase.scala:82) instead of re-encoding the object
+    # array; pure optimization — every consumer falls back to ``data``.
+    varbytes: Optional[Tuple[np.ndarray, np.ndarray]] = None
 
     def __post_init__(self):
         assert len(self.data) == len(self.validity), (
@@ -343,5 +351,10 @@ class HostBatch:
         for i, f in enumerate(schema.fields):
             data = np.concatenate([b.columns[i].data for b in batches])
             val = np.concatenate([b.columns[i].validity for b in batches])
-            cols.append(HostColumn(f.data_type, data, val))
+            vbs = [b.columns[i].varbytes for b in batches]
+            vb = None
+            if all(v is not None for v in vbs):
+                vb = (np.concatenate([v[0] for v in vbs]),
+                      np.concatenate([v[1] for v in vbs]))
+            cols.append(HostColumn(f.data_type, data, val, vb))
         return HostBatch(schema, cols, sum(b.num_rows for b in batches))

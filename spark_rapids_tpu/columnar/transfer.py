@@ -146,6 +146,37 @@ def pack_batch(batch) -> Tuple[np.ndarray, List[np.ndarray], Tuple]:
         else:
             vdesc = ("vb", pk.add(np.packbits(validity, bitorder="little")))
         if is_string_like(dt):
+            vb = getattr(c, "varbytes", None)
+            if vb is not None and len(vb[1]) == n and len(vb[0]) > 0:
+                # compact Arrow bytes ride the wire as-is; the decode
+                # program rebuilds the padded char matrix on device
+                # (cumsum starts + gather) — no host re-encode, no
+                # char_cap padding on the wire. The byte payload is
+                # padded to a bucketed size so the layout tuple (and
+                # with it every later column's c_off) repeats across
+                # batches — an exact len(bts) would compile a fresh
+                # decode program per batch.
+                from spark_rapids_tpu.columnar.device import (
+                    bucket_capacity, bucket_char_cap)
+                bts, raw_lengths = vb
+                masked_max = int(raw_lengths[validity].max()) \
+                    if validity.any() else 1
+                char_cap = bucket_char_cap(max(1, masked_max))
+                nb = bucket_capacity(len(bts))
+                if nb > len(bts):
+                    bts = np.concatenate(
+                        [bts, np.zeros(nb - len(bts), np.uint8)])
+                c_off = pk.add(bts)
+                raw_max = int(raw_lengths.max(initial=0))
+                lk = ("i8" if raw_max <= 127 else
+                      "i16" if raw_max <= 32767 else "i32")
+                l_idx = len(extras)
+                extras.append(raw_lengths.astype(
+                    {"i8": np.int8, "i16": np.int16,
+                     "i32": np.int32}[lk]))
+                layout.append(("vstr", char_cap, c_off, nb,
+                               l_idx, vdesc))
+                continue
             chars, lengths = _encode_strings(
                 c.data, validity, n, isinstance(dt, T.BinaryType))
             # invalid slots already zeroed by _encode_strings
@@ -257,7 +288,25 @@ def _build_decode(layout: Tuple, n: int, cap: int) -> Callable:
             else:
                 validity = _pad_cap(decode_bits(vdesc[1], n), n, cap)
             kind = ent[0]
-            if kind == "str":
+            if kind == "vstr":
+                # compact bytes -> (cap, char_cap) matrix on device:
+                # starts are the cumsum of the raw lengths, each row
+                # gathers its window, nulls/tails mask to 0
+                _, char_cap, c_off, nbytes, l_idx, _v = ent
+                raw_len = extras[l_idx].astype(jnp.int32)
+                starts = jnp.cumsum(raw_len) - raw_len
+                src = jax.lax.slice(get_bytes(), (c_off,),
+                                    (c_off + max(1, nbytes),))
+                idx = starts[:, None] + jnp.arange(char_cap,
+                                                   dtype=jnp.int32)
+                out_len = jnp.where(validity[:n], raw_len, 0)
+                mask = jnp.arange(char_cap, dtype=jnp.int32) \
+                    < out_len[:, None]
+                gathered = src[jnp.clip(idx, 0, max(0, nbytes - 1))]
+                chars = jnp.where(mask, gathered, 0).astype(jnp.uint8)
+                outs.extend([_pad_cap(chars, n, cap),
+                             _pad_cap(out_len, n, cap), validity])
+            elif kind == "str":
                 _, char_cap, c_off, lk, l_idx, _ = ent
                 chars = _pad_cap(
                     jax.lax.slice(get_bytes(), (c_off,),

@@ -35,7 +35,15 @@ def to_device_batches(df) -> List[List[DeviceBatch]]:
             "plan root is not device-resident; enable "
             "spark.rapids.sql.enabled and check "
             "spark.rapids.sql.explain=NOT_ON_GPU for fallbacks")
-    return [list(thunk()) for thunk in node.device_partitions()]
+    from spark_rapids_tpu.resource import get_semaphore
+    sem = get_semaphore(node.conf)
+    try:
+        return [list(thunk()) for thunk in node.device_partitions()]
+    finally:
+        # draining the pipeline acquires the TpuSemaphore (R2C upload);
+        # no TpuColumnarToRowExec runs here to release it, so release
+        # before handing the batches to ML code or the permit leaks
+        sem.release_if_necessary()
 
 
 def to_jax_arrays(df) -> Dict[str, jax.Array]:
@@ -46,10 +54,22 @@ def to_jax_arrays(df) -> Dict[str, jax.Array]:
     indistinguishable from real zeros in ML code; filter them out
     (``col.isNotNull()``) or use to_device_batches, whose validity
     masks survive."""
+    from spark_rapids_tpu.columnar.device import (is_string_like,
+                                                  storage_jnp_dtype)
+    from spark_rapids_tpu.sql import types as T
+
+    for f in df.schema.fields:
+        if (is_string_like(f.data_type) or T.is_limb_decimal(f.data_type)
+                or isinstance(f.data_type, (T.ArrayType, T.StructType))):
+            raise TypeError(
+                f"column {f.name}: only fixed-width columns convert to "
+                "plain jax arrays; use to_device_batches for "
+                "strings/decimals/nested")
     parts = to_device_batches(df)
     batches = [b for part in parts for b in part if b.row_count()]
     if not batches:
-        return {f.name: jnp.zeros(0) for f in df.schema.fields}
+        return {f.name: jnp.zeros(0, dtype=storage_jnp_dtype(f.data_type))
+                for f in df.schema.fields}
     whole = compact(concat_device(batches) if len(batches) > 1
                     else batches[0])
     n = whole.row_count()

@@ -114,6 +114,35 @@ def _fill_for(dt: T.DataType):
     return 0
 
 
+def _string_varbytes(arr: pa.Array):
+    """Compact (utf8_bytes, raw_lengths) view of an Arrow string/binary
+    array for the upload codec (HostColumn.varbytes). ``raw_lengths``
+    are the unmasked offset deltas — their cumsum reproduces the byte
+    starts exactly (null slots may own bytes; the decode program masks
+    OUTPUT lengths with validity, not the starts)."""
+    try:
+        if not (pa.types.is_string(arr.type) or pa.types.is_binary(arr.type)
+                or pa.types.is_large_string(arr.type)
+                or pa.types.is_large_binary(arr.type)):
+            return None
+        n = len(arr)
+        if n == 0:
+            return None
+        wide = (pa.types.is_large_string(arr.type)
+                or pa.types.is_large_binary(arr.type))
+        obuf = arr.buffers()[1]
+        offs = np.frombuffer(obuf, dtype=np.int64 if wide else np.int32,
+                             count=arr.offset + n + 1)[arr.offset:]
+        dbuf = arr.buffers()[2]
+        if dbuf is None:
+            return None
+        lengths = np.diff(offs).astype(np.int32)
+        raw = np.frombuffer(dbuf, dtype=np.uint8, count=int(offs[-1]))
+        return np.ascontiguousarray(raw[int(offs[0]):]), lengths
+    except Exception:
+        return None
+
+
 def arrow_column_to_host(arr: pa.ChunkedArray | pa.Array,
                          dt: T.DataType) -> HostColumn:
     if isinstance(arr, pa.ChunkedArray):
@@ -174,7 +203,8 @@ def arrow_column_to_host(arr: pa.ChunkedArray | pa.Array,
         if arr.null_count:
             data = data.copy()
             data[~validity] = ""
-        return HostColumn(dt, data, validity)
+        return HostColumn(dt, data, validity,
+                          _string_varbytes(arr))
     if isinstance(dt, T.TimestampType):
         arr = arr.cast(pa.timestamp("us"))
         data = np.asarray(arr.cast(pa.int64()).fill_null(0),

@@ -271,3 +271,40 @@ def test_filter_masks_without_moving_data():
     assert out.capacity == db.capacity  # no reshape
     kept = out.to_host()
     assert kept.to_pydict()["a"] == [1, 2147483647, 17, 3]
+
+
+def test_varbytes_packed_upload_round_trip():
+    """Scan-path string columns carry compact Arrow bytes (varbytes);
+    the packed upload must ship those and rebuild the char matrix on
+    device bit-identically to the object-array path — including nulls,
+    empties, multi-byte UTF-8, and table slices (io/arrow_convert.py
+    _string_varbytes + transfer.py 'vstr' decode)."""
+    import pyarrow as pa
+
+    from spark_rapids_tpu.columnar.transfer import (PACKED_MIN_ROWS,
+                                                    upload_batch)
+    from spark_rapids_tpu.io.arrow_convert import (arrow_schema_to_sql,
+                                                   arrow_to_host_batch)
+
+    n = PACKED_MIN_ROWS + 257
+    vals = []
+    for i in range(n):
+        r = i % 7
+        vals.append(None if r == 0 else "" if r == 1 else
+                    f"héllo∆{i % 13}" if r == 2 else "A" if r == 3 else
+                    "x" * (i % 17))
+    tbl = pa.table({"s": pa.array(vals, type=pa.string()),
+                    "v": np.arange(n, dtype=np.int64)})
+    for t in (tbl, tbl.slice(1000, PACKED_MIN_ROWS + 5)):
+        hb = arrow_to_host_batch(t, arrow_schema_to_sql(t.schema))
+        assert hb.columns[0].varbytes is not None
+        db = upload_batch(hb, bucket_capacity(t.num_rows))
+        got = db.to_host().columns[0].to_pylist()
+        exp = hb.columns[0].to_pylist()
+        assert got == exp
+    # concat keeps varbytes (the R2C goal-coalesce path)
+    hb = arrow_to_host_batch(tbl, arrow_schema_to_sql(tbl.schema))
+    cc = HostBatch.concat([hb, hb])
+    assert cc.columns[0].varbytes is not None
+    db = upload_batch(cc, bucket_capacity(2 * n))
+    assert db.to_host().columns[0].to_pylist() == 2 * vals
