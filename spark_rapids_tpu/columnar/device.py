@@ -283,6 +283,11 @@ class DeviceBatch:
     columns: List[AnyDeviceColumn]
     active: jax.Array  # bool[capacity]
     _num_rows: Optional[int] = None
+    # optional device-resident count scalar, attached by producers that
+    # compute it anyway (e.g. the FK fast-path join): row_count()
+    # resolves it with a prefetched read instead of dispatching a fresh
+    # _count_active program + flat roundtrip
+    _num_rows_dev: Optional[jax.Array] = None
 
     @property
     def capacity(self) -> int:
@@ -297,9 +302,12 @@ class DeviceBatch:
 
     def row_count(self) -> int:
         if self._num_rows is None:
-            # jitted: an EAGER jnp.sum pays a per-op dispatch handshake
-            # (~100ms on tunneled TPU backends)
-            self._num_rows = int(_count_active(self.active))
+            if self._num_rows_dev is not None:
+                self._num_rows = int(np.asarray(self._num_rows_dev))
+            else:
+                # jitted: an EAGER jnp.sum pays a per-op dispatch
+                # handshake (~100ms on tunneled TPU backends)
+                self._num_rows = int(_count_active(self.active))
         return self._num_rows
 
     def with_columns(self, schema: T.StructType,
@@ -332,16 +340,15 @@ class DeviceBatch:
         Buffers ride per-dtype concatenated transfers: each uncached
         D2H fetch costs ~100ms flat on tunneled backends, so a batch of
         N arrays moves in len(distinct dtypes) fetches, not N."""
+        return finish_to_host(self.start_to_host())
+
+    def start_to_host(self):
+        """Non-blocking half of to_host: dispatches the pack program and
+        the async D2H copies, returns a token for finish_to_host. Lets a
+        consumer overlap the ~100ms flat fetch latency of batch k+1 with
+        batch k's host-side conversion (TpuColumnarToRowExec lookahead)."""
         flat, spec = flatten_batch(self)
-        np_arrs = _fetch_arrays([self.active] + flat)
-        active = np_arrs[0]
-        idx = np.nonzero(active)[0]
-        cols: List[HostColumn] = []
-        i = 1
-        for f, (dt, n_arr) in zip(self.schema.fields, spec):
-            cols.append(_np_col_to_host(dt, np_arrs[i:i + n_arr], idx))
-            i += n_arr
-        return HostBatch(self.schema, cols, len(idx))
+        return (self, spec, start_fetch([self.active] + flat))
 
     @staticmethod
     def empty(schema: T.StructType, capacity: int = MIN_CAPACITY
@@ -349,31 +356,46 @@ class DeviceBatch:
         return DeviceBatch.from_host(HostBatch.empty(schema), capacity)
 
 
-_FETCH_POOL = None
+def _prefetch_host(arrays: List[jax.Array]) -> bool:
+    """NON-BLOCKING: enqueue async D2H copies so a later np.asarray
+    finds the bytes already local. The flat per-fetch latency
+    (~100-200ms on tunneled backends) overlaps with whatever runs
+    between the prefetch and the blocking read. Returns False when the
+    backend has no async copies — callers that replaced a single batched
+    fetch with per-item reads must fall back to batching then."""
+    for a in arrays:
+        try:
+            a.copy_to_host_async()
+        except Exception:
+            return False  # backend without async copies
+    return True
 
 
-def _prefetch_host(arrays: List[jax.Array]) -> None:
-    global _FETCH_POOL
-    if len(arrays) <= 1:
-        return
-    if _FETCH_POOL is None:
-        from concurrent.futures import ThreadPoolExecutor
-        _FETCH_POOL = ThreadPoolExecutor(
-            max_workers=8, thread_name_prefix="srt-fetch")
-    list(_FETCH_POOL.map(np.asarray, arrays))
+def finish_to_host(token) -> HostBatch:
+    """Blocking half of DeviceBatch.start_to_host."""
+    batch, spec, fetch_tok = token
+    np_arrs = finish_fetch(fetch_tok)
+    active = np_arrs[0]
+    idx = np.nonzero(active)[0]
+    cols: List[HostColumn] = []
+    i = 1
+    for f, (dt, n_arr) in zip(batch.schema.fields, spec):
+        cols.append(_np_col_to_host(dt, np_arrs[i:i + n_arr], idx))
+        i += n_arr
+    return HostBatch(batch.schema, cols, len(idx))
 
 
 _FETCH_PACK_CACHE: dict = {}
 
 
-def _fetch_arrays(arrays: List[jax.Array]) -> List[np.ndarray]:
-    """Fetch device arrays with per-dtype concatenation: one transfer
-    per distinct dtype (plus a jitted flatten/concat program, cached on
-    the shape-set) instead of one per array."""
+def start_fetch(arrays: List[jax.Array]):
+    """Non-blocking: dispatch the per-dtype concat program (one
+    transfer per distinct dtype instead of one per array) and the async
+    copies; returns a token for finish_fetch."""
     key = tuple((a.shape, str(a.dtype)) for a in arrays)
     if len(arrays) <= 2:
         _prefetch_host(list(arrays))
-        return [np.asarray(a) for a in arrays]
+        return ("raw", arrays, None)
     cached = _FETCH_PACK_CACHE.get(key)
     if cached is None:
         groups: dict = {}
@@ -391,6 +413,14 @@ def _fetch_arrays(arrays: List[jax.Array]) -> List[np.ndarray]:
     jfn, order = cached
     packed = jfn(*arrays)
     _prefetch_host(list(packed))
+    return ("packed", arrays, (order, packed))
+
+
+def finish_fetch(token) -> List[np.ndarray]:
+    kind, arrays, extra = token
+    if kind == "raw":
+        return [np.asarray(a) for a in arrays]
+    order, packed = extra
     out: List[Optional[np.ndarray]] = [None] * len(arrays)
     for (_dt, idxs), buf in zip(order, packed):
         b = np.asarray(buf)
@@ -401,6 +431,10 @@ def _fetch_arrays(arrays: List[jax.Array]) -> List[np.ndarray]:
             out[i] = b[off:off + size].reshape(shape)
             off += size
     return out
+
+
+def _fetch_arrays(arrays: List[jax.Array]) -> List[np.ndarray]:
+    return finish_fetch(start_fetch(arrays))
 
 
 def _np_col_to_host(dt: T.DataType, arrs: List[np.ndarray],

@@ -175,7 +175,7 @@ def pack_batch(batch) -> Tuple[np.ndarray, List[np.ndarray], Tuple]:
                     {"i8": np.int8, "i16": np.int16,
                      "i32": np.int32}[lk]))
                 layout.append(("vstr", char_cap, c_off, nb,
-                               l_idx, vdesc))
+                               lk, l_idx, vdesc))
                 continue
             chars, lengths = _encode_strings(
                 c.data, validity, n, isinstance(dt, T.BinaryType))
@@ -292,7 +292,7 @@ def _build_decode(layout: Tuple, n: int, cap: int) -> Callable:
                 # compact bytes -> (cap, char_cap) matrix on device:
                 # starts are the cumsum of the raw lengths, each row
                 # gathers its window, nulls/tails mask to 0
-                _, char_cap, c_off, nbytes, l_idx, _v = ent
+                _, char_cap, c_off, nbytes, _lk, l_idx, _v = ent
                 raw_len = extras[l_idx].astype(jnp.int32)
                 starts = jnp.cumsum(raw_len) - raw_len
                 src = jax.lax.slice(get_bytes(), (c_off,),

@@ -514,21 +514,31 @@ class TpuHashAggregateExec(TpuExec):
         small batch with zero extra syncs (the pre-shuffle reduction of
         aggregate.scala:224-245, restructured for a ~0.2-0.7s-per-D2H-
         roundtrip backend)."""
+        from spark_rapids_tpu.columnar.device import _prefetch_host
         pending = []
+        prefetched = True
         for b in thunk():
             out, cnt = self._aggregate_batch(b)
+            # async host copy starts NOW: by drain time the scalar is
+            # already local, so the drain costs pipeline-completion, not
+            # pipeline-completion + a flat ~0.2s roundtrip per fetch
+            prefetched = _prefetch_host([cnt]) and prefetched
             pending.append((store.register(out), cnt))
         if not pending:
             return
-        # ONE roundtrip for every batch's group count (each separate
-        # fetch costs ~0.2-1s flat on tunneled backends). This fetch is
-        # where the whole async upstream pipeline (upload transfer,
-        # decode, filter/project, per-batch agg) actually drains, so its
-        # wall time IS the device-side pipeline cost — metered so the
-        # bench breakdown shows it (round-4 verdict: the dominant term
-        # must not be invisible).
+        # This read is where the whole async upstream pipeline (upload
+        # transfer, decode, filter/project, per-batch agg) actually
+        # drains, so its wall time IS the device-side pipeline cost —
+        # metered so the bench breakdown shows it (round-4 verdict: the
+        # dominant term must not be invisible). Without async copies the
+        # per-batch reads would pay one flat roundtrip EACH — stack them
+        # into the single-fetch form instead.
         with self.metrics.timed("pipelineDrainTime"):
-            counts = np.asarray(_stack_counts([c for _h, c in pending]))
+            if prefetched:
+                counts = [int(np.asarray(c)) for _h, c in pending]
+            else:
+                counts = np.asarray(
+                    _stack_counts([c for _h, c in pending]))
         shrunk = []
         for (h, _c), cnt in zip(pending, counts):
             b = h.get()

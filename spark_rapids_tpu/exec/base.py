@@ -165,10 +165,24 @@ class TpuColumnarToRowExec(P.PhysicalPlan):
 
         def make(thunk: DevicePartitionThunk) -> P.PartitionThunk:
             def run() -> Iterator[HostBatch]:
+                from spark_rapids_tpu.columnar.device import finish_to_host
                 try:
+                    # 1-ahead: batch k+1's pack program + async D2H
+                    # copies are in flight while batch k converts on
+                    # the host — the flat fetch latency overlaps
+                    prev = None
                     for b in thunk():
+                        tok = b.start_to_host()
+                        if prev is not None:
+                            with metrics.timed(M.COPY_FROM_DEVICE_TIME):
+                                h = finish_to_host(prev)
+                            metrics.create(M.NUM_OUTPUT_ROWS,
+                                           M.ESSENTIAL).add(h.num_rows)
+                            yield h
+                        prev = tok
+                    if prev is not None:
                         with metrics.timed(M.COPY_FROM_DEVICE_TIME):
-                            h = b.to_host()
+                            h = finish_to_host(prev)
                         metrics.create(M.NUM_OUTPUT_ROWS,
                                        M.ESSENTIAL).add(h.num_rows)
                         yield h

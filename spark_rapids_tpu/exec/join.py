@@ -10,6 +10,7 @@ filters inside cudf's join, a complexity this design doesn't need yet).
 
 from __future__ import annotations
 
+import threading
 from typing import Iterator, List, Optional
 
 from spark_rapids_tpu import metrics as M
@@ -88,7 +89,8 @@ class TpuShuffledHashJoinExec(TpuExec):
         return list(self.left.output) + list(self.right.output)
 
     def _join_one(self, lbatches: List[DeviceBatch],
-                  rbatches: List[DeviceBatch]) -> Iterator[DeviceBatch]:
+                  rbatches: List[DeviceBatch],
+                  fk_hint: bool = False) -> Iterator[DeviceBatch]:
         lschema = self.left.schema
         rschema = self.right.schema
         lwhole = (concat_device(lbatches) if len(lbatches) > 1 else
@@ -103,7 +105,8 @@ class TpuShuffledHashJoinExec(TpuExec):
             out_schema = self._pair_schema()
         with self.metrics.timed(M.JOIN_TIME):
             out = device_join(lwhole, rwhole, lk, rk, self.join_type,
-                              out_schema, null_safe=self.null_safe)
+                              out_schema, null_safe=self.null_safe,
+                              fk_hint=fk_hint)
             if self.condition is not None:
                 cond = E.bind_references(self.condition, self._pair_attrs())
                 out = X.run_filter(cond, out)
@@ -206,6 +209,31 @@ class TpuShuffledHashJoinExec(TpuExec):
         TpuBroadcastHashJoinExec and the AQE runtime flip."""
         goal = self.conf.batch_size_rows
         chunkable = self.join_type in self._LEFT_STREAM_TYPES
+        # one sizing probe for the WHOLE broadcast: unique build keys
+        # (the dimension-table norm) certify every stream chunk for the
+        # no-sync FK fast path (ops/join.py build_key_max_multiplicity).
+        # The probe resolves lazily at the first joined chunk, so its
+        # one flat fetch overlaps the stream side's scan/upload.
+        fk_resolve = None
+        if self.join_type in ("inner", "left", "leftouter") \
+                and self.condition is None:
+            from spark_rapids_tpu.ops.join import build_key_max_multiplicity
+            rk = P.bind_list(self.right_keys, self.right.output)
+            fk_resolve = build_key_max_multiplicity(
+                rwhole, rk, self.null_safe)
+        fk_state: dict = {}
+        fk_lock = threading.Lock()
+
+        def fk_hint() -> bool:
+            if fk_resolve is None:
+                return False
+            with fk_lock:
+                if "v" not in fk_state:
+                    fk_state["v"] = fk_resolve() <= 1
+                    if fk_state["v"]:
+                        self.metrics.create("fkFastPathJoins",
+                                            M.ESSENTIAL).add(1)
+            return fk_state["v"]
 
         def make(lt: DevicePartitionThunk) -> DevicePartitionThunk:
             def run() -> Iterator[DeviceBatch]:
@@ -218,7 +246,8 @@ class TpuShuffledHashJoinExec(TpuExec):
                     lb = [h.get() for h in lhandles]
                     for h in lhandles:
                         h.close()
-                    yield from self._join_one(lb, [rwhole])
+                    yield from self._join_one(lb, [rwhole],
+                                              fk_hint=fk_hint())
                     return
                 i = 0
                 while i < len(lhandles):
@@ -233,7 +262,8 @@ class TpuShuffledHashJoinExec(TpuExec):
                     lb = [h.get() for h in chunk]
                     for h in chunk:
                         h.close()
-                    yield from self._join_one(lb, [rwhole])
+                    yield from self._join_one(lb, [rwhole],
+                                              fk_hint=fk_hint())
             return run
         return [make(t) for t in device_channel(left_src)]
 

@@ -216,7 +216,7 @@ def _build_fast_gather_fn(join_type: str) -> Callable:
                       jnp.clip(base, 0, cap_r - 1).astype(jnp.int32))
         out_r = take_columns(cols_r, jnp.where(has, ri, 0), valid_at=has)
         active = (active_l & has) if inner else active_l
-        return out_r, active
+        return out_r, active, jnp.sum(active.astype(jnp.int64))
     return jax.jit(fn)
 
 
@@ -304,6 +304,61 @@ def _build_mask_fn(lkeys: Tuple[E.Expression, ...],
     return jax.jit(fn)
 
 
+_MULT_CACHE: Dict[Tuple, Callable] = {}
+
+
+def build_key_max_multiplicity(right: DeviceBatch,
+                               rkeys: List[E.Expression],
+                               null_safe: Sequence[bool] = ()
+                               ) -> Callable[[], int]:
+    """Max number of build rows sharing one join key (0 when no valid
+    keys), as a LAZY resolver: the program + async host copy dispatch
+    now, the blocking read happens at the first call — overlapping the
+    probe's flat fetch latency with the stream side's scan. Computed
+    ONCE per broadcast build side; == 1 certifies every stream chunk
+    for the FK fast path with NO per-chunk sizing sync — the reference
+    reads the same property off its hash table build
+    (GpuHashJoin.scala:377 buildSide distinct-count role)."""
+    rk = tuple(rkeys)
+    ns = tuple(null_safe) or (False,) * len(rk)
+    salt = G.kernel_salt()
+    key = (tuple(X.expr_key(e) for e in rk), ns, salt)
+    fn = _MULT_CACHE.get(key)
+    if fn is None:
+        def _fn(cols_r, active_r, lits_r):
+            cap_r = active_r.shape[0]
+            ctx = X.Ctx(cols_r, cap_r, rk, lits_r)
+            kr = [X.dev_eval(e, ctx) for e in rk]
+            valid = active_r
+            for c, nsf in zip(kr, ns):
+                if not nsf:
+                    valid = valid & c.validity
+            words: List[jax.Array] = []
+            for c, nsf in zip(kr, ns):
+                if nsf:
+                    words.append(c.validity)
+                words.extend(G.value_words(c))
+            from spark_rapids_tpu.columnar.device import sort_with_payload
+            sorted_all, _order, _p = sort_with_payload(
+                [~valid] + words, [])
+            active_s = ~sorted_all[0]
+            boundary, is_end = G._boundaries_from_words(
+                sorted_all[1:], active_s, cap_r)
+            pos = jnp.arange(cap_r, dtype=jnp.int32)
+            start = jax.lax.cummax(jnp.where(boundary, pos, -1))
+            end = jnp.flip(jax.lax.cummin(
+                jnp.flip(jnp.where(is_end, pos, cap_r))))
+            length = jnp.where(active_s, end - start + 1, 0)
+            return jnp.max(length)
+        fn = jax.jit(_fn)
+        _MULT_CACHE[key] = fn
+    with G.nan_scope(salt[0]):
+        out = fn(right.columns, right.active, X.literal_values(list(rk)))
+    from spark_rapids_tpu.columnar.device import _prefetch_host
+    _prefetch_host([out])  # overlap the fetch with the stream-side scan
+    return lambda: int(np.asarray(out))
+
+
 _EXTRAS_CACHE: Dict[Tuple, Callable] = {}
 _OR = jax.jit(lambda a, b: a | b)
 
@@ -379,7 +434,8 @@ def device_join(left: DeviceBatch, right: DeviceBatch,
                 join_type: str,
                 out_schema: T.StructType,
                 collect_matched_r: bool = False,
-                null_safe: Sequence[bool] = ()):
+                null_safe: Sequence[bool] = (),
+                fk_hint: bool = False):
     """Run the equi-join of two device batches; keys are pre-bound device
     expressions. Returns the joined batch (pair layout: left columns then
     right columns) or, for semi/anti, the masked left batch. With
@@ -421,16 +477,35 @@ def device_join(left: DeviceBatch, right: DeviceBatch,
          extra_order, matched_r) = count_fn(
              left.columns, left.active, lits_l,
              right.columns, right.active, lits_r)
+    shapes = (tuple((a.shape, str(a.dtype))
+                    for c in left.columns for a in c.arrays()),
+              tuple((a.shape, str(a.dtype))
+                    for c in right.columns for a in c.arrays()))
+    if fk_hint and join_type in ("inner", "left", "leftouter"):
+        # build-side keys certified unique (max_m <= 1): take the fast
+        # path with NO sizing sync at all — the output keeps the left
+        # batch's capacity and its row count stays lazily unknown
+        fkey = (shapes, join_type, "fast")
+        fast_fn = _GATHER_CACHE.get(fkey)
+        if fast_fn is None:
+            fast_fn = _build_fast_gather_fn(join_type)
+            _GATHER_CACHE[fkey] = fast_fn
+        out_r, active, cnt = fast_fn(left.columns, right.columns,
+                                     left.active, m, base, order_r)
+        # device count rides along (prefetched): downstream sizing
+        # reads resolve without a fresh count program + flat roundtrip
+        from spark_rapids_tpu.columnar.device import _prefetch_host
+        _prefetch_host([cnt])
+        out = DeviceBatch(out_schema, list(left.columns) + list(out_r),
+                          active, None, cnt)
+        return (out, matched_r) if collect_matched_r else out
+
     # ONE host sync for sizing: all scalars ride one stacked fetch
     # (each roundtrip costs ~0.2-0.6s flat on tunneled backends)
     sc = np.asarray(_stack3(total_pairs, n_extra, max_m))
     total = int(sc[0]) + int(sc[1])
     out_cap = bucket_capacity(max(1, total))
 
-    shapes = (tuple((a.shape, str(a.dtype))
-                    for c in left.columns for a in c.arrays()),
-              tuple((a.shape, str(a.dtype))
-                    for c in right.columns for a in c.arrays()))
     if int(sc[2]) <= 1 and join_type in ("inner", "left", "leftouter"):
         # FK fast path: at most one match per stream row -> output stays
         # in the left batch's own layout; no expansion program at all
@@ -439,8 +514,8 @@ def device_join(left: DeviceBatch, right: DeviceBatch,
         if fast_fn is None:
             fast_fn = _build_fast_gather_fn(join_type)
             _GATHER_CACHE[fkey] = fast_fn
-        out_r, active = fast_fn(left.columns, right.columns, left.active,
-                                m, base, order_r)
+        out_r, active, _cnt = fast_fn(left.columns, right.columns,
+                                      left.active, m, base, order_r)
         out = DeviceBatch(out_schema, list(left.columns) + list(out_r),
                           active, total)
         return (out, matched_r) if collect_matched_r else out

@@ -219,3 +219,57 @@ def test_broadcast_exchange_reuse_builds_once():
         assert refs == 2, "both joins must reference a broadcast exchange"
         assert distinct == 1, "reuse pass must collapse equal broadcasts"
         assert builds == 1, "the shared build side must build once"
+
+
+def test_broadcast_fk_fast_path_no_sizing_sync():
+    """Unique build-side keys certify the whole broadcast for the FK
+    fast path: one multiplicity probe replaces the per-chunk sizing
+    sync (ops/join.py build_key_max_multiplicity) and the results stay
+    identical; duplicate build keys must NOT engage the hint."""
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    from spark_rapids_tpu.sql import functions as F
+
+    def metric_total(plans, name):
+        tot = 0
+
+        def walk(p):
+            nonlocal tot
+            ms = getattr(p, "metrics", None)
+            if ms is not None:
+                tot += ms.snapshot().get(name, 0)
+            for c in p.children:
+                walk(c)
+        for p in plans:
+            walk(p)
+        return tot
+
+    fact = {"k": [1, 2, 3, 4, 2, None], "v": [10, 20, 30, 40, 50, 60]}
+    uniq = {"k": [1, 2, 3], "name": ["a", "b", "c"]}
+    dup = {"k": [1, 2, 2, 3], "name": ["a", "b", "B", "c"]}
+
+    expected = {}
+    for tag, dim in (("uniq", uniq), ("dup", dup)):
+        s = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+        try:
+            f = s.createDataFrame(fact, "k int, v int")
+            d = s.createDataFrame(dim, "k int, name string")
+            expected[tag] = sorted(
+                map(tuple, f.join(d, "k", "inner").collect()))
+        finally:
+            s.stop()
+
+    for tag, dim, want_fast in (("uniq", uniq, True), ("dup", dup, False)):
+        s = TpuSparkSession({
+            "spark.rapids.sql.enabled": "true",
+            "spark.rapids.sql.test.forceDevice": "true"})
+        try:
+            s.start_capture()
+            f = s.createDataFrame(fact, "k int, v int")
+            d = s.createDataFrame(dim, "k int, name string")
+            got = sorted(map(tuple, f.join(d, "k", "inner").collect()))
+            plans = s.get_captured_plans()
+        finally:
+            s.stop()
+        assert got == expected[tag], tag
+        fast = metric_total(plans, "fkFastPathJoins")
+        assert (fast > 0) == want_fast, (tag, fast)
