@@ -312,7 +312,8 @@ class DeviceBatch:
 
     def with_columns(self, schema: T.StructType,
                      columns: List[AnyDeviceColumn]) -> "DeviceBatch":
-        return DeviceBatch(schema, columns, self.active, self._num_rows)
+        return DeviceBatch(schema, columns, self.active, self._num_rows,
+                           self._num_rows_dev)
 
     def sizeof(self) -> int:
         """Device bytes held by this batch (for HBM accounting)."""

@@ -186,7 +186,7 @@ class TpuUnionExec(TpuExec):
             def run() -> Iterator[DeviceBatch]:
                 for b in thunk():
                     yield DeviceBatch(schema, b.columns, b.active,
-                                      b._num_rows)
+                                      b._num_rows, b._num_rows_dev)
             return run
         for c in self.children:
             out.extend(retag(t) for t in device_channel(c))

@@ -127,7 +127,8 @@ class TpuShuffledHashJoinExec(TpuExec):
         if want == [a.expr_id for a in attrs]:
             return pair
         cols = [pair.columns[have[w]] for w in want]
-        return DeviceBatch(self.schema, cols, pair.active, pair._num_rows)
+        return DeviceBatch(self.schema, cols, pair.active, pair._num_rows,
+                           pair._num_rows_dev)
 
     # join types whose per-left-row results are independent of other left
     # rows — the stream (left) side may be processed in bounded chunks

@@ -86,6 +86,35 @@ def _concat_key_columns(kl: Sequence[AnyDeviceColumn],
     return out
 
 
+def _key_words(keys: Sequence, null_safe: Sequence[bool]) -> List[jax.Array]:
+    """Comparison words for evaluated key columns; null-safe keys get a
+    validity word so null groups with null. ONE implementation shared by
+    _key_plan and the FK-uniqueness probe — they must agree on key
+    equality or the probe's certificate lies to the fast path."""
+    words: List[jax.Array] = []
+    for c, nsf in zip(keys, null_safe):
+        if nsf:
+            words.append(c.validity)
+        words.extend(G.value_words(c))
+    return words
+
+
+def _group_extents(words: List[jax.Array], valid: jax.Array, cap: int):
+    """Sort rows by key words (invalid rows sink) and return
+    (active_s, order, start, end): per-sorted-position group extents.
+    Shared by _key_plan and build_key_max_multiplicity."""
+    from spark_rapids_tpu.columnar.device import sort_with_payload
+    sorted_all, order, _p = sort_with_payload([~valid] + words, [])
+    active_s = ~sorted_all[0]
+    boundary, is_end = G._boundaries_from_words(sorted_all[1:], active_s,
+                                                cap)
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    start = jax.lax.cummax(jnp.where(boundary, pos, -1))
+    end = jnp.flip(jax.lax.cummin(
+        jnp.flip(jnp.where(is_end, pos, cap))))
+    return active_s, order, start, end
+
+
 def _key_plan(lkeys: Sequence[E.Expression], rkeys: Sequence[E.Expression],
               ctx_l: X.Ctx, ctx_r: X.Ctx, active_l, active_r,
               null_safe: Sequence[bool] = ()):
@@ -112,20 +141,9 @@ def _key_plan(lkeys: Sequence[E.Expression], rkeys: Sequence[E.Expression],
     cap_c = cap_l + cap_r
     combined = _concat_key_columns(kl, kr)
     valid_c = jnp.concatenate([valid_l, valid_r])
-    words: List[jax.Array] = []
-    for c, nsf in zip(combined, ns):
-        if nsf:  # null forms its own key group, matching other nulls
-            words.append(c.validity)
-        words.extend(G.value_words(c))
-    from spark_rapids_tpu.columnar.device import sort_with_payload
-    sorted_all, order, _p = sort_with_payload([~valid_c] + words, [])
-    active_s = ~sorted_all[0]
-    boundary, is_end = G._boundaries_from_words(sorted_all[1:], active_s,
-                                                cap_c)
+    active_s, order, start, end = _group_extents(
+        _key_words(combined, ns), valid_c, cap_c)
     pos_c = jnp.arange(cap_c, dtype=jnp.int32)
-    start = jax.lax.cummax(jnp.where(boundary, pos_c, -1))
-    end = jnp.flip(jax.lax.cummin(
-        jnp.flip(jnp.where(is_end, pos_c, cap_c))))
     is_left_s = order < cap_l
     left_valid_s = is_left_s & active_s
     right_valid_s = (~is_left_s) & active_s
@@ -333,21 +351,8 @@ def build_key_max_multiplicity(right: DeviceBatch,
             for c, nsf in zip(kr, ns):
                 if not nsf:
                     valid = valid & c.validity
-            words: List[jax.Array] = []
-            for c, nsf in zip(kr, ns):
-                if nsf:
-                    words.append(c.validity)
-                words.extend(G.value_words(c))
-            from spark_rapids_tpu.columnar.device import sort_with_payload
-            sorted_all, _order, _p = sort_with_payload(
-                [~valid] + words, [])
-            active_s = ~sorted_all[0]
-            boundary, is_end = G._boundaries_from_words(
-                sorted_all[1:], active_s, cap_r)
-            pos = jnp.arange(cap_r, dtype=jnp.int32)
-            start = jax.lax.cummax(jnp.where(boundary, pos, -1))
-            end = jnp.flip(jax.lax.cummin(
-                jnp.flip(jnp.where(is_end, pos, cap_r))))
+            active_s, _order, start, end = _group_extents(
+                _key_words(kr, ns), valid, cap_r)
             length = jnp.where(active_s, end - start + 1, 0)
             return jnp.max(length)
         fn = jax.jit(_fn)
@@ -481,10 +486,12 @@ def device_join(left: DeviceBatch, right: DeviceBatch,
                     for c in left.columns for a in c.arrays()),
               tuple((a.shape, str(a.dtype))
                     for c in right.columns for a in c.arrays()))
-    if fk_hint and join_type in ("inner", "left", "leftouter"):
-        # build-side keys certified unique (max_m <= 1): take the fast
-        # path with NO sizing sync at all — the output keeps the left
-        # batch's capacity and its row count stays lazily unknown
+    def run_fast(num_rows: Optional[int]):
+        # FK fast path (max_m <= 1: every stream row matches at most one
+        # build row): output stays in the left batch's own layout — no
+        # expansion program, no output-capacity bucket. The device count
+        # rides along (prefetched) so downstream sizing reads resolve
+        # without a fresh count program + flat roundtrip.
         fkey = (shapes, join_type, "fast")
         fast_fn = _GATHER_CACHE.get(fkey)
         if fast_fn is None:
@@ -492,13 +499,17 @@ def device_join(left: DeviceBatch, right: DeviceBatch,
             _GATHER_CACHE[fkey] = fast_fn
         out_r, active, cnt = fast_fn(left.columns, right.columns,
                                      left.active, m, base, order_r)
-        # device count rides along (prefetched): downstream sizing
-        # reads resolve without a fresh count program + flat roundtrip
         from spark_rapids_tpu.columnar.device import _prefetch_host
         _prefetch_host([cnt])
         out = DeviceBatch(out_schema, list(left.columns) + list(out_r),
-                          active, None, cnt)
+                          active, num_rows, cnt)
         return (out, matched_r) if collect_matched_r else out
+
+    if fk_hint and join_type in ("inner", "left", "leftouter"):
+        # build-side keys certified unique: NO sizing sync at all — the
+        # row count stays lazily unknown (resolved from the prefetched
+        # device count only if someone asks)
+        return run_fast(None)
 
     # ONE host sync for sizing: all scalars ride one stacked fetch
     # (each roundtrip costs ~0.2-0.6s flat on tunneled backends)
@@ -507,18 +518,7 @@ def device_join(left: DeviceBatch, right: DeviceBatch,
     out_cap = bucket_capacity(max(1, total))
 
     if int(sc[2]) <= 1 and join_type in ("inner", "left", "leftouter"):
-        # FK fast path: at most one match per stream row -> output stays
-        # in the left batch's own layout; no expansion program at all
-        fkey = (shapes, join_type, "fast")
-        fast_fn = _GATHER_CACHE.get(fkey)
-        if fast_fn is None:
-            fast_fn = _build_fast_gather_fn(join_type)
-            _GATHER_CACHE[fkey] = fast_fn
-        out_r, active, _cnt = fast_fn(left.columns, right.columns,
-                                      left.active, m, base, order_r)
-        out = DeviceBatch(out_schema, list(left.columns) + list(out_r),
-                          active, total)
-        return (out, matched_r) if collect_matched_r else out
+        return run_fast(total)
 
     gkey = (shapes, out_cap, join_type, m.shape, order_r.shape)
     gather_fn = _GATHER_CACHE.get(gkey)

@@ -63,6 +63,11 @@ class DataFrame:
         return _coerce_resolved(resolved)
 
     # -- transformations ---------------------------------------------------
+    def alias(self, name: str) -> "DataFrame":
+        """pyspark DataFrame.alias: re-qualify this relation's columns so
+        ``name.col`` references resolve (SubqueryAlias node)."""
+        return DataFrame(L.SubqueryAlias(name, self.plan), self.session)
+
     def select(self, *cols) -> "DataFrame":
         items: List[E.Expression] = []
         for c in cols:
@@ -194,9 +199,13 @@ class DataFrame:
         # side with fresh expr_ids when the two sides share attribute ids.
         left_ids = {a.expr_id for a in self.plan.output}
         if any(a.expr_id in left_ids for a in other.plan.output):
+            # fresh expr_ids, same names AND same qualifiers — `b.col`
+            # still resolves after a self-join re-alias, however deep
+            # the alias sits under filters/projections
             other = DataFrame(
-                L.Project([E.Alias(a, a.name) for a in other.plan.output],
-                          other.plan), other.session)
+                L.Project([E.Alias(a, a.name, qualifier=a.qualifier)
+                           for a in other.plan.output], other.plan),
+                other.session)
         cond: Optional[E.Expression] = None
         using: List[str] = []
         if on is not None:
@@ -238,9 +247,13 @@ class DataFrame:
     def crossJoin(self, other: "DataFrame") -> "DataFrame":
         left_ids = {a.expr_id for a in self.plan.output}
         if any(a.expr_id in left_ids for a in other.plan.output):
+            # fresh expr_ids, same names AND same qualifiers — `b.col`
+            # still resolves after a self-join re-alias, however deep
+            # the alias sits under filters/projections
             other = DataFrame(
-                L.Project([E.Alias(a, a.name) for a in other.plan.output],
-                          other.plan), other.session)
+                L.Project([E.Alias(a, a.name, qualifier=a.qualifier)
+                           for a in other.plan.output], other.plan),
+                other.session)
         return DataFrame(L.Join(self.plan, other.plan, "cross", None),
                          self.session)
 
@@ -550,6 +563,8 @@ def _auto_name(e: E.Expression) -> str:
         return str(e.value)
     if isinstance(e, E.Cast):
         return _auto_name(e.child)
+    if isinstance(e, E.GetStructField):
+        return e.pretty_name  # `SELECT s.x` names the output column x
     return repr(e)
 
 

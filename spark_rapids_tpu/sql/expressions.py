@@ -157,15 +157,24 @@ def _infer_literal_type(v: Any) -> T.DataType:
 
 
 class AttributeReference(Expression):
-    """A resolved column with a unique id (Catalyst AttributeReference)."""
+    """A resolved column with a unique id (Catalyst AttributeReference).
+    ``qualifier`` carries the relation alias/table name so ``t.col``
+    references resolve against the right side of a join (Catalyst keeps
+    a qualifier seq on every attribute the same way)."""
 
     def __init__(self, name: str, dtype: T.DataType, nullable: bool = True,
-                 expr_id: Optional[int] = None):
+                 expr_id: Optional[int] = None,
+                 qualifier: Optional[str] = None):
         self.children = []
         self.name = name
         self._dtype = dtype
         self._nullable = nullable
         self.expr_id = expr_id if expr_id is not None else next_expr_id()
+        self.qualifier = qualifier
+
+    def with_qualifier(self, qualifier: str) -> "AttributeReference":
+        return AttributeReference(self.name, self._dtype, self._nullable,
+                                  self.expr_id, qualifier)
 
     @property
     def data_type(self) -> T.DataType:
@@ -229,10 +238,12 @@ class BoundReference(Expression):
 
 class Alias(Expression):
     def __init__(self, child: Expression, name: str,
-                 expr_id: Optional[int] = None):
+                 expr_id: Optional[int] = None,
+                 qualifier: Optional[str] = None):
         self.children = [child]
         self.name = name
         self.expr_id = expr_id if expr_id is not None else next_expr_id()
+        self.qualifier = qualifier  # kept by self-join dedup re-aliasing
 
     @property
     def child(self) -> Expression:
@@ -251,7 +262,7 @@ class Alias(Expression):
 
     def to_attribute(self) -> AttributeReference:
         return AttributeReference(self.name, self.data_type, self.nullable,
-                                  self.expr_id)
+                                  self.expr_id, self.qualifier)
 
     def __repr__(self) -> str:
         return f"{self.child!r} AS {self.name}#{self.expr_id}"

@@ -265,10 +265,33 @@ _TYPE_NAMES = {
 }
 
 
+def split_top_level(s: str, sep: str = ",") -> List[str]:
+    """Split on ``sep`` at nesting depth 0 (ignoring separators inside
+    <> and ()); shared by the DDL schema parser and struct/map type
+    strings."""
+    parts: List[str] = []
+    depth = 0
+    cur = ""
+    for ch in s:
+        if ch == sep and depth == 0:
+            parts.append(cur)
+            cur = ""
+            continue
+        if ch in "(<":
+            depth += 1
+        elif ch in ")>":
+            depth -= 1
+        cur += ch
+    if cur.strip():
+        parts.append(cur)
+    return parts
+
+
 def _parse_type(dt: Union[T.DataType, str]) -> T.DataType:
     if isinstance(dt, T.DataType):
         return dt
-    s = dt.strip().lower()
+    orig = dt.strip()
+    s = orig.lower()
     if s in _TYPE_NAMES:
         return _TYPE_NAMES[s]
     if s.startswith("decimal"):
@@ -277,8 +300,19 @@ def _parse_type(dt: Union[T.DataType, str]) -> T.DataType:
             p, sc = inner.split(",")
             return T.DecimalType(int(p), int(sc))
         return T.DecimalType(10, 0)
+    # nested types parse from the ORIGINAL string: field names keep case
     if s.startswith("array<") and s.endswith(">"):
-        return T.ArrayType(_parse_type(s[6:-1]))
+        return T.ArrayType(_parse_type(orig[6:-1]))
+    if s.startswith("struct<") and s.endswith(">"):
+        out = []
+        for f in split_top_level(orig[7:-1]):
+            name, _, tp = f.strip().partition(":")
+            out.append(T.StructField(name.strip(), _parse_type(tp.strip())))
+        return T.StructType(out)
+    if s.startswith("map<") and s.endswith(">"):
+        kv = split_top_level(orig[4:-1])
+        if len(kv) == 2:
+            return T.MapType(_parse_type(kv[0]), _parse_type(kv[1]))
     raise ValueError(f"unknown type string {dt!r}")
 
 

@@ -45,22 +45,74 @@ def resolve(expr: Expression, inputs: Sequence[AttributeReference],
             case_sensitive: bool = False) -> Expression:
     """Replace UnresolvedAttribute with matching AttributeReference."""
 
+    def norm(s: Optional[str]) -> Optional[str]:
+        return s if case_sensitive or s is None else s.lower()
+
+    def base_matches(parts: List[str], k: int) -> List[AttributeReference]:
+        """Attributes matching the first k name parts: as a bare (dotted)
+        column name, or as qualifier + column (Catalyst's order)."""
+        nm = norm(".".join(parts[:k]))
+        ms = [a for a in inputs if norm(a.name) == nm]
+        if not ms and k >= 2:
+            qual, col = norm(parts[0]), norm(".".join(parts[1:k]))
+            ms = [a for a in inputs
+                  if norm(a.name) == col and norm(a.qualifier) == qual]
+        return ms
+
     def rule(e: Expression) -> Optional[Expression]:
         if isinstance(e, UnresolvedAttribute):
-            name = e.name if case_sensitive else e.name.lower()
-            matches = [a for a in inputs
-                       if (a.name if case_sensitive else a.name.lower())
-                       == name]
-            if not matches:
-                raise KeyError(
-                    f"cannot resolve '{e.name}' among "
-                    f"{[a.name for a in inputs]}")
-            if len(matches) > 1:
-                raise KeyError(f"ambiguous column '{e.name}'")
-            return matches[0]
+            parts = e.name.split(".")
+            # longest base first: `a.s.y` prefers column a.s (or
+            # qualifier a + column s) before treating y as a field
+            for k in range(len(parts), 0, -1):
+                ms = base_matches(parts, k)
+                if len(ms) > 1:
+                    raise KeyError(f"ambiguous column '{e.name}'")
+                if not ms:
+                    continue
+                out: Expression = ms[0]
+                ok = True
+                for p in parts[k:]:  # remaining parts walk struct fields
+                    dt = out.data_type
+                    fld = next(
+                        (f.name for f in dt.fields
+                         if norm(f.name) == norm(p)), None) \
+                        if isinstance(dt, T.StructType) else None
+                    if fld is None:
+                        ok = False
+                        break
+                    from spark_rapids_tpu.sql.expressions import \
+                        GetStructField
+                    out = GetStructField(out, name=fld)
+                if ok:
+                    return out
+            raise KeyError(
+                f"cannot resolve '{e.name}' among "
+                f"{[a.name for a in inputs]}")
         return None
 
     return expr.transform(rule)
+
+
+class SubqueryAlias(LogicalPlan):
+    """Relation alias (Catalyst SubqueryAlias): same expr_ids, outputs
+    re-qualified so ``alias.col`` references resolve. Physically
+    transparent — the planner plans straight through it."""
+
+    def __init__(self, alias: str, child: LogicalPlan):
+        self.children = [child]
+        self.alias = alias
+
+    @property
+    def child(self) -> LogicalPlan:
+        return self.children[0]
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return [a.with_qualifier(self.alias) for a in self.child.output]
+
+    def simple_string(self) -> str:
+        return f"SubqueryAlias {self.alias}"
 
 
 class LocalRelation(LogicalPlan):
@@ -201,10 +253,12 @@ class Join(LogicalPlan):
         right_out = list(self.right.output)
         if jt in ("left", "full", "leftouter", "fullouter"):
             right_out = [AttributeReference(a.name, a.data_type, True,
-                                            a.expr_id) for a in right_out]
+                                            a.expr_id, a.qualifier)
+                         for a in right_out]
         if jt in ("right", "full", "rightouter", "fullouter"):
             left_out = [AttributeReference(a.name, a.data_type, True,
-                                           a.expr_id) for a in left_out]
+                                           a.expr_id, a.qualifier)
+                        for a in left_out]
         return left_out + right_out
 
     def simple_string(self) -> str:

@@ -226,13 +226,13 @@ class _Parser:
             self.next()
             df = self.query()
             self.expect(")")
-            self._relation_alias()
-            return df
+            alias = self._relation_alias()
+            return df.alias(alias) if alias else df
         kind, name = self.next()
         assert kind == "id", f"expected table name, got {name!r}"
         df = self.session.table(name)
-        self._relation_alias()
-        return df
+        alias = self._relation_alias()
+        return df.alias(alias) if alias else df
 
     def _relation_alias(self) -> Optional[str]:
         if self.kw("as"):
@@ -516,14 +516,15 @@ class _Parser:
             return Column(E.Cast(c.expr, _parse_type(tp)))
         if self.peek(1)[1] == "(":
             return self._function_call()
-        # column reference (qualified names drop the table part: the
-        # engine resolves by column name)
+        # column reference; qualified names keep every dotted part — the
+        # resolver matches relation aliases then walks struct fields
+        # (Catalyst's resolution order)
         self.next()
-        if self.peek()[1] == "." and self.peek(1)[0] == "id":
+        parts = [val]
+        while self.peek()[1] == "." and self.peek(1)[0] == "id":
             self.next()
-            _, col2 = self.next()
-            return F.col(col2)
-        return F.col(val)
+            parts.append(self.next()[1])
+        return F.col(".".join(parts))
 
     def _type_name(self) -> str:
         parts = [self.next()[1]]

@@ -45,7 +45,8 @@ def estimate_plan_bytes(p: L.LogicalPlan) -> Optional[int]:
             elif os.path.exists(path):
                 total += os.path.getsize(path)
         return total
-    if isinstance(p, (L.Project, L.Filter, L.Limit, L.Sort)):
+    if isinstance(p, (L.Project, L.Filter, L.Limit, L.Sort,
+                      L.SubqueryAlias)):
         return estimate_plan_bytes(p.child)
     return None
 
@@ -62,6 +63,11 @@ class Planner:
             raise NotImplementedError(
                 f"no physical planning for {type(plan).__name__}")
         return m(plan)
+
+    def _plan_subqueryalias(self, p) -> P.PhysicalPlan:
+        # physically transparent: the alias only re-qualifies attributes
+        # (same expr_ids), so the child's plan IS the plan
+        return self.plan(p.child)
 
     # -- sources -----------------------------------------------------------
     def _plan_localrelation(self, p: L.LocalRelation) -> P.PhysicalPlan:

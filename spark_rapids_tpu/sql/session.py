@@ -126,7 +126,10 @@ class TpuSparkSession:
         return DataFrameReader(self)
 
     def table(self, name: str) -> DataFrame:
-        return DataFrame(self.catalog_views[name.lower()], self)
+        # qualify outputs by the table name (Spark does the same), so
+        # `SELECT t.col FROM t JOIN u ...` resolves unambiguously
+        return DataFrame(
+            L.SubqueryAlias(name, self.catalog_views[name.lower()]), self)
 
     def sql(self, query: str) -> DataFrame:
         from spark_rapids_tpu.sql.parser import parse_sql
@@ -275,25 +278,10 @@ def _infer_type_from_values(values: Iterable[Any]) -> T.DataType:
 
 
 def _parse_ddl_schema(ddl: str) -> T.StructType:
-    from spark_rapids_tpu.sql.functions import _parse_type
+    from spark_rapids_tpu.sql.functions import _parse_type, split_top_level
     # split on commas not inside parens (decimal(10,2) etc.)
-    parts: List[str] = []
-    depth = 0
-    cur = ""
-    for ch in ddl:
-        if ch == "," and depth == 0:
-            parts.append(cur)
-            cur = ""
-            continue
-        if ch in "(<":
-            depth += 1
-        elif ch in ")>":
-            depth -= 1
-        cur += ch
-    if cur.strip():
-        parts.append(cur)
     fields = []
-    for part in parts:
+    for part in split_top_level(ddl):
         name, _, tp = part.strip().partition(" ")
         fields.append(T.StructField(name.strip(), _parse_type(tp.strip())))
     return T.StructType(fields)

@@ -133,3 +133,61 @@ def test_multiple_distinct_over_different_columns_rejected():
                   "FROM md").collect()
     finally:
         s.stop()
+
+
+def test_qualified_column_resolution():
+    """`t.col` references resolve against relation aliases (Catalyst
+    SubqueryAlias role): join conditions, self-joins with aliases, and
+    struct-field fallback — all dual-session (sql/logical.py resolve)."""
+    from harness import assert_tpu_and_cpu_equal_collect
+
+    def q(spark):
+        fact = spark.createDataFrame(
+            {"k": [1, 2, 3, 2, None], "v": [10, 20, 30, 40, 50]},
+            "k int, v int")
+        dim = spark.createDataFrame(
+            {"k": [1, 2, 3], "name": ["a", "b", "c"]},
+            "k int, name string")
+        fact.createOrReplaceTempView("fact")
+        dim.createOrReplaceTempView("dim")
+        return spark.sql(
+            "SELECT fact.k, dim.name, v FROM fact "
+            "JOIN dim ON fact.k = dim.k ORDER BY v")
+    assert_tpu_and_cpu_equal_collect(q)
+
+    def self_join(spark):
+        t = spark.createDataFrame({"k": [1, 1, 2], "v": [5, 7, 9]},
+                                  "k int, v int")
+        t.createOrReplaceTempView("t")
+        return spark.sql("SELECT a.v, b.v FROM t a JOIN t b "
+                         "ON a.k = b.k WHERE a.v < b.v")
+    assert_tpu_and_cpu_equal_collect(self_join)
+
+
+def test_struct_field_dot_access_sql():
+    """`s.f` falls back to struct-field extraction when no qualifier
+    matches, and the output column is named after the field."""
+    from harness import assert_tpu_and_cpu_equal_collect
+
+    def q(spark):
+        t = spark.createDataFrame(
+            {"s": [{"x": 1, "y": "p"}, {"x": 2, "y": "q"}, None]},
+            "s struct<x:int,y:string>")
+        t.createOrReplaceTempView("ts")
+        return spark.sql("SELECT s.x FROM ts WHERE s.y = 'q'")
+    assert_tpu_and_cpu_equal_collect(q)
+
+
+def test_ambiguous_unqualified_still_errors():
+    import pytest
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    sp = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        a = sp.createDataFrame({"k": [1]}, "k int")
+        b = sp.createDataFrame({"k": [1]}, "k int")
+        a.createOrReplaceTempView("a")
+        b.createOrReplaceTempView("b")
+        with pytest.raises(KeyError):
+            sp.sql("SELECT k FROM a JOIN b ON a.k = b.k").collect()
+    finally:
+        sp.stop()
