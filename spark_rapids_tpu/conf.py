@@ -271,6 +271,12 @@ PARQUET_READER_TYPE = conf("spark.rapids.sql.format.parquet.reader.type").doc(
     "PERFILE, MULTITHREADED or COALESCING parquet reader strategy "
     "(RapidsConf.scala:719-733).").string("MULTITHREADED")
 
+CONCURRENT_PYTHON_WORKERS = conf(
+    "spark.rapids.python.concurrentPythonWorkers").doc(
+    "Max concurrent python worker processes for pandas UDFs "
+    "(PythonConfEntries.scala:32 twin; the pool is the throttle the "
+    "reference implements as PythonWorkerSemaphore).").integer(2)
+
 MULTITHREADED_READ_NUM_THREADS = conf(
     "spark.rapids.sql.format.parquet.multiThreadedRead.numThreads").doc(
     "Thread pool size for the multithreaded reader "

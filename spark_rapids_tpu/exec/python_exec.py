@@ -1,0 +1,289 @@
+"""ArrowEvalPython / MapInPandas execs: the engine side of the pandas
+UDF path (GpuArrowEvalPythonExec.scala:487, GpuMapInPandasExec.scala).
+
+Shape mirrors the reference: only the UDFs' INPUT columns travel to the
+python worker (Arrow IPC through the process pool in python/pool.py);
+the result columns come back as Arrow and re-join the batch. On the
+device variant the surrounding batch never leaves HBM — the batch is
+compacted (a device program), just the input columns are fetched, and
+the worker's output uploads at the same capacity so the result columns
+zip with the device-resident originals (the BatchQueue zip of
+GpuArrowEvalPythonExec:543).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Iterator, List, Tuple
+
+from spark_rapids_tpu import metrics as M
+from spark_rapids_tpu.columnar.host import HostBatch
+from spark_rapids_tpu.conf import TpuConf
+from spark_rapids_tpu.exec.base import (DevicePartitionThunk, TpuExec,
+                                        device_channel)
+from spark_rapids_tpu.sql import expressions as E
+from spark_rapids_tpu.sql import physical as P
+from spark_rapids_tpu.sql import types as T
+
+
+def _ipc_bytes(tbl) -> bytes:
+    import pyarrow as pa
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, tbl.schema) as wr:
+        wr.write_table(tbl)
+    return sink.getvalue()
+
+
+def _ipc_read(b: bytes):
+    import pyarrow as pa
+    with pa.ipc.open_stream(io.BytesIO(b)) as rd:
+        return rd.read_all()
+
+
+def _schema_ipc(schema) -> bytes:
+    return _ipc_bytes(schema.empty_table())
+
+
+class CpuArrowEvalPythonExec(P.PhysicalPlan):
+    """Evaluates scalar pandas UDFs through the worker pool; output =
+    child output + one column per UDF (ArrowEvalPythonExec twin)."""
+
+    def __init__(self, udfs: List[E.Alias], child: P.PhysicalPlan,
+                 conf: TpuConf):
+        self.children = [child]
+        self.udfs = udfs  # Alias(PandasUDF) each
+        self.conf = conf
+        self.metrics = M.MetricRegistry("essential")
+
+    @property
+    def child(self) -> P.PhysicalPlan:
+        return self.children[0]
+
+    @property
+    def output(self):
+        return list(self.child.output) + [E.named_output(u)
+                                          for u in self.udfs]
+
+    def _plan_payload(self, input_attrs) -> Tuple[Tuple, List[int], "T.Any"]:
+        """(worker payload, needed child column indices, arrow input
+        schema). Bound once per partition set."""
+        import cloudpickle
+
+        from spark_rapids_tpu.io.arrow_convert import sql_schema_to_arrow
+        have = {a.expr_id: i for i, a in enumerate(input_attrs)}
+        needed: List[int] = []
+        arg_idxs: List[List[int]] = []
+        fn_blobs: List[bytes] = []
+        for u in self.udfs:
+            f: E.PandasUDF = u.child  # type: ignore[assignment]
+            idxs = []
+            for c in f.children:
+                assert isinstance(c, E.AttributeReference), \
+                    "extractor must leave plain attribute inputs"
+                j = have[c.expr_id]
+                if j not in needed:
+                    needed.append(j)
+                idxs.append(needed.index(j))
+            arg_idxs.append(idxs)
+            fn_blobs.append(cloudpickle.dumps(f.fn))
+        out_schema = sql_schema_to_arrow(T.StructType(
+            [T.StructField(u.name, u.data_type, True)
+             for u in self.udfs]))
+        in_schema = sql_schema_to_arrow(T.StructType(
+            [T.StructField(input_attrs[j].name,
+                           input_attrs[j].data_type, True)
+             for j in needed]))
+        payload = (fn_blobs, arg_idxs, _schema_ipc(out_schema))
+        return payload, needed, in_schema
+
+    def _run_udfs(self, hb_cols, n_rows: int, payload, in_schema,
+                  pool) -> List:
+        """Send the input columns, get one HostColumn per UDF back."""
+        import pyarrow as pa
+
+        from spark_rapids_tpu.io.arrow_convert import (arrow_column_to_host,
+                                                       host_column_to_arrow)
+        arrays = [host_column_to_arrow(c) for c in hb_cols]
+        tbl = pa.Table.from_arrays(arrays, schema=in_schema) if arrays \
+            else pa.table({"_": pa.nulls(n_rows, pa.int32())})
+        with self.metrics.timed("pythonEvalTime"):
+            out = _ipc_read(pool.run("scalar", payload, _ipc_bytes(tbl)))
+        return [arrow_column_to_host(out.column(i), u.data_type)
+                for i, u in enumerate(self.udfs)]
+
+    def partitions(self) -> List[P.PartitionThunk]:
+        from spark_rapids_tpu.python.pool import get_worker_pool
+        payload, needed, in_schema = self._plan_payload(self.child.output)
+        pool = get_worker_pool(self.conf)
+        schema = self.schema
+
+        def make(thunk: P.PartitionThunk) -> P.PartitionThunk:
+            def run() -> Iterator[HostBatch]:
+                for b in thunk():
+                    cols = self._run_udfs([b.columns[j] for j in needed],
+                                          b.num_rows, payload, in_schema,
+                                          pool)
+                    yield HostBatch(schema, list(b.columns) + cols,
+                                    b.num_rows)
+            return run
+        return [make(t) for t in self.child.partitions()]
+
+    def simple_string(self):
+        return f"ArrowEvalPython {[u.name for u in self.udfs]}"
+
+
+class TpuArrowEvalPythonExec(TpuExec):
+    """Device variant: the batch stays in HBM; only UDF input columns
+    round-trip through the worker (GpuArrowEvalPythonExec.scala:487)."""
+
+    def __init__(self, cpu: CpuArrowEvalPythonExec, child: TpuExec,
+                 conf: TpuConf):
+        super().__init__(conf)
+        self.children = [child]
+        self.udfs = cpu.udfs
+        self._cpu = cpu
+
+    @property
+    def child(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def output(self):
+        return list(self.child.output) + [E.named_output(u)
+                                          for u in self.udfs]
+
+    def device_partitions(self) -> List[DevicePartitionThunk]:
+        from spark_rapids_tpu.columnar.device import (DeviceBatch, compact,
+                                                      finish_to_host)
+        from spark_rapids_tpu.columnar.transfer import upload_batch
+        from spark_rapids_tpu.python.pool import get_worker_pool
+        payload, needed, in_schema = self._cpu._plan_payload(
+            self.child.output)
+        pool = get_worker_pool(self.conf)
+        schema = self.schema
+        child_fields = list(self.child.schema.fields)
+
+        def make(thunk: DevicePartitionThunk) -> DevicePartitionThunk:
+            def run() -> Iterator[DeviceBatch]:
+                for b in thunk():
+                    # compact so active rows form a prefix: the python
+                    # result rows then align with device rows by index
+                    b = compact(b)
+                    sub = DeviceBatch(
+                        T.StructType([child_fields[j] for j in needed]),
+                        [b.columns[j] for j in needed], b.active,
+                        b._num_rows, b._num_rows_dev)
+                    with self.metrics.timed("copyFromDeviceTime"):
+                        hb = sub.to_host()
+                    cols = self._cpu._run_udfs(hb.columns, hb.num_rows,
+                                               payload, in_schema, pool)
+                    res = HostBatch(T.StructType(
+                        [T.StructField(u.name, u.data_type, True)
+                         for u in self.udfs]), cols, hb.num_rows)
+                    with self.metrics.timed(M.COPY_TO_DEVICE_TIME):
+                        up = upload_batch(res, b.capacity)
+                    yield DeviceBatch(schema,
+                                      list(b.columns) + list(up.columns),
+                                      b.active, hb.num_rows)
+            return run
+        return [make(t) for t in device_channel(self.child)]
+
+    def simple_string(self):
+        return f"TpuArrowEvalPython {[u.name for u in self.udfs]}"
+
+
+class CpuMapInPandasExec(P.PhysicalPlan):
+    """DataFrame.mapInPandas through the worker pool
+    (GpuMapInPandasExec role)."""
+
+    def __init__(self, fn, out_schema: T.StructType, child: P.PhysicalPlan,
+                 conf: TpuConf, output=None):
+        self.children = [child]
+        self.fn = fn
+        self._schema = out_schema
+        # reuse the logical node's expr_ids when given — downstream
+        # operators bind by id, fresh attrs would not resolve
+        self._output = list(output) if output is not None else [
+            E.AttributeReference(f.name, f.data_type, f.nullable)
+            for f in out_schema.fields]
+        self.conf = conf
+        self.metrics = M.MetricRegistry("essential")
+
+    @property
+    def child(self) -> P.PhysicalPlan:
+        return self.children[0]
+
+    @property
+    def output(self):
+        return self._output
+
+    def _payload(self) -> Tuple:
+        import cloudpickle
+
+        from spark_rapids_tpu.io.arrow_convert import sql_schema_to_arrow
+        return (cloudpickle.dumps(self.fn),
+                _schema_ipc(sql_schema_to_arrow(self._schema)))
+
+    def _map_batch(self, hb: HostBatch, payload, pool) -> HostBatch:
+        from spark_rapids_tpu.io.arrow_convert import (arrow_to_host_batch,
+                                                       host_batch_to_arrow)
+        with self.metrics.timed("pythonEvalTime"):
+            out = _ipc_read(pool.run("map", payload,
+                                     _ipc_bytes(host_batch_to_arrow(hb))))
+        return arrow_to_host_batch(out, self._schema)
+
+    def partitions(self) -> List[P.PartitionThunk]:
+        from spark_rapids_tpu.python.pool import get_worker_pool
+        payload = self._payload()
+        pool = get_worker_pool(self.conf)
+
+        def make(thunk: P.PartitionThunk) -> P.PartitionThunk:
+            def run() -> Iterator[HostBatch]:
+                for b in thunk():
+                    yield self._map_batch(b, payload, pool)
+            return run
+        return [make(t) for t in self.child.partitions()]
+
+    def simple_string(self):
+        return f"MapInPandas {getattr(self.fn, '__name__', '<fn>')}"
+
+
+class TpuMapInPandasExec(TpuExec):
+    """Device variant: batches download, map in the worker, result
+    re-uploads (the whole row set IS the UDF input here, unlike the
+    scalar path)."""
+
+    def __init__(self, cpu: CpuMapInPandasExec, child: TpuExec,
+                 conf: TpuConf):
+        super().__init__(conf)
+        self.children = [child]
+        self._cpu = cpu
+
+    @property
+    def child(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def output(self):
+        return self._cpu.output
+
+    def device_partitions(self) -> List[DevicePartitionThunk]:
+        from spark_rapids_tpu.columnar.device import DeviceBatch
+        from spark_rapids_tpu.python.pool import get_worker_pool
+        payload = self._cpu._payload()
+        pool = get_worker_pool(self.conf)
+
+        def make(thunk: DevicePartitionThunk) -> DevicePartitionThunk:
+            def run() -> Iterator[DeviceBatch]:
+                for b in thunk():
+                    with self.metrics.timed("copyFromDeviceTime"):
+                        hb = b.to_host()
+                    out = self._cpu._map_batch(hb, payload, pool)
+                    with self.metrics.timed(M.COPY_TO_DEVICE_TIME):
+                        yield DeviceBatch.from_host(out)
+            return run
+        return [make(t) for t in device_channel(self.child)]
+
+    def simple_string(self):
+        return self._cpu.simple_string().replace("MapInPandas",
+                                                 "TpuMapInPandas")

@@ -614,6 +614,29 @@ from spark_rapids_tpu.io.readers import CpuFileScanExec  # noqa: E402
 from spark_rapids_tpu.io.cache import CpuCachedScanExec  # noqa: E402
 register_transparent_cpu(CpuFileScanExec, CpuCachedScanExec)
 
+from spark_rapids_tpu.exec import python_exec as PY  # noqa: E402
+
+
+def _conv_arrow_eval(meta, kids):
+    from spark_rapids_tpu.exec.python_exec import TpuArrowEvalPythonExec
+    return TpuArrowEvalPythonExec(meta.wrapped, kids[0], meta.conf)
+
+
+def _conv_map_in_pandas(meta, kids):
+    from spark_rapids_tpu.exec.python_exec import TpuMapInPandasExec
+    return TpuMapInPandasExec(meta.wrapped, kids[0], meta.conf)
+
+
+exec_rule(PY.CpuArrowEvalPythonExec,
+          "scalar pandas UDFs via the python worker pool; the "
+          "surrounding plan stays on device "
+          "(GpuArrowEvalPythonExec.scala:487)",
+          convert_fn=_conv_arrow_eval)
+exec_rule(PY.CpuMapInPandasExec,
+          "mapInPandas via the python worker pool "
+          "(GpuMapInPandasExec role)",
+          convert_fn=_conv_map_in_pandas)
+
 
 # ---------------------------------------------------------------------------
 # Entry points

@@ -1,0 +1,4 @@
+"""Python-worker side module (the reference's python/ dir +
+sql-plugin execution/python package): vectorized pandas UDFs evaluated
+in a pool of WORKER PROCESSES that speak Arrow IPC with the engine
+(GpuArrowEvalPythonExec.scala:487, GpuArrowPythonRunner:353 roles)."""

@@ -68,6 +68,16 @@ class DataFrame:
         ``name.col`` references resolve (SubqueryAlias node)."""
         return DataFrame(L.SubqueryAlias(name, self.plan), self.session)
 
+    def mapInPandas(self, func, schema) -> "DataFrame":
+        """pyspark DataFrame.mapInPandas: ``func(iter_of_pdf) ->
+        iter_of_pdf`` runs in the python worker pool over Arrow IPC
+        (GpuMapInPandasExec role)."""
+        if isinstance(schema, str):
+            from spark_rapids_tpu.sql.session import _parse_ddl_schema
+            schema = _parse_ddl_schema(schema)
+        return DataFrame(L.MapInPandas(func, schema, self.plan),
+                         self.session)
+
     def select(self, *cols) -> "DataFrame":
         items: List[E.Expression] = []
         for c in cols:

@@ -3926,6 +3926,50 @@ class WindowExpression(Expression):
 # reports for un-compiled UDFs)
 # ---------------------------------------------------------------------------
 
+class PandasUDF(Expression):
+    """Vectorized (scalar) pandas UDF (sql/core PythonUDF with
+    SQL_SCALAR_PANDAS_UDF evalType; GpuPythonUDF.scala role). The
+    planner EXTRACTS these out of projections into an
+    ArrowEvalPythonExec (Spark's ExtractPythonUDFs rule) — eval() here
+    is the in-process fallback used when one appears in an expression
+    position the extractor doesn't cover (filters, sort keys)."""
+
+    def __init__(self, fn, name: str, dtype: T.DataType,
+                 children: List[Expression]):
+        self.children = list(children)
+        self.fn = fn
+        self.name = name
+        self._dtype = dtype
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self._dtype
+
+    def eval(self, batch: HostBatch) -> HostColumn:
+        import pandas as pd
+
+        from spark_rapids_tpu.io.arrow_convert import (arrow_column_to_host,
+                                                       host_column_to_arrow,
+                                                       sql_type_to_arrow)
+        args = []
+        for c in self.children:
+            args.append(host_column_to_arrow(c.eval(batch)).to_pandas())
+        out = self.fn(*args)
+        if not isinstance(out, pd.Series):
+            out = pd.Series([out] * batch.num_rows)
+        import pyarrow as pa
+        arr = pa.Array.from_pandas(out,
+                                   type=sql_type_to_arrow(self._dtype))
+        if len(arr) != batch.num_rows:
+            raise ValueError(
+                f"pandas_udf {self.name} returned {len(arr)} rows for a "
+                f"{batch.num_rows}-row batch")
+        return arrow_column_to_host(arr, self._dtype)
+
+    def __repr__(self) -> str:
+        return f"{self.name}({self.children})"
+
+
 class PythonUDF(Expression):
     def __init__(self, fn, name: str, dtype: T.DataType,
                  children: List[Expression]):

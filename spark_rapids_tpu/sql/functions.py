@@ -947,6 +947,33 @@ def lead(c, offset: int = 1, default=None) -> Column:
     return Column(E.Lead(e, int(offset), d))
 
 
+def pandas_udf(f=None, returnType=None):
+    """pyspark.sql.functions.pandas_udf twin (SCALAR evalType): the
+    function receives pandas Series and returns a Series. Evaluated
+    vectorized through the python worker pool (Arrow IPC) by
+    ArrowEvalPythonExec — on the TPU session the surrounding plan stays
+    on device (GpuArrowEvalPythonExec.scala:487 role)."""
+    if f is not None and not callable(f):
+        f, returnType = None, f
+    if returnType is None:
+        # pyspark requires a return type for SCALAR pandas UDFs too —
+        # silently defaulting would coerce results to the wrong type
+        raise ValueError("pandas_udf requires a returnType, e.g. "
+                         "@pandas_udf('long')")
+    rt = _parse_type(returnType)
+
+    def wrap(fn):
+        def call(*cols) -> Column:
+            exprs = [_to_expr(col(c) if isinstance(c, str) else c)
+                     for c in cols]
+            return Column(E.PandasUDF(
+                fn, getattr(fn, "__name__", "pandas_udf"), rt, exprs))
+        return call
+    if f is not None:
+        return wrap(f)
+    return wrap
+
+
 def udf(f=None, returnType=None):
     """pyspark.sql.functions.udf twin: a host-evaluated Python UDF. The
     plan rewrite reports it NOT_ON_GPU (same placement the reference

@@ -94,6 +94,28 @@ def resolve(expr: Expression, inputs: Sequence[AttributeReference],
     return expr.transform(rule)
 
 
+class MapInPandas(LogicalPlan):
+    """DataFrame.mapInPandas(func, schema) (sql/core MapInPandas)."""
+
+    def __init__(self, fn, schema: T.StructType, child: LogicalPlan):
+        self.children = [child]
+        self.fn = fn
+        self._schema = schema
+        self._output = [AttributeReference(f.name, f.data_type, f.nullable)
+                        for f in schema.fields]
+
+    @property
+    def child(self) -> LogicalPlan:
+        return self.children[0]
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        return self._output
+
+    def simple_string(self) -> str:
+        return f"MapInPandas {getattr(self.fn, '__name__', '<fn>')}"
+
+
 class SubqueryAlias(LogicalPlan):
     """Relation alias (Catalyst SubqueryAlias): same expr_ids, outputs
     re-qualified so ``alias.col`` references resolve. Physically

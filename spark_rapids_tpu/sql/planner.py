@@ -86,9 +86,70 @@ class Planner:
         return P.CpuRangeExec(p.output, p.start, p.end, p.step,
                               p.num_partitions)
 
+    def _plan_mapinpandas(self, p) -> P.PhysicalPlan:
+        from spark_rapids_tpu.exec.python_exec import CpuMapInPandasExec
+        # the logical node's output attrs pass through (downstream
+        # operators already resolved against those expr_ids)
+        return CpuMapInPandasExec(p.fn, p._schema, self.plan(p.child),
+                                  self.conf, output=p.output)
+
+    def _extract_pandas_udfs(self, project_list, child):
+        """ExtractPythonUDFs rule (sql/core python rules; the reference
+        converts the result to GpuArrowEvalPythonExec): pull every
+        PandasUDF subtree into an ArrowEvalPython node below the
+        projection and substitute attribute references. UDF arguments
+        that are not plain attributes are pre-projected. PURE: the
+        logical expressions are never mutated (a DataFrame plans once
+        per execution; explain + collect must both see the UDFs)."""
+        extra: List[E.Alias] = []
+        udfs: dict = {}  # semantic key -> Alias(PandasUDF-copy)
+
+        def sub(e):
+            if not isinstance(e, E.PandasUDF):
+                return None
+            # the whole arg subtree must be free of already-extracted
+            # UDF outputs (bottom-up transform replaced inner UDFs with
+            # their _pudfN attrs): one eval node cannot feed itself
+            udf_ids = {al.expr_id for al in udfs.values()}
+            for a in e.children:
+                if a.collect(lambda x: isinstance(
+                        x, E.AttributeReference)
+                        and x.expr_id in udf_ids):
+                    raise NotImplementedError(
+                        "nested pandas UDF calls are not supported")
+            # dedup on the ORIGINAL arg subtrees so identical calls with
+            # expression args also evaluate once
+            key = (id(e.fn), repr(e.children), repr(e.data_type))
+            al = udfs.get(key)
+            if al is None:
+                new_args = []
+                for a in e.children:
+                    if isinstance(a, E.AttributeReference):
+                        new_args.append(a)
+                    else:
+                        arg_al = E.Alias(a, f"_pudf_arg{len(extra)}")
+                        extra.append(arg_al)
+                        new_args.append(arg_al.to_attribute())
+                al = E.Alias(
+                    E.PandasUDF(e.fn, e.name, e.data_type, new_args),
+                    f"_pudf{len(udfs)}")
+                udfs[key] = al
+            return al.to_attribute()
+
+        new_list = [e.transform(sub) for e in project_list]
+        if not udfs:
+            return project_list, child
+        from spark_rapids_tpu.exec.python_exec import CpuArrowEvalPythonExec
+        if extra:
+            child = P.CpuProjectExec(list(child.output) + extra, child)
+        return new_list, CpuArrowEvalPythonExec(
+            list(udfs.values()), child, self.conf)
+
     # -- simple unary ------------------------------------------------------
     def _plan_project(self, p: L.Project) -> P.PhysicalPlan:
         child = self.plan(p.child)
+        plist, child = self._extract_pandas_udfs(p.project_list, child)
+        p = L.Project(plist, p.child)
         # input_file_name() needs per-file batches: downgrade a
         # COALESCING scan under this project to PERFILE (the reference's
         # InputFileBlockRule forces the same, GpuOverrides.scala)

@@ -1,0 +1,133 @@
+"""Vectorized pandas UDF path: ArrowEvalPython extraction + the python
+worker pool (python/worker.py, python/pool.py, exec/python_exec.py —
+GpuArrowEvalPythonExec.scala:487 / GpuMapInPandasExec roles)."""
+
+import pytest
+
+from harness import assert_tpu_and_cpu_equal_collect
+
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.session import TpuSparkSession
+
+
+def test_scalar_pandas_udf_dual_session():
+    @F.pandas_udf("long")
+    def plus_one(s):
+        return s + 1
+
+    @F.pandas_udf("string")
+    def shout(s):
+        return s.str.upper() + "!"
+
+    def q(spark):
+        df = spark.createDataFrame(
+            {"a": [1, 2, None, 4, 5] * 20,
+             "s": ["x", None, "zz", "w", "héllo"] * 20},
+            "a long, s string")
+        return df.select(F.col("a"), plus_one("a").alias("a1"),
+                         shout("s").alias("u"),
+                         plus_one(F.col("a") * 2).alias("a2"))
+    assert_tpu_and_cpu_equal_collect(q)
+
+
+def test_pandas_udf_two_args_and_dedup():
+    @F.pandas_udf("double")
+    def ratio(a, b):
+        return a / b
+
+    def q(spark):
+        df = spark.createDataFrame(
+            {"a": [1.0, 2.0, None, 4.0], "b": [2.0, 0.5, 1.0, None]},
+            "a double, b double")
+        # the same UDF call twice must evaluate once (extractor dedup)
+        return df.select(ratio("a", "b").alias("r1"),
+                         (ratio("a", "b") * 2).alias("r2"))
+    assert_tpu_and_cpu_equal_collect(q, approx=True)
+
+
+def test_pandas_udf_placement_device():
+    """The surrounding plan stays ON DEVICE around the python exchange
+    (the whole point of GpuArrowEvalPythonExec)."""
+    @F.pandas_udf("long")
+    def twice(s):
+        return s * 2
+
+    sp = TpuSparkSession({"spark.rapids.sql.enabled": "true",
+                          "spark.rapids.sql.test.forceDevice": "true"})
+    try:
+        sp.start_capture()
+        df = sp.createDataFrame({"a": list(range(100))}, "a long")
+        out = df.select(twice("a").alias("t")) \
+            .filter(F.col("t") > 100).collect()
+        plans = sp.get_captured_plans()
+    finally:
+        sp.stop()
+    assert sorted(r[0] for r in out) == list(range(102, 200, 2))
+    s = "\n".join(p.tree_string() for p in plans)
+    assert "TpuArrowEvalPython" in s, s
+    assert "TpuFilter" in s, s
+
+
+def test_pandas_udf_error_propagates():
+    @F.pandas_udf("long")
+    def boom(s):
+        raise ValueError("intentional udf failure")
+
+    from spark_rapids_tpu.python.pool import PythonWorkerError
+    sp = TpuSparkSession({"spark.rapids.sql.enabled": "true"})
+    try:
+        df = sp.createDataFrame({"a": [1, 2]}, "a long")
+        with pytest.raises(PythonWorkerError,
+                           match="intentional udf failure"):
+            df.select(boom("a").alias("b")).collect()
+        # the worker survives a UDF error and serves the next call
+        ok = df.select(F.col("a")).collect()
+        assert [r[0] for r in ok] == [1, 2]
+    finally:
+        sp.stop()
+
+
+def test_map_in_pandas_dual_session():
+    def add_cols(it):
+        for pdf in it:
+            pdf = pdf.copy()
+            pdf["b"] = pdf["a"] * 3
+            yield pdf[["a", "b"]]
+
+    def q(spark):
+        df = spark.createDataFrame(
+            {"a": list(range(50)), "junk": ["x"] * 50},
+            "a long, junk string")
+        return df.mapInPandas(add_cols, "a long, b long")
+    assert_tpu_and_cpu_equal_collect(q)
+
+
+def test_map_in_pandas_changes_row_count():
+    def explode_evens(it):
+        for pdf in it:
+            keep = pdf[pdf["a"] % 2 == 0]
+            import pandas as pd
+            yield pd.concat([keep, keep])
+
+    def q(spark):
+        df = spark.createDataFrame({"a": list(range(20))}, "a long")
+        return df.mapInPandas(explode_evens, "a long")
+    assert_tpu_and_cpu_equal_collect(q, ignore_order=True)
+
+
+def test_worker_pool_reuse():
+    """One worker serves many batches (no per-batch process spawn)."""
+    from spark_rapids_tpu.python import pool as pool_mod
+    from spark_rapids_tpu.conf import TpuConf
+    p = pool_mod.get_worker_pool(TpuConf({}))
+    import cloudpickle
+    import pyarrow as pa
+    from spark_rapids_tpu.exec.python_exec import _ipc_bytes, _ipc_read
+
+    schema_ipc = _ipc_bytes(pa.schema([("x", pa.int64())]).empty_table())
+    payload = ([cloudpickle.dumps(lambda s: s + 1)], [[0]], schema_ipc)
+    for i in range(4):
+        tbl = pa.table({"v": pa.array([i, i + 1], pa.int64())})
+        out = _ipc_read(p.run("scalar", payload, _ipc_bytes(tbl)))
+        assert out.column(0).to_pylist() == [i + 1, i + 2]
+    assert p._created <= p.size
