@@ -13,7 +13,7 @@ GpuArrowEvalPythonExec:543).
 
 from __future__ import annotations
 
-import io
+
 from typing import Iterator, List, Tuple
 
 from spark_rapids_tpu import metrics as M
@@ -26,18 +26,10 @@ from spark_rapids_tpu.sql import physical as P
 from spark_rapids_tpu.sql import types as T
 
 
-def _ipc_bytes(tbl) -> bytes:
-    import pyarrow as pa
-    sink = io.BytesIO()
-    with pa.ipc.new_stream(sink, tbl.schema) as wr:
-        wr.write_table(tbl)
-    return sink.getvalue()
-
-
-def _ipc_read(b: bytes):
-    import pyarrow as pa
-    with pa.ipc.open_stream(io.BytesIO(b)) as rd:
-        return rd.read_all()
+# one IPC round-trip implementation, shared with the worker side — the
+# framing and table codec must never diverge between the two processes
+from spark_rapids_tpu.python.worker import _read_table as _ipc_read
+from spark_rapids_tpu.python.worker import _write_table as _ipc_bytes
 
 
 def _schema_ipc(schema) -> bytes:
@@ -280,7 +272,8 @@ class TpuMapInPandasExec(TpuExec):
                         hb = b.to_host()
                     out = self._cpu._map_batch(hb, payload, pool)
                     with self.metrics.timed(M.COPY_TO_DEVICE_TIME):
-                        yield DeviceBatch.from_host(out)
+                        up = DeviceBatch.from_host(out)
+                    yield up
             return run
         return [make(t) for t in device_channel(self.child)]
 

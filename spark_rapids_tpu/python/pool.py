@@ -5,9 +5,9 @@ distinct from the GPU one (python/PythonWorkerSemaphore.scala,
 spark.rapids.python.concurrentPythonWorkers in PythonConfEntries.scala
 :32); here the pool IS the throttle: at most ``concurrentPythonWorkers``
 processes exist, and a task borrowing a worker blocks until one frees.
-Workers start with the ``spawn`` context (a fork of the engine process
-would duplicate the initialized TPU client) and are reused across
-batches and queries until shutdown.
+Workers are plain subprocesses (no fork of the engine process, so the
+initialized TPU client never duplicates into a child) and are reused
+across batches and queries until shutdown.
 """
 
 from __future__ import annotations
@@ -148,7 +148,9 @@ _POOL_LOCK = threading.Lock()
 
 def get_worker_pool(conf) -> PythonWorkerPool:
     from spark_rapids_tpu.conf import CONCURRENT_PYTHON_WORKERS
-    size = int(conf.get(CONCURRENT_PYTHON_WORKERS))
+    # clamp BEFORE the staleness compare: an unclamped 0 would mismatch
+    # the pool's clamped size forever and churn pools mid-query
+    size = max(1, int(conf.get(CONCURRENT_PYTHON_WORKERS)))
     global _POOL
     with _POOL_LOCK:
         if _POOL is None or _POOL.size != size:

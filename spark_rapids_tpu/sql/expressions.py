@@ -3926,6 +3926,91 @@ class WindowExpression(Expression):
 # reports for un-compiled UDFs)
 # ---------------------------------------------------------------------------
 
+class ScalarSubquery(Expression):
+    """Uncorrelated scalar subquery `(SELECT ... )` in expression
+    position (Catalyst ScalarSubquery; the reference keeps the plan on
+    device via GpuScalarSubquery over a materialized value). The session
+    materializes it to a Literal before physical planning
+    (session.plan_physical) — this node never reaches execution."""
+
+    def __init__(self, plan, dtype: T.DataType):
+        self.children = []
+        self.plan = plan
+        self._dtype = dtype
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self._dtype
+
+    def __repr__(self) -> str:
+        return "scalar-subquery"
+
+
+def materialize_scalar_subqueries(plan, session):
+    """Replace every ScalarSubquery with the Literal it evaluates to
+    (executing each subquery ONCE per query, like Spark's subquery
+    reuse). Enforces the at-most-one-row contract."""
+    cache: dict = {}
+
+    def subst(e: Expression):
+        if not isinstance(e, ScalarSubquery):
+            return None
+        key = id(e.plan)
+        if key not in cache:
+            batch = session.execute_plan(e.plan)
+            if batch.num_rows > 1:
+                raise ValueError(
+                    "scalar subquery returned more than one row")
+            if batch.num_rows == 0 or not batch.columns[0].validity[0]:
+                val = None
+            else:
+                val = batch.columns[0].to_pylist()[0]
+            cache[key] = Literal(val, e.data_type)
+        return cache[key]
+
+    _EXPR_ATTRS = ("project_list", "condition", "aggregates",
+                   "grouping", "order", "window_exprs",
+                   "partition_spec", "order_spec", "generator",
+                   "expressions")
+
+    def walk(p):
+        """Copy-on-write: the input plan keeps its ScalarSubquery nodes
+        so a later collect() re-evaluates against fresh data."""
+        import copy as _copy
+        new_children = [walk(c) for c in p.children]
+        repl = {}
+        for attr in _EXPR_ATTRS:
+            v = getattr(p, attr, None)
+            if isinstance(v, list) and any(isinstance(x, Expression)
+                                           for x in v):
+                repl[attr] = [x.transform(subst)
+                              if isinstance(x, Expression) else x
+                              for x in v]
+            elif isinstance(v, Expression):
+                repl[attr] = v.transform(subst)
+        if new_children == p.children and not repl:
+            return p
+        q = _copy.copy(p)
+        q.children = new_children
+        for k, v in repl.items():
+            setattr(q, k, v)
+        return q
+
+    def has_subquery(p) -> bool:
+        for attr in _EXPR_ATTRS:
+            v = getattr(p, attr, None)
+            vs = v if isinstance(v, list) else [v] if v is not None else []
+            for x in vs:
+                if isinstance(x, Expression) and x.collect(
+                        lambda n: isinstance(n, ScalarSubquery)):
+                    return True
+        return any(has_subquery(c) for c in p.children)
+
+    if has_subquery(plan):
+        return walk(plan)
+    return plan
+
+
 class PandasUDF(Expression):
     """Vectorized (scalar) pandas UDF (sql/core PythonUDF with
     SQL_SCALAR_PANDAS_UDF evalType; GpuPythonUDF.scala role). The

@@ -475,6 +475,19 @@ class _Parser:
     def primary(self) -> Column:
         kind, val = self.peek()
         if val == "(":
+            if self.peek(1)[1].lower() == "select":
+                # uncorrelated scalar subquery (Catalyst ScalarSubquery;
+                # materialized to a Literal before physical planning)
+                self.next()
+                sub = self.query()
+                self.expect(")")
+                out = sub.plan.output
+                if len(out) != 1:
+                    raise ValueError(
+                        "scalar subquery must return one column, got "
+                        f"{len(out)}")
+                return Column(E.ScalarSubquery(sub.plan,
+                                               out[0].data_type))
             self.next()
             c = self.expr()
             self.expect(")")

@@ -139,6 +139,9 @@ class TpuSparkSession:
     def plan_physical(self, plan: L.LogicalPlan):
         """CPU physical plan, then the plugin rewrite when enabled."""
         from spark_rapids_tpu import udf_compiler
+        from spark_rapids_tpu.sql.expressions import \
+            materialize_scalar_subqueries
+        plan = materialize_scalar_subqueries(plan, self)
         plan = udf_compiler.rewrite_plan(plan, self.conf_obj)
         physical = Planner(self.conf_obj, session=self).plan(plan)
         self.last_rewrite_report = None

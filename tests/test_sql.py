@@ -191,3 +191,32 @@ def test_ambiguous_unqualified_still_errors():
             sp.sql("SELECT k FROM a JOIN b ON a.k = b.k").collect()
     finally:
         sp.stop()
+
+
+def test_scalar_subquery():
+    """Uncorrelated (SELECT ...) in expression position materializes to
+    a literal before planning (Catalyst ScalarSubquery role); empty
+    subqueries yield NULL and multi-row subqueries raise."""
+    from harness import assert_tpu_and_cpu_equal_collect
+
+    def q(spark):
+        t = spark.createDataFrame({"k": [1, 2, 3, 4],
+                                   "v": [10, 20, 30, 40]}, "k int, v int")
+        t.createOrReplaceTempView("tsq")
+        return spark.sql("SELECT k, v - (SELECT avg(v) FROM tsq) d "
+                         "FROM tsq WHERE v > (SELECT min(v) FROM tsq) "
+                         "ORDER BY k")
+    assert_tpu_and_cpu_equal_collect(q, approx=True)
+
+    import pytest
+    sp = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        t = sp.createDataFrame({"v": [1, 2]}, "v int")
+        t.createOrReplaceTempView("tsq2")
+        with pytest.raises(ValueError, match="more than one row"):
+            sp.sql("SELECT (SELECT v FROM tsq2) FROM tsq2").collect()
+        r = sp.sql("SELECT (SELECT max(v) FROM tsq2 WHERE v > 99) m "
+                   "FROM tsq2 LIMIT 1").collect()
+        assert r[0][0] is None
+    finally:
+        sp.stop()
