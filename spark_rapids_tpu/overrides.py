@@ -686,15 +686,101 @@ def apply_overrides(physical: P.PhysicalPlan, conf: TpuConf,
     return new_plan
 
 
+# -- cost model (CostBasedOptimizer.scala:52 CpuCostModel/GpuCostModel) ----
+#
+# Constants calibrated against THIS stack's measured behavior, in
+# seconds: the tunneled host<->HBM wire moves ~150MB/s with a flat
+# ~0.15s of sync/dispatch latency per island, and the CPU engine's
+# numpy passes stream at memory bandwidth (~2GB/s) EXCEPT regex-class
+# expressions, which run a python-level loop per row.
+_WIRE_BYTES_PER_S = 150e6
+_ISLAND_FLAT_S = 0.15
+_DEFAULT_ROW_COUNT = 1 << 20  # reference optimizer's default-row-count role
+
+_NS_ELEMENTWISE = 3.0      # one vectorized numpy pass per expression node
+_NS_STRING_OP = 25.0       # object-array string kernels
+_NS_REGEX = 2000.0         # python re loop per row (LIKE/regexp/split;
+                           # measured 2-4us/row on the host engine)
+
+
+def _expr_cost_ns(e) -> float:
+    """Estimated CPU nanoseconds PER ROW to evaluate this expression
+    tree with the host engine."""
+    from spark_rapids_tpu.sql import expressions as E
+    name = type(e).__name__
+    if name in ("Like", "RLike", "RegExpExtract", "RegExpReplace",
+                "StringSplit", "PythonUDF", "PandasUDF"):
+        ns = _NS_REGEX
+    elif isinstance(getattr(e, "data_type", None), T.StringType) \
+            and e.children:
+        ns = _NS_STRING_OP
+    elif not e.children:
+        ns = 0.0  # attribute/literal: no pass of its own
+    else:
+        ns = _NS_ELEMENTWISE
+    return ns + sum(_expr_cost_ns(c) for c in e.children)
+
+
+def _row_width_bytes(schema: T.StructType) -> int:
+    w = 0
+    for f in schema.fields:
+        dt = f.data_type
+        if isinstance(dt, (T.StringType, T.BinaryType)):
+            w += 24
+        elif T.is_limb_decimal(dt):
+            w += 16
+        else:
+            try:
+                w += T.numpy_dtype(dt).itemsize
+            except Exception:
+                w += 8
+        w += 1  # validity
+    return max(1, w)
+
+
+def _estimate_rows(p: P.PhysicalPlan) -> int:
+    """Row-count estimate for a CPU source subtree (the optimizer's
+    stats stand-in; scans estimate from file bytes, local data is
+    exact, everything else passes through its first child)."""
+    from spark_rapids_tpu.io.readers import CpuFileScanExec
+    if isinstance(p, P.CpuLocalScanExec):
+        return sum(b.num_rows for b in p.batches) \
+            if getattr(p, "batches", None) else _DEFAULT_ROW_COUNT
+    if isinstance(p, CpuFileScanExec):
+        # parquet row-group footers carry EXACT row counts (already
+        # parsed into ScanUnit.stats for predicate pushdown)
+        rows = 0
+        exact = True
+        for u in p._units:
+            nr = None
+            if u.stats:
+                for st in u.stats.values():
+                    nr = st[3]
+                    break
+            if nr is None:
+                exact = False
+                break
+            rows += int(nr)
+        if exact and rows:
+            return rows
+        total = sum(u.size_bytes for u in p._units)
+        # non-parquet bytes are compressed ~2x relative to in-memory
+        return max(1, int(total * 2) // _row_width_bytes(p.schema))
+    if p.children:
+        return _estimate_rows(p.children[0])
+    return _DEFAULT_ROW_COUNT
+
+
 def _revert_small_islands(plan: P.PhysicalPlan, report: RewriteReport
                           ) -> P.PhysicalPlan:
-    """Cost-based optimizer v0 (CostBasedOptimizer.scala:52 role):
-    revert CPU-sandwiched device islands whose compute cannot repay the
-    transitions. The cost model: an island pays upload + download of
-    every batch byte (the R2C/C2R pair) while an elementwise op saves at
-    most one CPU pass over the same bytes — so an island with at most
-    ONE cheap (project/filter) operator always loses and goes back to
-    CPU. Wider islands (aggregates, joins, sorts, multiple ops) stay."""
+    """Cost-based optimizer (CostBasedOptimizer.scala:52 role): revert a
+    CPU-sandwiched device island (a Project/Filter/Coalesce chain
+    between an upload and a download) when the estimated CPU cost of its
+    expressions is LESS than the transition cost of shipping the rows to
+    HBM and back. Unlike the v0 pattern-match, this keeps a single
+    regex-heavy operator on device for large inputs (the python re loop
+    dwarfs the wire cost) and reverts multi-op chains over small data
+    (the flat sync latency dominates)."""
     from spark_rapids_tpu.exec.base import (TpuColumnarToRowExec,
                                             TpuCoalesceBatchesExec,
                                             TpuRowToColumnarExec)
@@ -716,9 +802,23 @@ def _revert_small_islands(plan: P.PhysicalPlan, report: RewriteReport
         return plan
     compute = [n for n in island
                if not isinstance(n, TpuCoalesceBatchesExec)]
-    if len(compute) > 1:
-        return plan
-    cpu = cur.children[0]
+    cpu_src = cur.children[0]
+    rows = _estimate_rows(cpu_src)
+    cpu_ns_per_row = 0.0
+    for n in compute:
+        if isinstance(n, TpuProjectExec):
+            cpu_ns_per_row += sum(_expr_cost_ns(e)
+                                  for e in n.project_list)
+        elif isinstance(n, TpuFilterExec):
+            cpu_ns_per_row += _expr_cost_ns(n.condition)
+    cpu_cost_s = rows * cpu_ns_per_row * 1e-9
+    in_bytes = rows * _row_width_bytes(cpu_src.schema)
+    out_bytes = rows * _row_width_bytes(plan.child.schema)
+    transition_cost_s = (in_bytes + out_bytes) / _WIRE_BYTES_PER_S \
+        + _ISLAND_FLAT_S
+    if cpu_cost_s >= transition_cost_s:
+        return plan  # the island repays its transitions
+    cpu = cpu_src
     for n in reversed(island):
         if isinstance(n, TpuProjectExec):
             cpu = P.CpuProjectExec(n.project_list, cpu)
@@ -727,7 +827,9 @@ def _revert_small_islands(plan: P.PhysicalPlan, report: RewriteReport
         # coalesce nodes have no CPU-side meaning: drop
     report.fallbacks.append((
         type(compute[0]).__name__ if compute else "TpuRowToColumnar",
-        ["the transition cost outweighs the device speedup "
+        [f"the transition cost (~{transition_cost_s:.2f}s for ~{rows} "
+         f"rows) outweighs the estimated device speedup "
+         f"(~{cpu_cost_s:.2f}s of CPU work) "
          "(spark.rapids.sql.optimizer.enabled)"]))
     return cpu
 

@@ -3949,12 +3949,16 @@ class ScalarSubquery(Expression):
 def materialize_scalar_subqueries(plan, session):
     """Replace every ScalarSubquery with the Literal it evaluates to
     (executing each subquery ONCE per query, like Spark's subquery
-    reuse). Enforces the at-most-one-row contract."""
+    reuse). Enforces the at-most-one-row contract. With ``session``
+    None (the explain path) subqueries substitute to unevaluated NULL
+    placeholders instead — rendering a plan must never execute it."""
     cache: dict = {}
 
     def subst(e: Expression):
         if not isinstance(e, ScalarSubquery):
             return None
+        if session is None:
+            return Literal(None, e.data_type)
         key = id(e.plan)
         if key not in cache:
             batch = session.execute_plan(e.plan)

@@ -136,12 +136,18 @@ class TpuSparkSession:
         return parse_sql(query, self)
 
     # -- execution ---------------------------------------------------------
-    def plan_physical(self, plan: L.LogicalPlan):
-        """CPU physical plan, then the plugin rewrite when enabled."""
+    def plan_physical(self, plan: L.LogicalPlan,
+                      execute_subqueries: bool = True):
+        """CPU physical plan, then the plugin rewrite when enabled.
+        ``execute_subqueries=False`` (the explain path) substitutes
+        scalar subqueries with unevaluated placeholders — rendering a
+        plan must never run the query's subqueries (Spark's explain
+        does not either)."""
         from spark_rapids_tpu import udf_compiler
         from spark_rapids_tpu.sql.expressions import \
             materialize_scalar_subqueries
-        plan = materialize_scalar_subqueries(plan, self)
+        plan = materialize_scalar_subqueries(
+            plan, self if execute_subqueries else None)
         plan = udf_compiler.rewrite_plan(plan, self.conf_obj)
         physical = Planner(self.conf_obj, session=self).plan(plan)
         self.last_rewrite_report = None
@@ -185,7 +191,7 @@ class TpuSparkSession:
 
     def explain_string(self, plan: L.LogicalPlan, physical=None) -> str:
         if physical is None:
-            physical = self.plan_physical(plan)
+            physical = self.plan_physical(plan, execute_subqueries=False)
         return f"== Logical ==\n{plan!r}\n== Physical ==\n{physical!r}"
 
     # -- plan capture (ExecutionPlanCaptureCallback, Plugin.scala:268-390)

@@ -125,3 +125,53 @@ def test_cbo_keeps_wide_islands():
         assert "TpuHashAggregate" in pstr, pstr
     finally:
         sp.stop()
+
+
+def test_cbo_keeps_regex_island_on_large_input():
+    """CBO v1: a SINGLE regex-heavy filter island over a large scan
+    stays on device (the python re loop dwarfs the wire cost) — the v0
+    pattern-match wrongly reverted every 1-op island."""
+    import numpy as np
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    import os, shutil, tempfile
+    d = tempfile.mkdtemp()
+    try:
+        gen = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+        n = 300_000
+        gen.createDataFrame(
+            {"s": [f"row{i:07d}" for i in range(n)]},
+            "s string").write.mode("overwrite").parquet(d)
+        gen.stop()
+        sp = TpuSparkSession({"spark.rapids.sql.enabled": "true",
+                              "spark.rapids.sql.optimizer.enabled": "true"})
+        try:
+            sp.start_capture()
+            df = sp.read.parquet(d).filter("s LIKE 'row00%'")
+            got = df.collect()
+            pstr = "\n".join(p.tree_string()
+                             for p in sp.get_captured_plans())
+        finally:
+            sp.stop()
+        assert len(got) == 100_000
+        assert "TpuFilter" in pstr, pstr
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def test_cbo_reverts_multi_op_island_on_tiny_input():
+    """CBO v1: even a TWO-op cheap island over tiny data reverts (the
+    flat per-island sync latency dominates) — v0 only caught 1-op
+    islands."""
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    sp = TpuSparkSession({"spark.rapids.sql.enabled": "true",
+                          "spark.rapids.sql.optimizer.enabled": "true"})
+    try:
+        sp.start_capture()
+        df = sp.createDataFrame({"a": list(range(64))}, "a int") \
+            .filter(F.col("a") > 3).select((F.col("a") + 1).alias("b"))
+        out = df.collect()
+        pstr = "\n".join(p.tree_string() for p in sp.get_captured_plans())
+    finally:
+        sp.stop()
+    assert len(out) == 60
+    assert "TpuProject" not in pstr and "TpuFilter" not in pstr, pstr
