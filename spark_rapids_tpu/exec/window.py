@@ -80,8 +80,6 @@ def is_device_window(window_exprs: List[E.Expression],
         if isinstance(func, (E.RowNumber, E.Rank, E.DenseRank, E.NTile)):
             continue
         if isinstance(func, E.Lag):  # covers Lead
-            if T.is_limb_decimal(func.input.data_type):
-                return "lag/lead over decimal128 columns runs on CPU"
             r = X.is_device_expr(func.input, conf)
             if r:
                 return r
@@ -330,6 +328,19 @@ def _offset_fn(func: E.Lag, val: AnyDeviceColumn, default_val,
         chars = jnp.where(validity[:, None], chars, 0)
         lengths = jnp.where(validity, lengths, 0)
         return (chars, lengths), validity
+    from spark_rapids_tpu.columnar.device import DeviceDecimal128Column
+    if isinstance(val, DeviceDecimal128Column):
+        hi = val.hi[src_orig]
+        lo = val.lo[src_orig]
+        validity = val.validity[src_orig] & ok
+        if default_val is not None:
+            dhi, dlo, dvalid = default_val
+            hi = jnp.where(ok, hi, dhi)
+            lo = jnp.where(ok, lo, dlo)
+            validity = jnp.where(ok, validity, dvalid & lay.active_s)
+        z = jnp.zeros((), jnp.int64)
+        return (jnp.where(validity, hi, z),
+                jnp.where(validity, lo, z)), validity
     data = val.data[src_orig]
     validity = val.validity[src_orig] & ok
     if default_val is not None:
@@ -723,10 +734,14 @@ def _build_window_fn(part_bound: Tuple[E.Expression, ...],
                 val = X.dev_eval(all_exprs[src_i], ctx)
                 dflt = None
                 if dflt_i is not None:
+                    from spark_rapids_tpu.columnar.device import \
+                        DeviceDecimal128Column
                     dc = X.dev_eval(all_exprs[dflt_i], ctx)
-                    dflt = (dc.arrays() if isinstance(
-                        dc, DeviceStringColumn)
-                        else (dc.data, dc.validity))
+                    if isinstance(dc, (DeviceStringColumn,
+                                       DeviceDecimal128Column)):
+                        dflt = dc.arrays()
+                    else:
+                        dflt = (dc.data, dc.validity)
                 arrs, v = _offset_fn(func, val, dflt, lay)
                 outs.append((tuple(_to_orig(inv, a) for a in arrs),
                              _to_orig(inv, v)))

@@ -203,14 +203,28 @@ def _main(argv: List[str]) -> int:
     ap = argparse.ArgumentParser(
         prog="spark_rapids_tpu.tools",
         description="TPU qualification/profiling tools")
-    ap.add_argument("command", choices=["qualify", "profile"])
+    ap.add_argument("command", choices=["qualify", "profile", "docs"])
     ap.add_argument("sql", nargs="?", help="SQL text to analyze (live "
                     "mode; omit when using --log)")
     ap.add_argument("--view", action="append", default=[],
                     help="name=path parquet view registrations")
     ap.add_argument("--log", help="offline mode: event-log file or "
                     "directory (spark.rapids.sql.eventLog.dir output)")
+    ap.add_argument("--out", default="docs",
+                    help="docs: output directory for generated markdown")
     args = ap.parse_args(argv)
+
+    if args.command == "docs":
+        import os
+
+        from spark_rapids_tpu.conf import generate_docs
+        os.makedirs(args.out, exist_ok=True)
+        with open(os.path.join(args.out, "configs.md"), "w") as f:
+            f.write(generate_docs())
+        with open(os.path.join(args.out, "supported_ops.md"), "w") as f:
+            f.write(generate_supported_ops())
+        print(f"wrote {args.out}/configs.md and {args.out}/supported_ops.md")
+        return 0
 
     if args.log:
         print(qualify_log(args.log) if args.command == "qualify"
@@ -233,6 +247,60 @@ def _main(argv: List[str]) -> int:
     finally:
         spark.stop()
     return 0
+
+
+
+
+def generate_supported_ops() -> str:
+    """docs/supported_ops.md generator (the reference builds the same
+    table from its rule registries, SupportedOpsDocs via
+    TypeChecks.scala): one row per exec and per expression rule with
+    its conf key, type signature, and compatibility notes. Everything
+    is derived FROM the live registries, so the doc cannot drift from
+    the code."""
+    from spark_rapids_tpu import overrides as O
+    from spark_rapids_tpu import typesig as TS
+
+    def sig_str(sig) -> str:
+        tags = sorted(sig.tags)
+        s = ", ".join(tags)
+        if "decimal" in sig.tags and sig.max_decimal_precision:
+            s += f" (precision <= {sig.max_decimal_precision})"
+        return s or "none"
+
+    lines = [
+        "# Supported operators and expressions",
+        "",
+        "Generated from the rule registries "
+        "(`python -m spark_rapids_tpu.tools docs`); the per-op conf "
+        "keys disable individual replacements, exactly like the "
+        "reference's `spark.rapids.sql.exec.*` / "
+        "`spark.rapids.sql.expression.*` keys.",
+        "",
+        "## Execs",
+        "",
+        "| Exec | Description | Conf key | Supported types |",
+        "|---|---|---|---|",
+    ]
+    for cls, rule in sorted(O._EXEC_RULES.items(),
+                            key=lambda kv: kv[1].name):
+        lines.append(f"| {rule.name} | {rule.desc} | `{rule.conf_key}` "
+                     f"| {sig_str(rule.checks.sig)} |")
+    lines += [
+        "",
+        "## Expressions",
+        "",
+        "| Expression | Conf key | Output types | Input types | Notes |",
+        "|---|---|---|---|---|",
+    ]
+    for cls, rule in sorted(O._EXPR_RULES.items(),
+                            key=lambda kv: kv[1].name):
+        note = rule.incompat or ""
+        lines.append(
+            f"| {rule.name} | `{rule.conf_key}` "
+            f"| {sig_str(rule.checks.output)} "
+            f"| {sig_str(rule.checks.inputs)} | {note} |")
+    return "\n".join(lines) + "\n"
 
 
 if __name__ == "__main__":

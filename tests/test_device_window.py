@@ -249,3 +249,26 @@ def test_value_bounded_range_nan_order_values():
                     .rangeBetween(Window.unboundedPreceding, 0))
                 .alias("cu")),
         expect_execs=["TpuWindow"])
+
+
+def test_lag_lead_decimal128_on_device():
+    """lag/lead over DECIMAL128 columns now runs on device (two-limb
+    gather in exec/window.py _offset_fn) — formerly a CPU fallback."""
+    from decimal import Decimal
+
+
+    def q(spark):
+        vals = [None if i % 7 == 0 else
+                Decimal(10 ** 20 + i * 137) / Decimal(100)
+                for i in range(60)]
+        df = spark.createDataFrame(
+            {"g": [i % 4 for i in range(60)],
+             "o": list(range(60)), "d": vals},
+            "g int, o int, d decimal(25,2)")
+        w = Window.partitionBy("g").orderBy("o")
+        return df.select(
+            "g", "o",
+            F.lag("d", 1).over(w).alias("lg"),
+            F.lead("d", 2).over(w).alias("ld"),
+            F.lag("d", 1, Decimal("0.55")).over(w).alias("lgd"))
+    assert_tpu_and_cpu_equal_collect(q)

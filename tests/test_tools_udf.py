@@ -237,3 +237,16 @@ def test_udf_compiler_v1_mod_math_strings_locals():
             for a, b in zip(p, q))
     assert all(close(p, q) for p, q in zip(plain, cpu))
     assert all(close(p, q) for p, q in zip(plain, dev))
+
+
+def test_supported_ops_docs_generation():
+    """docs generator derives from the LIVE registries (SupportedOpsDocs
+    role): every exec and expression rule appears with its conf key."""
+    from spark_rapids_tpu import overrides as O
+    from spark_rapids_tpu.tools import generate_supported_ops
+    md = generate_supported_ops()
+    for rule in O._EXEC_RULES.values():
+        assert rule.conf_key in md, rule.conf_key
+    for rule in list(O._EXPR_RULES.values())[:20]:
+        assert rule.conf_key in md, rule.conf_key
+    assert "ArrowEvalPythonExec" in md
