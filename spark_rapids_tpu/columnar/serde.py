@@ -49,7 +49,18 @@ def _compress(data: bytes, codec: str) -> bytes:
         import zlib
         return zlib.compress(data, 1)
     if codec == "zstd":
-        import zstandard
+        try:
+            import zstandard
+        except ImportError:
+            # gate the optional dep: pyarrow ships a zstd codec; its
+            # frames don't embed the content size, so prefix it (both
+            # ends of a shuffle/spill run the same build, so the
+            # fallback is symmetric)
+            import struct
+
+            import pyarrow as pa
+            comp = pa.Codec("zstd").compress(data, asbytes=True)
+            return struct.pack("<Q", len(data)) + comp
         return zstandard.ZstdCompressor(level=1).compress(data)
     return data
 
@@ -60,7 +71,17 @@ def _decompress(data: bytes, codec_id: int) -> bytes:
         import zlib
         return zlib.decompress(data)
     if codec == "zstd":
-        import zstandard
+        try:
+            import zstandard
+        except ImportError:
+            import struct
+
+            import pyarrow as pa
+            (n,) = struct.unpack("<Q", data[:8])
+            buf = pa.Codec("zstd").decompress(data[8:],
+                                              decompressed_size=n)
+            return buf.to_pybytes() if hasattr(buf, "to_pybytes") \
+                else bytes(buf)
         return zstandard.ZstdDecompressor().decompress(data)
     return data
 

@@ -446,6 +446,9 @@ def prepare_upload(batch, cap: int):
     """Host-side half of an upload (pack/stage, NO device touch): the
     returned opaque token feeds finish_upload. Splitting the phases lets
     a producer thread pack batch k+1 while batch k's bytes move."""
+    from spark_rapids_tpu.io.device_decode import EncodedBatch
+    if isinstance(batch, EncodedBatch):
+        return prepare_encoded_upload(batch, cap)
     n = batch.num_rows
     if n < PACKED_MIN_ROWS or any(
             isinstance(f.data_type, (T.ArrayType, T.StructType))
@@ -457,7 +460,7 @@ def prepare_upload(batch, cap: int):
 
 def finish_upload(staged, device: Optional[jax.Device] = None):
     """Device-side half: one device_put (+ one decode program on the
-    packed path)."""
+    packed and encoded paths)."""
     from spark_rapids_tpu.columnar import device as D
     if staged[0] == "direct":
         _tag, schema, n, spec, np_arrays = staged
@@ -467,6 +470,8 @@ def finish_upload(staged, device: Optional[jax.Device] = None):
             dev = jax.device_put(np_arrays)
         return D.DeviceBatch(schema, D.rebuild_columns(spec, dev[:-1]),
                              dev[-1], n)
+    if staged[0] == "encoded":
+        return _finish_encoded_upload(staged, device)
     _tag, schema, n, cap, words, extras, layout = staged
     key = (layout, n, cap, words.nbytes)
     with _DECODE_CACHE_LOCK:
@@ -497,3 +502,216 @@ def upload_batch(batch, cap: int, device: Optional[jax.Device] = None):
     """HostBatch -> DeviceBatch via the packed codec (one device_put,
     one decode program); small batches skip the codec."""
     return finish_upload(prepare_upload(batch, cap), device)
+
+
+# -- device parquet decode (EncodedBatch path) -----------------------------
+#
+# The scan's raw-page staging: the wire carries the *still-encoded*
+# page bytes (dict indices at their bit width, packed validity runs,
+# PLAIN fixed-width bytes) plus small host-parsed plan tables; one XLA
+# program per (layout, n, cap) expands everything into device columns
+# (the reference's copy-compact-bytes-then-cudf-decode shape,
+# GpuParquetScanBase.scala:82, applied to the scan itself).
+
+def _pad_pow2(n: int, floor: int = 8) -> int:
+    if n <= floor:
+        return floor
+    return 1 << (n - 1).bit_length()
+
+
+def prepare_encoded_upload(enc, cap: int):
+    """EncodedBatch -> staged token: pads plan tables to pow2 buckets so
+    the decode-program cache keys repeat across row groups."""
+    n = enc.num_rows
+    extras: List[np.ndarray] = []
+    layout: List[Tuple] = []
+    spec: List[Tuple[T.DataType, int]] = []
+    for fi, f in enumerate(enc.schema.fields):
+        dt = f.data_type
+        plan = enc.plans.get(fi)
+        if plan is None:
+            parts = _stage_column(enc.host_cols[fi], dt, cap)
+            layout.append(("host", len(parts)))
+            spec.append((dt, len(parts)))
+            extras.extend(parts)
+            continue
+        n_pages = len(plan.pg_is_dict)
+        npg = _pad_pow2(n_pages)
+        dense_start = np.full(npg + 1, 1 << 62, dtype=np.int64)
+        dense_start[:n_pages + 1] = plan.pg_dense_start
+        plain_byte = np.zeros(npg, dtype=np.int64)
+        plain_byte[:n_pages] = plan.pg_plain_byte
+        is_dict = np.zeros(npg, dtype=bool)
+        is_dict[:n_pages] = plan.pg_is_dict
+        extras.extend([dense_start, plain_byte, is_dict])
+        ndl = _pad_pow2(len(plan.dl)) if plan.dl is not None else 0
+        if plan.dl is not None:
+            extras.extend(plan.dl.arrays(ndl))
+        nvr = _pad_pow2(len(plan.vr)) if plan.vr is not None else 0
+        if plan.vr is not None:
+            extras.extend(plan.vr.arrays(nvr))
+        dict_shapes: List[Tuple] = []
+        for da in plan.dict_arrays:
+            pad = _pad_pow2(da.shape[0], floor=1)
+            if pad > da.shape[0]:
+                padded = np.zeros((pad,) + da.shape[1:], dtype=da.dtype)
+                padded[:da.shape[0]] = da
+                da = padded
+            dict_shapes.append((da.shape, str(da.dtype)))
+            extras.append(da)
+        layout.append(("dev", plan.kind, plan.np_dtype, plan.elem_bytes,
+                       plan.char_cap, npg, ndl, nvr,
+                       tuple(dict_shapes), plan.has_plain))
+        arity = 3 if plan.kind in ("str", "dec128") else 2
+        spec.append((dt, arity))
+    # bucket the page buffer so same-shaped row groups share one
+    # decode program (exact sizes would compile per unit)
+    from spark_rapids_tpu.columnar.device import bucket_capacity
+    words = enc.words
+    nw = bucket_capacity(len(words))
+    if nw > len(words):
+        words = np.concatenate([words,
+                                np.zeros(nw - len(words), np.int32)])
+    return ("encoded", enc.schema, n, cap, words, extras,
+            tuple(layout), tuple(spec))
+
+
+def _build_encoded_decode(layout: Tuple, n: int, cap: int) -> Callable:
+    """One XLA program: packed page words + plan tables -> per-column
+    (data, validity) arrays at full capacity, plus the active mask."""
+    from spark_rapids_tpu.ops import rle as R
+
+    def fn(words, *extras):
+        bytes_all = None
+
+        def get_bytes():
+            nonlocal bytes_all
+            if bytes_all is None:
+                bytes_all = R.bytes_of_words(words)
+            return bytes_all
+
+        active = jnp.arange(cap) < n
+        pos = jnp.arange(cap, dtype=jnp.int64)
+        outs: List[jax.Array] = []
+        cur = 0
+        for ent in layout:
+            if ent[0] == "host":
+                _tag, n_parts = ent
+                outs.extend(extras[cur:cur + n_parts])
+                cur += n_parts
+                continue
+            (_tag, kind, np_dt, elem_bytes, char_cap, npg, ndl, nvr,
+             dict_shapes, has_plain) = ent
+            dense_start = extras[cur]
+            plain_byte = extras[cur + 1]
+            is_dict = extras[cur + 2]
+            cur += 3
+            if ndl:
+                dl = extras[cur:cur + 5]
+                cur += 5
+                dl_v = R.hybrid_lookup(get_bytes(), pos, *dl)
+                validity = (dl_v == 1) & active
+            else:
+                validity = active
+            vr = None
+            if nvr:
+                vr = extras[cur:cur + 5]
+                cur += 5
+            dicts = [extras[cur + i] for i in range(len(dict_shapes))]
+            cur += len(dict_shapes)
+
+            j = jnp.clip(R.dense_ranks(validity), 0, cap - 1) \
+                .astype(jnp.int64)
+            if kind == "bool":
+                v = R.hybrid_lookup(get_bytes(), j, *vr)
+                data = jnp.where(validity, v != 0, False)
+                outs.extend([data, validity])
+                continue
+            if kind == "str":
+                didx = R.hybrid_lookup(get_bytes(), j, *vr)
+                dmax = dict_shapes[0][0][0] - 1
+                didx = jnp.clip(didx, 0, dmax)
+                chars = jnp.where(validity[:, None], dicts[0][didx], 0)
+                lengths = jnp.where(validity,
+                                    dicts[1][didx].astype(jnp.int32), 0)
+                outs.extend([chars, lengths, validity])
+                continue
+            pg = jnp.clip(
+                jnp.searchsorted(dense_start, j, side="right") - 1,
+                0, npg - 1)
+            local = j - dense_start[pg]
+            didx = None
+            if vr is not None:
+                didx = jnp.clip(R.hybrid_lookup(get_bytes(), j, *vr),
+                                0, dict_shapes[0][0][0] - 1)
+            if kind == "dec128":
+                if has_plain:
+                    off = plain_byte[pg] + local * elem_bytes
+                    p_hi, p_lo = R.read_be_limbs(get_bytes(), off,
+                                                 elem_bytes)
+                else:
+                    p_hi = p_lo = jnp.zeros(cap, dtype=jnp.int64)
+                if didx is not None:
+                    hi = jnp.where(is_dict[pg], dicts[0][didx], p_hi)
+                    lo = jnp.where(is_dict[pg], dicts[1][didx], p_lo)
+                else:
+                    hi, lo = p_hi, p_lo
+                hi = jnp.where(validity, hi, 0)
+                lo = jnp.where(validity, lo, 0)
+                outs.extend([hi, lo, validity])
+                continue
+            # fixed-width scalar kinds: select in the int64 bit domain
+            if has_plain:
+                off = plain_byte[pg] + local * elem_bytes
+                if kind == "dec64":
+                    p_v = R.read_be_signed(get_bytes(), off, elem_bytes)
+                else:
+                    p_v = R.read_le(get_bytes(), off, elem_bytes)
+            else:
+                p_v = jnp.zeros(cap, dtype=jnp.int64)
+            if didx is not None:
+                v = jnp.where(is_dict[pg], dicts[0][didx], p_v)
+            else:
+                v = p_v
+            if kind == "f32":
+                data = jax.lax.bitcast_convert_type(
+                    v.astype(jnp.int32), jnp.float32)
+                data = jnp.where(validity, data, jnp.float32(0))
+            elif kind == "f64":
+                data = jax.lax.bitcast_convert_type(v, jnp.float64)
+                data = jnp.where(validity, data, jnp.float64(0))
+            else:  # int / dec64: reinterpret low bits into the storage
+                data = v.astype(jnp.dtype(np_dt)) if np_dt != "int64" \
+                    else v
+                if np_dt == "int64" and elem_bytes == 4 \
+                        and kind != "dec64":
+                    data = v.astype(jnp.int32).astype(jnp.int64)
+                data = jnp.where(validity, data, 0)
+            outs.extend([data, validity])
+        return active, tuple(outs)
+
+    return jax.jit(fn)
+
+
+def _finish_encoded_upload(staged, device: Optional[jax.Device] = None):
+    from spark_rapids_tpu.columnar import device as D
+    _tag, schema, n, cap, words, extras, layout, spec = staged
+    key = ("enc", layout, n, cap, words.nbytes)
+    with _DECODE_CACHE_LOCK:
+        fn = _DECODE_CACHE.get(key)
+        if fn is not None:
+            _DECODE_CACHE.move_to_end(key)
+    if fn is None:
+        fn = _build_encoded_decode(layout, n, cap)
+        with _DECODE_CACHE_LOCK:
+            _DECODE_CACHE[key] = fn
+            while len(_DECODE_CACHE) > _DECODE_CACHE_MAX:
+                _DECODE_CACHE.popitem(last=False)
+    bufs = [words] + list(extras)
+    if device is not None:
+        dev = jax.device_put(bufs, device)
+    else:
+        dev = jax.device_put(bufs)
+    active, outs = fn(dev[0], *dev[1:])
+    return D.DeviceBatch(schema, D.rebuild_columns(list(spec), outs),
+                         active, n)

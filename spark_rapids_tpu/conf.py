@@ -282,6 +282,18 @@ MULTITHREADED_READ_NUM_THREADS = conf(
     "Thread pool size for the multithreaded reader "
     "(GpuMultiFileReader.scala:300).").integer(8)
 
+PARQUET_DEVICE_DECODE = conf(
+    "spark.rapids.sql.format.parquet.deviceDecode.enabled").doc(
+    "Decode Parquet pages ON DEVICE: host threads read raw column-chunk "
+    "bytes, decompress pages and parse headers only; bit-unpacking of "
+    "RLE/bit-packed runs, dictionary gather, PLAIN fixed-width "
+    "reinterpret and definition-level expansion run as XLA kernels "
+    "(the cuDF-decode role of GpuParquetScanBase.scala:82). Columns "
+    "with unsupported encodings/types (DELTA_*, BYTE_STREAM_SPLIT, "
+    "PLAIN byte arrays, nested, INT96) fall back per column to the "
+    "pyarrow host decode; results are bit-identical either way. See "
+    "docs/supported_ops.md for the encoding matrix.").boolean(False)
+
 
 class TpuConf:
     """Bound view over a conf dict; the RapidsConf class equivalent.

@@ -68,6 +68,27 @@ def float_div_exact() -> bool:
             and f64_arith_exact())
 
 
+@functools.lru_cache(maxsize=None)
+def f64_bitcast_exact() -> bool:
+    """True when the backend can bitcast int64 <-> float64 exactly (the
+    device parquet decode rebuilds DOUBLE columns from raw page bytes
+    this way; the TPU lowering stack rejects 64-bit float bitcasts, so
+    DOUBLE columns fall back to the host decode there)."""
+    import jax
+    import jax.numpy as jnp
+
+    bits = np.array([0x3FF0000000000000, -0x10000000000000000 +
+                     0xC000000000000000, 0x7FF0000000000000, 0],
+                    dtype=np.int64)
+    try:
+        out = jax.jit(lambda x: jax.lax.bitcast_convert_type(
+            x, jnp.float64))(bits)
+        return np.array_equal(np.asarray(out),
+                              bits.view(np.float64), equal_nan=True)
+    except Exception:
+        return False
+
+
 def float_arith_reason(kind: str = "arithmetic") -> str:
     return (f"device float {kind} is not bit-identical to CPU on this "
             "backend (TPU f64 is emulated); set "

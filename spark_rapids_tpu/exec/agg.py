@@ -533,7 +533,10 @@ class TpuHashAggregateExec(TpuExec):
         # dominant term must not be invisible). Without async copies the
         # per-batch reads would pay one flat roundtrip EACH — stack them
         # into the single-fetch form instead.
-        with self.metrics.timed("pipelineDrainTime"):
+        # timed_wall: with taskParallelism > 1, several pool threads
+        # drain concurrently; interval-union keeps the metric <= query
+        # wall so the bench stage breakdown sums sensibly
+        with self.metrics.timed_wall("pipelineDrainTime"):
             if prefetched:
                 counts = [int(np.asarray(c)) for _h, c in pending]
             else:

@@ -84,6 +84,12 @@ class TpuRowToColumnarExec(TpuExec):
     def device_partitions(self) -> List[DevicePartitionThunk]:
         sem = get_semaphore(self.conf)
         metrics = self.metrics
+        # this transition is the scan's direct consumer: allow the scan
+        # to hand us still-encoded parquet pages for device decode
+        # (decided here, at execution time, so plan rewrites that splice
+        # CPU operators in between never see EncodedBatch objects)
+        if hasattr(self.child, "emit_encoded"):
+            self.child.emit_encoded = True
 
         def make(thunk: P.PartitionThunk) -> DevicePartitionThunk:
             def run() -> Iterator[DeviceBatch]:
@@ -91,26 +97,46 @@ class TpuRowToColumnarExec(TpuExec):
                 # batch k+1 (host-only work) while this thread runs
                 # batch k's device_put — pack and wire transfer overlap
                 from concurrent.futures import ThreadPoolExecutor
+                from spark_rapids_tpu.io.device_decode import EncodedBatch
                 pending: List[HostBatch] = []
                 rows = 0
                 staged = None  # in-flight prepare future
                 with ThreadPoolExecutor(
                         1, thread_name_prefix="srt-pack") as pool:
+                    def submit(payload):
+                        nonlocal staged
+                        prev, staged = staged, pool.submit(
+                            self._prepare, payload, metrics)
+                        return prev
                     for b in thunk():
+                        if isinstance(b, EncodedBatch):
+                            # device-decode scan batch: never coalesced
+                            # (it is already a whole row group); flush
+                            # accumulated host batches first to keep
+                            # partition order
+                            if pending:
+                                prev = submit(pending)
+                                pending, rows = [], 0
+                                if prev is not None:
+                                    yield self._finish(prev.result(),
+                                                       sem, metrics)
+                            prev = submit(b)
+                            if prev is not None:
+                                yield self._finish(prev.result(), sem,
+                                                   metrics)
+                            continue
                         if b.num_rows == 0:
                             continue
                         pending.append(b)
                         rows += b.num_rows
                         if rows >= self.goal_rows:
-                            prev, staged = staged, pool.submit(
-                                self._prepare, pending, metrics)
+                            prev = submit(pending)
                             pending, rows = [], 0
                             if prev is not None:
                                 yield self._finish(prev.result(), sem,
                                                    metrics)
                     if pending:
-                        prev, staged = staged, pool.submit(
-                            self._prepare, pending, metrics)
+                        prev = submit(pending)
                         if prev is not None:
                             yield self._finish(prev.result(), sem, metrics)
                     if staged is not None:
@@ -118,9 +144,13 @@ class TpuRowToColumnarExec(TpuExec):
             return run
         return [make(t) for t in self.child.partitions()]
 
-    def _prepare(self, batches: List[HostBatch], metrics):
+    def _prepare(self, batches, metrics):
         from spark_rapids_tpu.columnar.transfer import prepare_upload
-        whole = batches[0] if len(batches) == 1 else HostBatch.concat(batches)
+        if isinstance(batches, list):
+            whole = (batches[0] if len(batches) == 1
+                     else HostBatch.concat(batches))
+        else:
+            whole = batches  # an EncodedBatch stages as itself
         cap = bucket_capacity(max(1, whole.num_rows))
         # separate metric: pack overlaps the previous batch's transfer,
         # so folding it into copyToDeviceTime would double-count wall

@@ -803,8 +803,10 @@ class TpuWindowExec(TpuExec):
                 dflt_i = None
                 if func.default is not None:
                     dflt = func.default
-                    if type(dflt.data_type) is not type(
-                            func.input.data_type):
+                    # full type equality, not class equality: a
+                    # decimal(3,2) default against a decimal(25,2)
+                    # input still needs the cast to the two-limb form
+                    if dflt.data_type != func.input.data_type:
                         dflt = E.Cast(dflt, func.input.data_type)
                     dflt_i = add(dflt)
                 items.append(("offset", func, src_i, dflt_i))

@@ -31,6 +31,7 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence
 from spark_rapids_tpu.columnar.host import HostBatch
 from spark_rapids_tpu.conf import (MAX_READER_BATCH_SIZE_ROWS,
                                    MULTITHREADED_READ_NUM_THREADS,
+                                   PARQUET_DEVICE_DECODE,
                                    PARQUET_READER_TYPE, TASK_PARALLELISM,
                                    TpuConf)
 from spark_rapids_tpu.io.arrow_convert import (arrow_schema_to_sql,
@@ -319,21 +320,23 @@ def _read_csv(path: str, schema: T.StructType, options: Dict[str, Any]):
     return _conform(tbl, schema)
 
 
-def _append_partition_columns(tbl, part_fields: List[T.StructField],
-                              part_values: Dict[str, str]):
-    """Attach directory-derived partition values as constant columns
+def _partition_value_array(f: T.StructField, raw: Optional[str], n: int):
+    """One partition field's constant column: parse the raw directory
+    value ONCE, then broadcast the scalar
     (PartitioningUtils.castPartValueToDesiredType role)."""
     import pyarrow as pa
+    at = sql_type_to_arrow(f.data_type)
+    if raw is None or raw == HIVE_DEFAULT_PARTITION:
+        return pa.nulls(n, type=at)
+    return pa.repeat(pa.scalar(raw, type=pa.string()).cast(at), n)
+
+
+def _append_partition_columns(tbl, part_fields: List[T.StructField],
+                              part_values: Dict[str, str]):
+    """Attach directory-derived partition values as constant columns."""
     for f in part_fields:
-        raw = part_values.get(f.name)
-        at = sql_type_to_arrow(f.data_type)
-        if raw is None or raw == HIVE_DEFAULT_PARTITION:
-            arr = pa.nulls(tbl.num_rows, type=at)
-        else:
-            # parse the value ONCE, then broadcast the scalar
-            scalar = pa.scalar(raw, type=pa.string()).cast(at)
-            arr = pa.repeat(scalar, tbl.num_rows)
-        tbl = tbl.append_column(f.name, arr)
+        tbl = tbl.append_column(f.name, _partition_value_array(
+            f, part_values.get(f.name), tbl.num_rows))
     return tbl
 
 
@@ -349,6 +352,34 @@ def _conform(tbl, schema: T.StructType):
             cols.append(pa.nulls(tbl.num_rows,
                                  type=sql_type_to_arrow(f.data_type)))
     return pa.Table.from_arrays(cols, names=[f.name for f in schema.fields])
+
+
+def _extend_with_partition_cols(enc, schema: T.StructType,
+                                part_fields: List[T.StructField],
+                                part_values: Dict[str, str]):
+    """Remap an EncodedBatch built against the data schema onto the full
+    scan schema, adding directory-derived partition values as constant
+    host columns (they never touch the file bytes)."""
+    from spark_rapids_tpu.io.arrow_convert import arrow_column_to_host
+    data_idx = {f.name: i for i, f in enumerate(enc.schema.fields)}
+    plans = {}
+    host_cols = {}
+    n = enc.num_rows
+    for fi, f in enumerate(schema.fields):
+        di = data_idx.get(f.name)
+        if di is not None:
+            if di in enc.plans:
+                plans[fi] = enc.plans[di]
+            else:
+                host_cols[fi] = enc.host_cols[di]
+            continue
+        host_cols[fi] = arrow_column_to_host(
+            _partition_value_array(f, part_values.get(f.name), n),
+            f.data_type)
+    enc.schema = schema
+    enc.plans = plans
+    enc.host_cols = host_cols
+    return enc
 
 
 # ---------------------------------------------------------------------------
@@ -474,6 +505,11 @@ class CpuFileScanExec(P.PhysicalPlan):
                                       open_cost)
         # set by the planner when input_file_name() sits above this scan
         self.force_perfile = False
+        # set (at execution time) by TpuRowToColumnarExec when IT is the
+        # direct consumer: only then may partitions() emit EncodedBatch
+        # staging objects instead of HostBatches — CPU consumers always
+        # see decoded rows
+        self.emit_encoded = False
 
     def set_pushdown(self, preds: List[tuple]) -> None:
         """Install pushed-down predicates (name, op, storage-value) and
@@ -515,11 +551,17 @@ class CpuFileScanExec(P.PhysicalPlan):
         part_names = {f.name for f in part_fields}
         data_schema = T.StructType(
             [f for f in schema.fields if f.name not in part_names])
+        # the device-decode path stitches no tables, so COALESCING keeps
+        # the host decode (its whole point is the one-table stitch)
+        device_decode = (self.fmt == "parquet"
+                         and reader_type != "COALESCING"
+                         and self.emit_encoded
+                         and bool(self.conf.get(PARQUET_DEVICE_DECODE)))
 
         metrics = self.metrics
 
         def decode(u: ScanUnit):
-            with metrics.timed("decodeTime"):
+            with metrics.timed_wall("decodeTime"):
                 tbl = _read_unit(self.fmt, u, data_schema, self.options)
                 if part_fields:
                     tbl = _append_partition_columns(tbl, part_fields,
@@ -529,16 +571,53 @@ class CpuFileScanExec(P.PhysicalPlan):
 
         def emit(tbl) -> Iterator[HostBatch]:
             for lo in range(0, max(1, tbl.num_rows), max_rows):
-                with metrics.timed("convertTime"):
+                with metrics.timed_wall("convertTime"):
                     hb = arrow_to_host_batch(tbl.slice(lo, max_rows),
                                              schema)
                 yield hb
 
-        def decode_host(u: ScanUnit) -> List[HostBatch]:
-            # arrow->HostBatch conversion (string object arrays, casts)
-            # runs IN the pool thread so the consumer thread only
-            # packs/uploads (MultiFileCloudParquetPartitionReader keeps
-            # its host-side decode off the task thread the same way)
+        def plan_device(u: ScanUnit):
+            """ScanUnit -> EncodedBatch (host does IO/decompress/header
+            parse only), or None when the unit must host-decode."""
+            from spark_rapids_tpu.io import device_decode as DD
+            if u.row_groups is None or len(u.row_groups) != 1:
+                # whole-file / multi-row-group units host-decode; count
+                # them so the bench attribution can't mistake an
+                # all-fallback run for "nothing to decode"
+                metrics.create("deviceFallbackUnits").add(1)
+                return None
+            with metrics.timed_wall("deviceDecodeTime"):
+                try:
+                    enc = DD.plan_unit_encoded(u, data_schema)
+                except Exception:
+                    enc = None  # corrupt chunk: the host decode decides
+            if enc is None or enc.num_rows > max_rows:
+                metrics.create("deviceFallbackUnits").add(1)
+                return None
+            if part_fields:
+                enc = _extend_with_partition_cols(
+                    enc, schema, part_fields, u.part_values or {})
+            metrics.create("deviceDecodedBatches").add(1)
+            for name, _reason in enc.fallbacks:
+                metrics.create("deviceFallbackColumns").add(1)
+            for plan in enc.plans.values():
+                for ename, nvals in plan.encoding_values.items():
+                    metrics.create(
+                        f"deviceDecodedValues.{ename}").add(nvals)
+            return enc
+
+        def decode_unit(u: ScanUnit) -> List:
+            """One unit -> MATERIALIZED batches (EncodedBatch or
+            HostBatches) for the prefetch pool: the arrow->HostBatch
+            conversion (string object arrays, casts) runs IN the pool
+            thread so the consumer thread only packs/uploads
+            (MultiFileCloudParquetPartitionReader keeps its host-side
+            decode off the task thread the same way). The PERFILE path
+            streams instead (one batch in flight, not a whole file)."""
+            if device_decode:
+                enc = plan_device(u)
+                if enc is not None:
+                    return [enc]
             return list(emit(decode(u)))
 
         from spark_rapids_tpu.sql import expressions as E
@@ -566,19 +645,34 @@ class CpuFileScanExec(P.PhysicalPlan):
                     from collections import deque
                     from itertools import islice
                     it = iter(units)
-                    futures = deque(pool.submit(decode_host, u)
+                    futures = deque(pool.submit(decode_unit, u)
                                     for u in islice(it, n_threads + 2))
                     done = iter(units)
-                    while futures:
-                        f = futures.popleft()
-                        nxt = next(it, None)
-                        if nxt is not None:
-                            futures.append(pool.submit(decode_host, nxt))
-                        _set_file(next(done).path)
-                        for hb in f.result():
-                            yield hb
-                else:  # PERFILE
+                    try:
+                        while futures:
+                            f = futures.popleft()
+                            nxt = next(it, None)
+                            if nxt is not None:
+                                futures.append(
+                                    pool.submit(decode_unit, nxt))
+                            _set_file(next(done).path)
+                            for hb in f.result():
+                                yield hb
+                    finally:
+                        # a decode error (or a closed consumer) must not
+                        # leak pool work: unstarted prefetches are
+                        # cancelled so the shared pool drains promptly
+                        # and later queries see a clean queue
+                        for f in futures:
+                            f.cancel()
+                else:  # PERFILE: streamed, one host batch in flight
                     for u in units:
+                        if device_decode:
+                            enc = plan_device(u)
+                            if enc is not None:
+                                _set_file(u.path)
+                                yield enc
+                                continue
                         tbl = decode(u)
                         _set_file(u.path)
                         yield from emit(tbl)

@@ -48,6 +48,10 @@ class TpuMetric:
     value: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock,
                                   repr=False, compare=False)
+    # wall-union timer state (timed_wall): overlapping intervals from
+    # concurrent threads count once
+    _active: int = field(default=0, repr=False, compare=False)
+    _wall_start: int = field(default=0, repr=False, compare=False)
 
     def add(self, v: int) -> None:
         with self._lock:
@@ -56,6 +60,18 @@ class TpuMetric:
     def set_max(self, v: int) -> None:
         with self._lock:
             self.value = max(self.value, int(v))
+
+    def enter_wall(self) -> None:
+        with self._lock:
+            if self._active == 0:
+                self._wall_start = time.perf_counter_ns()
+            self._active += 1
+
+    def exit_wall(self) -> None:
+        with self._lock:
+            self._active -= 1
+            if self._active == 0:
+                self.value += time.perf_counter_ns() - self._wall_start
 
 
 class MetricRegistry:
@@ -91,6 +107,21 @@ class MetricRegistry:
             yield
         finally:
             m.add(time.perf_counter_ns() - t0)
+
+    @contextlib.contextmanager
+    def timed_wall(self, name: str, level: int = MODERATE
+                   ) -> Iterator[None]:
+        """Union-of-intervals timer: when N pool threads run the same
+        phase concurrently, the metric advances by WALL time, not by N
+        stacked thread-times, so a stage breakdown sums against the
+        query wall sensibly (round-5 issue: q1's drain metric read
+        11.6s against a 5.4s wall)."""
+        m = self.create(name, level)
+        m.enter_wall()
+        try:
+            yield
+        finally:
+            m.exit_wall()
 
     def snapshot(self) -> Dict[str, int]:
         return {k: m.value for k, m in self.metrics.items()}

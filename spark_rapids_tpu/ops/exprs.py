@@ -2038,13 +2038,30 @@ def _h_locate(e: E.StringLocate, ctx: Ctx) -> DeviceColumn:
 def _first_match_at_or_after(s: DeviceStringColumn, pat: DeviceStringColumn,
                              start: jax.Array) -> jax.Array:
     """Per-row first byte offset >= start where pat occurs in s, or -1.
-    O(char_cap) rounds of vectorized window compares."""
-    cap = max(s.char_cap, 1)
-    best = jnp.full(s.lengths.shape[0], -1, dtype=jnp.int32)
-    for p in range(cap):
-        at = jnp.full_like(start, p)
-        hit = _sliding_match(s, pat, at) & (p >= start)
-        best = jnp.where((best < 0) & hit, jnp.int32(p), best)
+
+    One 3-D windowed compare (rows, start_pos, pat_off) + argmax. The
+    former per-position python loop unrolled char_cap chained gathers
+    into the program; XLA's CPU backend spent MINUTES compiling the
+    5-expression projection in test_instr_locate (the round-5 tier-1
+    wall: every test after it never ran). A single broadcast gather
+    compiles in milliseconds and fuses with its consumers."""
+    rows = s.lengths.shape[0]
+    scap = max(s.char_cap, 1)
+    pcap = max(pat.char_cap, 1)  # pattern axis sized by the PATTERN
+    sc, pc = _pad_chars(s, scap), _pad_chars(pat, pcap)
+    spos = jnp.arange(scap, dtype=jnp.int32)
+    ppos = jnp.arange(pcap, dtype=jnp.int32)
+    idx = jnp.clip(spos[None, :, None] + ppos[None, None, :],
+                   0, scap - 1)
+    win = sc[jnp.arange(rows)[:, None, None], idx]
+    in_pat = ppos[None, None, :] < pat.lengths[:, None, None]
+    eq = jnp.where(in_pat, win == pc[:, None, :], True).all(axis=2)
+    ok_start = (spos[None, :] >= start[:, None]) & \
+        (spos[None, :] + pat.lengths[:, None] <= s.lengths[:, None])
+    hit = eq & ok_start
+    best = jnp.where(hit.any(axis=1),
+                     jnp.argmax(hit, axis=1).astype(jnp.int32),
+                     jnp.int32(-1))
     # empty pattern matches at `start` when start <= len(s)
     empty_hit = (pat.lengths == 0) & (start <= s.lengths)
     return jnp.where(empty_hit, start, best)

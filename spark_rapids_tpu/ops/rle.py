@@ -1,0 +1,127 @@
+"""Device-side Parquet page-decode kernels (XLA, static shapes).
+
+The reference decodes Parquet pages on the GPU inside cuDF
+(gpu_decode_page_data / rle_stream in cudf's parquet reader); these are
+the TPU twins, built from gathers and elementwise bit math so XLA can
+fuse them into ONE decode program per scan batch:
+
+- ``hybrid_lookup``: positional decode of the RLE/bit-packed hybrid
+  stream (dictionary indices, definition levels). The *run headers* are
+  parsed on the host (they are a few bytes per run); the *payload* —
+  every packed value — is extracted here, on device, from the raw page
+  bytes. Each output position binary-searches its run, then either
+  broadcasts the run's RLE value or bit-gathers from the packed words.
+- ``read_le`` / ``read_be_signed`` / ``read_be_limbs``: PLAIN
+  fixed-width and FIXED_LEN_BYTE_ARRAY (decimal) reinterpretation at
+  arbitrary byte offsets.
+
+All functions are shape-polymorphic trace-time helpers: they take the
+byte array as an int32 array (one byte per element, the form
+``bytes_of_words`` produces from the packed int32 staging words) and
+int64 offset arrays, and return int64 values. Callers mask invalid
+lanes afterwards; out-of-range offsets are clipped, never trapped.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# A bit-packed value of width <= 32 plus a 0..7 bit phase spans at most
+# 5 bytes; gathering a fixed 5-byte window keeps the kernel one fused
+# gather + shift instead of a data-dependent loop.
+_PACKED_WINDOW = 5
+
+
+def bytes_of_words(words: jax.Array) -> jax.Array:
+    """int32 staging words -> int32 byte array (little-endian order)."""
+    shifts = jnp.arange(4, dtype=jnp.int32) * 8
+    return ((words[:, None] >> shifts) & 0xFF).reshape(-1)
+
+
+def _gather_window(bytes_all: jax.Array, byte_off: jax.Array,
+                   width: int) -> jax.Array:
+    """(m, width) int64 window of bytes starting at byte_off (clipped)."""
+    nb = bytes_all.shape[0]
+    idx = byte_off[:, None] + jnp.arange(width, dtype=jnp.int64)
+    return bytes_all[jnp.clip(idx, 0, nb - 1)].astype(jnp.int64)
+
+
+def read_packed(bytes_all: jax.Array, bit_off: jax.Array,
+                width: jax.Array) -> jax.Array:
+    """Extract ``width``-bit little-endian values at arbitrary bit
+    offsets (the Parquet bit-packed layout). width may vary per lane
+    (dictionary index width differs across pages); width <= 32."""
+    byte0 = bit_off >> 3
+    shift = bit_off & 7
+    win = _gather_window(bytes_all, byte0, _PACKED_WINDOW)
+    k = jnp.arange(_PACKED_WINDOW, dtype=jnp.int64) * 8
+    word = jnp.sum(win << k, axis=1)
+    mask = (jnp.int64(1) << width.astype(jnp.int64)) - 1
+    return (word >> shift) & mask
+
+
+def hybrid_lookup(bytes_all: jax.Array, pos: jax.Array,
+                  out_start: jax.Array, packed: jax.Array,
+                  value: jax.Array, bit_start: jax.Array,
+                  width: jax.Array) -> jax.Array:
+    """Decode the RLE/bit-packed hybrid stream at positions ``pos``.
+
+    The run table (out_start ascending, padded with a huge sentinel;
+    packed flag; RLE value; absolute payload bit offset; per-run bit
+    width) comes from the host-side header parse. Positions beyond the
+    last real run decode garbage — callers mask by validity/active."""
+    rid = jnp.searchsorted(out_start, pos, side="right") - 1
+    rid = jnp.clip(rid, 0, out_start.shape[0] - 1)
+    local = pos - out_start[rid]
+    w = width[rid]
+    v_packed = read_packed(bytes_all, bit_start[rid] + local * w, w)
+    return jnp.where(packed[rid], v_packed, value[rid])
+
+
+def read_le(bytes_all: jax.Array, byte_off: jax.Array,
+            nbytes: int) -> jax.Array:
+    """PLAIN fixed-width reinterpret: little-endian nbytes -> int64
+    (sign bits land naturally for nbytes == 8; narrower widths are
+    returned zero-extended — cast to the narrow dtype to re-sign)."""
+    win = _gather_window(bytes_all, byte_off, nbytes)
+    k = jnp.arange(nbytes, dtype=jnp.int64) * 8
+    return jnp.sum(win << k, axis=1)
+
+
+def _sign_extend(v: jax.Array, nbytes: int) -> jax.Array:
+    if nbytes >= 8:
+        return v
+    bits = 8 * nbytes
+    return v - ((v >> (bits - 1)) << bits)
+
+
+def read_be_signed(bytes_all: jax.Array, byte_off: jax.Array,
+                   nbytes: int) -> jax.Array:
+    """FIXED_LEN_BYTE_ARRAY decimal: big-endian two's-complement of
+    nbytes (<= 8) -> signed int64 (the engine's DECIMAL64 storage)."""
+    win = _gather_window(bytes_all, byte_off, nbytes)
+    k = jnp.arange(nbytes - 1, -1, -1, dtype=jnp.int64) * 8
+    return _sign_extend(jnp.sum(win << k, axis=1), nbytes)
+
+
+def read_be_limbs(bytes_all: jax.Array, byte_off: jax.Array,
+                  nbytes: int) -> tuple:
+    """FIXED_LEN_BYTE_ARRAY decimal128: big-endian two's-complement of
+    nbytes (9..16) -> (hi, lo) int64 limbs (transfer.py's dec128
+    layout: hi = value >> 64 arithmetic, lo = low 64 bits)."""
+    lo_bytes = 8
+    hi_bytes = nbytes - 8
+    hi = read_be_signed(bytes_all, byte_off, hi_bytes)
+    win = _gather_window(bytes_all, byte_off + hi_bytes, lo_bytes)
+    k = jnp.arange(lo_bytes - 1, -1, -1, dtype=jnp.int64) * 8
+    lo = jnp.sum(win << k, axis=1)
+    return hi, lo
+
+
+def dense_ranks(validity: jax.Array) -> jax.Array:
+    """Row -> index of its value in the null-stripped (dense) value
+    stream: Parquet data pages store only non-null values, so row i's
+    value is the rank-of-i-among-valid-rows'th entry (the reference
+    calls this the value scatter step of page decode)."""
+    return jnp.cumsum(validity.astype(jnp.int32)) - 1

@@ -20,7 +20,10 @@ from typing import Callable, Dict, Tuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+try:  # jax >= 0.4.35 re-exports shard_map at the top level
+    from jax import shard_map
+except ImportError:  # older jax: experimental home
+    from jax.experimental.shard_map import shard_map
 
 from spark_rapids_tpu.columnar.device import DeviceColumn
 from spark_rapids_tpu.ops import groupby as G

@@ -258,6 +258,17 @@ def is_floating(dt: DataType) -> bool:
     return isinstance(dt, (FloatType, DoubleType))
 
 
+def storage_zeros(dt: DataType, n: int) -> np.ndarray:
+    """Zeroed host buffer in the engine's storage layout for ``dt``.
+    DECIMAL128 is the one type whose storage is not a flat numpy dtype:
+    its unscaled value lives in an (n, 2) int64 [hi, lo] limb pair (the
+    layout transfer.py ships and ops/int128.py computes over), so
+    buffer allocation must go through here, not numpy_dtype."""
+    if is_limb_decimal(dt):
+        return np.zeros((n, 2), dtype=np.int64)
+    return np.zeros(n, dtype=numpy_dtype(dt))
+
+
 def numpy_dtype(dt: DataType) -> np.dtype:
     """numpy storage dtype for the fixed-width physical representation."""
     if isinstance(dt, DecimalType):

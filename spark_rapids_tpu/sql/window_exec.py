@@ -122,7 +122,8 @@ class CpuWindowExec(P.PhysicalPlan):
         elif isinstance(func, E.Lag):
             vals = E.bind_references(func.input, child_out).eval(batch)
 
-        out_data = np.zeros(n, dtype=T.numpy_dtype(dt))
+        # storage_zeros: decimal128 outputs need the (n, 2) limb layout
+        out_data = T.storage_zeros(dt, n)
         out_valid = np.zeros(n, dtype=bool)
 
         for g in range(n_groups):
@@ -185,11 +186,20 @@ class CpuWindowExec(P.PhysicalPlan):
             gd = vals.data[sorted_rows][safe]
             gv = vals.validity[sorted_rows][safe] & ok
             if func.default is not None:
-                dcol = func.default.eval(
-                    HostBatch(T.StructType([]), [], 1))
+                # the analyzer-level cast Spark inserts: one rounding
+                # implementation (Cast's HALF_UP decimal rescale, limb
+                # split included) shared with the device exec
+                dflt = func.default
+                if dflt.data_type != dt:
+                    dflt = E.Cast(dflt, dt)
+                dcol = dflt.eval(HostBatch(T.StructType([]), [], 1))
                 if dcol.validity[0]:
-                    gd = np.where(ok, gd, dcol.data[0])
+                    # decimal128 data is (m, 2) limbs: lift the row mask
+                    okb = ok[:, None] if gd.ndim == 2 else ok
+                    gd = np.where(okb, gd, dcol.data[0])
                     gv = gv | ~ok
+            if T.is_limb_decimal(dt):
+                return gd.astype(np.int64), gv
             return gd.astype(T.numpy_dtype(dt)), gv
         if isinstance(func, E.AggregateExpression):
             return self._agg_over_group(func.func, frame, vals,

@@ -300,6 +300,39 @@ def generate_supported_ops() -> str:
             f"| {rule.name} | `{rule.conf_key}` "
             f"| {sig_str(rule.checks.output)} "
             f"| {sig_str(rule.checks.inputs)} | {note} |")
+    lines += [
+        "",
+        "## Parquet device decode (encoding matrix)",
+        "",
+        "With `spark.rapids.sql.format.parquet.deviceDecode.enabled` "
+        "the scan uploads still-encoded page bytes and decodes them in "
+        "one XLA program per batch (io/device_decode.py + ops/rle.py). "
+        "Unsupported cells fall back PER COLUMN to the pyarrow host "
+        "decode — results are bit-identical either way. The "
+        "`PERFILE`/`MULTITHREADED` reader types feed the device path; "
+        "`COALESCING` keeps the host decode (its point is the "
+        "one-table stitch). Compression is handled on the host: "
+        "uncompressed, snappy, zstd, gzip, brotli (lz4 falls back).",
+        "",
+        "| Type | PLAIN | PLAIN_DICTIONARY / RLE_DICTIONARY | "
+        "DELTA_* / BYTE_STREAM_SPLIT |",
+        "|---|---|---|---|",
+        "| BOOLEAN | device (bit-unpack) | fallback | fallback |",
+        "| INT32 (byte/short/int/date/decimal) | device | device | "
+        "fallback |",
+        "| INT64 (long/timestamp-micros/decimal) | device | device | "
+        "fallback |",
+        "| INT96 (legacy timestamp) | fallback | fallback | fallback |",
+        "| FLOAT | device | device | fallback |",
+        "| DOUBLE | device (backends with exact f64 bitcast; TPU "
+        "falls back) | same | fallback |",
+        "| FIXED_LEN_BYTE_ARRAY (decimal64/decimal128) | device "
+        "(big-endian limb build) | device | fallback |",
+        "| BYTE_ARRAY (string/binary) | fallback | device "
+        "(dictionary gather) | fallback |",
+        "| nested (LIST/MAP/STRUCT, repeated) | fallback | fallback "
+        "| fallback |",
+    ]
     return "\n".join(lines) + "\n"
 
 

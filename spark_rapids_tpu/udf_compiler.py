@@ -77,6 +77,16 @@ def compile_udf(fn, arg_exprs: List[E.Expression],
 
 _NULL = object()  # the NULL slot LOAD_GLOBAL/PUSH_NULL leave for CALL
 
+# Python <= 3.10 per-operator bytecodes (3.11+ folded them into
+# BINARY_OP); '//' intentionally absent, see the BINARY_OP note
+_LEGACY_BINOPS = {
+    "BINARY_ADD": "+", "INPLACE_ADD": "+",
+    "BINARY_SUBTRACT": "-", "INPLACE_SUBTRACT": "-",
+    "BINARY_MULTIPLY": "*", "INPLACE_MULTIPLY": "*",
+    "BINARY_TRUE_DIVIDE": "/", "INPLACE_TRUE_DIVIDE": "/",
+    "BINARY_MODULO": "%", "INPLACE_MODULO": "%",
+}
+
 
 def _py_mod(a, b):
     """Python's sign-follows-divisor ``%`` from SQL Remainder (whose
@@ -223,10 +233,13 @@ def _exec(instrs, by_offset, i: int, stack: List, params,
                 stack.append(_apply_math(f[1], args))
             else:
                 raise _Unsupported("unsupported callable")
-        elif op == "BINARY_OP":
+        elif op == "BINARY_OP" or op in _LEGACY_BINOPS:
+            # _LEGACY_BINOPS: Python <= 3.10 emits one opcode per
+            # operator (BINARY_ADD, INPLACE_ADD, ...) where 3.11+
+            # emits BINARY_OP with the symbol in argrepr
             r = stack.pop()
             a = stack.pop()
-            sym = ins.argrepr.replace("=", "")
+            sym = _LEGACY_BINOPS.get(op) or ins.argrepr.replace("=", "")
             if sym == "+":
                 stack.append(a + r)
             elif sym == "-":

@@ -175,3 +175,48 @@ def test_cbo_reverts_multi_op_island_on_tiny_input():
         sp.stop()
     assert len(out) == 60
     assert "TpuProject" not in pstr and "TpuFilter" not in pstr, pstr
+
+
+# -- metric timers (ISSUE 1 satellite: drain-time overlap) ------------------
+
+def test_timed_wall_unions_concurrent_intervals():
+    """N pool threads timing the same phase concurrently must advance
+    the metric by WALL time (interval union), not N stacked
+    thread-times — the round-5 bench reported an 11.6s drain against a
+    5.4s wall because of exactly this overlap."""
+    import threading
+    import time
+
+    from spark_rapids_tpu.metrics import MetricRegistry
+
+    reg = MetricRegistry("MODERATE")
+
+    def work():
+        with reg.timed_wall("pipelineDrainTime"):
+            time.sleep(0.15)
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - start
+    got = reg.value("pipelineDrainTime") / 1e9
+    # concurrent intervals count once: metric <= actual wall, and far
+    # below the 0.6s a per-thread sum would report
+    assert got <= wall + 0.02, (got, wall)
+    assert got < 0.45, got
+
+
+def test_timed_wall_sums_disjoint_intervals():
+    import time
+
+    from spark_rapids_tpu.metrics import MetricRegistry
+
+    reg = MetricRegistry("MODERATE")
+    for _ in range(3):
+        with reg.timed_wall("decodeTime"):
+            time.sleep(0.03)
+    got = reg.value("decodeTime") / 1e9
+    assert 0.09 <= got < 0.3, got

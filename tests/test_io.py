@@ -118,6 +118,65 @@ def test_parquet_reader_strategies(tmpdir_path, reader_type):
         spark.stop()
 
 
+def test_multithreaded_reader_fault_propagates_and_cancels(tmpdir_path,
+                                                           monkeypatch):
+    """A decode_host future that raises mid-stream must surface the
+    error to the caller AND cancel outstanding prefetch futures instead
+    of leaking pool work (ISSUE 1 satellite); the shared pool must stay
+    usable for the next query."""
+    import time
+
+    from spark_rapids_tpu.io import readers as RD
+
+    path = os.path.join(tmpdir_path, "multi")
+    os.makedirs(path)
+    spark = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        for i in range(10):
+            spark.createDataFrame(
+                {"a": list(range(i * 10, i * 10 + 10))},
+                "a bigint").write.mode("overwrite").parquet(
+                os.path.join(path, f"sub{i}"))
+    finally:
+        spark.stop()
+
+    calls = []
+    real_read = RD._read_unit
+
+    def faulty_read(fmt, unit, schema, options):
+        calls.append(unit.path)
+        if "sub0" in unit.path:
+            raise RuntimeError("injected decode fault")
+        time.sleep(0.05)  # keep later prefetches queued, not running
+        return real_read(fmt, unit, schema, options)
+
+    monkeypatch.setattr(RD, "_read_unit", faulty_read)
+    conf = {
+        "spark.rapids.sql.enabled": "false",
+        "spark.rapids.sql.format.parquet.reader.type": "MULTITHREADED",
+        "spark.rapids.sql.format.parquet.multiThreadedRead.numThreads":
+            "2",
+    }
+    spark = TpuSparkSession(conf)
+    try:
+        with pytest.raises(RuntimeError, match="injected decode fault"):
+            spark.read.parquet(path).collect()
+    finally:
+        spark.stop()
+    # the error cancelled the un-started prefetch window: the pool never
+    # decoded the whole dataset
+    assert len(calls) < 10, calls
+
+    # and the shared pool is healthy for the next (fault-free) query
+    monkeypatch.setattr(RD, "_read_unit", real_read)
+    spark = TpuSparkSession(conf)
+    try:
+        got = sorted(r.a for r in spark.read.parquet(path).collect())
+        assert got == list(range(100))
+    finally:
+        spark.stop()
+
+
 def test_reader_batch_size_rows_splits_batches(tmpdir_path):
     path = os.path.join(tmpdir_path, "p")
     _write_dataset(path, n=100)
