@@ -237,17 +237,50 @@ def run_tpcds_q3(spark, capture=False):
 def stage_breakdown(plans) -> dict:
     """Aggregate per-operator time metrics from the captured physical
     plan of the LAST timed run (VERDICT r3 weak #10: publish where the
-    wall time goes, not just its total)."""
+    wall time goes, not just its total). Fused stages fan their metrics
+    back to their constituent execs, so the breakdown keeps the same
+    per-operator stage keys whether or not fusion is enabled."""
     out: dict = {}
 
-    def walk(p):
+    def visit(p):
         ms = getattr(p, "metrics", None)
-        if ms is not None:
-            name = p.simple_string().split()[0]
-            for k, v in ms.snapshot().items():
-                if "Time" in k and v:
-                    key = f"{name}.{k}"
-                    out[key] = round(out.get(key, 0.0) + v / 1e9, 3)
+        if ms is None:
+            return
+        name = p.simple_string().split()[0]
+        for k, v in ms.snapshot().items():
+            if "Time" in k and v:
+                key = f"{name}.{k}"
+                out[key] = round(out.get(key, 0.0) + v / 1e9, 3)
+
+    def walk(p):
+        visit(p)
+        for op in getattr(p, "fused_ops", []):
+            visit(op)  # shallow: child links point back into the chain
+        for c in p.children:
+            walk(c)
+
+    for plan in plans or []:
+        walk(plan)
+    return out
+
+
+def collect_counters(plans, names) -> dict:
+    """Sum named metric counters across every exec (fused constituents
+    included) of the captured plans."""
+    out = {n: 0 for n in names}
+
+    def add(p):
+        ms = getattr(p, "metrics", None)
+        if ms is None:
+            return
+        snap = ms.snapshot()
+        for n in names:
+            out[n] += snap.get(n, 0)
+
+    def walk(p):
+        add(p)
+        for op in getattr(p, "fused_ops", []):
+            add(op)
         for c in p.children:
             walk(c)
 
@@ -290,7 +323,65 @@ def decode_breakdown(plans) -> dict:
     return out
 
 
+TPU_CONF = {
+    "spark.rapids.sql.enabled": "true",
+    "spark.rapids.sql.test.forceDevice": "true",  # fail on any fallback
+    "spark.rapids.sql.variableFloatAgg.enabled": "true",
+    # TPU executes f64 via emulation (not bit-identical rounding);
+    # q1's double arithmetic opts in exactly like the reference's
+    # .incompat() ops, and the result assert holds doubles to 1e-9
+    "spark.rapids.sql.incompatibleOps.enabled": "true",
+    # overlap per-task host round trips with device compute
+    "spark.rapids.sql.taskParallelism": "4",
+    "spark.rapids.sql.concurrentGpuTasks": "4",
+    # decode parquet pages on device (round-5 verdict: host decode
+    # was the dominant cost; this moves the per-value work to XLA)
+    "spark.rapids.sql.format.parquet.deviceDecode.enabled": "true",
+}
+
+_COUNTERS = ("dispatchCount", "stageCompileTime", "fusedOps")
+
+
+def run_tpu(fusion_enabled: bool) -> dict:
+    """One full TPU pass (q1 warm + 3 timed, q3) with stage fusion on
+    or off — the fused-vs-unfused comparison runs in the SAME bench
+    invocation so the walls are directly comparable."""
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    conf = dict(TPU_CONF)
+    conf["spark.rapids.sql.stageFusion.enabled"] = str(
+        fusion_enabled).lower()
+    tpu = TpuSparkSession(conf)
+    q_tpu = build_query(tpu)
+    tpu.start_capture()
+    run_once(q_tpu)  # jit compile warm-up
+    warm_counters = collect_counters(tpu.get_captured_plans(), _COUNTERS)
+    times, rows = [], None
+    for i in range(3):
+        if i == 2:
+            tpu.start_capture()
+        dt, rows = run_once(q_tpu)
+        times.append(dt)
+    captured = tpu.get_captured_plans()
+    counters = collect_counters(captured, _COUNTERS)
+    out = {
+        "wall_s": round(min(times), 4),
+        "rows": rows,
+        "stages": stage_breakdown(captured),
+        "decode": decode_breakdown(captured),
+        "dispatchCount": counters["dispatchCount"],
+        "fusedOps": counters["fusedOps"],
+        "stageCompileTime_s": round(
+            warm_counters["stageCompileTime"] / 1e9, 3),
+    }
+    q3_t, q3_rows, q3_stages, q3_decode = run_tpcds_q3(tpu, capture=True)
+    out["q3"] = {"wall_s": round(q3_t, 4), "rows": q3_rows,
+                 "stages": q3_stages, "decode": q3_decode}
+    tpu.stop()
+    return out
+
+
 def main():
+    from spark_rapids_tpu.jit_cache import cache_stats
     from spark_rapids_tpu.sql.session import TpuSparkSession
 
     gen = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
@@ -308,41 +399,19 @@ def main():
     q3_cpu_t, q3_cpu_rows, _, _ = run_tpcds_q3(cpu)
     cpu.stop()
 
-    tpu = TpuSparkSession({
-        "spark.rapids.sql.enabled": "true",
-        "spark.rapids.sql.test.forceDevice": "true",  # fail on any fallback
-        "spark.rapids.sql.variableFloatAgg.enabled": "true",
-        # TPU executes f64 via emulation (not bit-identical rounding);
-        # q1's double arithmetic opts in exactly like the reference's
-        # .incompat() ops, and the result assert holds doubles to 1e-9
-        "spark.rapids.sql.incompatibleOps.enabled": "true",
-        # overlap per-task host round trips with device compute
-        "spark.rapids.sql.taskParallelism": "4",
-        "spark.rapids.sql.concurrentGpuTasks": "4",
-        # decode parquet pages on device (round-5 verdict: host decode
-        # was the dominant cost; this moves the per-value work to XLA)
-        "spark.rapids.sql.format.parquet.deviceDecode.enabled": "true",
-    })
-    q_tpu = build_query(tpu)
-    run_once(q_tpu)  # jit compile warm-up
-    tpu_times, tpu_rows = [], None
-    stages = None
-    for i in range(3):
-        if i == 2:
-            tpu.start_capture()
-        dt, tpu_rows = run_once(q_tpu)
-        tpu_times.append(dt)
-    captured = tpu.get_captured_plans()
-    stages = stage_breakdown(captured)
-    decode = decode_breakdown(captured)
-    q3_tpu_t, q3_tpu_rows, q3_stages, q3_decode = run_tpcds_q3(tpu, capture=True)
-    tpu.stop()
+    # unfused FIRST (its compile misses don't warm fused-stage
+    # programs; the fused pass compiles its own)
+    unfused = run_tpu(fusion_enabled=False)
+    fused = run_tpu(fusion_enabled=True)
 
-    assert_rows_match(cpu_rows, tpu_rows)
-    assert_rows_match(q3_cpu_rows, q3_tpu_rows)
+    assert_rows_match(cpu_rows, fused["rows"])
+    assert_rows_match(cpu_rows, unfused["rows"])
+    assert_rows_match(q3_cpu_rows, fused["q3"]["rows"])
+    assert_rows_match(q3_cpu_rows, unfused["q3"]["rows"])
 
     cpu_t = min(cpu_times)
-    tpu_t = min(tpu_times)
+    tpu_t = fused["wall_s"]
+    q3_tpu_t = fused["q3"]["wall_s"]
     speedup = cpu_t / tpu_t
     print(json.dumps({
         "metric": "tpch_q1_sf1_parquet",
@@ -355,15 +424,31 @@ def main():
             "speedup_vs_cpu_engine": round(speedup, 4),
             "backend": __import__("jax").default_backend(),
             "rows": N_ROWS,
-            "stages": stages,
-            "decode": decode,
+            "stages": fused["stages"],
+            "decode": fused["decode"],
+            "fusion": {
+                "q1_fused_wall_s": fused["wall_s"],
+                "q1_unfused_wall_s": unfused["wall_s"],
+                "q1_fusion_speedup": round(
+                    unfused["wall_s"] / fused["wall_s"], 4),
+                "q3_fused_wall_s": fused["q3"]["wall_s"],
+                "q3_unfused_wall_s": unfused["q3"]["wall_s"],
+                "q3_fusion_speedup": round(
+                    unfused["q3"]["wall_s"] / fused["q3"]["wall_s"], 4),
+                "dispatchCount_fused": fused["dispatchCount"],
+                "dispatchCount_unfused": unfused["dispatchCount"],
+                "fusedOps": fused["fusedOps"],
+                "stageCompileTime_s": fused["stageCompileTime_s"],
+                "unfused_stages": unfused["stages"],
+            },
+            "jitCaches": cache_stats(),
             "tpcds_q3": {
                 "device_wall_s": round(q3_tpu_t, 4),
                 "cpu_engine_wall_s": round(q3_cpu_t, 4),
                 "speedup_vs_cpu_engine": round(q3_cpu_t / q3_tpu_t, 4),
                 "rows": TPCDS_ROWS,
-                "stages": q3_stages,
-                "decode": q3_decode,
+                "stages": fused["q3"]["stages"],
+                "decode": fused["q3"]["decode"],
             },
         },
     }))

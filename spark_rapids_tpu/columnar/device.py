@@ -386,7 +386,9 @@ def finish_to_host(token) -> HostBatch:
     return HostBatch(batch.schema, cols, len(idx))
 
 
-_FETCH_PACK_CACHE: dict = {}
+from spark_rapids_tpu.jit_cache import JitCache
+
+_FETCH_PACK_CACHE = JitCache("fetchPack")
 
 
 def start_fetch(arrays: List[jax.Array]):
@@ -409,8 +411,7 @@ def start_fetch(arrays: List[jax.Array]):
                 jnp.concatenate([arrs[i].reshape(-1) for i in idxs])
                 if len(idxs) > 1 else arrs[idxs[0]].reshape(-1)
                 for _dt, idxs in order)
-        cached = (jax.jit(_fn), order)
-        _FETCH_PACK_CACHE[key] = cached
+        cached = _FETCH_PACK_CACHE.put(key, (jax.jit(_fn), order))
     jfn, order = cached
     packed = jfn(*arrays)
     _prefetch_host(list(packed))
@@ -506,7 +507,7 @@ def _put(arr: np.ndarray, device: Optional[jax.Device]) -> jax.Array:
 # One fused program per (input shape-set, output capacity): eager
 # op-by-op dispatch costs ~100ms per op on tunneled TPU backends, so the
 # whole concatenation must be a single XLA executable.
-_CONCAT_CACHE: dict = {}
+_CONCAT_CACHE = JitCache("concat")
 
 
 def concat_device(batches: Sequence[DeviceBatch]) -> DeviceBatch:
@@ -577,8 +578,7 @@ def concat_device(batches: Sequence[DeviceBatch]) -> DeviceBatch:
             total_t = offs[len(flats)]
             active = jnp.arange(cap) < total_t
             return active, tuple(outs)
-        fn = jax.jit(_fn)
-        _CONCAT_CACHE[key] = fn
+        fn = _CONCAT_CACHE.put(key, jax.jit(_fn))
     counts_arr = jnp.asarray(np.asarray(counts, dtype=np.int64))
     all_flat = [a for flat in flats for a in flat]
     active, outs = fn(counts_arr, *all_flat)
@@ -821,7 +821,7 @@ def compact(batch: DeviceBatch) -> DeviceBatch:
     return DeviceBatch(batch.schema, cols, new_active, batch._num_rows)
 
 
-_SHRINK_CACHE: dict = {}
+_SHRINK_CACHE = JitCache("shrink")
 
 
 def _shrink_impl(batch: DeviceBatch, n: int, compact_first: bool
@@ -842,8 +842,7 @@ def _shrink_impl(batch: DeviceBatch, n: int, compact_first: bool
                 active, arrs = _compact_body(active, arrs)
             return active[:cap], tuple(
                 (a[:cap] if a.ndim == 1 else a[:cap, :]) for a in arrs)
-        fn = jax.jit(_fn)
-        _SHRINK_CACHE[key] = fn
+        fn = _SHRINK_CACHE.put(key, jax.jit(_fn))
     new_active, outs = fn(batch.active, *flat)
     return DeviceBatch(batch.schema, rebuild_columns(spec, outs),
                        new_active, n)

@@ -247,9 +247,9 @@ def pack_batch(batch) -> Tuple[np.ndarray, List[np.ndarray], Tuple]:
 # Bounded LRU: every distinct (layout, n, cap, nbytes) compiles its own
 # decode program; long sessions with varying batch sizes must not retain
 # them all.
-_DECODE_CACHE: "OrderedDict[Tuple, Callable]" = OrderedDict()
-_DECODE_CACHE_MAX = 64
-_DECODE_CACHE_LOCK = threading.Lock()
+from spark_rapids_tpu.jit_cache import JitCache
+
+_DECODE_CACHE = JitCache("uploadDecode", capacity=64)
 
 
 def _pad_cap(x: jax.Array, n: int, cap: int) -> jax.Array:
@@ -474,16 +474,9 @@ def finish_upload(staged, device: Optional[jax.Device] = None):
         return _finish_encoded_upload(staged, device)
     _tag, schema, n, cap, words, extras, layout = staged
     key = (layout, n, cap, words.nbytes)
-    with _DECODE_CACHE_LOCK:
-        fn = _DECODE_CACHE.get(key)
-        if fn is not None:
-            _DECODE_CACHE.move_to_end(key)
+    fn = _DECODE_CACHE.get(key)
     if fn is None:
-        fn = _build_decode(layout, n, cap)
-        with _DECODE_CACHE_LOCK:
-            _DECODE_CACHE[key] = fn
-            while len(_DECODE_CACHE) > _DECODE_CACHE_MAX:
-                _DECODE_CACHE.popitem(last=False)
+        fn = _DECODE_CACHE.put(key, _build_decode(layout, n, cap))
     bufs = [words] + extras
     if device is not None:
         dev = jax.device_put(bufs, device)
@@ -697,16 +690,10 @@ def _finish_encoded_upload(staged, device: Optional[jax.Device] = None):
     from spark_rapids_tpu.columnar import device as D
     _tag, schema, n, cap, words, extras, layout, spec = staged
     key = ("enc", layout, n, cap, words.nbytes)
-    with _DECODE_CACHE_LOCK:
-        fn = _DECODE_CACHE.get(key)
-        if fn is not None:
-            _DECODE_CACHE.move_to_end(key)
+    fn = _DECODE_CACHE.get(key)
     if fn is None:
-        fn = _build_encoded_decode(layout, n, cap)
-        with _DECODE_CACHE_LOCK:
-            _DECODE_CACHE[key] = fn
-            while len(_DECODE_CACHE) > _DECODE_CACHE_MAX:
-                _DECODE_CACHE.popitem(last=False)
+        fn = _DECODE_CACHE.put(key,
+                               _build_encoded_decode(layout, n, cap))
     bufs = [words] + list(extras)
     if device is not None:
         dev = jax.device_put(bufs, device)

@@ -282,6 +282,24 @@ MULTITHREADED_READ_NUM_THREADS = conf(
     "Thread pool size for the multithreaded reader "
     "(GpuMultiFileReader.scala:300).").integer(8)
 
+STAGE_FUSION_ENABLED = conf("spark.rapids.sql.stageFusion.enabled").doc(
+    "Fuse maximal linear chains of per-batch device operators "
+    "(filter -> project -> partial hash-aggregate update) into ONE "
+    "jitted XLA program per batch (TpuFusedStageExec) — the whole-"
+    "stage-codegen / GpuTieredProject analogue. Cuts per-operator "
+    "dispatch and intermediate HBM materialization; results are "
+    "bit-identical to the unfused plan. Per-operator metrics still "
+    "report: fused nodes fan updates back to their constituent "
+    "execs (see docs/fusion.md).").boolean(True)
+
+STAGE_FUSION_MAX_IN_FLIGHT = conf(
+    "spark.rapids.sql.stageFusion.maxInFlight").doc(
+    "Async pipeline window of a fused stage: how many batches may be "
+    "in flight (dispatched to the device but not yet yielded "
+    "downstream) at once. Batch k+1's dispatch overlaps batch k's "
+    "device compute; the value bounds HBM held by outstanding "
+    "batches. 1 = sequential per-batch draining.").integer(2)
+
 PARQUET_DEVICE_DECODE = conf(
     "spark.rapids.sql.format.parquet.deviceDecode.enabled").doc(
     "Decode Parquet pages ON DEVICE: host threads read raw column-chunk "

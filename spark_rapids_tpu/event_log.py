@@ -38,6 +38,20 @@ def _collect_ops(physical) -> List[Dict[str, Any]]:
             if vals:
                 entry["metrics"] = vals
         ops.append(entry)
+        # fused stages keep their constituent execs (with fanned-back
+        # metrics) off the child axis; log them SHALLOW under the
+        # stage (their child links point back into the chain)
+        for op in getattr(p, "fused_ops", []):
+            fe: Dict[str, Any] = {"op": type(op).__name__,
+                                  "depth": depth + 1, "device": True,
+                                  "fused": True}
+            fm = getattr(op, "metrics", None)
+            if fm is not None:
+                vals = {k: v.value for k, v in fm.metrics.items()
+                        if v.value}
+                if vals:
+                    fe["metrics"] = vals
+            ops.append(fe)
         for c in getattr(p, "children", []):
             walk(c, depth + 1)
     walk(physical)

@@ -184,8 +184,11 @@ def is_device_agg(grouping: List[E.AttributeReference],
 
 
 # Compiled aggregation programs cached on structure so re-planned queries
-# (every collect() builds fresh exec instances) reuse XLA executables.
-_AGG_FN_CACHE: Dict[Tuple, Callable] = {}
+# (every collect() builds fresh exec instances) reuse XLA executables;
+# bounded LRU so long-running sessions can't grow it without limit.
+from spark_rapids_tpu.jit_cache import JitCache, mirror_to_metrics
+
+_AGG_FN_CACHE = JitCache("agg")
 
 _stack_counts = jax.jit(lambda cs: jnp.stack(cs))
 
@@ -200,6 +203,28 @@ class TpuHashAggregateExec(TpuExec):
         self.aggregates = aggregates
         self.mode = mode
         self.slots = slots
+        # stage fusion (exec/fused.py): filter/project prelude traced
+        # INSIDE this exec's per-batch program — one dispatch per batch
+        self._prelude_ops = None
+        self._prelude_bind_out = None
+        self._donate_input = False
+
+    def absorb_prelude(self, prelude_ops, source) -> None:
+        """Absorb a fusible filter/project chain: the chain's programs
+        fuse into this aggregate's per-batch update program (the
+        GpuTieredProject-into-aggregate shape). ``source`` becomes the
+        direct child; agg expressions keep binding against the chain
+        top's output (the attrs they were resolved to)."""
+        assert self.mode == "partial", self.mode
+        self._prelude_ops = list(prelude_ops)
+        self._prelude_bind_out = prelude_ops[-1].output
+        self.children = [source]
+        # donate input buffers only when the source's batches are
+        # freshly allocated and solely ours (see fused._source_owns)
+        from spark_rapids_tpu.exec.fused import (_donation_supported,
+                                                 _source_owns_buffers)
+        self._donate_input = (_donation_supported()
+                              and _source_owns_buffers(source))
 
     @property
     def child(self) -> TpuExec:
@@ -244,7 +269,8 @@ class TpuHashAggregateExec(TpuExec):
     def _build_fn(self, mode: str, key_bound: List[E.Expression],
                   slot_srcs: List[E.Expression],
                   prims: List[Tuple[str, T.DataType]],
-                  has_nans: bool) -> Callable:
+                  has_nans: bool, prelude_steps=None,
+                  donate: bool = False) -> Callable:
         aliases = self._agg_aliases()
         slot_counts = [len(self.slots[a.expr_id]) for a in aliases]
         grouping = self.grouping
@@ -262,6 +288,13 @@ class TpuHashAggregateExec(TpuExec):
         def fn(cols, active, lit_vals):
             from spark_rapids_tpu.columnar.device import (flatten_columns,
                                                           rebuild_columns)
+            if prelude_steps:
+                # fused filter/project prelude: the chain's mask update
+                # and projections trace INLINE ahead of the key/slot
+                # evaluation — one XLA program for the whole stage
+                prelude_lits, lit_vals = lit_vals
+                cols, active, _errs = X.trace_stage_steps(
+                    prelude_steps, cols, active, prelude_lits)
             cap = active.shape[0]
             ctx = X.Ctx(cols, cap, all_exprs, lit_vals)
             key_cols = [X.dev_eval(e, ctx) for e in key_bound]
@@ -350,7 +383,7 @@ class TpuHashAggregateExec(TpuExec):
                 else:
                     raise X.DeviceUnsupported(f"agg result expr {e!r}")
             return out_cols, out_active
-        return jax.jit(fn)
+        return jax.jit(fn, donate_argnums=(0, 1) if donate else ())
 
     def _out_desc(self) -> Tuple:
         """Structural descriptor of the result-column layout (what the
@@ -379,15 +412,30 @@ class TpuHashAggregateExec(TpuExec):
         where ``cnt`` is the device-scalar group count for partial/merge
         modes (compacted output) and None for final/complete."""
         mode = mode or self.mode
+        prelude = (self._prelude_ops
+                   if self._prelude_ops and mode == "partial" else None)
         if mode == "merge_partial":
             # merge-within-partial: inputs are in THIS exec's buffer
             # layout (self.output), not the child's raw rows
             bind_out = self.output
+        elif prelude:
+            # fused prelude: agg exprs reference the chain top's attrs,
+            # which the prelude steps produce in-program
+            bind_out = self._prelude_bind_out
         else:
             bind_out = self.child.output
         child_out = bind_out
         key_bound = [E.bind_references(g, child_out) for g in self.grouping]
         slot_srcs, prims = self._bound_slot_sources(mode, child_out)
+        prelude_steps = None
+        donate = False
+        if prelude:
+            from spark_rapids_tpu.exec.fused import (batch_donatable,
+                                                     bind_chain_steps)
+            prelude_steps = bind_chain_steps(prelude)
+            # per-batch: aliased buffers (one array on two pytree
+            # leaves) must not be donated twice
+            donate = self._donate_input and batch_donatable(batch)
         salt = G.kernel_salt()  # snapshot: key AND trace use this value
         key = (mode, salt,
                tuple(X.expr_key(e) for e in key_bound),
@@ -396,21 +444,49 @@ class TpuHashAggregateExec(TpuExec):
                tuple(repr(dt) for _, dt in prims),
                tuple(len(self.slots[a.expr_id])
                      for a in self._agg_aliases()),
-               self._out_desc())
-        fn = _AGG_FN_CACHE.get(key)
-        if fn is None:
-            fn = self._build_fn(mode, key_bound, slot_srcs, prims,
-                                has_nans=salt[0])
-            _AGG_FN_CACHE[key] = fn
+               self._out_desc(),
+               X.stage_structural_key(prelude_steps)
+               if prelude_steps else None, donate)
+        fn, was_miss = _AGG_FN_CACHE.get_or_build(
+            key, lambda: self._build_fn(mode, key_bound, slot_srcs,
+                                        prims, has_nans=salt[0],
+                                        prelude_steps=prelude_steps,
+                                        donate=donate))
+        mirror_to_metrics(_AGG_FN_CACHE, self.metrics, was_miss)
         lit_vals = X.literal_values(list(key_bound) + list(slot_srcs))
+        if prelude_steps:
+            lit_vals = (X.stage_literal_values(prelude_steps), lit_vals)
         cnt = None
-        with self.metrics.timed(M.AGG_TIME):
-            if mode in ("partial", "merge", "merge_partial"):
-                out_cols, out_active, cnt = fn(batch.columns, batch.active,
-                                               lit_vals)
-            else:
-                out_cols, out_active = fn(batch.columns, batch.active,
-                                          lit_vals)
+        self.metrics.create(M.DISPATCH_COUNT, M.ESSENTIAL).add(1)
+        import time as _time
+        t0 = _time.perf_counter_ns()
+        if mode in ("partial", "merge", "merge_partial"):
+            out_cols, out_active, cnt = fn(batch.columns, batch.active,
+                                           lit_vals)
+        else:
+            out_cols, out_active = fn(batch.columns, batch.active,
+                                      lit_vals)
+        elapsed = _time.perf_counter_ns() - t0
+        if was_miss:
+            # first call after a compile miss carries trace+XLA compile
+            self.metrics.create(M.STAGE_COMPILE_TIME,
+                                M.ESSENTIAL).add(elapsed)
+        elif prelude:
+            # per-operator metrics keep their stage keys
+            # (docs/fusion.md): the ONE program's wall splits evenly
+            # across prelude ops + the agg, so fused and unfused stage
+            # breakdowns stay comparable without double counting
+            share = elapsed // (len(prelude) + 1)
+            for op in prelude:
+                op.metrics.create(M.OP_TIME).add(share)
+            self.metrics.create(M.AGG_TIME).add(
+                elapsed - share * len(prelude))
+        else:
+            self.metrics.create(M.AGG_TIME).add(elapsed)
+        if prelude:
+            for op in prelude:
+                op.metrics.create(M.NUM_OUTPUT_BATCHES,
+                                  M.ESSENTIAL).add(1)
         if mode in ("merge", "merge_partial"):
             # buffer layout keeps the input's schema
             schema = T.StructType(

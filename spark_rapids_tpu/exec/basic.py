@@ -25,8 +25,11 @@ from spark_rapids_tpu.sql import types as T
 
 import jax
 
+# row counters are DEVICE int64 scalars created via T.device_long —
+# a bare jnp.int64 would silently truncate to int32 without x64 and
+# wrap past 2^31 rows; the explicit dtype= keeps the jitted sum wide
 _advance_rows = jax.jit(
-    lambda start, active: start + jnp.sum(active.astype(jnp.int64)))
+    lambda start, active: start + jnp.sum(active, dtype=jnp.int64))
 
 
 class TpuProjectExec(TpuExec):
@@ -55,8 +58,8 @@ class TpuProjectExec(TpuExec):
             def run() -> Iterator[DeviceBatch]:
                 # row_start rides as a DEVICE scalar so counting rows
                 # across batches never syncs to host
-                row_start = jnp.int64(0) if needs_part else None
-                pid_d = jnp.int64(pid) if needs_part else None
+                row_start = T.device_long(0) if needs_part else None
+                pid_d = T.device_long(pid) if needs_part else None
                 for b in thunk():
                     with metrics.timed(M.OP_TIME):
                         if needs_part:
@@ -66,6 +69,7 @@ class TpuProjectExec(TpuExec):
                                                       b.active)
                         else:
                             cols = X.run_project(bound, b)
+                    metrics.create(M.DISPATCH_COUNT, M.ESSENTIAL).add(1)
                     metrics.create(M.NUM_OUTPUT_BATCHES, M.ESSENTIAL).add(1)
                     yield b.with_columns(schema, cols)
             return run
@@ -99,8 +103,8 @@ class TpuFilterExec(TpuExec):
         def make(pid: int, thunk: DevicePartitionThunk
                  ) -> DevicePartitionThunk:
             def run() -> Iterator[DeviceBatch]:
-                row_start = jnp.int64(0) if needs_part else None
-                pid_d = jnp.int64(pid) if needs_part else None
+                row_start = T.device_long(0) if needs_part else None
+                pid_d = T.device_long(pid) if needs_part else None
                 for b in thunk():
                     with metrics.timed(M.OP_TIME):
                         if needs_part:
@@ -110,6 +114,7 @@ class TpuFilterExec(TpuExec):
                                                       b.active)
                         else:
                             out = X.run_filter(bound, b)
+                    metrics.create(M.DISPATCH_COUNT, M.ESSENTIAL).add(1)
                     metrics.create(M.NUM_OUTPUT_BATCHES, M.ESSENTIAL).add(1)
                     yield out
             return run
@@ -118,6 +123,23 @@ class TpuFilterExec(TpuExec):
 
     def simple_string(self):
         return f"TpuFilter {self.condition!r}"
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnums=(4,))
+def _range_chunk(start, off, step, n, cap):
+    idx = jnp.arange(cap, dtype=jnp.int64)
+    data = start + (off + idx) * step
+    active = idx < n
+    return jnp.where(active, data, jnp.int64(0)), active
+
+
+@jax.jit
+def _limit_mask(active, remaining):
+    rank = jnp.cumsum(active.astype(jnp.int32))
+    return active & (rank <= remaining)
 
 
 class TpuRangeExec(TpuExec):
@@ -152,11 +174,12 @@ class TpuRangeExec(TpuExec):
                 while off < hi:
                     n = min(goal, hi - off)
                     cap = bucket_capacity(n)
-                    idx = jnp.arange(cap, dtype=jnp.int64)
-                    data = jnp.int64(self.start) + (
-                        jnp.int64(off) + idx) * jnp.int64(self.step)
-                    active = idx < n
-                    data = jnp.where(active, data, jnp.int64(0))
+                    # ONE jitted program per capacity bucket (the four
+                    # eager ops here each paid a flat dispatch
+                    # handshake on tunneled backends)
+                    data, active = _range_chunk(
+                        T.device_long(self.start), T.device_long(off),
+                        T.device_long(self.step), T.device_long(n), cap)
                     from spark_rapids_tpu.columnar.device import DeviceColumn
                     col = DeviceColumn(T.LongT, data, active)
                     yield DeviceBatch(schema, [col], active, n)
@@ -227,8 +250,9 @@ class TpuLocalLimitExec(TpuExec):
                         remaining -= cnt
                         yield b
                         continue
-                    rank = jnp.cumsum(b.active.astype(jnp.int32))
-                    active = b.active & (rank <= remaining)
+                    # jitted: the eager cumsum+and paid two dispatch
+                    # handshakes per truncated batch
+                    active = _limit_mask(b.active, jnp.int32(remaining))
                     yield DeviceBatch(b.schema, b.columns, active, remaining)
                     remaining = 0
             return run

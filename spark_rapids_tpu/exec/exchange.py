@@ -34,11 +34,15 @@ from spark_rapids_tpu.exec.base import (DevicePartitionThunk, TpuExec,
 from spark_rapids_tpu.ops import exprs as X
 from spark_rapids_tpu.sql import expressions as E
 from spark_rapids_tpu.sql import physical as P
+from spark_rapids_tpu.sql import types as T
 
-_PID_CACHE: Dict[Tuple, Callable] = {}
-_SORT_CACHE: Dict[Tuple, Callable] = {}
-_EXTRACT_CACHE: Dict[Tuple, Callable] = {}
-_RANGE_PID_CACHE: Dict[Tuple, Callable] = {}
+from spark_rapids_tpu.jit_cache import JitCache
+
+_PID_CACHE = JitCache("exchangePid")
+_SORT_CACHE = JitCache("exchangeSort")
+_EXTRACT_CACHE = JitCache("exchangeExtract")
+_RANGE_PID_CACHE = JitCache("rangeKeys")
+_RANGE_RANK_CACHE = JitCache("rangeRank")
 
 
 def hash_partition_ids(exprs: List[E.Expression], batch: DeviceBatch,
@@ -52,8 +56,7 @@ def hash_partition_ids(exprs: List[E.Expression], batch: DeviceBatch,
         def _fn(cols, active, lit_vals):
             return hashing.traced_partition_ids(exprs, cols, active,
                                                 lit_vals, num_partitions)
-        fn = jax.jit(_fn)
-        _PID_CACHE[key] = fn
+        fn = _PID_CACHE.put(key, jax.jit(_fn))
     return fn(batch.columns, batch.active, X.literal_values(exprs))
 
 
@@ -81,8 +84,7 @@ def range_key_columns(order: List[E.Expression],
             cap = active.shape[0]
             ctx = X.Ctx(cols, cap, bound_t, lit_vals)
             return tuple(X.dev_eval(e, ctx).arrays() for e in bound_t)
-        fn = jax.jit(_fn)
-        _RANGE_PID_CACHE[key] = fn
+        fn = _RANGE_PID_CACHE.put(key, jax.jit(_fn))
     arrs = fn(batch.columns, batch.active, X.literal_values(bound))
     return [make_column(e.data_type, a) for e, a in zip(bound, arrs)]
 
@@ -109,32 +111,50 @@ def global_range_pids(order: List[E.Expression],
                         c.dtype,
                         jnp.pad(c.chars, ((0, 0), (0, cc - c.char_cap))),
                         c.lengths, c.validity)
-    keysets = []
-    for kc in keycols_per_batch:
-        subkeys: List[jax.Array] = []
-        for c, o in zip(kc, order):
-            subkeys.extend(S.order_subkeys(c, o.ascending, o.nulls_first))
-        keysets.append(tuple(subkeys))
-    combined = [jnp.concatenate([ks[i] for ks in keysets])
-                for i in range(len(keysets[0]))]
-    active = jnp.concatenate(actives)
-    from spark_rapids_tpu.columnar.device import sort_with_payload
-    # most-significant first: live rows, then the order words (the LSD
-    # helper replaces jnp.lexsort, whose many-operand sorts hang the
-    # TPU compiler — see sort_with_payload)
-    _k, perm, _p = sort_with_payload([~active] + combined, [])
-    cap = active.shape[0]
-    # rank of row p = its sorted position = inverse permutation (a sort,
-    # not a scatter — scatters serialize on TPU)
-    ranks = jnp.argsort(perm).astype(jnp.int64)
-    total = jnp.maximum(jnp.sum(active), 1)
-    pids = jnp.minimum((ranks * n) // total, n - 1).astype(jnp.int32)
-    out: List[jax.Array] = []
-    off = 0
-    for a in actives:
-        out.append(pids[off:off + a.shape[0]])
-        off += a.shape[0]
-    return out
+    # ONE jitted program for the whole global ranking (concat + LSD
+    # sort + inverse permutation + bucketing): the previous eager form
+    # paid a flat dispatch handshake PER op — dozens per range
+    # exchange on tunneled backends
+    from spark_rapids_tpu.ops import groupby as G
+    flags = tuple((o.ascending, o.nulls_first) for o in order)
+    salt = G.kernel_salt()  # snapshot: key AND trace use this value
+    has_nans = salt[0]
+    key = (flags, n, salt)
+    fn = _RANGE_RANK_CACHE.get(key)
+    if fn is None:
+        def _fn(keycols_pb, actives_t):
+            from spark_rapids_tpu.columnar.device import sort_with_payload
+            keysets = []
+            for kc in keycols_pb:
+                subkeys: List[jax.Array] = []
+                for c, (asc, nf) in zip(kc, flags):
+                    # has_nans pinned from the snapshotted salt so the
+                    # trace can never disagree with its cache key
+                    # (sort.py / window.py follow the same discipline)
+                    subkeys.extend(S.order_subkeys(c, asc, nf, has_nans))
+                keysets.append(tuple(subkeys))
+            combined = [jnp.concatenate([ks[i] for ks in keysets])
+                        for i in range(len(keysets[0]))]
+            active = jnp.concatenate(actives_t)
+            # most-significant first: live rows, then the order words
+            # (the LSD helper replaces jnp.lexsort, whose many-operand
+            # sorts hang the TPU compiler — see sort_with_payload)
+            _k, perm, _p = sort_with_payload([~active] + combined, [])
+            # rank of row p = its sorted position = inverse permutation
+            # (a sort, not a scatter — scatters serialize on TPU)
+            ranks = jnp.argsort(perm).astype(jnp.int64)
+            total = jnp.maximum(jnp.sum(active), 1)
+            pids = jnp.minimum((ranks * n) // total,
+                               n - 1).astype(jnp.int32)
+            outs: List[jax.Array] = []
+            off = 0
+            for a in actives_t:
+                outs.append(pids[off:off + a.shape[0]])
+                off += a.shape[0]
+            return tuple(outs)
+        fn = _RANGE_RANK_CACHE.put(key, jax.jit(_fn))
+    return list(fn(tuple(tuple(kc) for kc in keycols_per_batch),
+                   tuple(actives)))
 
 
 def split_by_pid(batch: DeviceBatch, pids: jax.Array, n: int
@@ -161,8 +181,7 @@ def split_by_pid(batch: DeviceBatch, pids: jax.Array, n: int
                                      side="left")
             counts = edges[1:] - edges[:-1]
             return counts, tuple(sorted_arrs)
-        sort_fn = jax.jit(_sort)
-        _SORT_CACHE[skey] = sort_fn
+        sort_fn = _SORT_CACHE.put(skey, jax.jit(_sort))
     counts_d, sorted_flat = sort_fn(pids, batch.active, *flat)
     counts = np.asarray(counts_d)
     offsets = np.concatenate([[0], np.cumsum(counts)])
@@ -191,10 +210,9 @@ def split_by_pid(batch: DeviceBatch, pids: jax.Array, n: int
                                       jnp.zeros((), dtype=g.dtype))
                     outs.append(g)
                 return new_active, tuple(outs)
-            ext_fn = jax.jit(_extract)
-            _EXTRACT_CACHE[ekey] = ext_fn
+            ext_fn = _EXTRACT_CACHE.put(ekey, jax.jit(_extract))
         new_active, outs = ext_fn(
-            jnp.int64(offsets[pid]), jnp.int64(cnt), *sorted_flat)
+            T.device_long(offsets[pid]), T.device_long(cnt), *sorted_flat)
         out.append(DeviceBatch(batch.schema, rebuild_columns(spec, outs),
                                new_active, cnt))
     return out

@@ -37,7 +37,9 @@ from spark_rapids_tpu.ops import sort as S
 from spark_rapids_tpu.sql import expressions as E
 from spark_rapids_tpu.sql import physical as P
 
-_SORT_FN_CACHE: Dict[Tuple, Callable] = {}
+from spark_rapids_tpu.jit_cache import JitCache
+
+_SORT_FN_CACHE = JitCache("sort")
 
 
 def is_device_sort(order: List[E.SortOrder], conf: TpuConf):
@@ -93,8 +95,7 @@ def sorted_batch(order: List[E.SortOrder], bound: List[E.Expression],
             out = [mask_col(c, new_active).arrays()
                    for c in rebuild_columns(spec, sorted_flat)]
             return out, new_active
-        fn = jax.jit(_fn)
-        _SORT_FN_CACHE[key] = fn
+        fn = _SORT_FN_CACHE.put(key, jax.jit(_fn))
     arrs, new_active = fn(batch.columns, batch.active,
                           X.literal_values(bound))
     from spark_rapids_tpu.columnar.device import make_column

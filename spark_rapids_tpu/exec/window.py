@@ -44,7 +44,9 @@ from spark_rapids_tpu.sql import expressions as E
 from spark_rapids_tpu.sql import physical as P
 from spark_rapids_tpu.sql import types as T
 
-_WINDOW_FN_CACHE: Dict[Tuple, Callable] = {}
+from spark_rapids_tpu.jit_cache import JitCache
+
+_WINDOW_FN_CACHE = JitCache("window")
 
 
 
@@ -843,10 +845,11 @@ class TpuWindowExec(TpuExec):
                self._item_key(items), salt)
         fn = _WINDOW_FN_CACHE.get(key)
         if fn is None:
-            fn = _build_window_fn(part_bound, tuple(self.order_spec),
-                                  order_bound, tuple(items), all_exprs)
-            _WINDOW_FN_CACHE[key] = fn
+            fn = _WINDOW_FN_CACHE.put(key, _build_window_fn(
+                part_bound, tuple(self.order_spec), order_bound,
+                tuple(items), all_exprs))
         lit_vals = X.literal_values(list(all_exprs))
+        self.metrics.create(M.DISPATCH_COUNT, M.ESSENTIAL).add(1)
         with self.metrics.timed(M.OP_TIME), G.nan_scope(salt[0]):
             outs = fn(batch.columns, batch.active, lit_vals)
         new_cols: List[AnyDeviceColumn] = list(batch.columns)

@@ -1,0 +1,108 @@
+"""Bounded LRU caches for compiled device programs.
+
+Every structurally-keyed jit cache in the package (project/filter
+programs, aggregation programs, fetch-pack/concat shape programs, the
+window/exchange/sort kernels) goes through a ``JitCache`` instead of a
+bare module dict: long-running sessions that plan many distinct query
+shapes would otherwise grow the compile caches without limit (each
+entry pins an XLA executable). Eviction drops the *oldest-used* entry;
+a re-planned query simply recompiles (and, on backends with the
+persistent XLA cache, reloads the serialized executable cheaply).
+
+Hit/miss counters are kept per cache and surfaced two ways: execs that
+own a cache mirror the counts into their metric registries
+(``compileCacheHits`` / ``compileCacheMisses``), and ``cache_stats()``
+returns the whole registry for the bench's JSON detail.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional, Tuple
+
+# Large enough that no single query ever thrashes (q1 compiles ~10
+# distinct programs per operator family), small enough that thousands
+# of distinct plan shapes cannot pin unbounded executables.
+DEFAULT_CAPACITY = int(os.environ.get(
+    "SPARK_RAPIDS_TPU_JIT_CACHE_CAPACITY", "256"))
+
+_CACHES: Dict[str, "JitCache"] = {}
+_REG_LOCK = threading.Lock()
+
+
+class JitCache:
+    """Thread-safe LRU mapping structural keys -> compiled callables."""
+
+    def __init__(self, name: str, capacity: int = 0):
+        self.name = name
+        self.capacity = capacity or DEFAULT_CAPACITY
+        self._data: "OrderedDict[Any, Any]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        with _REG_LOCK:
+            _CACHES[name] = self
+
+    def get(self, key) -> Optional[Any]:
+        """Lookup, counting a hit or a miss; refreshes LRU order."""
+        with self._lock:
+            val = self._data.get(key)
+            if val is None:
+                self.misses += 1
+                return None
+            self._data.move_to_end(key)
+            self.hits += 1
+            return val
+
+    def put(self, key, value) -> Any:
+        with self._lock:
+            self._data[key] = value
+            self._data.move_to_end(key)
+            while len(self._data) > self.capacity:
+                self._data.popitem(last=False)
+                self.evictions += 1
+        return value
+
+    def get_or_build(self, key, build: Callable[[], Any]
+                     ) -> Tuple[Any, bool]:
+        """Returns ``(value, was_miss)``. The build runs OUTSIDE the
+        lock (tracing can be slow and may re-enter other caches); a
+        racing duplicate build is harmless — last write wins and both
+        callables are equivalent."""
+        val = self.get(key)
+        if val is not None:
+            return val, False
+        val = build()
+        self.put(key, val)
+        return val, True
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._data)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._data.clear()
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"size": len(self._data), "capacity": self.capacity,
+                    "hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions}
+
+
+def cache_stats() -> Dict[str, Dict[str, int]]:
+    """Snapshot of every registered compile cache (bench detail JSON)."""
+    with _REG_LOCK:
+        caches = list(_CACHES.values())
+    return {c.name: c.stats() for c in caches}
+
+
+def mirror_to_metrics(cache: JitCache, metrics, was_miss: bool) -> None:
+    """Mirror one lookup's outcome into an exec's metric registry."""
+    from spark_rapids_tpu import metrics as M
+    name = M.COMPILE_CACHE_MISSES if was_miss else M.COMPILE_CACHE_HITS
+    metrics.create(name, M.MODERATE).add(1)

@@ -36,6 +36,12 @@ PARTITION_TIME = "partitionTime"
 COPY_TO_DEVICE_TIME = "copyToDeviceTime"
 PACK_TIME = "packBatchTime"  # host-side staging half of an upload
 COPY_FROM_DEVICE_TIME = "copyFromDeviceTime"
+# stage-fusion metrics (TpuFusedStageExec + prelude-absorbing aggs)
+DISPATCH_COUNT = "dispatchCount"        # device programs dispatched
+STAGE_COMPILE_TIME = "stageCompileTime"  # first-call build+compile wall
+FUSED_OPS = "fusedOps"                  # operators collapsed into a stage
+COMPILE_CACHE_HITS = "compileCacheHits"
+COMPILE_CACHE_MISSES = "compileCacheMisses"
 
 
 @dataclass

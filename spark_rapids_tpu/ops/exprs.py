@@ -1617,7 +1617,9 @@ def _cast_to_string_device(c: AnyDeviceColumn, ctx: Ctx
 # Jitted entry points + structural compile cache
 # ---------------------------------------------------------------------------
 
-_PROJECT_CACHE: Dict[Tuple, Callable] = {}
+from spark_rapids_tpu.jit_cache import JitCache  # noqa: E402
+
+_PROJECT_CACHE = JitCache("project")
 
 
 def _build_project(exprs: Tuple[E.Expression, ...]) -> Callable:
@@ -1661,8 +1663,7 @@ def run_project(exprs: Sequence[E.Expression], batch: DeviceBatch,
     key = (tuple(expr_key(e) for e in exprs), part_ctx is not None)
     fn = _PROJECT_CACHE.get(key)
     if fn is None:
-        fn = _build_project(tuple(exprs))
-        _PROJECT_CACHE[key] = fn
+        fn = _PROJECT_CACHE.put(key, _build_project(tuple(exprs)))
     if part_ctx is not None:
         outs, err = fn(batch.columns, batch.active,
                        literal_values(exprs), part_ctx)
@@ -1673,7 +1674,7 @@ def run_project(exprs: Sequence[E.Expression], batch: DeviceBatch,
     return outs
 
 
-_FILTER_CACHE: Dict[Tuple, Callable] = {}
+_FILTER_CACHE = JitCache("filter")
 
 
 def run_filter(cond: E.Expression, batch: DeviceBatch,
@@ -1692,8 +1693,7 @@ def run_filter(cond: E.Expression, batch: DeviceBatch,
                                       for f, _m in ctx.errors]))
                    if ctx.errors else None)
             return active & p.validity & _as_bool(p), err
-        fn = jax.jit(_fn)
-        _FILTER_CACHE[key] = fn
+        fn = _FILTER_CACHE.put(key, jax.jit(_fn))
     if part_ctx is not None:
         new_active, err = fn(batch.columns, batch.active,
                              literal_values([cond]), part_ctx)
@@ -1702,6 +1702,69 @@ def run_filter(cond: E.Expression, batch: DeviceBatch,
                              literal_values([cond]))
     _raise_if_errors(err)
     return DeviceBatch(batch.schema, batch.columns, new_active, None)
+
+
+# ---------------------------------------------------------------------------
+# Whole-stage fusion: a chain of filter/project steps as ONE program
+# (the GpuTieredProject / whole-stage-codegen analogue; exec/fused.py
+# owns the plan-level pass, this is the trace machinery)
+# ---------------------------------------------------------------------------
+
+# A step is ("filter", (bound_cond,)) or ("project", (bound_exprs...)).
+StageSteps = Tuple[Tuple[str, Tuple[E.Expression, ...]], ...]
+
+
+def stage_structural_key(steps: StageSteps) -> Tuple:
+    """Structural identity of a fused chain for compile caching (the
+    per-step twin of expr_key)."""
+    return tuple((kind, tuple(expr_key(e) for e in exprs))
+                 for kind, exprs in steps)
+
+
+def stage_literal_values(steps: StageSteps) -> Tuple[list, ...]:
+    """Per-step traced-literal inputs, in step order (the pytree the
+    compiled stage program takes alongside columns+active)."""
+    return tuple(literal_values(list(exprs)) for _kind, exprs in steps)
+
+
+def trace_stage_steps(steps: StageSteps, cols, active, lits_per_step):
+    """Trace every step of a fused chain over (cols, active). Returns
+    ``(cols, active, error_flags)`` — filters only update the mask
+    (same no-data-movement discipline as run_filter), projects rebuild
+    the column list masked to the CURRENT active (matching what the
+    unfused per-op programs produce bit-for-bit). Error flags are
+    pre-masked with the active mask their op would have seen."""
+    from spark_rapids_tpu.columnar.device import mask_col
+    errors: List[jax.Array] = []
+    for (kind, exprs), lv in zip(steps, lits_per_step):
+        ctx = Ctx(cols, active.shape[0], exprs, lv)
+        ctx.active_hint = active
+        if kind == "filter":
+            p = dev_eval(exprs[0], ctx)
+            errors.extend(f & active for f, _m in ctx.errors)
+            active = active & p.validity & _as_bool(p)
+        else:
+            cols = [mask_col(dev_eval(e, ctx), active) for e in exprs]
+            errors.extend(f & active for f, _m in ctx.errors)
+    return cols, active, errors
+
+
+def build_stage_fn(steps: StageSteps, donate: bool = False) -> Callable:
+    """Compile a fused chain into one jitted program:
+    ``fn(cols, active, lits_per_step) -> (out_cols, out_active, err)``.
+    With ``donate=True`` the input column/mask HBM buffers are donated
+    to XLA, so each batch's buffers are reused for the outputs instead
+    of being held live across the op boundary (callers must guarantee
+    sole ownership of the inputs — see TpuFusedStageExec)."""
+    steps_t = tuple(steps)
+
+    def fn(cols, active, lits_per_step):
+        cols, active, errors = trace_stage_steps(steps_t, cols, active,
+                                                 lits_per_step)
+        err = (jnp.any(jnp.stack([jnp.any(f) for f in errors]))
+               if errors else None)
+        return cols, active, err
+    return jax.jit(fn, donate_argnums=(0, 1) if donate else ())
 
 
 # ---------------------------------------------------------------------------

@@ -681,6 +681,13 @@ def apply_overrides(physical: P.PhysicalPlan, conf: TpuConf,
     if conf.get(CBO_ENABLED) and not conf.get(TEST_FORCE_DEVICE):
         new_plan = _revert_small_islands(new_plan, report)
         report.replaced_any = _has_device_op(new_plan)
+    # whole-stage fusion LAST: it must see the final operator placement
+    # (post-CBO), and a fused stage never crosses the boundaries the
+    # passes above inserted (transitions, exchanges, coalesce)
+    from spark_rapids_tpu.conf import STAGE_FUSION_ENABLED
+    if conf.get(STAGE_FUSION_ENABLED):
+        from spark_rapids_tpu.exec.fused import fuse_stages
+        new_plan = fuse_stages(new_plan, conf)
     if conf.explain in ("ALL", "NOT_ON_GPU") and report.fallbacks:
         print(report.format())
     return new_plan

@@ -246,6 +246,32 @@ DateT = DateType()
 TimestampT = TimestampType()
 
 
+def require_x64() -> None:
+    """Row counters and SQL LONG/DOUBLE need 64-bit jax types. The
+    package __init__ enables x64 before any array exists, but an
+    embedder that imported jax first (or flipped the flag) would make
+    ``jnp.int64(v)`` silently produce int32 — row counts would wrap at
+    2^31 rows with no error. Fail loudly instead."""
+    import jax
+    if not jax.config.jax_enable_x64:
+        raise RuntimeError(
+            "jax_enable_x64 is disabled: spark-rapids-tpu requires "
+            "64-bit jax types (int64 row counters, SQL bigint/double). "
+            "Import spark_rapids_tpu before creating jax arrays, or "
+            "set JAX_ENABLE_X64=1.")
+
+
+def device_long(v) -> "object":
+    """int64 DEVICE scalar (row counters, batch offsets, partition
+    ids). All device row-counter scalars must come through here: a bare
+    ``jnp.int64(v)`` downcasts to int32 without x64 — silently."""
+    require_x64()
+    import jax.numpy as jnp
+    a = jnp.asarray(v, dtype=jnp.int64)
+    assert a.dtype == jnp.int64, a.dtype
+    return a
+
+
 def is_integral(dt: DataType) -> bool:
     return isinstance(dt, IntegralType)
 

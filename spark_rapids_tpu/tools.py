@@ -63,6 +63,10 @@ def qualify(session, df) -> QualificationReport:
     def walk(p):
         if isinstance(p, TpuExec):
             report.device_ops.append(p.simple_string().split()[0])
+        # constituents of a fused stage, SHALLOW (their child links
+        # point back into the chain)
+        for op in getattr(p, "fused_ops", []):
+            report.device_ops.append(op.simple_string().split()[0])
         for c in p.children:
             walk(c)
     walk(physical)
@@ -99,11 +103,19 @@ def profile(session, df) -> ProfileReport:
     result = physical.execute_collect()
     out = ProfileReport(rows=result.num_rows)
 
+    def visit(p):
+        vals = {name: m.value
+                for name, m in p.metrics.metrics.items() if m.value}
+        out.operators.append((p.simple_string().split()[0], vals))
+
     def walk(p):
         if isinstance(p, TpuExec):
-            vals = {name: m.value
-                    for name, m in p.metrics.metrics.items() if m.value}
-            out.operators.append((p.simple_string().split()[0], vals))
+            visit(p)
+        # constituents of a fused stage keep their own metric
+        # registries (the fan-back contract, docs/fusion.md) — visited
+        # SHALLOW, their child links point back into the chain
+        for op in getattr(p, "fused_ops", []):
+            visit(op)
         for c in p.children:
             walk(c)
     walk(physical)

@@ -1,0 +1,390 @@
+"""Whole-stage fusion parity corpus (exec/fused.py).
+
+Every fusible chain shape is asserted BIT-IDENTICAL between the fused
+plan (``spark.rapids.sql.stageFusion.enabled=true``, the default) and
+the unfused per-operator plan (``...=false``), plus the dual-session
+CPU check through the standard harness. A property test over plans
+containing shuffles/transitions asserts the fuser never crosses such a
+boundary (a fused stage may only contain filter/project and a partial
+hash-aggregate sink).
+"""
+
+import random
+
+import pytest
+
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.session import TpuSparkSession
+
+from tests.datagen import (DoubleGen, IntegerGen, KeyStringGen, LongGen,
+                           StringGen, gen_batch)
+from tests.harness import assert_tpu_and_cpu_equal_collect
+from tests.support import values_equal
+
+N = 512
+
+
+def _df(spark, gens, n=N, seed=7, parts=3):
+    return spark.createDataFrame(gen_batch(gens, n, seed),
+                                 num_partitions=parts)
+
+
+def _collect_fused(plans):
+    from spark_rapids_tpu.exec.fused import TpuFusedStageExec
+    found = []
+
+    def walk(p):
+        if isinstance(p, TpuFusedStageExec):
+            found.append(p)
+        for c in p.children:
+            walk(c)
+    for p in plans:
+        walk(p)
+    return found
+
+
+def _run_tpu(df_fn, conf):
+    spark = TpuSparkSession({**(conf or {}),
+                             "spark.rapids.sql.enabled": "true"})
+    try:
+        spark.start_capture()
+        batch = df_fn(spark)._execute()
+        return batch.to_pydict(), spark.get_captured_plans()
+    finally:
+        spark.stop()
+
+
+def assert_fused_matches_unfused(df_fn, conf=None, expect_fused=True):
+    """Core parity assert: same query, fusion on vs off, EXACT equality
+    (same partition order either way, so no sorting slack needed)."""
+    fused, fplans = _run_tpu(df_fn, {
+        **(conf or {}), "spark.rapids.sql.stageFusion.enabled": "true"})
+    unfused, uplans = _run_tpu(df_fn, {
+        **(conf or {}), "spark.rapids.sql.stageFusion.enabled": "false"})
+    fnodes = _collect_fused(fplans)
+    if expect_fused:
+        assert fnodes, ("expected a TpuFusedStage in:\n"
+                        + "\n".join(p.tree_string() for p in fplans))
+    assert not _collect_fused(uplans), "fuser must disable cleanly"
+    assert set(fused) == set(unfused), (set(fused), set(unfused))
+    for col in fused:
+        assert len(fused[col]) == len(unfused[col]), col
+        for i, (a, b) in enumerate(zip(fused[col], unfused[col])):
+            assert values_equal(a, b, approx=False), (
+                f"col {col} row {i}: fused={a!r} unfused={b!r}")
+    return fnodes
+
+
+# ---------------------------------------------------------------------------
+# Parity corpus: every fusible chain shape
+# ---------------------------------------------------------------------------
+
+def test_filter_project_chain():
+    fnodes = assert_fused_matches_unfused(
+        lambda s: _df(s, [("a", IntegerGen()), ("b", DoubleGen())])
+        .filter(F.col("a") > 3)
+        .select((F.col("a") * 2).alias("a2"),
+                (F.col("b") + 1.5).alias("b1")))
+    names = [type(op).__name__ for op in fnodes[0].fused_ops]
+    assert names == ["TpuFilterExec", "TpuProjectExec"], names
+
+
+def test_project_filter_chain():
+    assert_fused_matches_unfused(
+        lambda s: _df(s, [("a", LongGen()), ("s", StringGen())])
+        .select((F.col("a") + 7).alias("a7"), F.col("s"))
+        .filter(F.col("a7") % 3 == 0))
+
+
+def test_long_mixed_chain():
+    # filter -> project -> filter -> project: one maximal stage
+    fnodes = assert_fused_matches_unfused(
+        lambda s: _df(s, [("a", IntegerGen()), ("b", DoubleGen())])
+        .filter(F.col("a").isNotNull())
+        .select((F.col("a") * F.col("a")).alias("sq"), F.col("b"))
+        .filter(F.col("sq") < 400)
+        .select((F.col("sq") + F.col("b")).alias("out")))
+    assert len(fnodes) == 1, [f.simple_string() for f in fnodes]
+    assert len(fnodes[0].fused_ops) == 4
+
+
+def test_filter_project_partial_agg_chain():
+    from spark_rapids_tpu.exec.agg import TpuHashAggregateExec
+    fnodes = assert_fused_matches_unfused(
+        lambda s: _df(s, [("k", KeyStringGen()), ("v", LongGen()),
+                          ("w", DoubleGen())])
+        .filter(F.col("v") > 0)
+        .select(F.col("k"), (F.col("v") * 3).alias("v3"))
+        .groupBy("k").agg(F.sum(F.col("v3")).alias("s"),
+                          F.count(F.lit(1)).alias("c")))
+    agg_stages = [n for n in fnodes
+                  if isinstance(n.fused_ops[-1], TpuHashAggregateExec)]
+    assert agg_stages, [f.simple_string() for f in fnodes]
+    assert agg_stages[0].fused_ops[-1].mode == "partial"
+
+
+def test_project_topn_build_chain():
+    # chain feeding a TopN (TakeOrderedAndProject) build
+    assert_fused_matches_unfused(
+        lambda s: _df(s, [("a", IntegerGen()), ("b", DoubleGen())])
+        .filter(F.col("b").isNotNull())
+        .select(F.col("a"), (F.col("b") * 2.0).alias("b2"))
+        .orderBy(F.col("b2")).limit(10))
+
+
+def test_chain_feeding_join_build_side():
+    def q(s):
+        left = _df(s, [("k", IntegerGen()), ("v", LongGen())], seed=11)
+        right = (_df(s, [("k", IntegerGen()), ("w", LongGen())], seed=13)
+                 .filter(F.col("w") > 0)
+                 .select(F.col("k"), (F.col("w") + 1).alias("w1")))
+        return left.join(right, on="k")
+    assert_fused_matches_unfused(q)
+
+
+def test_global_agg_not_absorbed_but_chain_fuses():
+    # complete-mode (no grouping) agg is NOT absorbed; the chain below
+    # it still fuses and parity holds
+    fnodes = assert_fused_matches_unfused(
+        lambda s: _df(s, [("a", LongGen()), ("b", DoubleGen())])
+        .filter(F.col("a") > 0)
+        .select((F.col("a") * 2).alias("a2"))
+        .agg(F.sum(F.col("a2")).alias("s")))
+    from spark_rapids_tpu.exec.agg import TpuHashAggregateExec
+    for n in fnodes:
+        sink = n.fused_ops[-1]
+        if isinstance(sink, TpuHashAggregateExec):
+            assert sink.mode == "partial"
+
+
+def test_single_op_not_fused():
+    # fusing one operator would just re-wrap its one program
+    _, plans = _run_tpu(
+        lambda s: _df(s, [("a", IntegerGen())])
+        .select((F.col("a") + 1).alias("a1")), {})
+    assert not _collect_fused(plans)
+
+
+def test_fusion_disabled_conf():
+    assert_fused_matches_unfused(
+        lambda s: _df(s, [("a", IntegerGen())])
+        .filter(F.col("a") > 0).select((F.col("a") * 2).alias("x")),
+        expect_fused=True)
+    _, plans = _run_tpu(
+        lambda s: _df(s, [("a", IntegerGen())])
+        .filter(F.col("a") > 0).select((F.col("a") * 2).alias("x")),
+        {"spark.rapids.sql.stageFusion.enabled": "false"})
+    assert not _collect_fused(plans)
+
+
+def test_cpu_parity_through_harness():
+    # the standard dual-session check still holds with fusion on (the
+    # default), and the fused stage shows up in the captured plan
+    assert_tpu_and_cpu_equal_collect(
+        lambda s: _df(s, [("k", KeyStringGen()), ("v", LongGen())])
+        .filter(F.col("v") > 2)
+        .select(F.col("k"), (F.col("v") - 1).alias("vm"))
+        .groupBy("k").agg(F.sum(F.col("vm")).alias("s")),
+        expect_execs=["TpuFusedStage"])
+
+
+def test_part_ctx_chain_not_fused():
+    # monotonically_increasing_id threads cross-batch device state the
+    # fused program does not carry: the chain must stay unfused AND
+    # stay correct
+    def q(s):
+        return (_df(s, [("a", IntegerGen())])
+                .filter(F.col("a").isNotNull())
+                .select(F.monotonically_increasing_id().alias("i"),
+                        F.col("a")))
+    _, plans = _run_tpu(q, {})
+    for node in _collect_fused(plans):
+        for op in node.fused_ops:
+            assert "Monotonically" not in repr(
+                getattr(op, "project_list", [])), node.tree_string()
+    assert_fused_matches_unfused(q, expect_fused=False)
+
+
+# ---------------------------------------------------------------------------
+# Property: fusion never crosses a shuffle / transition boundary
+# ---------------------------------------------------------------------------
+
+_BOUNDARY_QUERIES = [
+    lambda s: _df(s, [("k", KeyStringGen()), ("v", LongGen())])
+    .filter(F.col("v") > 0).select(F.col("k"),
+                                   (F.col("v") * 2).alias("v2"))
+    .groupBy("k").agg(F.sum(F.col("v2")).alias("s"))
+    .filter(F.col("s") > 10).select((F.col("s") + 1).alias("s1")),
+    lambda s: _df(s, [("a", IntegerGen()), ("b", DoubleGen())])
+    .repartition(4, F.col("a"))
+    .filter(F.col("a") > 1).select((F.col("a") + 1).alias("x"),
+                                   F.col("b"))
+    .orderBy(F.col("x")),
+    lambda s: _df(s, [("k", IntegerGen()), ("v", LongGen())], seed=3)
+    .join(_df(s, [("k", IntegerGen()), ("w", LongGen())], seed=5)
+          .filter(F.col("w") != 0), on="k")
+    .select(F.col("k"), (F.col("v") + F.col("w")).alias("vw"))
+    .filter(F.col("vw") > 0),
+]
+
+
+@pytest.mark.parametrize("qi", range(len(_BOUNDARY_QUERIES)))
+def test_fusion_respects_boundaries(qi):
+    from spark_rapids_tpu.exec.agg import TpuHashAggregateExec
+    from spark_rapids_tpu.exec.basic import TpuFilterExec, TpuProjectExec
+    q = _BOUNDARY_QUERIES[qi]
+    assert_fused_matches_unfused(q)
+    _, plans = _run_tpu(q, {})
+    for node in _collect_fused(plans):
+        ops = node.fused_ops
+        # every constituent is a per-batch chain op; only the SINK may
+        # be a (partial) aggregate — exchanges, transitions, coalesce
+        # can never be absorbed
+        for op in ops[:-1]:
+            assert isinstance(op, (TpuFilterExec, TpuProjectExec)), (
+                node.tree_string())
+        assert isinstance(ops[-1], (TpuFilterExec, TpuProjectExec,
+                                    TpuHashAggregateExec)), (
+            node.tree_string())
+        if isinstance(ops[-1], TpuHashAggregateExec):
+            assert ops[-1].mode == "partial"
+
+
+def test_random_chain_property():
+    """Seeded random filter/project chains: fused == unfused exactly."""
+    rng = random.Random(20260803)
+    cols = ["a", "b"]
+    for case in range(6):
+        steps = []
+        n_steps = rng.randint(2, 5)
+        for _ in range(n_steps):
+            if rng.random() < 0.4:
+                c = rng.choice(cols)
+                thr = rng.randint(-5, 5)
+                steps.append(("filter", c, thr))
+            else:
+                c1, c2 = rng.choice(cols), rng.choice(cols)
+                k = rng.randint(1, 4)
+                steps.append(("project", c1, c2, k))
+
+        def q(s, _steps=tuple(steps)):
+            df = _df(s, [("a", IntegerGen()), ("b", LongGen())],
+                     seed=100 + case)
+            names = {"a": "a", "b": "b"}
+            for st in _steps:
+                if st[0] == "filter":
+                    df = df.filter(F.col(names[st[1]]) > st[2])
+                else:
+                    _, c1, c2, k = st
+                    df = df.select(
+                        (F.col(names[c1]) * k).alias("a"),
+                        (F.col(names[c2]) + k).alias("b"))
+            return df
+        # parity is the property; whether the planner's simplifications
+        # leave a >=2-op chain to fuse varies per case
+        assert_fused_matches_unfused(q, expect_fused=False)
+
+
+# ---------------------------------------------------------------------------
+# Metrics fan-back + fusion-specific counters
+# ---------------------------------------------------------------------------
+
+def test_fused_metrics_fan_back():
+    _, plans = _run_tpu(
+        lambda s: _df(s, [("a", IntegerGen()), ("b", DoubleGen())])
+        .filter(F.col("a") > 0)
+        .select((F.col("a") + 1).alias("x"), F.col("b")), {})
+    nodes = _collect_fused(plans)
+    assert nodes
+    node = nodes[0]
+    snap = node.metrics.snapshot()
+    assert snap.get("fusedOps") == len(node.fused_ops) == 2
+    assert snap.get("dispatchCount", 0) >= 1
+    # the compile cache is process-global: an identical chain compiled
+    # by an earlier test hits; a fresh one misses and books its first
+    # call's wall as compile time
+    if snap.get("compileCacheMisses", 0):
+        assert snap.get("stageCompileTime", 0) > 0
+    else:
+        assert snap.get("compileCacheHits", 0) >= 1
+    # constituent execs keep their stage keys (batch counts fan back)
+    for op in node.fused_ops:
+        assert op.metrics.value("numOutputBatches") >= 1, (
+            type(op).__name__)
+
+
+def test_agg_prelude_metrics():
+    _, plans = _run_tpu(
+        lambda s: _df(s, [("k", KeyStringGen()), ("v", LongGen())])
+        .filter(F.col("v") > 0).select(F.col("k"),
+                                       (F.col("v") * 2).alias("v2"))
+        .groupBy("k").agg(F.sum(F.col("v2")).alias("s")), {})
+    from spark_rapids_tpu.exec.agg import TpuHashAggregateExec
+    nodes = [n for n in _collect_fused(plans)
+             if isinstance(n.fused_ops[-1], TpuHashAggregateExec)]
+    assert nodes
+    agg = nodes[0].fused_ops[-1]
+    snap = agg.metrics.snapshot()
+    assert snap.get("dispatchCount", 0) >= 1
+    for op in nodes[0].fused_ops[:-1]:
+        assert op.metrics.value("numOutputBatches") >= 1
+
+
+def test_dispatch_count_drops_with_fusion():
+    """The whole point: fewer device programs per batch."""
+    def q(s):
+        return (_df(s, [("k", KeyStringGen()), ("v", LongGen())])
+                .filter(F.col("v") > 0)
+                .select(F.col("k"), (F.col("v") * 2).alias("v2"))
+                .groupBy("k").agg(F.sum(F.col("v2")).alias("s")))
+
+    def dispatches(plans):
+        total = 0
+
+        def walk(p):
+            nonlocal total
+            ms = getattr(p, "metrics", None)
+            if ms is not None:
+                total += ms.snapshot().get("dispatchCount", 0)
+            for op in getattr(p, "fused_ops", []):
+                total += op.metrics.snapshot().get("dispatchCount", 0)
+            for c in p.children:
+                walk(c)
+        for p in plans:
+            walk(p)
+        return total
+
+    _, fplans = _run_tpu(q, {})
+    _, uplans = _run_tpu(
+        q, {"spark.rapids.sql.stageFusion.enabled": "false"})
+    assert dispatches(fplans) < dispatches(uplans), (
+        dispatches(fplans), dispatches(uplans))
+
+
+# ---------------------------------------------------------------------------
+# Satellites: bounded compile caches + int64 device scalars
+# ---------------------------------------------------------------------------
+
+def test_jit_cache_lru_and_stats():
+    from spark_rapids_tpu.jit_cache import JitCache, cache_stats
+    c = JitCache("test-lru", capacity=2)
+    assert c.get("a") is None          # miss
+    c.put("a", 1)
+    c.put("b", 2)
+    assert c.get("a") == 1             # hit; refreshes LRU order
+    c.put("c", 3)                      # evicts b (oldest-used)
+    assert c.get("b") is None
+    assert c.get("a") == 1 and c.get("c") == 3
+    st = c.stats()
+    assert st["size"] == 2 and st["evictions"] == 1
+    assert st["hits"] == 3 and st["misses"] == 2
+    assert "test-lru" in cache_stats()
+
+
+def test_device_long_is_int64():
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.sql import types as T
+    a = T.device_long(1 << 40)  # would wrap as int32
+    assert a.dtype == jnp.int64
+    assert int(a) == 1 << 40
