@@ -32,6 +32,18 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+# Multichip leg on emulated devices: BENCH_MULTICHIP_DEVICES=8 forces N
+# virtual CPU devices (same emulation tests/conftest.py uses) so the
+# detail.multichip section can run without TPU hardware. Must be set
+# BEFORE the first jax import; on real multi-chip backends leave unset.
+_mc_emu = int(os.environ.get("BENCH_MULTICHIP_DEVICES", "0"))
+if _mc_emu > 1:
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + f" --xla_force_host_platform_device_count={_mc_emu}"
+        ).strip()
+
 import numpy as np  # noqa: E402
 
 SF1_ROWS = 6_001_215
@@ -380,6 +392,59 @@ def run_tpu(fusion_enabled: bool) -> dict:
     return out
 
 
+def run_multichip(single_chip_wall: float, cpu_rows) -> dict:
+    """q1 end-to-end with shuffle.mode=ici over every visible device:
+    the mesh-sharded scan runs one reader stream per chip, fused stages
+    execute on each chip's resident batches, and the exchange consumes
+    them without a host gather (docs/multichip.md). Skips gracefully
+    when fewer than 2 devices are visible. The mesh size honors
+    spark.rapids.shuffle.ici.devices (0 = all visible)."""
+    import jax
+    n_vis = len(jax.devices())
+    if n_vis < 2:
+        return {"skipped": True,
+                "reason": f"{n_vis} device visible (need >= 2; set "
+                          "BENCH_MULTICHIP_DEVICES=8 to emulate)"}
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    conf = dict(TPU_CONF)
+    conf["spark.rapids.shuffle.mode"] = "ici"
+    # 0 = all visible devices (resolved by the session's mesh wiring)
+    conf["spark.rapids.shuffle.ici.devices"] = os.environ.get(
+        "BENCH_ICI_DEVICES", "0")
+    tpu = TpuSparkSession(conf)
+    try:
+        from spark_rapids_tpu.parallel.mesh import get_active_mesh, mesh_size
+        n_chips = mesh_size(get_active_mesh())
+        q = build_query(tpu)
+        run_once(q)  # jit compile warm-up
+        times, rows = [], None
+        for i in range(2):
+            if i == 1:
+                tpu.start_capture()
+            dt, rows = run_once(q)
+            times.append(dt)
+        from spark_rapids_tpu.metrics import sum_plan_metrics
+        captured = tpu.get_captured_plans()
+        assert_rows_match(cpu_rows, rows)
+        wall = min(times)
+        dispatch = sum_plan_metrics(captured, "dispatchCount.chip")
+        units = sum_plan_metrics(captured, "meshScanUnits.chip")
+        pad = sum_plan_metrics(captured, "meshPadWaste")
+        return {
+            "skipped": False,
+            "n_chips": n_chips,
+            "wall_s": round(wall, 4),
+            "single_chip_wall_s": round(single_chip_wall, 4),
+            "speedup_vs_single_chip": round(single_chip_wall / wall, 4),
+            "perChipDispatchCount": dispatch,
+            "chipsDispatching": sum(1 for v in dispatch.values() if v),
+            "scanUnitsPerChip": units,
+            "meshPadWaste": pad.get("meshPadWaste", 0),
+        }
+    finally:
+        tpu.stop()
+
+
 def main():
     from spark_rapids_tpu.jit_cache import cache_stats
     from spark_rapids_tpu.sql.session import TpuSparkSession
@@ -408,6 +473,14 @@ def main():
     assert_rows_match(cpu_rows, unfused["rows"])
     assert_rows_match(q3_cpu_rows, fused["q3"]["rows"])
     assert_rows_match(q3_cpu_rows, unfused["q3"]["rows"])
+
+    # AFTER the primary asserts, and fault-isolated: a multichip-leg
+    # failure must not discard the measured single-chip results
+    try:
+        multichip = run_multichip(fused["wall_s"], cpu_rows)
+    except Exception as e:  # noqa: BLE001 - reported, not swallowed
+        multichip = {"skipped": True,
+                     "reason": f"multichip leg failed: {e!r}"}
 
     cpu_t = min(cpu_times)
     tpu_t = fused["wall_s"]
@@ -441,6 +514,7 @@ def main():
                 "stageCompileTime_s": fused["stageCompileTime_s"],
                 "unfused_stages": unfused["stages"],
             },
+            "multichip": multichip,
             "jitCaches": cache_stats(),
             "tpcds_q3": {
                 "device_wall_s": round(q3_tpu_t, 4),

@@ -504,6 +504,28 @@ def _put(arr: np.ndarray, device: Optional[jax.Device]) -> jax.Array:
     return jnp.asarray(arr)
 
 
+def batch_device(b: DeviceBatch) -> Optional[jax.Device]:
+    """The single device this batch's buffers live on, or None when the
+    buffers are sharded/replicated across several (e.g. the landed
+    output of a mesh exchange). The mesh scan pins each reader stream's
+    batches to one chip; residency-aware consumers (exchange slotting,
+    broadcast alignment) group by this."""
+    try:
+        ds = b.active.devices()
+    except Exception:  # non-Array stand-ins in unit tests
+        return None
+    return next(iter(ds)) if len(ds) == 1 else None
+
+
+def batch_to_device(b: DeviceBatch, device: jax.Device) -> DeviceBatch:
+    """Copy a batch's buffers to ``device`` (device-to-device; a cheap
+    no-op when already resident there)."""
+    flat, spec = flatten_batch(b)
+    moved = jax.device_put(flat + [b.active], device)
+    return DeviceBatch(b.schema, rebuild_columns(spec, moved[:-1]),
+                       moved[-1], b._num_rows)
+
+
 # One fused program per (input shape-set, output capacity): eager
 # op-by-op dispatch costs ~100ms per op on tunneled TPU backends, so the
 # whole concatenation must be a single XLA executable.
@@ -524,6 +546,22 @@ def concat_device(batches: Sequence[DeviceBatch]) -> DeviceBatch:
     assert batches
     if len(batches) == 1:
         return batches[0]
+    # inputs spanning chips (a broadcast build or a global merge over
+    # the mesh-sharded scan) must land on ONE device first: a jitted
+    # program over differently-committed arrays is a placement error.
+    # Merge onto the chip holding the most rows (capacity is static —
+    # no count sync) so the skewed case moves the small side only
+    devs = [batch_device(b) for b in batches]
+    if any(d is not None for d in devs):
+        load: dict = {}
+        for b, d in zip(batches, devs):
+            if d is not None:
+                load[d] = load.get(d, 0) + b.capacity
+        tgt = max(load, key=lambda d: (load[d], -d.id))
+        if any(d is not None and d.id != tgt.id for d in devs):
+            batches = [b if d is None or d.id == tgt.id
+                       else batch_to_device(b, tgt)
+                       for b, d in zip(batches, devs)]
     schema = batches[0].schema
     counts = [b.row_count() for b in batches]
     total = sum(counts)

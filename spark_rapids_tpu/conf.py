@@ -300,6 +300,21 @@ STAGE_FUSION_MAX_IN_FLIGHT = conf(
     "device compute; the value bounds HBM held by outstanding "
     "batches. 1 = sequential per-batch draining.").integer(2)
 
+MULTICHIP_SCAN_ENABLED = conf(
+    "spark.rapids.sql.multichip.scan.enabled").doc(
+    "Shard the SCAN itself across the active shuffle mesh: partition "
+    "units (parquet row groups / orc stripes / files) are assigned "
+    "round-robin-by-bytes to one reader stream per chip, and each "
+    "stream's batches (encoded pages or decoded rows) upload directly "
+    "to that chip's HBM — no gather to chip 0. Downstream per-batch "
+    "stages (filter/project/partial aggregate, fused stages) then run "
+    "data-parallel on each chip's resident batches, and the ICI "
+    "exchange consumes them without a host-side stacking round trip "
+    "(docs/multichip.md). Effective only while a multi-device mesh is "
+    "active (spark.rapids.shuffle.mode=ici); single-device behavior "
+    "and the CPU engine are unchanged and results are bit-identical."
+    ).boolean(True)
+
 PARQUET_DEVICE_DECODE = conf(
     "spark.rapids.sql.format.parquet.deviceDecode.enabled").doc(
     "Decode Parquet pages ON DEVICE: host threads read raw column-chunk "

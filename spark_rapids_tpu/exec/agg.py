@@ -458,6 +458,8 @@ class TpuHashAggregateExec(TpuExec):
             lit_vals = (X.stage_literal_values(prelude_steps), lit_vals)
         cnt = None
         self.metrics.create(M.DISPATCH_COUNT, M.ESSENTIAL).add(1)
+        from spark_rapids_tpu.parallel.mesh import record_chip_dispatch
+        record_chip_dispatch(self.metrics, batch)
         import time as _time
         t0 = _time.perf_counter_ns()
         if mode in ("partial", "merge", "merge_partial"):

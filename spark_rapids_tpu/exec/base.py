@@ -90,8 +90,18 @@ class TpuRowToColumnarExec(TpuExec):
         # CPU operators in between never see EncodedBatch objects)
         if hasattr(self.child, "emit_encoded"):
             self.child.emit_encoded = True
+        # mesh scan handshake (docs/multichip.md): hand the scan the
+        # active mesh's devices so it plans one reader stream per chip;
+        # each stream's batches then upload DIRECTLY to that chip's HBM
+        # (finish_upload pins the device_put) — no gather to chip 0
+        if hasattr(self.child, "set_scan_mesh"):
+            from spark_rapids_tpu.parallel.mesh import mesh_scan_devices
+            self.child.set_scan_mesh(mesh_scan_devices(self.conf))
+        parts = self.child.partitions()
+        devices = list(getattr(self.child, "partition_devices", []))
+        devices += [None] * (len(parts) - len(devices))
 
-        def make(thunk: P.PartitionThunk) -> DevicePartitionThunk:
+        def make(thunk: P.PartitionThunk, device) -> DevicePartitionThunk:
             def run() -> Iterator[DeviceBatch]:
                 # 1-deep upload pipeline: a helper thread packs/stages
                 # batch k+1 (host-only work) while this thread runs
@@ -119,11 +129,12 @@ class TpuRowToColumnarExec(TpuExec):
                                 pending, rows = [], 0
                                 if prev is not None:
                                     yield self._finish(prev.result(),
-                                                       sem, metrics)
+                                                       sem, metrics,
+                                                       device)
                             prev = submit(b)
                             if prev is not None:
                                 yield self._finish(prev.result(), sem,
-                                                   metrics)
+                                                   metrics, device)
                             continue
                         if b.num_rows == 0:
                             continue
@@ -134,15 +145,17 @@ class TpuRowToColumnarExec(TpuExec):
                             pending, rows = [], 0
                             if prev is not None:
                                 yield self._finish(prev.result(), sem,
-                                                   metrics)
+                                                   metrics, device)
                     if pending:
                         prev = submit(pending)
                         if prev is not None:
-                            yield self._finish(prev.result(), sem, metrics)
+                            yield self._finish(prev.result(), sem,
+                                               metrics, device)
                     if staged is not None:
-                        yield self._finish(staged.result(), sem, metrics)
+                        yield self._finish(staged.result(), sem, metrics,
+                                           device)
             return run
-        return [make(t) for t in self.child.partitions()]
+        return [make(t, d) for t, d in zip(parts, devices)]
 
     def _prepare(self, batches, metrics):
         from spark_rapids_tpu.columnar.transfer import prepare_upload
@@ -157,12 +170,13 @@ class TpuRowToColumnarExec(TpuExec):
         with metrics.timed(M.PACK_TIME):
             return whole.num_rows, prepare_upload(whole, cap)
 
-    def _finish(self, prepared, sem, metrics) -> DeviceBatch:
+    def _finish(self, prepared, sem, metrics, device=None) -> DeviceBatch:
         from spark_rapids_tpu.columnar.transfer import finish_upload
         num_rows, staged = prepared
         sem.acquire_if_necessary(metrics)
         with metrics.timed(M.COPY_TO_DEVICE_TIME):
-            d = finish_upload(staged)
+            # mesh scan: each reader stream's batches land on THEIR chip
+            d = finish_upload(staged, device)
         metrics.create(M.NUM_OUTPUT_ROWS, M.ESSENTIAL).add(num_rows)
         metrics.create(M.NUM_OUTPUT_BATCHES, M.ESSENTIAL).add(1)
         return d

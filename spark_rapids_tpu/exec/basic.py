@@ -70,6 +70,9 @@ class TpuProjectExec(TpuExec):
                         else:
                             cols = X.run_project(bound, b)
                     metrics.create(M.DISPATCH_COUNT, M.ESSENTIAL).add(1)
+                    from spark_rapids_tpu.parallel.mesh import \
+                        record_chip_dispatch
+                    record_chip_dispatch(metrics, b)
                     metrics.create(M.NUM_OUTPUT_BATCHES, M.ESSENTIAL).add(1)
                     yield b.with_columns(schema, cols)
             return run
@@ -115,6 +118,9 @@ class TpuFilterExec(TpuExec):
                         else:
                             out = X.run_filter(bound, b)
                     metrics.create(M.DISPATCH_COUNT, M.ESSENTIAL).add(1)
+                    from spark_rapids_tpu.parallel.mesh import \
+                        record_chip_dispatch
+                    record_chip_dispatch(metrics, b)
                     metrics.create(M.NUM_OUTPUT_BATCHES, M.ESSENTIAL).add(1)
                     yield out
             return run

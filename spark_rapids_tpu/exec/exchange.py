@@ -497,27 +497,44 @@ class TpuShuffleExchangeExec(TpuExec):
     def _materialize_mesh(self, p: P.HashPartitioning, n: int
                           ) -> List[List[DeviceBatch]]:
         """ICI path: batches stay HBM-resident per chip and ride one
-        all_to_all (SURVEY.md §2.3 TPU mapping note)."""
-        from spark_rapids_tpu.columnar.device import concat_device
+        all_to_all (SURVEY.md §2.3 TPU mapping note). Streams from the
+        mesh-sharded scan arrive already committed per chip and KEEP
+        their residency (slot = resident chip, concat runs on that
+        chip, the stack assembles from the resident shards) — no host
+        gather between scan and exchange. Single-device children fall
+        back to the round-robin task->chip placement Spark's scheduler
+        provides in the reference."""
+        from spark_rapids_tpu.columnar.device import (batch_device,
+                                                      concat_device)
         from spark_rapids_tpu.parallel.ici import mesh_exchange
         from spark_rapids_tpu.parallel.mesh import get_active_mesh, mesh_size
         mesh = get_active_mesh()
         n_dev = mesh_size(mesh)
         bound = P.bind_list(p.exprs, self.child.output)
-        # land child partitions on chips round-robin (the task->chip
-        # placement Spark's scheduler provides in the reference)
+        # concurrent drain (taskParallelism): each per-chip stream's
+        # host orchestration overlaps the other chips' device compute
+        drained = self._pull_split(device_channel(self.child),
+                                   lambda b: b)
+        with_dev = [(ti, b, batch_device(b))
+                    for ti, per_part in enumerate(drained)
+                    for b in per_part if b.row_count()]
+        slot_of = {d.id: i for i, d in enumerate(mesh.devices.flat)}
+        resident = {d.id for _ti, _b, d in with_dev
+                    if d is not None and d.id in slot_of}
         slots: List[List[DeviceBatch]] = [[] for _ in range(n_dev)]
-        for i, thunk in enumerate(device_channel(self.child)):
-            for b in thunk():
-                if b.row_count():
-                    slots[i % n_dev].append(b)
+        for ti, b, d in with_dev:
+            if len(resident) >= 2 and d is not None and d.id in slot_of:
+                slots[slot_of[d.id]].append(b)
+            else:
+                slots[ti % n_dev].append(b)
         schema = self.child.schema
         slot_batches = [
             concat_device(bs) if bs else DeviceBatch.empty(schema)
             for bs in slots]
         self.metrics.create("numIciExchanges", M.ESSENTIAL).add(1)
         with self.metrics.timed(M.PARTITION_TIME):
-            return mesh_exchange(slot_batches, bound, n, mesh)
+            return mesh_exchange(slot_batches, bound, n, mesh,
+                                 self.metrics)
 
     def device_partitions(self) -> List[DevicePartitionThunk]:
         from spark_rapids_tpu.memory import SpillableBatch

@@ -168,6 +168,11 @@ class TpuFusedStageExec(TpuExec):
             # validity aliasing active) must use the non-donating
             # program variant
             donate = may_donate and batch_donatable(b)
+            # per-chip attribution BEFORE dispatch: a donating program
+            # deletes b's buffers, after which batch_device(b) cannot
+            # read their placement
+            from spark_rapids_tpu.parallel.mesh import record_chip_dispatch
+            record_chip_dispatch(metrics, b)
             fn, was_miss = _STAGE_CACHE.get_or_build(
                 (skey, donate), lambda: X.build_stage_fn(steps, donate))
             mirror_to_metrics(_STAGE_CACHE, metrics, was_miss)

@@ -72,6 +72,41 @@ class TpuShuffledHashJoinExec(TpuExec):
         self.condition = condition
         self._output = output
         self.null_safe = list(null_safe or [False] * len(left_keys))
+        # per-chip copies of a shared build side (mesh-sharded streams);
+        # values pin their source batch so id() keys can never alias.
+        # Bounded LRU: each entry holds a full build-side copy in HBM,
+        # so the cache must not retain one per (partition, chip) for
+        # the exec's whole lifetime
+        from collections import OrderedDict
+        self._build_dev_cache: "OrderedDict" = OrderedDict()
+        self._build_dev_cap = 8
+        self._build_dev_lock = threading.Lock()
+
+    def _align_build(self, lwhole: DeviceBatch, rwhole: DeviceBatch
+                     ) -> DeviceBatch:
+        """When the stream chunk is resident on a different chip than
+        the build side (streams over the mesh-sharded scan), ship the
+        build side to the stream's chip — the reference broadcasts its
+        build to every executor; here chips are the executors. Copies
+        are cached per (build batch, chip) for the exec's lifetime."""
+        from spark_rapids_tpu.columnar.device import (batch_device,
+                                                      batch_to_device)
+        ld = batch_device(lwhole)
+        if ld is None:
+            return rwhole
+        rd = batch_device(rwhole)
+        if rd is not None and rd.id == ld.id:
+            return rwhole
+        with self._build_dev_lock:
+            key = (id(rwhole), ld.id)
+            hit = self._build_dev_cache.get(key)
+            if hit is None:
+                hit = (rwhole, batch_to_device(rwhole, ld))
+                self._build_dev_cache[key] = hit
+            self._build_dev_cache.move_to_end(key)
+            while len(self._build_dev_cache) > self._build_dev_cap:
+                self._build_dev_cache.popitem(last=False)
+            return hit[1]
 
     @property
     def left(self) -> TpuExec:
@@ -97,6 +132,7 @@ class TpuShuffledHashJoinExec(TpuExec):
                   lbatches[0] if lbatches else DeviceBatch.empty(lschema))
         rwhole = (concat_device(rbatches) if len(rbatches) > 1 else
                   rbatches[0] if rbatches else DeviceBatch.empty(rschema))
+        rwhole = self._align_build(lwhole, rwhole)
         lk = P.bind_list(self.left_keys, self.left.output)
         rk = P.bind_list(self.right_keys, self.right.output)
         if self.join_type in MASK_JOINS:
@@ -356,6 +392,7 @@ class TpuShuffledHashJoinExec(TpuExec):
         hoisted out of the chunk loop by the caller."""
         lwhole = (concat_device(lbatches) if len(lbatches) > 1
                   else lbatches[0])
+        rwhole = self._align_build(lwhole, rwhole)
         with self.metrics.timed(M.JOIN_TIME):
             out, matched = device_join(lwhole, rwhole, lk, rk, chunk_type,
                                        out_schema, collect_matched_r=True,

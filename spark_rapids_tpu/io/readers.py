@@ -217,6 +217,26 @@ def plan_scan_units(fmt: str, files: List[tuple]) -> List[ScanUnit]:
     return units
 
 
+def shard_units_by_bytes(units: List[ScanUnit], n: int
+                         ) -> List[List[ScanUnit]]:
+    """Round-robin-by-bytes unit scheduler for the mesh scan: each unit
+    goes to the stream with the least accumulated bytes (ties resolve
+    by lowest stream index, so equal-sized units round-robin), which
+    keeps skewed row-group sizes balanced across chips — the task->
+    executor placement Spark's scheduler gives the reference for free.
+    Streams may come back empty (fewer units than chips); callers keep
+    them so per-chip structure is stable."""
+    streams: List[List[ScanUnit]] = [[] for _ in range(n)]
+    loads = [0] * n
+    for u in units:
+        i = min(range(n), key=lambda d: (loads[d], d))
+        streams[i].append(u)
+        # +1 so zero-byte units (empty row groups) still spread instead
+        # of all landing on stream 0
+        loads[i] += u.size_bytes + 1
+    return streams
+
+
 def pack_partitions(units: List[ScanUnit], max_bytes: int,
                     open_cost: int = 0) -> List[List[ScanUnit]]:
     """Bin-pack units into partitions (FilePartition.getFilePartitions;
@@ -510,6 +530,16 @@ class CpuFileScanExec(P.PhysicalPlan):
         # staging objects instead of HostBatches — CPU consumers always
         # see decoded rows
         self.emit_encoded = False
+        # mesh scan (docs/multichip.md): set by TpuRowToColumnarExec at
+        # execution time to the active mesh's devices; partitions() then
+        # returns ONE reader stream per chip (units assigned round-
+        # robin-by-bytes) and publishes the per-stream target device in
+        # partition_devices so the upload lands each stream on its chip
+        self._mesh_devices: List = []
+        self.partition_devices: List = []
+
+    def set_scan_mesh(self, devices: List) -> None:
+        self._mesh_devices = list(devices or [])
 
     def set_pushdown(self, preds: List[tuple]) -> None:
         """Install pushed-down predicates (name, op, storage-value) and
@@ -678,6 +708,33 @@ class CpuFileScanExec(P.PhysicalPlan):
                         yield from emit(tbl)
             return run
 
+        if len(self._mesh_devices) >= 2:
+            # mesh scan: one reader stream per chip over the (pruned)
+            # unit list, round-robin-by-bytes; empty streams are kept so
+            # a chip with zero units still yields an (empty) partition
+            # and the per-chip pipeline structure stays stable
+            units = [u for part in self._parts for u in part]
+            streams = shard_units_by_bytes(units, len(self._mesh_devices))
+            self.partition_devices = list(self._mesh_devices)
+
+            def chip_stream(st: List[ScanUnit]):
+                # a chip's share still honors the max_bytes bin packing
+                # (COALESCING concatenates one TABLE per sub-partition,
+                # not the chip's whole share; MULTITHREADED windows per
+                # sub-partition) — the stream just chains them
+                subs = pack_partitions(st, self._max_bytes,
+                                       self._open_cost) if st else [[]]
+                runs = [make(us) for us in subs]
+
+                def run():
+                    for r in runs:
+                        yield from r()
+                return run
+
+            for d, st in zip(self._mesh_devices, streams):
+                metrics.create(f"meshScanUnits.chip{d.id}").add(len(st))
+            return [chip_stream(st) for st in streams]
+        self.partition_devices = []
         return [make(us) for us in self._parts]
 
 

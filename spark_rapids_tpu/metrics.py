@@ -131,3 +131,31 @@ class MetricRegistry:
 
     def snapshot(self) -> Dict[str, int]:
         return {k: m.value for k, m in self.metrics.items()}
+
+
+def sum_plan_metrics(plans, prefix: str) -> Dict[str, int]:
+    """Sum every metric whose key starts with ``prefix`` across captured
+    physical plans, fused-stage constituents included. Per-chip counters
+    (``dispatchCount.chip3``, ``meshScanUnits.chip0``) are dynamic keys,
+    so callers aggregate by prefix (bench ``detail.multichip``, the
+    multichip tests)."""
+    out: Dict[str, int] = {}
+
+    def add(p) -> None:
+        ms = getattr(p, "metrics", None)
+        if ms is None:
+            return
+        for k, v in ms.snapshot().items():
+            if k.startswith(prefix):
+                out[k] = out.get(k, 0) + v
+
+    def walk(p) -> None:
+        add(p)
+        for op in getattr(p, "fused_ops", []):
+            add(op)
+        for c in getattr(p, "children", []):
+            walk(c)
+
+    for plan in plans or []:
+        walk(plan)
+    return out

@@ -90,7 +90,11 @@ def all_to_all_rows(arrs: Sequence[jax.Array], active: jax.Array,
 # Exchange program cache
 # ---------------------------------------------------------------------------
 
-_EXCHANGE_CACHE: Dict[Tuple, Callable] = {}
+# bounded LRU like every other structural jit cache: mesh programs show
+# up in compileCacheHits/Misses and the bench's detail.jitCaches
+from spark_rapids_tpu.jit_cache import JitCache, mirror_to_metrics
+
+_EXCHANGE_CACHE = JitCache("iciExchange")
 
 
 def _build_exchange(mesh: Mesh, exprs: Tuple[E.Expression, ...],
@@ -126,20 +130,22 @@ def _build_exchange(mesh: Mesh, exprs: Tuple[E.Expression, ...],
 
 
 def exchange_fn(mesh: Mesh, exprs: Sequence[E.Expression],
-                n_parts: int, block_cap: Optional[int] = None) -> Callable:
+                n_parts: int, block_cap: Optional[int] = None,
+                metrics=None) -> Callable:
     from spark_rapids_tpu.ops import exprs as X
     from spark_rapids_tpu.parallel.mesh import mesh_key
     key = (mesh_key(mesh), tuple(X.expr_key(e) for e in exprs), n_parts,
            block_cap)
-    fn = _EXCHANGE_CACHE.get(key)
-    if fn is None:
-        fn = _build_exchange(mesh, tuple(exprs), n_parts, block_cap)
-        _EXCHANGE_CACHE[key] = fn
+    fn, was_miss = _EXCHANGE_CACHE.get_or_build(
+        key, lambda: _build_exchange(mesh, tuple(exprs), n_parts,
+                                     block_cap))
+    if metrics is not None:
+        mirror_to_metrics(_EXCHANGE_CACHE, metrics, was_miss)
     return fn
 
 
 def _dest_counts_fn(mesh: Mesh, exprs: Tuple[E.Expression, ...],
-                    n_parts: int) -> Callable:
+                    n_parts: int, metrics=None) -> Callable:
     """Tiny shard_map program: per-chip [n_dev] counts of rows headed to
     each destination — the size-exchange phase that lets the real
     exchange stage occupancy-proportional send blocks (the
@@ -150,26 +156,27 @@ def _dest_counts_fn(mesh: Mesh, exprs: Tuple[E.Expression, ...],
     from spark_rapids_tpu.parallel.mesh import mesh_key
     key = (mesh_key(mesh), tuple(X.expr_key(e) for e in exprs), n_parts,
            "counts")
-    fn = _EXCHANGE_CACHE.get(key)
-    if fn is not None:
-        return fn
     n_dev = mesh.shape[SHUFFLE_AXIS]
 
-    def per_shard(cols, active, lit_vals):
-        cols = jax.tree_util.tree_map(lambda a: a[0], cols)
-        active = active[0]
-        pids = hashing.traced_partition_ids(exprs, cols, active, lit_vals,
-                                            n_parts)
-        dest = jnp.mod(pids, n_dev)
-        counts = jnp.stack([
-            jnp.sum(active & (dest == d)) for d in range(n_dev)])
-        return counts[None]
+    def build():
+        def per_shard(cols, active, lit_vals):
+            cols = jax.tree_util.tree_map(lambda a: a[0], cols)
+            active = active[0]
+            pids = hashing.traced_partition_ids(exprs, cols, active,
+                                                lit_vals, n_parts)
+            dest = jnp.mod(pids, n_dev)
+            counts = jnp.stack([
+                jnp.sum(active & (dest == d)) for d in range(n_dev)])
+            return counts[None]
 
-    sm = shard_map(per_shard, mesh=mesh,
-                   in_specs=(P(SHUFFLE_AXIS), P(SHUFFLE_AXIS), P()),
-                   out_specs=P(SHUFFLE_AXIS))
-    fn = jax.jit(sm)
-    _EXCHANGE_CACHE[key] = fn
+        sm = shard_map(per_shard, mesh=mesh,
+                       in_specs=(P(SHUFFLE_AXIS), P(SHUFFLE_AXIS), P()),
+                       out_specs=P(SHUFFLE_AXIS))
+        return jax.jit(sm)
+
+    fn, was_miss = _EXCHANGE_CACHE.get_or_build(key, build)
+    if metrics is not None:
+        mirror_to_metrics(_EXCHANGE_CACHE, metrics, was_miss)
     return fn
 
 
@@ -206,32 +213,51 @@ def pad_batch(b: DeviceBatch, cap: int,
 
 
 def stack_batches(slots: Sequence[DeviceBatch], mesh: Mesh):
-    """Pad each per-chip batch to a common shape and stack into global
-    arrays sharded over the mesh's shuffle axis (leading dim = chip)."""
+    """Pad each per-chip batch to the common bucketed capacity ON ITS
+    CHIP, then assemble global arrays sharded over the mesh's shuffle
+    axis directly from the per-device shards
+    (``jax.make_array_from_single_device_arrays``) — the chip-resident
+    handoff: a slot already living on its chip contributes its buffers
+    in place, with no gather to one device and no host round trip.
+    Slots produced elsewhere (chip 0, host uploads) are device_put
+    (device-to-device) onto their mesh position first."""
+    from spark_rapids_tpu.columnar.device import (batch_device,
+                                                  batch_to_device,
+                                                  bucket_capacity,
+                                                  bucket_char_cap)
     schema = slots[0].schema
-    cap = max(b.capacity for b in slots)
+    cap = bucket_capacity(max(b.capacity for b in slots))
     char_caps: List[Optional[int]] = []
     for ci, f in enumerate(schema.fields):
         if isinstance(slots[0].columns[ci], DeviceStringColumn):
-            char_caps.append(max(b.columns[ci].char_cap for b in slots))
+            char_caps.append(bucket_char_cap(
+                max(b.columns[ci].char_cap for b in slots)))
         else:
             char_caps.append(None)
-    padded = [pad_batch(b, cap, char_caps) for b in slots]
+    padded = []
+    for b, d in zip(slots, mesh.devices.flat):
+        cur = batch_device(b)
+        if cur is None or cur.id != d.id:
+            b = batch_to_device(b, d)
+        padded.append(pad_batch(b, cap, char_caps))
     stacked_cols = jax.tree_util.tree_map(
-        lambda *xs: _shard_stack(xs, mesh),
+        lambda *xs: _assemble_sharded(xs, mesh),
         padded[0].columns, *[p.columns for p in padded[1:]])
-    stacked_active = _shard_stack([p.active for p in padded], mesh)
+    stacked_active = _assemble_sharded([p.active for p in padded], mesh)
     return stacked_cols, stacked_active, schema, cap
 
 
-def _shard_stack(xs: Sequence[jax.Array], mesh: Mesh) -> jax.Array:
-    stacked = jnp.stack(list(xs))
-    return jax.device_put(stacked, shard_leading(mesh, stacked.ndim))
+def _assemble_sharded(xs: Sequence[jax.Array], mesh: Mesh) -> jax.Array:
+    """Global [n_dev, ...] array built from one resident shard per chip
+    — no data movement (each ``x[None]`` stays committed to x's chip)."""
+    shape = (len(xs),) + tuple(xs[0].shape)
+    return jax.make_array_from_single_device_arrays(
+        shape, shard_leading(mesh, len(shape)), [x[None] for x in xs])
 
 
 def mesh_exchange(slots: Sequence[DeviceBatch],
                   bound_exprs: Sequence[E.Expression], n_parts: int,
-                  mesh: Mesh) -> List[List[DeviceBatch]]:
+                  mesh: Mesh, metrics=None) -> List[List[DeviceBatch]]:
     """Run the ICI exchange: one input batch per chip -> per-partition
     output batches (partition p owned by chip p % n_dev).  Returns
     ``out[pid] -> [DeviceBatch]`` like the in-process exchange."""
@@ -246,10 +272,16 @@ def mesh_exchange(slots: Sequence[DeviceBatch],
     # fetch) size the send blocks proportionally to real occupancy —
     # without it every block is worst-case cap and staging grows
     # n_dev x cap per chip (VERDICT r3 weak #6)
-    counts = np.asarray(_dest_counts_fn(mesh, tuple(bound_exprs), n_parts)(
+    counts = np.asarray(_dest_counts_fn(
+        mesh, tuple(bound_exprs), n_parts, metrics)(
         stacked_cols, stacked_active, lit_vals))
+    if metrics is not None:
+        # cross-chip padding overhead: rows staged for the collective
+        # beyond the active ones (slots pad to the global max bucket)
+        metrics.create("meshPadWaste").add(
+            n_dev * cap - int(counts.sum()))
     block_cap = min(cap, bucket_capacity(max(1, int(counts.max()))))
-    fn = exchange_fn(mesh, bound_exprs, n_parts, block_cap)
+    fn = exchange_fn(mesh, bound_exprs, n_parts, block_cap, metrics)
     recv_cols, recv_pids, recv_act = fn(stacked_cols, stacked_active,
                                         lit_vals)
     # recv leaves: [n_dev(owner), n_src, block, ...]; land each owner

@@ -68,6 +68,37 @@ def active_mesh(mesh: Mesh) -> Iterator[Mesh]:
         set_active_mesh(prev)
 
 
+def mesh_scan_devices(conf) -> list:
+    """Devices for the mesh-sharded scan: the active mesh's chips when
+    ``spark.rapids.sql.multichip.scan.enabled`` is on AND a multi-device
+    mesh is active, else ``[]`` (single-chip behavior unchanged). The
+    scan, the row-to-columnar upload, and the exchange all consult this
+    one gate so the whole pipeline flips together."""
+    m = get_active_mesh()
+    if m is None or mesh_size(m) <= 1:
+        return []
+    from spark_rapids_tpu.conf import MULTICHIP_SCAN_ENABLED
+    if not bool(conf.get(MULTICHIP_SCAN_ENABLED)):
+        return []
+    return list(m.devices.flat)
+
+
+def record_chip_dispatch(metrics, batch) -> None:
+    """Per-chip dispatch attribution (bench ``detail.multichip``): when
+    a mesh is active, also count this program dispatch against the chip
+    the batch is resident on, so the bench can show every chip doing
+    scan/stage work (the per-executor task counters of the reference's
+    Spark UI)."""
+    if _active is None:
+        return
+    from spark_rapids_tpu import metrics as M
+    from spark_rapids_tpu.columnar.device import batch_device
+    d = batch_device(batch)
+    if d is not None:
+        metrics.create(f"{M.DISPATCH_COUNT}.chip{d.id}",
+                       M.MODERATE).add(1)
+
+
 def mesh_key(mesh: Mesh) -> tuple:
     """Value-based cache key for compiled per-mesh programs (two Mesh
     objects over the same devices share executables; id()-keyed caches

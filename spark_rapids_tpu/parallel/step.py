@@ -32,7 +32,12 @@ from spark_rapids_tpu.parallel.ici import all_to_all_rows
 from spark_rapids_tpu.parallel.mesh import SHUFFLE_AXIS
 from spark_rapids_tpu.sql import types as T
 
-_STEP_CACHE: Dict[Tuple, Callable] = {}
+# bounded LRU like every other structural jit cache: mesh step programs
+# count in cache_stats() (bench detail.jitCaches) instead of living in
+# an invisible module dict
+from spark_rapids_tpu.jit_cache import JitCache
+
+_STEP_CACHE = JitCache("meshStep")
 
 
 def sum_count_step(mesh: Mesh) -> Callable:
@@ -95,6 +100,4 @@ def sum_count_step(mesh: Mesh) -> Callable:
                    in_specs=(P(SHUFFLE_AXIS), P(SHUFFLE_AXIS),
                              P(SHUFFLE_AXIS)),
                    out_specs=(P(SHUFFLE_AXIS),) * 4)
-    fn = jax.jit(sm)
-    _STEP_CACHE[key] = fn
-    return fn
+    return _STEP_CACHE.put(key, jax.jit(sm))

@@ -274,3 +274,192 @@ def test_q1_shape_over_mesh(mesh8):
         q, conf={"spark.rapids.shuffle.mode": "ici"},
         ignore_order=False,
         expect_execs=["TpuHashAggregate", "TpuSort"])
+
+
+# -- mesh-sharded scan (PR 3) ----------------------------------------------
+#
+# Skew/degenerate sharding coverage: the unit scheduler, and end-to-end
+# parquet scans over the 8-device mesh that must stay bit-identical to
+# BOTH the in-process (single-chip) TPU path and the CPU engine —
+# including unit counts not divisible by the mesh size, chips that
+# receive zero scan units, and an empty (fully pruned) relation.
+
+class _Unit:
+    def __init__(self, size_bytes):
+        self.size_bytes = size_bytes
+
+
+def test_shard_units_by_bytes_balances_skew():
+    from spark_rapids_tpu.io.readers import shard_units_by_bytes
+    rng = np.random.default_rng(4)
+    sizes = [int(s) for s in rng.integers(1, 1_000_000, 37)]
+    streams = shard_units_by_bytes([_Unit(s) for s in sizes], 8)
+    assert sum(len(st) for st in streams) == 37
+    loads = [sum(u.size_bytes for u in st) for st in streams]
+    # least-loaded-first: no stream exceeds the ideal share by more
+    # than one max-sized unit
+    assert max(loads) - min(loads) <= max(sizes)
+
+
+def test_shard_units_by_bytes_fewer_units_than_streams():
+    from spark_rapids_tpu.io.readers import shard_units_by_bytes
+    streams = shard_units_by_bytes([_Unit(10), _Unit(20)], 8)
+    assert sum(len(st) for st in streams) == 2
+    # empty streams are KEPT (stable per-chip structure)
+    assert len(streams) == 8
+    assert sum(1 for st in streams if not st) == 6
+
+
+def test_shard_units_by_bytes_zero_byte_units_spread():
+    from spark_rapids_tpu.io.readers import shard_units_by_bytes
+    streams = shard_units_by_bytes([_Unit(0) for _ in range(8)], 4)
+    assert [len(st) for st in streams] == [2, 2, 2, 2]
+
+
+def _write_scan_table(spark, path, n_files, rows_per_file=80):
+    n = n_files * rows_per_file
+    rng = np.random.default_rng(n_files)
+    df = spark.createDataFrame(
+        {"k": [int(x) for x in rng.integers(0, 23, n)],
+         "v": [int(x) for x in rng.integers(-500, 500, n)],
+         "s": ["t%03d" % x for x in rng.integers(0, 50, n)]},
+        "k long, v long, s string", num_partitions=n_files)
+    df.write.mode("overwrite").parquet(path)
+
+
+def _scan_agg(path):
+    def q(spark):
+        df = spark.read.parquet(path)
+        return (df.where(F.col("v") > -400).groupBy("k")
+                .agg(F.sum("v").alias("sv"), F.count("*").alias("c"),
+                     F.max("s").alias("mx"))
+                .orderBy("k"))
+    return q
+
+
+def _collect_rows(q, conf):
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    spark = TpuSparkSession(conf)
+    try:
+        spark.start_capture()
+        rows = [tuple(r) for r in q(spark).collect()]
+        return rows, spark.get_captured_plans()
+    finally:
+        spark.stop()
+
+
+def _sum_metric(plans, prefix):
+    from spark_rapids_tpu.metrics import sum_plan_metrics
+    return sum_plan_metrics(plans, prefix)
+
+
+def _assert_mesh_matches_all_paths(q, tmp_path_unused=None):
+    """ici-mesh run == in-process single-chip TPU run == CPU engine,
+    bit-identical (ORDER BY makes row order deterministic)."""
+    ici, ici_plans = _collect_rows(q, {
+        "spark.rapids.sql.enabled": "true",
+        "spark.rapids.shuffle.mode": "ici"})
+    inproc, _ = _collect_rows(q, {"spark.rapids.sql.enabled": "true"})
+    cpu, _ = _collect_rows(q, {"spark.rapids.sql.enabled": "false"})
+    assert ici == inproc, "mesh path diverged from in-process TPU path"
+    assert ici == cpu, "mesh path diverged from CPU engine"
+    return ici_plans
+
+
+def test_mesh_scan_units_not_divisible_by_mesh(tmp_path):
+    """11 scan units over 8 chips: uneven streams, same answer."""
+    import os
+    path = os.path.join(str(tmp_path), "t11")
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    gen = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        _write_scan_table(gen, path, n_files=11)
+    finally:
+        gen.stop()
+    plans = _assert_mesh_matches_all_paths(_scan_agg(path))
+    units = _sum_metric(plans, "meshScanUnits.chip")
+    assert len(units) == 8 and sum(units.values()) == 11
+    assert all(v >= 1 for v in units.values())  # every chip scans
+
+
+def test_mesh_scan_chip_with_zero_units(tmp_path):
+    """2 scan units over 8 chips: six chips get no units, the empty
+    streams still yield stable (empty) partitions."""
+    import os
+    path = os.path.join(str(tmp_path), "t2")
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    gen = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        _write_scan_table(gen, path, n_files=2)
+    finally:
+        gen.stop()
+    plans = _assert_mesh_matches_all_paths(_scan_agg(path))
+    units = _sum_metric(plans, "meshScanUnits.chip")
+    assert sum(units.values()) == 2
+    assert sum(1 for v in units.values() if v == 0) == 6
+
+
+def test_mesh_scan_empty_relation(tmp_path):
+    """Fully-pruned scan (pushdown removes every row group): the mesh
+    path sees zero units on every chip and still agrees everywhere."""
+    import os
+    path = os.path.join(str(tmp_path), "tempty")
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    gen = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        _write_scan_table(gen, path, n_files=3)
+    finally:
+        gen.stop()
+
+    def q(spark):
+        df = spark.read.parquet(path)
+        return (df.where(F.col("v") > 10_000)  # prunes every row group
+                .groupBy("k").agg(F.sum("v").alias("sv"))
+                .orderBy("k"))
+    _assert_mesh_matches_all_paths(q)
+
+
+def test_mesh_scan_batches_resident_per_chip(tmp_path):
+    """The q1 shape over the mesh scan: every chip runs scan units AND
+    dispatches device programs on ITS resident batches (per-chip
+    dispatch counters all nonzero), and the exchange reports the
+    cross-chip padding overhead (meshPadWaste)."""
+    import os
+    path = os.path.join(str(tmp_path), "t16")
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    gen = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        _write_scan_table(gen, path, n_files=16, rows_per_file=200)
+    finally:
+        gen.stop()
+    rows, plans = _collect_rows(_scan_agg(path), {
+        "spark.rapids.sql.enabled": "true",
+        "spark.rapids.shuffle.mode": "ici"})
+    units = _sum_metric(plans, "meshScanUnits.chip")
+    assert len(units) == 8 and all(v >= 1 for v in units.values())
+    dispatch = _sum_metric(plans, "dispatchCount.chip")
+    assert len(dispatch) >= 8 and all(v >= 1 for v in dispatch.values()), \
+        f"expected device programs on every chip, got {dispatch}"
+    pad = _sum_metric(plans, "meshPadWaste")
+    assert "meshPadWaste" in pad  # emitted (value may be 0 if aligned)
+
+
+def test_multichip_scan_disabled_falls_back(tmp_path):
+    """multichip.scan.enabled=false: ici exchange still works but the
+    scan stays a single stream (no per-chip scan-unit counters)."""
+    import os
+    path = os.path.join(str(tmp_path), "tdis")
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    gen = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        _write_scan_table(gen, path, n_files=8)
+    finally:
+        gen.stop()
+    rows, plans = _collect_rows(_scan_agg(path), {
+        "spark.rapids.sql.enabled": "true",
+        "spark.rapids.shuffle.mode": "ici",
+        "spark.rapids.sql.multichip.scan.enabled": "false"})
+    assert not _sum_metric(plans, "meshScanUnits.chip")
+    cpu, _ = _collect_rows(_scan_agg(path),
+                           {"spark.rapids.sql.enabled": "false"})
+    assert rows == cpu
