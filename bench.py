@@ -445,6 +445,71 @@ def run_multichip(single_chip_wall: float, cpu_rows) -> dict:
         tpu.stop()
 
 
+_ROBUSTNESS_COUNTERS = ("retryCount", "splitRetryCount",
+                        "spillBytesOnRetry", "retryBlockTime",
+                        "ioRetryCount", "degradedChips")
+
+
+def run_robustness(clean_wall: float, cpu_rows) -> dict:
+    """q1 under deterministic fault injection (docs/robustness.md): one
+    leg per failure mode — every-Nth OOM (retry), split-OOM (split-and-
+    retry), and a persistently failing mesh chip (graceful degradation)
+    — asserting bit-identical results and reporting the retry/split/
+    spill counters plus the degraded-mode walls against the clean wall.
+    Skips gracefully when injection is off (BENCH_INJECT=0)."""
+    if os.environ.get("BENCH_INJECT", "1").lower() in ("0", "false",
+                                                       "off"):
+        return {"skipped": True, "reason": "injection off (BENCH_INJECT=0)"}
+    from spark_rapids_tpu import retry as RT
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    legs = [
+        ("oomEveryN", {"spark.rapids.sql.test.injectOOM": "5"}, {}),
+        ("splitOom", {"spark.rapids.sql.test.injectOOM": "split:7"}, {}),
+    ]
+    import jax
+    if len(jax.devices()) >= 2:
+        legs.append(("chipFailure",
+                     {"spark.rapids.sql.test.injectChipFailure":
+                      str(jax.devices()[0].id)},
+                     {"spark.rapids.shuffle.mode": "ici"}))
+    out = {"skipped": False, "clean_wall_s": round(clean_wall, 4),
+           "legs": {}}
+    for name, inject, extra in legs:
+        RT.reset_fault_injection()
+        conf = dict(TPU_CONF)
+        conf.update(inject)
+        conf.update(extra)
+        tpu = TpuSparkSession(conf)
+        try:
+            q = build_query(tpu)
+            # capture BOTH runs: one-time events (chip degradation
+            # happens once per session) land in the warm run, while the
+            # second run's wall is the degraded-mode steady state
+            tpu.start_capture()
+            run_once(q)
+            RT.reset_fault_injection()
+            dt, rows = run_once(q)
+            assert_rows_match(cpu_rows, rows)
+            counters = collect_counters(tpu.get_captured_plans(),
+                                        _ROBUSTNESS_COUNTERS)
+            inj = RT.get_fault_injector(tpu.conf_obj)
+            out["legs"][name] = {
+                "wall_s": round(dt, 4),
+                "slowdown_vs_clean": round(dt / clean_wall, 4),
+                "retryCount": counters["retryCount"],
+                "splitRetryCount": counters["splitRetryCount"],
+                "spillBytesOnRetry": counters["spillBytesOnRetry"],
+                "retryBlockTime_s": round(
+                    counters["retryBlockTime"] / 1e9, 4),
+                "degradedChips": counters["degradedChips"],
+                "injected": inj.stats() if inj is not None else {},
+            }
+        finally:
+            tpu.stop()
+    RT.reset_fault_injection()
+    return out
+
+
 def main():
     from spark_rapids_tpu.jit_cache import cache_stats
     from spark_rapids_tpu.sql.session import TpuSparkSession
@@ -482,6 +547,13 @@ def main():
         multichip = {"skipped": True,
                      "reason": f"multichip leg failed: {e!r}"}
 
+    # robustness sweep, equally fault-isolated
+    try:
+        robustness = run_robustness(fused["wall_s"], cpu_rows)
+    except Exception as e:  # noqa: BLE001 - reported, not swallowed
+        robustness = {"skipped": True,
+                      "reason": f"robustness leg failed: {e!r}"}
+
     cpu_t = min(cpu_times)
     tpu_t = fused["wall_s"]
     q3_tpu_t = fused["q3"]["wall_s"]
@@ -515,6 +587,7 @@ def main():
                 "unfused_stages": unfused["stages"],
             },
             "multichip": multichip,
+            "robustness": robustness,
             "jitCaches": cache_stats(),
             "tpcds_q3": {
                 "device_wall_s": round(q3_tpu_t, 4),

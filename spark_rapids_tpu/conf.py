@@ -315,6 +315,48 @@ MULTICHIP_SCAN_ENABLED = conf(
     "and the CPU engine are unchanged and results are bit-identical."
     ).boolean(True)
 
+RETRY_MAX_RETRIES = conf("spark.rapids.sql.retry.maxRetries").doc(
+    "Maximum OOM retries of one device allocation/operation before the "
+    "failure escalates (split-and-retry where the operator supports "
+    "splitting its input, abort otherwise). Each retry spills the "
+    "device store down and backs off exponentially "
+    "(RmmRapidsRetryIterator.scala:243 withRetry role).").integer(3)
+
+RETRY_BACKOFF_MS = conf("spark.rapids.sql.retry.backoffMs").doc(
+    "Base backoff in milliseconds between OOM retries; doubles per "
+    "attempt up to spark.rapids.sql.retry.maxBackoffMs. The block time "
+    "is reported as the retryBlockTime metric.").integer(1)
+
+RETRY_MAX_BACKOFF_MS = conf("spark.rapids.sql.retry.maxBackoffMs").doc(
+    "Upper bound in milliseconds on the exponential OOM-retry "
+    "backoff.").integer(100)
+
+READER_MAX_RETRIES = conf("spark.rapids.sql.reader.maxRetries").doc(
+    "Maximum retries of a transient IO error in the file readers "
+    "(PERFILE / MULTITHREADED / COALESCING and the mesh-sharded "
+    "streams); the original error re-raises after exhaustion.").integer(3)
+
+READER_RETRY_BACKOFF_MS = conf("spark.rapids.sql.reader.retryBackoffMs").doc(
+    "Base backoff in milliseconds between reader IO retries; doubles "
+    "per attempt (bounded at 1s).").integer(5)
+
+INJECT_OOM = conf("spark.rapids.sql.test.injectOOM").internal().doc(
+    "Testing: deterministic synthetic-OOM schedule for the retry "
+    "framework. 'N' = every Nth wrapped allocation throws TpuRetryOOM; "
+    "'N:K' = K consecutive failures at every Nth allocation; "
+    "'split:N' = TpuSplitAndRetryOOM every Nth; 'seed:S:P' = seeded "
+    "random with probability P (docs/robustness.md).").string("")
+
+INJECT_IO_ERROR = conf("spark.rapids.sql.test.injectIOError").internal().doc(
+    "Testing: deterministic synthetic IO-error schedule for the file "
+    "readers; same 'N' / 'N:K' grammar as injectOOM.").string("")
+
+INJECT_CHIP_FAILURE = conf(
+    "spark.rapids.sql.test.injectChipFailure").internal().doc(
+    "Testing: comma-separated mesh chip ids whose dispatches "
+    "persistently fail; the mesh degrades to the surviving chips "
+    "(docs/robustness.md degradation ladder).").string("")
+
 PARQUET_DEVICE_DECODE = conf(
     "spark.rapids.sql.format.parquet.deviceDecode.enabled").doc(
     "Decode Parquet pages ON DEVICE: host threads read raw column-chunk "

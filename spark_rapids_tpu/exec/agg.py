@@ -535,7 +535,10 @@ class TpuHashAggregateExec(TpuExec):
                     merged.append(chunk[0])
                     continue
                 whole = concat_device([h.get() for h in chunk])
-                out, cnt = self._aggregate_batch(whole, mode="merge")
+                from spark_rapids_tpu import retry as R
+                out, cnt = R.with_retry(
+                    lambda w=whole: self._aggregate_batch(w, mode="merge"),
+                    self.conf, self.metrics)
                 out._num_rows = int(cnt)  # sizes the bucket slice
                 out = slice_compacted_to_bucket(out)
                 for h in chunk:
@@ -570,7 +573,10 @@ class TpuHashAggregateExec(TpuExec):
                         h.close()
                 # no shrink: results stay mask-scattered (caps here are
                 # already small post-exchange; skipping saves a sync)
-                out, _cnt = self._aggregate_batch(whole)
+                from spark_rapids_tpu import retry as R
+                out, _cnt = R.with_retry(
+                    lambda: self._aggregate_batch(whole),
+                    self.conf, self.metrics)
                 if not grouped and self.mode in ("final", "complete") \
                         and out.row_count() == 0:
                     # inputs existed but every row was filtered/inactive:
@@ -592,16 +598,23 @@ class TpuHashAggregateExec(TpuExec):
         small batch with zero extra syncs (the pre-shuffle reduction of
         aggregate.scala:224-245, restructured for a ~0.2-0.7s-per-D2H-
         roundtrip backend)."""
+        from spark_rapids_tpu import retry as R
         from spark_rapids_tpu.columnar.device import _prefetch_host
         pending = []
         prefetched = True
         for b in thunk():
-            out, cnt = self._aggregate_batch(b)
-            # async host copy starts NOW: by drain time the scalar is
-            # already local, so the drain costs pipeline-completion, not
-            # pipeline-completion + a flat ~0.2s roundtrip per fetch
-            prefetched = _prefetch_host([cnt]) and prefetched
-            pending.append((store.register(out), cnt))
+            # OOM protocol on the per-batch update program: spill+retry
+            # first, then split the input in half by rows — partial
+            # outputs from the halves merge downstream exactly like two
+            # ordinary input batches, so results stay bit-identical
+            for out, cnt in R.with_split_retry(
+                    b, self._aggregate_batch, self.conf, self.metrics,
+                    translate_real=not self._donate_input):
+                # async host copy starts NOW: by drain time the scalar
+                # is already local, so the drain costs pipeline-
+                # completion, not + a flat ~0.2s roundtrip per fetch
+                prefetched = _prefetch_host([cnt]) and prefetched
+                pending.append((store.register(out), cnt))
         if not pending:
             return
         # This read is where the whole async upstream pipeline (upload
@@ -632,7 +645,10 @@ class TpuHashAggregateExec(TpuExec):
             whole = concat_device([h.get() for h in shrunk])
             for h in shrunk:
                 h.close()
-            out, _cnt = self._aggregate_batch(whole, mode="merge_partial")
+            out, _cnt = R.with_retry(
+                lambda: self._aggregate_batch(whole,
+                                              mode="merge_partial"),
+                self.conf, self.metrics)
             # leave _num_rows lazy: the output is compacted at a small
             # concat capacity already, and fetching the count here would
             # cost one more roundtrip nothing downstream needs

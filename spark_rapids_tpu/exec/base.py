@@ -128,13 +128,13 @@ class TpuRowToColumnarExec(TpuExec):
                                 prev = submit(pending)
                                 pending, rows = [], 0
                                 if prev is not None:
-                                    yield self._finish(prev.result(),
-                                                       sem, metrics,
-                                                       device)
+                                    yield from self._finish(
+                                        prev.result(), sem, metrics,
+                                        device)
                             prev = submit(b)
                             if prev is not None:
-                                yield self._finish(prev.result(), sem,
-                                                   metrics, device)
+                                yield from self._finish(
+                                    prev.result(), sem, metrics, device)
                             continue
                         if b.num_rows == 0:
                             continue
@@ -144,16 +144,16 @@ class TpuRowToColumnarExec(TpuExec):
                             prev = submit(pending)
                             pending, rows = [], 0
                             if prev is not None:
-                                yield self._finish(prev.result(), sem,
-                                                   metrics, device)
+                                yield from self._finish(
+                                    prev.result(), sem, metrics, device)
                     if pending:
                         prev = submit(pending)
                         if prev is not None:
-                            yield self._finish(prev.result(), sem,
-                                               metrics, device)
+                            yield from self._finish(prev.result(), sem,
+                                                    metrics, device)
                     if staged is not None:
-                        yield self._finish(staged.result(), sem, metrics,
-                                           device)
+                        yield from self._finish(staged.result(), sem,
+                                                metrics, device)
             return run
         return [make(t, d) for t, d in zip(parts, devices)]
 
@@ -168,18 +168,69 @@ class TpuRowToColumnarExec(TpuExec):
         # separate metric: pack overlaps the previous batch's transfer,
         # so folding it into copyToDeviceTime would double-count wall
         with metrics.timed(M.PACK_TIME):
-            return whole.num_rows, prepare_upload(whole, cap)
+            # the source rides along for OOM recovery: a HostBatch can
+            # split in half by rows, an EncodedBatch can fall back to
+            # its pyarrow host decode (docs/robustness.md). Host-memory
+            # cost: at most one extra host copy per in-flight upload
+            # (the 1-deep prefetch bounds this at 2 per stream), freed
+            # as soon as _finish returns
+            return whole.num_rows, prepare_upload(whole, cap), whole
 
-    def _finish(self, prepared, sem, metrics, device=None) -> DeviceBatch:
+    def _finish(self, prepared, sem, metrics,
+                device=None) -> List[DeviceBatch]:
+        from spark_rapids_tpu import retry as R
         from spark_rapids_tpu.columnar.transfer import finish_upload
-        num_rows, staged = prepared
+        num_rows, staged, src = prepared
         sem.acquire_if_necessary(metrics)
-        with metrics.timed(M.COPY_TO_DEVICE_TIME):
-            # mesh scan: each reader stream's batches land on THEIR chip
-            d = finish_upload(staged, device)
+        if device is not None:
+            # mesh scan: an injected/real dispatch failure on this chip
+            # surfaces here; the exchange's degrade loop (or the
+            # driver-level task retry) re-plans on the survivors
+            R.chip_checkpoint(self.conf, device)
+        try:
+            with metrics.timed(M.COPY_TO_DEVICE_TIME):
+                # mesh scan: each stream's batches land on THEIR chip
+                out = [R.with_retry(
+                    lambda: finish_upload(staged, device),
+                    self.conf, metrics, splittable=True)]
+        except (R.TpuSplitAndRetryOOM, R.TpuRetryOOM):
+            out = self._upload_degraded(src, device, metrics)
         metrics.create(M.NUM_OUTPUT_ROWS, M.ESSENTIAL).add(num_rows)
-        metrics.create(M.NUM_OUTPUT_BATCHES, M.ESSENTIAL).add(1)
-        return d
+        metrics.create(M.NUM_OUTPUT_BATCHES, M.ESSENTIAL).add(len(out))
+        return out
+
+    def _upload_degraded(self, src, device, metrics) -> List[DeviceBatch]:
+        """OOM recovery for one upload: an EncodedBatch falls back to
+        its pyarrow per-column host decode for this batch; a HostBatch
+        splits in half by rows and the halves upload independently
+        (downstream consumers see the halves in order — results stay
+        bit-identical to the unsplit whole)."""
+        from spark_rapids_tpu import retry as R
+        from spark_rapids_tpu.columnar.transfer import upload_batch
+        from spark_rapids_tpu.io.device_decode import EncodedBatch
+
+        def upload_host(hb):
+            return upload_batch(hb, bucket_capacity(max(1, hb.num_rows)),
+                                device)
+
+        if isinstance(src, EncodedBatch):
+            if src.host_fallback is None:
+                raise  # no host decode attached (unit-test batches)
+            metrics.create(M.DEVICE_DECODE_OOM_FALLBACKS,
+                           M.ESSENTIAL).add(1)
+            with R.suppress_injection():
+                hbs = [hb for hb in src.host_fallback() if hb.num_rows]
+                # the HBM pressure that forced this fallback is still
+                # live: the replacement uploads get the same retry/
+                # split protection (suppression keeps injected faults
+                # out; real OOMs spill the store and halve the batch)
+                return [d for hb in hbs
+                        for d in R.with_split_retry(
+                            hb, upload_host, self.conf, metrics,
+                            split=R.split_host_batch)]
+        return R.with_split_retry(src, upload_host, self.conf, metrics,
+                                  split=R.split_host_batch,
+                                  split_first=True)
 
 
     def simple_string(self):

@@ -259,8 +259,18 @@ class TpuBroadcastExchangeExec(TpuExec):
         with self._lock:
             if self._built is None:
                 self.metrics.create("broadcastBuilds", M.ESSENTIAL).add(1)
-                batches = [b for t in device_channel(self.child)
-                           for b in t() if b._num_rows != 0]
+                try:
+                    batches = [b for t in device_channel(self.child)
+                               for b in t() if b._num_rows != 0]
+                except BaseException:
+                    # the build drain acquired a device permit on THIS
+                    # thread; a fault mid-build (often during plan
+                    # wiring, before any C2R finally exists) must not
+                    # burn it for the process lifetime
+                    from spark_rapids_tpu.resource import \
+                        release_current_thread
+                    release_current_thread()
+                    raise
                 self._built = (
                     concat_device(batches) if len(batches) > 1 else
                     batches[0] if batches else
@@ -341,7 +351,12 @@ class TpuShuffleExchangeExec(TpuExec):
         with self._lock:  # consumers race here under taskParallelism
             if self._cache is not None:
                 return self._cache
-            cache = self._materialize_inner()
+            # graceful degradation (docs/robustness.md): demote the
+            # failed chip, then re-execute the subtree on the surviving
+            # mesh — single-chip/in-process once too few chips remain
+            from spark_rapids_tpu.retry import degrade_on_chip_failure
+            cache = degrade_on_chip_failure(self._materialize_inner,
+                                            self.metrics)
             from spark_rapids_tpu.conf import SHUFFLE_MODE
             if str(self.conf.get(SHUFFLE_MODE)).lower() == "external":
                 cache = self._external_roundtrip(cache)
@@ -388,11 +403,27 @@ class TpuShuffleExchangeExec(TpuExec):
         return out
 
     def _materialize_inner(self) -> List[List]:
-        from spark_rapids_tpu.memory import get_device_store
+        from spark_rapids_tpu.memory import SpillableBatch, get_device_store
         store = get_device_store(self.conf)
         p = self.partitioning
         n = p.num_partitions
         out: List[List] = [[] for _ in range(n)]
+        try:
+            return self._materialize_parts(p, n, store, out)
+        except BaseException:
+            # an aborted attempt (chip failure mid-drain, exhausted OOM)
+            # must not strand its already-registered partitions in the
+            # store: the degrade loop re-executes from scratch, and a
+            # leaked handle would shrink the budget for the process
+            # lifetime (close is idempotent)
+            for part in out:
+                for h in part:
+                    if isinstance(h, SpillableBatch):
+                        h.close()
+            raise
+
+    def _materialize_parts(self, p, n: int, store,
+                           out: List[List]) -> List[List]:
 
         def keep(pid: int, part: DeviceBatch) -> None:
             """Retain a materialized partition as a spillable handle —
@@ -417,20 +448,29 @@ class TpuShuffleExchangeExec(TpuExec):
                 for h in per_part:
                     if h is not None:
                         out[0].append(h)
-        elif isinstance(p, P.HashPartitioning) and self._mesh_eligible():
+        elif isinstance(p, P.HashPartitioning) and self._mesh_eligible() \
+                and (mesh_out := self._materialize_mesh(p, n)) is not None:
             # mesh batches are sharded jax arrays pinned per chip; the
             # spill tiers (host numpy round-trip) would gather them
             # cross-device, so the ICI path manages residency itself —
             # the reference likewise exempts UCX bounce buffers from the
-            # catalog (RapidsShuffleClient).
-            out = self._materialize_mesh(p, n)
+            # catalog (RapidsShuffleClient). A None mesh_out means the
+            # mesh lost a degradation race after the eligibility gate;
+            # the next branch takes the in-process path.
+            out = mesh_out
         elif isinstance(p, P.HashPartitioning):
             bound = P.bind_list(p.exprs, self.child.output)
 
             def split_one(b):
+                from spark_rapids_tpu import retry as R
                 with self.metrics.timed(M.PARTITION_TIME):
-                    pids = hash_partition_ids(bound, b, n)
-                    parts = split_by_pid(b, pids, n)
+                    # the contiguous-split staging is an allocation
+                    # point: OOM spills the store down and re-runs the
+                    # pid+sort-split program (pure over b — idempotent)
+                    parts = R.with_retry(
+                        lambda: split_by_pid(
+                            b, hash_partition_ids(bound, b, n), n),
+                        self.conf, self.metrics)
                 # register IMMEDIATELY (store is thread-safe) so the
                 # spill budget applies during the drain, not after
                 return [store.register(part) if part is not None else None
@@ -449,8 +489,11 @@ class TpuShuffleExchangeExec(TpuExec):
                     # on tunneled backends)
                     pids = _round_robin_pids(b.active, jnp.int32(start),
                                              n)
+                    from spark_rapids_tpu import retry as R
                     with self.metrics.timed(M.PARTITION_TIME):
-                        parts = split_by_pid(b, pids, n)
+                        parts = R.with_retry(
+                            lambda: split_by_pid(b, pids, n),
+                            self.conf, self.metrics)
                     for pid, part in enumerate(parts):
                         if part is not None:
                             keep(pid, part)
@@ -479,23 +522,41 @@ class TpuShuffleExchangeExec(TpuExec):
                 handles.append(store.register(b))
         if not handles:
             return
-        with self.metrics.timed(M.PARTITION_TIME):
-            pids_per_batch = global_range_pids(p.order, keycols, actives, n)
-        for h, pids, act in zip(handles, pids_per_batch, actives):
-            b, pids = realign_spilled_pids(h, pids, act)
+        from spark_rapids_tpu import retry as R
+        try:
             with self.metrics.timed(M.PARTITION_TIME):
-                parts = split_by_pid(b, pids, n)
-            h.close()
-            for pid, part in enumerate(parts):
-                if part is not None:
-                    keep(pid, part)
+                pids_per_batch = R.with_retry(
+                    lambda: global_range_pids(p.order, keycols, actives,
+                                              n),
+                    self.conf, self.metrics)
+            for h, pids, act in zip(handles, pids_per_batch, actives):
+                b, pids = realign_spilled_pids(h, pids, act)
+                with self.metrics.timed(M.PARTITION_TIME):
+                    parts = R.with_retry(
+                        lambda b=b, pids=pids: split_by_pid(b, pids, n),
+                        self.conf, self.metrics)
+                h.close()
+                for pid, part in enumerate(parts):
+                    if part is not None:
+                        keep(pid, part)
+        except BaseException:
+            # don't strand the staged input handles in the store when
+            # the ranking/split aborts (close is idempotent; the split
+            # outputs in `out` are closed by _materialize_inner)
+            for h in handles:
+                h.close()
+            raise
 
     def _mesh_eligible(self) -> bool:
-        from spark_rapids_tpu.parallel.mesh import get_active_mesh, mesh_size
-        return get_active_mesh() is not None and mesh_size() > 1
+        # the HEALTHY mesh: demoted chips shrink it, and below 2
+        # survivors the exchange falls back to the in-process transport
+        # (the bottom of the degradation ladder, docs/robustness.md)
+        from spark_rapids_tpu.parallel.mesh import healthy_mesh, mesh_size
+        m = healthy_mesh()
+        return m is not None and mesh_size(m) > 1
 
     def _materialize_mesh(self, p: P.HashPartitioning, n: int
-                          ) -> List[List[DeviceBatch]]:
+                          ) -> Optional[List[List[DeviceBatch]]]:
         """ICI path: batches stay HBM-resident per chip and ride one
         all_to_all (SURVEY.md §2.3 TPU mapping note). Streams from the
         mesh-sharded scan arrive already committed per chip and KEEP
@@ -504,12 +565,24 @@ class TpuShuffleExchangeExec(TpuExec):
         gather between scan and exchange. Single-device children fall
         back to the round-robin task->chip placement Spark's scheduler
         provides in the reference."""
+        from spark_rapids_tpu import retry as R
         from spark_rapids_tpu.columnar.device import (batch_device,
                                                       concat_device)
         from spark_rapids_tpu.parallel.ici import mesh_exchange
-        from spark_rapids_tpu.parallel.mesh import get_active_mesh, mesh_size
-        mesh = get_active_mesh()
+        from spark_rapids_tpu.parallel.mesh import healthy_mesh, mesh_size
+        mesh = healthy_mesh()
+        if mesh is None or mesh_size(mesh) <= 1:
+            # lost a degradation race: a concurrent thread demoted
+            # chip(s) between the caller's _mesh_eligible gate and here,
+            # shrinking the healthy mesh below 2 survivors. Signal the
+            # caller to take the in-process path instead of crashing.
+            return None
         n_dev = mesh_size(mesh)
+        # dispatch-failure checkpoint per mesh chip BEFORE staging: an
+        # injected (or detected) chip failure raises TpuChipFailure and
+        # the degrade loop in _materialize re-plans on the survivors
+        for d in mesh.devices.flat:
+            R.chip_checkpoint(self.conf, d)
         bound = P.bind_list(p.exprs, self.child.output)
         # concurrent drain (taskParallelism): each per-chip stream's
         # host orchestration overlaps the other chips' device compute
@@ -533,8 +606,10 @@ class TpuShuffleExchangeExec(TpuExec):
             for bs in slots]
         self.metrics.create("numIciExchanges", M.ESSENTIAL).add(1)
         with self.metrics.timed(M.PARTITION_TIME):
-            return mesh_exchange(slot_batches, bound, n, mesh,
-                                 self.metrics)
+            return R.with_retry(
+                lambda: mesh_exchange(slot_batches, bound, n, mesh,
+                                      self.metrics),
+                self.conf, self.metrics)
 
     def device_partitions(self) -> List[DevicePartitionThunk]:
         from spark_rapids_tpu.memory import SpillableBatch

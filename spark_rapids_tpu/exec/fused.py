@@ -200,15 +200,24 @@ class TpuFusedStageExec(TpuExec):
 
         def make(thunk: DevicePartitionThunk) -> DevicePartitionThunk:
             def run() -> Iterator[DeviceBatch]:
+                from spark_rapids_tpu import retry as R
                 # async pipeline: dispatch up to window_n batches ahead
                 # of the consumer; jax's async dispatch overlaps batch
                 # k+1's programs with batch k's device compute, the
                 # deque bounds outstanding HBM
                 window: deque = deque()
                 for b in thunk():
-                    window.append(run_one(b))
-                    if len(window) >= window_n:
-                        yield window.popleft()
+                    # OOM protocol: spill+retry, then split the input
+                    # in half by rows (halves yield in order, so the
+                    # stream stays bit-identical). Real backend OOMs
+                    # are only retried when inputs were NOT donated —
+                    # a donating program may have consumed its buffers
+                    for ob in R.with_split_retry(
+                            b, run_one, self.conf, metrics,
+                            translate_real=not may_donate):
+                        window.append(ob)
+                        if len(window) >= window_n:
+                            yield window.popleft()
                 while window:
                     yield window.popleft()
             return run

@@ -101,7 +101,10 @@ class TpuShuffledHashJoinExec(TpuExec):
             key = (id(rwhole), ld.id)
             hit = self._build_dev_cache.get(key)
             if hit is None:
-                hit = (rwhole, batch_to_device(rwhole, ld))
+                from spark_rapids_tpu import retry as R
+                hit = (rwhole, R.with_retry(
+                    lambda: batch_to_device(rwhole, ld),
+                    self.conf, self.metrics))
                 self._build_dev_cache[key] = hit
             self._build_dev_cache.move_to_end(key)
             while len(self._build_dev_cache) > self._build_dev_cap:
@@ -139,13 +142,20 @@ class TpuShuffledHashJoinExec(TpuExec):
             out_schema = lschema
         else:
             out_schema = self._pair_schema()
-        with self.metrics.timed(M.JOIN_TIME):
+        from spark_rapids_tpu import retry as R
+
+        def attempt():
             out = device_join(lwhole, rwhole, lk, rk, self.join_type,
                               out_schema, null_safe=self.null_safe,
                               fk_hint=fk_hint)
             if self.condition is not None:
-                cond = E.bind_references(self.condition, self._pair_attrs())
+                cond = E.bind_references(self.condition,
+                                         self._pair_attrs())
                 out = X.run_filter(cond, out)
+            return out
+
+        with self.metrics.timed(M.JOIN_TIME):
+            out = R.with_retry(attempt, self.conf, self.metrics)
         if out._num_rows is not None:
             # known counts only: fetching one here would be a blocking
             # roundtrip per joined batch purely for the metric
@@ -393,10 +403,13 @@ class TpuShuffledHashJoinExec(TpuExec):
         lwhole = (concat_device(lbatches) if len(lbatches) > 1
                   else lbatches[0])
         rwhole = self._align_build(lwhole, rwhole)
+        from spark_rapids_tpu import retry as R
         with self.metrics.timed(M.JOIN_TIME):
-            out, matched = device_join(lwhole, rwhole, lk, rk, chunk_type,
-                                       out_schema, collect_matched_r=True,
-                                       null_safe=self.null_safe)
+            out, matched = R.with_retry(
+                lambda: device_join(lwhole, rwhole, lk, rk, chunk_type,
+                                    out_schema, collect_matched_r=True,
+                                    null_safe=self.null_safe),
+                self.conf, self.metrics)
         if out._num_rows is not None:
             self.metrics.create(M.NUM_OUTPUT_ROWS, M.ESSENTIAL).add(
                 out._num_rows)

@@ -141,8 +141,12 @@ class TpuSortExec(TpuExec):
                         return
                     whole = (batches[0] if len(batches) == 1
                              else concat_device(batches))
+                    from spark_rapids_tpu import retry as R
                     with metrics.timed(M.SORT_TIME):
-                        out = sorted_batch(self.order, bound, whole, limit)
+                        out = R.with_retry(
+                            lambda: sorted_batch(self.order, bound,
+                                                 whole, limit),
+                            self.conf, metrics)
                     metrics.create(M.NUM_OUTPUT_ROWS, M.ESSENTIAL).add(
                         out.row_count())
                     yield out
@@ -169,8 +173,14 @@ class TpuSortExec(TpuExec):
                     whole = concat_device([h.get() for h in handles])
                     for h in handles:
                         h.close()
+                    from spark_rapids_tpu import retry as R
                     with metrics.timed(M.SORT_TIME):
-                        out = sorted_batch(self.order, bound, whole, -1)
+                        # retry-only: a sort is not row-splittable (the
+                        # out-of-core rank-split path IS the split story)
+                        out = R.with_retry(
+                            lambda: sorted_batch(self.order, bound,
+                                                 whole, -1),
+                            self.conf, metrics)
                     if out._num_rows is not None:
                         # known counts only: fetching one here would be
                         # a blocking D2H roundtrip purely for the metric
@@ -191,19 +201,24 @@ class TpuSortExec(TpuExec):
         key columns assign each row to a rank-contiguous sub-range of at
         most ``goal`` rows; each sub-range is concatenated, sorted, and
         emitted in order (GpuSortExec.scala:231 role)."""
+        from spark_rapids_tpu import retry as R
         from spark_rapids_tpu.exec.exchange import (global_range_pids,
                                                     realign_spilled_pids,
                                                     split_by_pid)
         n_sub = (total + goal - 1) // goal
         with metrics.timed(M.SORT_TIME):
-            pids_per_batch = global_range_pids(self.order, keycols,
-                                               actives, n_sub)
+            pids_per_batch = R.with_retry(
+                lambda: global_range_pids(self.order, keycols, actives,
+                                          n_sub),
+                self.conf, metrics)
         keycols.clear()
         buckets: List[List] = [[] for _ in range(n_sub)]
         for h, pids, act in zip(handles, pids_per_batch, actives):
             b, pids = realign_spilled_pids(h, pids, act)
             with metrics.timed(M.SORT_TIME):
-                parts = split_by_pid(b, pids, n_sub)
+                parts = R.with_retry(
+                    lambda b=b, pids=pids: split_by_pid(b, pids, n_sub),
+                    self.conf, metrics)
             h.close()
             for pid, part in enumerate(parts):
                 if part is not None:
@@ -216,7 +231,10 @@ class TpuSortExec(TpuExec):
             for h in buckets[pid]:
                 h.close()
             with metrics.timed(M.SORT_TIME):
-                out = sorted_batch(self.order, bound, whole, -1)
+                out = R.with_retry(
+                    lambda w=whole: sorted_batch(self.order, bound, w,
+                                                 -1),
+                    self.conf, metrics)
             metrics.create(M.NUM_OUTPUT_ROWS, M.ESSENTIAL).add(
                 out.row_count())
             yield out

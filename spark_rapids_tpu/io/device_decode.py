@@ -235,6 +235,11 @@ class EncodedBatch:
     host_cols: Dict[int, Any]              # field index -> HostColumn
     fallbacks: List[Tuple[str, str]]       # (column, reason)
     path: str = ""
+    # OOM recovery hook (docs/robustness.md): () -> List[HostBatch] via
+    # the pyarrow per-column host decode of the SAME scan unit; set by
+    # the reader so a device-decode upload that cannot fit falls back
+    # for just that batch instead of failing the query
+    host_fallback: Any = None
 
 
 # ---------------------------------------------------------------------------

@@ -591,8 +591,18 @@ class CpuFileScanExec(P.PhysicalPlan):
         metrics = self.metrics
 
         def decode(u: ScanUnit):
+            from spark_rapids_tpu import retry as R
             with metrics.timed_wall("decodeTime"):
-                tbl = _read_unit(self.fmt, u, data_schema, self.options)
+                # transient IO errors retry with bounded exponential
+                # backoff (spark.rapids.sql.reader.maxRetries /
+                # retryBackoffMs), re-raising the original after
+                # exhaustion; covers PERFILE, MULTITHREADED (pool
+                # threads), COALESCING, and the mesh-sharded streams,
+                # which all decode through here
+                tbl = R.io_with_retry(
+                    lambda: _read_unit(self.fmt, u, data_schema,
+                                       self.options),
+                    self.conf, metrics, path=u.path)
                 if part_fields:
                     tbl = _append_partition_columns(tbl, part_fields,
                                                     u.part_values or {})
@@ -627,6 +637,9 @@ class CpuFileScanExec(P.PhysicalPlan):
             if part_fields:
                 enc = _extend_with_partition_cols(
                     enc, schema, part_fields, u.part_values or {})
+            # OOM recovery: the upload can fall back to the pyarrow
+            # host decode of this unit for just this batch
+            enc.host_fallback = lambda u=u: list(emit(decode(u)))
             metrics.create("deviceDecodedBatches").add(1)
             for name, _reason in enc.fallbacks:
                 metrics.create("deviceFallbackColumns").add(1)

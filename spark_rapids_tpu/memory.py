@@ -157,6 +157,13 @@ class DeviceStore:
         self.spilled_device_bytes = 0
         self.disk_spill_count = 0
         self.peak_device_bytes = 0
+        # disk-tier hygiene: every spill file carries this store's
+        # prefix so close() can sweep stragglers without touching other
+        # stores sharing the directory; diskFilesLive tracks files the
+        # store believes exist (leak detector for tests/stats)
+        self._file_prefix = f"spill-{uuid.uuid4().hex[:8]}"
+        self.disk_files_live = 0
+        self._closed = False
 
     # -- registration ------------------------------------------------------
 
@@ -184,6 +191,7 @@ class DeviceStore:
                 with open(st.disk_path, "rb") as f:
                     st.host = serde.deserialize_batch(f.read())
                 os.unlink(st.disk_path)
+                self.disk_files_live -= 1
                 st.disk_path = None
                 st.tier = TIER_HOST
                 st.host_bytes = _host_sizeof(st.host)
@@ -243,8 +251,9 @@ class DeviceStore:
             _log.info("spill host->disk: %d bytes (host %d/%d)",
                       st.host_bytes, self.host_bytes, self.host_budget)
         os.makedirs(self.spill_dir, exist_ok=True)
-        path = os.path.join(self.spill_dir,
-                            f"spill-{uuid.uuid4().hex[:16]}.bin")
+        path = os.path.join(
+            self.spill_dir,
+            f"{self._file_prefix}-{uuid.uuid4().hex[:16]}.bin")
         from spark_rapids_tpu.columnar import serde
         with open(path, "wb") as f:
             f.write(serde.serialize_batch(st.host, self.codec))
@@ -253,6 +262,7 @@ class DeviceStore:
         st.disk_path = path
         st.tier = TIER_DISK
         self.disk_spill_count += 1
+        self.disk_files_live += 1
 
     def _release_id(self, hid: int) -> None:
         with self._lock:
@@ -267,10 +277,55 @@ class DeviceStore:
             elif st.disk_path:
                 try:
                     os.unlink(st.disk_path)
+                    self.disk_files_live -= 1
                 except OSError:
                     pass
+                st.disk_path = None
             st.device = None
             st.host = None
+
+    # -- OOM-retry hook + lifecycle ----------------------------------------
+
+    def spill_device_down(self, target_bytes: int = 0) -> int:
+        """Demote device-tier handles (LRU first) until at most
+        ``target_bytes`` remain in HBM — the retry framework's
+        spill-the-store-and-retry step
+        (DeviceMemoryEventHandler.onAllocFailure role). Returns the
+        HBM bytes freed."""
+        freed = 0
+        with self._lock:
+            for hid in list(self._states):
+                if self.device_bytes <= target_bytes:
+                    break
+                st = self._states[hid]
+                if st.tier == TIER_DEVICE and not st.closed:
+                    freed += st.device_bytes
+                    self._spill_to_host(st)
+        return freed
+
+    def close(self) -> None:
+        """Release every handle and sweep this store's disk-tier files
+        (spill files are scratch — nothing must survive the store;
+        registered atexit for the process singleton so interpreter exit
+        never leaks /tmp spill files)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            for hid in list(self._states):
+                self._release_id(hid)
+            # stragglers (crash paths, files orphaned mid-transition)
+            try:
+                import glob
+                for path in glob.glob(os.path.join(
+                        self.spill_dir, f"{self._file_prefix}-*.bin")):
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+            except Exception:
+                pass
+            self.disk_files_live = 0
 
     def stats(self) -> Dict[str, int]:
         return {
@@ -280,6 +335,7 @@ class DeviceStore:
             "spillCount": self.spill_count,
             "spilledDeviceBytes": self.spilled_device_bytes,
             "diskSpillCount": self.disk_spill_count,
+            "diskFilesLive": self.disk_files_live,
         }
 
 
@@ -309,6 +365,23 @@ def _default_budget() -> int:
 _STORE: Optional[DeviceStore] = None
 _STORE_KEY: Optional[tuple] = None
 _STORE_LOCK = threading.Lock()
+# every store this process built (the keyed rebuild replaces _STORE but
+# older stores may still back live handles): atexit closes them ALL so
+# no disk-tier spill file survives the interpreter
+_ALL_STORES: list = []
+
+
+def _close_stores_at_exit() -> None:
+    for s in _ALL_STORES:
+        try:
+            s.close()
+        except Exception:
+            pass
+
+
+import atexit  # noqa: E402  (registration belongs with the registry)
+
+atexit.register(_close_stores_at_exit)
 
 
 def get_device_store(conf: TpuConf) -> DeviceStore:
@@ -331,6 +404,7 @@ def get_device_store(conf: TpuConf) -> DeviceStore:
             _STORE = DeviceStore(budget, host_budget, spill_dir,
                                  codec=codec)
             _STORE_KEY = key
+            _ALL_STORES.append(_STORE)
         # logging-only: toggled in place so a debug flip never replaces
         # the live store (two stores would account one HBM independently)
         _STORE.debug = bool(conf.get(MEMORY_DEBUG))

@@ -42,6 +42,15 @@ STAGE_COMPILE_TIME = "stageCompileTime"  # first-call build+compile wall
 FUSED_OPS = "fusedOps"                  # operators collapsed into a stage
 COMPILE_CACHE_HITS = "compileCacheHits"
 COMPILE_CACHE_MISSES = "compileCacheMisses"
+# retry framework metrics (spark_rapids_tpu/retry.py, docs/robustness.md)
+RETRY_COUNT = "retryCount"                # OOM retries that re-attempted
+SPLIT_RETRY_COUNT = "splitRetryCount"     # input batches split in half
+RETRY_BLOCK_TIME = "retryBlockTime"       # spill+backoff wall inside retries
+SPILL_BYTES_ON_RETRY = "spillBytesOnRetry"  # HBM freed by retry spills
+DEGRADED_CHIPS = "degradedChips"          # mesh chips demoted after failure
+IO_RETRY_COUNT = "ioRetryCount"           # transient reader IO retries
+DEVICE_DECODE_OOM_FALLBACKS = "deviceDecodeOomFallbacks"  # encoded-upload
+#   OOMs that fell back to the pyarrow host decode for that batch
 
 
 @dataclass

@@ -26,6 +26,11 @@ SHUFFLE_AXIS = "shuffle"
 
 _lock = threading.Lock()
 _active: Optional[Mesh] = None
+# chips demoted after dispatch failures (docs/robustness.md degradation
+# ladder): the healthy mesh excludes them, so scans, stages, and
+# exchanges re-plan on the survivors instead of failing the query
+_failed_chips: set = set()
+_healthy_cache: Optional[tuple] = None  # (key, mesh)
 
 
 def build_mesh(n_devices: Optional[int] = None,
@@ -42,9 +47,60 @@ def build_mesh(n_devices: Optional[int] = None,
 
 
 def set_active_mesh(mesh: Optional[Mesh]) -> None:
-    global _active
+    global _active, _healthy_cache
     with _lock:
         _active = mesh
+        # a (re)activated topology starts fully healthy: degradation is
+        # a per-activation view, like the reference's heartbeat registry
+        _failed_chips.clear()
+        _healthy_cache = None
+
+
+def mark_chip_failed(chip_id: int) -> bool:
+    """Demote one chip after a dispatch failure. Returns False when the
+    chip was already demoted. Degrade loops decide retry-vs-reraise
+    against a ``failed_chips()`` snapshot taken BEFORE their attempt
+    (a failure on a chip demoted before the attempt began means the
+    failure is elsewhere; losing a demotion race mid-attempt does not),
+    and use this return value only to keep degradedChips exact."""
+    global _healthy_cache
+    with _lock:
+        if chip_id in _failed_chips:
+            return False
+        _failed_chips.add(chip_id)
+        _healthy_cache = None
+        return True
+
+
+def failed_chips() -> frozenset:
+    with _lock:
+        return frozenset(_failed_chips)
+
+
+def degraded_chip_count() -> int:
+    with _lock:
+        return len(_failed_chips)
+
+
+def healthy_mesh() -> Optional[Mesh]:
+    """The active mesh restricted to chips that have not failed; the
+    full active mesh while everything is healthy, None when no mesh is
+    active or at most one chip survives (single-chip execution then
+    takes the normal non-mesh paths)."""
+    global _healthy_cache
+    with _lock:
+        m = _active
+        if m is None:
+            return None
+        if not _failed_chips:
+            return m
+        key = (mesh_key(m), frozenset(_failed_chips))
+        if _healthy_cache is not None and _healthy_cache[0] == key:
+            return _healthy_cache[1]
+        devs = [d for d in m.devices.flat if d.id not in _failed_chips]
+        healthy = build_mesh(devices=devs) if len(devs) >= 2 else None
+        _healthy_cache = (key, healthy)
+        return healthy
 
 
 def get_active_mesh() -> Optional[Mesh]:
@@ -74,7 +130,7 @@ def mesh_scan_devices(conf) -> list:
     mesh is active, else ``[]`` (single-chip behavior unchanged). The
     scan, the row-to-columnar upload, and the exchange all consult this
     one gate so the whole pipeline flips together."""
-    m = get_active_mesh()
+    m = healthy_mesh()  # degraded chips never receive scan streams
     if m is None or mesh_size(m) <= 1:
         return []
     from spark_rapids_tpu.conf import MULTICHIP_SCAN_ENABLED

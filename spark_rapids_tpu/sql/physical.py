@@ -84,26 +84,57 @@ class PhysicalPlan:
     def execute_collect(self, parallelism: int = 1) -> HostBatch:
         """Drain all partitions (optionally with a task thread pool — the
         executor-cores analogue; the TpuSemaphore bounds how many tasks
-        touch the device at once). Partition ORDER is preserved."""
-        thunks = self.partitions()
-        if parallelism > 1 and len(thunks) > 1:
-            from concurrent.futures import ThreadPoolExecutor
+        touch the device at once). Partition ORDER is preserved.
 
-            from spark_rapids_tpu.resource import release_current_thread
-            # partitions() may have eagerly drained device subtrees on
-            # this thread (broadcast build sides), leaving a semaphore
-            # permit held; release it before blocking on the pool or the
-            # task threads can starve of permits and hang
+        A ``TpuChipFailure`` that escapes the operators' own recovery
+        (queries without an exchange between the mesh point and the
+        sink) is handled HERE like Spark's driver handles a fetch
+        failure: the chip is demoted and the whole collect re-executes
+        on the surviving mesh (retry.degrade_on_chip_failure — shared
+        with the exchange materializer so the retry-vs-reraise protocol
+        lives in one place). CPU-only roots without a metric registry
+        just skip the degradedChips update."""
+        from spark_rapids_tpu.retry import degrade_on_chip_failure
+        return degrade_on_chip_failure(
+            lambda: self._collect_once(parallelism),
+            getattr(self, "metrics", None))
+
+    def _collect_once(self, parallelism: int) -> HostBatch:
+        from spark_rapids_tpu.resource import release_current_thread
+
+        def drain(t) -> list:
+            # per-task try/finally: an injected/real fault mid-drain
+            # must return the task thread's device permit — pool
+            # threads are discarded with the pool, so a leaked permit
+            # would shrink the semaphore for the process lifetime
+            try:
+                return list(t())
+            finally:
+                release_current_thread()
+
+        try:
+            thunks = self.partitions()
+            if parallelism > 1 and len(thunks) > 1:
+                from concurrent.futures import ThreadPoolExecutor
+                # partitions() may have eagerly drained device subtrees
+                # on this thread (broadcast build sides), leaving a
+                # permit held; release it before blocking on the pool or
+                # the task threads can starve of permits and hang
+                release_current_thread()
+                with ThreadPoolExecutor(
+                        min(parallelism, len(thunks)),
+                        thread_name_prefix="srt-task") as pool:
+                    per_part = list(pool.map(drain, thunks))
+                batches = [b for part in per_part for b in part]
+            else:
+                batches = []
+                for thunk in thunks:
+                    batches.extend(drain(thunk))
+        finally:
+            # the planning/drain path itself may hold this thread's
+            # permit when an exception unwinds (e.g. an AQE broadcast
+            # materialization during partitions() wiring)
             release_current_thread()
-            with ThreadPoolExecutor(
-                    min(parallelism, len(thunks)),
-                    thread_name_prefix="srt-task") as pool:
-                per_part = list(pool.map(lambda t: list(t()), thunks))
-            batches = [b for part in per_part for b in part]
-        else:
-            batches = []
-            for thunk in thunks:
-                batches.extend(thunk())
         if not batches:
             return HostBatch.empty(self.schema)
         return HostBatch.concat(batches)

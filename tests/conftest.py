@@ -19,8 +19,16 @@ os.environ["SPARK_RAPIDS_TPU_XLA_CACHE"] = "off"
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_backend_optimization_level" not in _flags:
+    # The suite is compile-bound: hundreds of distinct XLA programs,
+    # recompiled per module (see _clear_jax_caches_per_module). Tests
+    # assert CORRECTNESS against the CPU oracle, not codegen quality,
+    # and O0 halves the wall of the compile-heavy modules while staying
+    # bit-identical (XLA optimization passes are semantics-preserving;
+    # no fast-math is enabled at any level). bench.py is unaffected.
+    _flags = (_flags + " --xla_backend_optimization_level=0").strip()
+os.environ["XLA_FLAGS"] = _flags
 
 import jax  # noqa: E402
 
@@ -46,3 +54,13 @@ def _clear_jax_caches_per_module():
     yield
     import jax
     jax.clear_caches()
+
+
+def pytest_configure(config):
+    # tier-1 selects with `-m 'not slow'`, so `fault` tests (the
+    # robustness/fault-injection corpus) run IN tier-1 by default
+    config.addinivalue_line(
+        "markers", "slow: long-running test excluded from tier-1")
+    config.addinivalue_line(
+        "markers", "fault: fault-injection robustness test "
+        "(docs/robustness.md); included in tier-1")

@@ -1,0 +1,680 @@
+"""Task-level OOM retry / split-and-retry parity corpus
+(RmmRapidsRetryIterator + DeviceMemoryEventHandler coverage, driven by
+the deterministic FaultInjector — SURVEY.md:377-385 names the missing
+fault-injection framework this closes).
+
+q1/q3-shaped pipelines run under swept injected-OOM schedules and must
+be bit-identical to the clean run with ``retryCount``/``splitRetryCount``
+metrics > 0; persistent chip-failure injection must degrade the mesh
+(identical results, ``degradedChips`` > 0) instead of failing the query;
+reader IO injection must retry with bounded backoff and re-raise the
+original error on exhaustion.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import memory as MEM
+from spark_rapids_tpu import metrics as M
+from spark_rapids_tpu import resource
+from spark_rapids_tpu import retry as R
+from spark_rapids_tpu.columnar.device import DeviceBatch
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+from spark_rapids_tpu.metrics import MetricRegistry, sum_plan_metrics
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql import types as T
+from spark_rapids_tpu.sql.session import TpuSparkSession
+
+from tests.datagen import (IntegerGen, KeyStringGen, LongGen, SmallIntGen,
+                           StringGen, gen_batch)
+from tests.harness import _rows, _sort_key, values_equal
+
+pytestmark = pytest.mark.fault
+
+
+@pytest.fixture(autouse=True)
+def _fresh_injection():
+    """Deterministic schedules: every test starts a fresh injector."""
+    R.reset_fault_injection()
+    yield
+    R.reset_fault_injection()
+
+
+def _conf(injection=None, **extra):
+    conf = {
+        "spark.rapids.sql.enabled": "true",
+        # small batches -> many wrapped allocation points per query
+        "spark.rapids.sql.batchSizeRows": "256",
+        # fast, bounded backoff so injected sweeps stay quick
+        "spark.rapids.sql.retry.backoffMs": "1",
+        "spark.rapids.sql.retry.maxBackoffMs": "4",
+    }
+    if injection:
+        conf["spark.rapids.sql.test.injectOOM"] = injection
+    conf.update(extra)
+    return conf
+
+
+def _run_clean_vs_injected(df_fn, conf, ignore_order=True):
+    """CPU clean run vs TPU injected run: assert bit-identical rows;
+    return the captured TPU plans (for metric assertions)."""
+    cpu_conf = dict(conf)
+    cpu_conf["spark.rapids.sql.enabled"] = "false"
+    # the clean oracle must not see injection (deterministic schedules
+    # are a property of the process-wide injector)
+    for k in list(cpu_conf):
+        if k.startswith("spark.rapids.sql.test.inject"):
+            del cpu_conf[k]
+    spark = TpuSparkSession(cpu_conf)
+    try:
+        cpu = df_fn(spark)._execute().to_pydict()
+    finally:
+        spark.stop()
+
+    R.reset_fault_injection()
+    spark = TpuSparkSession(conf)
+    try:
+        spark.start_capture()
+        tpu = df_fn(spark)._execute().to_pydict()
+        report = spark.last_rewrite_report
+        plans = spark.get_captured_plans()
+    finally:
+        spark.stop()
+    assert report is not None and report.replaced_any, (
+        "nothing placed on device:\n" + (report.format() if report else ""))
+
+    assert set(cpu) == set(tpu)
+    crows, trows = _rows(cpu), _rows(tpu)
+    assert len(crows) == len(trows), (len(crows), len(trows))
+    if ignore_order:
+        crows = sorted(crows, key=_sort_key)
+        trows = sorted(trows, key=_sort_key)
+    for cr, tr in zip(crows, trows):
+        for a, b in zip(cr, tr):
+            assert values_equal(a, b, False), (cr, tr)
+    return plans
+
+
+def _metric(plans, name) -> int:
+    return sum(sum_plan_metrics(plans, name).values())
+
+
+# ---------------------------------------------------------------------------
+# Combinator units
+# ---------------------------------------------------------------------------
+
+def _device_batch(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    schema = T.StructType([T.StructField("v", T.LongT),
+                           T.StructField("s", T.StringT)])
+    return DeviceBatch.from_host(HostBatch(schema, [
+        HostColumn(T.LongT, rng.integers(0, 1 << 40, n),
+                   np.ones(n, dtype=bool)),
+        HostColumn(T.StringT,
+                   np.array([f"s{i % 7}" for i in range(n)], dtype=object),
+                   np.ones(n, dtype=bool)),
+    ], n))
+
+
+def test_with_retry_recovers_from_injected_oom():
+    from spark_rapids_tpu.conf import TpuConf
+    conf = TpuConf({"spark.rapids.sql.test.injectOOM": "2:2",
+                    "spark.rapids.sql.retry.backoffMs": "1",
+                    "spark.rapids.sql.retry.maxBackoffMs": "2"})
+    metrics = MetricRegistry()
+    # allocation 1 passes; allocation 2 starts a 2-failure streak
+    assert R.with_retry(lambda: "a", conf, metrics) == "a"
+    assert metrics.value(M.RETRY_COUNT) == 0
+    calls = []
+    out = R.with_retry(lambda: calls.append(1) or 42, conf, metrics)
+    assert out == 42
+    # the streak failed two attempts pre-dispatch, the third succeeded
+    assert metrics.value(M.RETRY_COUNT) == 2
+    assert len(calls) == 1  # fn itself only ran once (faults pre-empt it)
+    inj = R.get_fault_injector(conf)
+    assert inj is not None and inj.oom_injected == 2
+
+
+def test_with_retry_exhausts_and_reraises():
+    from spark_rapids_tpu.conf import TpuConf
+    conf = TpuConf({"spark.rapids.sql.test.injectOOM": "1:100",
+                    "spark.rapids.sql.retry.maxRetries": "2",
+                    "spark.rapids.sql.retry.backoffMs": "1",
+                    "spark.rapids.sql.retry.maxBackoffMs": "1"})
+    metrics = MetricRegistry()
+    with pytest.raises(R.TpuRetryOOM):
+        R.with_retry(lambda: 1, conf, metrics)
+    assert metrics.value(M.RETRY_COUNT) == 2
+
+
+def test_with_split_retry_splits_and_preserves_order():
+    """A fn that refuses pieces above 16 rows forces recursive halving;
+    the concatenated results must be the original rows in order."""
+    b = _device_batch(64, seed=3)
+    metrics = MetricRegistry()
+
+    def fn(piece):
+        if piece.row_count() > 16:
+            raise R.TpuSplitAndRetryOOM("too big")
+        return piece
+
+    outs = R.with_split_retry(b, fn, None, metrics)
+    assert len(outs) == 4
+    assert metrics.value(M.SPLIT_RETRY_COUNT) == 3  # 64 -> 2x32 -> 4x16
+    from spark_rapids_tpu.columnar.device import concat_device
+    got = concat_device(outs).to_host().to_pydict()
+    want = b.to_host().to_pydict()
+    assert got == want
+
+
+def test_split_device_batch_respects_active_mask():
+    """Split balances ACTIVE rows and keeps their original order even
+    when the active mask is scattered."""
+    import jax.numpy as jnp
+    b = _device_batch(32, seed=4)
+    scatter = jnp.asarray(np.arange(b.capacity) % 3 == 0)
+    b = DeviceBatch(b.schema, b.columns, b.active & scatter, None)
+    halves = R.split_device_batch(b)
+    assert halves is not None and len(halves) == 2
+    want = b.to_host().to_pydict()
+    from spark_rapids_tpu.columnar.device import concat_device
+    got = concat_device(halves).to_host().to_pydict()
+    assert got == want
+
+
+def test_split_single_row_reports_unsplittable():
+    b = _device_batch(1, seed=5)
+    assert R.split_device_batch(b) is None
+    hb = HostBatch.from_pydict({"v": [1]}, T.StructType(
+        [T.StructField("v", T.LongT)]))
+    assert R.split_host_batch(hb) is None
+
+
+def test_injector_determinism():
+    """Two injectors with the same spec fire at exactly the same
+    events — for the counter grammar and the seeded-random one."""
+    for spec in ("5:2", "seed:42:0.3"):
+        patterns = []
+        for _ in range(2):
+            inj = R.FaultInjector(oom_spec=spec)
+            fired = []
+            for _i in range(100):
+                try:
+                    inj.on_alloc()
+                    fired.append(False)
+                except R.TpuRetryOOM:
+                    fired.append(True)
+            patterns.append(fired)
+        assert patterns[0] == patterns[1], spec
+        assert any(patterns[0]), spec
+
+
+def test_seeded_io_schedule_independent_of_oom():
+    """A seeded IO schedule must work with injectOOM unset, and when
+    both are set each schedule follows its OWN deterministic stream
+    (regression: the RNG was built from the OOM schedule only)."""
+    inj = R.FaultInjector(io_spec="seed:7:0.4")
+    fired = []
+    for _ in range(50):
+        try:
+            inj.on_io("p")
+            fired.append(False)
+        except IOError:
+            fired.append(True)
+    assert any(fired)
+    # same IO pattern when an OOM schedule (different seed) is present
+    both = R.FaultInjector(oom_spec="seed:99:0.4", io_spec="seed:7:0.4")
+    fired2 = []
+    for _ in range(50):
+        try:
+            both.on_io("p")
+            fired2.append(False)
+        except IOError:
+            fired2.append(True)
+    assert fired2 == fired
+
+
+def test_injection_suppressed_in_recovery():
+    inj = R.FaultInjector(oom_spec="1")
+    with R.suppress_injection():
+        inj.on_alloc()  # no raise
+    with pytest.raises(R.TpuRetryOOM):
+        inj.on_alloc()
+
+
+# ---------------------------------------------------------------------------
+# Store hooks (spill-on-retry + disk-tier hygiene satellites)
+# ---------------------------------------------------------------------------
+
+def test_store_spill_device_down_frees_hbm():
+    store = MEM.DeviceStore(1 << 30, 1 << 30, "/tmp/srt_spill_t")
+    b1, b2 = _device_batch(128, 6), _device_batch(128, 7)
+    h1, h2 = store.register(b1), store.register(b2)
+    assert store.device_bytes > 0
+    freed = store.spill_device_down()
+    assert freed > 0 and store.device_bytes == 0
+    got = np.asarray(h1.get().columns[0].data)[:128]
+    assert (got == np.asarray(b1.columns[0].data)[:128]).all()
+    h1.close()
+    h2.close()
+
+
+def test_disk_files_tracked_and_swept_on_close(tmp_path):
+    store = MEM.DeviceStore(device_budget=1, host_budget=1,
+                            spill_dir=str(tmp_path))
+    handles = [store.register(_device_batch(64, s)) for s in range(3)]
+    assert store.stats()["diskFilesLive"] >= 1
+    assert glob.glob(str(tmp_path / "spill-*.bin"))
+    # promote one: its file must be removed and the counter decremented
+    live_before = store.disk_files_live
+    handles[0].get()
+    assert store.disk_files_live < live_before + 1  # no double count
+    store.close()
+    assert store.stats()["diskFilesLive"] == 0
+    assert not glob.glob(str(tmp_path / "spill-*.bin"))
+    # a closed store's handles are released too
+    assert store.device_bytes == 0 and store.host_bytes == 0
+
+
+# ---------------------------------------------------------------------------
+# q1/q3-shaped parity sweeps under injected OOM
+# ---------------------------------------------------------------------------
+
+def _q1_shape(s):
+    """filter -> 2-key groupBy with sum/min/max/count over decimal-free
+    columns (the q1 silhouette at test scale)."""
+    df = s.createDataFrame(
+        gen_batch([("flag", KeyStringGen(cardinality=3)),
+                   ("status", SmallIntGen()),
+                   ("qty", LongGen()), ("price", IntegerGen())],
+                  3000, 11),
+        num_partitions=4)
+    return (df.filter(F.col("qty") % 5 != 0)
+            .groupBy("flag", "status")
+            .agg(F.sum("qty").alias("sq"), F.min("price").alias("mn"),
+                 F.max("price").alias("mx"), F.count("*").alias("c")))
+
+
+def _q3_shape(s):
+    """fact-dim join -> groupBy -> orderBy/limit (the q3 silhouette)."""
+    fact = s.createDataFrame(
+        gen_batch([("k", SmallIntGen()), ("item", IntegerGen()),
+                   ("amt", LongGen())], 2500, 12),
+        num_partitions=3)
+    dim = s.createDataFrame(
+        gen_batch([("item2", IntegerGen()),
+                   ("brand", KeyStringGen(cardinality=5))], 400, 13),
+        num_partitions=2)
+    return (fact.join(dim, fact["item"] == dim["item2"], "inner")
+            .groupBy("brand").agg(F.sum("amt").alias("sa"),
+                                  F.count("*").alias("c"))
+            .orderBy("brand").limit(50))
+
+
+OOM_SCHEDULES = ["3", "4:2", "seed:42:0.2"]
+
+
+@pytest.mark.parametrize("sched", OOM_SCHEDULES)
+def test_q1_shape_bit_identical_under_oom_sweep(sched):
+    plans = _run_clean_vs_injected(_q1_shape, _conf(sched))
+    assert _metric(plans, M.RETRY_COUNT) > 0, sched
+
+
+def test_q1_shape_split_and_retry():
+    """The split:N schedule forces TpuSplitAndRetryOOM: split-capable
+    sites (upload, fused stage, partial agg) must split — and both
+    counters must show activity."""
+    plans = _run_clean_vs_injected(_q1_shape, _conf("split:3"))
+    assert _metric(plans, M.SPLIT_RETRY_COUNT) > 0
+    assert _metric(plans, M.RETRY_COUNT) > 0  # retry-only sites degrade
+
+
+def test_exhaustion_escalates_into_split():
+    """Consecutive failures beyond maxRetries: with_retry exhausts and
+    with_split_retry escalates into halving instead of failing — the
+    halves then succeed once the failure streak is consumed."""
+    from spark_rapids_tpu.conf import TpuConf
+    conf = TpuConf({"spark.rapids.sql.retry.maxRetries": "2",
+                    "spark.rapids.sql.retry.backoffMs": "1",
+                    "spark.rapids.sql.retry.maxBackoffMs": "1"})
+    metrics = MetricRegistry()
+    b = _device_batch(32, seed=8)
+    state = {"fails": 4}
+
+    def fn(piece):
+        if state["fails"] > 0:
+            state["fails"] -= 1
+            raise R.TpuRetryOOM("synthetic alloc failure")
+        return piece
+
+    outs = R.with_split_retry(b, fn, conf, metrics)
+    # 4 failures vs 3 attempts (1 + maxRetries=2): the whole batch
+    # exhausted and split once; the last failure lands on the first
+    # half, whose retry then succeeds
+    assert metrics.value(M.SPLIT_RETRY_COUNT) == 1
+    assert metrics.value(M.RETRY_COUNT) == 3
+    assert len(outs) == 2
+    from spark_rapids_tpu.columnar.device import concat_device
+    got = concat_device(outs).to_host().to_pydict()
+    assert got == b.to_host().to_pydict()
+
+
+def test_split_oom_on_unsplittable_piece_degrades_to_retry():
+    """A split-demand on a piece that cannot shrink (single row) must
+    fall back to the plain spill+retry protocol instead of failing the
+    task outright (regression: an aggressive split:2 sweep used to
+    escape through the 1-row floor and kill the query)."""
+    from spark_rapids_tpu.conf import TpuConf
+    conf = TpuConf({"spark.rapids.sql.retry.backoffMs": "1",
+                    "spark.rapids.sql.retry.maxBackoffMs": "1"})
+    metrics = MetricRegistry()
+    b = _device_batch(1, seed=9)
+    state = {"fails": 2}
+
+    def fn(piece):
+        if state["fails"] > 0:
+            state["fails"] -= 1
+            raise R.TpuSplitAndRetryOOM("split demanded on 1-row piece")
+        return piece
+
+    outs = R.with_split_retry(b, fn, conf, metrics)
+    assert len(outs) == 1
+    assert metrics.value(M.SPLIT_RETRY_COUNT) == 0  # nothing could split
+    assert metrics.value(M.RETRY_COUNT) == 1  # degraded retry recovered
+    assert outs[0].to_host().to_pydict() == b.to_host().to_pydict()
+    # and when even the retry budget exhausts, the OOM still re-raises
+    state["fails"] = 10**6
+    with pytest.raises(R.TpuRetryOOM):
+        R.with_split_retry(b, fn, conf, metrics)
+
+
+@pytest.mark.parametrize("sched", ["3", "split:4"])
+def test_q3_shape_bit_identical_under_oom_sweep(sched):
+    plans = _run_clean_vs_injected(
+        _q3_shape, _conf(sched), ignore_order=False)
+    assert _metric(plans, M.RETRY_COUNT) > 0, sched
+
+
+def test_oom_sweep_with_tiny_budget_spills_on_retry():
+    """Injected OOM + a tiny device budget: retries must actually spill
+    the store down (spillBytesOnRetry > 0) and stay correct."""
+    conf = _conf("3", **{
+        "spark.rapids.memory.tpu.poolSize": str(256 << 10)})
+    plans = _run_clean_vs_injected(_q1_shape, conf)
+    assert _metric(plans, M.RETRY_COUNT) > 0
+    assert _metric(plans, M.SPILL_BYTES_ON_RETRY) > 0
+
+
+def test_oom_sweep_under_task_parallelism():
+    """Concurrent task threads share the injector and the store; the
+    sweep must stay bit-identical with permits correctly returned."""
+    conf = _conf("4", **{"spark.rapids.sql.taskParallelism": "3"})
+    plans = _run_clean_vs_injected(_q1_shape, conf)
+    assert _metric(plans, M.RETRY_COUNT) > 0
+    sem = resource._SEMAPHORE
+    if sem is not None:
+        assert sem._sem._value == sem.permits
+
+
+# ---------------------------------------------------------------------------
+# Semaphore-leak regression (satellite)
+# ---------------------------------------------------------------------------
+
+def test_semaphore_permits_restored_after_failed_query():
+    """A query that dies mid-drain (every allocation fails, beyond any
+    retry/split budget) must return every device permit: pool task
+    threads are discarded, so a leaked permit would shrink the
+    semaphore for the process lifetime."""
+    conf = _conf("1:1000000", **{
+        "spark.rapids.sql.retry.maxRetries": "1",
+        "spark.rapids.sql.taskParallelism": "2",
+    })
+    spark = TpuSparkSession(conf)
+    try:
+        with pytest.raises(Exception):
+            _q1_shape(spark)._execute()
+    finally:
+        spark.stop()
+    sem = resource._SEMAPHORE
+    assert sem is not None
+    assert sem._sem._value == sem.permits, (
+        f"leaked {sem.permits - sem._sem._value} device permit(s)")
+
+
+# ---------------------------------------------------------------------------
+# Reader IO retry (satellite)
+# ---------------------------------------------------------------------------
+
+def _write_parquet(tmp_path, spark):
+    path = str(tmp_path / "t")
+    df = spark.createDataFrame(
+        gen_batch([("k", SmallIntGen()), ("v", LongGen())], 1200, 14),
+        num_partitions=3)
+    df.write.mode("overwrite").parquet(path)
+    return path
+
+
+@pytest.mark.parametrize("reader_type", ["PERFILE", "MULTITHREADED"])
+def test_reader_retries_transient_io_errors(tmp_path, reader_type):
+    gen = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        path = _write_parquet(tmp_path, gen)
+    finally:
+        gen.stop()
+    R.reset_fault_injection()
+    conf = {
+        "spark.rapids.sql.enabled": "true",
+        "spark.rapids.sql.test.injectIOError": "2",
+        "spark.rapids.sql.reader.retryBackoffMs": "1",
+        "spark.rapids.sql.format.parquet.reader.type": reader_type,
+    }
+    spark = TpuSparkSession(conf)
+    try:
+        spark.start_capture()
+        got = spark.read.parquet(path).groupBy("k").agg(
+            F.sum("v").alias("s"))._execute().to_pydict()
+        plans = spark.get_captured_plans()
+    finally:
+        spark.stop()
+    assert _metric(plans, M.IO_RETRY_COUNT) > 0
+    # oracle: clean CPU read of the same files
+    R.reset_fault_injection()
+    cpu = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        want = cpu.read.parquet(path).groupBy("k").agg(
+            F.sum("v").alias("s"))._execute().to_pydict()
+    finally:
+        cpu.stop()
+    assert sorted(_rows(got), key=_sort_key) == \
+        sorted(_rows(want), key=_sort_key)
+
+
+def test_reader_reraises_original_after_exhaustion(tmp_path):
+    gen = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        path = _write_parquet(tmp_path, gen)
+    finally:
+        gen.stop()
+    R.reset_fault_injection()
+    conf = {
+        "spark.rapids.sql.enabled": "true",
+        "spark.rapids.sql.test.injectIOError": "1:1000000",
+        "spark.rapids.sql.reader.maxRetries": "2",
+        "spark.rapids.sql.reader.retryBackoffMs": "1",
+    }
+    spark = TpuSparkSession(conf)
+    try:
+        with pytest.raises(IOError, match="injected IO error"):
+            spark.read.parquet(path)._execute()
+    finally:
+        spark.stop()
+
+
+def test_mesh_sharded_streams_retry_io(tmp_path):
+    """The per-chip reader streams of the mesh scan go through the same
+    retry-wrapped decode."""
+    gen = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        path = _write_parquet(tmp_path, gen)
+    finally:
+        gen.stop()
+    R.reset_fault_injection()
+    conf = {
+        "spark.rapids.sql.enabled": "true",
+        "spark.rapids.shuffle.mode": "ici",
+        "spark.rapids.sql.test.injectIOError": "2",
+        "spark.rapids.sql.reader.retryBackoffMs": "1",
+    }
+    spark = TpuSparkSession(conf)
+    try:
+        spark.start_capture()
+        got = spark.read.parquet(path).repartition(4, "k").groupBy("k") \
+            .agg(F.sum("v").alias("s"))._execute().to_pydict()
+        plans = spark.get_captured_plans()
+    finally:
+        spark.stop()
+    assert _metric(plans, M.IO_RETRY_COUNT) > 0
+    R.reset_fault_injection()
+    cpu = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        want = cpu.read.parquet(path).groupBy("k").agg(
+            F.sum("v").alias("s"))._execute().to_pydict()
+    finally:
+        cpu.stop()
+    assert sorted(_rows(got), key=_sort_key) == \
+        sorted(_rows(want), key=_sort_key)
+
+
+# ---------------------------------------------------------------------------
+# Chip-failure injection -> graceful mesh degradation
+# ---------------------------------------------------------------------------
+
+def _ici_conf(chips: str, **extra):
+    conf = {
+        "spark.rapids.sql.enabled": "true",
+        "spark.rapids.shuffle.mode": "ici",
+        "spark.rapids.sql.test.injectChipFailure": chips,
+        "spark.rapids.sql.batchSizeRows": "256",
+    }
+    conf.update(extra)
+    return conf
+
+
+def _shuffle_query(s):
+    df = s.createDataFrame(
+        gen_batch([("k", SmallIntGen()), ("v", LongGen()),
+                   ("w", IntegerGen())], 3000, 15),
+        num_partitions=4)
+    return df.repartition(8, "k").groupBy("k").agg(
+        F.sum("v").alias("s"), F.count("w").alias("c"))
+
+
+def test_chip_failure_degrades_mesh_identical_results():
+    """One persistently failing chip: the exchange demotes it and the
+    query completes on the survivors, bit-identical, with
+    degradedChips > 0."""
+    import jax
+    assert len(jax.devices()) >= 2
+    chip = str(jax.devices()[1].id)
+    plans = _run_clean_vs_injected(_shuffle_query, _ici_conf(chip))
+    assert _metric(plans, M.DEGRADED_CHIPS) > 0
+    from spark_rapids_tpu.parallel import mesh as PM
+    assert PM.get_active_mesh() is None  # session cleaned up
+
+
+def test_chip_failures_degrade_to_single_chip():
+    """All but one chip failing persistently walks the whole ladder
+    down to single-chip in-process execution — never a failed query."""
+    import jax
+    devs = jax.devices()
+    assert len(devs) >= 2
+    chips = ",".join(str(d.id) for d in devs[:-1])
+    plans = _run_clean_vs_injected(_shuffle_query, _ici_conf(chips))
+    assert _metric(plans, M.DEGRADED_CHIPS) == len(devs) - 1
+
+
+def test_chip_failure_with_mesh_scan(tmp_path):
+    """Mesh-sharded scan + failing chip: the degraded re-plan re-shards
+    the reader streams over the survivors (scan + exchange demote
+    together)."""
+    gen = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        path = _write_parquet(tmp_path, gen)
+    finally:
+        gen.stop()
+    import jax
+    chip = str(jax.devices()[0].id)
+
+    def q(s):
+        return s.read.parquet(path).repartition(4, "k").groupBy("k") \
+            .agg(F.sum("v").alias("s"))
+
+    plans = _run_clean_vs_injected(q, _ici_conf(chip))
+    assert _metric(plans, M.DEGRADED_CHIPS) > 0
+
+
+def test_chip_failure_race_retries_not_reraises():
+    """execute_collect decides retry-vs-reraise against a pre-attempt
+    snapshot: a chip another thread demoted MID-attempt still retries
+    (regression: mark_chip_failed()==False used to re-raise and fail
+    the query on concurrent failures of the same chip); only a failure
+    on a chip demoted BEFORE the attempt began re-raises."""
+    from spark_rapids_tpu.parallel import mesh as PM
+    from spark_rapids_tpu.sql import physical as P
+    from spark_rapids_tpu.sql import types as T
+
+    class _StubPlan(P.PhysicalPlan):
+        def __init__(self, script):
+            self.children = []
+            self._script = list(script)
+
+        @property
+        def output(self):
+            return []
+
+        @property
+        def schema(self):
+            return T.StructType([])
+
+        def partitions(self):
+            step = self._script.pop(0)
+            if step == "ok":
+                return []
+            if step == "race":
+                # another thread demotes the chip before our raise lands
+                PM.mark_chip_failed(step_chip)
+            raise R.TpuChipFailure(step_chip)
+
+    step_chip = 3
+    with PM.active_mesh(PM.build_mesh()):
+        # plain failure -> demote -> retry -> ok
+        out = _StubPlan(["fail", "ok"]).execute_collect()
+        assert out.num_rows == 0
+        assert step_chip in PM.failed_chips()
+    with PM.active_mesh(PM.build_mesh()):
+        # demotion race mid-attempt -> still retries
+        out = _StubPlan(["race", "ok"]).execute_collect()
+        assert out.num_rows == 0
+    with PM.active_mesh(PM.build_mesh()):
+        # chip already demoted before the attempt -> failure is
+        # elsewhere: re-raise, bounded loop
+        PM.mark_chip_failed(step_chip)
+        with pytest.raises(R.TpuChipFailure):
+            _StubPlan(["fail"]).execute_collect()
+
+
+def test_degraded_mesh_state_resets_per_activation():
+    from spark_rapids_tpu.parallel import mesh as PM
+    with PM.active_mesh(PM.build_mesh()):
+        assert PM.mark_chip_failed(0)
+        assert not PM.mark_chip_failed(0)  # already demoted: no recount
+        assert PM.degraded_chip_count() == 1
+        hm = PM.healthy_mesh()
+        assert hm is not None
+        assert 0 not in [d.id for d in hm.devices.flat]
+    with PM.active_mesh(PM.build_mesh()):
+        assert PM.degraded_chip_count() == 0  # fresh activation
+        assert PM.healthy_mesh() is PM.get_active_mesh()
