@@ -277,28 +277,12 @@ def stage_breakdown(plans) -> dict:
 
 
 def collect_counters(plans, names) -> dict:
-    """Sum named metric counters across every exec (fused constituents
-    included) of the captured plans."""
-    out = {n: 0 for n in names}
-
-    def add(p):
-        ms = getattr(p, "metrics", None)
-        if ms is None:
-            return
-        snap = ms.snapshot()
-        for n in names:
-            out[n] += snap.get(n, 0)
-
-    def walk(p):
-        add(p)
-        for op in getattr(p, "fused_ops", []):
-            add(op)
-        for c in p.children:
-            walk(c)
-
-    for plan in plans or []:
-        walk(plan)
-    return out
+    """Named metric counters across every exec of the captured plans —
+    one registry_snapshot call (metrics.py owns the walk; fused
+    constituents included)."""
+    from spark_rapids_tpu.metrics import registry_snapshot
+    snap = registry_snapshot(plans)["metrics"]
+    return {n: snap.get(n, 0) for n in names}
 
 
 def decode_breakdown(plans) -> dict:
@@ -510,8 +494,55 @@ def run_robustness(clean_wall: float, cpu_rows) -> dict:
     return out
 
 
+def run_trace(clean_wall: float, cpu_rows) -> dict:
+    """q1 with span tracing on (docs/observability.md): emits one
+    Chrome-trace file per run under .bench-data/traces, reports the
+    per-chip occupancy + critical-path breakdown from the last run's
+    trace, and measures the tracing overhead against the untraced
+    wall (budget: <= 15% on the smoke input, tests/test_trace.py)."""
+    import glob
+
+    from spark_rapids_tpu import trace as TR
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    from spark_rapids_tpu.tools import analyze_trace
+    tdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".bench-data", "traces")
+    shutil.rmtree(tdir, ignore_errors=True)
+    TR.reset_tracing()
+    conf = dict(TPU_CONF)
+    conf["spark.rapids.sql.trace.enabled"] = "true"
+    conf["spark.rapids.sql.trace.dir"] = tdir
+    tpu = TpuSparkSession(conf)
+    try:
+        q = build_query(tpu)
+        run_once(q)  # jit compile warm-up
+        times, rows = [], None
+        for _ in range(2):
+            dt, rows = run_once(q)
+            times.append(dt)
+        assert_rows_match(cpu_rows, rows)
+        wall = min(times)
+        files = sorted(glob.glob(os.path.join(tdir, "trace-*.json")))
+        analysis = analyze_trace(files[-1]) if files else {}
+        return {
+            "skipped": False,
+            "wall_s": round(wall, 4),
+            "untraced_wall_s": round(clean_wall, 4),
+            "tracingOverhead": round(wall / clean_wall, 4),
+            "traceFiles": len(files),
+            "spanCount": analysis.get("spanCount", 0),
+            "criticalPath_s": analysis.get("criticalPath_s", {}),
+            "criticalPathIdle_s": analysis.get("criticalPathIdle_s", 0),
+            "occupancy": analysis.get("occupancy", {}),
+            "topSpans": analysis.get("topSpans", []),
+        }
+    finally:
+        tpu.stop()
+        TR.reset_tracing()
+
+
 def main():
-    from spark_rapids_tpu.jit_cache import cache_stats
+    from spark_rapids_tpu.metrics import registry_snapshot
     from spark_rapids_tpu.sql.session import TpuSparkSession
 
     gen = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
@@ -554,6 +585,13 @@ def main():
         robustness = {"skipped": True,
                       "reason": f"robustness leg failed: {e!r}"}
 
+    # span-tracing leg (docs/observability.md), equally fault-isolated
+    try:
+        trace_leg = run_trace(fused["wall_s"], cpu_rows)
+    except Exception as e:  # noqa: BLE001 - reported, not swallowed
+        trace_leg = {"skipped": True,
+                     "reason": f"trace leg failed: {e!r}"}
+
     cpu_t = min(cpu_times)
     tpu_t = fused["wall_s"]
     q3_tpu_t = fused["q3"]["wall_s"]
@@ -588,7 +626,8 @@ def main():
             },
             "multichip": multichip,
             "robustness": robustness,
-            "jitCaches": cache_stats(),
+            "trace": trace_leg,
+            "jitCaches": registry_snapshot()["jitCaches"],
             "tpcds_q3": {
                 "device_wall_s": round(q3_tpu_t, 4),
                 "cpu_engine_wall_s": round(q3_cpu_t, 4),

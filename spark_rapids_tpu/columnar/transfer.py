@@ -460,7 +460,15 @@ def prepare_upload(batch, cap: int):
 
 def finish_upload(staged, device: Optional[jax.Device] = None):
     """Device-side half: one device_put (+ one decode program on the
-    packed and encoded paths)."""
+    packed and encoded paths). Traced per staging mode with the target
+    chip, nested inside the R2C transition's copyToDeviceTime span."""
+    from spark_rapids_tpu import trace as _trace
+    with _trace.span("finishUpload", mode=staged[0],
+                     chip=(device.id if device is not None else None)):
+        return _finish_upload(staged, device)
+
+
+def _finish_upload(staged, device: Optional[jax.Device] = None):
     from spark_rapids_tpu.columnar import device as D
     if staged[0] == "direct":
         _tag, schema, n, spec, np_arrays = staged

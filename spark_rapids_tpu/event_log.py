@@ -34,7 +34,10 @@ def _collect_ops(physical) -> List[Dict[str, Any]]:
         }
         m = getattr(p, "metrics", None)
         if m is not None:
-            vals = {k: v.value for k, v in m.metrics.items() if v.value}
+            # ALL created metrics, zero-valued included: an op that saw
+            # 0 rows (or degradedChips=0) must be distinguishable from
+            # one whose metric was never created (v2 event format)
+            vals = {k: v.value for k, v in m.metrics.items()}
             if vals:
                 entry["metrics"] = vals
         ops.append(entry)
@@ -47,8 +50,7 @@ def _collect_ops(physical) -> List[Dict[str, Any]]:
                                   "fused": True}
             fm = getattr(op, "metrics", None)
             if fm is not None:
-                vals = {k: v.value for k, v in fm.metrics.items()
-                        if v.value}
+                vals = {k: v.value for k, v in fm.metrics.items()}
                 if vals:
                     fe["metrics"] = vals
             ops.append(fe)
@@ -58,9 +60,16 @@ def _collect_ops(physical) -> List[Dict[str, Any]]:
     return ops
 
 
+# event-line format version: 2 adds zero-valued metrics, the compact
+# conf snapshot, and the fault-injector summary; readers treat absent
+# version as 1 (read_events normalizes)
+EVENT_VERSION = 2
+
+
 def write_event(log_dir: str, session_id: int, physical,
                 rewrite_report, wall_s: float, rows: int,
-                store_stats: Optional[Dict[str, int]] = None) -> None:
+                store_stats: Optional[Dict[str, int]] = None,
+                conf=None) -> None:
     """Append one query-completion event; failures never break the
     query (observability must not take down execution)."""
     try:
@@ -70,6 +79,7 @@ def write_event(log_dir: str, session_id: int, physical,
             qid = _SEQ[0]
         rec: Dict[str, Any] = {
             "event": "queryCompleted",
+            "version": EVENT_VERSION,
             "ts": time.time(),
             "queryId": qid,
             "wallSeconds": round(wall_s, 6),
@@ -84,6 +94,16 @@ def write_event(log_dir: str, session_id: int, physical,
                 for name, reasons in rewrite_report.fallbacks]
         if store_stats:
             rec["storeStats"] = store_stats
+        if conf is not None:
+            # compact snapshot: only the session's EXPLICIT settings
+            # (defaults are derivable from the code version); enough to
+            # re-run the query's configuration offline
+            rec["conf"] = {k: str(v)
+                           for k, v in sorted(conf.settings.items())}
+            from spark_rapids_tpu.retry import get_fault_injector
+            inj = get_fault_injector(conf)
+            if inj is not None:
+                rec["faultInjector"] = inj.stats()
         path = os.path.join(
             log_dir, f"events-{os.getpid()}-{session_id}.jsonl")
         with _LOCK, open(path, "a") as f:
@@ -107,4 +127,7 @@ def read_events(path: str) -> Iterator[Dict[str, Any]]:
             for line in f:
                 line = line.strip()
                 if line:
-                    yield json.loads(line)
+                    ev = json.loads(line)
+                    # pre-versioning lines are format 1
+                    ev.setdefault("version", 1)
+                    yield ev

@@ -458,8 +458,11 @@ class TpuHashAggregateExec(TpuExec):
             lit_vals = (X.stage_literal_values(prelude_steps), lit_vals)
         cnt = None
         self.metrics.create(M.DISPATCH_COUNT, M.ESSENTIAL).add(1)
+        from spark_rapids_tpu import trace as TR
         from spark_rapids_tpu.parallel.mesh import record_chip_dispatch
         record_chip_dispatch(self.metrics, batch)
+        qt = TR._ACTIVE
+        chip = TR.chip_of(batch)  # None (no device query) when untraced
         import time as _time
         t0 = _time.perf_counter_ns()
         if mode in ("partial", "merge", "merge_partial"):
@@ -469,6 +472,11 @@ class TpuHashAggregateExec(TpuExec):
             out_cols, out_active = fn(batch.columns, batch.active,
                                       lit_vals)
         elapsed = _time.perf_counter_ns() - t0
+        if qt is not None:
+            # the same measurement feeds computeAggTime/stageCompileTime
+            # below — trace and metrics agree (docs/observability.md)
+            qt.add("TpuHashAggregateExec.dispatch", t0, t0 + elapsed,
+                   chip=chip, mode=mode, compile=bool(was_miss))
         if was_miss:
             # first call after a compile miss carries trace+XLA compile
             self.metrics.create(M.STAGE_COMPILE_TIME,

@@ -34,7 +34,13 @@ class TpuExec(P.PhysicalPlan):
 
     def __init__(self, conf: TpuConf):
         self.conf = conf
-        self.metrics = M.MetricRegistry(str(conf.get(METRICS_LEVEL)))
+        # owner labels this exec's trace spans "<Exec>.<metric>"
+        self.metrics = M.MetricRegistry(str(conf.get(METRICS_LEVEL)),
+                                        owner=type(self).__name__)
+        # pre-created so an op that saw 0 rows logs numOutputRows: 0 —
+        # distinguishable from a metric that never existed (event-log
+        # v2 contract, docs/observability.md)
+        self.metrics.create(M.NUM_OUTPUT_ROWS, M.ESSENTIAL)
 
     def device_partitions(self) -> List[DevicePartitionThunk]:
         raise NotImplementedError
@@ -188,7 +194,9 @@ class TpuRowToColumnarExec(TpuExec):
             # driver-level task retry) re-plans on the survivors
             R.chip_checkpoint(self.conf, device)
         try:
-            with metrics.timed(M.COPY_TO_DEVICE_TIME):
+            with metrics.timed(M.COPY_TO_DEVICE_TIME,
+                               chip=(device.id if device is not None
+                                     else None), rows=num_rows):
                 # mesh scan: each stream's batches land on THEIR chip
                 out = [R.with_retry(
                     lambda: finish_upload(staged, device),
@@ -244,7 +252,8 @@ class TpuColumnarToRowExec(P.PhysicalPlan):
     def __init__(self, child: TpuExec, conf: TpuConf):
         self.children = [child]
         self.conf = conf
-        self.metrics = M.MetricRegistry(str(conf.get(METRICS_LEVEL)))
+        self.metrics = M.MetricRegistry(str(conf.get(METRICS_LEVEL)),
+                                        owner=type(self).__name__)
 
     @property
     def child(self) -> TpuExec:

@@ -256,6 +256,14 @@ class TpuBroadcastExchangeExec(TpuExec):
 
     def materialize_device(self):
         from spark_rapids_tpu.columnar.device import concat_device
+        from spark_rapids_tpu.resource import get_semaphore
+        # consumers touch the device with the broadcast batch: take the
+        # permit BEFORE the build lock — a permit-holder blocked on the
+        # lock while the lock-holder waits for a permit would deadlock
+        # at concurrentGpuTasks=1 — and time the wait against the
+        # broadcast's own registry (the per-task collect path was the
+        # only one metered before)
+        get_semaphore(self.conf).acquire_if_necessary(self.metrics)
         with self._lock:
             if self._built is None:
                 self.metrics.create("broadcastBuilds", M.ESSENTIAL).add(1)
@@ -321,6 +329,11 @@ class TpuShuffleExchangeExec(TpuExec):
 
         def pull(thunk):
             try:
+                # bill the drain thread's permit wait to the EXCHANGE
+                # (semaphoreWaitTime span + metric): the lazy acquire
+                # inside the child's R2C books it against the upload,
+                # hiding exchange-drain contention from the breakdown
+                sem.acquire_if_necessary(self.metrics)
                 return [split_one(b) for b in thunk()]
             finally:
                 # pool threads acquire the TpuSemaphore inside the child
@@ -354,9 +367,12 @@ class TpuShuffleExchangeExec(TpuExec):
             # graceful degradation (docs/robustness.md): demote the
             # failed chip, then re-execute the subtree on the surviving
             # mesh — single-chip/in-process once too few chips remain
+            from spark_rapids_tpu import trace as TR
             from spark_rapids_tpu.retry import degrade_on_chip_failure
-            cache = degrade_on_chip_failure(self._materialize_inner,
-                                            self.metrics)
+            with TR.span("exchangeMaterialize",
+                         parts=self.partitioning.num_partitions):
+                cache = degrade_on_chip_failure(self._materialize_inner,
+                                                self.metrics)
             from spark_rapids_tpu.conf import SHUFFLE_MODE
             if str(self.conf.get(SHUFFLE_MODE)).lower() == "external":
                 cache = self._external_roundtrip(cache)

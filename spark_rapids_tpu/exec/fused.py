@@ -161,9 +161,15 @@ class TpuFusedStageExec(TpuExec):
         has_filter = any(k == "filter" for k, _ in steps)
         window_n = max(1, int(self.conf.get(STAGE_FUSION_MAX_IN_FLIGHT)))
         metrics = self.metrics
+        stage_label = "+".join(op.simple_string().split()[0]
+                               for op in self.fused_ops)
+        import itertools
+        bseq = itertools.count()  # thread-safe-enough batch ids (GIL)
 
         def run_one(b: DeviceBatch) -> DeviceBatch:
             import time as _time
+
+            from spark_rapids_tpu import trace as TR
             # per-batch: a batch whose pytree repeats a buffer (range
             # validity aliasing active) must use the non-donating
             # program variant
@@ -173,6 +179,8 @@ class TpuFusedStageExec(TpuExec):
             # read their placement
             from spark_rapids_tpu.parallel.mesh import record_chip_dispatch
             record_chip_dispatch(metrics, b)
+            qt = TR._ACTIVE
+            chip = TR.chip_of(b)  # None (no device query) when untraced
             fn, was_miss = _STAGE_CACHE.get_or_build(
                 (skey, donate), lambda: X.build_stage_fn(steps, donate))
             mirror_to_metrics(_STAGE_CACHE, metrics, was_miss)
@@ -181,7 +189,14 @@ class TpuFusedStageExec(TpuExec):
             nrows_dev = None if has_filter else b._num_rows_dev
             t0 = _time.perf_counter_ns()
             cols, active, err = fn(b.columns, b.active, lits)
-            elapsed = _time.perf_counter_ns() - t0
+            t1 = _time.perf_counter_ns()
+            elapsed = t1 - t0
+            # the SAME measurement feeds the metric channel and the
+            # trace span — one set of numbers (docs/observability.md)
+            if qt is not None:
+                qt.add("TpuFusedStageExec.dispatch", t0, t1,
+                       batch=next(bseq), chip=chip, stage=stage_label,
+                       compile=bool(was_miss))
             # a miss's first call carries trace+XLA-compile on top of
             # the dispatch: book it as compile wall; otherwise the wall
             # is fanned back to the constituents ONLY (the fused node

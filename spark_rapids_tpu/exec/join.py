@@ -154,7 +154,8 @@ class TpuShuffledHashJoinExec(TpuExec):
                 out = X.run_filter(cond, out)
             return out
 
-        with self.metrics.timed(M.JOIN_TIME):
+        from spark_rapids_tpu import trace as TR
+        with self.metrics.timed(M.JOIN_TIME, chip=TR.chip_of(lwhole)):
             out = R.with_retry(attempt, self.conf, self.metrics)
         if out._num_rows is not None:
             # known counts only: fetching one here would be a blocking
@@ -404,7 +405,8 @@ class TpuShuffledHashJoinExec(TpuExec):
                   else lbatches[0])
         rwhole = self._align_build(lwhole, rwhole)
         from spark_rapids_tpu import retry as R
-        with self.metrics.timed(M.JOIN_TIME):
+        from spark_rapids_tpu import trace as TR
+        with self.metrics.timed(M.JOIN_TIME, chip=TR.chip_of(lwhole)):
             out, matched = R.with_retry(
                 lambda: device_join(lwhole, rwhole, lk, rk, chunk_type,
                                     out_schema, collect_matched_r=True,

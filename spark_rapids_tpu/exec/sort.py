@@ -142,7 +142,9 @@ class TpuSortExec(TpuExec):
                     whole = (batches[0] if len(batches) == 1
                              else concat_device(batches))
                     from spark_rapids_tpu import retry as R
-                    with metrics.timed(M.SORT_TIME):
+                    from spark_rapids_tpu import trace as TR
+                    with metrics.timed(M.SORT_TIME,
+                                       chip=TR.chip_of(whole)):
                         out = R.with_retry(
                             lambda: sorted_batch(self.order, bound,
                                                  whole, limit),
@@ -174,7 +176,9 @@ class TpuSortExec(TpuExec):
                     for h in handles:
                         h.close()
                     from spark_rapids_tpu import retry as R
-                    with metrics.timed(M.SORT_TIME):
+                    from spark_rapids_tpu import trace as TR
+                    with metrics.timed(M.SORT_TIME,
+                                       chip=TR.chip_of(whole)):
                         # retry-only: a sort is not row-splittable (the
                         # out-of-core rank-split path IS the split story)
                         out = R.with_retry(
@@ -230,7 +234,8 @@ class TpuSortExec(TpuExec):
             whole = parts[0] if len(parts) == 1 else concat_device(parts)
             for h in buckets[pid]:
                 h.close()
-            with metrics.timed(M.SORT_TIME):
+            from spark_rapids_tpu import trace as TR
+            with metrics.timed(M.SORT_TIME, chip=TR.chip_of(whole)):
                 out = R.with_retry(
                     lambda w=whole: sorted_batch(self.order, bound, w,
                                                  -1),

@@ -495,7 +495,8 @@ class CpuFileScanExec(P.PhysicalPlan):
         self.conf = conf
         # decodeTime/convertTime surface in the bench stage breakdown —
         # round-4 verdict: the dominant cost must never be invisible
-        self.metrics = M.MetricRegistry(str(conf.get(METRICS_LEVEL)))
+        self.metrics = M.MetricRegistry(str(conf.get(METRICS_LEVEL)),
+                                        owner="FileScan")
         listed = list_files(paths)
         self.files = [f for f, _ in listed]
         part_names = {k for _f, pv in listed for k in pv}
@@ -592,7 +593,8 @@ class CpuFileScanExec(P.PhysicalPlan):
 
         def decode(u: ScanUnit):
             from spark_rapids_tpu import retry as R
-            with metrics.timed_wall("decodeTime"):
+            with metrics.timed_wall("decodeTime", path=u.path,
+                                    bytes=u.size_bytes):
                 # transient IO errors retry with bounded exponential
                 # backoff (spark.rapids.sql.reader.maxRetries /
                 # retryBackoffMs), re-raising the original after
@@ -626,7 +628,7 @@ class CpuFileScanExec(P.PhysicalPlan):
                 # all-fallback run for "nothing to decode"
                 metrics.create("deviceFallbackUnits").add(1)
                 return None
-            with metrics.timed_wall("deviceDecodeTime"):
+            with metrics.timed_wall("deviceDecodeTime", path=u.path):
                 try:
                     enc = DD.plan_unit_encoded(u, data_schema)
                 except Exception:

@@ -43,6 +43,11 @@ class JitCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        # per-thread (miss time, key) so the build between a miss and
+        # its put traces as one `compile` span (best-effort: only the
+        # get->put pattern on one thread is covered, which is every
+        # caller in the package)
+        self._miss_tls = threading.local()
         with _REG_LOCK:
             _CACHES[name] = self
 
@@ -52,12 +57,25 @@ class JitCache:
             val = self._data.get(key)
             if val is None:
                 self.misses += 1
+                from spark_rapids_tpu import trace as _trace
+                if _trace._ACTIVE is not None:
+                    import time
+                    self._miss_tls.pending = (time.perf_counter_ns(), key)
                 return None
             self._data.move_to_end(key)
             self.hits += 1
             return val
 
     def put(self, key, value) -> Any:
+        pending = getattr(self._miss_tls, "pending", None)
+        if pending is not None and pending[1] == key:
+            self._miss_tls.pending = None
+            from spark_rapids_tpu import trace as _trace
+            qt = _trace._ACTIVE
+            if qt is not None:
+                import time
+                qt.add("compile", pending[0], time.perf_counter_ns(),
+                       cache=self.name)
         with self._lock:
             self._data[key] = value
             self._data.move_to_end(key)

@@ -187,8 +187,10 @@ class DeviceStore:
             assert st is not None and not st.closed, \
                 "SpillableBatch used after close"
             if st.tier == TIER_DISK:
+                from spark_rapids_tpu import trace as _trace
                 from spark_rapids_tpu.columnar import serde
-                with open(st.disk_path, "rb") as f:
+                with _trace.span("promoteFromDisk"), \
+                        open(st.disk_path, "rb") as f:
                     st.host = serde.deserialize_batch(f.read())
                 os.unlink(st.disk_path)
                 self.disk_files_live -= 1
@@ -200,7 +202,9 @@ class DeviceStore:
                 if self.debug:
                     _log.info("promote host->device: %d bytes",
                               st.host_bytes)
-                st.device = DeviceBatch.from_host(st.host)
+                from spark_rapids_tpu import trace as _trace
+                with _trace.span("promoteToDevice", bytes=st.host_bytes):
+                    st.device = DeviceBatch.from_host(st.host)
                 self.host_bytes -= st.host_bytes
                 st.host, st.host_bytes = None, 0
                 st.tier = TIER_DEVICE
@@ -235,7 +239,9 @@ class DeviceStore:
             _log.info("spill device->host: %d bytes (pool %d/%d)",
                       st.device_bytes, self.device_bytes,
                       self.device_budget)
-        st.host = st.device.to_host()
+        from spark_rapids_tpu import trace as _trace
+        with _trace.span("spillToHost", bytes=st.device_bytes):
+            st.host = st.device.to_host()
         st.rows = st.host.num_rows
         st.device = None
         self.device_bytes -= st.device_bytes
@@ -254,8 +260,10 @@ class DeviceStore:
         path = os.path.join(
             self.spill_dir,
             f"{self._file_prefix}-{uuid.uuid4().hex[:16]}.bin")
+        from spark_rapids_tpu import trace as _trace
         from spark_rapids_tpu.columnar import serde
-        with open(path, "wb") as f:
+        with _trace.span("spillToDisk", bytes=st.host_bytes), \
+                open(path, "wb") as f:
             f.write(serde.serialize_batch(st.host, self.codec))
         self.host_bytes -= st.host_bytes
         st.host, st.host_bytes = None, 0

@@ -3,6 +3,13 @@
 Three verbosity levels (ESSENTIAL/MODERATE/DEBUG) gated by
 ``spark.rapids.sql.metrics.level``; each Tpu exec owns a named metric map
 surfaced by ``TpuExec.metrics``. Timers are wall-clock nanoseconds.
+
+Every ``timed``/``timed_wall`` scope also mirrors its interval into the
+active span tracer (spark_rapids_tpu/trace.py) as a span named
+``<owner>.<metric>`` — the trace, the event log, and the profiler read
+the SAME measurement, so the three can never disagree
+(docs/observability.md). When tracing is off the mirror is a single
+module-global None check.
 """
 
 from __future__ import annotations
@@ -10,8 +17,11 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
+import weakref
 from dataclasses import dataclass, field
-from typing import Dict, Iterator
+from typing import Any, Dict, Iterator
+
+from spark_rapids_tpu import trace as _trace
 
 ESSENTIAL = 0
 MODERATE = 1
@@ -89,14 +99,23 @@ class TpuMetric:
                 self.value += time.perf_counter_ns() - self._wall_start
 
 
+# every live registry, for registry_snapshot(); weak so plans release
+# their metrics with themselves
+_REGISTRIES: "weakref.WeakSet[MetricRegistry]" = weakref.WeakSet()
+
+
 class MetricRegistry:
     """Per-exec metric map; creation is gated by the configured level so
-    disabled metrics cost a no-op (the reference wraps them in NoopMetric)."""
+    disabled metrics cost a no-op (the reference wraps them in NoopMetric).
+    ``owner`` labels this registry's spans in the trace (the exec class
+    name)."""
 
-    def __init__(self, conf_level: str = "MODERATE"):
+    def __init__(self, conf_level: str = "MODERATE", owner: str = ""):
         self.enabled_level = _LEVELS.get(conf_level.upper(), MODERATE)
         self.metrics: Dict[str, TpuMetric] = {}
+        self.owner = owner
         self._lock = threading.Lock()
+        _REGISTRIES.add(self)
 
     def create(self, name: str, level: int = MODERATE) -> TpuMetric:
         with self._lock:  # check-then-set must be atomic across tasks
@@ -114,32 +133,80 @@ class MetricRegistry:
         m = self.metrics.get(name)
         return m.value if m else 0
 
+    def _span_kind(self, name: str) -> str:
+        return f"{self.owner}.{name}" if self.owner else name
+
     @contextlib.contextmanager
-    def timed(self, name: str, level: int = MODERATE) -> Iterator[None]:
+    def timed(self, name: str, level: int = MODERATE,
+              **attrs) -> Iterator[None]:
         m = self.create(name, level)
+        qt = _trace._ACTIVE
         t0 = time.perf_counter_ns()
         try:
             yield
         finally:
-            m.add(time.perf_counter_ns() - t0)
+            t1 = time.perf_counter_ns()
+            m.add(t1 - t0)
+            if qt is not None:
+                qt.add(self._span_kind(name), t0, t1, **attrs)
 
     @contextlib.contextmanager
-    def timed_wall(self, name: str, level: int = MODERATE
-                   ) -> Iterator[None]:
+    def timed_wall(self, name: str, level: int = MODERATE,
+                   **attrs) -> Iterator[None]:
         """Union-of-intervals timer: when N pool threads run the same
         phase concurrently, the metric advances by WALL time, not by N
         stacked thread-times, so a stage breakdown sums against the
         query wall sensibly (round-5 issue: q1's drain metric read
-        11.6s against a 5.4s wall)."""
+        11.6s against a 5.4s wall). The mirrored trace span is this
+        THREAD's interval — the trace shows per-thread lanes, the
+        metric their union."""
         m = self.create(name, level)
+        qt = _trace._ACTIVE
+        t0 = time.perf_counter_ns()
         m.enter_wall()
         try:
             yield
         finally:
             m.exit_wall()
+            if qt is not None:
+                qt.add(self._span_kind(name), t0,
+                       time.perf_counter_ns(), **attrs)
 
     def snapshot(self) -> Dict[str, int]:
         return {k: m.value for k, m in self.metrics.items()}
+
+
+def registry_snapshot(plans=None) -> Dict[str, Any]:
+    """Every metric as ONE dict: ``{"metrics": {name: summed value},
+    "jitCaches": {cache: stats}}``. With ``plans`` given (captured
+    physical plans), only their registries contribute — fused-stage
+    constituents and children included — which is the bench's scraping
+    shape; with None, every live registry in the process contributes
+    (cross-query totals)."""
+    vals: Dict[str, int] = {}
+
+    def add_reg(ms) -> None:
+        for k, v in ms.snapshot().items():
+            vals[k] = vals.get(k, 0) + v
+
+    if plans is None:
+        for ms in list(_REGISTRIES):
+            add_reg(ms)
+    else:
+        def walk(p) -> None:
+            ms = getattr(p, "metrics", None)
+            if ms is not None:
+                add_reg(ms)
+            for op in getattr(p, "fused_ops", []):
+                fm = getattr(op, "metrics", None)
+                if fm is not None:
+                    add_reg(fm)
+            for c in getattr(p, "children", []):
+                walk(c)
+        for plan in plans or []:
+            walk(plan)
+    from spark_rapids_tpu.jit_cache import cache_stats
+    return {"metrics": vals, "jitCaches": cache_stats()}
 
 
 def sum_plan_metrics(plans, prefix: str) -> Dict[str, int]:

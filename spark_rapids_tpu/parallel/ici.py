@@ -213,6 +213,12 @@ def pad_batch(b: DeviceBatch, cap: int,
 
 
 def stack_batches(slots: Sequence[DeviceBatch], mesh: Mesh):
+    from spark_rapids_tpu import trace as _trace
+    with _trace.span("meshStack", slots=len(slots)):
+        return _stack_batches(slots, mesh)
+
+
+def _stack_batches(slots: Sequence[DeviceBatch], mesh: Mesh):
     """Pad each per-chip batch to the common bucketed capacity ON ITS
     CHIP, then assemble global arrays sharded over the mesh's shuffle
     axis directly from the per-device shards
@@ -272,9 +278,11 @@ def mesh_exchange(slots: Sequence[DeviceBatch],
     # fetch) size the send blocks proportionally to real occupancy —
     # without it every block is worst-case cap and staging grows
     # n_dev x cap per chip (VERDICT r3 weak #6)
-    counts = np.asarray(_dest_counts_fn(
-        mesh, tuple(bound_exprs), n_parts, metrics)(
-        stacked_cols, stacked_active, lit_vals))
+    from spark_rapids_tpu import trace as _trace
+    with _trace.span("meshSizeExchange"):
+        counts = np.asarray(_dest_counts_fn(
+            mesh, tuple(bound_exprs), n_parts, metrics)(
+            stacked_cols, stacked_active, lit_vals))
     if metrics is not None:
         # cross-chip padding overhead: rows staged for the collective
         # beyond the active ones (slots pad to the global max bucket)
@@ -282,8 +290,9 @@ def mesh_exchange(slots: Sequence[DeviceBatch],
             n_dev * cap - int(counts.sum()))
     block_cap = min(cap, bucket_capacity(max(1, int(counts.max()))))
     fn = exchange_fn(mesh, bound_exprs, n_parts, block_cap, metrics)
-    recv_cols, recv_pids, recv_act = fn(stacked_cols, stacked_active,
-                                        lit_vals)
+    with _trace.span("meshExchange", nDev=n_dev, blockCap=block_cap):
+        recv_cols, recv_pids, recv_act = fn(stacked_cols, stacked_active,
+                                            lit_vals)
     # recv leaves: [n_dev(owner), n_src, block, ...]; land each owner
     # chip's block through the shared sort-split (one counts sync per
     # chip, no per-partition round trips)

@@ -67,16 +67,24 @@ class TpuSemaphore:
     def acquire_if_necessary(self, metrics=None) -> None:
         """Idempotent while held (GpuSemaphore.acquireIfNecessary): repeated
         acquires on the same thread do NOT nest, so a single release frees
-        the permit regardless of how many uploads the task performed."""
+        the permit regardless of how many uploads the task performed.
+        The wait is recorded as semaphoreWaitTime on ``metrics`` (the
+        per-task collect path, the broadcast build, and the exchange
+        drain all pass their registry) and as a span in the active
+        trace."""
         import time
         if getattr(self._held, "count", 0) > 0:
             return
         t0 = time.perf_counter_ns()
         self._sem.acquire()
+        t1 = time.perf_counter_ns()
         if metrics is not None:
             from spark_rapids_tpu import metrics as M
-            metrics.create(M.SEMAPHORE_WAIT_TIME).add(
-                time.perf_counter_ns() - t0)
+            metrics.create(M.SEMAPHORE_WAIT_TIME).add(t1 - t0)
+        from spark_rapids_tpu import trace as _trace
+        qt = _trace._ACTIVE
+        if qt is not None:
+            qt.add("semaphoreWait", t0, t1)
         self._held.count = 1
 
     def release_if_necessary(self) -> None:

@@ -287,6 +287,8 @@ def degrade_on_chip_failure(attempt: Callable[[], T],
         except TpuChipFailure as e:
             if e.chip_id in already:
                 raise
+            from spark_rapids_tpu import trace as TR
+            TR.instant("chipFailure", chip=e.chip_id)
             if mark_chip_failed(e.chip_id) and metrics is not None:
                 metrics.create(M.DEGRADED_CHIPS, M.ESSENTIAL).add(1)
 
@@ -317,7 +319,14 @@ def _recover(conf, metrics, attempt: int, backoff_ms: int,
              max_backoff_ms: int) -> None:
     """One OOM recovery step: spill the device store down (the
     DeviceMemoryEventHandler.onAllocFailure role), then block for a
-    bounded exponential backoff so concurrent tasks' frees land."""
+    bounded exponential backoff so concurrent tasks' frees land. Traced
+    as an instant ``retryOOM`` marker plus a nested ``retryBlock`` span
+    over the SAME interval the retryBlockTime metric reads — the
+    offline analyzer subtracts the nested span from enclosing operator
+    spans, undoing the documented retryBlockTime-inside-opTime double
+    count at the reporting layer (docs/observability.md)."""
+    from spark_rapids_tpu import trace as TR
+    TR.instant("retryOOM", attempt=attempt)
     t0 = time.perf_counter_ns()
     freed = 0
     with suppress_injection():
@@ -333,12 +342,15 @@ def _recover(conf, metrics, attempt: int, backoff_ms: int,
         delay = min(backoff_ms * (1 << (attempt - 1)), max_backoff_ms)
         if delay > 0:
             time.sleep(delay / 1000.0)
+    t1 = time.perf_counter_ns()
+    qt = TR._ACTIVE
+    if qt is not None:
+        qt.add("retryBlock", t0, t1, attempt=attempt, freedBytes=freed)
     if metrics is not None:
         metrics.create(M.RETRY_COUNT, M.ESSENTIAL).add(1)
         if freed:
             metrics.create(M.SPILL_BYTES_ON_RETRY, M.ESSENTIAL).add(freed)
-        metrics.create(M.RETRY_BLOCK_TIME).add(
-            time.perf_counter_ns() - t0)
+        metrics.create(M.RETRY_BLOCK_TIME).add(t1 - t0)
 
 
 def with_retry(fn: Callable[[], T], conf=None, metrics=None, *,
@@ -445,6 +457,8 @@ def _split_piece(b, split, metrics) -> Optional[list]:
         return None
     if metrics is not None:
         metrics.create(M.SPLIT_RETRY_COUNT, M.ESSENTIAL).add(1)
+    from spark_rapids_tpu import trace as TR
+    TR.instant("splitRetry", pieces=len(halves))
     return halves
 
 
@@ -474,6 +488,8 @@ def io_with_retry(fn: Callable[[], T], conf=None, metrics=None,
             attempt += 1
             if attempt > max_retries:
                 raise first_err
+            from spark_rapids_tpu import trace as TR
+            TR.instant("ioRetry", path=path, attempt=attempt)
             if metrics is not None:
                 metrics.create(M.IO_RETRY_COUNT, M.ESSENTIAL).add(1)
             t0 = time.perf_counter_ns()

@@ -165,6 +165,7 @@ class TpuSparkSession:
     def execute_plan(self, plan: L.LogicalPlan) -> HostBatch:
         import time as _time
 
+        from spark_rapids_tpu import trace as TR
         from spark_rapids_tpu.conf import EVENT_LOG_DIR, TASK_PARALLELISM
         if self.conf_obj.sql_enabled:
             # re-assert THIS session's kernel flags before executing:
@@ -174,10 +175,22 @@ class TpuSparkSession:
             from spark_rapids_tpu.conf import HAS_NANS
             from spark_rapids_tpu.ops import groupby as _G
             _G.set_has_nans(bool(self.conf_obj.get(HAS_NANS)))
-        physical = self.plan_physical(plan)
-        t0 = _time.perf_counter()
-        result = physical.execute_collect(
-            int(self.conf_obj.get(TASK_PARALLELISM)))
+        # span tracing (docs/observability.md): the trace scope opens
+        # BEFORE planning so compile spans and scalar-subquery execution
+        # (nested execute_plan calls fold into this query's trace) are
+        # attributed; one Chrome-trace file per sampled query
+        tok = TR.begin_query(self.conf_obj)
+        try:
+            physical = self.plan_physical(plan)
+            t0 = _time.perf_counter()
+            result = physical.execute_collect(
+                int(self.conf_obj.get(TASK_PARALLELISM)))
+            wall_s = _time.perf_counter() - t0
+        except BaseException:
+            TR.end_query(self.conf_obj, tok, error=True)
+            raise
+        TR.end_query(self.conf_obj, tok, wall_s=wall_s,
+                     rows=result.num_rows)
         log_dir = str(self.conf_obj.get(EVENT_LOG_DIR))
         if log_dir:
             from spark_rapids_tpu import event_log, memory
@@ -185,8 +198,9 @@ class TpuSparkSession:
             event_log.write_event(
                 log_dir, id(self) & 0xFFFF, physical,
                 self.last_rewrite_report,
-                _time.perf_counter() - t0, result.num_rows,
-                store.stats() if store is not None else None)
+                wall_s, result.num_rows,
+                store.stats() if store is not None else None,
+                conf=self.conf_obj)
         return result
 
     def explain_string(self, plan: L.LogicalPlan, physical=None) -> str:

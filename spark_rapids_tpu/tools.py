@@ -209,33 +209,273 @@ def profile_log(log_path: str) -> str:
     return "\n".join(lines)
 
 
+# -- offline trace analysis -------------------------------------------------
+# (the span-trace half of the profiling tool: critical path, exclusive
+# self-time, per-chip occupancy over one query's Chrome-trace file —
+# docs/observability.md explains how to read each section)
+
+def _trace_bounds(spans: List[dict]) -> Tuple[float, float]:
+    t0 = min(s["t0"] for s in spans)
+    t1 = max(s["t1"] for s in spans)
+    return t0, max(t1, t0 + 1e-9)
+
+
+def critical_path(spans: List[dict]) -> Tuple[Dict[str, float], float]:
+    """Backward walk from the last span end to the first span start: at
+    each point the *most immediate* covering span (the one with the
+    latest start) owns the segment; where nothing covers, the gap is
+    idle. Returns (microseconds attributed per span name, idle us) —
+    the chain of work that determined the query wall, so shrinking
+    anything NOT on it cannot speed the query up."""
+    if not spans:
+        return {}, 0.0
+    import heapq
+    t_begin, t_end = _trace_bounds(spans)
+    desc = sorted(spans, key=lambda s: -s["t1"])
+    attr: Dict[str, float] = {}
+    idle = 0.0
+    heap: List[Tuple[float, int]] = []  # (-t0, index into desc)
+    i = 0
+    cur = t_end
+    while cur > t_begin + 1e-9:
+        while i < len(desc) and desc[i]["t1"] >= cur - 1e-9:
+            heapq.heappush(heap, (-desc[i]["t0"], i))
+            i += 1
+        # a span whose t0 >= cur can never cover this or any smaller cur
+        while heap and -heap[0][0] >= cur - 1e-9:
+            heapq.heappop(heap)
+        if heap:
+            neg_t0, idx = heap[0]
+            s = desc[idx]
+            seg_start = max(-neg_t0, t_begin)
+            attr[s["name"]] = attr.get(s["name"], 0.0) + (cur - seg_start)
+            cur = seg_start
+        elif i < len(desc):
+            nxt = min(cur, max(desc[i]["t1"], t_begin))
+            idle += cur - nxt
+            cur = nxt
+        else:
+            idle += cur - t_begin
+            cur = t_begin
+    return attr, idle
+
+
+def exclusive_times(spans: List[dict]) -> Dict[str, Dict[str, float]]:
+    """Per span name: count, total us, and EXCLUSIVE us (total minus
+    directly nested child spans on the same lane). This undoes
+    double counting at the reporting layer — e.g. the ``retryBlock``
+    span nested inside an operator's timer span is subtracted from the
+    operator's self-time, fixing the documented retryBlockTime-inside-
+    opTime overlap (docs/robustness.md)."""
+    out: Dict[str, Dict[str, float]] = {}
+    by_tid: Dict[int, List[dict]] = {}
+    for s in spans:
+        by_tid.setdefault(s["tid"], []).append(s)
+    for ss in by_tid.values():
+        ss.sort(key=lambda s: (s["t0"], -(s["t1"] - s["t0"])))
+        stack: List[dict] = []
+        for s in ss:
+            s["_child"] = 0.0
+            while stack and stack[-1]["t1"] <= s["t0"] + 1e-9:
+                stack.pop()
+            if stack:
+                stack[-1]["_child"] += s["t1"] - s["t0"]
+            stack.append(s)
+        for s in ss:
+            d = out.setdefault(s["name"],
+                               {"count": 0, "total": 0.0,
+                                "exclusive": 0.0})
+            d["count"] += 1
+            dur = s["t1"] - s["t0"]
+            d["total"] += dur
+            d["exclusive"] += max(0.0, dur - s.pop("_child"))
+    return out
+
+
+def chip_occupancy(spans: List[dict]) -> Dict[int, Dict]:
+    """Busy/idle per chip from chip-attributed spans (uploads,
+    dispatches): merged busy intervals, occupancy over the trace
+    window, and the top idle gaps (mesh skew shows up here)."""
+    t_begin, t_end = _trace_bounds(spans) if spans else (0.0, 1.0)
+    per: Dict[int, List[Tuple[float, float]]] = {}
+    for s in spans:
+        chip = s.get("args", {}).get("chip")
+        if chip is not None:
+            per.setdefault(int(chip), []).append((s["t0"], s["t1"]))
+    out: Dict[int, Dict] = {}
+    for chip, ivs in sorted(per.items()):
+        ivs.sort()
+        merged: List[List[float]] = []
+        for a, b in ivs:
+            if merged and a <= merged[-1][1]:
+                merged[-1][1] = max(merged[-1][1], b)
+            else:
+                merged.append([a, b])
+        busy = sum(b - a for a, b in merged)
+        gaps = []
+        prev = t_begin
+        for a, b in merged:
+            if a > prev:
+                gaps.append((prev, a - prev))
+            prev = max(prev, b)
+        if t_end > prev:
+            gaps.append((prev, t_end - prev))
+        gaps.sort(key=lambda g: -g[1])
+        out[chip] = {
+            "busy_us": round(busy, 1),
+            "occupancy": round(busy / (t_end - t_begin), 4),
+            "dispatches": len(ivs),
+            "topIdleGaps_us": [round(g[1], 1) for g in gaps[:3]],
+        }
+    return out
+
+
+def top_spans(spans: List[dict], n: int = 10) -> List[dict]:
+    ranked = sorted(spans, key=lambda s: -(s["t1"] - s["t0"]))[:n]
+    return [{"name": s["name"], "dur_us": round(s["t1"] - s["t0"], 1),
+             "t0_us": round(s["t0"], 1), "tid": s["tid"],
+             "args": s.get("args", {})} for s in ranked]
+
+
+def analyze_trace(path: str) -> Dict:
+    """Machine-readable analysis of one trace file (bench detail.trace
+    consumes this)."""
+    from spark_rapids_tpu.trace import load_trace
+    tr = load_trace(path)
+    spans = tr["spans"]
+    out: Dict = {"file": path, "meta": tr["meta"],
+                 "spanCount": len(spans),
+                 "instantCount": len(tr["instants"])}
+    if not spans:
+        return out
+    cp, idle = critical_path(spans)
+    total = sum(cp.values()) + idle
+    out["criticalPath_s"] = {
+        k: round(v / 1e6, 4)
+        for k, v in sorted(cp.items(), key=lambda kv: -kv[1])}
+    out["criticalPathIdle_s"] = round(idle / 1e6, 4)
+    out["criticalPathSpan_s"] = round(total / 1e6, 4)
+    out["occupancy"] = chip_occupancy(spans)
+    out["topSpans"] = top_spans(spans, 5)
+    return out
+
+
+def format_trace_report(path: str, top: int = 10) -> str:
+    """Human-readable trace report (the `tools trace` CLI output)."""
+    from spark_rapids_tpu.trace import load_trace
+    tr = load_trace(path)
+    spans, instants, meta = tr["spans"], tr["instants"], tr["meta"]
+    lines = ["=== TPU Trace Report ===", f"trace: {path}",
+             f"query {meta.get('queryId')}: "
+             f"{meta.get('wallSeconds', 0):.3f}s wall, "
+             f"{meta.get('outputRows', 0)} rows, "
+             f"{len(spans)} spans, {len(instants)} markers", ""]
+    if not spans:
+        lines.append("no spans recorded")
+        return "\n".join(lines)
+    t_begin, t_end = _trace_bounds(spans)
+    window = t_end - t_begin
+    cp, idle = critical_path(spans)
+    lines.append(f"critical path ({window / 1e6:.3f}s traced window):")
+    for name, us in sorted(cp.items(), key=lambda kv: -kv[1]):
+        lines.append(f"  {us / 1e6:8.3f}s  {us / window:5.1%}  {name}")
+    lines.append(f"  {idle / 1e6:8.3f}s  {idle / window:5.1%}  (idle)")
+    lines += ["", "exclusive self-time per operator (retry/compile "
+              "blocks subtracted from their enclosing spans):"]
+    excl = exclusive_times(spans)
+    ranked = sorted(excl.items(), key=lambda kv: -kv[1]["exclusive"])
+    lines.append(f"  {'span':44s} {'count':>6s} {'total_s':>9s} "
+                 f"{'self_s':>9s}")
+    for name, d in ranked[:top]:
+        lines.append(f"  {name:44s} {d['count']:6d} "
+                     f"{d['total'] / 1e6:9.3f} "
+                     f"{d['exclusive'] / 1e6:9.3f}")
+    occ = chip_occupancy(spans)
+    lines += ["", "per-chip occupancy (chip-attributed spans over the "
+              "traced window):"]
+    if occ:
+        for chip, d in occ.items():
+            gaps = ", ".join(f"{g / 1e3:.1f}ms"
+                             for g in d["topIdleGaps_us"]) or "-"
+            lines.append(f"  chip {chip}: {d['occupancy']:6.1%} busy, "
+                         f"{d['dispatches']} dispatches, "
+                         f"top idle gaps: {gaps}")
+    else:
+        lines.append("  (no chip-attributed spans)")
+    lines += ["", f"top {top} slowest spans:"]
+    for s in top_spans(spans, top):
+        extra = ""
+        if s["args"]:
+            extra = "  " + ", ".join(
+                f"{k}={v}" for k, v in sorted(s["args"].items()))
+        lines.append(f"  {s['dur_us'] / 1e3:9.1f}ms  {s['name']}{extra}")
+    if instants:
+        counts: Dict[str, int] = {}
+        for ins in instants:
+            counts[ins["name"]] = counts.get(ins["name"], 0) + 1
+        lines += ["", "instant markers:"]
+        for name, c in sorted(counts.items(), key=lambda kv: -kv[1]):
+            lines.append(f"  {c:5d}x {name}")
+    return "\n".join(lines)
+
+
 def _main(argv: List[str]) -> int:
     import argparse
 
     ap = argparse.ArgumentParser(
         prog="spark_rapids_tpu.tools",
         description="TPU qualification/profiling tools")
-    ap.add_argument("command", choices=["qualify", "profile", "docs"])
+    ap.add_argument("command",
+                    choices=["qualify", "profile", "docs", "trace"])
     ap.add_argument("sql", nargs="?", help="SQL text to analyze (live "
-                    "mode; omit when using --log)")
+                    "mode; omit when using --log), or the trace "
+                    "file/directory for the trace command")
     ap.add_argument("--view", action="append", default=[],
                     help="name=path parquet view registrations")
     ap.add_argument("--log", help="offline mode: event-log file or "
                     "directory (spark.rapids.sql.eventLog.dir output)")
     ap.add_argument("--out", default="docs",
                     help="docs: output directory for generated markdown")
+    ap.add_argument("--top", type=int, default=10,
+                    help="trace: rows per report section")
     args = ap.parse_args(argv)
+
+    if args.command == "trace":
+        import os
+        path = args.sql or args.log
+        if not path:
+            ap.error("provide a trace file or directory "
+                     "(spark.rapids.sql.trace.dir output)")
+        if os.path.isdir(path):
+            files = sorted(
+                os.path.join(path, f) for f in os.listdir(path)
+                if f.startswith("trace-") and f.endswith(".json"))
+            if not files:
+                print(f"no trace-*.json files in {path}")
+                return 1
+        else:
+            files = [path]
+        for i, fp in enumerate(files):
+            if i:
+                print()
+            print(format_trace_report(fp, top=args.top))
+        return 0
 
     if args.command == "docs":
         import os
 
+        import spark_rapids_tpu.trace  # noqa: F401 - registers the
+        #   spark.rapids.sql.trace.* conf entries before generate_docs
         from spark_rapids_tpu.conf import generate_docs
         os.makedirs(args.out, exist_ok=True)
         with open(os.path.join(args.out, "configs.md"), "w") as f:
             f.write(generate_docs())
         with open(os.path.join(args.out, "supported_ops.md"), "w") as f:
             f.write(generate_supported_ops())
-        print(f"wrote {args.out}/configs.md and {args.out}/supported_ops.md")
+        with open(os.path.join(args.out, "observability.md"), "w") as f:
+            f.write(generate_observability_docs())
+        print(f"wrote {args.out}/configs.md, {args.out}/supported_ops.md "
+              f"and {args.out}/observability.md")
         return 0
 
     if args.log:
@@ -345,6 +585,140 @@ def generate_supported_ops() -> str:
         "| nested (LIST/MAP/STRUCT, repeated) | fallback | fallback "
         "| fallback |",
     ]
+    return "\n".join(lines) + "\n"
+
+
+def metric_name_constants() -> List[Tuple[str, str]]:
+    """Every metric-name constant defined in metrics.py (the drift
+    guard's source of truth: a new metric constant MUST appear in the
+    generated observability doc or tier-1 fails)."""
+    from spark_rapids_tpu import metrics as M
+    return sorted(
+        (n, v) for n, v in vars(M).items()
+        if n.isupper() and not n.startswith("_") and isinstance(v, str))
+
+
+def generate_observability_docs() -> str:
+    """docs/observability.md generator (`python -m spark_rapids_tpu.tools
+    docs`): span model, trace configuration, how to open traces in
+    Perfetto, how to read the offline reports, and the full metric-name
+    reference derived from the LIVE metrics module so the doc cannot
+    drift from the code."""
+    from spark_rapids_tpu import conf as C
+    from spark_rapids_tpu import trace as _trace  # registers trace confs
+
+    assert _trace is not None
+    lines = [
+        "# Observability: span tracing, metrics, event logs",
+        "",
+        "Generated by `python -m spark_rapids_tpu.tools docs`.",
+        "",
+        "## Span model",
+        "",
+        "With `spark.rapids.sql.trace.enabled` the engine records a",
+        "Dapper-style span stream `(query_id, batch_id, chip, thread,",
+        "kind, t0, t1, attrs)` at its existing choke points and writes",
+        "ONE Chrome-trace JSON file per query (`trace-<pid>-q<n>.json`)",
+        "under `spark.rapids.sql.trace.dir`:",
+        "",
+        "- every `MetricRegistry.timed`/`timed_wall` scope mirrors its",
+        "  interval into a span named `<Exec>.<metric>` (reader",
+        "  `FileScan.decodeTime`, upload",
+        "  `TpuRowToColumnar.copyToDeviceTime` with the target chip,",
+        "  exchange `TpuShuffleExchangeExec.partitionTime`, sort/join/",
+        "  agg timers, `pipelineDrainTime`, ...) — the trace, the event",
+        "  log, and the profiler read the SAME measurement;",
+        "- device dispatches are explicit spans with the executing chip:",
+        "  `TpuFusedStageExec.dispatch` (stage label, batch sequence) and",
+        "  `TpuHashAggregateExec.dispatch` (mode);",
+        "- JIT compiles are `compile` spans (attr `cache` = which LRU",
+        "  missed); semaphore waits are `semaphoreWait` spans; store",
+        "  tier movement is `spillToHost`/`spillToDisk`/",
+        "  `promoteFromDisk`/`promoteToDevice`; the ICI exchange adds",
+        "  `meshStack`/`meshSizeExchange`/`meshExchange` and",
+        "  `exchangeMaterialize`;",
+        "- retry machinery emits INSTANT markers (`retryOOM`,",
+        "  `splitRetry`, `ioRetry`, `chipFailure`) plus a nested",
+        "  `retryBlock` span covering the spill+backoff wall — the same",
+        "  interval the `retryBlockTime` metric reads.",
+        "",
+        "A span that crosses a generator yield can resume on another",
+        "thread; the exporter assigns such partially-overlapping spans",
+        "to overflow lanes (`<thread>!k`) so every lane's B/E stream is",
+        "strictly nested — the schema tests assert this invariant.",
+        "",
+        "## Configuration",
+        "",
+        "| Key | Default | Description |",
+        "|---|---|---|",
+    ]
+    for e in sorted(C.registered_entries(), key=lambda e: e.key):
+        if e.key.startswith("spark.rapids.sql.trace."):
+            lines.append(f"| {e.key} | {e.default} | {e.doc} |")
+    lines += [
+        "",
+        "Sampling: with `sampleRate < 1.0` the Nth traced-candidate",
+        "query of the process is traced iff the Nth draw of the",
+        "`sampleSeed`-seeded stream falls below the rate — a fixed seed",
+        "gives a deterministic, reproducible sample (production traces",
+        "a stable subset at bounded overhead; the bench measures the",
+        "overhead in `detail.trace`).",
+        "",
+        "## Opening traces in Perfetto",
+        "",
+        "1. run a query with `spark.rapids.sql.trace.enabled=true`;",
+        "2. open https://ui.perfetto.dev (or chrome://tracing) and drag",
+        "   the `trace-<pid>-q<n>.json` file in;",
+        "3. lanes are the engine's real threads (`srt-task-*` task",
+        "   threads, `srt-multifile-*` reader pool, `srt-pack` upload",
+        "   stagers); click a span for its attrs (chip, batch, rows,",
+        "   path, cache); instant markers show retries/splits.",
+        "",
+        "## Reading the offline reports",
+        "",
+        "`python -m spark_rapids_tpu.tools trace <file-or-dir>` prints:",
+        "",
+        "- **critical path** — backward walk from the last span end:",
+        "  at every instant the most-recently-started covering span",
+        "  owns the segment, uncovered gaps are idle. Only work ON this",
+        "  chain bounds the query wall; optimize it first.",
+        "- **exclusive self-time** — per span name, total minus",
+        "  directly nested spans (same lane). This undoes the",
+        "  documented double counts at the reporting layer: e.g.",
+        "  `retryBlock` (spill+backoff) nests inside operator timers,",
+        "  so operators' self-time no longer absorbs retry stalls.",
+        "- **per-chip occupancy** — busy fraction + top idle gaps per",
+        "  chip from chip-attributed spans; mesh skew and a degraded",
+        "  chip show up as occupancy imbalance.",
+        "- **top slowest spans** and **instant marker counts** (retry",
+        "  storms surface here).",
+        "",
+        "`bench.py` runs a traced q1 leg (`detail.trace`): occupancy,",
+        "critical-path breakdown, and measured tracing overhead vs the",
+        "untraced wall (the overhead budget is <= 15%, asserted by",
+        "tests/test_trace.py on the smoke input).",
+        "",
+        "## Event log (v2)",
+        "",
+        "Event lines (`spark.rapids.sql.eventLog.dir`) carry",
+        "`version: 2`: per-op metrics now INCLUDE zero values (an op",
+        "that saw 0 rows is distinguishable from one whose metric never",
+        "existed), plus a compact snapshot of the session's explicit",
+        "conf settings and the fault-injector summary when injection is",
+        "active. `read_events` still reads v1 lines (version",
+        "normalized to 1).",
+        "",
+        "## Metric-name reference",
+        "",
+        "Derived from the live `spark_rapids_tpu.metrics` constants;",
+        "tier-1 asserts every constant appears here (the \"new metric,",
+        "stale docs\" drift guard).",
+        "",
+        "| Constant | Metric key |",
+        "|---|---|",
+    ]
+    for const, name in metric_name_constants():
+        lines.append(f"| {const} | `{name}` |")
     return "\n".join(lines) + "\n"
 
 

@@ -1,0 +1,409 @@
+"""Pipeline-wide span tracer with Chrome-trace export (Dapper-style).
+
+The reference explains *where a query's time went* with NVTX ranges fed
+into Nsight plus the Qualification/Profiler tools; after whole-stage
+fusion, mesh-parallel scan, and the async in-flight dispatch window the
+hot path here is concurrent in three dimensions (reader pool threads,
+``stageFusion.maxInFlight`` dispatches, per-chip mesh execution) and
+wall-clock counters alone cannot attribute time.  This module is the
+missing layer: a low-overhead, thread-safe span stream
+
+    (query_id, batch_id, chip, thread, kind, t0, t1, attrs)
+
+recorded at the engine's existing choke points and exported as
+Chrome-trace-event JSON — one file per query under
+``spark.rapids.sql.trace.dir`` — that loads directly in Perfetto /
+chrome://tracing.  ``tools.py trace <file>`` analyzes the same stream
+offline (critical path, exclusive self-time, per-chip occupancy).
+
+Integration contract (docs/observability.md):
+
+- ``MetricRegistry.timed``/``timed_wall`` mirror every metric timer
+  into a span with the SAME interval, so the event log, the profiler,
+  and the trace agree on one set of numbers by construction.
+- Sites without a metric timer (fused/agg dispatch, semaphore waits,
+  spills, JIT compiles) measure ONCE and feed both channels.
+- Retry/backoff/split/chip-failure events are instant markers; the
+  retry recovery block (spill + backoff) is a nested ``retryBlock``
+  span so the offline analyzer's *exclusive* self-time report undoes
+  the documented retryBlockTime-inside-opTime double count.
+
+Overhead discipline: when no trace is active (``trace.enabled`` off,
+or the query was not sampled per ``trace.sampleRate``) every hook is a
+single module-global ``None`` check; span recording itself is a tuple
+append under the GIL (no lock on the hot path).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import random
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from spark_rapids_tpu.conf import conf
+
+TRACE_ENABLED = conf("spark.rapids.sql.trace.enabled").doc(
+    "Record per-query span traces (reader IO/decode, host pack, upload, "
+    "per-chip device dispatch, exchange, JIT compiles, semaphore waits, "
+    "spills, retries) and write one Chrome-trace JSON file per query "
+    "under spark.rapids.sql.trace.dir. Open the files in Perfetto "
+    "(https://ui.perfetto.dev) or analyze offline with `python -m "
+    "spark_rapids_tpu.tools trace <file>` (docs/observability.md)."
+    ).boolean(False)
+
+TRACE_DIR = conf("spark.rapids.sql.trace.dir").doc(
+    "Directory for per-query Chrome-trace files "
+    "(trace-<pid>-q<n>.json).").string("/tmp/srt_traces")
+
+TRACE_SAMPLE_RATE = conf("spark.rapids.sql.trace.sampleRate").doc(
+    "Fraction of queries to trace (1.0 = every query). Sampling is "
+    "deterministic for a fixed spark.rapids.sql.trace.sampleSeed: the "
+    "Nth traced-candidate query of the process is sampled iff the Nth "
+    "draw of the seeded stream is below the rate — production use "
+    "traces a stable subset at bounded overhead.").double(1.0)
+
+TRACE_SAMPLE_SEED = conf("spark.rapids.sql.trace.sampleSeed").doc(
+    "Seed of the deterministic query-sampling stream used by "
+    "spark.rapids.sql.trace.sampleRate.").integer(0)
+
+
+# ---------------------------------------------------------------------------
+# Active-trace state (process-wide, like the DeviceStore / FaultInjector)
+# ---------------------------------------------------------------------------
+
+class QueryTrace:
+    """Span sink for one traced query. ``add``/``mark`` are called from
+    task/pool threads concurrently; CPython ``list.append`` is atomic
+    under the GIL, so the hot path takes no lock."""
+
+    __slots__ = ("query_id", "t0", "wall_t0", "spans", "instants",
+                 "_thread_names")
+
+    def __init__(self, query_id: int):
+        self.query_id = query_id
+        self.t0 = time.perf_counter_ns()
+        self.wall_t0 = time.time()
+        # span record: (kind, t0_ns, t1_ns, thread_ident, batch, chip,
+        #               attrs-or-None)
+        self.spans: List[Tuple] = []
+        # instant record: (kind, t_ns, thread_ident, attrs-or-None)
+        self.instants: List[Tuple] = []
+        self._thread_names: Dict[int, str] = {}
+
+    def _thread(self) -> int:
+        t = threading.current_thread()
+        ident = t.ident or 0
+        if ident not in self._thread_names:
+            self._thread_names[ident] = t.name
+        return ident
+
+    def add(self, kind: str, t0: int, t1: int, batch=None, chip=None,
+            **attrs) -> None:
+        self.spans.append((kind, t0, t1, self._thread(), batch, chip,
+                           _clean(attrs)))
+
+    def mark(self, kind: str, **attrs) -> None:
+        self.instants.append((kind, time.perf_counter_ns(),
+                              self._thread(), _clean(attrs)))
+
+
+def _clean(attrs: dict) -> Optional[dict]:
+    if not attrs:
+        return None
+    out = {k: v for k, v in attrs.items() if v is not None}
+    return out or None
+
+
+# Hot-path flag: hooks read this module global directly (one attribute
+# load when tracing is off). Guarded by _LOCK only for begin/end.
+_ACTIVE: Optional[QueryTrace] = None
+_LOCK = threading.Lock()
+_DEPTH = 0           # nested execute_plan calls (scalar subqueries)
+_SEQ = 0             # traced-candidate query counter (sampling stream)
+_RNG: Optional[random.Random] = None
+_RNG_SEED: Optional[int] = None
+
+
+def active() -> Optional[QueryTrace]:
+    return _ACTIVE
+
+
+def reset_tracing() -> None:
+    """Drop the sampling stream + query counter so the next query sees
+    a fresh deterministic schedule (tests call this between runs, like
+    retry.reset_fault_injection)."""
+    global _ACTIVE, _DEPTH, _SEQ, _RNG, _RNG_SEED
+    with _LOCK:
+        _ACTIVE = None
+        _DEPTH = 0
+        _SEQ = 0
+        _RNG = None
+        _RNG_SEED = None
+
+
+def begin_query(conf_obj) -> Optional[str]:
+    """Start (or join) a query trace. Returns an opaque token for
+    ``end_query`` — ``None`` when tracing is disabled, ``"root"`` when
+    this call opened the trace, ``"nested"``/``"unsampled"`` otherwise.
+    Nested queries (scalar subqueries executed during planning) fold
+    their spans into the outer query's trace; so does a concurrent
+    query from another session thread (documented limitation — span
+    streams are a property of the process timeline)."""
+    global _ACTIVE, _DEPTH, _SEQ, _RNG, _RNG_SEED
+    if conf_obj is None or not bool(conf_obj.get(TRACE_ENABLED)):
+        return None
+    with _LOCK:
+        _DEPTH += 1
+        if _DEPTH > 1:
+            return "nested"
+        _SEQ += 1
+        rate = float(conf_obj.get(TRACE_SAMPLE_RATE))
+        if rate < 1.0:
+            seed = int(conf_obj.get(TRACE_SAMPLE_SEED))
+            if _RNG is None or _RNG_SEED != seed:
+                _RNG = random.Random(seed)
+                _RNG_SEED = seed
+            if _RNG.random() >= rate:
+                return "unsampled"
+        _ACTIVE = QueryTrace(_SEQ)
+        return "root"
+
+
+def end_query(conf_obj, token: Optional[str], wall_s: float = 0.0,
+              rows: int = 0, error: bool = False) -> Optional[str]:
+    """Close a ``begin_query`` scope; on the outermost sampled close,
+    write the Chrome-trace file and return its path. Failures never
+    break the query (observability must not take down execution)."""
+    global _ACTIVE, _DEPTH
+    if token is None:
+        return None
+    with _LOCK:
+        _DEPTH = max(0, _DEPTH - 1)
+        if token != "root":
+            return None
+        qt, _ACTIVE = _ACTIVE, None
+    if qt is None:
+        return None
+    try:
+        trace_dir = str(conf_obj.get(TRACE_DIR))
+        os.makedirs(trace_dir, exist_ok=True)
+        path = os.path.join(
+            trace_dir, f"trace-{os.getpid()}-q{qt.query_id:05d}.json")
+        write_chrome_trace(path, qt, wall_s=wall_s, rows=rows,
+                           error=error)
+        return path
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Recording helpers (the instrumentation surface)
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def span(kind: str, batch=None, chip=None, **attrs) -> Iterator[None]:
+    """Trace-only span (sites whose duration already reaches a metric
+    through another channel, e.g. store stats). One None check when
+    tracing is off."""
+    qt = _ACTIVE
+    if qt is None:
+        yield
+        return
+    t0 = time.perf_counter_ns()
+    try:
+        yield
+    finally:
+        qt.add(kind, t0, time.perf_counter_ns(), batch=batch, chip=chip,
+               **attrs)
+
+
+def instant(kind: str, **attrs) -> None:
+    """Point-in-time marker (retry/backoff/split/chip-failure events)."""
+    qt = _ACTIVE
+    if qt is not None:
+        qt.mark(kind, **attrs)
+
+
+def chip_of(batch) -> Optional[int]:
+    """The chip a device batch is resident on, for span attribution —
+    None (and no device query at all) when tracing is off."""
+    if _ACTIVE is None:
+        return None
+    try:
+        from spark_rapids_tpu.columnar.device import batch_device
+        d = batch_device(batch)
+        return d.id if d is not None else None
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event export
+# ---------------------------------------------------------------------------
+#
+# Spans are emitted as matched B/E pairs (ph "B"/"E"), instants as ph
+# "i". Within one recording thread, context-manager spans are properly
+# nested (LIFO); a span that spans a generator yield can resume on a
+# different consumer thread and partially overlap its lane's stack, so
+# the writer assigns spans greedily to LANES: a span joins the first
+# lane whose open spans all fully contain it, otherwise it opens an
+# overflow lane (tid "<thread>!k"). Every lane's event stream is
+# strictly nested and time-ordered, which is exactly what the Chrome
+# B/E semantics (and the schema test) require.
+
+def _us(t_ns: int, base_ns: int) -> float:
+    return round((t_ns - base_ns) / 1000.0, 3)
+
+
+def _lane_events(spans: List[Tuple], base: int, pid: int,
+                 tid0: int) -> Tuple[List[dict], int]:
+    """Per-source-thread span list -> correctly nested B/E streams over
+    one or more lanes. Returns (events, lanes_used)."""
+    events: List[dict] = []
+    # lane state: list of stacks; each stack holds (t1, kind) of opens
+    lanes: List[List[Tuple[int, str]]] = []
+    lane_ev: List[List[dict]] = []
+    for kind, t0, t1, _ident, batch, chip, attrs in sorted(
+            spans, key=lambda s: (s[1], -s[2])):
+        args: Dict[str, Any] = {}
+        if batch is not None:
+            args["batch"] = batch
+        if chip is not None:
+            args["chip"] = chip
+        if attrs:
+            args.update(attrs)
+        placed = False
+        for li in range(len(lanes)):
+            stack, ev = lanes[li], lane_ev[li]
+            while stack and stack[-1][0] <= t0:
+                ct1, ckind = stack.pop()
+                ev.append({"name": ckind, "ph": "E", "pid": pid,
+                           "tid": tid0 + li, "ts": _us(ct1, base)})
+            if not stack or stack[-1][0] >= t1:
+                b = {"name": kind, "ph": "B", "pid": pid,
+                     "tid": tid0 + li, "ts": _us(t0, base)}
+                if args:
+                    b["args"] = args
+                ev.append(b)
+                stack.append((t1, kind))
+                placed = True
+                break
+        if not placed:
+            li = len(lanes)
+            b = {"name": kind, "ph": "B", "pid": pid, "tid": tid0 + li,
+                 "ts": _us(t0, base)}
+            if args:
+                b["args"] = args
+            lanes.append([(t1, kind)])
+            lane_ev.append([b])
+    for li, stack in enumerate(lanes):
+        while stack:
+            ct1, ckind = stack.pop()
+            lane_ev[li].append({"name": ckind, "ph": "E", "pid": pid,
+                                "tid": tid0 + li, "ts": _us(ct1, base)})
+    for ev in lane_ev:
+        events.extend(ev)
+    return events, max(1, len(lanes))
+
+
+def write_chrome_trace(path: str, qt: QueryTrace, wall_s: float = 0.0,
+                       rows: int = 0, error: bool = False) -> None:
+    base = qt.t0
+    pid = os.getpid()
+    events: List[dict] = [{
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": f"spark-rapids-tpu q{qt.query_id}"}}]
+    by_thread: Dict[int, List[Tuple]] = {}
+    for s in qt.spans:
+        by_thread.setdefault(s[3], []).append(s)
+    for ins in qt.instants:
+        by_thread.setdefault(ins[2], [])
+    tid = 1
+    tid_of: Dict[int, int] = {}
+    for ident in sorted(by_thread):
+        tid_of[ident] = tid
+        ev, lanes = _lane_events(by_thread[ident], base, pid, tid)
+        name = qt._thread_names.get(ident, str(ident))
+        for li in range(lanes):
+            events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": tid + li,
+                           "args": {"name": name if li == 0
+                                    else f"{name}!{li}"}})
+        events.extend(ev)
+        tid += lanes
+    for kind, t_ns, ident, attrs in qt.instants:
+        ev = {"name": kind, "ph": "i", "s": "t", "pid": pid,
+              "tid": tid_of.get(ident, 0), "ts": _us(t_ns, base)}
+        if attrs:
+            ev["args"] = attrs
+        events.append(ev)
+    doc = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "version": 1,
+            "queryId": qt.query_id,
+            "pid": pid,
+            "wallSeconds": round(wall_s, 6),
+            "outputRows": rows,
+            "error": bool(error),
+            "startUnixTime": qt.wall_t0,
+            "spanCount": len(qt.spans),
+            "instantCount": len(qt.instants),
+        },
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        # default=str: attr values are normally JSON scalars, but an
+        # exotic attr must degrade to its repr, never kill the write
+        json.dump(doc, f, default=str)
+    os.replace(tmp, path)
+
+
+# ---------------------------------------------------------------------------
+# Loader (tools.py's data source)
+# ---------------------------------------------------------------------------
+
+def load_trace(path: str) -> Dict[str, Any]:
+    """Parse a written trace back into spans/instants (timestamps in
+    microseconds from trace start). B/E pairs are matched per tid with
+    a stack, exactly the Chrome semantics."""
+    with open(path) as f:
+        doc = json.load(f)
+    spans: List[dict] = []
+    instants: List[dict] = []
+    tid_names: Dict[int, str] = {}
+    stacks: Dict[int, List[dict]] = {}
+    for ev in doc.get("traceEvents", []):
+        ph = ev.get("ph")
+        tid = ev.get("tid", 0)
+        if ph == "M":
+            if ev.get("name") == "thread_name":
+                tid_names[tid] = ev.get("args", {}).get("name", str(tid))
+        elif ph == "B":
+            stacks.setdefault(tid, []).append(ev)
+        elif ph == "E":
+            st = stacks.get(tid)
+            if not st:
+                raise ValueError(f"unmatched E event at ts={ev.get('ts')}")
+            b = st.pop()
+            if b.get("name") != ev.get("name"):
+                raise ValueError(
+                    f"B/E name mismatch: {b.get('name')} vs "
+                    f"{ev.get('name')}")
+            spans.append({"name": b["name"], "t0": float(b["ts"]),
+                          "t1": float(ev["ts"]), "tid": tid,
+                          "args": b.get("args", {})})
+        elif ph in ("i", "I"):
+            instants.append({"name": ev.get("name"),
+                             "ts": float(ev.get("ts", 0)), "tid": tid,
+                             "args": ev.get("args", {})})
+    leftover = {t: st for t, st in stacks.items() if st}
+    if leftover:
+        raise ValueError(f"unmatched B events on tids {sorted(leftover)}")
+    return {"spans": spans, "instants": instants,
+            "meta": doc.get("otherData", {}), "tidNames": tid_names}
