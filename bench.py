@@ -319,6 +319,18 @@ def decode_breakdown(plans) -> dict:
     return out
 
 
+def fresh_leg() -> int:
+    """Scope a detail leg's process-wide observability state: start a
+    new metric-registry epoch and re-base the device store's pool +
+    per-owner peak watermarks, so each leg's snapshot/profile reports
+    its OWN run instead of inheriting earlier legs' registries and
+    high-watermarks."""
+    from spark_rapids_tpu import memory
+    from spark_rapids_tpu.metrics import begin_epoch
+    memory.reset_store_peaks()
+    return begin_epoch()
+
+
 TPU_CONF = {
     "spark.rapids.sql.enabled": "true",
     "spark.rapids.sql.test.forceDevice": "true",  # fail on any fallback
@@ -343,6 +355,7 @@ def run_tpu(fusion_enabled: bool) -> dict:
     or off — the fused-vs-unfused comparison runs in the SAME bench
     invocation so the walls are directly comparable."""
     from spark_rapids_tpu.sql.session import TpuSparkSession
+    fresh_leg()
     conf = dict(TPU_CONF)
     conf["spark.rapids.sql.stageFusion.enabled"] = str(
         fusion_enabled).lower()
@@ -390,6 +403,7 @@ def run_multichip(single_chip_wall: float, cpu_rows) -> dict:
                 "reason": f"{n_vis} device visible (need >= 2; set "
                           "BENCH_MULTICHIP_DEVICES=8 to emulate)"}
     from spark_rapids_tpu.sql.session import TpuSparkSession
+    fresh_leg()
     conf = dict(TPU_CONF)
     conf["spark.rapids.shuffle.mode"] = "ici"
     # 0 = all visible devices (resolved by the session's mesh wiring)
@@ -460,6 +474,7 @@ def run_robustness(clean_wall: float, cpu_rows) -> dict:
            "legs": {}}
     for name, inject, extra in legs:
         RT.reset_fault_injection()
+        fresh_leg()
         conf = dict(TPU_CONF)
         conf.update(inject)
         conf.update(extra)
@@ -509,6 +524,7 @@ def run_trace(clean_wall: float, cpu_rows) -> dict:
                         ".bench-data", "traces")
     shutil.rmtree(tdir, ignore_errors=True)
     TR.reset_tracing()
+    fresh_leg()
     conf = dict(TPU_CONF)
     conf["spark.rapids.sql.trace.enabled"] = "true"
     conf["spark.rapids.sql.trace.dir"] = tdir
@@ -539,6 +555,82 @@ def run_trace(clean_wall: float, cpu_rows) -> dict:
     finally:
         tpu.stop()
         TR.reset_tracing()
+
+
+def run_profile(clean_wall: float, cpu_rows) -> dict:
+    """q1 + q3 with the profile subsystem on (docs/observability.md
+    "Reading a query profile"): per-op peak HBM from each query's
+    artifact (checked against the pool watermark), explain coverage
+    counts, and the measured profiling overhead vs the clean wall
+    (acceptance: <= 1.15x on the smoke input)."""
+    from spark_rapids_tpu.profile import read_profiles
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    pdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".bench-data", "profiles")
+    shutil.rmtree(pdir, ignore_errors=True)
+    conf = dict(TPU_CONF)
+    # no forceDevice: the explain section should report REAL coverage
+    # (a forced-fallback query would abort under forceDevice)
+    conf.pop("spark.rapids.sql.test.forceDevice", None)
+    conf["spark.rapids.sql.profile.enabled"] = "true"
+    conf["spark.rapids.sql.profile.dir"] = pdir
+
+    def leg(run_query, check_rows) -> dict:
+        epoch = fresh_leg()
+        tpu = TpuSparkSession(conf)
+        try:
+            wall, rows, path = run_query(tpu)
+            if check_rows is not None:
+                assert_rows_match(check_rows, rows)
+            prof = list(read_profiles(path))[0]
+            ops = prof["memory"]["operators"]
+            pool = prof["memory"]["pool"]
+            ex = prof.get("explain", {})
+            # consistency: the pool watermark is bounded by the sum of
+            # per-op peaks (acceptance criterion)
+            sum_peaks = sum(st["peakBytes"] for st in ops.values())
+            assert pool.get("peakDeviceBytes", 0) <= sum_peaks or \
+                not ops, (pool, ops)
+            # epoch-scoped process-wide snapshot: only THIS leg's
+            # registries contribute (the registry-bleed satellite)
+            from spark_rapids_tpu.metrics import registry_snapshot
+            leg_metrics = registry_snapshot(epoch=epoch)["metrics"]
+            return {
+                "wall_s": round(wall, 4),
+                "perOpPeakHBM": {o: st["peakBytes"]
+                                 for o, st in sorted(ops.items())},
+                "poolPeakHBM": pool.get("peakDeviceBytes", 0),
+                "deviceOps": len(ex.get("deviceOps", [])),
+                "fallbacks": len(ex.get("fallbacks", [])),
+                "coverage": ex.get("coverage", 1.0),
+                "legSpillBytes": leg_metrics.get("spillBytes", 0),
+                "legRetryCount": leg_metrics.get("retryCount", 0),
+            }
+        finally:
+            tpu.stop()
+
+    def q1_run(tpu):
+        q = build_query(tpu)
+        run_once(q)  # warm
+        times, rows = [], None
+        for _ in range(2):
+            dt, rows = run_once(q)
+            times.append(dt)
+        return min(times), rows, tpu.last_profile_path
+
+    def q3_run(tpu):
+        t, rows, _stages, _decode = run_tpcds_q3(tpu)
+        return t, rows, tpu.last_profile_path
+
+    q1_leg = leg(q1_run, cpu_rows)
+    q3_leg = leg(q3_run, None)
+    return {
+        "skipped": False,
+        "clean_wall_s": round(clean_wall, 4),
+        "profilingOverhead": round(q1_leg["wall_s"] / clean_wall, 4),
+        "q1": q1_leg,
+        "q3": q3_leg,
+    }
 
 
 def main():
@@ -592,6 +684,13 @@ def main():
         trace_leg = {"skipped": True,
                      "reason": f"trace leg failed: {e!r}"}
 
+    # query-profile leg (per-op peak HBM + explain coverage)
+    try:
+        profile_leg = run_profile(fused["wall_s"], cpu_rows)
+    except Exception as e:  # noqa: BLE001 - reported, not swallowed
+        profile_leg = {"skipped": True,
+                       "reason": f"profile leg failed: {e!r}"}
+
     cpu_t = min(cpu_times)
     tpu_t = fused["wall_s"]
     q3_tpu_t = fused["q3"]["wall_s"]
@@ -627,6 +726,7 @@ def main():
             "multichip": multichip,
             "robustness": robustness,
             "trace": trace_leg,
+            "profile": profile_leg,
             "jitCaches": registry_snapshot()["jitCaches"],
             "tpcds_q3": {
                 "device_wall_s": round(q3_tpu_t, 4),

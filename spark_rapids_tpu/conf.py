@@ -120,7 +120,12 @@ SQL_ENABLED = conf("spark.rapids.sql.enabled").doc(
 
 EXPLAIN = conf("spark.rapids.sql.explain").doc(
     "Explain why parts of a query were or were not placed on the TPU: "
-    "NONE, ALL, or NOT_ON_GPU (GpuOverrides.scala:3609-3616).").string("NONE")
+    "NONE (silent), NOT_ON_TPU (print one line per operator/expression "
+    "fallback with the reason and the offending expression subtree), or "
+    "ALL (also list every operator that WILL run on TPU). NOT_ON_GPU is "
+    "accepted as an alias of NOT_ON_TPU. The same report is aggregated "
+    "per query into the profile artifact (spark.rapids.sql.profile.*) "
+    "and the event log (GpuOverrides.scala:3609-3616).").string("NONE")
 
 CONCURRENT_TPU_TASKS = conf("spark.rapids.sql.concurrentGpuTasks").doc(
     "Number of tasks that may use the TPU concurrently; bounds HBM pressure "

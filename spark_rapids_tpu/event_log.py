@@ -22,6 +22,16 @@ _LOCK = threading.Lock()
 _SEQ = [0]
 
 
+def next_query_id() -> int:
+    """Process-wide query-completion sequence, SHARED between the event
+    log and the profile writer so one query's event line and profile
+    artifact carry the same queryId (the session allocates one id per
+    query and passes it to both)."""
+    with _LOCK:
+        _SEQ[0] += 1
+        return _SEQ[0]
+
+
 def _collect_ops(physical) -> List[Dict[str, Any]]:
     from spark_rapids_tpu.exec.base import TpuExec
     ops: List[Dict[str, Any]] = []
@@ -69,14 +79,14 @@ EVENT_VERSION = 2
 def write_event(log_dir: str, session_id: int, physical,
                 rewrite_report, wall_s: float, rows: int,
                 store_stats: Optional[Dict[str, int]] = None,
-                conf=None) -> None:
+                conf=None,
+                memory_by_op: Optional[Dict[str, Dict[str, int]]] = None,
+                query_id: Optional[int] = None) -> None:
     """Append one query-completion event; failures never break the
     query (observability must not take down execution)."""
     try:
         os.makedirs(log_dir, exist_ok=True)
-        with _LOCK:
-            _SEQ[0] += 1
-            qid = _SEQ[0]
+        qid = query_id if query_id is not None else next_query_id()
         rec: Dict[str, Any] = {
             "event": "queryCompleted",
             "version": EVENT_VERSION,
@@ -92,8 +102,19 @@ def write_event(log_dir: str, session_id: int, physical,
             rec["fallbacks"] = [
                 {"op": name, "reasons": list(reasons)}
                 for name, reasons in rewrite_report.fallbacks]
+            # aggregated per-query fallback summary (coverage + reason
+            # histogram) so offline tools need not re-walk the reasons
+            summary = getattr(rewrite_report, "summary", None)
+            if callable(summary):
+                rec["fallbackSummary"] = {
+                    k: v for k, v in summary().items()
+                    if k in ("deviceOps", "coverage", "reasonCounts")}
         if store_stats:
             rec["storeStats"] = store_stats
+        if memory_by_op:
+            # per-operator peak/live HBM (the store's owner-attributed
+            # ledger, memory.py) rides along in each line
+            rec["memoryByOperator"] = memory_by_op
         if conf is not None:
             # compact snapshot: only the session's EXPLICIT settings
             # (defaults are derivable from the code version); enough to

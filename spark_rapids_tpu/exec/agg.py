@@ -551,7 +551,7 @@ class TpuHashAggregateExec(TpuExec):
                 out = slice_compacted_to_bucket(out)
                 for h in chunk:
                     h.close()
-                merged.append(store.register(out))
+                merged.append(self.register_spillable(store, out))
             handles = merged
         final = handles[0].get()
         handles[0].close()
@@ -567,8 +567,8 @@ class TpuHashAggregateExec(TpuExec):
                 if self.mode == "partial":
                     yield from self._run_partial(thunk, store)
                     return
-                handles = [store.register(b) for b in thunk()
-                           if b._num_rows != 0]
+                handles = [self.register_spillable(store, b)
+                           for b in thunk() if b._num_rows != 0]
                 if not handles:
                     if not grouped and self.mode in ("final", "complete"):
                         yield self._empty_global_result()
@@ -622,7 +622,7 @@ class TpuHashAggregateExec(TpuExec):
                 # is already local, so the drain costs pipeline-
                 # completion, not + a flat ~0.2s roundtrip per fetch
                 prefetched = _prefetch_host([cnt]) and prefetched
-                pending.append((store.register(out), cnt))
+                pending.append((self.register_spillable(store, out), cnt))
         if not pending:
             return
         # This read is where the whole async upstream pipeline (upload
@@ -647,7 +647,7 @@ class TpuHashAggregateExec(TpuExec):
             b._num_rows = int(cnt)
             b = slice_compacted_to_bucket(b)
             h.close()
-            shrunk.append(store.register(b))
+            shrunk.append(self.register_spillable(store, b))
         total = sum(h.rows for h in shrunk)
         if len(shrunk) > 1 and total <= self.conf.batch_size_rows:
             whole = concat_device([h.get() for h in shrunk])

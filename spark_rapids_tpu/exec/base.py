@@ -45,6 +45,15 @@ class TpuExec(P.PhysicalPlan):
     def device_partitions(self) -> List[DevicePartitionThunk]:
         raise NotImplementedError
 
+    def register_spillable(self, store, batch: DeviceBatch):
+        """Register a batch this operator holds across yields, tagged
+        with the operator as the owning allocator: the store's per-op
+        HBM ledger (live/peak bytes, spill attribution) and this exec's
+        peakDeviceMemory/spillBytes metrics all hang off this tag
+        (docs/observability.md, per-op profile accounting)."""
+        return store.register(batch, owner=type(self).__name__,
+                              metrics=self.metrics)
+
     def partitions(self) -> List[P.PartitionThunk]:
         def make(thunk: DevicePartitionThunk) -> P.PartitionThunk:
             def run() -> Iterator[HostBatch]:

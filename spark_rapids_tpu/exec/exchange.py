@@ -409,7 +409,8 @@ class TpuShuffleExchangeExec(TpuExec):
             for pid in range(len(cache)):
                 part = []
                 for hb in XS.read_partition(sdir, pid):
-                    part.append(store.register(DeviceBatch.from_host(hb)))
+                    part.append(self.register_spillable(
+                        store, DeviceBatch.from_host(hb)))
                 out.append(part)
         self.metrics.create("externalShuffleBytes", M.ESSENTIAL).add(
             sum(os.path.getsize(os.path.join(sdir, f))
@@ -445,7 +446,7 @@ class TpuShuffleExchangeExec(TpuExec):
             """Retain a materialized partition as a spillable handle —
             the exchange holds the whole dataset across yields, so every
             held batch must be demotable (SpillableColumnarBatch role)."""
-            out[pid].append(store.register(part))
+            out[pid].append(self.register_spillable(store, part))
 
         single_out = isinstance(p, P.SinglePartitioning) or (
             n == 1 and isinstance(p, (P.HashPartitioning,
@@ -459,8 +460,8 @@ class TpuShuffleExchangeExec(TpuExec):
             # rows between partitions)
             for per_part in self._pull_split(
                     device_channel(self.child),
-                    lambda b: store.register(b) if b._num_rows != 0
-                    else None):
+                    lambda b: self.register_spillable(store, b)
+                    if b._num_rows != 0 else None):
                 for h in per_part:
                     if h is not None:
                         out[0].append(h)
@@ -489,8 +490,8 @@ class TpuShuffleExchangeExec(TpuExec):
                         self.conf, self.metrics)
                 # register IMMEDIATELY (store is thread-safe) so the
                 # spill budget applies during the drain, not after
-                return [store.register(part) if part is not None else None
-                        for part in parts]
+                return [self.register_spillable(store, part)
+                        if part is not None else None for part in parts]
             for per_part in self._pull_split(device_channel(self.child),
                                              split_one):
                 for handles in per_part:
@@ -535,7 +536,7 @@ class TpuShuffleExchangeExec(TpuExec):
                 with self.metrics.timed(M.PARTITION_TIME):
                     keycols.append(range_key_columns(p.order, bound, b))
                 actives.append(b.active)
-                handles.append(store.register(b))
+                handles.append(self.register_spillable(store, b))
         if not handles:
             return
         from spark_rapids_tpu import retry as R

@@ -287,8 +287,8 @@ class TpuShuffledHashJoinExec(TpuExec):
             def run() -> Iterator[DeviceBatch]:
                 from spark_rapids_tpu.memory import get_device_store
                 store = get_device_store(self.conf)
-                lhandles = [store.register(b) for b in lt()
-                            if b._num_rows != 0]
+                lhandles = [self.register_spillable(store, b)
+                            for b in lt() if b._num_rows != 0]
                 total_l = sum(h.rows for h in lhandles)
                 if not chunkable or total_l <= goal:
                     lb = [h.get() for h in lhandles]
@@ -332,8 +332,8 @@ class TpuShuffledHashJoinExec(TpuExec):
                 store = get_device_store(self.conf)
                 # stream side drains into spillable handles first, so a
                 # skewed partition never pins both sides at once
-                lhandles = [store.register(b) for b in lt()
-                            if b._num_rows != 0]
+                lhandles = [self.register_spillable(store, b)
+                            for b in lt() if b._num_rows != 0]
                 rb = [b for b in rt() if b._num_rows != 0]
                 total_l = sum(h.rows for h in lhandles)
                 chunkable = (self.join_type in self._LEFT_STREAM_TYPES

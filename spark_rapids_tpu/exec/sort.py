@@ -163,7 +163,7 @@ class TpuSortExec(TpuExec):
                         keycols.append(
                             range_key_columns(self.order, bound, b))
                     actives.append(b.active)
-                    handles.append(store.register(b))
+                    handles.append(self.register_spillable(store, b))
                 if not handles:
                     return
                 # len check FIRST: a single handle sorts in-core no
@@ -226,7 +226,8 @@ class TpuSortExec(TpuExec):
             h.close()
             for pid, part in enumerate(parts):
                 if part is not None:
-                    buckets[pid].append(store.register(part))
+                    buckets[pid].append(
+                        self.register_spillable(store, part))
         for pid in range(n_sub):
             parts = [h.get() for h in buckets[pid]]
             if not parts:

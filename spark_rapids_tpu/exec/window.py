@@ -879,7 +879,7 @@ class TpuWindowExec(TpuExec):
                         keycols.append(range_key_columns(
                             part_orders, part_bound, b))
                     actives.append(b.active)
-                    handles.append(store.register(b))
+                    handles.append(self.register_spillable(store, b))
                 if not handles:
                     return
                 total = sum(h.rows for h in handles)
@@ -906,7 +906,8 @@ class TpuWindowExec(TpuExec):
                     h.close()
                     for pid, part in enumerate(parts):
                         if part is not None:
-                            buckets[pid].append(store.register(part))
+                            buckets[pid].append(
+                                self.register_spillable(store, part))
                 for pid in range(n_chunks):
                     parts = [h.get() for h in buckets[pid]]
                     if not parts:

@@ -38,6 +38,7 @@ import weakref
 from collections import OrderedDict
 from typing import Dict, Optional
 
+from spark_rapids_tpu import trace as _trace
 from spark_rapids_tpu.columnar.device import DeviceBatch
 from spark_rapids_tpu.columnar.host import HostBatch
 from spark_rapids_tpu.conf import (DEVICE_MEMORY_LIMIT,
@@ -55,15 +56,22 @@ TIER_DEVICE = "device"
 TIER_HOST = "host"
 TIER_DISK = "disk"
 
+# owner label for registrations that did not attribute themselves (the
+# profile's accounting still balances: unattributed bytes are a bucket,
+# not a leak)
+UNATTRIBUTED = "(unattributed)"
+
 
 class _State:
     """Per-handle storage owned by the store (survives handle GC so the
     finalizer can release whatever tier the data sits in)."""
 
     __slots__ = ("tier", "device", "host", "disk_path", "device_bytes",
-                 "host_bytes", "closed", "rows", "ever_spilled")
+                 "host_bytes", "closed", "rows", "ever_spilled", "owner",
+                 "metrics_ref")
 
-    def __init__(self, batch: DeviceBatch):
+    def __init__(self, batch: DeviceBatch, owner: str = UNATTRIBUTED,
+                 metrics=None):
         self.tier = TIER_DEVICE
         self.device: Optional[DeviceBatch] = batch
         self.host: Optional[HostBatch] = None
@@ -76,6 +84,12 @@ class _State:
         # counts (splits) attach them, others resolve on first use
         self.rows: Optional[int] = batch._num_rows
         self.ever_spilled = False
+        # owner-attributed HBM accounting (docs/observability.md): the
+        # exec that registered the batch; the registry is held weakly so
+        # accounting never pins a released plan's metrics
+        self.owner = owner
+        self.metrics_ref = (weakref.ref(metrics)
+                            if metrics is not None else None)
 
 
 class SpillableBatch:
@@ -157,6 +171,12 @@ class DeviceStore:
         self.spilled_device_bytes = 0
         self.disk_spill_count = 0
         self.peak_device_bytes = 0
+        # owner-attributed accounting: live/peak HBM bytes per
+        # registering operator. Invariant (asserted by the profile
+        # tests): sum(owner_live.values()) == device_bytes at all
+        # times, so the per-op view always reconciles with the pool
+        self.owner_live: Dict[str, int] = {}
+        self.owner_peak: Dict[str, int] = {}
         # disk-tier hygiene: every spill file carries this store's
         # prefix so close() can sweep stragglers without touching other
         # stores sharing the directory; diskFilesLive tracks files the
@@ -165,17 +185,57 @@ class DeviceStore:
         self.disk_files_live = 0
         self._closed = False
 
+    # -- owner accounting + occupancy timeline -----------------------------
+
+    def _owner_delta(self, st: _State, delta: int) -> None:
+        """Move ``delta`` HBM bytes on the owner's ledger (call under
+        the lock). Peaks are monotone. The per-INSTANCE peak is tracked
+        on the registering exec's own metric registry (a plan with two
+        exchanges must not report each other's bytes as its
+        peakDeviceMemory), while the store ledger aggregates by owner
+        class name."""
+        live = self.owner_live.get(st.owner, 0) + delta
+        self.owner_live[st.owner] = live
+        if delta > 0 and live > self.owner_peak.get(st.owner, 0):
+            self.owner_peak[st.owner] = live
+        m = st.metrics_ref() if st.metrics_ref is not None else None
+        if m is not None:
+            # instance-live rides on the registry object itself; all
+            # mutations happen under this store lock, so the
+            # read-modify-write is safe
+            inst = getattr(m, "_store_live_bytes", 0) + delta
+            m._store_live_bytes = inst
+            if delta > 0:
+                from spark_rapids_tpu import metrics as M
+                m.create(M.PEAK_DEVICE_MEMORY, M.ESSENTIAL).set_max(inst)
+
+    def _sample_counters(self) -> None:
+        """Pool occupancy sample into the active trace (Chrome "C"
+        counter events -> the Perfetto HBM timeline). One None check
+        when tracing is off."""
+        qt = _trace._ACTIVE
+        if qt is not None:
+            qt.count("deviceStoreBytes", self.device_bytes)
+            qt.count("hostStoreBytes", self.host_bytes)
+
     # -- registration ------------------------------------------------------
 
-    def register(self, batch: DeviceBatch) -> SpillableBatch:
+    def register(self, batch: DeviceBatch, owner: str = UNATTRIBUTED,
+                 metrics=None) -> SpillableBatch:
+        """Track ``batch`` as spillable. ``owner`` names the creating
+        operator for the per-op HBM ledger (execs call this through
+        ``TpuExec.register_spillable``, which threads their class name
+        and metric registry)."""
         with self._lock:
-            st = _State(batch)
+            st = _State(batch, owner=owner, metrics=metrics)
             hid = self._next_id
             self._next_id += 1
             self._states[hid] = st
             self.device_bytes += st.device_bytes
             self.peak_device_bytes = max(self.peak_device_bytes,
                                          self.device_bytes)
+            self._owner_delta(st, st.device_bytes)
+            self._sample_counters()
             self._enforce(exclude=hid)
             return SpillableBatch(self, st, hid)
 
@@ -187,7 +247,6 @@ class DeviceStore:
             assert st is not None and not st.closed, \
                 "SpillableBatch used after close"
             if st.tier == TIER_DISK:
-                from spark_rapids_tpu import trace as _trace
                 from spark_rapids_tpu.columnar import serde
                 with _trace.span("promoteFromDisk"), \
                         open(st.disk_path, "rb") as f:
@@ -202,7 +261,6 @@ class DeviceStore:
                 if self.debug:
                     _log.info("promote host->device: %d bytes",
                               st.host_bytes)
-                from spark_rapids_tpu import trace as _trace
                 with _trace.span("promoteToDevice", bytes=st.host_bytes):
                     st.device = DeviceBatch.from_host(st.host)
                 self.host_bytes -= st.host_bytes
@@ -212,6 +270,8 @@ class DeviceStore:
                 self.device_bytes += st.device_bytes
                 self.peak_device_bytes = max(self.peak_device_bytes,
                                              self.device_bytes)
+                self._owner_delta(st, st.device_bytes)
+                self._sample_counters()
             self._states.move_to_end(hid)
             self._enforce(exclude=hid)
             return st.device
@@ -239,7 +299,6 @@ class DeviceStore:
             _log.info("spill device->host: %d bytes (pool %d/%d)",
                       st.device_bytes, self.device_bytes,
                       self.device_budget)
-        from spark_rapids_tpu import trace as _trace
         with _trace.span("spillToHost", bytes=st.device_bytes):
             st.host = st.device.to_host()
         st.rows = st.host.num_rows
@@ -251,6 +310,14 @@ class DeviceStore:
         st.ever_spilled = True
         self.spill_count += 1
         self.spilled_device_bytes += st.device_bytes
+        self._owner_delta(st, -st.device_bytes)
+        # the demotion is billed to the OWNING operator, not whichever
+        # task happened to trip the budget (per-op spillBytes)
+        m = st.metrics_ref() if st.metrics_ref is not None else None
+        if m is not None:
+            from spark_rapids_tpu import metrics as M
+            m.create(M.SPILL_BYTES, M.ESSENTIAL).add(st.device_bytes)
+        self._sample_counters()
 
     def _spill_to_disk(self, st: _State) -> None:
         if self.debug:
@@ -260,7 +327,6 @@ class DeviceStore:
         path = os.path.join(
             self.spill_dir,
             f"{self._file_prefix}-{uuid.uuid4().hex[:16]}.bin")
-        from spark_rapids_tpu import trace as _trace
         from spark_rapids_tpu.columnar import serde
         with _trace.span("spillToDisk", bytes=st.host_bytes), \
                 open(path, "wb") as f:
@@ -271,6 +337,7 @@ class DeviceStore:
         st.tier = TIER_DISK
         self.disk_spill_count += 1
         self.disk_files_live += 1
+        self._sample_counters()
 
     def _release_id(self, hid: int) -> None:
         with self._lock:
@@ -280,8 +347,11 @@ class DeviceStore:
             st.closed = True
             if st.tier == TIER_DEVICE:
                 self.device_bytes -= st.device_bytes
+                self._owner_delta(st, -st.device_bytes)
+                self._sample_counters()
             elif st.tier == TIER_HOST:
                 self.host_bytes -= st.host_bytes
+                self._sample_counters()
             elif st.disk_path:
                 try:
                     os.unlink(st.disk_path)
@@ -345,6 +415,27 @@ class DeviceStore:
             "diskSpillCount": self.disk_spill_count,
             "diskFilesLive": self.disk_files_live,
         }
+
+    def owner_stats(self) -> Dict[str, Dict[str, int]]:
+        """Per-operator HBM ledger: live and peak bytes for every owner
+        that registered batches (the profile's memory section and the
+        event log's memoryByOperator field)."""
+        with self._lock:
+            owners = set(self.owner_live) | set(self.owner_peak)
+            return {o: {"liveBytes": self.owner_live.get(o, 0),
+                        "peakBytes": self.owner_peak.get(o, 0)}
+                    for o in sorted(owners)}
+
+    def reset_peaks(self) -> None:
+        """Re-base the pool and per-owner high-watermarks at the current
+        live occupancy. Bench detail legs call this (with
+        metrics.begin_epoch) so each leg's profile reports its OWN
+        peaks, not a high-watermark inherited from an earlier leg."""
+        with self._lock:
+            self.peak_device_bytes = self.device_bytes
+            self.owner_live = {o: v for o, v in self.owner_live.items()
+                               if v}
+            self.owner_peak = dict(self.owner_live)
 
 
 def _host_sizeof(b: HostBatch) -> int:
@@ -417,3 +508,16 @@ def get_device_store(conf: TpuConf) -> DeviceStore:
         # the live store (two stores would account one HBM independently)
         _STORE.debug = bool(conf.get(MEMORY_DEBUG))
         return _STORE
+
+
+def reset_store_peaks() -> None:
+    """Re-base the process store's high-watermarks (no-op without a
+    store); the bench leg / test hook pairing metrics.begin_epoch."""
+    if _STORE is not None:
+        _STORE.reset_peaks()
+
+
+def store_owner_stats() -> Dict[str, Dict[str, int]]:
+    """The process store's per-operator HBM ledger ({} without a
+    store) — the profile writer's and event log's data source."""
+    return _STORE.owner_stats() if _STORE is not None else {}

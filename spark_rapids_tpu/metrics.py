@@ -19,7 +19,7 @@ import threading
 import time
 import weakref
 from dataclasses import dataclass, field
-from typing import Any, Dict, Iterator
+from typing import Any, Dict, Iterator, Optional
 
 from spark_rapids_tpu import trace as _trace
 
@@ -63,6 +63,95 @@ DEVICE_DECODE_OOM_FALLBACKS = "deviceDecodeOomFallbacks"  # encoded-upload
 #   OOMs that fell back to the pyarrow host decode for that batch
 
 
+# ---------------------------------------------------------------------------
+# Central metric description table (docs/tools/profile single source of
+# truth). EVERY metric any exec registers — constants above AND the
+# ad-hoc keys created inline — must have an entry here (exact key) or
+# match a prefix in METRIC_PREFIX_DESCRIPTIONS (dynamic families like
+# per-chip counters). tests/test_profile.py lints this against the
+# registries of executed plans, so profile/docs/bench can never
+# disagree on names.
+# ---------------------------------------------------------------------------
+
+METRIC_DESCRIPTIONS: Dict[str, str] = {
+    NUM_OUTPUT_ROWS: "rows emitted by the operator",
+    NUM_OUTPUT_BATCHES: "device batches emitted",
+    NUM_INPUT_ROWS: "rows consumed",
+    NUM_INPUT_BATCHES: "batches consumed",
+    OP_TIME: "operator wall time (ns)",
+    SEMAPHORE_WAIT_TIME: "wall blocked on the device semaphore (ns)",
+    PEAK_DEVICE_MEMORY: "peak HBM bytes this operator held live in the "
+                        "device store (owner-attributed accounting)",
+    SPILL_BYTES: "HBM bytes of this operator's batches demoted "
+                 "device->host by the store",
+    SORT_TIME: "device sort wall (ns)",
+    AGG_TIME: "aggregation update/merge wall (ns)",
+    JOIN_TIME: "join probe/gather wall (ns)",
+    CONCAT_TIME: "device batch concat wall (ns)",
+    PARTITION_TIME: "exchange partition-split wall (ns)",
+    COPY_TO_DEVICE_TIME: "host->HBM upload wall (ns)",
+    PACK_TIME: "host-side upload staging wall (ns; overlaps transfer)",
+    COPY_FROM_DEVICE_TIME: "HBM->host download wall (ns)",
+    DISPATCH_COUNT: "device programs dispatched",
+    STAGE_COMPILE_TIME: "first-call trace+XLA-compile wall (ns)",
+    FUSED_OPS: "operators collapsed into this fused stage",
+    COMPILE_CACHE_HITS: "jit-cache hits for this exec's programs",
+    COMPILE_CACHE_MISSES: "jit-cache misses (compiles) for this exec",
+    RETRY_COUNT: "OOM retries that re-attempted the operation",
+    SPLIT_RETRY_COUNT: "input batches split in half after OOM",
+    RETRY_BLOCK_TIME: "spill+backoff wall inside OOM retries (ns; also "
+                      "counted inside the enclosing operator timer)",
+    SPILL_BYTES_ON_RETRY: "HBM freed by retry spills",
+    DEGRADED_CHIPS: "mesh chips demoted after persistent failure",
+    IO_RETRY_COUNT: "transient reader IO retries",
+    DEVICE_DECODE_OOM_FALLBACKS: "encoded uploads that fell back to the "
+                                 "pyarrow host decode after OOM",
+    # ad-hoc keys registered inline by individual operators
+    "pipelineDrainTime": "wall where the partial agg drained the async "
+                         "upstream pipeline (interval union)",
+    "pythonEvalTime": "python worker-pool UDF evaluation wall (ns)",
+    "externalShuffleWriteTime": "external-shuffle serialize+write wall",
+    "externalShuffleReadTime": "external-shuffle read+re-upload wall",
+    "externalShuffleBytes": "bytes shipped through the external shuffle",
+    "broadcastBuilds": "broadcast build-side materializations",
+    "numIciExchanges": "all-to-all exchanges run over the ICI mesh",
+    "aqeCoalescedPartitions": "tiny exchange partitions coalesced by AQE",
+    "aqeBroadcastFlip": "shuffled joins flipped to broadcast at runtime",
+    "fkFastPathJoins": "joins taking the unique-build-key fast path",
+    "meshPadWaste": "staged-minus-active rows padded by mesh stacking",
+    # scan-side keys (CpuFileScanExec; kept here so the profile tree and
+    # docs can annotate the whole plan, not only Tpu* nodes)
+    "decodeTime": "host parquet/file decode wall (interval union)",
+    "convertTime": "arrow->HostBatch conversion wall",
+    "deviceDecodeTime": "host-side half of the device decode path "
+                        "(IO, page headers, decode plans)",
+    "deviceDecodedBatches": "scan batches decoded on device",
+    "deviceFallbackUnits": "scan units that fell back to host decode",
+    "deviceFallbackColumns": "columns that fell back to host decode",
+}
+
+# dynamic metric families: any key starting with one of these prefixes
+# is described by the entry (per-chip counters, per-encoding counts)
+METRIC_PREFIX_DESCRIPTIONS: Dict[str, str] = {
+    "dispatchCount.chip": "device programs dispatched on chip <N>",
+    "meshScanUnits.chip": "scan units assigned to chip <N>'s stream",
+    "deviceDecodedValues.": "values decoded on device per encoding",
+}
+
+
+def describe_metric(name: str) -> Optional[str]:
+    """Description for a metric key, resolving dynamic per-chip /
+    per-encoding families by prefix; None for an unknown key (the lint
+    test fails on those)."""
+    d = METRIC_DESCRIPTIONS.get(name)
+    if d is not None:
+        return d
+    for prefix, desc in METRIC_PREFIX_DESCRIPTIONS.items():
+        if name.startswith(prefix):
+            return desc
+    return None
+
+
 @dataclass
 class TpuMetric:
     """Thread-safe counter: task threads (taskParallelism/shuffle pools)
@@ -103,6 +192,26 @@ class TpuMetric:
 # their metrics with themselves
 _REGISTRIES: "weakref.WeakSet[MetricRegistry]" = weakref.WeakSet()
 
+# registry epoch: process-wide counters (the weak set above, the device
+# store peaks) otherwise bleed one bench leg's numbers into the next
+# leg's snapshot. Each registry stamps the epoch current at its
+# creation; begin_epoch() + registry_snapshot(epoch=...) scope a
+# process-wide snapshot to registries created since.
+_EPOCH = 0
+
+
+def begin_epoch() -> int:
+    """Start a new registry epoch and return it. Bench detail legs call
+    this (plus DeviceStore.reset_peaks) at leg start so process-wide
+    snapshots cover only the leg's own plans."""
+    global _EPOCH
+    _EPOCH += 1
+    return _EPOCH
+
+
+def current_epoch() -> int:
+    return _EPOCH
+
 
 class MetricRegistry:
     """Per-exec metric map; creation is gated by the configured level so
@@ -114,6 +223,7 @@ class MetricRegistry:
         self.enabled_level = _LEVELS.get(conf_level.upper(), MODERATE)
         self.metrics: Dict[str, TpuMetric] = {}
         self.owner = owner
+        self.epoch = _EPOCH
         self._lock = threading.Lock()
         _REGISTRIES.add(self)
 
@@ -176,13 +286,16 @@ class MetricRegistry:
         return {k: m.value for k, m in self.metrics.items()}
 
 
-def registry_snapshot(plans=None) -> Dict[str, Any]:
+def registry_snapshot(plans=None, epoch: Optional[int] = None
+                      ) -> Dict[str, Any]:
     """Every metric as ONE dict: ``{"metrics": {name: summed value},
     "jitCaches": {cache: stats}}``. With ``plans`` given (captured
     physical plans), only their registries contribute — fused-stage
     constituents and children included — which is the bench's scraping
     shape; with None, every live registry in the process contributes
-    (cross-query totals)."""
+    (cross-query totals). ``epoch`` scopes the process-wide form to
+    registries created at or after a ``begin_epoch()`` stamp, so bench
+    detail legs stop inheriting earlier legs' registries."""
     vals: Dict[str, int] = {}
 
     def add_reg(ms) -> None:
@@ -191,6 +304,8 @@ def registry_snapshot(plans=None) -> Dict[str, Any]:
 
     if plans is None:
         for ms in list(_REGISTRIES):
+            if epoch is not None and getattr(ms, "epoch", 0) < epoch:
+                continue
             add_reg(ms)
     else:
         def walk(p) -> None:

@@ -69,6 +69,7 @@ class TpuSparkSession:
         self._plan_capture: List = []  # ExecutionPlanCaptureCallback twin
         self._capture_enabled = False
         self.last_rewrite_report = None
+        self.last_profile_path: Optional[str] = None
         with TpuSparkSession._lock:
             TpuSparkSession._active = self
 
@@ -175,6 +176,15 @@ class TpuSparkSession:
             from spark_rapids_tpu.conf import HAS_NANS
             from spark_rapids_tpu.ops import groupby as _G
             _G.set_has_nans(bool(self.conf_obj.get(HAS_NANS)))
+        # profiling: re-base the process store's pool + per-owner peak
+        # watermarks at query START so each artifact's memory section
+        # covers THIS query, not a high-watermark inherited from
+        # earlier queries (concurrent queries still share the process
+        # store — same documented limitation as the span stream)
+        from spark_rapids_tpu import profile as PROF
+        if bool(self.conf_obj.get(PROF.PROFILE_ENABLED)):
+            from spark_rapids_tpu import memory as _memory
+            _memory.reset_store_peaks()
         # span tracing (docs/observability.md): the trace scope opens
         # BEFORE planning so compile spans and scalar-subquery execution
         # (nested execute_plan calls fold into this query's trace) are
@@ -191,16 +201,31 @@ class TpuSparkSession:
             raise
         TR.end_query(self.conf_obj, tok, wall_s=wall_s,
                      rows=result.num_rows)
+        # profile artifact (docs/observability.md "Reading a query
+        # profile"): the executed plan's registries + the store's
+        # owner-attributed HBM ledger + the rewrite explain, one JSON
+        # per query; the path is kept for tests/tools. ONE query id is
+        # allocated for both sinks so the artifact and the event-log
+        # line for this query correlate by queryId
+        from spark_rapids_tpu import event_log
         log_dir = str(self.conf_obj.get(EVENT_LOG_DIR))
+        profiling = bool(self.conf_obj.get(PROF.PROFILE_ENABLED))
+        qid = event_log.next_query_id() if (log_dir or profiling) else None
+        self.last_profile_path = PROF.write_profile(
+            self.conf_obj, physical, self.last_rewrite_report,
+            wall_s, result.num_rows, query_id=qid)
         if log_dir:
-            from spark_rapids_tpu import event_log, memory
+            from spark_rapids_tpu import memory
             store = memory._STORE
             event_log.write_event(
                 log_dir, id(self) & 0xFFFF, physical,
                 self.last_rewrite_report,
                 wall_s, result.num_rows,
                 store.stats() if store is not None else None,
-                conf=self.conf_obj)
+                conf=self.conf_obj,
+                memory_by_op=(store.owner_stats()
+                              if store is not None else None),
+                query_id=qid)
         return result
 
     def explain_string(self, plan: L.LogicalPlan, physical=None) -> str:

@@ -428,8 +428,10 @@ def _main(argv: List[str]) -> int:
     ap.add_argument("command",
                     choices=["qualify", "profile", "docs", "trace"])
     ap.add_argument("sql", nargs="?", help="SQL text to analyze (live "
-                    "mode; omit when using --log), or the trace "
-                    "file/directory for the trace command")
+                    "mode; omit when using --log), the trace "
+                    "file/directory for the trace command, or a "
+                    "profile-*.json file/directory for the profile "
+                    "command (spark.rapids.sql.profile.dir output)")
     ap.add_argument("--view", action="append", default=[],
                     help="name=path parquet view registrations")
     ap.add_argument("--log", help="offline mode: event-log file or "
@@ -439,6 +441,35 @@ def _main(argv: List[str]) -> int:
     ap.add_argument("--top", type=int, default=10,
                     help="trace: rows per report section")
     args = ap.parse_args(argv)
+
+    if args.command == "profile":
+        # offline renderer: a path argument means "render the written
+        # profile artifacts" (spark.rapids.sql.profile.dir output);
+        # SQL text keeps the live run-and-profile behavior below
+        import os
+        # an argument that LOOKS like a path but does not exist must
+        # error like the trace command does, not fall through and run
+        # "/tmp/.../profile-1.json" as SQL text
+        looks_like_path = bool(args.sql) and (
+            os.path.exists(args.sql) or args.sql.endswith(".json")
+            or (os.sep in args.sql and " " not in args.sql))
+        if looks_like_path and not os.path.exists(args.sql):
+            print(f"no such profile file or directory: {args.sql}")
+            return 1
+        path = args.sql if looks_like_path else None
+        if path is not None:
+            from spark_rapids_tpu.profile import (format_profile,
+                                                  read_profiles)
+            n = 0
+            for prof in read_profiles(path):
+                if n:
+                    print()
+                print(format_profile(prof, top=args.top))
+                n += 1
+            if not n:
+                print(f"no profile-*.json files in {path}")
+                return 1
+            return 0
 
     if args.command == "trace":
         import os
@@ -464,6 +495,8 @@ def _main(argv: List[str]) -> int:
     if args.command == "docs":
         import os
 
+        import spark_rapids_tpu.profile  # noqa: F401 - registers the
+        #   spark.rapids.sql.profile.* conf entries before generate_docs
         import spark_rapids_tpu.trace  # noqa: F401 - registers the
         #   spark.rapids.sql.trace.* conf entries before generate_docs
         from spark_rapids_tpu.conf import generate_docs
@@ -605,9 +638,10 @@ def generate_observability_docs() -> str:
     reference derived from the LIVE metrics module so the doc cannot
     drift from the code."""
     from spark_rapids_tpu import conf as C
+    from spark_rapids_tpu import profile as _profile  # registers confs
     from spark_rapids_tpu import trace as _trace  # registers trace confs
 
-    assert _trace is not None
+    assert _trace is not None and _profile is not None
     lines = [
         "# Observability: span tracing, metrics, event logs",
         "",
@@ -653,7 +687,9 @@ def generate_observability_docs() -> str:
         "|---|---|---|",
     ]
     for e in sorted(C.registered_entries(), key=lambda e: e.key):
-        if e.key.startswith("spark.rapids.sql.trace."):
+        if e.key.startswith(("spark.rapids.sql.trace.",
+                             "spark.rapids.sql.profile.")) \
+                or e.key == "spark.rapids.sql.explain":
             lines.append(f"| {e.key} | {e.default} | {e.doc} |")
     lines += [
         "",
@@ -698,6 +734,57 @@ def generate_observability_docs() -> str:
         "untraced wall (the overhead budget is <= 15%, asserted by",
         "tests/test_trace.py on the smoke input).",
         "",
+        "## Reading a query profile",
+        "",
+        "With `spark.rapids.sql.profile.enabled` every executed query",
+        "writes ONE artifact (`profile-<pid>-q<n>.json` under",
+        "`spark.rapids.sql.profile.dir`) unifying the annotated plan,",
+        "the HBM accounting, and the rewrite explain. Render it with",
+        "`python -m spark_rapids_tpu.tools profile <file-or-dir>`:",
+        "",
+        "- **annotated plan tree** — the final physical plan (fused",
+        "  stages with their constituents), each node with its full",
+        "  metric registry: rows/batches, operator timers, jit-cache",
+        "  hits/misses, retry/split/spill counters. A `*` marks device",
+        "  operators.",
+        "- **top memory consumers** — the owner-attributed HBM ledger:",
+        "  every `SpillableBatch` is tagged with the registering",
+        "  operator (`TpuExec.register_spillable`), so the store keeps",
+        "  live/peak bytes PER OPERATOR next to the pool watermarks.",
+        "  The per-op live bytes always sum to the pool's live bytes;",
+        "  the pool peak never exceeds the sum of per-op peaks. Spills",
+        "  are billed to the owning operator (`spillBytes`), and each",
+        "  op's `peakDeviceMemory` metric mirrors its ledger peak.",
+        "- **fallback summary** — operator coverage plus the explain",
+        "  reasons aggregated by frequency (see below).",
+        "",
+        "With tracing ALSO enabled, the store emits Chrome-trace",
+        "counter events (`deviceStoreBytes`/`hostStoreBytes`), so",
+        "Perfetto shows the HBM/host pool occupancy timeline in a",
+        "`counters` lane next to the query's spans.",
+        "",
+        "`bench.py` runs a profiled q1+q3 leg (`detail.profile`):",
+        "per-op peak HBM, explain coverage counts, and the measured",
+        "profiling overhead vs the clean wall (budget <= 15%).",
+        "",
+        "## Explain / fallback reasons",
+        "",
+        "`spark.rapids.sql.explain=NOT_ON_TPU` prints one line per",
+        "operator/expression that stayed on CPU:",
+        "",
+        "    !Exec <CpuProjectExec> cannot run on TPU because",
+        "    expression PythonUDF <...> is not supported on TPU",
+        "",
+        "`ALL` additionally lists `*Exec <...> will run on TPU` for",
+        "every placed operator (`NOT_ON_GPU` is accepted as an alias).",
+        "Expression-level reasons name the OFFENDING SUBTREE, so a",
+        "failure deep inside a projection is attributable without",
+        "replaying the rewrite. The same report aggregates per query",
+        "into the profile artifact's `explain` section (device ops,",
+        "coverage, reason histogram) and the event log's",
+        "`fallbackSummary` field; `tools qualify` scores whole",
+        "workloads with it.",
+        "",
         "## Event log (v2)",
         "",
         "Event lines (`spark.rapids.sql.eventLog.dir`) carry",
@@ -705,18 +792,33 @@ def generate_observability_docs() -> str:
         "that saw 0 rows is distinguishable from one whose metric never",
         "existed), plus a compact snapshot of the session's explicit",
         "conf settings and the fault-injector summary when injection is",
-        "active. `read_events` still reads v1 lines (version",
-        "normalized to 1).",
+        "active; each line also carries the per-query `fallbackSummary`",
+        "(coverage + reason histogram) and `memoryByOperator` (the",
+        "per-op peak/live HBM ledger). `read_events` still reads v1",
+        "lines (version normalized to 1).",
         "",
         "## Metric-name reference",
         "",
-        "Derived from the live `spark_rapids_tpu.metrics` constants;",
-        "tier-1 asserts every constant appears here (the \"new metric,",
-        "stale docs\" drift guard).",
+        "Derived from the central description table",
+        "(`spark_rapids_tpu.metrics.METRIC_DESCRIPTIONS`); tier-1",
+        "asserts every metric-name constant appears here AND that every",
+        "metric a `Tpu*Exec` registers at runtime resolves in the table",
+        "(the \"new metric, stale docs\" drift guard, now a lint over",
+        "the live registries).",
         "",
-        "| Constant | Metric key |",
+        "| Metric key | Description |",
         "|---|---|",
     ]
+    from spark_rapids_tpu.metrics import (METRIC_DESCRIPTIONS,
+                                          METRIC_PREFIX_DESCRIPTIONS)
+    for name, desc in sorted(METRIC_DESCRIPTIONS.items()):
+        lines.append(f"| `{name}` | {desc} |")
+    for prefix, desc in sorted(METRIC_PREFIX_DESCRIPTIONS.items()):
+        lines.append(f"| `{prefix}*` | {desc} |")
+    # the constants table keeps the original drift guard anchored: a
+    # new metrics.py constant must surface here (and therefore in
+    # METRIC_DESCRIPTIONS, which the lint test cross-checks)
+    lines += ["", "| Constant | Metric key |", "|---|---|"]
     for const, name in metric_name_constants():
         lines.append(f"| {const} | `{name}` |")
     return "\n".join(lines) + "\n"

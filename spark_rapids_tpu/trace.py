@@ -81,7 +81,7 @@ class QueryTrace:
     under the GIL, so the hot path takes no lock."""
 
     __slots__ = ("query_id", "t0", "wall_t0", "spans", "instants",
-                 "_thread_names")
+                 "counters", "_thread_names")
 
     def __init__(self, query_id: int):
         self.query_id = query_id
@@ -92,6 +92,9 @@ class QueryTrace:
         self.spans: List[Tuple] = []
         # instant record: (kind, t_ns, thread_ident, attrs-or-None)
         self.instants: List[Tuple] = []
+        # counter sample: (series, t_ns, value) — Chrome "C" events;
+        # the device/host pool occupancy timeline (docs/observability.md)
+        self.counters: List[Tuple] = []
         self._thread_names: Dict[int, str] = {}
 
     def _thread(self) -> int:
@@ -109,6 +112,9 @@ class QueryTrace:
     def mark(self, kind: str, **attrs) -> None:
         self.instants.append((kind, time.perf_counter_ns(),
                               self._thread(), _clean(attrs)))
+
+    def count(self, series: str, value) -> None:
+        self.counters.append((series, time.perf_counter_ns(), value))
 
 
 def _clean(attrs: dict) -> Optional[dict]:
@@ -228,6 +234,16 @@ def instant(kind: str, **attrs) -> None:
         qt.mark(kind, **attrs)
 
 
+def counter(series: str, value) -> None:
+    """Counter sample (Chrome "C" event): Perfetto renders each series
+    as a stepped occupancy track next to the span lanes. Used by the
+    DeviceStore so the HBM/host pool timeline sits beside the query's
+    spans. One None check when tracing is off."""
+    qt = _ACTIVE
+    if qt is not None:
+        qt.count(series, value)
+
+
 def chip_of(batch) -> Optional[int]:
     """The chip a device batch is resident on, for span attribution —
     None (and no device query at all) when tracing is off."""
@@ -341,6 +357,18 @@ def write_chrome_trace(path: str, qt: QueryTrace, wall_s: float = 0.0,
         if attrs:
             ev["args"] = attrs
         events.append(ev)
+    if qt.counters:
+        # counter tracks get a lane of their own: samples from many
+        # threads interleave in append order, so sort by time to keep
+        # the per-tid stream monotone (the schema test's invariant)
+        ctid = tid
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": ctid, "args": {"name": "counters"}})
+        for series, t_ns, value in sorted(qt.counters,
+                                          key=lambda c: c[1]):
+            events.append({"name": series, "ph": "C", "pid": pid,
+                           "tid": ctid, "ts": _us(t_ns, base),
+                           "args": {"value": value}})
     doc = {
         "traceEvents": events,
         "displayTimeUnit": "ms",
@@ -354,6 +382,7 @@ def write_chrome_trace(path: str, qt: QueryTrace, wall_s: float = 0.0,
             "startUnixTime": qt.wall_t0,
             "spanCount": len(qt.spans),
             "instantCount": len(qt.instants),
+            "counterCount": len(qt.counters),
         },
     }
     tmp = path + ".tmp"
@@ -376,6 +405,7 @@ def load_trace(path: str) -> Dict[str, Any]:
         doc = json.load(f)
     spans: List[dict] = []
     instants: List[dict] = []
+    counters: List[dict] = []
     tid_names: Dict[int, str] = {}
     stacks: Dict[int, List[dict]] = {}
     for ev in doc.get("traceEvents", []):
@@ -402,8 +432,12 @@ def load_trace(path: str) -> Dict[str, Any]:
             instants.append({"name": ev.get("name"),
                              "ts": float(ev.get("ts", 0)), "tid": tid,
                              "args": ev.get("args", {})})
+        elif ph == "C":
+            counters.append({"name": ev.get("name"),
+                             "ts": float(ev.get("ts", 0)),
+                             "value": ev.get("args", {}).get("value")})
     leftover = {t: st for t, st in stacks.items() if st}
     if leftover:
         raise ValueError(f"unmatched B events on tids {sorted(leftover)}")
-    return {"spans": spans, "instants": instants,
+    return {"spans": spans, "instants": instants, "counters": counters,
             "meta": doc.get("otherData", {}), "tidNames": tid_names}

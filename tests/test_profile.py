@@ -1,0 +1,463 @@
+"""Query-profile corpus (docs/observability.md "Reading a query
+profile"): artifact schema well-formedness, bit-identical results with
+profiling on/off, per-op peak-bytes sanity (owner-attributed HBM
+accounting incl. under injected OOM), explain=NOT_ON_TPU|ALL output for
+a forced fallback, the `tools profile` CLI, the metric-description lint
+(every metric a Tpu*Exec registers must resolve in the central table),
+the registry-epoch satellite, and the event-log round trip for the new
+fallbackSummary/memoryByOperator fields."""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import memory as MEM
+from spark_rapids_tpu import metrics as M
+from spark_rapids_tpu import retry as R
+from spark_rapids_tpu import trace as TR
+from spark_rapids_tpu.columnar.device import DeviceBatch
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql import types as T
+from spark_rapids_tpu.sql.session import TpuSparkSession
+
+from tests.datagen import (IntegerGen, KeyStringGen, LongGen, SmallIntGen,
+                           gen_batch)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    TR.reset_tracing()
+    R.reset_fault_injection()
+    yield
+    TR.reset_tracing()
+    R.reset_fault_injection()
+
+
+def _conf(profile_dir=None, **extra):
+    conf = {"spark.rapids.sql.enabled": "true",
+            "spark.rapids.sql.batchSizeRows": "512"}
+    if profile_dir is not None:
+        conf["spark.rapids.sql.profile.enabled"] = "true"
+        conf["spark.rapids.sql.profile.dir"] = str(profile_dir)
+    conf.update(extra)
+    return conf
+
+
+def _q1_silhouette(s):
+    df = s.createDataFrame(
+        gen_batch([("flag", KeyStringGen(cardinality=3)),
+                   ("status", SmallIntGen()),
+                   ("qty", LongGen()), ("price", IntegerGen())],
+                  3000, 31),
+        num_partitions=4)
+    return (df.filter(F.col("qty") % 5 != 0)
+            .groupBy("flag", "status")
+            .agg(F.sum("qty").alias("sq"), F.min("price").alias("mn"),
+                 F.max("price").alias("mx"), F.count("*").alias("c"))
+            .orderBy("flag", "status"))
+
+
+def _q3_silhouette(s):
+    fact = s.createDataFrame(
+        gen_batch([("k", SmallIntGen()), ("item", IntegerGen()),
+                   ("amt", LongGen())], 2500, 32),
+        num_partitions=3)
+    dim = s.createDataFrame(
+        gen_batch([("item2", IntegerGen()),
+                   ("brand", KeyStringGen(cardinality=5))], 400, 33),
+        num_partitions=2)
+    return (fact.join(dim, fact["item"] == dim["item2"], "inner")
+            .groupBy("brand").agg(F.sum("amt").alias("sa"),
+                                  F.count("*").alias("c"))
+            .orderBy("brand").limit(50))
+
+
+def _run(df_fn, conf):
+    spark = TpuSparkSession(conf)
+    try:
+        out = df_fn(spark)._execute().to_pydict()
+        return out, spark.last_profile_path
+    finally:
+        spark.stop()
+
+
+# ---------------------------------------------------------------------------
+# Artifact schema well-formedness
+# ---------------------------------------------------------------------------
+
+def _walk_plan(entry):
+    yield entry
+    for fe in entry.get("fused", []):
+        yield fe
+    for c in entry.get("children", []):
+        yield from _walk_plan(c)
+
+
+def test_profile_artifact_schema_wellformed(tmp_path):
+    _out, path = _run(_q1_silhouette, _conf(tmp_path / "prof"))
+    assert path is not None and os.path.exists(path), path
+    with open(path) as f:
+        prof = json.load(f)
+    for key in ("version", "queryId", "wallSeconds", "outputRows",
+                "plan", "memory", "explain", "conf", "jitCaches"):
+        assert key in prof, key
+    assert prof["version"] == 1 and prof["outputRows"] > 0
+    nodes = list(_walk_plan(prof["plan"]))
+    assert any(n["op"] == "TpuHashAggregateExec" for n in nodes), nodes
+    assert any(n.get("device") for n in nodes)
+    # every node has op + simpleString; device nodes carry metrics with
+    # numOutputRows present (zero-valued metrics kept)
+    for n in nodes:
+        assert n["op"] and n["simpleString"]
+    device_metrics = [n["metrics"] for n in nodes
+                      if n.get("device") and "metrics" in n]
+    assert any("numOutputRows" in m for m in device_metrics)
+    # the memory section reconciles: per-op live bytes sum to the pool
+    pool = prof["memory"]["pool"]
+    ops = prof["memory"]["operators"]
+    assert sum(st["liveBytes"] for st in ops.values()) \
+        == pool["deviceBytes"]
+    if ops:
+        assert pool["peakDeviceBytes"] \
+            <= sum(st["peakBytes"] for st in ops.values())
+    # explain: this query is fully placed
+    assert prof["explain"]["coverage"] == 1.0
+    assert prof["explain"]["deviceOps"]
+
+
+@pytest.mark.parametrize("df_fn", [_q1_silhouette, _q3_silhouette],
+                         ids=["q1", "q3"])
+def test_profiled_results_bit_identical(df_fn, tmp_path):
+    clean, _ = _run(df_fn, _conf())
+    profiled, path = _run(df_fn, _conf(tmp_path / "prof"))
+    assert profiled == clean
+    assert path is not None
+
+
+# ---------------------------------------------------------------------------
+# Owner-attributed HBM accounting
+# ---------------------------------------------------------------------------
+
+def _batch(n=256, seed=0):
+    rng = np.random.default_rng(seed)
+    col = HostColumn(T.LongT, rng.integers(0, 1 << 40, n),
+                     np.ones(n, dtype=bool))
+    return DeviceBatch.from_host(
+        HostBatch(T.StructType([T.StructField("v", T.LongT)]), [col], n))
+
+
+def test_store_owner_ledger_spill_shrinks_live_peak_monotone(tmp_path):
+    """Unit sanity on the ledger: registration grows live+peak, an LRU
+    spill shrinks the owner's LIVE bytes while its PEAK stays put, and
+    the per-op live sum always equals the pool's device bytes."""
+    b1, b2, b3 = _batch(256, 1), _batch(256, 2), _batch(256, 3)
+    store = MEM.DeviceStore(b1.sizeof() * 2 + 10, 1 << 30,
+                            str(tmp_path))
+    reg_a = M.MetricRegistry(owner="OpA")
+    reg_b = M.MetricRegistry(owner="OpB")
+    h1 = store.register(b1, owner="OpA", metrics=reg_a)
+    assert store.owner_stats()["OpA"]["liveBytes"] == b1.sizeof()
+    h2 = store.register(b2, owner="OpA", metrics=reg_a)
+    peak_a = store.owner_stats()["OpA"]["peakBytes"]
+    assert peak_a == b1.sizeof() + b2.sizeof()
+    # third registration under a second owner forces an LRU spill of
+    # OpA's oldest handle
+    h3 = store.register(b3, owner="OpB", metrics=reg_b)
+    st = store.owner_stats()
+    assert store.spill_count >= 1
+    assert st["OpA"]["liveBytes"] < peak_a          # spill shrank live
+    assert st["OpA"]["peakBytes"] == peak_a         # peak is monotone
+    assert sum(s["liveBytes"] for s in st.values()) \
+        == store.device_bytes                        # ledger reconciles
+    assert store.peak_device_bytes <= sum(
+        s["peakBytes"] for s in st.values())
+    # the owning exec's metrics got the attribution
+    assert reg_a.value(M.PEAK_DEVICE_MEMORY) == peak_a
+    assert reg_a.value(M.SPILL_BYTES) > 0
+    assert reg_b.value(M.SPILL_BYTES) == 0
+    for h in (h1, h2, h3):
+        h.close()
+    st = store.owner_stats()
+    assert all(s["liveBytes"] == 0 for s in st.values())
+    # reset_peaks re-bases the watermarks at current (zero) occupancy
+    store.reset_peaks()
+    assert store.peak_device_bytes == 0
+    assert store.owner_stats() == {}
+
+
+def test_peak_device_memory_is_per_instance_not_per_class(tmp_path):
+    """Two exec INSTANCES of the same class must not report each
+    other's bytes as their own peakDeviceMemory (the store ledger
+    aggregates by class; the metric must not)."""
+    b1, b2 = _batch(256, 6), _batch(256, 7)
+    store = MEM.DeviceStore(1 << 30, 1 << 30, str(tmp_path))
+    reg1 = M.MetricRegistry(owner="TpuShuffleExchangeExec")
+    reg2 = M.MetricRegistry(owner="TpuShuffleExchangeExec")
+    h1 = store.register(b1, owner="TpuShuffleExchangeExec", metrics=reg1)
+    h2 = store.register(b2, owner="TpuShuffleExchangeExec", metrics=reg2)
+    assert reg1.value(M.PEAK_DEVICE_MEMORY) == b1.sizeof()
+    assert reg2.value(M.PEAK_DEVICE_MEMORY) == b2.sizeof()
+    # the class-aggregated ledger still sees both
+    assert store.owner_stats()["TpuShuffleExchangeExec"]["peakBytes"] \
+        == b1.sizeof() + b2.sizeof()
+    h1.close()
+    h2.close()
+
+
+def test_profile_peaks_rebased_per_query(tmp_path):
+    """A tiny query after a big one (same session) must report its OWN
+    pool/owner peaks, not the big query's high-watermark."""
+    spark = TpuSparkSession(_conf(tmp_path / "prof"))
+    try:
+        _q1_silhouette(spark)._execute()
+        big = json.load(open(spark.last_profile_path))
+        (spark.createDataFrame({"k": [1, 2], "v": [3, 4]}, "k int, v int")
+         .groupBy("k").agg(F.sum("v").alias("s")).orderBy("k")._execute())
+        small = json.load(open(spark.last_profile_path))
+    finally:
+        spark.stop()
+    big_peak = big["memory"]["pool"]["peakDeviceBytes"]
+    small_peak = small["memory"]["pool"]["peakDeviceBytes"]
+    assert 0 < small_peak < big_peak, (small_peak, big_peak)
+
+
+@pytest.mark.fault
+def test_per_op_peaks_sane_under_injected_oom(tmp_path):
+    """Injected OOMs force retry spills; the profile's per-op ledger
+    must stay consistent (live sums to pool, peaks bound the pool
+    watermark) and results stay bit-identical."""
+    clean, _ = _run(_q1_silhouette, _conf())
+    R.reset_fault_injection()
+    MEM.reset_store_peaks()
+    profiled, path = _run(_q1_silhouette, _conf(
+        tmp_path / "prof",
+        **{"spark.rapids.sql.test.injectOOM": "3",
+           "spark.rapids.sql.retry.backoffMs": "1",
+           "spark.rapids.sql.retry.maxBackoffMs": "4"}))
+    assert profiled == clean
+    with open(path) as f:
+        prof = json.load(f)
+    ops = prof["memory"]["operators"]
+    pool = prof["memory"]["pool"]
+    assert sum(st["liveBytes"] for st in ops.values()) \
+        == pool["deviceBytes"]
+    for st in ops.values():
+        assert st["peakBytes"] >= st["liveBytes"] >= 0
+    # retry spills happened and were recorded per-plan
+    metrics = {}
+    for n in _walk_plan(prof["plan"]):
+        for k, v in (n.get("metrics") or {}).items():
+            metrics[k] = metrics.get(k, 0) + v
+    assert metrics.get("retryCount", 0) > 0
+
+
+def test_trace_counter_events_for_pool_occupancy(tmp_path):
+    """With tracing on, store transitions sample deviceStoreBytes /
+    hostStoreBytes as Chrome "C" counter events (the Perfetto HBM
+    timeline)."""
+    conf = _conf(**{"spark.rapids.sql.trace.enabled": "true",
+                    "spark.rapids.sql.trace.dir": str(tmp_path / "tr")})
+    _run(_q1_silhouette, conf)
+    files = sorted(glob.glob(os.path.join(str(tmp_path / "tr"),
+                                          "trace-*.json")))
+    assert files
+    tr = TR.load_trace(files[-1])
+    series = {c["name"] for c in tr["counters"]}
+    assert "deviceStoreBytes" in series, series
+    assert all(isinstance(c["value"], int) for c in tr["counters"])
+    assert tr["meta"]["counterCount"] == len(tr["counters"])
+
+
+# ---------------------------------------------------------------------------
+# Explain / fallback reasons
+# ---------------------------------------------------------------------------
+
+def test_explain_not_on_tpu_reports_forced_fallback(capsys, tmp_path):
+    """A query with a known forced fallback (the Filter replacement
+    disabled per-op) yields a non-empty NOT_ON_TPU report naming the op
+    and the reason, and the profile aggregates it."""
+    conf = _conf(tmp_path / "prof",
+                 **{"spark.rapids.sql.explain": "NOT_ON_TPU",
+                    "spark.rapids.sql.exec.FilterExec": "false"})
+    spark = TpuSparkSession(conf)
+    try:
+        _q1_silhouette(spark)._execute()
+        report = spark.last_rewrite_report
+        path = spark.last_profile_path
+    finally:
+        spark.stop()
+    out = capsys.readouterr().out
+    assert "!Exec <CpuFilterExec> cannot run on TPU because " \
+           "the exec has been disabled" in out, out
+    assert report.fallbacks and report.coverage < 1.0
+    with open(path) as f:
+        ex = json.load(f)["explain"]
+    assert any(fb["op"] == "CpuFilterExec" for fb in ex["fallbacks"])
+    assert ex["reasonCounts"]
+
+
+def test_explain_all_lists_device_ops(capsys):
+    spark = TpuSparkSession(_conf(
+        **{"spark.rapids.sql.explain": "ALL"}))
+    try:
+        _q1_silhouette(spark)._execute()
+    finally:
+        spark.stop()
+    out = capsys.readouterr().out
+    assert "will run on TPU" in out
+    assert "TpuHashAggregateExec" in out or "HashAggregate" in out
+
+
+def test_explain_not_on_gpu_alias(capsys):
+    spark = TpuSparkSession(_conf(
+        **{"spark.rapids.sql.explain": "NOT_ON_GPU",
+           "spark.rapids.sql.exec.SortExec": "false"}))
+    try:
+        _q1_silhouette(spark)._execute()
+    finally:
+        spark.stop()
+    assert "cannot run on TPU" in capsys.readouterr().out
+
+
+def test_check_expr_tree_reason_names_offending_subtree():
+    """The reason for a deep expression failure must render the
+    offending SUBTREE, not just the expression class name."""
+    from spark_rapids_tpu.conf import TpuConf
+    from spark_rapids_tpu.overrides import check_expr_tree
+    from spark_rapids_tpu.sql import expressions as E
+    attr = E.AttributeReference("s", T.StringT, True)
+    # Upper is .incompat-gated: without incompatibleOps it falls back
+    tree = E.Alias(E.Upper(attr), "u")
+    reason = check_expr_tree(tree, TpuConf({}))
+    assert reason is not None and "Upper" in reason
+    assert "<" in reason and "s#" in reason, reason  # subtree named
+
+
+# ---------------------------------------------------------------------------
+# tools profile CLI
+# ---------------------------------------------------------------------------
+
+def test_tools_profile_cli_smoke(tmp_path, capsys):
+    from spark_rapids_tpu.tools import _main
+    pdir = tmp_path / "prof"
+    _run(_q1_silhouette, _conf(pdir))
+    path = sorted(glob.glob(os.path.join(str(pdir),
+                                         "profile-*.json")))[0]
+    assert _main(["profile", path]) == 0
+    out = capsys.readouterr().out
+    assert "annotated plan" in out
+    assert "top memory consumers" in out
+    assert "TpuHashAggregate" in out
+    # directory mode renders every artifact in it
+    assert _main(["profile", str(pdir)]) == 0
+    # empty directory is reported, not a crash
+    os.makedirs(tmp_path / "empty", exist_ok=True)
+    assert _main(["profile", str(tmp_path / "empty")]) == 1
+    # a path-looking argument that does NOT exist errors instead of
+    # falling through to live-SQL mode and "executing" the path
+    assert _main(["profile", str(tmp_path / "missing" / "p.json")]) == 1
+    assert "no such profile" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# Satellites: metric-description lint, registry epoch, event-log fields
+# ---------------------------------------------------------------------------
+
+def test_every_registered_tpu_metric_is_described():
+    """CI lint (the PR-5 drift guard, extended): every metric ANY
+    Tpu*Exec registers at runtime must resolve in the central
+    description table metrics.METRIC_DESCRIPTIONS (memory metrics
+    included), so profile/docs/bench never disagree on names."""
+    spark = TpuSparkSession(_conf())
+    try:
+        spark.start_capture()
+        _q1_silhouette(spark)._execute()
+        _q3_silhouette(spark)._execute()
+        plans = spark.get_captured_plans()
+    finally:
+        spark.stop()
+    seen = set()
+
+    def walk(p):
+        ms = getattr(p, "metrics", None)
+        if ms is not None:
+            seen.update(ms.metrics.keys())
+        for op in getattr(p, "fused_ops", []):
+            fm = getattr(op, "metrics", None)
+            if fm is not None:
+                seen.update(fm.metrics.keys())
+        for c in getattr(p, "children", []):
+            walk(c)
+
+    for p in plans:
+        walk(p)
+    assert seen, "no metrics registered?"
+    undescribed = sorted(k for k in seen if M.describe_metric(k) is None)
+    assert not undescribed, (
+        f"metrics without an entry in metrics.METRIC_DESCRIPTIONS: "
+        f"{undescribed} — add them so profile/docs/bench agree")
+
+
+def test_every_metric_constant_is_described_and_documented():
+    """Both directions of the drift guard: every metrics.py name
+    constant has a description, and the generated observability doc
+    carries the whole description table."""
+    from spark_rapids_tpu.tools import (generate_observability_docs,
+                                        metric_name_constants)
+    for const, name in metric_name_constants():
+        assert name in M.METRIC_DESCRIPTIONS, (
+            f"constant {const} = {name!r} missing from "
+            "METRIC_DESCRIPTIONS")
+    doc = generate_observability_docs()
+    for name, desc in M.METRIC_DESCRIPTIONS.items():
+        assert name in doc, name
+    for key in ("spark.rapids.sql.profile.enabled",
+                "spark.rapids.sql.profile.dir",
+                "spark.rapids.sql.explain"):
+        assert key in doc, key
+    assert "Reading a query profile" in doc
+    assert "Explain / fallback reasons" in doc
+
+
+def test_registry_epoch_scopes_process_wide_snapshot():
+    """Satellite: process-wide registry_snapshot bleeds earlier runs'
+    registries; an epoch stamp scopes it to registries created since
+    begin_epoch()."""
+    before = M.MetricRegistry(owner="Old")
+    before.create("numOutputRows", M.ESSENTIAL).add(7)
+    epoch = M.begin_epoch()
+    after = M.MetricRegistry(owner="New")
+    after.create("numOutputRows", M.ESSENTIAL).add(5)
+    scoped = M.registry_snapshot(epoch=epoch)["metrics"]
+    whole = M.registry_snapshot()["metrics"]
+    assert scoped.get("numOutputRows", 0) < whole["numOutputRows"]
+    # keep strong refs so the weak registry set cannot drop them early
+    assert before.epoch < epoch <= after.epoch
+
+
+def test_event_log_round_trip_fallback_summary_and_memory(tmp_path):
+    from spark_rapids_tpu.event_log import read_events
+    log_dir = str(tmp_path / "events")
+    conf = _conf(**{"spark.rapids.sql.eventLog.dir": log_dir,
+                    "spark.rapids.sql.exec.SortExec": "false"})
+    _run(_q1_silhouette, conf)
+    events = list(read_events(log_dir))
+    assert len(events) == 1
+    ev = events[0]
+    assert ev["version"] == 2
+    # per-query fallback summary rides along
+    fs = ev["fallbackSummary"]
+    assert fs["deviceOps"] and 0.0 < fs["coverage"] < 1.0
+    assert fs["reasonCounts"]
+    # per-op peak HBM ledger rides along and reconciles with storeStats
+    mem = ev["memoryByOperator"]
+    assert mem and all(set(v) == {"liveBytes", "peakBytes"}
+                       for v in mem.values())
+    assert sum(v["liveBytes"] for v in mem.values()) \
+        == ev["storeStats"]["deviceBytes"]

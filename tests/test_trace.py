@@ -23,7 +23,8 @@ from spark_rapids_tpu.sql.session import TpuSparkSession
 from tests.datagen import (IntegerGen, KeyStringGen, LongGen, SmallIntGen,
                            gen_batch)
 
-VALID_PH = {"M", "B", "E", "i", "I", "X"}
+# "C" = counter samples (device/host pool occupancy, PR 6 profile work)
+VALID_PH = {"M", "B", "E", "i", "I", "X", "C"}
 
 
 @pytest.fixture(autouse=True)
