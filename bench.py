@@ -633,6 +633,127 @@ def run_profile(clean_wall: float, cpu_rows) -> dict:
     }
 
 
+def run_serving(clean_wall: float, cpu_rows, q3_cpu_rows) -> dict:
+    """Mixed q1/q3 workload through the query server
+    (docs/serving.md): sustained QPS and p50/p99 latency at
+    concurrency 1/4/16, plan-cache and jit-cache hit rates warm vs
+    cold, per-tenant queue waits. Results are asserted bit-identical
+    to the CPU oracle on every request. Skips gracefully when the
+    server cannot bind."""
+    import threading
+
+    from spark_rapids_tpu.plan_cache import PLAN_CACHE
+    from spark_rapids_tpu.serve import QueryServer, ServeClient
+    from spark_rapids_tpu.serve.scheduler import percentile
+    fresh_leg()
+    conf = dict(TPU_CONF)
+    # admission sized for the c=16 leg: queries queue rather than reject
+    conf.update({
+        "spark.rapids.sql.serve.maxConcurrentQueries": "4",
+        "spark.rapids.sql.serve.maxQueued": "64",
+        "spark.rapids.sql.serve.maxConcurrentPerTenant": "4",
+    })
+    try:
+        srv = QueryServer(conf).start()
+    except OSError as e:
+        return {"skipped": True, "reason": f"cannot bind: {e!r}"}
+    try:
+        srv.register_view("lineitem", DATA_DIR)
+        for name in ("item", "date_dim", "store_sales"):
+            srv.register_view(name, os.path.join(TPCDS_DIR, name))
+
+        def check(kind, rows):
+            assert_rows_match(cpu_rows if kind == "q1" else q3_cpu_rows,
+                              rows)
+
+        # cold: first submission of each shape populates plan cache +
+        # jit caches through the server path
+        cold_stats = {"hits0": PLAN_CACHE.hits,
+                      "misses0": PLAN_CACHE.misses}
+        t0 = time.perf_counter()
+        with ServeClient(srv.port, tenant="warmup") as c:
+            b, _ = c.sql(Q1)
+            check("q1", [tuple(r) for r in b.rows()])
+            b, _ = c.sql(TPCDS_Q3)
+            check("q3", [tuple(r) for r in b.rows()])
+        cold_s = time.perf_counter() - t0
+        cold = {
+            "wall_s": round(cold_s, 4),
+            "planCacheMisses": PLAN_CACHE.misses - cold_stats["misses0"],
+            "planCacheHits": PLAN_CACHE.hits - cold_stats["hits0"],
+        }
+
+        legs = {}
+        n_queries = int(os.environ.get("BENCH_SERVE_QUERIES", "8"))
+        for concurrency in (1, 4, 16):
+            h0, m0 = PLAN_CACHE.hits, PLAN_CACHE.misses
+            total = max(n_queries, concurrency)
+            lat: list = []
+            errors: list = []
+            lat_lock = threading.Lock()
+
+            def worker(i):
+                try:
+                    with ServeClient(srv.port,
+                                     tenant=f"t{i % 4}") as c:
+                        kind = "q1" if i % 2 == 0 else "q3"
+                        tq = time.perf_counter()
+                        b, _h = c.sql(Q1 if kind == "q1" else TPCDS_Q3)
+                        dt = time.perf_counter() - tq
+                        check(kind, [tuple(r) for r in b.rows()])
+                        with lat_lock:
+                            lat.append(dt)
+                except Exception as e:  # noqa: BLE001 - reported below
+                    errors.append(repr(e))
+
+            t0 = time.perf_counter()
+            threads = []
+            for i in range(total):
+                t = threading.Thread(target=worker, args=(i,))
+                t.start()
+                threads.append(t)
+                # cap live threads at the leg's concurrency
+                while sum(1 for x in threads if x.is_alive()) \
+                        >= concurrency:
+                    time.sleep(0.005)
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            if errors:
+                legs[f"c{concurrency}"] = {"errors": errors[:3]}
+                continue
+            hits = PLAN_CACHE.hits - h0
+            misses = PLAN_CACHE.misses - m0
+            legs[f"c{concurrency}"] = {
+                "queries": total,
+                "wall_s": round(wall, 4),
+                "qps": round(total / wall, 4),
+                "latency_s": {
+                    "p50": round(percentile(lat, 0.50), 4),
+                    "p99": round(percentile(lat, 0.99), 4),
+                },
+                "planCacheHitRate": round(
+                    hits / max(1, hits + misses), 4),
+            }
+        st = srv.stats()
+        jit = st["jitCaches"]
+        warm_hit_rates = {
+            name: round(s["hits"] / max(1, s["hits"] + s["misses"]), 4)
+            for name, s in sorted(jit.items())
+            if s["hits"] + s["misses"] > 0}
+        return {
+            "skipped": False,
+            "clean_wall_s": round(clean_wall, 4),
+            "cold": cold,
+            "concurrency": legs,
+            "admission": st["admission"],
+            "tenantsHBM": st["tenantsHBM"],
+            "jitCacheHitRates": warm_hit_rates,
+        }
+    finally:
+        srv.shutdown()
+
+
 def main():
     from spark_rapids_tpu.metrics import registry_snapshot
     from spark_rapids_tpu.sql.session import TpuSparkSession
@@ -691,6 +812,14 @@ def main():
         profile_leg = {"skipped": True,
                        "reason": f"profile leg failed: {e!r}"}
 
+    # serving leg (docs/serving.md): QPS/latency through the query
+    # server at concurrency 1/4/16, equally fault-isolated
+    try:
+        serving = run_serving(fused["wall_s"], cpu_rows, q3_cpu_rows)
+    except Exception as e:  # noqa: BLE001 - reported, not swallowed
+        serving = {"skipped": True,
+                   "reason": f"serving leg failed: {e!r}"}
+
     cpu_t = min(cpu_times)
     tpu_t = fused["wall_s"]
     q3_tpu_t = fused["q3"]["wall_s"]
@@ -727,6 +856,7 @@ def main():
             "robustness": robustness,
             "trace": trace_leg,
             "profile": profile_leg,
+            "serving": serving,
             "jitCaches": registry_snapshot()["jitCaches"],
             "tpcds_q3": {
                 "device_wall_s": round(q3_tpu_t, 4),

@@ -362,6 +362,59 @@ INJECT_CHIP_FAILURE = conf(
     "persistently fail; the mesh degrades to the surviving chips "
     "(docs/robustness.md degradation ladder).").string("")
 
+PLAN_CACHE_ENABLED = conf("spark.rapids.sql.planCache.enabled").doc(
+    "Cross-query plan-rewrite cache: the finished physical plan "
+    "(Planner + TpuOverrides rewrite + CBO + whole-stage fusion) is "
+    "cached per normalized logical-plan signature, and repeated query "
+    "shapes clone the cached template instead of re-running the "
+    "rewrite pipeline. Results are bit-identical (each execution gets "
+    "fresh operator instances and metric registries); the cache is the "
+    "bounded LRU 'planRewrite' in the jit-cache registry. Off by "
+    "default; the query server enables it for its sessions "
+    "(docs/serving.md).").boolean(False)
+
+SERVE_MAX_CONCURRENT = conf(
+    "spark.rapids.sql.serve.maxConcurrentQueries").doc(
+    "Queries the server executes simultaneously across all tenants; "
+    "admitted queries still contend on concurrentGpuTasks for actual "
+    "device access — this bounds whole-query concurrency the way "
+    "GpuSemaphore bounds task concurrency (docs/serving.md)."
+    ).integer(4)
+
+SERVE_MAX_QUEUED = conf("spark.rapids.sql.serve.maxQueued").doc(
+    "Bound on queries waiting for admission; a request arriving with "
+    "the queue full is REJECTED immediately (backpressure — the client "
+    "sees status=rejected and retries with its own policy) instead of "
+    "growing an unbounded queue (docs/serving.md).").integer(32)
+
+SERVE_MAX_PER_TENANT = conf(
+    "spark.rapids.sql.serve.maxConcurrentPerTenant").doc(
+    "Per-tenant in-flight query limit: one tenant cannot occupy every "
+    "execution slot no matter how fast it submits (docs/serving.md)."
+    ).integer(2)
+
+SERVE_FAIR_SHARE_FACTOR = conf(
+    "spark.rapids.sql.serve.fairShareFactor").doc(
+    "Fair-share HBM arbitration: a tenant whose live device-store "
+    "bytes exceed factor * (pool budget / live tenants) is over share "
+    "— its batches spill FIRST under pool pressure (billing the spill "
+    "to the offender, not an LRU victim) and its queued queries are "
+    "passed over while other tenants wait (docs/serving.md)."
+    ).double(1.5)
+
+SERVE_HOST = conf("spark.rapids.sql.serve.host").doc(
+    "Interface the query server binds (local serving; the cross-host "
+    "tier is ROADMAP item 5).").string("127.0.0.1")
+
+SERVE_PORT = conf("spark.rapids.sql.serve.port").doc(
+    "Port the query server binds (0 = ephemeral; the bound port is "
+    "printed/returned for clients).").integer(0)
+
+SERVE_TENANT_ID = conf("spark.rapids.sql.serve.tenantId").internal().doc(
+    "Session-scoped tenant id the server sets on each tenant's "
+    "session; threads through trace files, event-log lines, profile "
+    "artifacts, and the store's per-tenant HBM ledger.").string("")
+
 PARQUET_DEVICE_DECODE = conf(
     "spark.rapids.sql.format.parquet.deviceDecode.enabled").doc(
     "Decode Parquet pages ON DEVICE: host threads read raw column-chunk "

@@ -81,7 +81,8 @@ def write_event(log_dir: str, session_id: int, physical,
                 store_stats: Optional[Dict[str, int]] = None,
                 conf=None,
                 memory_by_op: Optional[Dict[str, Dict[str, int]]] = None,
-                query_id: Optional[int] = None) -> None:
+                query_id: Optional[int] = None,
+                tenant: Optional[str] = None) -> None:
     """Append one query-completion event; failures never break the
     query (observability must not take down execution)."""
     try:
@@ -97,6 +98,10 @@ def write_event(log_dir: str, session_id: int, physical,
             "plan": repr(physical),
             "ops": _collect_ops(physical),
         }
+        if tenant:
+            # serving tenancy: the session's tenant id rides on every
+            # event line so offline tools can slice per tenant
+            rec["tenant"] = tenant
         if rewrite_report is not None:
             rec["replacedAny"] = rewrite_report.replaced_any
             rec["fallbacks"] = [

@@ -40,9 +40,15 @@ class JitCache:
         self.capacity = capacity or DEFAULT_CAPACITY
         self._data: "OrderedDict[Any, Any]" = OrderedDict()
         self._lock = threading.Lock()
+        # single-flight (docs/serving.md): keys whose build is in
+        # progress map to the Event concurrent requesters wait on, so
+        # two queries sharing a shape never compile the same program
+        # twice nor corrupt LRU order racing a duplicate put
+        self._building: Dict[Any, Any] = {}
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self.contention = 0  # threads that blocked on an in-progress build
         # per-thread (miss time, key) so the build between a miss and
         # its put traces as one `compile` span (best-effort: only the
         # get->put pattern on one thread is covered, which is every
@@ -86,16 +92,53 @@ class JitCache:
 
     def get_or_build(self, key, build: Callable[[], Any]
                      ) -> Tuple[Any, bool]:
-        """Returns ``(value, was_miss)``. The build runs OUTSIDE the
-        lock (tracing can be slow and may re-enter other caches); a
-        racing duplicate build is harmless — last write wins and both
-        callables are equivalent."""
-        val = self.get(key)
-        if val is not None:
-            return val, False
-        val = build()
-        self.put(key, val)
-        return val, True
+        """Returns ``(value, was_miss)``. SINGLE-FLIGHT: exactly one
+        thread builds a missing key; concurrent requesters of the SAME
+        key block on the builder's Event (counted as ``contention`` in
+        the stats and a ``compileCacheContention`` trace instant) and
+        then read the finished value — no duplicate compiles under
+        concurrent queries sharing a shape. The build itself runs
+        OUTSIDE the lock (tracing can be slow and may re-enter other
+        caches). If a build raises, its waiters re-race: one becomes
+        the new builder, so a transient failure never wedges the key."""
+        import time
+
+        from spark_rapids_tpu import trace as _trace
+        while True:
+            wait_ev = None
+            with self._lock:
+                val = self._data.get(key)
+                if val is not None:
+                    self._data.move_to_end(key)
+                    self.hits += 1
+                    return val, False
+                ev = self._building.get(key)
+                if ev is None:
+                    self.misses += 1
+                    my_ev = self._building[key] = threading.Event()
+                    break
+                self.contention += 1
+                wait_ev = ev
+            _trace.instant("compileCacheContention", cache=self.name)
+            wait_ev.wait()
+        t0 = time.perf_counter_ns()
+        try:
+            val = build()
+            with self._lock:
+                self._data[key] = val
+                self._data.move_to_end(key)
+                while len(self._data) > self.capacity:
+                    self._data.popitem(last=False)
+                    self.evictions += 1
+            qt = _trace._ACTIVE
+            if qt is not None:
+                qt.add("compile", t0, time.perf_counter_ns(),
+                       cache=self.name)
+            return val, True
+        finally:
+            with self._lock:
+                self._building.pop(key, None)
+            my_ev.set()
 
     def __len__(self) -> int:
         with self._lock:
@@ -109,7 +152,8 @@ class JitCache:
         with self._lock:
             return {"size": len(self._data), "capacity": self.capacity,
                     "hits": self.hits, "misses": self.misses,
-                    "evictions": self.evictions}
+                    "evictions": self.evictions,
+                    "contention": self.contention}
 
 
 def cache_stats() -> Dict[str, Dict[str, int]]:

@@ -61,6 +61,67 @@ TIER_DISK = "disk"
 # not a leak)
 UNATTRIBUTED = "(unattributed)"
 
+# ---------------------------------------------------------------------------
+# Tenant attribution (docs/serving.md): the serving layer executes each
+# query under a tenant, and every SpillableBatch registered during that
+# query bills to the tenant's HBM ledger. Attribution rides on the
+# registering exec's METRIC REGISTRY (``stamp_plan_tenant`` tags every
+# registry of the executing plan before collect), because the registry
+# object travels with the exec's closures into whatever pool thread
+# performs the registration — a thread-local could not follow the work
+# across the task/reader/pack pools. A thread-local scope remains as
+# the fallback for registrations without a registry.
+# ---------------------------------------------------------------------------
+
+_TENANT_TLS = threading.local()
+
+
+def current_tenant() -> Optional[str]:
+    """The calling thread's fallback tenant (None = untenanted; only
+    the serving layer sets this, for registrations without metrics)."""
+    return getattr(_TENANT_TLS, "name", None)
+
+
+import contextlib  # noqa: E402  (scope helper belongs with the TLS)
+
+
+@contextlib.contextmanager
+def tenant_scope(name: Optional[str]):
+    """Thread-local fallback tenant for registrations that carry no
+    metric registry (no-op for None)."""
+    if name is None:
+        yield
+        return
+    prev = getattr(_TENANT_TLS, "name", None)
+    _TENANT_TLS.name = name
+    try:
+        yield
+    finally:
+        _TENANT_TLS.name = prev
+
+
+def stamp_plan_tenant(physical, tenant: Optional[str]) -> None:
+    """Tag every metric registry in ``physical`` (fused constituents
+    included) with the owning tenant, so store registrations made from
+    ANY pool thread bill to the right per-tenant ledger. Called by
+    ``execute_plan`` before the collect when the session carries a
+    tenant id (docs/serving.md)."""
+    if tenant is None:
+        return
+
+    def walk(p) -> None:
+        m = getattr(p, "metrics", None)
+        if m is not None:
+            m._tenant = tenant
+        for op in getattr(p, "fused_ops", []):
+            fm = getattr(op, "metrics", None)
+            if fm is not None:
+                fm._tenant = tenant
+        for c in getattr(p, "children", []):
+            walk(c)
+
+    walk(physical)
+
 
 class _State:
     """Per-handle storage owned by the store (survives handle GC so the
@@ -68,7 +129,7 @@ class _State:
 
     __slots__ = ("tier", "device", "host", "disk_path", "device_bytes",
                  "host_bytes", "closed", "rows", "ever_spilled", "owner",
-                 "metrics_ref")
+                 "metrics_ref", "tenant")
 
     def __init__(self, batch: DeviceBatch, owner: str = UNATTRIBUTED,
                  metrics=None):
@@ -90,6 +151,12 @@ class _State:
         self.owner = owner
         self.metrics_ref = (weakref.ref(metrics)
                             if metrics is not None else None)
+        # tenant attribution: the registry's stamp (stamp_plan_tenant)
+        # wins because it follows the work across pool threads; the
+        # thread-local scope is the metric-less fallback
+        self.tenant: Optional[str] = (
+            getattr(metrics, "_tenant", None) if metrics is not None
+            else None) or current_tenant()
 
 
 class SpillableBatch:
@@ -177,6 +244,20 @@ class DeviceStore:
         # times, so the per-op view always reconciles with the pool
         self.owner_live: Dict[str, int] = {}
         self.owner_peak: Dict[str, int] = {}
+        # tenant-attributed ledger (docs/serving.md): live/peak HBM and
+        # spilled bytes per serving tenant. Invariant mirrored from the
+        # owner ledger: sum(tenant_live) == device bytes registered
+        # under ANY tenant (untenanted bytes are outside the ledger).
+        self.tenant_live: Dict[str, int] = {}
+        self.tenant_peak: Dict[str, int] = {}
+        self.tenant_spill: Dict[str, int] = {}
+        # fair-share HBM arbitration: a tenant whose live bytes exceed
+        # factor * (budget / live tenants) is "over share" — its
+        # handles spill FIRST when the pool needs room, so the spill
+        # bills to the offending tenant, not whichever victim happened
+        # to be least-recently used (spark.rapids.sql.serve
+        # .fairShareFactor; set in place by get_device_store)
+        self.fair_share_factor = 1.5
         # disk-tier hygiene: every spill file carries this store's
         # prefix so close() can sweep stragglers without touching other
         # stores sharing the directory; diskFilesLive tracks files the
@@ -198,6 +279,11 @@ class DeviceStore:
         self.owner_live[st.owner] = live
         if delta > 0 and live > self.owner_peak.get(st.owner, 0):
             self.owner_peak[st.owner] = live
+        if st.tenant is not None:
+            tlive = self.tenant_live.get(st.tenant, 0) + delta
+            self.tenant_live[st.tenant] = tlive
+            if delta > 0 and tlive > self.tenant_peak.get(st.tenant, 0):
+                self.tenant_peak[st.tenant] = tlive
         m = st.metrics_ref() if st.metrics_ref is not None else None
         if m is not None:
             # instance-live rides on the registry object itself; all
@@ -276,13 +362,38 @@ class DeviceStore:
             self._enforce(exclude=hid)
             return st.device
 
+    def _over_share_tenants(self) -> Dict[str, int]:
+        """Tenants whose live HBM exceeds ``fair_share_factor`` times
+        the equal share of the budget (budget / live tenants), most
+        over-share first. Call under the lock."""
+        live = {t: v for t, v in self.tenant_live.items() if v > 0}
+        if len(live) < 2:
+            # a lone tenant cannot crowd anyone; plain LRU applies
+            return {}
+        share = self.device_budget / len(live)
+        limit = self.fair_share_factor * share
+        over = {t: v for t, v in live.items() if v > limit}
+        return dict(sorted(over.items(), key=lambda kv: -kv[1]))
+
+    def _device_spill_order(self, exclude: int) -> list:
+        """Handle ids in the order the pool should demote them:
+        over-share tenants' handles first (most-over tenant first, LRU
+        within), then plain LRU — the fair-share arbitration that bills
+        spill pressure to the tenant causing it (docs/serving.md)."""
+        over = self._over_share_tenants()
+        if not over:
+            return [h for h in self._states if h != exclude]
+        rank = {t: i for i, t in enumerate(over)}
+        ordered = sorted(
+            (h for h in self._states if h != exclude),
+            key=lambda h: rank.get(self._states[h].tenant, len(rank)))
+        return ordered
+
     def _enforce(self, exclude: int) -> None:
         if self.device_bytes > self.device_budget:
-            for hid in list(self._states):
+            for hid in self._device_spill_order(exclude):
                 if self.device_bytes <= self.device_budget:
                     break
-                if hid == exclude:
-                    continue
                 st = self._states[hid]
                 if st.tier == TIER_DEVICE:
                     self._spill_to_host(st)
@@ -310,6 +421,12 @@ class DeviceStore:
         st.ever_spilled = True
         self.spill_count += 1
         self.spilled_device_bytes += st.device_bytes
+        if st.tenant is not None:
+            # the demotion bills the OWNING tenant's spill ledger (the
+            # fair-share ordering below makes the owner usually the
+            # over-share offender, never an arbitrary victim)
+            self.tenant_spill[st.tenant] = (
+                self.tenant_spill.get(st.tenant, 0) + st.device_bytes)
         self._owner_delta(st, -st.device_bytes)
         # the demotion is billed to the OWNING operator, not whichever
         # task happened to trip the budget (per-op spillBytes)
@@ -372,7 +489,10 @@ class DeviceStore:
         HBM bytes freed."""
         freed = 0
         with self._lock:
-            for hid in list(self._states):
+            # same fair-share ordering as budget enforcement: a retry
+            # spill under multi-tenant pressure demotes the over-share
+            # tenant's working set first (docs/serving.md)
+            for hid in self._device_spill_order(exclude=-1):
                 if self.device_bytes <= target_bytes:
                     break
                 st = self._states[hid]
@@ -426,6 +546,26 @@ class DeviceStore:
                         "peakBytes": self.owner_peak.get(o, 0)}
                     for o in sorted(owners)}
 
+    def over_share_tenants(self) -> Dict[str, int]:
+        """Public snapshot of the fair-share offenders (live bytes per
+        over-share tenant, most over first) — the admission
+        controller's throttle signal (docs/serving.md)."""
+        with self._lock:
+            return self._over_share_tenants()
+
+    def tenant_stats(self) -> Dict[str, Dict[str, int]]:
+        """Per-tenant HBM ledger: live/peak/spilled bytes for every
+        serving tenant that registered batches (the admission
+        controller's fair-share signal and the server's per-tenant
+        stats surface, docs/serving.md)."""
+        with self._lock:
+            tenants = (set(self.tenant_live) | set(self.tenant_peak)
+                       | set(self.tenant_spill))
+            return {t: {"liveBytes": self.tenant_live.get(t, 0),
+                        "peakBytes": self.tenant_peak.get(t, 0),
+                        "spillBytes": self.tenant_spill.get(t, 0)}
+                    for t in sorted(tenants)}
+
     def reset_peaks(self) -> None:
         """Re-base the pool and per-owner high-watermarks at the current
         live occupancy. Bench detail legs call this (with
@@ -436,6 +576,10 @@ class DeviceStore:
             self.owner_live = {o: v for o, v in self.owner_live.items()
                                if v}
             self.owner_peak = dict(self.owner_live)
+            self.tenant_live = {t: v for t, v
+                                in self.tenant_live.items() if v}
+            self.tenant_peak = dict(self.tenant_live)
+            self.tenant_spill = {}
 
 
 def _host_sizeof(b: HostBatch) -> int:
@@ -504,9 +648,12 @@ def get_device_store(conf: TpuConf) -> DeviceStore:
                                  codec=codec)
             _STORE_KEY = key
             _ALL_STORES.append(_STORE)
-        # logging-only: toggled in place so a debug flip never replaces
-        # the live store (two stores would account one HBM independently)
+        # toggled in place so a flip never replaces the live store (two
+        # stores would account one HBM independently): debug logging and
+        # the serving fair-share factor are both policy, not identity
         _STORE.debug = bool(conf.get(MEMORY_DEBUG))
+        from spark_rapids_tpu.conf import SERVE_FAIR_SHARE_FACTOR
+        _STORE.fair_share_factor = float(conf.get(SERVE_FAIR_SHARE_FACTOR))
         return _STORE
 
 
@@ -521,3 +668,9 @@ def store_owner_stats() -> Dict[str, Dict[str, int]]:
     """The process store's per-operator HBM ledger ({} without a
     store) — the profile writer's and event log's data source."""
     return _STORE.owner_stats() if _STORE is not None else {}
+
+
+def store_tenant_stats() -> Dict[str, Dict[str, int]]:
+    """The process store's per-tenant HBM ledger ({} without a store)
+    — the admission controller's and server stats' data source."""
+    return _STORE.tenant_stats() if _STORE is not None else {}

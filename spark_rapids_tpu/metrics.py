@@ -227,6 +227,23 @@ class MetricRegistry:
         self._lock = threading.Lock()
         _REGISTRIES.add(self)
 
+    def clone_empty(self) -> "MetricRegistry":
+        """A fresh registry with the same level/owner and the same
+        PRE-CREATED (all-zero) metric names, for plan-cache clones: a
+        cached template's registries are never updated (the template is
+        never executed), so copying the names reproduces exactly the
+        event-log-v2 pre-creation contract (numOutputRows: 0 present)."""
+        r = MetricRegistry.__new__(MetricRegistry)
+        r.enabled_level = self.enabled_level
+        r.metrics = {}
+        r.owner = self.owner
+        r.epoch = _EPOCH
+        r._lock = threading.Lock()
+        _REGISTRIES.add(r)
+        for k, m in self.metrics.items():
+            r.create(k, m.level)
+        return r
+
     def create(self, name: str, level: int = MODERATE) -> TpuMetric:
         with self._lock:  # check-then-set must be atomic across tasks
             m = self.metrics.get(name)

@@ -697,6 +697,20 @@ class RewriteReport:
                 out[r] = out.get(r, 0) + 1
         return out
 
+    def print_explain(self, conf: TpuConf) -> None:
+        """Print the explain lines the configured mode asks for
+        (NOT_ON_GPU honored as an alias). ``apply_overrides`` calls
+        this once per rewrite; a plan-cache HIT replays it from the
+        cached report so `sql.explain` output does not disappear when
+        the rewrite itself was skipped (docs/serving.md)."""
+        mode = conf.explain
+        if mode == "NOT_ON_GPU":
+            mode = "NOT_ON_TPU"
+        if mode == "ALL" or (mode == "NOT_ON_TPU" and self.fallbacks):
+            text = self.format(mode)
+            if text:
+                print(text)
+
     def summary(self) -> Dict:
         """JSON-ready aggregate (profile artifact + event log v2)."""
         return {
@@ -767,13 +781,7 @@ def apply_overrides(physical: P.PhysicalPlan, conf: TpuConf,
     _record_device_ops(new_plan, report)
     # NOT_ON_GPU accepted as an alias: half the reference's docs/tests
     # spell it that way and the muscle memory is worth honoring
-    mode = conf.explain
-    if mode == "NOT_ON_GPU":
-        mode = "NOT_ON_TPU"
-    if mode == "ALL" or (mode == "NOT_ON_TPU" and report.fallbacks):
-        text = report.format(mode)
-        if text:
-            print(text)
+    report.print_explain(conf)
     return new_plan
 
 

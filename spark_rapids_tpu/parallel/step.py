@@ -51,9 +51,6 @@ def sum_count_step(mesh: Mesh) -> Callable:
     from spark_rapids_tpu.parallel.mesh import mesh_key
     n_dev = mesh.shape[SHUFFLE_AXIS]
     key = (mesh_key(mesh), "sum_count", G.kernel_salt())
-    fn = _STEP_CACHE.get(key)
-    if fn is not None:
-        return fn
 
     def per_shard(keys, vals, active):
         keys, vals, active = keys[0], vals[0], active[0]
@@ -96,8 +93,15 @@ def sum_count_step(mesh: Mesh) -> Callable:
         add = lambda a: a[None]
         return (add(fkeys), add(fsum.data), add(fcnt.data), add(fact))
 
-    sm = shard_map(per_shard, mesh=mesh,
-                   in_specs=(P(SHUFFLE_AXIS), P(SHUFFLE_AXIS),
-                             P(SHUFFLE_AXIS)),
-                   out_specs=(P(SHUFFLE_AXIS),) * 4)
-    return _STEP_CACHE.put(key, jax.jit(sm))
+    def build():
+        sm = shard_map(per_shard, mesh=mesh,
+                       in_specs=(P(SHUFFLE_AXIS), P(SHUFFLE_AXIS),
+                                 P(SHUFFLE_AXIS)),
+                       out_specs=(P(SHUFFLE_AXIS),) * 4)
+        return jax.jit(sm)
+
+    # single-flight get_or_build (not raw get/put): two concurrent
+    # queries racing the first mesh-step compile would otherwise both
+    # trace+jit the program (docs/serving.md thread-safety audit)
+    fn, _ = _STEP_CACHE.get_or_build(key, build)
+    return fn

@@ -108,9 +108,18 @@ def build_profile(physical, report, conf_obj, wall_s: float, rows: int,
             "pool": store.stats() if store is not None else {},
             "operators": (store.owner_stats()
                           if store is not None else {}),
+            "tenants": (store.tenant_stats()
+                        if store is not None else {}),
         },
         "jitCaches": cache_stats(),
     }
+    if conf_obj is not None:
+        from spark_rapids_tpu.conf import SERVE_TENANT_ID
+        tenant = str(conf_obj.get(SERVE_TENANT_ID))
+        if tenant:
+            # serving tenancy: the artifact names the tenant the query
+            # executed for (matches the event-log line's field)
+            prof["tenant"] = tenant
     if report is not None:
         prof["explain"] = report.summary()
     if conf_obj is not None:

@@ -61,7 +61,8 @@ class TpuSemaphore:
     def __init__(self, permits: int):
         import threading
         self.permits = max(1, permits)
-        self._sem = threading.Semaphore(self.permits)
+        self._in_use = 0
+        self._cv = threading.Condition()
         self._held = threading.local()
 
     def acquire_if_necessary(self, metrics=None) -> None:
@@ -76,7 +77,10 @@ class TpuSemaphore:
         if getattr(self._held, "count", 0) > 0:
             return
         t0 = time.perf_counter_ns()
-        self._sem.acquire()
+        with self._cv:
+            while self._in_use >= self.permits:
+                self._cv.wait()
+            self._in_use += 1
         t1 = time.perf_counter_ns()
         if metrics is not None:
             from spark_rapids_tpu import metrics as M
@@ -92,20 +96,60 @@ class TpuSemaphore:
         permit in one call at C2R / task end)."""
         if getattr(self._held, "count", 0) > 0:
             self._held.count = 0
-            self._sem.release()
+            with self._cv:
+                self._in_use -= 1
+                self._cv.notify()
+
+    def resize(self, permits: int) -> None:
+        """Re-size the permit pool in place. Safe mid-flight: growing
+        wakes waiters immediately; shrinking lets current holders drain
+        (``_in_use`` may exceed the new bound transiently — no permit
+        is revoked, new acquires just wait until the pool drains under
+        the new cap). This fixes the sized-once-forever singleton: a
+        later session with a different concurrentGpuTasks used to keep
+        the first session's sizing silently."""
+        with self._cv:
+            self.permits = max(1, int(permits))
+            self._cv.notify_all()
+
+    @property
+    def in_use(self) -> int:
+        with self._cv:
+            return self._in_use
 
 
 _SEMAPHORE: "TpuSemaphore | None" = None
+_SEMAPHORE_LOCK = None
+
+
+def _sem_lock():
+    global _SEMAPHORE_LOCK
+    if _SEMAPHORE_LOCK is None:
+        import threading
+        _SEMAPHORE_LOCK = threading.Lock()
+    return _SEMAPHORE_LOCK
+
+
+_sem_lock()  # built at import time: the lazy branch is only a fallback
 
 
 def get_semaphore(conf) -> TpuSemaphore:
     """Process-wide semaphore sized by spark.rapids.sql.concurrentGpuTasks
-    (initialized lazily; Plugin.scala:199 does this at executor startup)."""
+    (initialized lazily; Plugin.scala:199 does this at executor startup).
+    A conf whose concurrentGpuTasks differs from the current sizing
+    RE-SIZES the singleton in place (last conf wins, like the
+    reference's executor restart — but without losing held permits).
+    Init/resize are serialized: two concurrent first queries must not
+    construct two semaphores (that would double the device bound)."""
     global _SEMAPHORE
-    if _SEMAPHORE is None:
-        from spark_rapids_tpu.conf import CONCURRENT_TPU_TASKS
-        _SEMAPHORE = TpuSemaphore(conf.get(CONCURRENT_TPU_TASKS))
-    return _SEMAPHORE
+    from spark_rapids_tpu.conf import CONCURRENT_TPU_TASKS
+    want = max(1, int(conf.get(CONCURRENT_TPU_TASKS)))
+    with _sem_lock():
+        if _SEMAPHORE is None:
+            _SEMAPHORE = TpuSemaphore(want)
+        elif _SEMAPHORE.permits != want:
+            _SEMAPHORE.resize(want)
+        return _SEMAPHORE
 
 
 def release_current_thread() -> None:

@@ -1,0 +1,23 @@
+"""Multi-query serving subsystem (docs/serving.md).
+
+A long-lived query server multiplexing N concurrent sessions onto one
+device mesh — the SURVEY §7 colocated-daemon sketch made concrete:
+
+- ``server.QueryServer``   — socket front end, one session per tenant,
+  all sessions sharing the process device runtime (DeviceStore,
+  TpuSemaphore, jit caches, plan-rewrite cache);
+- ``scheduler.AdmissionController`` — bounded queue + per-tenant
+  in-flight limits + fair-share HBM throttling in front of the
+  semaphore;
+- ``protocol``             — length-prefixed JSON headers with Arrow
+  IPC result payloads over a local socket;
+- ``client.ServeClient``   — the matching client.
+
+CLI: ``python -m spark_rapids_tpu.tools serve --view name=path`` and
+``python -m spark_rapids_tpu.tools serve-client "SELECT ..."``.
+"""
+
+from spark_rapids_tpu.serve.client import ServeClient  # noqa: F401
+from spark_rapids_tpu.serve.scheduler import (AdmissionController,  # noqa: F401
+                                              QueryRejected)
+from spark_rapids_tpu.serve.server import QueryServer  # noqa: F401

@@ -1,0 +1,117 @@
+"""Client of the query server (docs/serving.md).
+
+One ``ServeClient`` holds one connection and runs one request at a
+time (the protocol is strict request/response); concurrent load uses
+one client per worker, which is exactly what the bench's concurrency
+legs and the server's per-connection threading expect.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from spark_rapids_tpu.serve import protocol
+
+
+class ServeError(Exception):
+    """Server-side failure reported for one request."""
+
+
+class ServeRejected(ServeError):
+    """Admission refused (queue full / shutting down) — the
+    backpressure signal; retry is the CLIENT's decision."""
+
+
+class ServeClient:
+    def __init__(self, port: int, host: str = "127.0.0.1",
+                 tenant: str = "default", timeout: float = 300.0):
+        self.host = host
+        self.port = port
+        self.tenant = tenant
+        self._sock = socket.create_connection((host, port),
+                                              timeout=timeout)
+        self._lock = threading.Lock()
+        # once a transport error (timeout/OSError/corrupt frame) hits,
+        # the request/response stream is desynchronized: a later call
+        # could read the PREVIOUS query's late response. The client
+        # refuses further use instead of silently mixing results.
+        self._broken = False
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "ServeClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- requests ----------------------------------------------------------
+
+    def _roundtrip(self, header: Dict,
+                   payload: bytes = b"") -> Tuple[Dict, bytes]:
+        try:
+            with self._lock:
+                if self._broken:
+                    raise ServeError(
+                        "connection desynchronized by an earlier "
+                        "transport error; open a new client")
+                protocol.send_msg(self._sock, header, payload)
+                msg = protocol.recv_msg(self._sock)
+        except protocol.ProtocolError as e:
+            self._broken = True
+            raise ServeError(f"corrupted server stream: {e}") from e
+        except OSError as e:  # incl. socket.timeout
+            self._broken = True
+            raise ServeError(f"transport error: {e}") from e
+        if msg is None:
+            raise ServeError("server closed the connection")
+        return msg
+
+    def sql(self, text: str,
+            tenant: Optional[str] = None) -> Tuple[object, Dict]:
+        """Execute SQL; returns ``(HostBatch, response header)``. The
+        header carries rows/queueWaitMs/execMs/planCacheHit. Raises
+        ServeRejected on admission rejection, ServeError on failure."""
+        header, payload = self._roundtrip({
+            "op": "sql", "sql": text,
+            "tenant": tenant or self.tenant})
+        status = header.get("status")
+        if status == "rejected":
+            raise ServeRejected(header.get("error", "rejected"))
+        if status != "ok":
+            raise ServeError(header.get("error", "unknown server error"))
+        return protocol.ipc_to_batch(payload), header
+
+    def collect(self, text: str,
+                tenant: Optional[str] = None) -> List[tuple]:
+        """Execute SQL and return rows as tuples (test/CLI sugar)."""
+        batch, _ = self.sql(text, tenant=tenant)
+        return [tuple(r) for r in batch.rows()]
+
+    def register_view(self, name: str, path: str,
+                      fmt: str = "parquet") -> None:
+        header, _ = self._roundtrip({"op": "view", "name": name,
+                                     "path": path, "fmt": fmt})
+        if header.get("status") != "ok":
+            raise ServeError(header.get("error", "view failed"))
+
+    def stats(self) -> Dict:
+        header, _ = self._roundtrip({"op": "stats"})
+        if header.get("status") != "ok":
+            raise ServeError(header.get("error", "stats failed"))
+        return header["stats"]
+
+    def ping(self) -> bool:
+        header, _ = self._roundtrip({"op": "ping"})
+        return header.get("status") == "ok"
+
+    def shutdown(self) -> None:
+        """Ask the server to shut down cleanly (in-flight queries
+        drain); the connection is unusable afterwards."""
+        self._roundtrip({"op": "shutdown"})

@@ -1,0 +1,255 @@
+"""Admission control for the query server (docs/serving.md).
+
+Sits IN FRONT of the existing ``TpuSemaphore``: the semaphore bounds
+how many *tasks* touch the device at once, this controller bounds how
+many *queries* execute at all and how many wait, so a traffic burst
+degrades into bounded queueing + explicit rejection instead of a pile
+of half-admitted queries thrashing the HBM pool (the GpuSemaphore /
+``concurrentGpuTasks`` division of labor from SURVEY §2.1, lifted one
+level up).
+
+Three policies compose in ``_eligible``:
+
+1. **capacity** — at most ``serve.maxConcurrentQueries`` in flight,
+   at most ``serve.maxQueued`` waiting (beyond that: REJECT, the
+   backpressure contract);
+2. **per-tenant cap** — at most ``serve.maxConcurrentPerTenant`` in
+   flight per tenant, so one chatty tenant cannot occupy every slot;
+3. **fair-share HBM throttle** — a tenant the DeviceStore reports over
+   its fair HBM share (``serve.fairShareFactor`` x budget / live
+   tenants, the PR-6 per-owner ledger generalized per tenant) is
+   passed over while OTHER tenants wait; it runs again once its
+   working set drains or the queue empties of competitors (no
+   starvation: a lone tenant is never throttled).
+
+Admission order is FIFO among eligible tickets — an earlier ticket
+that could run always runs first, so the queue cannot invert arrival
+order except where policy demands it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.conf import (SERVE_MAX_CONCURRENT,
+                                   SERVE_MAX_PER_TENANT, SERVE_MAX_QUEUED,
+                                   TpuConf)
+
+# bounded reservoir per tenant: enough for stable p99 at bench scale
+# without unbounded growth on a long-lived server
+_RESERVOIR = 4096
+
+
+class QueryRejected(Exception):
+    """Admission refused (queue full or server shutting down); the
+    server maps this to a ``status: rejected`` response."""
+
+
+def percentile(samples: List[float], q: float) -> float:
+    """Nearest-rank percentile of an unsorted sample list (0 when
+    empty); small-n behavior matches what the bench reports."""
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+    return s[idx]
+
+
+class _Ticket:
+    __slots__ = ("seq", "tenant")
+
+    def __init__(self, seq: int, tenant: str):
+        self.seq = seq
+        self.tenant = tenant
+
+
+class AdmissionController:
+    def __init__(self, conf: TpuConf):
+        self.max_concurrent = max(1, int(conf.get(SERVE_MAX_CONCURRENT)))
+        self.max_queued = max(0, int(conf.get(SERVE_MAX_QUEUED)))
+        self.max_per_tenant = max(1, int(conf.get(SERVE_MAX_PER_TENANT)))
+        self._cv = threading.Condition()
+        self._queue: List[_Ticket] = []
+        self._seq = 0
+        self._in_flight = 0
+        self._tenant_flight: Dict[str, int] = {}
+        self._shutdown = False
+        # server metrics (docs/serving.md): admitted/rejected totals,
+        # per-tenant counts, queue-wait reservoirs
+        self.admitted = 0
+        self.rejected = 0
+        self.throttled_waits = 0  # admissions delayed by fair share
+        self._tenant_admitted: Dict[str, int] = {}
+        self._tenant_rejected: Dict[str, int] = {}
+        self._tenant_waits: Dict[str, List[float]] = {}
+
+    # -- policy ------------------------------------------------------------
+
+    def _over_share(self) -> Dict[str, int]:
+        from spark_rapids_tpu import memory
+        store = memory._STORE
+        if store is None:
+            return {}
+        try:
+            return store.over_share_tenants()
+        except Exception:
+            return {}
+
+    def _tenant_ok(self, tenant: str) -> bool:
+        return self._tenant_flight.get(tenant, 0) < self.max_per_tenant
+
+    def _count_rejection(self, tenant: str) -> None:
+        """Every wire-level rejection (queue full OR shutdown) counts;
+        call under the condition lock."""
+        self.rejected += 1
+        self._tenant_rejected[tenant] = \
+            self._tenant_rejected.get(tenant, 0) + 1
+
+    def _eligible(self, tk: _Ticket, over: Dict[str, int]) -> bool:
+        if self._in_flight >= self.max_concurrent:
+            return False
+        if not self._tenant_ok(tk.tenant):
+            return False
+        others_waiting = any(e.tenant != tk.tenant for e in self._queue)
+        if tk.tenant in over and others_waiting:
+            # fair-share throttle: over-share tenants yield the slot
+            # while anyone else is waiting (never starved — the gate
+            # opens the moment the queue is all theirs)
+            return False
+        # FIFO among eligible: an earlier ticket that could run now
+        # goes first
+        for e in self._queue:
+            if e is tk:
+                return True
+            if self._tenant_ok(e.tenant) and not (
+                    e.tenant in over and any(
+                        o.tenant != e.tenant for o in self._queue
+                        if o is not e)):
+                return False
+        return True
+
+    # -- acquire/release ---------------------------------------------------
+
+    def acquire(self, tenant: str) -> float:
+        """Block until the query may execute; returns the queue wait in
+        seconds. Raises QueryRejected when the queue is full (the
+        backpressure path) or the server is shutting down."""
+        t0 = time.perf_counter()
+        throttled = False
+        with self._cv:
+            if self._shutdown:
+                self._count_rejection(tenant)
+                raise QueryRejected("server is shutting down")
+            self._seq += 1
+            tk = _Ticket(self._seq, tenant)
+            self._queue.append(tk)
+            # maxQueued bounds WAITING queries: a ticket that can run
+            # immediately is admitted regardless (maxQueued=0 means
+            # "reject whenever anything must wait", not "reject all")
+            if not self._eligible(tk, self._over_share()) and \
+                    len(self._queue) > self.max_queued:
+                self._queue.remove(tk)
+                self._count_rejection(tenant)
+                raise QueryRejected(
+                    f"queue full ({self.max_queued} waiting)")
+            try:
+                while True:
+                    if self._shutdown:
+                        # counted like every other wire-level rejection
+                        # (stats must reconcile with what clients saw)
+                        self._count_rejection(tenant)
+                        raise QueryRejected("server is shutting down")
+                    over = self._over_share()
+                    if self._eligible(tk, over):
+                        break
+                    if tk.tenant in over:
+                        throttled = True
+                    # bounded wait: the fair-share signal lives in the
+                    # DeviceStore and changes without notifying this
+                    # condition, so re-evaluate periodically
+                    self._cv.wait(timeout=0.05)
+            except BaseException:
+                self._queue.remove(tk)
+                self._cv.notify_all()
+                raise
+            self._queue.remove(tk)
+            self._in_flight += 1
+            self._tenant_flight[tenant] = \
+                self._tenant_flight.get(tenant, 0) + 1
+            self.admitted += 1
+            self._tenant_admitted[tenant] = \
+                self._tenant_admitted.get(tenant, 0) + 1
+            if throttled:
+                self.throttled_waits += 1
+            wait = time.perf_counter() - t0
+            waits = self._tenant_waits.setdefault(tenant, [])
+            waits.append(wait)
+            del waits[:-_RESERVOIR]
+        from spark_rapids_tpu import trace as _trace
+        qt = _trace._ACTIVE
+        if qt is not None:
+            now = time.perf_counter_ns()
+            qt.add("serveQueueWait", now - int(wait * 1e9), now,
+                   tenant=tenant)
+        return wait
+
+    def release(self, tenant: str) -> None:
+        with self._cv:
+            self._in_flight -= 1
+            n = self._tenant_flight.get(tenant, 0) - 1
+            if n > 0:
+                self._tenant_flight[tenant] = n
+            else:
+                self._tenant_flight.pop(tenant, None)
+            self._cv.notify_all()
+
+    def begin_shutdown(self) -> None:
+        """Queued (not yet admitted) queries are rejected; in-flight
+        queries run to completion (the clean-shutdown contract)."""
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Wait for in-flight queries to finish; True when drained."""
+        deadline = time.perf_counter() + timeout
+        with self._cv:
+            while self._in_flight > 0:
+                left = deadline - time.perf_counter()
+                if left <= 0:
+                    return False
+                self._cv.wait(timeout=min(0.1, left))
+        return True
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> Dict:
+        with self._cv:
+            tenants = (set(self._tenant_admitted)
+                       | set(self._tenant_rejected)
+                       | set(self._tenant_waits))
+            per_tenant = {}
+            for t in sorted(tenants):
+                waits = self._tenant_waits.get(t, [])
+                per_tenant[t] = {
+                    "admitted": self._tenant_admitted.get(t, 0),
+                    "rejected": self._tenant_rejected.get(t, 0),
+                    "inFlight": self._tenant_flight.get(t, 0),
+                    "queueWaitMs": {
+                        "p50": round(percentile(waits, 0.50) * 1e3, 3),
+                        "p99": round(percentile(waits, 0.99) * 1e3, 3),
+                    },
+                }
+            return {
+                "maxConcurrentQueries": self.max_concurrent,
+                "maxQueued": self.max_queued,
+                "maxConcurrentPerTenant": self.max_per_tenant,
+                "inFlight": self._in_flight,
+                "queued": len(self._queue),
+                "admitted": self.admitted,
+                "rejected": self.rejected,
+                "throttledWaits": self.throttled_waits,
+                "tenants": per_tenant,
+            }

@@ -1,0 +1,348 @@
+"""The query server (docs/serving.md).
+
+A long-lived process accepting SQL over a local socket and multiplexing
+N concurrent sessions onto ONE device runtime — the SURVEY §7
+colocated-daemon sketch. Division of labor:
+
+- one ``TpuSparkSession`` per TENANT (lazily created, all sharing the
+  process DeviceStore / TpuSemaphore / jit caches / plan-rewrite
+  cache), so per-tenant conf, capture state and rewrite reports never
+  clobber each other;
+- ``AdmissionController`` in front: bounded queue with rejection,
+  per-tenant in-flight caps, fair-share HBM throttling off the store's
+  per-tenant ledger;
+- the tenant id threads through everything the engine already records:
+  trace files, event-log lines, profile artifacts, and the store's
+  per-tenant live/peak/spill ledger (``serve.tenantId``);
+- results return as Arrow IPC streams (protocol.py).
+
+Server sessions enable the cross-query plan cache by default
+(``spark.rapids.sql.planCache.enabled``), so repeated query shapes —
+from ANY tenant — skip the plan rewrite, and the jit caches take care
+of XLA programs as they always did.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from spark_rapids_tpu.conf import SERVE_HOST, SERVE_PORT, TpuConf
+from spark_rapids_tpu.serve import protocol
+from spark_rapids_tpu.serve.scheduler import (AdmissionController,
+                                              QueryRejected, percentile)
+
+_LAT_RESERVOIR = 4096
+
+
+class QueryServer:
+    """Multi-tenant SQL server over one device runtime.
+
+    Usage::
+
+        srv = QueryServer({"spark.rapids.sql.enabled": "true"})
+        srv.register_view("lineitem", "/data/lineitem")
+        srv.start()                  # returns once the socket listens
+        ... ServeClient(port=srv.port) ...
+        srv.shutdown()               # drains in-flight queries
+    """
+
+    def __init__(self, conf: Optional[Dict] = None,
+                 host: Optional[str] = None,
+                 port: Optional[int] = None):
+        base = dict(conf or {})
+        # serving default: cross-query plan caching ON unless the
+        # operator explicitly disabled it
+        base.setdefault("spark.rapids.sql.planCache.enabled", "true")
+        self._base_conf = base
+        cobj = TpuConf(base)
+        self.host = host if host is not None else str(cobj.get(SERVE_HOST))
+        self.port = port if port is not None else int(cobj.get(SERVE_PORT))
+        self._admission = AdmissionController(cobj)
+        self._sessions: Dict[str, object] = {}
+        self._sessions_lock = threading.Lock()
+        # per-tenant creation locks: concurrent first requests for ONE
+        # tenant must build exactly one session (a discarded loser
+        # would tear down shared state it happened to initialize, e.g.
+        # the ICI mesh), without serializing OTHER tenants' requests
+        self._tenant_locks: Dict[str, threading.Lock] = {}
+        self._views: Dict[str, Tuple[str, str]] = {}  # name -> (fmt, path)
+        self._sock: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conn_threads: List[threading.Thread] = []
+        self._conn_lock = threading.Lock()
+        self._stopping = threading.Event()
+        self._started = time.perf_counter()
+        # per-tenant end-to-end latency (queue + execute) reservoirs
+        self._lat_lock = threading.Lock()
+        self._tenant_lat: Dict[str, List[float]] = {}
+        self.queries_ok = 0
+        self.queries_err = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "QueryServer":
+        """Bind + listen + start the accept loop; ``self.port`` holds
+        the bound port (ephemeral when configured 0)."""
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((self.host, self.port))
+        sock.listen(128)
+        # bounded accept blocks: close() does not interrupt a thread
+        # parked in accept(), and the kernel keeps the listener alive
+        # until that accept returns — the timeout lets the loop observe
+        # _stopping so shutdown actually releases the port
+        sock.settimeout(0.2)
+        self.port = sock.getsockname()[1]
+        self._sock = sock
+        self._started = time.perf_counter()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="srt-serve-accept", daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def shutdown(self, timeout: float = 60.0) -> bool:
+        """Clean shutdown: stop accepting, reject queued queries, DRAIN
+        in-flight queries (they complete and their responses are
+        delivered), then stop tenant sessions. Returns True when the
+        drain finished inside the timeout."""
+        self._stopping.set()
+        self._admission.begin_shutdown()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            # the port is only released once the accept loop exits
+            self._accept_thread.join(timeout=5.0)
+        drained = self._admission.drain(timeout)
+        with self._conn_lock:
+            threads = list(self._conn_threads)
+        for t in threads:
+            t.join(timeout=max(0.1, timeout / max(1, len(threads))))
+        with self._sessions_lock:
+            sessions, self._sessions = dict(self._sessions), {}
+        for s in sessions.values():
+            try:
+                s.stop()
+            except Exception:
+                pass
+        return drained
+
+    # -- catalog -----------------------------------------------------------
+
+    def register_view(self, name: str, path: str,
+                      fmt: str = "parquet") -> None:
+        """Register a file-backed view for every tenant session
+        (existing sessions update immediately, future sessions get it
+        at creation)."""
+        with self._sessions_lock:
+            self._views[name] = (fmt, path)
+            sessions = list(self._sessions.values())
+        for s in sessions:
+            self._apply_view(s, name, fmt, path)
+
+    @staticmethod
+    def _apply_view(session, name: str, fmt: str, path: str) -> None:
+        reader = session.read
+        df = (reader.parquet(path) if fmt == "parquet"
+              else reader.format(fmt).load(path))
+        df.createOrReplaceTempView(name)
+
+    def _session(self, tenant: str):
+        """The tenant's session, created on first use: base conf +
+        tenantId, every registered view applied. Construction happens
+        under the TENANT's creation lock, OUTSIDE the sessions lock — a
+        new tenant's session setup (view IO included) must not
+        head-of-line-block other tenants' request handling, and exactly
+        one session is ever constructed per tenant (no discarded loser
+        that could tear down shared runtime state it initialized)."""
+        with self._sessions_lock:
+            s = self._sessions.get(tenant)
+            if s is not None:
+                return s
+            tlock = self._tenant_locks.setdefault(tenant,
+                                                  threading.Lock())
+        with tlock:
+            with self._sessions_lock:
+                s = self._sessions.get(tenant)
+                if s is not None:
+                    return s
+                views = dict(self._views)
+            from spark_rapids_tpu.sql.session import TpuSparkSession
+            conf = dict(self._base_conf)
+            conf["spark.rapids.sql.serve.tenantId"] = tenant
+            s = TpuSparkSession(conf)
+            for name, (fmt, path) in views.items():
+                self._apply_view(s, name, fmt, path)
+            with self._sessions_lock:
+                self._sessions[tenant] = s
+                # views registered while we were constructing: apply
+                # the delta (register_view covers the session from now
+                # on; re-applying is an idempotent replace)
+                missed = {n: v for n, v in self._views.items()
+                          if n not in views}
+        for name, (fmt, path) in missed.items():
+            self._apply_view(s, name, fmt, path)
+        return s
+
+    # -- request handling --------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+                conn.settimeout(None)  # requests block until served
+            except socket.timeout:
+                continue  # re-check _stopping
+            except OSError:
+                return  # socket closed by shutdown
+            t = threading.Thread(target=self._handle_conn, args=(conn,),
+                                 name="srt-serve-conn", daemon=True)
+            with self._conn_lock:
+                self._conn_threads.append(t)
+                # drop finished threads so a long-lived server's list
+                # stays bounded
+                self._conn_threads = [x for x in self._conn_threads
+                                      if x.is_alive() or x is t]
+            t.start()
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                msg = protocol.recv_msg(conn)
+                if msg is None:
+                    return
+                header, _payload = msg
+                op = header.get("op")
+                if op == "sql":
+                    self._handle_sql(conn, header)
+                elif op == "view":
+                    self._handle_view(conn, header)
+                elif op == "stats":
+                    protocol.send_msg(conn, {"status": "ok",
+                                             "stats": self.stats()})
+                elif op == "ping":
+                    protocol.send_msg(conn, {"status": "ok"})
+                elif op == "shutdown":
+                    protocol.send_msg(conn, {"status": "ok"})
+                    threading.Thread(target=self.shutdown,
+                                     name="srt-serve-shutdown",
+                                     daemon=True).start()
+                    return
+                else:
+                    protocol.send_msg(conn, {
+                        "status": "error",
+                        "error": f"unknown op {op!r}"})
+        except (protocol.ProtocolError, OSError):
+            pass  # client went away / malformed stream: drop the conn
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle_view(self, conn: socket.socket, header: Dict) -> None:
+        try:
+            self.register_view(header["name"], header["path"],
+                               header.get("fmt", "parquet"))
+            protocol.send_msg(conn, {"status": "ok"})
+        except Exception as e:  # noqa: BLE001 - reported to the client
+            protocol.send_msg(conn, {"status": "error", "error": str(e)})
+
+    def _handle_sql(self, conn: socket.socket, header: Dict) -> None:
+        from spark_rapids_tpu import trace as TR
+        from spark_rapids_tpu import plan_cache as PC
+        tenant = str(header.get("tenant") or "default")
+        sql = header.get("sql") or ""
+        t_req = time.perf_counter()
+        session = self._session(tenant)
+        # the server opens the query trace scope BEFORE admission, so
+        # the admission wait (the scheduler's serveQueueWait span) lands
+        # inside the traced window; execute_plan's own begin_query folds
+        # in as the nested scope it already supports
+        tok = TR.begin_query(session.conf_obj)
+        try:
+            wait_s = self._admission.acquire(tenant)
+        except QueryRejected as e:
+            TR.end_query(session.conf_obj, tok, error=True)
+            protocol.send_msg(conn, {"status": "rejected",
+                                     "error": str(e), "tenant": tenant})
+            return
+        try:
+            t0 = time.perf_counter()
+            batch = session.sql(sql)._execute()
+            exec_s = time.perf_counter() - t0
+            TR.end_query(session.conf_obj, tok, wall_s=exec_s,
+                         rows=batch.num_rows)
+            tok = None
+            payload = protocol.batch_to_ipc(batch)
+            resp = {
+                "status": "ok",
+                "tenant": tenant,
+                "rows": batch.num_rows,
+                "queueWaitMs": round(wait_s * 1e3, 3),
+                "execMs": round(exec_s * 1e3, 3),
+                # per-THREAD outcome: the request plans and executes on
+                # this connection thread, so this cannot misreport
+                # under concurrent queries the way a global hits-delta
+                # would
+                "planCacheHit": bool(PC.last_lookup_was_hit()),
+            }
+            ppath = session.thread_profile_path()
+            if ppath:
+                resp["profilePath"] = ppath
+            protocol.send_msg(conn, resp, payload)
+            # counted AFTER the successful send: a query whose response
+            # delivery fails must not land in both ok and err
+            with self._lat_lock:
+                self.queries_ok += 1
+            self._record_latency(tenant, time.perf_counter() - t_req)
+        except Exception as e:  # noqa: BLE001 - reported to the client
+            if tok is not None:
+                TR.end_query(session.conf_obj, tok, error=True)
+            with self._lat_lock:
+                self.queries_err += 1
+            protocol.send_msg(conn, {"status": "error", "tenant": tenant,
+                                     "error": f"{type(e).__name__}: {e}"})
+        finally:
+            self._admission.release(tenant)
+
+    def _record_latency(self, tenant: str, seconds: float) -> None:
+        with self._lat_lock:
+            lat = self._tenant_lat.setdefault(tenant, [])
+            lat.append(seconds)
+            del lat[:-_LAT_RESERVOIR]
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> Dict:
+        """Server metrics (docs/serving.md): admission counters +
+        per-tenant queue-wait/latency percentiles, plan/jit cache hit
+        rates, and the store's per-tenant HBM ledger."""
+        from spark_rapids_tpu import memory
+        from spark_rapids_tpu.jit_cache import cache_stats
+        adm = self._admission.stats()
+        with self._lat_lock:
+            for t, lat in self._tenant_lat.items():
+                entry = adm["tenants"].setdefault(t, {})
+                entry["latencyMs"] = {
+                    "p50": round(percentile(lat, 0.50) * 1e3, 3),
+                    "p99": round(percentile(lat, 0.99) * 1e3, 3),
+                    "count": len(lat),
+                }
+        uptime = max(1e-9, time.perf_counter() - self._started)
+        return {
+            "host": self.host,
+            "port": self.port,
+            "uptimeSeconds": round(uptime, 3),
+            "queriesOk": self.queries_ok,
+            "queriesErr": self.queries_err,
+            "qps": round(self.queries_ok / uptime, 4),
+            "admission": adm,
+            "tenantsHBM": memory.store_tenant_stats(),
+            "jitCaches": cache_stats(),
+        }

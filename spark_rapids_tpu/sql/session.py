@@ -58,19 +58,43 @@ class TpuSparkSession:
             if str(self.conf_obj.get(SHUFFLE_MODE)).lower() == "ici":
                 # executor-plugin-init analogue: activate the shuffle
                 # mesh once per session (GpuShuffleEnv.initShuffleManager
-                # role; jax already knows the topology)
+                # role; jax already knows the topology). Check-then-act
+                # under the class lock: concurrent server threads
+                # constructing tenant sessions must not both build (and
+                # later both tear down) the process mesh
                 from spark_rapids_tpu.parallel import mesh as PM
-                if PM.get_active_mesh() is None:
-                    n = int(self.conf_obj.get(SHUFFLE_ICI_DEVICES)) or None
-                    PM.set_active_mesh(PM.build_mesh(n))
-                    self._owns_mesh = True
+                with TpuSparkSession._lock:
+                    if PM.get_active_mesh() is None:
+                        n = int(self.conf_obj.get(
+                            SHUFFLE_ICI_DEVICES)) or None
+                        PM.set_active_mesh(PM.build_mesh(n))
+                        self._owns_mesh = True
         self.conf = RuntimeConfApi(self.conf_obj)
         self.catalog_views: Dict[str, L.LogicalPlan] = {}
         self._plan_capture: List = []  # ExecutionPlanCaptureCallback twin
         self._capture_enabled = False
         self.last_rewrite_report = None
         self.last_profile_path: Optional[str] = None
+        # per-thread mirrors of last_rewrite_report/last_profile_path:
+        # concurrent queries on ONE session (the server shares a
+        # session per tenant) race the session-level attributes; each
+        # worker thread plans AND executes on its own thread, so the
+        # profile/event-log sinks read the thread's own report and the
+        # server reads thread_profile_path
+        self._tls = threading.local()
+        # serving tenant id (docs/serving.md): threads through the
+        # store's per-tenant HBM ledger, trace files, event-log lines,
+        # and profile artifacts; "" = untenanted
+        from spark_rapids_tpu.conf import SERVE_TENANT_ID
+        self.tenant: Optional[str] = \
+            str(self.conf_obj.get(SERVE_TENANT_ID)) or None
+        # the previously-active session is REMEMBERED, not clobbered:
+        # stop() restores it, so interleaved session lifetimes (the
+        # server keeps one live session per tenant) leave active()
+        # pointing at a live session instead of None/stale
+        self._stopped = False
         with TpuSparkSession._lock:
+            self._prev_active = TpuSparkSession._active
             TpuSparkSession._active = self
 
     # -- builder-compatible constructor
@@ -150,18 +174,54 @@ class TpuSparkSession:
         plan = materialize_scalar_subqueries(
             plan, self if execute_subqueries else None)
         plan = udf_compiler.rewrite_plan(plan, self.conf_obj)
+        # cross-query plan-rewrite cache (docs/serving.md): AFTER
+        # subquery materialization (their results must be fresh per
+        # submission) a repeated query shape skips the whole
+        # Planner + apply_overrides + CBO + fusion pipeline and clones
+        # the cached template. Scoped to the execute path — the explain
+        # path plans with unevaluated placeholders and must not pollute
+        # (or hit) the executable cache.
+        from spark_rapids_tpu.conf import PLAN_CACHE_ENABLED
+        use_cache = (execute_subqueries
+                     and bool(self.conf_obj.get(PLAN_CACHE_ENABLED)))
+        if use_cache:
+            from spark_rapids_tpu import plan_cache as PC
+            sig = PC.plan_signature(plan, self.conf_obj)
+            # single-flight build: concurrent cold misses of one shape
+            # (a burst of identical queries on a fresh server) run the
+            # rewrite once; everyone executes a clone of the template
+            physical, report, was_miss = PC.get_or_clone(
+                sig, lambda: self._rewrite_fresh(plan))
+            self.last_rewrite_report = report
+            self._tls.rewrite_report = report
+            if not was_miss and report is not None:
+                # sql.explain output replays from the cached report
+                # (the building thread printed inside apply_overrides)
+                report.print_explain(self.conf_obj)
+        else:
+            template, report = self._rewrite_fresh(plan)
+            physical = template
+            self.last_rewrite_report = report
+            self._tls.rewrite_report = report
+        if self._capture_enabled:
+            self._plan_capture.append(physical)
+        return physical
+
+    def _rewrite_fresh(self, plan):
+        """Run the full rewrite pipeline (CPU planning, TpuOverrides,
+        CBO, fusion, broadcast reuse); returns ``(physical, report)``.
+        The plan-cache build callback — must not touch session state
+        (it may run under the cache's single-flight on behalf of
+        another thread's identical query)."""
         physical = Planner(self.conf_obj, session=self).plan(plan)
-        self.last_rewrite_report = None
+        report = None
         if self.conf_obj.sql_enabled:
             from spark_rapids_tpu.overrides import (RewriteReport,
                                                     apply_overrides)
             report = RewriteReport()
             physical = apply_overrides(physical, self.conf_obj, report)
-            self.last_rewrite_report = report
         physical = _reuse_broadcast_exchanges(physical)
-        if self._capture_enabled:
-            self._plan_capture.append(physical)
-        return physical
+        return physical, report
 
     def execute_plan(self, plan: L.LogicalPlan) -> HostBatch:
         import time as _time
@@ -192,9 +252,20 @@ class TpuSparkSession:
         tok = TR.begin_query(self.conf_obj)
         try:
             physical = self.plan_physical(plan)
+            # THIS thread's rewrite report: a concurrent query on the
+            # same session may overwrite last_rewrite_report before the
+            # profile/event-log writes below run
+            report = getattr(self._tls, "rewrite_report",
+                             self.last_rewrite_report)
+            # serving tenancy (docs/serving.md): stamp every registry of
+            # THIS execution's plan with the session tenant so store
+            # registrations from any pool thread bill the right ledger
+            from spark_rapids_tpu import memory as _mem
+            _mem.stamp_plan_tenant(physical, self.tenant)
             t0 = _time.perf_counter()
-            result = physical.execute_collect(
-                int(self.conf_obj.get(TASK_PARALLELISM)))
+            with _mem.tenant_scope(self.tenant):
+                result = physical.execute_collect(
+                    int(self.conf_obj.get(TASK_PARALLELISM)))
             wall_s = _time.perf_counter() - t0
         except BaseException:
             TR.end_query(self.conf_obj, tok, error=True)
@@ -212,26 +283,32 @@ class TpuSparkSession:
         profiling = bool(self.conf_obj.get(PROF.PROFILE_ENABLED))
         qid = event_log.next_query_id() if (log_dir or profiling) else None
         self.last_profile_path = PROF.write_profile(
-            self.conf_obj, physical, self.last_rewrite_report,
+            self.conf_obj, physical, report,
             wall_s, result.num_rows, query_id=qid)
+        self._tls.profile_path = self.last_profile_path
         if log_dir:
             from spark_rapids_tpu import memory
             store = memory._STORE
             event_log.write_event(
-                log_dir, id(self) & 0xFFFF, physical,
-                self.last_rewrite_report,
+                log_dir, id(self) & 0xFFFF, physical, report,
                 wall_s, result.num_rows,
                 store.stats() if store is not None else None,
                 conf=self.conf_obj,
                 memory_by_op=(store.owner_stats()
                               if store is not None else None),
-                query_id=qid)
+                query_id=qid, tenant=self.tenant)
         return result
 
     def explain_string(self, plan: L.LogicalPlan, physical=None) -> str:
         if physical is None:
             physical = self.plan_physical(plan, execute_subqueries=False)
         return f"== Logical ==\n{plan!r}\n== Physical ==\n{physical!r}"
+
+    def thread_profile_path(self) -> Optional[str]:
+        """The profile artifact written by the CALLING thread's last
+        query on this session (None when none) — race-free under the
+        server's shared-session-per-tenant concurrency."""
+        return getattr(self._tls, "profile_path", None)
 
     # -- plan capture (ExecutionPlanCaptureCallback, Plugin.scala:268-390)
     def start_capture(self) -> None:
@@ -249,7 +326,16 @@ class TpuSparkSession:
             self._owns_mesh = False
         with TpuSparkSession._lock:
             if TpuSparkSession._active is self:
-                TpuSparkSession._active = None
+                # restore the session that was active before this one
+                # (global-singleton satellite: concurrent server
+                # sessions must not clobber each other's active slot) —
+                # skipping any already-stopped ancestor in the chain
+                prev = self._prev_active
+                while prev is not None and getattr(prev, "_stopped",
+                                                   False):
+                    prev = prev._prev_active
+                TpuSparkSession._active = prev
+            self._stopped = True
 
 
 class _BuilderFactory:

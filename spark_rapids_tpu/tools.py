@@ -426,7 +426,8 @@ def _main(argv: List[str]) -> int:
         prog="spark_rapids_tpu.tools",
         description="TPU qualification/profiling tools")
     ap.add_argument("command",
-                    choices=["qualify", "profile", "docs", "trace"])
+                    choices=["qualify", "profile", "docs", "trace",
+                             "serve", "serve-client"])
     ap.add_argument("sql", nargs="?", help="SQL text to analyze (live "
                     "mode; omit when using --log), the trace "
                     "file/directory for the trace command, or a "
@@ -440,7 +441,26 @@ def _main(argv: List[str]) -> int:
                     help="docs: output directory for generated markdown")
     ap.add_argument("--top", type=int, default=10,
                     help="trace: rows per report section")
-    args = ap.parse_args(argv)
+    ap.add_argument("--conf", action="append", default=[],
+                    help="serve: key=value spark.rapids confs")
+    ap.add_argument("--host", default=None, help="serve/serve-client: "
+                    "bind/connect host (default 127.0.0.1)")
+    ap.add_argument("--port", type=int, default=None,
+                    help="serve: bind port (0/unset = ephemeral); "
+                    "serve-client: server port (required)")
+    ap.add_argument("--tenant", default="default",
+                    help="serve-client: tenant id for the request")
+    ap.add_argument("--stats", action="store_true",
+                    help="serve-client: print server stats instead of "
+                    "running SQL")
+    # intermixed: `serve-client --port N "SELECT ..."` must parse (the
+    # plain parser cannot allocate a positional after optionals)
+    args = ap.parse_intermixed_args(argv)
+
+    if args.command == "serve":
+        return _serve_main(args)
+    if args.command == "serve-client":
+        return _serve_client_main(args, ap)
 
     if args.command == "profile":
         # offline renderer: a path argument means "render the written
@@ -534,6 +554,65 @@ def _main(argv: List[str]) -> int:
     return 0
 
 
+
+
+def _serve_main(args) -> int:
+    """`tools serve`: run the query server until interrupted
+    (docs/serving.md). Views from --view name=path, confs from
+    --conf key=value."""
+    import json as _json
+    import signal
+    import threading
+
+    from spark_rapids_tpu.serve import QueryServer
+    conf = {"spark.rapids.sql.enabled": "true"}
+    for kv in args.conf:
+        k, _, v = kv.partition("=")
+        conf[k.strip()] = v.strip()
+    srv = QueryServer(conf, host=args.host, port=args.port)
+    srv.start()
+    for v in args.view:
+        name, _, path = v.partition("=")
+        srv.register_view(name, path)
+    print(_json.dumps({"event": "serving", "host": srv.host,
+                       "port": srv.port,
+                       "views": sorted(v.partition("=")[0]
+                                       for v in args.view)}),
+          flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    while not stop.is_set() and not srv._stopping.is_set():
+        stop.wait(0.2)
+    srv.shutdown()
+    print(_json.dumps({"event": "stopped", **srv.stats()}), flush=True)
+    return 0
+
+
+def _serve_client_main(args, ap) -> int:
+    """`tools serve-client`: the client smoke command — one SQL round
+    trip (or --stats) against a running server."""
+    import json as _json
+
+    from spark_rapids_tpu.serve import ServeClient
+    if args.port is None:
+        ap.error("serve-client requires --port")
+    with ServeClient(args.port, host=args.host or "127.0.0.1",
+                     tenant=args.tenant) as c:
+        if args.stats:
+            print(_json.dumps(c.stats(), indent=2))
+            return 0
+        if not args.sql:
+            ap.error("provide SQL text (or --stats)")
+        batch, header = c.sql(args.sql)
+        names = [f.name for f in batch.schema.fields]
+        print("\t".join(names))
+        for row in batch.rows():
+            print("\t".join(str(v) for v in row))
+        print(_json.dumps({k: header[k] for k in
+                           ("rows", "queueWaitMs", "execMs",
+                            "planCacheHit") if k in header}))
+    return 0
 
 
 def generate_supported_ops() -> str:
@@ -666,7 +745,11 @@ def generate_observability_docs() -> str:
         "  `TpuFusedStageExec.dispatch` (stage label, batch sequence) and",
         "  `TpuHashAggregateExec.dispatch` (mode);",
         "- JIT compiles are `compile` spans (attr `cache` = which LRU",
-        "  missed); semaphore waits are `semaphoreWait` spans; store",
+        "  missed); a thread that blocks on ANOTHER thread's",
+        "  in-progress compile of the same key (single-flight) emits a",
+        "  `compileCacheContention` instant and counts in the cache's",
+        "  `contention` stat; semaphore waits are `semaphoreWait` spans;",
+        "  store",
         "  tier movement is `spillToHost`/`spillToDisk`/",
         "  `promoteFromDisk`/`promoteToDevice`; the ICI exchange adds",
         "  `meshStack`/`meshSizeExchange`/`meshExchange` and",
@@ -795,7 +878,11 @@ def generate_observability_docs() -> str:
         "active; each line also carries the per-query `fallbackSummary`",
         "(coverage + reason histogram) and `memoryByOperator` (the",
         "per-op peak/live HBM ledger). `read_events` still reads v1",
-        "lines (version normalized to 1).",
+        "lines (version normalized to 1). Queries executed through the",
+        "query server additionally carry `tenant` (docs/serving.md) —",
+        "the same id appears in the profile artifact and the trace",
+        "file's `otherData.tenant`, and admission waits show up as",
+        "`serveQueueWait` spans.",
         "",
         "## Metric-name reference",
         "",

@@ -81,10 +81,15 @@ class QueryTrace:
     under the GIL, so the hot path takes no lock."""
 
     __slots__ = ("query_id", "t0", "wall_t0", "spans", "instants",
-                 "counters", "_thread_names")
+                 "counters", "_thread_names", "tenant")
 
-    def __init__(self, query_id: int):
+    def __init__(self, query_id: int, tenant: Optional[str] = None):
         self.query_id = query_id
+        # serving tenancy: the tenant of the session that OPENED the
+        # trace (concurrent queries from other sessions fold their
+        # spans into this file — the documented process-timeline
+        # limitation — but the root attribution names its owner)
+        self.tenant = tenant
         self.t0 = time.perf_counter_ns()
         self.wall_t0 = time.time()
         # span record: (kind, t0_ns, t1_ns, thread_ident, batch, chip,
@@ -175,7 +180,9 @@ def begin_query(conf_obj) -> Optional[str]:
                 _RNG_SEED = seed
             if _RNG.random() >= rate:
                 return "unsampled"
-        _ACTIVE = QueryTrace(_SEQ)
+        from spark_rapids_tpu.conf import SERVE_TENANT_ID
+        _ACTIVE = QueryTrace(
+            _SEQ, tenant=str(conf_obj.get(SERVE_TENANT_ID)) or None)
         return "root"
 
 
@@ -385,6 +392,8 @@ def write_chrome_trace(path: str, qt: QueryTrace, wall_s: float = 0.0,
             "counterCount": len(qt.counters),
         },
     }
+    if qt.tenant:
+        doc["otherData"]["tenant"] = qt.tenant
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         # default=str: attr values are normally JSON scalars, but an

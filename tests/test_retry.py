@@ -416,7 +416,7 @@ def test_oom_sweep_under_task_parallelism():
     assert _metric(plans, M.RETRY_COUNT) > 0
     sem = resource._SEMAPHORE
     if sem is not None:
-        assert sem._sem._value == sem.permits
+        assert sem.in_use == 0
 
 
 # ---------------------------------------------------------------------------
@@ -440,8 +440,8 @@ def test_semaphore_permits_restored_after_failed_query():
         spark.stop()
     sem = resource._SEMAPHORE
     assert sem is not None
-    assert sem._sem._value == sem.permits, (
-        f"leaked {sem.permits - sem._sem._value} device permit(s)")
+    assert sem.in_use == 0, (
+        f"leaked {sem.in_use} device permit(s)")
 
 
 # ---------------------------------------------------------------------------
