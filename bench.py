@@ -287,15 +287,19 @@ def collect_counters(plans, names) -> dict:
 
 def decode_breakdown(plans) -> dict:
     """Per-encoding scan decode attribution: host decodeTime vs
-    deviceDecodeTime (the host-side IO/plan half of the device path)
-    plus how many values each Parquet encoding contributed, so a bench
-    round can attribute the device-decode win per encoding."""
+    deviceDecodeTime (the host-side IO/plan half of the device path),
+    how many values each Parquet encoding contributed on DEVICE vs the
+    per-column HOST fallbacks, and the scan pipeline's prefetch /
+    upload-ahead counters (docs/scan.md)."""
     out = {"hostDecodeTime_s": 0.0, "deviceDecodeTime_s": 0.0,
-           "deviceDecodedBatches": 0, "deviceFallbackUnits": 0,
-           "deviceFallbackColumns": 0, "valuesByEncoding": {}}
+           "scanPrefetchTime_s": 0.0, "deviceDecodedBatches": 0,
+           "deviceFallbackUnits": 0, "deviceFallbackColumns": 0,
+           "uploadAheadBatches": 0, "prefetchRingShrinks": 0,
+           "valuesByEncoding": {}, "hostValuesByEncoding": {}}
 
     def walk(p):
-        if type(p).__name__ == "CpuFileScanExec":
+        name = type(p).__name__
+        if name == "CpuFileScanExec":
             snap = p.metrics.snapshot()
             out["hostDecodeTime_s"] = round(
                 out["hostDecodeTime_s"] + snap.get("decodeTime", 0) / 1e9,
@@ -311,6 +315,18 @@ def decode_breakdown(plans) -> dict:
                     enc = k.split(".", 1)[1]
                     out["valuesByEncoding"][enc] = \
                         out["valuesByEncoding"].get(enc, 0) + v
+                elif k.startswith("hostDecodedValues."):
+                    enc = k.split(".", 1)[1]
+                    out["hostValuesByEncoding"][enc] = \
+                        out["hostValuesByEncoding"].get(enc, 0) + v
+        elif name == "TpuRowToColumnarExec":
+            snap = p.metrics.snapshot()
+            out["scanPrefetchTime_s"] = round(
+                out["scanPrefetchTime_s"]
+                + snap.get("scanPrefetchTime", 0) / 1e9, 3)
+            out["uploadAheadBatches"] += snap.get("uploadAheadBatches", 0)
+            out["prefetchRingShrinks"] += snap.get(
+                "prefetchRingShrinks", 0)
         for c in p.children:
             walk(c)
 
@@ -342,10 +358,15 @@ TPU_CONF = {
     # overlap per-task host round trips with device compute
     "spark.rapids.sql.taskParallelism": "4",
     "spark.rapids.sql.concurrentGpuTasks": "4",
-    # decode parquet pages on device (round-5 verdict: host decode
-    # was the dominant cost; this moves the per-value work to XLA)
-    "spark.rapids.sql.format.parquet.deviceDecode.enabled": "true",
+    # device parquet decode + the async scan pipeline are ON BY
+    # DEFAULT (ISSUE 9); the bench runs the stock configuration and
+    # detail.decode A/B-measures the host-decode / unpipelined legs
 }
+
+DEVICE_DECODE_CONF = \
+    "spark.rapids.sql.format.parquet.deviceDecode.enabled"
+MAX_IN_FLIGHT_CONF = \
+    "spark.rapids.sql.format.parquet.deviceDecode.maxInFlight"
 
 _COUNTERS = ("dispatchCount", "stageCompileTime", "fusedOps")
 
@@ -386,6 +407,43 @@ def run_tpu(fusion_enabled: bool) -> dict:
     out["q3"] = {"wall_s": round(q3_t, 4), "rows": q3_rows,
                  "stages": q3_stages, "decode": q3_decode}
     tpu.stop()
+    return out
+
+
+def run_decode_ab(pipelined_wall: float, cpu_rows) -> dict:
+    """detail.decode A/B legs (like detail.fusion): q1 with the HOST
+    decode (deviceDecode off) and with device decode but the scan
+    pipeline fully synchronous (maxInFlight=0), against the default
+    pipelined wall — so the device-decode win and the pipeline win are
+    separately attributable. Both legs assert bit-identical rows."""
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    out = {"pipelined_wall_s": round(pipelined_wall, 4)}
+    for name, extra in (("hostDecode", {DEVICE_DECODE_CONF: "false"}),
+                        ("unpipelined", {MAX_IN_FLIGHT_CONF: "0"})):
+        fresh_leg()
+        conf = dict(TPU_CONF)
+        conf.update(extra)
+        tpu = TpuSparkSession(conf)
+        try:
+            q = build_query(tpu)
+            run_once(q)  # warm
+            times, rows = [], None
+            for i in range(2):
+                if i == 1:
+                    tpu.start_capture()
+                dt, rows = run_once(q)
+                times.append(dt)
+            assert_rows_match(cpu_rows, rows)
+            out[name] = {
+                "wall_s": round(min(times), 4),
+                "decode": decode_breakdown(tpu.get_captured_plans()),
+            }
+        finally:
+            tpu.stop()
+    out["pipelineSpeedup"] = round(
+        out["unpipelined"]["wall_s"] / pipelined_wall, 4)
+    out["deviceDecodeSpeedup"] = round(
+        out["hostDecode"]["wall_s"] / pipelined_wall, 4)
     return out
 
 
@@ -445,7 +503,9 @@ def run_multichip(single_chip_wall: float, cpu_rows) -> dict:
 
 _ROBUSTNESS_COUNTERS = ("retryCount", "splitRetryCount",
                         "spillBytesOnRetry", "retryBlockTime",
-                        "ioRetryCount", "degradedChips")
+                        "ioRetryCount", "degradedChips",
+                        "prefetchRingShrinks", "uploadAheadBatches",
+                        "deviceDecodeOomFallbacks")
 
 
 def run_robustness(clean_wall: float, cpu_rows) -> dict:
@@ -463,6 +523,11 @@ def run_robustness(clean_wall: float, cpu_rows) -> dict:
     legs = [
         ("oomEveryN", {"spark.rapids.sql.test.injectOOM": "5"}, {}),
         ("splitOom", {"spark.rapids.sql.test.injectOOM": "split:7"}, {}),
+        # OOM targeted at the scan pipeline's prefetched uploads: the
+        # in-flight ring must SHRINK (drain + synchronous retry), not
+        # deadlock, under with_retry spills (docs/scan.md)
+        ("prefetchOom",
+         {"spark.rapids.sql.test.injectOOM": "site:upload:3"}, {}),
     ]
     import jax
     if len(jax.devices()) >= 2:
@@ -501,6 +566,9 @@ def run_robustness(clean_wall: float, cpu_rows) -> dict:
                 "retryBlockTime_s": round(
                     counters["retryBlockTime"] / 1e9, 4),
                 "degradedChips": counters["degradedChips"],
+                "prefetchRingShrinks": counters["prefetchRingShrinks"],
+                "deviceDecodeOomFallbacks":
+                    counters["deviceDecodeOomFallbacks"],
                 "injected": inj.stats() if inj is not None else {},
             }
         finally:
@@ -533,13 +601,36 @@ def run_trace(clean_wall: float, cpu_rows) -> dict:
         q = build_query(tpu)
         run_once(q)  # jit compile warm-up
         times, rows = [], None
-        for _ in range(2):
+        for i in range(2):
+            if i == 1:
+                tpu.start_capture()
             dt, rows = run_once(q)
             times.append(dt)
         assert_rows_match(cpu_rows, rows)
         wall = min(times)
         files = sorted(glob.glob(os.path.join(tdir, "trace-*.json")))
         analysis = analyze_trace(files[-1]) if files else {}
+        cp = analysis.get("criticalPath_s", {})
+        # decode-overlap ratio (ISSUE 9 acceptance): how much of the
+        # scan's wall (host decode plan + prefetch threads) hid under
+        # device compute — 1.0 means the scan never held the critical
+        # path, and FileScan.decodeTime off the critical path is the
+        # flip's proof
+        dec = decode_breakdown(tpu.get_captured_plans())
+        scan_total = (dec["hostDecodeTime_s"] + dec["deviceDecodeTime_s"]
+                      + dec["scanPrefetchTime_s"])
+        scan_critical = sum(v for k, v in cp.items() if k in (
+            "FileScan.decodeTime", "FileScan.deviceDecodeTime",
+            "scanPrefetch", "uploadAhead"))
+        overlap = {
+            "scanTotal_s": round(scan_total, 4),
+            "scanOnCriticalPath_s": round(scan_critical, 4),
+            "overlapRatio": round(
+                max(0.0, 1.0 - scan_critical / scan_total), 4)
+            if scan_total > 0 else 1.0,
+            "decodeTimeOnCriticalPath":
+                "FileScan.decodeTime" in cp,
+        }
         return {
             "skipped": False,
             "wall_s": round(wall, 4),
@@ -547,10 +638,11 @@ def run_trace(clean_wall: float, cpu_rows) -> dict:
             "tracingOverhead": round(wall / clean_wall, 4),
             "traceFiles": len(files),
             "spanCount": analysis.get("spanCount", 0),
-            "criticalPath_s": analysis.get("criticalPath_s", {}),
+            "criticalPath_s": cp,
             "criticalPathIdle_s": analysis.get("criticalPathIdle_s", 0),
             "occupancy": analysis.get("occupancy", {}),
             "topSpans": analysis.get("topSpans", []),
+            "scanOverlap": overlap,
         }
     finally:
         tpu.stop()
@@ -783,6 +875,14 @@ def main():
     assert_rows_match(q3_cpu_rows, fused["q3"]["rows"])
     assert_rows_match(q3_cpu_rows, unfused["q3"]["rows"])
 
+    # decode A/B legs (host decode / unpipelined), fault-isolated like
+    # every other detail leg
+    try:
+        decode_ab = run_decode_ab(fused["wall_s"], cpu_rows)
+    except Exception as e:  # noqa: BLE001 - reported, not swallowed
+        decode_ab = {"skipped": True,
+                     "reason": f"decode A/B leg failed: {e!r}"}
+
     # AFTER the primary asserts, and fault-isolated: a multichip-leg
     # failure must not discard the measured single-chip results
     try:
@@ -836,7 +936,8 @@ def main():
             "backend": __import__("jax").default_backend(),
             "rows": N_ROWS,
             "stages": fused["stages"],
-            "decode": fused["decode"],
+            "decode": {**fused["decode"], "ab": decode_ab,
+                       "overlap": trace_leg.get("scanOverlap")},
             "fusion": {
                 "q1_fused_wall_s": fused["wall_s"],
                 "q1_unfused_wall_s": unfused["wall_s"],

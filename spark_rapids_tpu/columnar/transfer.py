@@ -465,31 +465,50 @@ def finish_upload(staged, device: Optional[jax.Device] = None):
     from spark_rapids_tpu import trace as _trace
     with _trace.span("finishUpload", mode=staged[0],
                      chip=(device.id if device is not None else None)):
-        return _finish_upload(staged, device)
+        return finish_started(start_upload(staged, device))
 
 
-def _finish_upload(staged, device: Optional[jax.Device] = None):
-    from spark_rapids_tpu.columnar import device as D
+def start_upload(staged, device: Optional[jax.Device] = None):
+    """Issue a staged token's host->device copies ASYNCHRONOUSLY (jax
+    device_put returns once the transfers are enqueued) and return an
+    upload token for :func:`finish_started`. The split is the scan
+    pipeline's upload-ahead hook (docs/scan.md): batch k+1's raw-chunk
+    bytes move while batch k's decode program / downstream compute
+    runs, bounded by deviceDecode.maxInFlight tokens in flight."""
+    def put(bufs):
+        return (jax.device_put(bufs, device) if device is not None
+                else jax.device_put(bufs))
+
     if staged[0] == "direct":
         _tag, schema, n, spec, np_arrays = staged
-        if device is not None:
-            dev = jax.device_put(np_arrays, device)
-        else:
-            dev = jax.device_put(np_arrays)
+        return ("direct", schema, n, spec, put(np_arrays))
+    if staged[0] == "encoded":
+        _tag, schema, n, cap, words, extras, layout, spec = staged
+        dev = put([words, np.asarray(n, dtype=np.int64)] + list(extras))
+        return ("encoded", schema, n, cap, words.nbytes, layout, spec,
+                dev)
+    _tag, schema, n, cap, words, extras, layout = staged
+    return ("packed", schema, n, cap, words.nbytes, layout,
+            put([words] + extras))
+
+
+def finish_started(token):
+    """Complete a :func:`start_upload` token: run the decode program
+    (packed/encoded paths) and assemble the DeviceBatch. Safe to
+    re-invoke after an OOM retry — the device buffers are still
+    resident, only the program dispatch repeats."""
+    from spark_rapids_tpu.columnar import device as D
+    if token[0] == "direct":
+        _tag, schema, n, spec, dev = token
         return D.DeviceBatch(schema, D.rebuild_columns(spec, dev[:-1]),
                              dev[-1], n)
-    if staged[0] == "encoded":
-        return _finish_encoded_upload(staged, device)
-    _tag, schema, n, cap, words, extras, layout = staged
-    key = (layout, n, cap, words.nbytes)
+    if token[0] == "encoded":
+        return _finish_encoded_upload(token)
+    _tag, schema, n, cap, nbytes, layout, dev = token
+    key = (layout, n, cap, nbytes)
     fn = _DECODE_CACHE.get(key)
     if fn is None:
         fn = _DECODE_CACHE.put(key, _build_decode(layout, n, cap))
-    bufs = [words] + extras
-    if device is not None:
-        dev = jax.device_put(bufs, device)
-    else:
-        dev = jax.device_put(bufs)
     active, outs = fn(dev[0], *dev[1:])
     spec = [(f.data_type,
              3 if (D.is_string_like(f.data_type)
@@ -522,7 +541,9 @@ def _pad_pow2(n: int, floor: int = 8) -> int:
 
 def prepare_encoded_upload(enc, cap: int):
     """EncodedBatch -> staged token: pads plan tables to pow2 buckets so
-    the decode-program cache keys repeat across row groups."""
+    the decode-program cache keys repeat across row groups (the row
+    count itself rides as a device scalar, so row groups of any size
+    share one program per layout/capacity bucket)."""
     n = enc.num_rows
     extras: List[np.ndarray] = []
     layout: List[Tuple] = []
@@ -536,21 +557,33 @@ def prepare_encoded_upload(enc, cap: int):
             spec.append((dt, len(parts)))
             extras.extend(parts)
             continue
-        n_pages = len(plan.pg_is_dict)
+        n_pages = len(plan.pg_enc)
         npg = _pad_pow2(n_pages)
         dense_start = np.full(npg + 1, 1 << 62, dtype=np.int64)
         dense_start[:n_pages + 1] = plan.pg_dense_start
         plain_byte = np.zeros(npg, dtype=np.int64)
         plain_byte[:n_pages] = plan.pg_plain_byte
-        is_dict = np.zeros(npg, dtype=bool)
-        is_dict[:n_pages] = plan.pg_is_dict
-        extras.extend([dense_start, plain_byte, is_dict])
+        pg_enc = np.zeros(npg, dtype=np.int32)
+        pg_enc[:n_pages] = plan.pg_enc
+        extras.extend([dense_start, plain_byte, pg_enc])
+        if plan.has_delta:
+            pg_first = np.zeros(npg, dtype=np.int64)
+            pg_first[:n_pages] = plan.pg_first
+            extras.append(pg_first)
         ndl = _pad_pow2(len(plan.dl)) if plan.dl is not None else 0
         if plan.dl is not None:
             extras.extend(plan.dl.arrays(ndl))
         nvr = _pad_pow2(len(plan.vr)) if plan.vr is not None else 0
         if plan.vr is not None:
             extras.extend(plan.vr.arrays(nvr))
+        ndr = _pad_pow2(len(plan.dr)) if plan.dr is not None else 0
+        if plan.dr is not None:
+            extras.extend(plan.dr.arrays(ndr))
+        has_slen = plan.str_lens is not None
+        if has_slen:
+            slen = np.zeros(cap, dtype=np.int32)
+            slen[:plan.str_lens.shape[0]] = plan.str_lens
+            extras.append(slen)
         dict_shapes: List[Tuple] = []
         for da in plan.dict_arrays:
             pad = _pad_pow2(da.shape[0], floor=1)
@@ -561,8 +594,9 @@ def prepare_encoded_upload(enc, cap: int):
             dict_shapes.append((da.shape, str(da.dtype)))
             extras.append(da)
         layout.append(("dev", plan.kind, plan.np_dtype, plan.elem_bytes,
-                       plan.char_cap, npg, ndl, nvr,
-                       tuple(dict_shapes), plan.has_plain))
+                       plan.char_cap, npg, ndl, nvr, ndr,
+                       tuple(dict_shapes), plan.has_plain,
+                       plan.has_delta, plan.has_bss, has_slen))
         arity = 3 if plan.kind in ("str", "dec128") else 2
         spec.append((dt, arity))
     # bucket the page buffer so same-shaped row groups share one
@@ -577,12 +611,18 @@ def prepare_encoded_upload(enc, cap: int):
             tuple(layout), tuple(spec))
 
 
-def _build_encoded_decode(layout: Tuple, n: int, cap: int) -> Callable:
+def _build_encoded_decode(layout: Tuple, cap: int) -> Callable:
     """One XLA program: packed page words + plan tables -> per-column
-    (data, validity) arrays at full capacity, plus the active mask."""
+    (data, validity) arrays at full capacity, plus the active mask.
+    The page-encoding class array (pg_enc) selects the decode lane per
+    page, so dict / PLAIN / DELTA / BYTE_STREAM_SPLIT / string pages
+    can mix freely inside one chunk (dictionary overflow)."""
+    from spark_rapids_tpu.io.device_decode import (PGE_BSS, PGE_DELTA,
+                                                   PGE_DICT, PGE_DL_STR,
+                                                   PGE_PLAIN_STR)
     from spark_rapids_tpu.ops import rle as R
 
-    def fn(words, *extras):
+    def fn(words, n_arr, *extras):
         bytes_all = None
 
         def get_bytes():
@@ -591,7 +631,7 @@ def _build_encoded_decode(layout: Tuple, n: int, cap: int) -> Callable:
                 bytes_all = R.bytes_of_words(words)
             return bytes_all
 
-        active = jnp.arange(cap) < n
+        active = jnp.arange(cap) < n_arr
         pos = jnp.arange(cap, dtype=jnp.int64)
         outs: List[jax.Array] = []
         cur = 0
@@ -602,11 +642,16 @@ def _build_encoded_decode(layout: Tuple, n: int, cap: int) -> Callable:
                 cur += n_parts
                 continue
             (_tag, kind, np_dt, elem_bytes, char_cap, npg, ndl, nvr,
-             dict_shapes, has_plain) = ent
+             ndr, dict_shapes, has_plain, has_delta, has_bss,
+             has_slen) = ent
             dense_start = extras[cur]
             plain_byte = extras[cur + 1]
-            is_dict = extras[cur + 2]
+            pg_enc = extras[cur + 2]
             cur += 3
+            pg_first = None
+            if has_delta:
+                pg_first = extras[cur]
+                cur += 1
             if ndl:
                 dl = extras[cur:cur + 5]
                 cur += 5
@@ -618,6 +663,14 @@ def _build_encoded_decode(layout: Tuple, n: int, cap: int) -> Callable:
             if nvr:
                 vr = extras[cur:cur + 5]
                 cur += 5
+            dr = None
+            if ndr:
+                dr = extras[cur:cur + 5]
+                cur += 5
+            slen = None
+            if has_slen:
+                slen = extras[cur]
+                cur += 1
             dicts = [extras[cur + i] for i in range(len(dict_shapes))]
             cur += len(dict_shapes)
 
@@ -628,23 +681,58 @@ def _build_encoded_decode(layout: Tuple, n: int, cap: int) -> Callable:
                 data = jnp.where(validity, v != 0, False)
                 outs.extend([data, validity])
                 continue
-            if kind == "str":
-                didx = R.hybrid_lookup(get_bytes(), j, *vr)
-                dmax = dict_shapes[0][0][0] - 1
-                didx = jnp.clip(didx, 0, dmax)
-                chars = jnp.where(validity[:, None], dicts[0][didx], 0)
-                lengths = jnp.where(validity,
-                                    dicts[1][didx].astype(jnp.int32), 0)
-                outs.extend([chars, lengths, validity])
-                continue
             pg = jnp.clip(
                 jnp.searchsorted(dense_start, j, side="right") - 1,
                 0, npg - 1)
             local = j - dense_start[pg]
+            enc_pg = pg_enc[pg]
             didx = None
-            if vr is not None:
+            if vr is not None and dict_shapes:
                 didx = jnp.clip(R.hybrid_lookup(get_bytes(), j, *vr),
                                 0, dict_shapes[0][0][0] - 1)
+            if kind == "str":
+                if has_slen:
+                    # offset+bytes model (SURVEY.md §7 c), computed in
+                    # DENSE coordinates (pos) — each stored value's
+                    # footprint counts exactly once even when null rows
+                    # repeat a dense index through j: offsets are a
+                    # per-page segmented prefix-sum over the byte
+                    # footprints (PLAIN values add their 4-byte length
+                    # prefix), then one gather builds the char matrix
+                    pgd = jnp.clip(
+                        jnp.searchsorted(dense_start, pos,
+                                         side="right") - 1, 0, npg - 1)
+                    encd = pg_enc[pgd]
+                    sl_d = slen.astype(jnp.int64)
+                    lp_d = jnp.where(encd == PGE_PLAIN_STR, 4, 0) \
+                        .astype(jnp.int64)
+                    is_str_d = (encd == PGE_PLAIN_STR) \
+                        | (encd == PGE_DL_STR)
+                    contrib = jnp.where(is_str_d, sl_d + lp_d, 0)
+                    based = jnp.clip(dense_start[pgd], 0, cap - 1)
+                    rel_d = R.seg_excl_cumsum(contrib, based)
+                    start_d = plain_byte[pgd] + rel_d + lp_d
+                    jj = jnp.clip(j, 0, cap - 1)
+                    pchars = R.gather_chars(get_bytes(), start_d[jj],
+                                            sl_d[jj].astype(jnp.int32),
+                                            char_cap)
+                    plens = sl_d[jj].astype(jnp.int32)
+                else:
+                    pchars = jnp.zeros((cap, char_cap), dtype=jnp.uint8)
+                    plens = jnp.zeros(cap, dtype=jnp.int32)
+                if didx is not None:
+                    is_dict_pg = enc_pg == PGE_DICT
+                    chars = jnp.where(is_dict_pg[:, None],
+                                      dicts[0][didx], pchars)
+                    lengths = jnp.where(is_dict_pg,
+                                        dicts[1][didx].astype(jnp.int32),
+                                        plens)
+                else:
+                    chars, lengths = pchars, plens
+                chars = jnp.where(validity[:, None], chars, 0)
+                lengths = jnp.where(validity, lengths, 0)
+                outs.extend([chars, lengths, validity])
+                continue
             if kind == "dec128":
                 if has_plain:
                     off = plain_byte[pg] + local * elem_bytes
@@ -653,8 +741,9 @@ def _build_encoded_decode(layout: Tuple, n: int, cap: int) -> Callable:
                 else:
                     p_hi = p_lo = jnp.zeros(cap, dtype=jnp.int64)
                 if didx is not None:
-                    hi = jnp.where(is_dict[pg], dicts[0][didx], p_hi)
-                    lo = jnp.where(is_dict[pg], dicts[1][didx], p_lo)
+                    is_dict_pg = enc_pg == PGE_DICT
+                    hi = jnp.where(is_dict_pg, dicts[0][didx], p_hi)
+                    lo = jnp.where(is_dict_pg, dicts[1][didx], p_lo)
                 else:
                     hi, lo = p_hi, p_lo
                 hi = jnp.where(validity, hi, 0)
@@ -665,15 +754,40 @@ def _build_encoded_decode(layout: Tuple, n: int, cap: int) -> Callable:
             if has_plain:
                 off = plain_byte[pg] + local * elem_bytes
                 if kind == "dec64":
-                    p_v = R.read_be_signed(get_bytes(), off, elem_bytes)
+                    v = R.read_be_signed(get_bytes(), off, elem_bytes)
                 else:
-                    p_v = R.read_le(get_bytes(), off, elem_bytes)
+                    v = R.read_le(get_bytes(), off, elem_bytes)
             else:
-                p_v = jnp.zeros(cap, dtype=jnp.int64)
+                v = jnp.zeros(cap, dtype=jnp.int64)
+            if has_bss:
+                # BYTE_STREAM_SPLIT: byte j of value i lives at
+                # page_base + j*values_in_page + i
+                stride = jnp.clip(dense_start[pg + 1] - dense_start[pg],
+                                  0, cap)
+                b_v = R.read_bss(get_bytes(), plain_byte[pg], stride,
+                                 local, elem_bytes)
+                v = jnp.where(enc_pg == PGE_BSS, b_v, v)
+            if has_delta:
+                # DELTA_BINARY_PACKED, in DENSE coordinates (each delta
+                # counts once even when null rows repeat a dense index):
+                # per-value deltas from the miniblock run table,
+                # reconstructed by a per-page segmented prefix-sum off
+                # the page's first_value, then gathered per row
+                pgd = jnp.clip(
+                    jnp.searchsorted(dense_start, pos,
+                                     side="right") - 1, 0, npg - 1)
+                encd = pg_enc[pgd]
+                d_raw = R.delta_lookup(get_bytes(), pos, *dr)
+                d_contrib = jnp.where(
+                    (encd == PGE_DELTA) & (pos > dense_start[pgd]),
+                    d_raw, 0)
+                c = jnp.cumsum(d_contrib)
+                based = jnp.clip(dense_start[pgd], 0, cap - 1)
+                val_d = pg_first[pgd] + (c - c[based])
+                d_v = val_d[jnp.clip(j, 0, cap - 1)]
+                v = jnp.where(enc_pg == PGE_DELTA, d_v, v)
             if didx is not None:
-                v = jnp.where(is_dict[pg], dicts[0][didx], p_v)
-            else:
-                v = p_v
+                v = jnp.where(enc_pg == PGE_DICT, dicts[0][didx], v)
             if kind == "f32":
                 data = jax.lax.bitcast_convert_type(
                     v.astype(jnp.int32), jnp.float32)
@@ -694,19 +808,16 @@ def _build_encoded_decode(layout: Tuple, n: int, cap: int) -> Callable:
     return jax.jit(fn)
 
 
-def _finish_encoded_upload(staged, device: Optional[jax.Device] = None):
+def _finish_encoded_upload(token):
     from spark_rapids_tpu.columnar import device as D
-    _tag, schema, n, cap, words, extras, layout, spec = staged
-    key = ("enc", layout, n, cap, words.nbytes)
+    _tag, schema, n, cap, nbytes, layout, spec, dev = token
+    # the row count is a DEVICE SCALAR input, not a static shape: row
+    # groups of any size share one compiled program per (layout, cap,
+    # bucketed-words) key
+    key = ("enc", layout, cap, nbytes)
     fn = _DECODE_CACHE.get(key)
     if fn is None:
-        fn = _DECODE_CACHE.put(key,
-                               _build_encoded_decode(layout, n, cap))
-    bufs = [words] + list(extras)
-    if device is not None:
-        dev = jax.device_put(bufs, device)
-    else:
-        dev = jax.device_put(bufs)
-    active, outs = fn(dev[0], *dev[1:])
+        fn = _DECODE_CACHE.put(key, _build_encoded_decode(layout, cap))
+    active, outs = fn(dev[0], dev[1], *dev[2:])
     return D.DeviceBatch(schema, D.rebuild_columns(list(spec), outs),
                          active, n)

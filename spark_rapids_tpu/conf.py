@@ -417,15 +417,56 @@ SERVE_TENANT_ID = conf("spark.rapids.sql.serve.tenantId").internal().doc(
 
 PARQUET_DEVICE_DECODE = conf(
     "spark.rapids.sql.format.parquet.deviceDecode.enabled").doc(
-    "Decode Parquet pages ON DEVICE: host threads read raw column-chunk "
-    "bytes, decompress pages and parse headers only; bit-unpacking of "
-    "RLE/bit-packed runs, dictionary gather, PLAIN fixed-width "
-    "reinterpret and definition-level expansion run as XLA kernels "
-    "(the cuDF-decode role of GpuParquetScanBase.scala:82). Columns "
-    "with unsupported encodings/types (DELTA_*, BYTE_STREAM_SPLIT, "
-    "PLAIN byte arrays, nested, INT96) fall back per column to the "
-    "pyarrow host decode; results are bit-identical either way. See "
-    "docs/supported_ops.md for the encoding matrix.").boolean(False)
+    "Decode Parquet pages ON DEVICE (the default scan path, the "
+    "cuDF-decode role of GpuParquetScanBase.scala:82): host threads "
+    "read raw column-chunk bytes, decompress pages and parse headers "
+    "only; bit-unpacking of RLE/bit-packed runs, dictionary gather, "
+    "PLAIN fixed-width reinterpret, string offset+bytes assembly "
+    "(segmented prefix-sum over the lengths + bytes gather), "
+    "DELTA_BINARY_PACKED reconstruction, BYTE_STREAM_SPLIT "
+    "reinterleave and definition-level expansion run as XLA kernels. "
+    "Columns with genuinely unsupported shapes (nested, INT96, "
+    "DELTA_BYTE_ARRAY) fall back per column to the pyarrow host "
+    "decode; results are bit-identical either way. See "
+    "docs/supported_ops.md for the encoding matrix and docs/scan.md "
+    "for the async scan pipeline.").boolean(True)
+
+PARQUET_DEVICE_DECODE_BYTE_ARRAY = conf(
+    "spark.rapids.sql.format.parquet.deviceDecode.byteArray.enabled"
+    ).doc(
+    "Device-decode PLAIN / DELTA_LENGTH byte-array (string/binary) "
+    "pages: the host extracts only the per-value byte lengths; the "
+    "offsets column is built ON DEVICE by a per-page segmented "
+    "prefix-sum and the bytes column is gathered into the padded char "
+    "matrix (SURVEY.md §7 hard part (c)). Off = those columns fall "
+    "back to the pyarrow host decode (dictionary-encoded strings "
+    "still device-decode).").boolean(True)
+
+PARQUET_DEVICE_DECODE_DELTA = conf(
+    "spark.rapids.sql.format.parquet.deviceDecode.delta.enabled").doc(
+    "Device-decode DELTA_BINARY_PACKED (and the length half of "
+    "DELTA_LENGTH_BYTE_ARRAY): the host parses block/miniblock "
+    "headers only; bit-unpacking of the packed deltas and the "
+    "prefix-sum reconstruction run on device. Off = DELTA_* columns "
+    "fall back to the pyarrow host decode.").boolean(True)
+
+PARQUET_DEVICE_DECODE_BSS = conf(
+    "spark.rapids.sql.format.parquet.deviceDecode.byteStreamSplit."
+    "enabled").doc(
+    "Device-decode BYTE_STREAM_SPLIT pages (float/double/int32/int64): "
+    "the byte-plane reinterleave is a strided device gather. Off = "
+    "those columns fall back to the pyarrow host decode.").boolean(True)
+
+PARQUET_DEVICE_DECODE_MAX_IN_FLIGHT = conf(
+    "spark.rapids.sql.format.parquet.deviceDecode.maxInFlight").doc(
+    "Scan upload pipeline depth: how many staged scan batches may have "
+    "their raw-chunk upload in flight (device_put issued, decode "
+    "program not yet dispatched) ahead of the consuming stage, per "
+    "reader stream and per chip. A producer thread prefetches + packs "
+    "batch k+1 while batch k's bytes move and batch k-1 computes, so "
+    "the scan never idles a chip (docs/scan.md). 1 = upload-ahead off "
+    "(still prefetch-threaded); 0 = fully synchronous scan uploads "
+    "(the A/B baseline bench.py measures).").integer(2)
 
 
 class TpuConf:

@@ -115,62 +115,234 @@ class TpuRowToColumnarExec(TpuExec):
         parts = self.child.partitions()
         devices = list(getattr(self.child, "partition_devices", []))
         devices += [None] * (len(parts) - len(devices))
+        from spark_rapids_tpu.conf import \
+            PARQUET_DEVICE_DECODE_MAX_IN_FLIGHT
+        depth = int(self.conf.get(PARQUET_DEVICE_DECODE_MAX_IN_FLIGHT))
 
         def make(thunk: P.PartitionThunk, device) -> DevicePartitionThunk:
-            def run() -> Iterator[DeviceBatch]:
-                # 1-deep upload pipeline: a helper thread packs/stages
-                # batch k+1 (host-only work) while this thread runs
-                # batch k's device_put — pack and wire transfer overlap
-                from concurrent.futures import ThreadPoolExecutor
-                from spark_rapids_tpu.io.device_decode import EncodedBatch
-                pending: List[HostBatch] = []
-                rows = 0
-                staged = None  # in-flight prepare future
-                with ThreadPoolExecutor(
-                        1, thread_name_prefix="srt-pack") as pool:
-                    def submit(payload):
-                        nonlocal staged
-                        prev, staged = staged, pool.submit(
-                            self._prepare, payload, metrics)
-                        return prev
-                    for b in thunk():
+            if depth <= 0:
+                return self._make_sync(thunk, sem, metrics, device)
+            return self._make_pipelined(thunk, sem, metrics, device,
+                                        depth)
+        return [make(t, d) for t, d in zip(parts, devices)]
+
+    def _make_sync(self, thunk, sem, metrics,
+                   device) -> DevicePartitionThunk:
+        """Fully synchronous upload loop (deviceDecode.maxInFlight=0):
+        read -> prepare -> upload -> decode, one batch at a time on the
+        task thread. The unpipelined A/B baseline bench.py measures."""
+        def run() -> Iterator[DeviceBatch]:
+            from spark_rapids_tpu.io.device_decode import EncodedBatch
+
+            def one(payload):
+                return self._finish(self._prepare(payload, metrics),
+                                    sem, metrics, device)
+            pending: List[HostBatch] = []
+            rows = 0
+            for b in thunk():
+                if isinstance(b, EncodedBatch):
+                    if pending:
+                        yield from one(pending)
+                        pending, rows = [], 0
+                    yield from one(b)
+                    continue
+                if b.num_rows == 0:
+                    continue
+                pending.append(b)
+                rows += b.num_rows
+                if rows >= self.goal_rows:
+                    yield from one(pending)
+                    pending, rows = [], 0
+            if pending:
+                yield from one(pending)
+        return run
+
+    def _make_pipelined(self, thunk, sem, metrics, device,
+                        depth: int) -> DevicePartitionThunk:
+        """The async read -> decode -> compute scan pipeline
+        (docs/scan.md): a producer thread pulls reader batches (file
+        IO, decompress, header parse), coalesces and packs them —
+        bounded by a prefetch ring of ``depth`` staged batches — while
+        the task thread issues each batch's raw-chunk device upload
+        AHEAD of the previous batch's decode program, so the upload of
+        batch k+1 overlaps the compute of batch k and the read of
+        batch k+2. One ring per reader stream; on the mesh scan each
+        stream's uploads target its own chip's HBM."""
+        def run() -> Iterator[DeviceBatch]:
+            import queue as _q
+            import threading
+            import time as _time
+
+            from spark_rapids_tpu import trace as _trace
+            from spark_rapids_tpu.io.device_decode import EncodedBatch
+
+            q: "_q.Queue" = _q.Queue(maxsize=depth)
+            stop = threading.Event()
+
+            def put_bounded(item) -> bool:
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.05)
+                        return True
+                    except _q.Full:
+                        continue
+                return False
+
+            def producer() -> None:
+                err = None
+                gen = thunk()
+                try:
+                    def emit(payload) -> bool:
+                        # interval-union metric: N streams' overlapping
+                        # prefetch work counts wall once (the PR 1
+                        # decodeTime>wall audit applies to these
+                        # threads too), mirrored as a scanPrefetch span
+                        m = metrics.create("scanPrefetchTime")
+                        qt = _trace._ACTIVE
+                        t0 = _time.perf_counter_ns()
+                        m.enter_wall()
+                        try:
+                            prep = self._prepare(payload, metrics)
+                        finally:
+                            m.exit_wall()
+                            if qt is not None:
+                                qt.add("scanPrefetch", t0,
+                                       _time.perf_counter_ns(),
+                                       chip=(device.id if device
+                                             is not None else None))
+                        return put_bounded(("batch", prep))
+
+                    pending: List[HostBatch] = []
+                    rows = 0
+                    for b in gen:
+                        if stop.is_set():
+                            return
                         if isinstance(b, EncodedBatch):
-                            # device-decode scan batch: never coalesced
-                            # (it is already a whole row group); flush
-                            # accumulated host batches first to keep
-                            # partition order
+                            # a device-decode batch is already a whole
+                            # row group: never coalesced; flush queued
+                            # host batches first to keep order
                             if pending:
-                                prev = submit(pending)
+                                if not emit(pending):
+                                    return
                                 pending, rows = [], 0
-                                if prev is not None:
-                                    yield from self._finish(
-                                        prev.result(), sem, metrics,
-                                        device)
-                            prev = submit(b)
-                            if prev is not None:
-                                yield from self._finish(
-                                    prev.result(), sem, metrics, device)
+                            if not emit(b):
+                                return
                             continue
                         if b.num_rows == 0:
                             continue
                         pending.append(b)
                         rows += b.num_rows
                         if rows >= self.goal_rows:
-                            prev = submit(pending)
+                            if not emit(pending):
+                                return
                             pending, rows = [], 0
-                            if prev is not None:
-                                yield from self._finish(
-                                    prev.result(), sem, metrics, device)
                     if pending:
-                        prev = submit(pending)
-                        if prev is not None:
-                            yield from self._finish(prev.result(), sem,
-                                                    metrics, device)
-                    if staged is not None:
-                        yield from self._finish(staged.result(), sem,
-                                                metrics, device)
-            return run
-        return [make(t, d) for t, d in zip(parts, devices)]
+                        emit(pending)
+                except BaseException as e:  # surfaced on the task thread
+                    err = e
+                finally:
+                    # a closed/failed consumer must not leak reader
+                    # prefetch work: closing the generator runs the
+                    # reader's finally (cancels pool futures)
+                    try:
+                        gen.close()
+                    except Exception:
+                        pass
+                    put_bounded(("error", err) if err is not None
+                                else ("done",))
+
+            t = threading.Thread(target=producer, daemon=True,
+                                 name="srt-scan-prefetch")
+            t.start()
+            ring: List = []
+            try:
+                while True:
+                    item = q.get()
+                    if item[0] == "done":
+                        break
+                    if item[0] == "error":
+                        raise item[1]
+                    prep = item[1]
+                    entry = self._start_ahead(prep, sem, metrics, device)
+                    if entry is None:
+                        # OOM on the prefetched upload: SHRINK the ring
+                        # — complete and yield the older in-flight
+                        # batches (their raw buffers free with them),
+                        # then run this batch through the synchronous
+                        # spill/retry/host-fallback protocol
+                        metrics.create("prefetchRingShrinks").add(1)
+                        while ring:
+                            yield from self._complete_ahead(
+                                ring.pop(0), metrics)
+                        yield from self._finish(prep, sem, metrics,
+                                                device)
+                        continue
+                    ring.append(entry)
+                    while len(ring) >= depth:
+                        yield from self._complete_ahead(ring.pop(0),
+                                                        metrics)
+                while ring:
+                    yield from self._complete_ahead(ring.pop(0), metrics)
+            finally:
+                stop.set()
+                try:
+                    while True:
+                        q.get_nowait()
+                except _q.Empty:
+                    pass
+                t.join(timeout=10.0)
+        return run
+
+    def _start_ahead(self, prepared, sem, metrics, device):
+        """Issue one prepared batch's raw-buffer device_put (async) —
+        the upload-ahead half of the pipeline. Returns a ring entry, or
+        None on OOM so the caller can shrink the ring first (the
+        prefetched buffers are not yet store-registered, so completing
+        the older in-flight uploads IS the spill here)."""
+        from spark_rapids_tpu import retry as R
+        from spark_rapids_tpu import trace as _trace
+        from spark_rapids_tpu.columnar.transfer import start_upload
+        num_rows, staged, src = prepared
+        sem.acquire_if_necessary(metrics)
+        if device is not None:
+            # mesh scan: an injected/real dispatch failure on this chip
+            # surfaces here; the exchange's degrade loop (or the
+            # driver-level task retry) re-plans on the survivors
+            R.chip_checkpoint(self.conf, device)
+        inj = R.get_fault_injector(self.conf)
+        try:
+            with _trace.span("uploadAhead", mode=staged[0],
+                             chip=(device.id if device is not None
+                                   else None), rows=num_rows):
+                if inj is not None:
+                    inj.on_alloc("upload")
+                tok = start_upload(staged, device)
+            metrics.create("uploadAheadBatches").add(1)
+            return (num_rows, tok, src, device)
+        except R.TpuRetryOOM:
+            return None
+        except Exception as e:
+            if R.is_oom_error(e):
+                return None
+            raise
+
+    def _complete_ahead(self, entry, metrics) -> List[DeviceBatch]:
+        """Run a ring entry's decode program and emit its batches; OOM
+        falls back per batch exactly like the synchronous path."""
+        from spark_rapids_tpu import retry as R
+        from spark_rapids_tpu.columnar.transfer import finish_started
+        num_rows, tok, src, device = entry
+        try:
+            with metrics.timed(M.COPY_TO_DEVICE_TIME,
+                               chip=(device.id if device is not None
+                                     else None), rows=num_rows):
+                out = [R.with_retry(lambda: finish_started(tok),
+                                    self.conf, metrics, splittable=True)]
+        except (R.TpuSplitAndRetryOOM, R.TpuRetryOOM):
+            out = self._upload_degraded(src, device, metrics)
+        metrics.create(M.NUM_OUTPUT_ROWS, M.ESSENTIAL).add(num_rows)
+        metrics.create(M.NUM_OUTPUT_BATCHES, M.ESSENTIAL).add(len(out))
+        return out
 
     def _prepare(self, batches, metrics):
         from spark_rapids_tpu.columnar.transfer import prepare_upload

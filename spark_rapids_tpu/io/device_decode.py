@@ -42,10 +42,27 @@ ENC_PLAIN = 0
 ENC_PLAIN_DICTIONARY = 2
 ENC_RLE = 3
 ENC_BIT_PACKED = 4
+ENC_DELTA_BINARY_PACKED = 5
+ENC_DELTA_LENGTH_BYTE_ARRAY = 6
+ENC_DELTA_BYTE_ARRAY = 7
 ENC_RLE_DICTIONARY = 8
+ENC_BYTE_STREAM_SPLIT = 9
 
 _ENC_NAMES = {ENC_PLAIN: "PLAIN", ENC_PLAIN_DICTIONARY: "PLAIN_DICTIONARY",
-              ENC_RLE: "RLE", ENC_RLE_DICTIONARY: "RLE_DICTIONARY"}
+              ENC_RLE: "RLE", ENC_RLE_DICTIONARY: "RLE_DICTIONARY",
+              ENC_DELTA_BINARY_PACKED: "DELTA_BINARY_PACKED",
+              ENC_DELTA_LENGTH_BYTE_ARRAY: "DELTA_LENGTH_BYTE_ARRAY",
+              ENC_DELTA_BYTE_ARRAY: "DELTA_BYTE_ARRAY",
+              ENC_BYTE_STREAM_SPLIT: "BYTE_STREAM_SPLIT"}
+
+# per-page value-section encoding classes shipped to the device
+# (columnar/transfer.py selects the decode lane per page by these)
+PGE_DICT = 0     # RLE/bit-packed hybrid stream (dict indices, bool bits)
+PGE_PLAIN = 1    # PLAIN fixed-width at pg_plain_byte
+PGE_DELTA = 2    # DELTA_BINARY_PACKED (miniblock runs + seg-cumsum)
+PGE_BSS = 3      # BYTE_STREAM_SPLIT at pg_plain_byte
+PGE_PLAIN_STR = 4  # PLAIN byte array (4-byte length prefixes)
+PGE_DL_STR = 5   # DELTA_LENGTH byte array (concatenated bytes)
 
 # searchsorted sentinel for padded run/page tables
 _SENTINEL = 1 << 62
@@ -213,12 +230,17 @@ class ColumnDevicePlan:
     dl: Optional[RunTable]         # definition levels (None = no nulls)
     pg_dense_start: List[int] = field(default_factory=list)
     pg_plain_byte: List[int] = field(default_factory=list)  # -1 = dict page
-    pg_is_dict: List[bool] = field(default_factory=list)
+    pg_enc: List[int] = field(default_factory=list)         # PGE_* class
+    pg_first: List[int] = field(default_factory=list)  # delta first_value
     vr: Optional[RunTable] = None  # dict-index / bool-bit runs
+    dr: Optional[RunTable] = None  # delta miniblock runs (value=min_delta)
+    str_lens: Optional[np.ndarray] = None  # dense byte lengths (plain/DL)
     dict_arrays: List[np.ndarray] = field(default_factory=list)
     char_cap: int = 0
     n_dense: int = 0               # non-null value count
     has_plain: bool = False
+    has_delta: bool = False
+    has_bss: bool = False
     encoding_values: Dict[str, int] = field(default_factory=dict)
 
 
@@ -235,6 +257,9 @@ class EncodedBatch:
     host_cols: Dict[int, Any]              # field index -> HostColumn
     fallbacks: List[Tuple[str, str]]       # (column, reason)
     path: str = ""
+    # host-decoded value counts per Parquet data encoding for the
+    # fallback columns (bench detail.decode's device-vs-host split)
+    fallback_encodings: Dict[str, int] = field(default_factory=dict)
     # OOM recovery hook (docs/robustness.md): () -> List[HostBatch] via
     # the pyarrow per-column host decode of the SAME scan unit; set by
     # the reader so a device-decode upload that cannot fit falls back
@@ -398,6 +423,147 @@ def _popcount_regions(page: bytes, regions: List[Tuple[int, int]]) -> int:
     return total
 
 
+def _plain_str_lengths(body: bytes, pos: int, end: int,
+                       nn: int) -> np.ndarray:
+    """Per-value byte lengths of a PLAIN byte-array page (4-byte LE
+    length prefixes interleaved with the bytes). The value starts form
+    a sequential chain (start[i+1] = start[i] + 4 + len[i]); resolved
+    with vectorized pointer doubling over a byte-position jump table —
+    O(page_bytes * log n) numpy work, no per-value Python loop."""
+    if nn <= 0:
+        return np.zeros(0, dtype=np.int64)
+    buf = np.frombuffer(body, dtype=np.uint8, offset=pos,
+                        count=end - pos).astype(np.int64)
+    B = buf.shape[0]
+    if B < 4:
+        raise UnsupportedColumn("truncated PLAIN byte-array page")
+    le = (buf[:-3] | (buf[1:-2] << 8) | (buf[2:-1] << 16)
+          | (buf[3:] << 24))        # u32 length at every byte position
+    limit = B - 3
+    nxt = np.arange(limit, dtype=np.int64) + 4 + le
+    np.clip(nxt, 0, limit - 1, out=nxt)   # keep the table in-domain
+    starts = np.empty(nn, dtype=np.int64)
+    starts[0] = 0
+    filled = 1
+    jump = nxt                            # jumps exactly `filled` values
+    while filled < nn:
+        take = min(filled, nn - filled)
+        starts[filled:filled + take] = jump[starts[:take]]
+        filled += take
+        if filled < nn:
+            jump = jump[jump]
+    lengths = le[starts]
+    if nn >= 2 and not (np.diff(starts) > 0).all():
+        raise UnsupportedColumn("corrupt PLAIN byte-array chain")
+    if int(starts[-1]) + 4 + int(lengths[-1]) > B:
+        raise UnsupportedColumn("PLAIN byte-array page overruns body")
+    return lengths
+
+
+def _parse_delta_header(page: bytes, pos: int) -> Tuple[int, int, int,
+                                                        int, int]:
+    """DELTA_BINARY_PACKED stream header ->
+    (values_per_miniblock, miniblocks_per_block, total_count,
+    first_value, pos_after_header)."""
+    block_size, pos = _varint(page, pos)
+    mbpb, pos = _varint(page, pos)
+    total, pos = _varint(page, pos)
+    first, pos = _zigzag(page, pos)
+    if mbpb <= 0 or block_size <= 0 or block_size % mbpb:
+        raise UnsupportedColumn("malformed delta header")
+    vpm = block_size // mbpb
+    if vpm % 8:
+        raise UnsupportedColumn(f"delta miniblock size {vpm}")
+    return vpm, mbpb, total, first, pos
+
+
+def _parse_delta_runs(page: bytes, pos: int, end: int, out_base: int,
+                      page_buf_off: int, runs: RunTable
+                      ) -> Tuple[int, int, int]:
+    """Parse DELTA_BINARY_PACKED block/miniblock HEADERS (the payload
+    stays in the page bytes for the device): appends one run per
+    miniblock with out_start in dense-lane coordinates (the lane of the
+    miniblock's FIRST delta = out_base + 1 + delta_index), value =
+    the block's min_delta, and the payload's absolute bit offset.
+    Returns (first_value, total_count, stream_end_pos)."""
+    vpm, mbpb, total, first, pos = _parse_delta_header(page, pos)
+    remaining = total - 1
+    di = 0
+    while remaining > 0:
+        if pos >= end:
+            raise UnsupportedColumn("truncated delta stream")
+        md, pos = _zigzag(page, pos)
+        widths = page[pos:pos + mbpb]
+        pos += mbpb
+        for w in widths:
+            if remaining <= 0:
+                break
+            if w > 64:
+                raise UnsupportedColumn(f"delta bit width {w}")
+            nv = min(vpm, remaining)
+            runs.add(out_base + 1 + di, True, md,
+                     (page_buf_off + pos) * 8, w)
+            pos += vpm * w // 8
+            di += nv
+            remaining -= nv
+    if pos > end:
+        # a truncated last miniblock would otherwise point the device
+        # kernel past this page into neighbor bytes — fall back instead
+        raise UnsupportedColumn("delta stream overruns page")
+    return first, total, pos
+
+
+def _delta_decode_host(page: bytes, pos: int, end: int
+                       ) -> Tuple[np.ndarray, int]:
+    """Full host decode of one DELTA_BINARY_PACKED stream (used for
+    DELTA_LENGTH_BYTE_ARRAY *lengths*, which the host needs anyway to
+    size the static char matrix): vectorized per miniblock via
+    unpackbits, wrap-around arithmetic in uint64. Returns
+    (int64 values, stream_end_pos)."""
+    vpm, mbpb, total, first, pos = _parse_delta_header(page, pos)
+    first_u = np.uint64(first & 0xFFFFFFFFFFFFFFFF)
+    if total <= 0:
+        return np.zeros(0, dtype=np.int64), pos
+    deltas = np.zeros(max(0, total - 1), dtype=np.uint64)
+    remaining = total - 1
+    di = 0
+    shifts = {}
+    while remaining > 0:
+        if pos >= end:
+            raise UnsupportedColumn("truncated delta stream")
+        md, pos = _zigzag(page, pos)
+        md_u = np.uint64(md & 0xFFFFFFFFFFFFFFFF)
+        widths = page[pos:pos + mbpb]
+        pos += mbpb
+        for w in widths:
+            if remaining <= 0:
+                break
+            if w > 64:
+                raise UnsupportedColumn(f"delta bit width {w}")
+            nv = min(vpm, remaining)
+            nb = vpm * w // 8
+            if w:
+                bits = np.unpackbits(
+                    np.frombuffer(page, dtype=np.uint8, offset=pos,
+                                  count=nb), bitorder="little")
+                if w not in shifts:
+                    shifts[w] = np.arange(w, dtype=np.uint64)
+                vals = (bits.reshape(vpm, w).astype(np.uint64)
+                        << shifts[w]).sum(axis=1, dtype=np.uint64)
+                deltas[di:di + nv] = vals[:nv] + md_u
+            else:
+                deltas[di:di + nv] = md_u
+            pos += nb
+            di += nv
+            remaining -= nv
+    out = np.empty(total, dtype=np.uint64)
+    out[0] = first_u
+    if total > 1:
+        np.cumsum(deltas, out=out[1:])
+        out[1:] += first_u
+    return out.view(np.int64), pos
+
+
 def _decode_dict_page(body: bytes, nvals: int, dt: T.DataType,
                       kind: str, leaf) -> Tuple[List[np.ndarray], int]:
     """PLAIN dictionary page -> host-decoded lookup arrays (dictionaries
@@ -455,21 +621,28 @@ def _decode_dict_page(body: bytes, nvals: int, dt: T.DataType,
     raise UnsupportedColumn(f"dictionary for kind {kind}")
 
 
+_ALL_FEATS = (True, True, True)  # (byteArray, delta, byteStreamSplit)
+
+
 def _plan_column(raw: bytes, chunk, leaf, dt: T.DataType, n_rows: int,
-                 packer) -> ColumnDevicePlan:
+                 packer, feats: Tuple[bool, bool, bool] = _ALL_FEATS
+                 ) -> ColumnDevicePlan:
     """Walk one column chunk's pages, appending decompressed page bytes
-    to ``packer`` and building the device plan."""
+    to ``packer`` and building the device plan. ``feats`` are the
+    per-encoding enables (deviceDecode.byteArray/delta/byteStreamSplit
+    confs) — a disabled encoding falls back per column."""
     _check_supported(dt, leaf)
     codec_name = _HOST_CODECS.get(chunk.compression, "?")
     if codec_name == "?":
         raise UnsupportedColumn(f"codec {chunk.compression}")
     kind, np_dt, elem_bytes = _kind_for(dt, leaf)
     max_def = leaf.max_definition_level
+    feat_bytearray, feat_delta, feat_bss = feats
 
     start, end = 0, len(raw)  # raw is exactly the chunk's byte range
 
     plan = ColumnDevicePlan(dt, kind, np_dt, elem_bytes,
-                            dl=RunTable(), vr=RunTable())
+                            dl=RunTable(), vr=RunTable(), dr=RunTable())
     import pyarrow as pa
     codec = pa.Codec(codec_name) if codec_name else None
 
@@ -477,6 +650,7 @@ def _plan_column(raw: bytes, chunk, leaf, dt: T.DataType, n_rows: int,
     dense = 0      # non-null values consumed
     n_dict = 0
     all_valid_runs = True
+    str_parts: List[Tuple[int, np.ndarray]] = []  # (dense_off, lengths)
     pos = start
     while pos < end:
         hdr, body_off = parse_page_header(raw, pos)
@@ -565,6 +739,7 @@ def _plan_column(raw: bytes, chunk, leaf, dt: T.DataType, n_rows: int,
         ename = _ENC_NAMES.get(enc, str(enc))
         plan.encoding_values[ename] = \
             plan.encoding_values.get(ename, 0) + nn
+        plan.pg_first.append(0)
         if enc in (ENC_PLAIN_DICTIONARY, ENC_RLE_DICTIONARY):
             if not plan.dict_arrays:
                 raise UnsupportedColumn("dictionary page missing")
@@ -573,24 +748,78 @@ def _plan_column(raw: bytes, chunk, leaf, dt: T.DataType, n_rows: int,
                 raise UnsupportedColumn(f"dict index width {vw}")
             _parse_hybrid_runs(body, val_off + 1, len(body), vw, nn,
                                dense, page_off, plan.vr)
-            plan.pg_is_dict.append(True)
+            plan.pg_enc.append(PGE_DICT)
+            plan.pg_plain_byte.append(-1)
+        elif enc == ENC_PLAIN and kind == "str":
+            if not feat_bytearray:
+                raise UnsupportedColumn(
+                    "PLAIN byte array (deviceDecode.byteArray disabled)")
+            lens = _plain_str_lengths(body, val_off, len(body), nn)
+            str_parts.append((dense, lens))
+            plan.pg_enc.append(PGE_PLAIN_STR)
+            plan.pg_plain_byte.append(page_off + val_off)
+        elif enc == ENC_PLAIN and kind == "bool":
+            # raw bit-packed values == one packed run of width 1
+            plan.vr.add(dense, True, 0, (page_off + val_off) * 8, 1)
+            plan.pg_enc.append(PGE_DICT)  # value comes from vr
             plan.pg_plain_byte.append(-1)
         elif enc == ENC_PLAIN:
-            if kind == "str":
-                raise UnsupportedColumn("PLAIN byte_array data page")
-            if kind == "bool":
-                # raw bit-packed values == one packed run of width 1
-                plan.vr.add(dense, True, 0,
-                            (page_off + val_off) * 8, 1)
-                plan.pg_is_dict.append(True)  # value comes from vr
-                plan.pg_plain_byte.append(-1)
-            else:
-                plan.has_plain = True
-                plan.pg_is_dict.append(False)
-                plan.pg_plain_byte.append(page_off + val_off)
+            plan.has_plain = True
+            plan.pg_enc.append(PGE_PLAIN)
+            plan.pg_plain_byte.append(page_off + val_off)
+        elif enc == ENC_RLE and kind == "bool":
+            # v2 boolean pages: 4-byte length prefix then a hybrid
+            # stream of width 1 — same device lane as PLAIN booleans
+            _parse_hybrid_runs(body, val_off + 4, len(body), 1, nn,
+                               dense, page_off, plan.vr)
+            plan.pg_enc.append(PGE_DICT)
+            plan.pg_plain_byte.append(-1)
+        elif enc == ENC_DELTA_BINARY_PACKED and kind in ("int", "dec64") \
+                and leaf.physical_type in ("INT32", "INT64"):
+            if not feat_delta:
+                raise UnsupportedColumn(
+                    "DELTA_BINARY_PACKED (deviceDecode.delta disabled)")
+            first, total, _ = _parse_delta_runs(
+                body, val_off, len(body), dense, page_off, plan.dr)
+            if total != nn:
+                raise UnsupportedColumn(
+                    f"delta count {total} != page values {nn}")
+            plan.pg_first[-1] = first
+            plan.has_delta = True
+            plan.pg_enc.append(PGE_DELTA)
+            plan.pg_plain_byte.append(-1)
+        elif enc == ENC_DELTA_LENGTH_BYTE_ARRAY and kind == "str":
+            if not (feat_bytearray and feat_delta):
+                raise UnsupportedColumn(
+                    "DELTA_LENGTH_BYTE_ARRAY (deviceDecode disabled)")
+            lens, bytes_pos = _delta_decode_host(body, val_off,
+                                                 len(body))
+            if lens.shape[0] != nn:
+                raise UnsupportedColumn(
+                    f"delta-length count {lens.shape[0]} != {nn}")
+            if lens.shape[0] and (int(lens.min()) < 0 or
+                                  bytes_pos + int(lens.sum())
+                                  > len(body)):
+                raise UnsupportedColumn("delta-length bytes overrun")
+            str_parts.append((dense, lens))
+            plan.pg_enc.append(PGE_DL_STR)
+            plan.pg_plain_byte.append(page_off + bytes_pos)
+        elif enc == ENC_BYTE_STREAM_SPLIT and (
+                kind in ("f32", "f64")
+                or (kind == "int" and leaf.physical_type
+                    in ("INT32", "INT64"))):
+            if not feat_bss:
+                raise UnsupportedColumn(
+                    "BYTE_STREAM_SPLIT (deviceDecode.byteStreamSplit "
+                    "disabled)")
+            if val_off + nn * elem_bytes > len(body):
+                raise UnsupportedColumn("BYTE_STREAM_SPLIT page overrun")
+            plan.has_bss = True
+            plan.pg_enc.append(PGE_BSS)
+            plan.pg_plain_byte.append(page_off + val_off)
         else:
             raise UnsupportedColumn(
-                f"encoding {_ENC_NAMES.get(enc, enc)}")
+                f"encoding {_ENC_NAMES.get(enc, enc)} for {kind}")
         rows += nv
         dense += nn
 
@@ -603,9 +832,45 @@ def _plan_column(raw: bytes, chunk, leaf, dt: T.DataType, n_rows: int,
         plan.dl = None  # no nulls: validity is just the active mask
     if len(plan.vr) == 0:
         plan.vr = None
-    if kind == "str" and plan.vr is None:
-        raise UnsupportedColumn("string column with no dictionary pages")
+    if len(plan.dr) == 0:
+        plan.dr = None
+    if str_parts:
+        # dense-lane byte lengths for the non-dict string pages; the
+        # device builds offsets from these with a per-page (segmented)
+        # prefix-sum and gathers the bytes column (SURVEY.md §7 c)
+        lens = np.zeros(max(1, dense), dtype=np.int32)
+        max_len = 1
+        for off, part in str_parts:
+            lens[off:off + part.shape[0]] = part
+            if part.shape[0]:
+                max_len = max(max_len, int(part.max()))
+        plan.str_lens = lens
+        from spark_rapids_tpu.columnar.device import bucket_char_cap
+        plain_cap = bucket_char_cap(max_len)
+        if plan.dict_arrays:
+            if plain_cap > plan.char_cap:
+                # unify the char matrix width across dict + plain pages
+                ch = plan.dict_arrays[0]
+                wide = np.zeros((ch.shape[0], plain_cap), dtype=ch.dtype)
+                wide[:, :ch.shape[1]] = ch
+                plan.dict_arrays[0] = wide
+                plan.char_cap = plain_cap
+        else:
+            plan.char_cap = plain_cap
+    if kind == "str" and plan.vr is None and plan.str_lens is None:
+        raise UnsupportedColumn("string column with no value pages")
     return plan
+
+
+def _feats_from_conf(conf) -> Tuple[bool, bool, bool]:
+    if conf is None:
+        return _ALL_FEATS
+    from spark_rapids_tpu.conf import (PARQUET_DEVICE_DECODE_BYTE_ARRAY,
+                                       PARQUET_DEVICE_DECODE_BSS,
+                                       PARQUET_DEVICE_DECODE_DELTA)
+    return (bool(conf.get(PARQUET_DEVICE_DECODE_BYTE_ARRAY)),
+            bool(conf.get(PARQUET_DEVICE_DECODE_DELTA)),
+            bool(conf.get(PARQUET_DEVICE_DECODE_BSS)))
 
 
 def plan_unit_encoded(unit, data_schema: T.StructType, conf=None
@@ -617,6 +882,7 @@ def plan_unit_encoded(unit, data_schema: T.StructType, conf=None
     import pyarrow.parquet as pq
     from spark_rapids_tpu.columnar.transfer import _Packer
     from spark_rapids_tpu.io.arrow_convert import arrow_column_to_host
+    feats = _feats_from_conf(conf)
 
     if not unit.row_groups or len(unit.row_groups) != 1:
         return None
@@ -665,7 +931,7 @@ def plan_unit_encoded(unit, data_schema: T.StructType, conf=None
                 # as dead bytes in every uploaded batch
                 sub = _Packer()
                 plan = _plan_column(raw, chunk, leaf,
-                                    fld.data_type, n_rows, sub)
+                                    fld.data_type, n_rows, sub, feats)
                 _rebase_plan(plan, packer.off)
                 packer.parts.extend(sub.parts)
                 packer.off += sub.off
@@ -677,6 +943,23 @@ def plan_unit_encoded(unit, data_schema: T.StructType, conf=None
 
     if not plans:
         return None
+    # host-decoded value counts per data encoding for the fallback
+    # columns (regression visibility: a new fallback shows up in the
+    # bench's hostDecodedValues split, not just a unit count)
+    fallback_encodings: Dict[str, int] = {}
+    for name, _reason in fallbacks:
+        chunk = chunk_by_leaf.get(name)
+        if chunk is None:
+            continue
+        # count each column's rows ONCE, under its dominant DATA
+        # encoding: chunk.encodings also lists level encodings and the
+        # dictionary page's own PLAIN, which would multi-count
+        data_encs = [e for e in chunk.encodings
+                     if e not in ("RLE", "BIT_PACKED")]
+        dict_encs = [e for e in data_encs if "DICTIONARY" in e]
+        ename = (dict_encs or data_encs or ["UNKNOWN"])[0]
+        fallback_encodings[ename] = \
+            fallback_encodings.get(ename, 0) + n_rows
     if fallbacks:
         names = [n for n, _r in fallbacks]
         present = [n for n in names if n in leaf_by_name]
@@ -692,14 +975,15 @@ def plan_unit_encoded(unit, data_schema: T.StructType, conf=None
                 from spark_rapids_tpu.columnar.host import HostColumn
                 host_cols[fi] = _null_host_column(fld.data_type, n_rows)
     return EncodedBatch(data_schema, n_rows, packer.words(), plans,
-                        host_cols, fallbacks, unit.path)
+                        host_cols, fallbacks, unit.path,
+                        fallback_encodings=fallback_encodings)
 
 
 def _rebase_plan(plan: ColumnDevicePlan, base: int) -> None:
     """Shift a plan built against a column-local buffer to its final
     byte offset in the shared packed buffer (base is 4-byte aligned:
     _Packer pads every add)."""
-    for rt in (plan.dl, plan.vr):
+    for rt in (plan.dl, plan.vr, plan.dr):
         if rt is None:
             continue
         for i in range(len(rt)):

@@ -174,16 +174,24 @@ def plan_scan_units(fmt: str, files: List[tuple]) -> List[ScanUnit]:
                 stats: Dict[str, tuple] = {}
                 for ci in range(rgm.num_columns):
                     col = rgm.column(ci)
-                    st = col.statistics
                     name = col.path_in_schema.split(".")[0]
-                    if st is None:
+                    try:
+                        st = col.statistics
+                        if st is None:
+                            stats[name] = (None, None, None,
+                                           rgm.num_rows)
+                        else:
+                            stats[name] = (
+                                st.min if st.has_min_max else None,
+                                st.max if st.has_min_max else None,
+                                st.null_count if st.has_null_count
+                                else None,
+                                rgm.num_rows)
+                    except Exception:
+                        # some physical/logical combos (e.g. decimal
+                        # stored as integer) cannot extract stats —
+                        # pruning is optional, the scan is not
                         stats[name] = (None, None, None, rgm.num_rows)
-                    else:
-                        stats[name] = (
-                            st.min if st.has_min_max else None,
-                            st.max if st.has_min_max else None,
-                            st.null_count if st.has_null_count else None,
-                            rgm.num_rows)
                 units.append(ScanUnit(
                     f, rgm.total_byte_size, [rg], pv, stats))
             if meta.num_row_groups == 0:
@@ -628,9 +636,19 @@ class CpuFileScanExec(P.PhysicalPlan):
                 # all-fallback run for "nothing to decode"
                 metrics.create("deviceFallbackUnits").add(1)
                 return None
+            from spark_rapids_tpu import retry as R
             with metrics.timed_wall("deviceDecodeTime", path=u.path):
                 try:
-                    enc = DD.plan_unit_encoded(u, data_schema)
+                    # the device plan's file reads ride the same
+                    # transient-IO retry protocol (and fault-injection
+                    # checkpoints) as the host decode; a genuine IO
+                    # failure after retries fails the query either way
+                    enc = R.io_with_retry(
+                        lambda: DD.plan_unit_encoded(
+                            u, data_schema, self.conf),
+                        self.conf, metrics, path=u.path)
+                except OSError:
+                    raise  # exhausted retries: a real reader failure
                 except Exception:
                     enc = None  # corrupt chunk: the host decode decides
             if enc is None or enc.num_rows > max_rows:
@@ -645,6 +663,8 @@ class CpuFileScanExec(P.PhysicalPlan):
             metrics.create("deviceDecodedBatches").add(1)
             for name, _reason in enc.fallbacks:
                 metrics.create("deviceFallbackColumns").add(1)
+            for ename, nvals in enc.fallback_encodings.items():
+                metrics.create(f"hostDecodedValues.{ename}").add(nvals)
             for plan in enc.plans.values():
                 for ename, nvals in plan.encoding_values.items():
                     metrics.create(

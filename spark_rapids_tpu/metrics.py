@@ -128,6 +128,14 @@ METRIC_DESCRIPTIONS: Dict[str, str] = {
     "deviceDecodedBatches": "scan batches decoded on device",
     "deviceFallbackUnits": "scan units that fell back to host decode",
     "deviceFallbackColumns": "columns that fell back to host decode",
+    # scan pipeline (docs/scan.md): producer-thread prefetch + bounded
+    # upload-ahead ring in TpuRowToColumnarExec
+    "scanPrefetchTime": "scan producer-thread read+pack wall "
+                        "(interval union; overlaps device compute)",
+    "uploadAheadBatches": "scan batches whose raw-chunk upload was "
+                          "issued ahead of the consuming stage",
+    "prefetchRingShrinks": "upload-ahead rings drained after OOM on a "
+                           "prefetched upload",
 }
 
 # dynamic metric families: any key starting with one of these prefixes
@@ -136,6 +144,8 @@ METRIC_PREFIX_DESCRIPTIONS: Dict[str, str] = {
     "dispatchCount.chip": "device programs dispatched on chip <N>",
     "meshScanUnits.chip": "scan units assigned to chip <N>'s stream",
     "deviceDecodedValues.": "values decoded on device per encoding",
+    "hostDecodedValues.": "values host-decoded (fallback columns) per "
+                          "encoding",
 }
 
 

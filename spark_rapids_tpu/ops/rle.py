@@ -79,6 +79,76 @@ def hybrid_lookup(bytes_all: jax.Array, pos: jax.Array,
     return jnp.where(packed[rid], v_packed, value[rid])
 
 
+def read_packed64(bytes_all: jax.Array, bit_off: jax.Array,
+                  width: jax.Array) -> jax.Array:
+    """``read_packed`` for widths up to 64 (DELTA_BINARY_PACKED
+    miniblocks store deltas at any width): the value is assembled from
+    two <=32-bit reads so every intermediate fits an int64 without
+    shift overflow. width may vary per lane; width == 0 reads 0."""
+    w = width.astype(jnp.int64)
+    lo = read_packed(bytes_all, bit_off, jnp.minimum(w, 32))
+    hi = read_packed(bytes_all, bit_off + 32, jnp.maximum(w - 32, 0))
+    return lo | (hi << 32)
+
+
+def delta_lookup(bytes_all: jax.Array, pos: jax.Array,
+                 out_start: jax.Array, packed: jax.Array,
+                 value: jax.Array, bit_start: jax.Array,
+                 width: jax.Array) -> jax.Array:
+    """Per-lane DELTA_BINARY_PACKED delta: the run table is one entry
+    per miniblock (out_start = dense lane of the miniblock's first
+    delta, value = the block's min_delta, bit_start = absolute payload
+    bit offset, width = miniblock bit width). Lane ``pos`` returns
+    min_delta + unpacked[pos - out_start]; positions outside any run
+    (a page's first value, other-encoding pages) decode garbage —
+    callers mask before the segmented cumsum."""
+    rid = jnp.searchsorted(out_start, pos, side="right") - 1
+    rid = jnp.clip(rid, 0, out_start.shape[0] - 1)
+    local = pos - out_start[rid]
+    w = width[rid]
+    raw = read_packed64(bytes_all, bit_start[rid] + local * w, w)
+    return value[rid] + raw
+
+
+def read_bss(bytes_all: jax.Array, base: jax.Array, stride: jax.Array,
+             local: jax.Array, nbytes: int) -> jax.Array:
+    """BYTE_STREAM_SPLIT reinterpret: a page's value section holds
+    ``stride`` (= values-in-page) copies of byte 0, then byte 1, ...;
+    value ``local`` gathers byte j at base + j*stride + local and
+    assembles little-endian into an int64 (zero-extended)."""
+    nb = bytes_all.shape[0]
+    k = jnp.arange(nbytes, dtype=jnp.int64)
+    idx = base[:, None] + k[None, :] * stride[:, None] + local[:, None]
+    win = bytes_all[jnp.clip(idx, 0, nb - 1)].astype(jnp.int64)
+    return jnp.sum(win << (k * 8), axis=1)
+
+
+def gather_chars(bytes_all: jax.Array, starts: jax.Array,
+                 lengths: jax.Array, char_cap: int) -> jax.Array:
+    """Variable bytes -> (n, char_cap) uint8 matrix: row i gathers
+    lengths[i] bytes at starts[i], zero-padded (the SURVEY offset+bytes
+    string model's gather half; offsets come from a segmented
+    prefix-sum over the lengths)."""
+    nb = bytes_all.shape[0]
+    idx = starts[:, None] + jnp.arange(char_cap, dtype=jnp.int64)
+    mask = jnp.arange(char_cap, dtype=jnp.int32) < lengths[:, None]
+    g = bytes_all[jnp.clip(idx, 0, nb - 1)]
+    return jnp.where(mask, g, 0).astype(jnp.uint8)
+
+
+def seg_excl_cumsum(contrib: jax.Array, seg_first_lane: jax.Array
+                    ) -> jax.Array:
+    """Exclusive prefix sum of ``contrib`` restarting at each segment:
+    lane i gets sum(contrib[seg_first_lane[i]:i]). seg_first_lane is
+    each lane's own segment-start lane index (clipped by the caller).
+    This is the offsets-from-lengths half of the string decode: within
+    a page, value i starts at the sum of the byte footprints before
+    it."""
+    c = jnp.cumsum(contrib)
+    excl = c - contrib
+    return excl - excl[seg_first_lane]
+
+
 def read_le(bytes_all: jax.Array, byte_off: jax.Array,
             nbytes: int) -> jax.Array:
     """PLAIN fixed-width reinterpret: little-endian nbytes -> int64

@@ -115,16 +115,22 @@ class _Schedule:
                      (K > retry.maxRetries forces split-and-retry)
     - ``"split:N"``  throw TpuSplitAndRetryOOM at every Nth event
     - ``"seed:S:P"`` seeded random: each event fails with probability P
+    - ``"site:NAME:SPEC"`` scope any of the above to events tagged
+      with site NAME (e.g. ``site:upload:2`` fails every 2nd scan
+      upload-ahead; untagged sites never count against the schedule)
     """
 
-    __slots__ = ("every_n", "streak", "split", "seed", "prob", "rng")
+    __slots__ = ("every_n", "streak", "split", "seed", "prob", "rng",
+                 "site")
 
-    def __init__(self, every_n=0, streak=1, split=False, seed=0, prob=0.0):
+    def __init__(self, every_n=0, streak=1, split=False, seed=0,
+                 prob=0.0, site=""):
         self.every_n = every_n
         self.streak = max(1, streak)
         self.split = split
         self.seed = seed
         self.prob = prob
+        self.site = site
         # per-schedule RNG: a seeded OOM schedule and a seeded IO
         # schedule must each follow their OWN deterministic stream
         self.rng = random.Random(seed) if prob > 0.0 else None
@@ -134,6 +140,12 @@ def _parse_schedule(spec: str) -> Optional[_Schedule]:
     s = str(spec or "").strip().lower()
     if not s or s in ("0", "false", "off", "none"):
         return None
+    if s.startswith("site:"):
+        _, name, rest = s.split(":", 2)
+        sched = _parse_schedule(rest)
+        if sched is not None:
+            sched.site = name
+        return sched
     if s.startswith("split:"):
         return _Schedule(every_n=int(s[len("split:"):]), split=True)
     if s.startswith("seed:"):
@@ -175,9 +187,14 @@ class FaultInjector:
             return sched.rng.random() < sched.prob
         return sched.every_n > 0 and count % sched.every_n == 0
 
-    def on_alloc(self) -> None:
-        """Checkpoint at one wrapped device allocation attempt."""
+    def on_alloc(self, site: str = "") -> None:
+        """Checkpoint at one wrapped device allocation attempt. ``site``
+        tags named allocation classes (``upload`` = the scan pipeline's
+        prefetched raw-chunk upload) so a ``site:NAME:...`` schedule
+        can target exactly one of them."""
         if self._oom is None or _suppressed():
+            return
+        if self._oom.site and self._oom.site != site:
             return
         with self._lock:
             if self._oom_streak > 0:
@@ -355,7 +372,7 @@ def _recover(conf, metrics, attempt: int, backoff_ms: int,
 
 def with_retry(fn: Callable[[], T], conf=None, metrics=None, *,
                splittable: bool = False,
-               translate_real: bool = True) -> T:
+               translate_real: bool = True, site: str = "") -> T:
     """Run ``fn`` under the OOM-retry protocol (withRetryNoSplit role).
 
     On :class:`TpuRetryOOM` — injected, or a real backend
@@ -377,7 +394,7 @@ def with_retry(fn: Callable[[], T], conf=None, metrics=None, *,
     while True:
         try:
             if inj is not None:
-                inj.on_alloc()
+                inj.on_alloc(site)
             return fn()
         except TpuSplitAndRetryOOM:
             if splittable:

@@ -668,34 +668,47 @@ def generate_supported_ops() -> str:
         "",
         "## Parquet device decode (encoding matrix)",
         "",
-        "With `spark.rapids.sql.format.parquet.deviceDecode.enabled` "
-        "the scan uploads still-encoded page bytes and decodes them in "
-        "one XLA program per batch (io/device_decode.py + ops/rle.py). "
-        "Unsupported cells fall back PER COLUMN to the pyarrow host "
-        "decode — results are bit-identical either way. The "
-        "`PERFILE`/`MULTITHREADED` reader types feed the device path; "
-        "`COALESCING` keeps the host decode (its point is the "
+        "Device decode is the DEFAULT scan path "
+        "(`spark.rapids.sql.format.parquet.deviceDecode.enabled`, on "
+        "by default): the scan uploads still-encoded page bytes and "
+        "decodes them in one XLA program per batch "
+        "(io/device_decode.py + ops/rle.py), pipelined ahead of the "
+        "consuming stage (docs/scan.md). Unsupported cells fall back "
+        "PER COLUMN to the pyarrow host decode — results are "
+        "bit-identical either way, and fallbacks are visible as "
+        "`deviceFallbackColumns` / `hostDecodedValues.<ENC>` metrics. "
+        "The `PERFILE`/`MULTITHREADED` reader types feed the device "
+        "path; `COALESCING` keeps the host decode (its point is the "
         "one-table stitch). Compression is handled on the host: "
-        "uncompressed, snappy, zstd, gzip, brotli (lz4 falls back).",
+        "uncompressed, snappy, zstd, gzip, brotli (lz4 falls back). "
+        "Per-encoding enables: `deviceDecode.byteArray.enabled`, "
+        "`deviceDecode.delta.enabled`, "
+        "`deviceDecode.byteStreamSplit.enabled`.",
         "",
         "| Type | PLAIN | PLAIN_DICTIONARY / RLE_DICTIONARY | "
-        "DELTA_* / BYTE_STREAM_SPLIT |",
-        "|---|---|---|---|",
-        "| BOOLEAN | device (bit-unpack) | fallback | fallback |",
+        "DELTA_BINARY_PACKED / DELTA_LENGTH_BYTE_ARRAY | "
+        "BYTE_STREAM_SPLIT | DELTA_BYTE_ARRAY |",
+        "|---|---|---|---|---|---|",
+        "| BOOLEAN | device (bit-unpack; v2 RLE pages too) | n/a | "
+        "n/a | n/a | n/a |",
         "| INT32 (byte/short/int/date/decimal) | device | device | "
-        "fallback |",
+        "device (miniblock runs + seg prefix-sum) | device | n/a |",
         "| INT64 (long/timestamp-micros/decimal) | device | device | "
-        "fallback |",
-        "| INT96 (legacy timestamp) | fallback | fallback | fallback |",
-        "| FLOAT | device | device | fallback |",
+        "device (miniblock runs + seg prefix-sum) | device | n/a |",
+        "| INT96 (legacy timestamp) | fallback | fallback | fallback "
+        "| fallback | n/a |",
+        "| FLOAT | device | device | n/a | device | n/a |",
         "| DOUBLE | device (backends with exact f64 bitcast; TPU "
-        "falls back) | same | fallback |",
+        "falls back) | same | n/a | same | n/a |",
         "| FIXED_LEN_BYTE_ARRAY (decimal64/decimal128) | device "
-        "(big-endian limb build) | device | fallback |",
-        "| BYTE_ARRAY (string/binary) | fallback | device "
-        "(dictionary gather) | fallback |",
+        "(big-endian limb build) | device | fallback | fallback "
+        "| n/a |",
+        "| BYTE_ARRAY (string/binary) | device (offsets = segmented "
+        "prefix-sum over lengths, bytes gather) | device (dictionary "
+        "gather) | device (DELTA_LENGTH: host decodes lengths, device "
+        "builds offsets + gathers bytes) | n/a | fallback |",
         "| nested (LIST/MAP/STRUCT, repeated) | fallback | fallback "
-        "| fallback |",
+        "| fallback | fallback | fallback |",
     ]
     return "\n".join(lines) + "\n"
 
@@ -744,6 +757,11 @@ def generate_observability_docs() -> str:
         "- device dispatches are explicit spans with the executing chip:",
         "  `TpuFusedStageExec.dispatch` (stage label, batch sequence) and",
         "  `TpuHashAggregateExec.dispatch` (mode);",
+        "- the scan pipeline (docs/scan.md) adds `scanPrefetch` (the",
+        "  producer thread's read+pack of one staged batch, mirrored",
+        "  into the interval-union `scanPrefetchTime` metric) and",
+        "  `uploadAhead` (the async raw-chunk device_put issued ahead",
+        "  of the consuming stage, with the target chip);",
         "- JIT compiles are `compile` spans (attr `cache` = which LRU",
         "  missed); a thread that blocks on ANOTHER thread's",
         "  in-progress compile of the same key (single-flight) emits a",

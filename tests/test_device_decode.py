@@ -36,16 +36,12 @@ def _collect(path, device_decode: bool, extra_conf=None, sql=None):
         df = spark.sql(sql or "SELECT * FROM t")
         spark.start_capture()
         out = df._execute().to_pydict()
-        scan_metrics = {}
-        for plan in spark.get_captured_plans():
-            stack = [plan]
-            while stack:
-                p = stack.pop()
-                if type(p).__name__ == "CpuFileScanExec":
-                    for k, v in p.metrics.snapshot().items():
-                        scan_metrics[k] = scan_metrics.get(k, 0) + v
-                stack.extend(p.children)
-        return out, scan_metrics
+        # whole-plan metric snapshot: the scan's decode counters plus
+        # the R2C transition's pipeline counters (uploadAheadBatches,
+        # prefetchRingShrinks) ride the same dict
+        from spark_rapids_tpu.metrics import registry_snapshot
+        metrics = registry_snapshot(spark.get_captured_plans())["metrics"]
+        return out, metrics
     finally:
         spark.stop()
 
@@ -194,33 +190,217 @@ def test_multi_row_group_aggregate(tmp_path):
                   "GROUP BY k ORDER BY k")
 
 
-# -- fallback behavior -----------------------------------------------------
+# -- full encoding matrix (ISSUE 9 tentpole) -------------------------------
 
-def test_unsupported_encoding_falls_back_per_column(tmp_path):
-    n = 3000
+def test_delta_binary_packed_device_decode(tmp_path):
+    # DELTA_BINARY_PACKED int64/int32: miniblock runs decoded on device
+    # + segmented prefix-sum reconstruction, vs the pyarrow oracle
+    n = 30_000
     rng = np.random.default_rng(7)
     tbl = pa.table({
-        "delta": pa.array(rng.integers(0, 10**6, n), type=pa.int64()),
-        "ok": pa.array(rng.integers(0, 10**6, n), type=pa.int64()),
+        "i64": pa.array(rng.integers(-(1 << 50), 1 << 50, n),
+                        type=pa.int64()),
+        "i32": pa.array(rng.integers(-(1 << 30), 1 << 30, n)
+                        .astype("int32"), type=pa.int32()),
+        "sorted": pa.array(np.cumsum(rng.integers(0, 9, n)),
+                           type=pa.int64()),
     })
     path = _write(tmp_path, tbl, use_dictionary=False,
-                  column_encoding={"delta": "DELTA_BINARY_PACKED",
-                                   "ok": "PLAIN"})
-    m = _assert_parity(path, expect_fallback_cols=1)
-    # the supported sibling column still decoded on device
-    assert m.get("deviceDecodedValues.PLAIN", 0) >= n, m
+                  column_encoding="DELTA_BINARY_PACKED",
+                  data_page_size=8192)
+    m = _assert_parity(path)
+    assert m.get("deviceDecodedValues.DELTA_BINARY_PACKED", 0) >= 3 * n, m
 
 
-def test_plain_byte_array_falls_back(tmp_path):
-    # PLAIN string pages carry length-prefixed variable bytes — host
-    # fallback for that column, device decode for the rest
+def test_delta_binary_packed_nulls_and_page_boundaries(tmp_path):
+    n = 9000
+    vals = [None if (i // 41) % 3 == 0 else (i * 7919) % (1 << 40) - 17
+            for i in range(n)]
+    tbl = pa.table({"v": pa.array(vals, type=pa.int64())})
+    path = _write(tmp_path, tbl, use_dictionary=False,
+                  column_encoding="DELTA_BINARY_PACKED",
+                  data_page_size=1024)
+    _assert_parity(path)
+
+
+def test_delta_decimal_int_physical(tmp_path):
+    # decimal with INT32/INT64 physical storage rides the delta path
+    n = 4000
+    rng = np.random.default_rng(17)
+    tbl = pa.table({
+        "d": pa.array(rng.integers(0, 10**6, n).tolist(),
+                      type=pa.decimal128(9, 2)),
+    })
+    import pyarrow.parquet as _pq
+    path = os.path.join(str(tmp_path), "d.parquet")
+    try:
+        _pq.write_table(tbl, path, use_dictionary=False,
+                        store_decimal_as_integer=True,
+                        column_encoding="DELTA_BINARY_PACKED")
+    except (OSError, TypeError) as e:
+        pytest.skip(f"writer cannot emit delta decimal: {e}")
+    enc = _pq.ParquetFile(path).metadata.row_group(0).column(0).encodings
+    if "DELTA_BINARY_PACKED" not in enc:
+        pytest.skip(f"writer did not emit delta for decimal: {enc}")
+    _assert_parity(path, sql="SELECT sum(d) s, count(*) c FROM t")
+
+
+def test_plain_byte_array_device_decode(tmp_path):
+    # PLAIN string pages: host extracts lengths only; the offsets
+    # column is a device segmented prefix-sum, the bytes a gather
     n = 2500
     tbl = pa.table({
         "s": pa.array([f"value-{i}" for i in range(n)]),
         "i": pa.array(np.arange(n), type=pa.int64()),
     })
     path = _write(tmp_path, tbl, use_dictionary=False)
-    _assert_parity(path, expect_fallback_cols=1)
+    m = _assert_parity(path)
+    assert m.get("deviceDecodedValues.PLAIN", 0) >= 2 * n, m
+
+
+def test_plain_strings_empty_and_nulls_at_page_boundaries(tmp_path):
+    # empty strings, nulls straddling tiny pages, variable lengths
+    n = 6000
+    vals = []
+    for i in range(n):
+        if (i // 37) % 3 == 1:
+            vals.append(None)
+        elif i % 11 == 0:
+            vals.append("")
+        else:
+            vals.append("x" * (i % 23) + f"#{i}")
+    tbl = pa.table({"s": pa.array(vals)})
+    path = _write(tmp_path, tbl, use_dictionary=False,
+                  data_page_size=512)
+    _assert_parity(path)
+
+
+def test_string_dict_overflow_to_plain_mid_chunk(tmp_path):
+    # the writer starts RLE_DICTIONARY, overflows the dict-page limit,
+    # and finishes the SAME chunk with PLAIN byte-array pages: both
+    # lanes decode on device, selected per page
+    n = 12_000
+    rng = np.random.default_rng(13)
+    vals = [f"prefix-{int(v)}-suffix" for v in rng.integers(0, 6000, n)]
+    tbl = pa.table({"s": pa.array(vals)})
+    path = _write(tmp_path, tbl, dictionary_pagesize_limit=8_000,
+                  data_page_size=4096)
+    import pyarrow.parquet as _pq
+    encs = _pq.ParquetFile(path).metadata.row_group(0).column(0).encodings
+    m = _assert_parity(path)
+    if "PLAIN" in encs:  # overflow really happened
+        assert m.get("deviceDecodedValues.PLAIN", 0) > 0, (encs, m)
+        assert m.get("deviceDecodedValues.RLE_DICTIONARY", 0) > 0, m
+
+
+def test_binary_plain_device_decode(tmp_path):
+    n = 1500
+    rng = np.random.default_rng(14)
+    vals = [rng.bytes(int(rng.integers(0, 19))) for _ in range(n)]
+    tbl = pa.table({"b": pa.array(vals, type=pa.binary()),
+                    "k": pa.array(np.arange(n) % 7, type=pa.int64())})
+    path = _write(tmp_path, tbl, use_dictionary=False)
+    _assert_parity(path, sql="SELECT k, count(b) c FROM t GROUP BY k "
+                             "ORDER BY k")
+
+
+def test_delta_length_byte_array(tmp_path):
+    n = 5000
+    vals = ["" if i % 13 == 0 else
+            None if i % 17 == 0 else f"dl-{i % 97}-{'y' * (i % 9)}"
+            for i in range(n)]
+    tbl = pa.table({"s": pa.array(vals),
+                    "i": pa.array(np.arange(n), type=pa.int64())})
+    path = _write(tmp_path, tbl, use_dictionary=False,
+                  column_encoding={"s": "DELTA_LENGTH_BYTE_ARRAY",
+                                   "i": "PLAIN"},
+                  data_page_size=2048)
+    m = _assert_parity(path)
+    assert m.get("deviceDecodedValues.DELTA_LENGTH_BYTE_ARRAY", 0) > 0, m
+
+
+def test_byte_stream_split_float_and_int(tmp_path):
+    n = 4000
+    rng = np.random.default_rng(15)
+    cols = {
+        "f": pa.array(rng.random(n).astype("float32"),
+                      type=pa.float32()),
+        "i64": pa.array(rng.integers(-(1 << 50), 1 << 50, n),
+                        type=pa.int64()),
+        "i32": pa.array(rng.integers(-(1 << 30), 1 << 30, n)
+                        .astype("int32"), type=pa.int32()),
+    }
+    tbl = pa.table(cols)
+    path = _write(tmp_path, tbl, use_dictionary=False,
+                  column_encoding="BYTE_STREAM_SPLIT")
+    m = _assert_parity(path, sql="SELECT i64, i32 FROM t")
+    assert m.get("deviceDecodedValues.BYTE_STREAM_SPLIT", 0) >= 2 * n, m
+
+
+def test_byte_stream_split_double_matches_backend(tmp_path):
+    from spark_rapids_tpu.device_caps import f64_bitcast_exact
+    n = 2000
+    rng = np.random.default_rng(16)
+    tbl = pa.table({"d": pa.array(rng.random(n), type=pa.float64())})
+    path = _write(tmp_path, tbl, use_dictionary=False,
+                  column_encoding="BYTE_STREAM_SPLIT")
+    expect_fb = 0 if f64_bitcast_exact() else 1
+    _assert_parity(path, expect_device=expect_fb == 0,
+                   expect_fallback_cols=expect_fb,
+                   sql="SELECT d FROM t WHERE d >= 0")
+
+
+def test_data_page_v2(tmp_path):
+    # v2 pages: uncompressed level section, RLE boolean values
+    tbl = _mixed_table(n=3000, seed=18)
+    path = _write(tmp_path, tbl, data_page_version="2.0",
+                  data_page_size=2048)
+    _assert_parity(path)
+
+
+# -- fallback behavior -----------------------------------------------------
+
+def test_unsupported_encoding_falls_back_per_column(tmp_path):
+    # DELTA_BYTE_ARRAY (prefix/suffix strings) is genuinely
+    # unsupported: that column host-decodes, the sibling stays on
+    # device, and the host fallback is visible per encoding
+    n = 3000
+    tbl = pa.table({
+        "dba": pa.array([f"prefix-common-{i}" for i in range(n)]),
+        "ok": pa.array(np.arange(n), type=pa.int64()),
+    })
+    path = _write(tmp_path, tbl, use_dictionary=False,
+                  column_encoding={"dba": "DELTA_BYTE_ARRAY",
+                                   "ok": "PLAIN"})
+    m = _assert_parity(path, expect_fallback_cols=1)
+    # the supported sibling column still decoded on device
+    assert m.get("deviceDecodedValues.PLAIN", 0) >= n, m
+    assert m.get("hostDecodedValues.DELTA_BYTE_ARRAY", 0) >= n, m
+
+
+def test_per_encoding_enable_confs(tmp_path):
+    # each deviceDecode.<enc>.enabled=false turns exactly that lane
+    # into a per-column host fallback, bit-identical either way
+    n = 2000
+    rng = np.random.default_rng(19)
+    tbl = pa.table({
+        "s": pa.array([f"v{i}" for i in range(n)]),
+        "d": pa.array(rng.integers(0, 10**6, n), type=pa.int64()),
+        "b": pa.array(rng.random(n).astype("float32"),
+                      type=pa.float32()),
+    })
+    path = _write(tmp_path, tbl, use_dictionary=False,
+                  column_encoding={"s": "PLAIN",
+                                   "d": "DELTA_BINARY_PACKED",
+                                   "b": "BYTE_STREAM_SPLIT"})
+    base = "spark.rapids.sql.format.parquet.deviceDecode."
+    for key, col in ((base + "byteArray.enabled", "s"),
+                     (base + "delta.enabled", "d"),
+                     (base + "byteStreamSplit.enabled", "b")):
+        host, _ = _collect(path, False)
+        dev, m = _collect(path, True, {key: "false"})
+        assert host == dev, (key, col)
+        assert m.get("deviceFallbackColumns", 0) >= 1, (key, m)
 
 
 def test_double_fallback_matches_backend(tmp_path):
@@ -286,6 +466,137 @@ def test_reader_type_multithreaded_device_decode(tmp_path):
             sql="SELECT k, sum(v) s FROM t GROUP BY k ORDER BY k")
         assert host == dev
         assert m.get("deviceDecodedBatches", 0) >= 1, (rt, m)
+
+
+# -- scan pipeline (async read->decode->compute, docs/scan.md) -------------
+
+MAXIF_CONF = "spark.rapids.sql.format.parquet.deviceDecode.maxInFlight"
+
+
+def _write_q1_shaped(tmp_path, n=24_000):
+    """A lineitem-shaped dataset (decimal money, low-cardinality
+    strings, dates) across several row groups — the bench smoke's
+    schema at corpus scale."""
+    rng = np.random.default_rng(20)
+    tbl = pa.table({
+        "qty": pa.array(rng.integers(100, 5100, n).tolist(),
+                        type=pa.decimal128(15, 2)),
+        "price": pa.array(rng.integers(90100, 10494951, n).tolist(),
+                          type=pa.decimal128(15, 2)),
+        "flag": pa.array([("A", "N", "R")[int(v)]
+                          for v in rng.integers(0, 3, n)]),
+        "status": pa.array([("O", "F")[int(v)]
+                            for v in rng.integers(0, 2, n)]),
+        "ship": pa.array(rng.integers(8000, 10500, n).astype("int32"),
+                         type=pa.date32()),
+    })
+    path = os.path.join(str(tmp_path), "lineitem.parquet")
+    pq.write_table(tbl, path, row_group_size=4000)
+    return path
+
+
+Q1_SHAPED_SQL = ("SELECT flag, status, sum(qty) sq, sum(price) sp, "
+                 "count(*) c FROM t WHERE ship <= date '1998-09-02' "
+                 "GROUP BY flag, status ORDER BY flag, status")
+
+
+def _plan_metrics(spark):
+    from spark_rapids_tpu.metrics import registry_snapshot
+    return registry_snapshot(spark.get_captured_plans())["metrics"]
+
+
+def test_q1_shaped_bit_identical_across_decode_and_pipeline(tmp_path):
+    # the acceptance sweep: device decode on/off x pipeline depth
+    # 0 (sync) / 1 (prefetch only) / 3 (upload-ahead) all bit-identical
+    path = _write_q1_shaped(tmp_path)
+    want, _ = _collect(path, False, sql=Q1_SHAPED_SQL)
+    for depth in ("0", "1", "3"):
+        got, m = _collect(path, True, {MAXIF_CONF: depth},
+                          sql=Q1_SHAPED_SQL)
+        assert got == want, depth
+        assert m.get("deviceDecodedBatches", 0) >= 1, (depth, m)
+        assert m.get("deviceFallbackColumns", 0) == 0, (depth, m)
+
+
+def test_pipelined_scan_metrics_and_spans(tmp_path):
+    # default depth: uploads are issued ahead, the producer thread's
+    # prefetch wall is interval-union (never exceeds the query wall)
+    path = _write_q1_shaped(tmp_path)
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    spark = TpuSparkSession({"spark.rapids.sql.enabled": "true",
+                             DEV_CONF: "true"})
+    try:
+        import time
+        spark.read.parquet(path).createOrReplaceTempView("t")
+        df = spark.sql(Q1_SHAPED_SQL)
+        spark.start_capture()
+        t0 = time.perf_counter_ns()
+        df._execute()
+        wall = time.perf_counter_ns() - t0
+        m = _plan_metrics(spark)
+        assert m.get("uploadAheadBatches", 0) >= 1, m
+        assert m.get("scanPrefetchTime", 0) > 0, m
+        # the timed_wall audit: prefetch threads must not re-introduce
+        # the PR 1 decodeTime > wall over-count
+        assert m["scanPrefetchTime"] <= wall, (m["scanPrefetchTime"],
+                                               wall)
+        assert m.get("deviceDecodeTime", 0) <= wall, m
+    finally:
+        spark.stop()
+
+
+@pytest.mark.fault
+def test_pipelined_scan_injected_io_error_cancels_cleanly(tmp_path):
+    # an IO error that exhausts reader retries must surface as the
+    # query error (not hang the ring), and the next query on a clean
+    # injector must succeed — prefetch state drained
+    from spark_rapids_tpu import retry as R
+    path = _write_q1_shaped(tmp_path)
+    R.reset_fault_injection()
+    try:
+        with pytest.raises(Exception) as ei:
+            _collect(path, True,
+                     {"spark.rapids.sql.test.injectIOError": "1:99",
+                      "spark.rapids.sql.reader.maxRetries": "1"},
+                     sql=Q1_SHAPED_SQL)
+        assert "injected IO error" in str(ei.value)
+    finally:
+        R.reset_fault_injection()
+    want, _ = _collect(path, False, sql=Q1_SHAPED_SQL)
+    got, _ = _collect(path, True, sql=Q1_SHAPED_SQL)
+    assert got == want
+
+
+@pytest.mark.fault
+def test_oom_during_prefetched_upload_shrinks_ring(tmp_path):
+    # site:upload:N targets exactly the prefetched raw-chunk uploads:
+    # the in-flight ring must SHRINK (drain + synchronous retry), not
+    # deadlock, and results stay bit-identical
+    from spark_rapids_tpu import retry as R
+    path = _write_q1_shaped(tmp_path)
+    want, _ = _collect(path, False, sql=Q1_SHAPED_SQL)
+    R.reset_fault_injection()
+    try:
+        got, m = _collect(
+            path, True,
+            {"spark.rapids.sql.test.injectOOM": "site:upload:2"},
+            sql=Q1_SHAPED_SQL)
+    finally:
+        R.reset_fault_injection()
+    assert got == want
+    assert m.get("prefetchRingShrinks", 0) >= 1, m
+
+
+def test_site_scoped_injection_grammar():
+    from spark_rapids_tpu.retry import FaultInjector, TpuRetryOOM
+    inj = FaultInjector(oom_spec="site:upload:2")
+    inj.on_alloc()          # untagged: never counts
+    inj.on_alloc("other")   # other site: never counts
+    inj.on_alloc("upload")  # 1st upload event
+    with pytest.raises(TpuRetryOOM):
+        inj.on_alloc("upload")  # 2nd fires
+    assert inj.oom_injected == 1
+    assert FaultInjector(oom_spec="site:upload:split:3")._oom.split
 
 
 # -- kernel unit tests (ops/rle.py against numpy oracles) ------------------
@@ -387,3 +698,117 @@ def test_dense_ranks_kernel():
     v = np.array([True, False, True, True, False, True])
     got = np.asarray(R.dense_ranks(jnp.asarray(v)))
     assert got.tolist() == [0, 0, 1, 2, 2, 3]
+
+
+def _bytes_arr(payload: bytes):
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.ops import rle as R
+    words = np.zeros((len(payload) + 3) // 4 * 4, dtype=np.uint8)
+    words[:len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+    return R.bytes_of_words(jnp.asarray(words.view(np.int32)))
+
+
+def test_read_packed64_wide_widths():
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.ops import rle as R
+    rng = np.random.default_rng(21)
+    for width in (33, 47, 63, 64):
+        vals = [int(v) for v in
+                rng.integers(0, 1 << 62, 40)] if width < 64 else \
+            [int(v) for v in rng.integers(-(1 << 62), 1 << 62, 40)]
+        vals = [v & ((1 << width) - 1) for v in vals]
+        bits = 0
+        for k, v in enumerate(vals):
+            bits |= v << (k * width)
+        payload = bits.to_bytes((len(vals) * width + 7) // 8 + 8,
+                                "little")
+        ba = _bytes_arr(payload)
+        off = jnp.asarray(np.arange(len(vals), dtype=np.int64) * width)
+        w = jnp.full(len(vals), width, dtype=jnp.int64)
+        got = np.asarray(R.read_packed64(ba, off, w)).astype(np.uint64)
+        want = np.array(vals, dtype=np.uint64)
+        assert np.array_equal(got, want), f"width={width}"
+
+
+def test_delta_host_decoder_matches_pyarrow(tmp_path):
+    # the host DELTA decoder (used for DELTA_LENGTH lengths) against
+    # pyarrow's own decode of a DELTA_BINARY_PACKED file
+    import pyarrow.parquet as _pq
+
+    from spark_rapids_tpu.io.device_decode import (_delta_decode_host,
+                                                   parse_page_header)
+    rng = np.random.default_rng(22)
+    n = 5000
+    vals = rng.integers(-(1 << 45), 1 << 45, n)
+    tbl = pa.table({"v": pa.array(vals, type=pa.int64())})
+    path = os.path.join(str(tmp_path), "d.parquet")
+    _pq.write_table(tbl, path, use_dictionary=False,
+                    column_encoding="DELTA_BINARY_PACKED",
+                    compression="NONE")
+    meta = _pq.ParquetFile(path).metadata.row_group(0).column(0)
+    with open(path, "rb") as f:
+        f.seek(meta.data_page_offset)
+        raw = f.read(meta.total_compressed_size)
+    decoded = []
+    pos = 0
+    while pos < len(raw) and len(decoded) < n:
+        hdr, body_off = parse_page_header(raw, pos)
+        csize = hdr.get(3, 0)
+        body = raw[body_off:body_off + csize]
+        pos = body_off + csize
+        if hdr.get(1) != 0:
+            continue
+        # optional column: skip the length-prefixed def-level section
+        dl_len = int.from_bytes(body[0:4], "little")
+        val_off = 4 + dl_len
+        got, _end = _delta_decode_host(body, val_off, len(body))
+        decoded.extend(got.tolist())
+    assert decoded == vals.tolist()
+
+
+def test_plain_str_lengths_oracle():
+    from spark_rapids_tpu.io.device_decode import _plain_str_lengths
+    rng = np.random.default_rng(23)
+    vals = [b"x" * int(rng.integers(0, 37)) for _ in range(500)]
+    body = b"".join(len(v).to_bytes(4, "little") + v for v in vals)
+    lens = _plain_str_lengths(body, 0, len(body), len(vals))
+    assert lens.tolist() == [len(v) for v in vals]
+
+
+def test_gather_chars_and_seg_cumsum_kernels():
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.ops import rle as R
+    data = b"heyworldabc!"
+    ba = _bytes_arr(data)
+    starts = jnp.asarray(np.array([0, 3, 8], dtype=np.int64))
+    lens = jnp.asarray(np.array([3, 5, 4], dtype=np.int32))
+    out = np.asarray(R.gather_chars(ba, starts, lens, 8))
+    assert bytes(out[0][:3]) == b"hey" and out[0][3:].tolist() == [0] * 5
+    assert bytes(out[1][:5]) == b"world"
+    assert bytes(out[2][:4]) == b"abc!"
+    # segmented exclusive cumsum: two segments starting at lanes 0, 3
+    contrib = jnp.asarray(np.array([2, 3, 4, 10, 20, 30],
+                                   dtype=np.int64))
+    seg = jnp.asarray(np.array([0, 0, 0, 3, 3, 3], dtype=np.int64))
+    got = np.asarray(R.seg_excl_cumsum(contrib, seg))
+    assert got.tolist() == [0, 2, 5, 0, 10, 30]
+
+
+def test_read_bss_kernel():
+    from spark_rapids_tpu.ops import rle as R
+    import jax.numpy as jnp
+    rng = np.random.default_rng(24)
+    vals = rng.integers(-(1 << 60), 1 << 60, 17)
+    raw = vals.astype("<i8").tobytes()
+    # split the byte planes the BYTE_STREAM_SPLIT way
+    planes = b"".join(raw[j::8] for j in range(8))
+    ba = _bytes_arr(planes)
+    n = len(vals)
+    base = jnp.zeros(n, dtype=jnp.int64)
+    stride = jnp.full(n, n, dtype=jnp.int64)
+    local = jnp.asarray(np.arange(n, dtype=np.int64))
+    got = np.asarray(R.read_bss(ba, base, stride, local, 8))
+    assert got.tolist() == vals.tolist()

@@ -155,13 +155,16 @@ def test_trace_file_wellformed_with_expected_kinds(tmp_path):
     names = _check_wellformed(doc)
     meta = doc["otherData"]
     assert meta["queryId"] == 1 and meta["outputRows"] > 0
-    # every stage of a batch's life is represented: reader decode,
-    # host pack, upload (chip-attributed), device dispatch, exchange,
-    # JIT compile, semaphore wait
-    for expected in ("FileScan.decodeTime",
+    # every stage of a batch's life is represented: reader decode plan
+    # (device decode is the default scan path), producer-thread
+    # prefetch, host pack, upload-ahead + decode-program completion
+    # (chip-attributed), device dispatch, exchange, JIT compile,
+    # semaphore wait
+    for expected in ("FileScan.deviceDecodeTime",
+                     "scanPrefetch",
+                     "uploadAhead",
                      "TpuRowToColumnarExec.packBatchTime",
                      "TpuRowToColumnarExec.copyToDeviceTime",
-                     "finishUpload",
                      "TpuHashAggregateExec.dispatch",
                      "exchangeMaterialize",
                      "compile",
@@ -170,6 +173,33 @@ def test_trace_file_wellformed_with_expected_kinds(tmp_path):
     # the loader round-trips the same stream
     tr = TR.load_trace(files[0])
     assert len(tr["spans"]) == meta["spanCount"]
+
+
+def test_scan_pipeline_trace_and_critical_path(tmp_path):
+    """The ISSUE 9 acceptance probe at test scale: a traced parquet
+    aggregation's Chrome stream stays well-formed with the pipeline
+    spans present, and the critical path contains no host
+    FileScan.decodeTime (the scan is off the critical path — decode
+    rides the device program / prefetch threads)."""
+    from spark_rapids_tpu.tools import analyze_trace
+    data = _write_parquet(tmp_path)
+    tdir = tmp_path / "traces"
+    spark = TpuSparkSession(_conf(tdir))
+    try:
+        df = (spark.read.parquet(data).filter(F.col("v") % 3 != 0)
+              .groupBy("k").agg(F.sum("v").alias("sv"))
+              .orderBy("k"))
+        df._execute()
+    finally:
+        spark.stop()
+    files = _trace_files(tdir)
+    assert files
+    with open(files[-1]) as f:
+        _check_wellformed(json.load(f))
+    analysis = analyze_trace(files[-1])
+    cp = analysis.get("criticalPath_s", {})
+    assert cp, analysis
+    assert "FileScan.decodeTime" not in cp, cp
 
 
 # ---------------------------------------------------------------------------
