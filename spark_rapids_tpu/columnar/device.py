@@ -334,6 +334,11 @@ class DeviceBatch:
         # staging buffer + ONE device_put; a single jitted program
         # decodes to full-width padded columns in HBM (transfer.py)
         from spark_rapids_tpu.columnar.transfer import upload_batch
+        # NOT retried here: the DeviceStore promote path (memory.py
+        # _access) calls this while HOLDING the store lock — a spill +
+        # backoff sleep inside it would stall every task in the
+        # process. OOM propagates to the caller's own retry scope.
+        # tpu-lint: disable=retry-coverage(runs under DeviceStore._lock on the promote path; spilling/sleeping there blocks the whole store — callers own the retry)
         return upload_batch(batch, cap, device)
 
     def to_host(self) -> HostBatch:
@@ -500,7 +505,8 @@ def _np_col_to_host(dt: T.DataType, arrs: List[np.ndarray],
 
 def _put(arr: np.ndarray, device: Optional[jax.Device]) -> jax.Array:
     if device is not None:
-        return jax.device_put(arr, device)
+        from spark_rapids_tpu import retry as R
+        return R.with_retry(lambda: jax.device_put(arr, device))
     return jnp.asarray(arr)
 
 
@@ -520,8 +526,10 @@ def batch_device(b: DeviceBatch) -> Optional[jax.Device]:
 def batch_to_device(b: DeviceBatch, device: jax.Device) -> DeviceBatch:
     """Copy a batch's buffers to ``device`` (device-to-device; a cheap
     no-op when already resident there)."""
+    from spark_rapids_tpu import retry as R
     flat, spec = flatten_batch(b)
-    moved = jax.device_put(flat + [b.active], device)
+    moved = R.with_retry(lambda: jax.device_put(flat + [b.active],
+                                                device))
     return DeviceBatch(b.schema, rebuild_columns(spec, moved[:-1]),
                        moved[-1], b._num_rows)
 

@@ -37,6 +37,7 @@ def f64_arith_exact() -> bool:
         return x + y, x * y, x / y, jnp.sum(x)
 
     try:
+        # tpu-lint: disable=jit-direct(one-shot lru_cached capability probe, never re-compiled)
         add, mul, div, s = jax.jit(probe)(a, b)
     except Exception:
         return False
@@ -60,6 +61,7 @@ def float_div_exact() -> bool:
         return x / y, jnp.sqrt(x)
 
     try:
+        # tpu-lint: disable=jit-direct(one-shot lru_cached capability probe, never re-compiled)
         div, sq = jax.jit(probe)(a32, b32)
     except Exception:
         return False
@@ -81,6 +83,7 @@ def f64_bitcast_exact() -> bool:
                      0xC000000000000000, 0x7FF0000000000000, 0],
                     dtype=np.int64)
     try:
+        # tpu-lint: disable=jit-direct(one-shot lru_cached capability probe, never re-compiled)
         out = jax.jit(lambda x: jax.lax.bitcast_convert_type(
             x, jnp.float64))(bits)
         return np.array_equal(np.asarray(out),

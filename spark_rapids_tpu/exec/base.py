@@ -316,6 +316,7 @@ class TpuRowToColumnarExec(TpuExec):
                                    else None), rows=num_rows):
                 if inj is not None:
                     inj.on_alloc("upload")
+                # tpu-lint: disable=retry-coverage(deliberately unretried: OOM returns None and the caller shrinks the upload-ahead ring, docs/scan.md)
                 tok = start_upload(staged, device)
             metrics.create("uploadAheadBatches").add(1)
             return (num_rows, tok, src, device)

@@ -12,7 +12,7 @@ start + ordinal. Output capacity is static: the element pool's capacity
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Iterator, List, Tuple
+from typing import Iterator, List
 
 import jax
 import jax.numpy as jnp
@@ -31,7 +31,12 @@ from spark_rapids_tpu.sql import expressions as E
 from spark_rapids_tpu.sql import physical as P
 from spark_rapids_tpu.sql import types as T
 
-_GEN_CACHE: Dict[Tuple, Callable] = {}
+# bounded LRU like every other structural jit cache (jit_cache.py);
+# the raw module dict it replaces grew one pinned XLA executable per
+# distinct (shape-set, flags) forever
+from spark_rapids_tpu.jit_cache import JitCache, mirror_to_metrics
+
+_GEN_CACHE = JitCache("generate")
 
 
 def is_device_generate(gen: E.Expression, conf: TpuConf):
@@ -84,11 +89,10 @@ class TpuGenerateExec(TpuExec):
             shapes = tuple((a.shape, str(a.dtype)) for a in flat)
             key = (shapes, tuple(repr(dt) for dt, _ in spec), ordinal,
                    position, outer)
-            fn = _GEN_CACHE.get(key)
-            if fn is None:
-                fn = jax.jit(self._build_fn(spec, ordinal, position,
-                                            outer))
-                _GEN_CACHE[key] = fn
+            fn, was_miss = _GEN_CACHE.get_or_build(
+                key, lambda: jax.jit(self._build_fn(
+                    spec, ordinal, position, outer)))
+            mirror_to_metrics(_GEN_CACHE, metrics, was_miss)
             active_out, outs = fn(b.active, *flat)
             from spark_rapids_tpu.columnar.device import is_string_like
             out_spec = list(spec)

@@ -174,7 +174,10 @@ class TpuArrowEvalPythonExec(TpuExec):
                         [T.StructField(u.name, u.data_type, True)
                          for u in self.udfs]), cols, hb.num_rows)
                     with self.metrics.timed(M.COPY_TO_DEVICE_TIME):
-                        up = upload_batch(res, b.capacity)
+                        from spark_rapids_tpu import retry as R
+                        up = R.with_retry(
+                            lambda: upload_batch(res, b.capacity),
+                            self.conf, self.metrics)
                     yield DeviceBatch(schema,
                                       list(b.columns) + list(up.columns),
                                       b.active, hb.num_rows)

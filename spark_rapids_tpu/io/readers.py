@@ -146,6 +146,7 @@ class ScanUnit:
 # collect()) doesn't re-read every parquet footer — the reference caches
 # its file index per relation. Bounded LRU so sessions reading many
 # distinct/growing datasets don't accumulate stale listings.
+# tpu-lint: disable=jit-module-cache(holds unit-assignment tuples, not compiled programs; hand-bounded at _UNITS_CACHE_MAX below)
 _UNITS_CACHE: "OrderedDict[tuple, tuple]" = OrderedDict()
 _UNITS_CACHE_MAX = 64
 

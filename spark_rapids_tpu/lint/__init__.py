@@ -1,0 +1,41 @@
+"""tpu-lint: AST-based invariant checker for spark_rapids_tpu.
+
+Machine-enforces the correctness invariants the last six PRs fixed by
+hand (docs/linting.md):
+
+* ``retry-coverage``   — device allocation/dispatch sites run under the
+  PR-4 ``with_retry`` protocol (docs/robustness.md wrapped-site table).
+* ``jit-direct`` / ``jit-module-cache`` — all compiles go through the
+  bounded single-flight ``JitCache``; no raw ``jax.jit`` or module dict
+  caches of compiled programs.
+* ``lock-order`` / ``lock-blocking-call`` / ``check-then-act`` — the
+  concurrency races PR 7's review pass fixed by hand, checked on the
+  lock-acquisition graph of memory/resource/serve/jit_cache.
+* ``metric-key`` / ``conf-key`` / ``span-scope`` / ``docs-drift`` — the
+  static promotion of the former runtime drift lints: metric keys
+  resolve in ``describe_metric``, ``spark.rapids.*`` literals are
+  registered confs, spans are with-scoped, generated docs are fresh.
+
+CLI: ``python -m spark_rapids_tpu.tools lint`` (exit 0 clean /
+1 findings / 2 internal error). Per-line suppressions must carry a
+reason: ``# tpu-lint: disable=rule-name(reason)``.
+
+The package is stdlib-only (``ast`` + ``tokenize``); only the
+``docs-drift`` rule imports the runtime doc generators, and only when
+enabled.
+"""
+
+from spark_rapids_tpu.lint.config import LintConfig, load_config
+from spark_rapids_tpu.lint.engine import (Finding, LintResult,
+                                          default_root, render_human,
+                                          render_json, run_cli, run_lint)
+
+# rule modules self-register on import
+from spark_rapids_tpu.lint import rules_retry  # noqa: F401,E402
+from spark_rapids_tpu.lint import rules_jit  # noqa: F401,E402
+from spark_rapids_tpu.lint import rules_concurrency  # noqa: F401,E402
+from spark_rapids_tpu.lint import rules_drift  # noqa: F401,E402
+
+__all__ = ["LintConfig", "load_config", "Finding", "LintResult",
+           "run_lint", "run_cli", "render_human", "render_json",
+           "default_root"]

@@ -1,0 +1,117 @@
+"""tpu-lint configuration.
+
+Defaults below describe the real repo (scopes, allowlisted donating
+sites, critical locks). A ``tpu-lint.json`` at the repo root can merge
+overrides for the file-based knobs (no runtime conf keys — lint config
+is deliberately outside the spark.rapids.* registry)::
+
+    {
+      "check_docs": false,
+      "retry_allowlist": {"pkg/mod.py::fn": "why this site is exempt"},
+      "baseline": "tpu-lint-baseline.json"
+    }
+
+Every allowlist entry maps ``<repo-relative-path>::<qualname>`` to a
+written reason, mirroring the suppression grammar's
+reason-is-mandatory rule.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, Tuple
+
+CONFIG_FILENAME = "tpu-lint.json"
+
+
+@dataclasses.dataclass
+class LintConfig:
+    # directories (relative to the lint root) scanned for *.py
+    scan_roots: Tuple[str, ...] = ("spark_rapids_tpu",)
+
+    # -- retry-coverage ----------------------------------------------------
+    # files whose allocation/dispatch sites must sit inside the PR-4
+    # retry protocol (docs/robustness.md wrapped-site table)
+    retry_scope: Tuple[str, ...] = (
+        "spark_rapids_tpu/exec/",
+        "spark_rapids_tpu/parallel/",
+        "spark_rapids_tpu/columnar/transfer.py",
+        "spark_rapids_tpu/columnar/device.py",
+    )
+    retry_wrappers: Tuple[str, ...] = (
+        "with_retry", "with_split_retry", "io_with_retry")
+    # device allocation / dispatch entry points the rule tracks
+    alloc_entrypoints: Tuple[str, ...] = (
+        "device_put", "finish_upload", "start_upload", "finish_started",
+        "upload_batch", "stack_batches")
+    # "<rel>::<qualname>" -> reason. These are the protocol's own
+    # implementation layer: the wrapped-site table wraps their CALLERS,
+    # so the raw calls inside them are the single sanctioned copies.
+    retry_allowlist: Dict[str, str] = dataclasses.field(
+        default_factory=lambda: {
+            "spark_rapids_tpu/columnar/transfer.py::finish_upload":
+                "upload protocol implementation — every invoking site "
+                "wraps it in with_retry (docs/robustness.md "
+                "wrapped-site table)",
+            "spark_rapids_tpu/columnar/transfer.py::start_upload":
+                "async upload-ahead half: the ring owner handles OOM by "
+                "shrinking the ring, then retries via _finish "
+                "(docs/scan.md)",
+            "spark_rapids_tpu/columnar/transfer.py::upload_batch":
+                "composition of the wrapped halves; call sites run it "
+                "under with_retry/with_split_retry",
+            "spark_rapids_tpu/parallel/ici.py::mesh_exchange":
+                "runs under the exchange materializer's with_retry "
+                "(exec/exchange.py mesh path, docs/robustness.md)",
+        })
+
+    # -- jit discipline ----------------------------------------------------
+    jit_home: str = "spark_rapids_tpu/jit_cache.py"
+
+    # -- concurrency -------------------------------------------------------
+    concurrency_scope: Tuple[str, ...] = (
+        "spark_rapids_tpu/memory.py",
+        "spark_rapids_tpu/resource.py",
+        "spark_rapids_tpu/jit_cache.py",
+        "spark_rapids_tpu/serve/",
+    )
+    # holding one of these, a blocking call is a stall for every task /
+    # query in the process (DeviceStore + scheduler/semaphore locks)
+    critical_locks: Tuple[str, ...] = (
+        "DeviceStore._lock", "TpuSemaphore._cv",
+        "AdmissionController._cv", "JitCache._lock")
+
+    # -- drift -------------------------------------------------------------
+    metrics_rel: str = "spark_rapids_tpu/metrics.py"
+    trace_rel: str = "spark_rapids_tpu/trace.py"
+    # generated docs compared against `tools docs` regeneration
+    check_docs: bool = True
+
+    # -- engine ------------------------------------------------------------
+    baseline: str = "tpu-lint-baseline.json"
+
+
+def load_config(root: str) -> LintConfig:
+    """Defaults, merged with an optional ``tpu-lint.json`` at root."""
+    cfg = LintConfig()
+    path = os.path.join(root, CONFIG_FILENAME)
+    if not os.path.exists(path):
+        return cfg
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    for key in ("check_docs", "baseline", "jit_home", "metrics_rel",
+                "trace_rel"):
+        if key in data:
+            setattr(cfg, key, data[key])
+    for key in ("scan_roots", "retry_scope", "retry_wrappers",
+                "alloc_entrypoints", "concurrency_scope",
+                "critical_locks"):
+        if key in data:
+            setattr(cfg, key, tuple(data[key]))
+    if "retry_allowlist" in data:
+        merged = dict(cfg.retry_allowlist)
+        merged.update(data["retry_allowlist"])
+        cfg.retry_allowlist = merged
+    return cfg

@@ -1,0 +1,276 @@
+"""tpu-lint rule engine: file collection, rule registry, suppression
+and baseline semantics, JSON/human rendering, CLI entry.
+
+Exit-code contract (wired into `tools lint` and tier-1):
+  0 — clean (no unsuppressed, unbaselined findings)
+  1 — findings
+  2 — internal error (a rule crashed, or the engine itself did)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import traceback
+from typing import Callable, Dict, Iterable, List, Optional
+
+from spark_rapids_tpu.lint.astutil import FileCtx
+from spark_rapids_tpu.lint.config import LintConfig, load_config
+
+JSON_SCHEMA_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+
+    def fingerprint(self, line_text: str) -> str:
+        # line-TEXT based (not line-number based) so unrelated edits
+        # above a baselined finding don't churn the baseline file
+        h = hashlib.sha256(
+            f"{self.rule}|{self.path}|{line_text or self.message}"
+            .encode("utf-8"))
+        return h.hexdigest()[:16]
+
+
+@dataclasses.dataclass
+class Rule:
+    name: str
+    doc: str
+    func: Callable
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(name: str, doc: str):
+    """Register a rule. The function receives the PackageContext and
+    yields Findings."""
+    def deco(func):
+        RULES[name] = Rule(name, doc, func)
+        return func
+    return deco
+
+
+class PackageContext:
+    """Everything a rule needs: every scanned file parsed once, plus
+    the config and root."""
+
+    def __init__(self, root: str, config: LintConfig,
+                 files: List[FileCtx]):
+        self.root = root
+        self.config = config
+        self.files = files
+        self.by_rel: Dict[str, FileCtx] = {f.rel: f for f in files}
+
+    def file(self, rel: str) -> Optional[FileCtx]:
+        return self.by_rel.get(rel)
+
+    def in_scope(self, rel: str, scope: Iterable[str]) -> bool:
+        return any(rel == s or (s.endswith("/") and rel.startswith(s))
+                   for s in scope)
+
+
+@dataclasses.dataclass
+class LintResult:
+    root: str
+    findings: List[Finding]            # active (reported)
+    suppressed: int
+    baselined: int
+    files: int
+    internal_errors: List[str]
+    pctx: Optional["PackageContext"] = None
+    # findings matched by the baseline file (not reported, but
+    # --fix-baseline must re-capture them or accepted debt would be
+    # silently dropped from the rewritten file)
+    baselined_findings: List[Finding] = dataclasses.field(
+        default_factory=list)
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings and not self.internal_errors
+
+
+def default_root() -> str:
+    """Repo root = parent of the installed package directory."""
+    import spark_rapids_tpu
+    return os.path.dirname(
+        os.path.dirname(os.path.abspath(spark_rapids_tpu.__file__)))
+
+
+def collect_files(root: str, config: LintConfig) -> List[FileCtx]:
+    out: List[FileCtx] = []
+    for scan in config.scan_roots:
+        base = os.path.join(root, scan)
+        if os.path.isfile(base):
+            out.append(FileCtx(root, scan))
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__")
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    rel = os.path.relpath(os.path.join(dirpath, fn),
+                                          root)
+                    out.append(FileCtx(root, rel))
+    return out
+
+
+def _load_baseline(root: str, config: LintConfig) -> Dict[str, dict]:
+    path = os.path.join(root, config.baseline)
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return {e["fingerprint"]: e for e in data.get("findings", [])}
+
+
+def write_baseline(root: str, config: LintConfig,
+                   findings: List[Finding], pctx: PackageContext) -> str:
+    """--fix-baseline: capture current findings as accepted debt."""
+    path = os.path.join(root, config.baseline)
+    entries = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        entries.append({
+            "fingerprint": f.fingerprint(_line_text(pctx, f)),
+            "rule": f.rule, "path": f.path, "line_hint": f.line,
+            "message": f.message,
+        })
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": JSON_SCHEMA_VERSION, "findings": entries},
+                  fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+def _line_text(pctx: PackageContext, f: Finding) -> str:
+    fctx = pctx.file(f.path)
+    return fctx.line_text(f.line) if fctx is not None else ""
+
+
+def run_lint(root: Optional[str] = None,
+             config: Optional[LintConfig] = None) -> LintResult:
+    root = root or default_root()
+    config = config or load_config(root)
+    files = collect_files(root, config)
+    pctx = PackageContext(root, config, files)
+
+    raw: List[Finding] = []
+    internal: List[str] = []
+    for r in RULES.values():
+        try:
+            raw.extend(r.func(pctx))
+        except Exception:
+            internal.append(
+                f"rule {r.name} crashed:\n{traceback.format_exc()}")
+    # suppressions without a reason are findings themselves and are
+    # not suppressible (otherwise the grammar could erase its own gate)
+    for fctx in files:
+        for line, msg in fctx.bad_suppressions:
+            raw.append(Finding("bad-suppression", fctx.rel, line, 1,
+                               msg))
+
+    suppressed = 0
+    unsuppressed: List[Finding] = []
+    for f in raw:
+        fctx = pctx.file(f.path)
+        if f.rule != "bad-suppression" and fctx is not None \
+                and fctx.suppressed(f.rule, f.line):
+            suppressed += 1
+        else:
+            unsuppressed.append(f)
+
+    baseline = _load_baseline(root, config)
+    baselined: List[Finding] = []
+    active: List[Finding] = []
+    for f in unsuppressed:
+        if f.fingerprint(_line_text(pctx, f)) in baseline:
+            baselined.append(f)
+        else:
+            active.append(f)
+    active.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return LintResult(root=root, findings=active, suppressed=suppressed,
+                      baselined=len(baselined), files=len(files),
+                      internal_errors=internal, pctx=pctx,
+                      baselined_findings=baselined)
+
+
+# -- rendering -------------------------------------------------------------
+
+def render_json(result: LintResult,
+                pctx: Optional[PackageContext] = None) -> str:
+    findings = []
+    for f in result.findings:
+        findings.append({
+            "rule": f.rule, "path": f.path, "line": f.line,
+            "col": f.col, "message": f.message,
+            "fingerprint": f.fingerprint(
+                _line_text(pctx, f) if pctx is not None else ""),
+        })
+    return json.dumps({
+        "version": JSON_SCHEMA_VERSION,
+        "root": result.root,
+        "clean": result.clean,
+        "counts": {
+            "findings": len(result.findings),
+            "suppressed": result.suppressed,
+            "baselined": result.baselined,
+            "files": result.files,
+        },
+        "rules": sorted(RULES),
+        "findings": findings,
+        "internalErrors": result.internal_errors,
+    }, indent=2)
+
+
+def render_human(result: LintResult) -> str:
+    lines: List[str] = []
+    for f in result.findings:
+        lines.append(f"{f.path}:{f.line}:{f.col}: [{f.rule}] "
+                     f"{f.message}")
+    lines.append(
+        f"tpu-lint: {len(result.findings)} finding(s), "
+        f"{result.suppressed} suppressed, {result.baselined} baselined "
+        f"across {result.files} files "
+        f"({len(RULES)} rules)")
+    return "\n".join(lines)
+
+
+def run_cli(root: Optional[str] = None, as_json: bool = False,
+            fix_baseline: bool = False) -> int:
+    """`tools lint` body. Exit contract: 0 clean / 1 findings /
+    2 internal error."""
+    try:
+        root = root or default_root()
+        config = load_config(root)
+        result = run_lint(root, config)
+        if result.files == 0:
+            # a wrong --root (or a renamed scan root) must not turn
+            # the CI gate green by linting nothing
+            print(f"tpu-lint: no files found under {root} "
+                  f"(scan roots: {', '.join(config.scan_roots)})")
+            return 2
+        if result.internal_errors:
+            for e in result.internal_errors:
+                print(e)
+            return 2
+        if fix_baseline:
+            # active findings PLUS still-live accepted debt: rewriting
+            # with only the new findings would un-accept the old ones
+            keep = result.findings + result.baselined_findings
+            path = write_baseline(root, config, keep, result.pctx)
+            print(f"tpu-lint: baselined {len(keep)} "
+                  f"finding(s) into {path}")
+            return 0
+        print(render_json(result, result.pctx) if as_json
+              else render_human(result))
+        return 0 if result.clean else 1
+    except Exception:
+        traceback.print_exc()
+        return 2

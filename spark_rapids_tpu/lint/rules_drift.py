@@ -1,0 +1,257 @@
+"""Rule family 4 — drift unification (static promotion of the PR 5/6
+runtime lints; docs/observability.md).
+
+``metric-key``  — every literal (or metrics-constant) key passed to
+                  ``create`` / ``timed`` / ``timed_wall`` must resolve
+                  via ``describe_metric`` (exact entry or registered
+                  prefix family), and every metric-name constant in
+                  metrics.py must be described. Dynamic f-string keys
+                  are invisible to the AST — the one remaining runtime
+                  smoke in tests/test_profile.py guards those.
+``conf-key``    — every whole-string ``spark.rapids.*`` literal in the
+                  package must be a registered conf.py key (prefix
+                  literals ending in '.' are exempt — they are
+                  namespace matches, not keys).
+``span-scope``  — every ``trace.span(...)`` open must be the context
+                  expression of a ``with`` (an unclosed span corrupts
+                  the B/E nesting of the whole lane).
+``docs-drift``  — docs/configs.md, docs/supported_ops.md and
+                  docs/observability.md must match `tools docs`
+                  regeneration byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from spark_rapids_tpu.lint import astutil as A
+from spark_rapids_tpu.lint.engine import Finding, rule
+
+_METRIC_SINKS = {"create", "timed", "timed_wall"}
+_CONF_KEY_RE = re.compile(r"^spark\.rapids\.[A-Za-z0-9_.]*[A-Za-z0-9_]$")
+
+
+# -- metrics table (parsed from metrics.py, no import) ---------------------
+
+def _module_str_constants(fctx: A.FileCtx) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for stmt in fctx.tree.body:
+        if isinstance(stmt, ast.Assign) and isinstance(
+                stmt.value, ast.Constant) and isinstance(
+                stmt.value.value, str):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = stmt.value.value
+    return out
+
+
+def _dict_keys(fctx: A.FileCtx, name: str,
+               consts: Dict[str, str]) -> Optional[Set[str]]:
+    for stmt in fctx.tree.body:
+        if isinstance(stmt, ast.Assign) or isinstance(stmt,
+                                                      ast.AnnAssign):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            if not any(isinstance(t, ast.Name) and t.id == name
+                       for t in targets):
+                continue
+            value = stmt.value
+            if not isinstance(value, ast.Dict):
+                return None
+            keys: Set[str] = set()
+            for k in value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value,
+                                                              str):
+                    keys.add(k.value)
+                elif isinstance(k, ast.Name) and k.id in consts:
+                    keys.add(consts[k.id])
+            return keys
+    return None
+
+
+class _MetricTable:
+    def __init__(self, pctx):
+        cfg = pctx.config
+        fctx = pctx.file(cfg.metrics_rel)
+        self.ok = fctx is not None
+        if not self.ok:
+            return
+        self.consts = _module_str_constants(fctx)
+        self.exact = _dict_keys(fctx, "METRIC_DESCRIPTIONS",
+                                self.consts) or set()
+        self.prefixes = _dict_keys(fctx, "METRIC_PREFIX_DESCRIPTIONS",
+                                   self.consts) or set()
+        self.metrics_rel = cfg.metrics_rel
+        self.metrics_mod = os.path.splitext(
+            cfg.metrics_rel.replace("/", "."))[0]
+
+    def describes(self, key: str) -> bool:
+        return key in self.exact or any(key.startswith(p)
+                                        for p in self.prefixes)
+
+    def resolve_arg(self, fctx: A.FileCtx,
+                    arg: ast.AST) -> Optional[str]:
+        """Literal, metrics-module attribute (M.OP_TIME) or imported
+        constant -> the key string; None when dynamic."""
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value
+        if isinstance(arg, ast.Attribute) and isinstance(arg.value,
+                                                         ast.Name):
+            base = fctx.imports.get(arg.value.id, arg.value.id)
+            if base == self.metrics_mod and arg.attr in self.consts:
+                return self.consts[arg.attr]
+        if isinstance(arg, ast.Name):
+            target = fctx.imports.get(arg.id)
+            if target and target.startswith(self.metrics_mod + "."):
+                cname = target[len(self.metrics_mod) + 1:]
+                return self.consts.get(cname)
+        return None
+
+
+@rule("metric-key",
+      "metric keys must resolve via metrics.describe_metric (exact "
+      "entry or prefix family)")
+def check_metric_keys(pctx):
+    table = _MetricTable(pctx)
+    if not table.ok:
+        return
+    mfctx = pctx.file(table.metrics_rel)
+    # direction 1: every metric-name constant in metrics.py described
+    for stmt in mfctx.tree.body:
+        if isinstance(stmt, ast.Assign) and isinstance(
+                stmt.value, ast.Constant) and isinstance(
+                stmt.value.value, str):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name) and t.id.isupper() \
+                        and not t.id.startswith("_") \
+                        and not table.describes(stmt.value.value):
+                    yield Finding(
+                        "metric-key", mfctx.rel, stmt.lineno, 1,
+                        f"metric constant {t.id} = "
+                        f"{stmt.value.value!r} has no entry in "
+                        f"METRIC_DESCRIPTIONS")
+    # direction 2: every statically-resolvable key at a sink call site
+    for fctx in pctx.files:
+        if fctx.rel == table.metrics_rel:
+            continue
+        for call in A.walk_calls(fctx.tree):
+            if A.call_tail(call) not in _METRIC_SINKS or not call.args:
+                continue
+            if not isinstance(call.func, ast.Attribute):
+                continue
+            key = table.resolve_arg(fctx, call.args[0])
+            if key is None or table.describes(key):
+                continue
+            yield Finding(
+                "metric-key", fctx.rel, call.lineno,
+                call.col_offset + 1,
+                f"metric key {key!r} does not resolve via "
+                f"describe_metric — add it to METRIC_DESCRIPTIONS (or "
+                f"a prefix family) in metrics.py")
+
+
+@rule("conf-key",
+      "spark.rapids.* string literals must be registered conf.py keys")
+def check_conf_keys(pctx):
+    registered: Set[str] = set()
+    reg_nodes: Set[int] = set()
+    for fctx in pctx.files:
+        for call in A.walk_calls(fctx.tree):
+            if A.call_tail(call) == "conf" and len(call.args) >= 1 \
+                    and isinstance(call.args[0], ast.Constant) \
+                    and isinstance(call.args[0].value, str) \
+                    and call.args[0].value.startswith("spark.rapids."):
+                registered.add(call.args[0].value)
+                reg_nodes.add(id(call.args[0]))
+    if not registered:
+        return  # no registry in this tree (fixture runs)
+    for fctx in pctx.files:
+        for node in ast.walk(fctx.tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                continue
+            if id(node) in reg_nodes:
+                continue
+            if not _CONF_KEY_RE.match(node.value):
+                continue
+            # skip docstrings and f-string fragments
+            par = A.parent(node)
+            if isinstance(par, ast.Expr) or isinstance(par,
+                                                       ast.JoinedStr):
+                continue
+            if node.value not in registered:
+                yield Finding(
+                    "conf-key", fctx.rel, node.lineno,
+                    node.col_offset + 1,
+                    f"conf key literal {node.value!r} is not a "
+                    f"registered conf.py entry — register it (or fix "
+                    f"the typo); docs/configs.md is generated from "
+                    f"the registry")
+
+
+@rule("span-scope",
+      "Tracer span opens must be with-scoped (unclosed spans corrupt "
+      "the lane's B/E nesting)")
+def check_span_scope(pctx):
+    cfg = pctx.config
+    trace_mod = os.path.splitext(cfg.trace_rel.replace("/", "."))[0]
+    for fctx in pctx.files:
+        if fctx.rel == cfg.trace_rel:
+            continue
+        for call in A.walk_calls(fctx.tree):
+            if A.call_tail(call) != "span":
+                continue
+            if not isinstance(call.func, ast.Attribute):
+                continue
+            base = A.resolve_path(fctx, call.func.value)
+            if base != trace_mod:
+                continue
+            par = A.parent(call)
+            if isinstance(par, ast.withitem):
+                continue
+            yield Finding(
+                "span-scope", fctx.rel, call.lineno,
+                call.col_offset + 1,
+                "trace span opened outside a `with` — every span must "
+                "be with-scoped so its B/E pair always closes")
+
+
+@rule("docs-drift",
+      "generated docs must match `tools docs` regeneration")
+def check_docs_drift(pctx):
+    cfg = pctx.config
+    if not cfg.check_docs:
+        return
+    # the generators come from the INSTALLED package on sys.path; for a
+    # foreign --root tree they would describe the wrong code, so the
+    # rule only runs on the tree the interpreter is actually importing
+    from spark_rapids_tpu.lint.engine import default_root
+    if os.path.realpath(pctx.root) != os.path.realpath(default_root()):
+        return
+    docs_dir = os.path.join(pctx.root, "docs")
+    if not os.path.isdir(docs_dir):
+        return
+    # the one rule that imports the runtime: the generators ARE the
+    # source of truth the docs must match (same order as `tools docs`)
+    import spark_rapids_tpu.profile  # noqa: F401 — registers confs
+    import spark_rapids_tpu.trace  # noqa: F401 — registers confs
+    from spark_rapids_tpu.conf import generate_docs
+    from spark_rapids_tpu.tools import (generate_observability_docs,
+                                        generate_supported_ops)
+    for fname, gen in (("configs.md", generate_docs),
+                       ("supported_ops.md", generate_supported_ops),
+                       ("observability.md",
+                        generate_observability_docs)):
+        path = os.path.join(docs_dir, fname)
+        if not os.path.exists(path):
+            continue
+        with open(path, "r", encoding="utf-8") as f:
+            on_disk = f.read()
+        if on_disk != gen():
+            yield Finding(
+                "docs-drift", f"docs/{fname}", 1, 1,
+                f"docs/{fname} is stale — regenerate with "
+                f"`python -m spark_rapids_tpu.tools docs`")

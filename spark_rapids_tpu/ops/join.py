@@ -45,10 +45,15 @@ from spark_rapids_tpu.sql import types as T
 
 import numpy as np
 
-_COUNT_CACHE: Dict[Tuple, Callable] = {}
-_GATHER_CACHE: Dict[Tuple, Callable] = {}
-_MASK_CACHE: Dict[Tuple, Callable] = {}
+# bounded LRUs (jit_cache.py): long sessions planning many distinct
+# join shapes must not pin unbounded XLA executables
+from spark_rapids_tpu.jit_cache import JitCache
 
+_COUNT_CACHE = JitCache("joinCount")
+_GATHER_CACHE = JitCache("joinGather")
+_MASK_CACHE = JitCache("joinMask")
+
+# tpu-lint: disable=jit-direct(single fixed 3-scalar stack program — one executable, bounded by construction)
 _stack3 = jax.jit(lambda a, b, c: jnp.stack([a, b, c]))
 
 # join types that expand to (left, right) pairs
@@ -322,7 +327,7 @@ def _build_mask_fn(lkeys: Tuple[E.Expression, ...],
     return jax.jit(fn)
 
 
-_MULT_CACHE: Dict[Tuple, Callable] = {}
+_MULT_CACHE = JitCache("joinMult")
 
 
 def build_key_max_multiplicity(right: DeviceBatch,
@@ -341,8 +346,7 @@ def build_key_max_multiplicity(right: DeviceBatch,
     ns = tuple(null_safe) or (False,) * len(rk)
     salt = G.kernel_salt()
     key = (tuple(X.expr_key(e) for e in rk), ns, salt)
-    fn = _MULT_CACHE.get(key)
-    if fn is None:
+    def _build_mult():
         def _fn(cols_r, active_r, lits_r):
             cap_r = active_r.shape[0]
             ctx = X.Ctx(cols_r, cap_r, rk, lits_r)
@@ -355,8 +359,8 @@ def build_key_max_multiplicity(right: DeviceBatch,
                 _key_words(kr, ns), valid, cap_r)
             length = jnp.where(active_s, end - start + 1, 0)
             return jnp.max(length)
-        fn = jax.jit(_fn)
-        _MULT_CACHE[key] = fn
+        return jax.jit(_fn)
+    fn, _ = _MULT_CACHE.get_or_build(key, _build_mult)
     with G.nan_scope(salt[0]):
         out = fn(right.columns, right.active, X.literal_values(list(rk)))
     from spark_rapids_tpu.columnar.device import _prefetch_host
@@ -364,7 +368,8 @@ def build_key_max_multiplicity(right: DeviceBatch,
     return lambda: int(np.asarray(out))
 
 
-_EXTRAS_CACHE: Dict[Tuple, Callable] = {}
+_EXTRAS_CACHE = JitCache("joinExtras")
+# tpu-lint: disable=jit-direct(single fixed boolean-OR program — one executable, bounded by construction)
 _OR = jax.jit(lambda a, b: a | b)
 
 
@@ -387,8 +392,7 @@ def right_extras_batch(right: DeviceBatch, matched_any: jax.Array,
     shapes = tuple((a.shape, str(a.dtype)) for a in flat)
     ldts = tuple(repr(f.data_type) for f in left_fields)
     key = (shapes, ldts)
-    fn = _EXTRAS_CACHE.get(key)
-    if fn is None:
+    def _build_extras():
         ltypes = [f.data_type for f in left_fields]
 
         def build(matched, active_r, *rflat):
@@ -420,8 +424,8 @@ def right_extras_batch(right: DeviceBatch, matched_any: jax.Array,
                     lefts += [jnp.zeros(cap_r,
                                         dtype=storage_jnp_dtype(dt)), fv]
             return tuple(lefts), tuple(outs), keep
-        fn = jax.jit(build)
-        _EXTRAS_CACHE[key] = fn
+        return jax.jit(build)
+    fn, _ = _EXTRAS_CACHE.get_or_build(key, _build_extras)
     lefts, routs, keep = fn(matched_any, right.active, *flat)
     from spark_rapids_tpu.columnar.device import column_arity, make_column
     lcols = []
@@ -459,10 +463,8 @@ def device_join(left: DeviceBatch, right: DeviceBatch,
 
     if join_type in MASK_JOINS:
         key = (struct, join_type)
-        fn = _MASK_CACHE.get(key)
-        if fn is None:
-            fn = _build_mask_fn(lk, rk, join_type, nst)
-            _MASK_CACHE[key] = fn
+        fn, _ = _MASK_CACHE.get_or_build(
+            key, lambda: _build_mask_fn(lk, rk, join_type, nst))
         with G.nan_scope(salt[0]):
             new_active = fn(left.columns, left.active, lits_l,
                             right.columns, right.active, lits_r)
@@ -473,10 +475,8 @@ def device_join(left: DeviceBatch, right: DeviceBatch,
         raise X.DeviceUnsupported(f"join type {join_type}")
 
     ckey = (struct, join_type)
-    count_fn = _COUNT_CACHE.get(ckey)
-    if count_fn is None:
-        count_fn = _build_count_fn(lk, rk, join_type, nst)
-        _COUNT_CACHE[ckey] = count_fn
+    count_fn, _ = _COUNT_CACHE.get_or_build(
+        ckey, lambda: _build_count_fn(lk, rk, join_type, nst))
     with G.nan_scope(salt[0]):
         (total_pairs, n_extra, max_m, m, offsets, base, order_r,
          extra_order, matched_r) = count_fn(
@@ -493,10 +493,8 @@ def device_join(left: DeviceBatch, right: DeviceBatch,
         # rides along (prefetched) so downstream sizing reads resolve
         # without a fresh count program + flat roundtrip.
         fkey = (shapes, join_type, "fast")
-        fast_fn = _GATHER_CACHE.get(fkey)
-        if fast_fn is None:
-            fast_fn = _build_fast_gather_fn(join_type)
-            _GATHER_CACHE[fkey] = fast_fn
+        fast_fn, _ = _GATHER_CACHE.get_or_build(
+            fkey, lambda: _build_fast_gather_fn(join_type))
         out_r, active, cnt = fast_fn(left.columns, right.columns,
                                      left.active, m, base, order_r)
         from spark_rapids_tpu.columnar.device import _prefetch_host
@@ -521,10 +519,8 @@ def device_join(left: DeviceBatch, right: DeviceBatch,
         return run_fast(total)
 
     gkey = (shapes, out_cap, join_type, m.shape, order_r.shape)
-    gather_fn = _GATHER_CACHE.get(gkey)
-    if gather_fn is None:
-        gather_fn = _build_gather_fn(out_cap, join_type)
-        _GATHER_CACHE[gkey] = gather_fn
+    gather_fn, _ = _GATHER_CACHE.get_or_build(
+        gkey, lambda: _build_gather_fn(out_cap, join_type))
     if join_type in ("right", "rightouter", "full", "fullouter"):
         out_l, out_r, active, _lv, _rv = gather_fn(
             left.columns, right.columns, total_pairs, n_extra, m, offsets,

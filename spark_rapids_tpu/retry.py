@@ -350,6 +350,13 @@ def _recover(conf, metrics, attempt: int, backoff_ms: int,
         if conf is not None:
             from spark_rapids_tpu.memory import get_device_store
             store = get_device_store(conf)
+        else:
+            # conf-less wrap sites (columnar helpers without a plan
+            # context): best-effort spill of the live process store —
+            # backoff alone rarely frees HBM
+            from spark_rapids_tpu import memory
+            store = memory._STORE
+        if store is not None:
             # escalate: first retry frees half the device tier (handles
             # the operation touches next stay resident instead of
             # thrashing a full device->host->device round trip), later
@@ -563,5 +570,6 @@ def _half_pids():
             rank = jnp.cumsum(active.astype(jnp.int64)) - 1
             total = jnp.sum(active.astype(jnp.int64))
             return jnp.where(rank * 2 < total, 0, 1).astype(jnp.int32)
+        # tpu-lint: disable=jit-direct(one lazily-built fixed split program — bounded by construction)
         _HALF_PIDS = jax.jit(_fn)
     return _HALF_PIDS

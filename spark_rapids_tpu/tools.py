@@ -427,7 +427,7 @@ def _main(argv: List[str]) -> int:
         description="TPU qualification/profiling tools")
     ap.add_argument("command",
                     choices=["qualify", "profile", "docs", "trace",
-                             "serve", "serve-client"])
+                             "serve", "serve-client", "lint"])
     ap.add_argument("sql", nargs="?", help="SQL text to analyze (live "
                     "mode; omit when using --log), the trace "
                     "file/directory for the trace command, or a "
@@ -453,9 +453,24 @@ def _main(argv: List[str]) -> int:
     ap.add_argument("--stats", action="store_true",
                     help="serve-client: print server stats instead of "
                     "running SQL")
+    ap.add_argument("--json", action="store_true",
+                    help="lint: machine-readable JSON output")
+    ap.add_argument("--fix-baseline", action="store_true",
+                    help="lint: capture current findings into the "
+                    "baseline file as accepted debt")
+    ap.add_argument("--root", default=None,
+                    help="lint: repo root to analyze (default: the "
+                    "installed package's parent directory)")
     # intermixed: `serve-client --port N "SELECT ..."` must parse (the
     # plain parser cannot allocate a positional after optionals)
     args = ap.parse_intermixed_args(argv)
+
+    if args.command == "lint":
+        # exit contract (docs/linting.md): 0 clean / 1 findings /
+        # 2 internal error
+        from spark_rapids_tpu.lint import run_cli
+        return run_cli(root=args.root, as_json=args.json,
+                       fix_baseline=args.fix_baseline)
 
     if args.command == "serve":
         return _serve_main(args)

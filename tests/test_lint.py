@@ -1,0 +1,600 @@
+"""tpu-lint corpus (docs/linting.md): fixture-driven good/bad pairs
+for every rule family, suppression + baseline semantics, JSON output
+schema, the CLI exit-code contract, and the zero-findings gate over
+the real package (which makes tier-1 the lint CI gate)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from spark_rapids_tpu.lint import (LintConfig, load_config, render_json,
+                                   run_lint)
+from spark_rapids_tpu.lint.engine import default_root, write_baseline
+
+
+def _tree(tmp_path, files):
+    root = tmp_path / "fixture"
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src).lstrip("\n"))
+    for d in ("spark_rapids_tpu", "spark_rapids_tpu/exec",
+              "spark_rapids_tpu/serve"):
+        if (root / d).is_dir():
+            init = root / d / "__init__.py"
+            if not init.exists():
+                init.write_text("")
+    return str(root)
+
+
+def _lint(root, **over):
+    cfg = LintConfig(check_docs=False, **over)
+    return run_lint(root, cfg)
+
+
+def _rules(result):
+    return sorted({f.rule for f in result.findings})
+
+
+# ---------------------------------------------------------------------------
+# family 1: retry coverage
+# ---------------------------------------------------------------------------
+
+def test_retry_coverage_bad_and_good(tmp_path):
+    root = _tree(tmp_path, {"spark_rapids_tpu/exec/x.py": """
+        from spark_rapids_tpu import retry as R
+
+        def bad(staged, device):
+            return finish_upload(staged, device)
+
+        def good(staged, device, conf):
+            return R.with_retry(lambda: finish_upload(staged, device),
+                                conf)
+    """})
+    r = _lint(root)
+    assert _rules(r) == ["retry-coverage"]
+    assert len(r.findings) == 1
+    assert r.findings[0].line == 4  # only the unwrapped site
+
+
+def test_retry_coverage_transitive_local_closure(tmp_path):
+    # with_retry re-runs the whole closure: a local def passed BY NAME
+    # to the combinator covers everything it calls in-module
+    root = _tree(tmp_path, {"spark_rapids_tpu/exec/x.py": """
+        from spark_rapids_tpu import retry as R
+
+        def outer(src, conf):
+            def upload_host(hb):
+                return inner(hb)
+            return R.with_split_retry(src, upload_host, conf)
+
+        def inner(hb):
+            return upload_batch(hb, 8)
+    """})
+    assert _lint(root).clean
+
+
+def test_retry_coverage_allowlist_and_scope(tmp_path):
+    files = {"spark_rapids_tpu/exec/x.py": """
+        def proto(staged, device):
+            return finish_upload(staged, device)
+    """,
+             # out of retry scope: same code, no finding
+             "spark_rapids_tpu/sql/y.py": """
+        def elsewhere(staged, device):
+            return finish_upload(staged, device)
+    """}
+    root = _tree(tmp_path, files)
+    assert _rules(_lint(root)) == ["retry-coverage"]
+    allow = {"spark_rapids_tpu/exec/x.py::proto":
+             "fixture protocol layer"}
+    assert _lint(root, retry_allowlist=allow).clean
+
+
+# ---------------------------------------------------------------------------
+# family 2: compile discipline
+# ---------------------------------------------------------------------------
+
+def test_jit_direct_bad_and_routed_good(tmp_path):
+    root = _tree(tmp_path, {"spark_rapids_tpu/exec/x.py": """
+        import jax
+        from spark_rapids_tpu.jit_cache import JitCache
+
+        _C = JitCache("fixture")
+
+        def bad(fn):
+            return jax.jit(fn)
+
+        def good(key, fn):
+            got = _C.get(key)
+            if got is None:
+                got = _C.put(key, jax.jit(fn))
+            return got
+
+        def also_good(key):
+            fn, _ = _C.get_or_build(key, lambda: _builder())
+            return fn
+
+        def _builder():
+            return jax.jit(lambda x: x)
+    """})
+    r = _lint(root)
+    assert _rules(r) == ["jit-direct"]
+    assert [f.line for f in r.findings] == [7]
+
+
+def test_jit_builder_resolves_across_modules(tmp_path):
+    # _STAGE_CACHE.put(key, X.build_fn(...)) in one module makes the
+    # jax.jit inside other_module.build_fn compliant
+    root = _tree(tmp_path, {
+        "spark_rapids_tpu/exec/a.py": """
+            from spark_rapids_tpu.jit_cache import JitCache
+            from spark_rapids_tpu.exec import b as B
+
+            _C = JitCache("x")
+
+            def use(key, steps):
+                return _C.put(key, B.build_fn(steps))
+        """,
+        "spark_rapids_tpu/exec/b.py": """
+            import jax
+
+            def build_fn(steps):
+                return jax.jit(lambda c: c)
+        """})
+    assert _lint(root).clean
+
+
+def test_jit_module_cache_flags_raw_dicts(tmp_path):
+    root = _tree(tmp_path, {"spark_rapids_tpu/exec/x.py": """
+        from collections import OrderedDict
+        from spark_rapids_tpu.jit_cache import JitCache
+
+        _BAD_CACHE = {}
+        _ALSO_BAD_CACHE = OrderedDict()
+        _GOOD_CACHE = JitCache("good")
+        _PLAIN_TABLE = {}
+    """})
+    r = _lint(root)
+    assert _rules(r) == ["jit-module-cache"]
+    assert [f.line for f in r.findings] == [4, 5]
+
+
+# ---------------------------------------------------------------------------
+# family 3: concurrency
+# ---------------------------------------------------------------------------
+
+_LOCKY = """
+    import threading
+    import time
+
+    class DeviceStore:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+            self._d = {}
+"""
+
+
+def test_lock_order_cycle_flagged(tmp_path):
+    root = _tree(tmp_path, {"spark_rapids_tpu/memory.py": _LOCKY + """
+        def one(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def two(self):
+            with self._b:
+                with self._a:
+                    pass
+    """})
+    r = _lint(root)
+    assert _rules(r) == ["lock-order"]
+    assert "DeviceStore._a" in r.findings[0].message
+
+
+def test_lock_order_consistent_is_clean(tmp_path):
+    root = _tree(tmp_path, {"spark_rapids_tpu/memory.py": _LOCKY + """
+        def one(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def two(self):
+            with self._a:
+                with self._b:
+                    pass
+    """})
+    assert _lint(root).clean
+
+
+def test_lock_order_interprocedural_edge(tmp_path):
+    # with A held, calling a method that takes B adds the A->B edge
+    root = _tree(tmp_path, {"spark_rapids_tpu/memory.py": _LOCKY + """
+        def one(self):
+            with self._a:
+                self.takes_b()
+
+        def takes_b(self):
+            with self._b:
+                pass
+
+        def two(self):
+            with self._b:
+                with self._a:
+                    pass
+    """})
+    assert _rules(_lint(root)) == ["lock-order"]
+
+
+def test_blocking_call_under_critical_lock(tmp_path):
+    root = _tree(tmp_path, {"spark_rapids_tpu/memory.py": _LOCKY + """
+        def bad_sleep(self):
+            with self._lock:
+                time.sleep(0.1)
+
+        def bad_dispatch(self, staged):
+            with self._lock:
+                return finish_upload(staged)
+
+        def good(self):
+            with self._lock:
+                n = 1
+            time.sleep(0.1)
+            return n
+    """})
+    r = _lint(root)
+    assert _rules(r) == ["lock-blocking-call"]
+    assert len(r.findings) == 2
+
+
+def test_wait_on_different_lock_flagged(tmp_path):
+    root = _tree(tmp_path, {"spark_rapids_tpu/memory.py": """
+        import threading
+
+        class DeviceStore:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition()
+
+            def bad(self):
+                with self._lock:
+                    self._cv.wait()
+
+            def fine(self):
+                with self._cv:
+                    self._cv.wait()
+    """})
+    r = _lint(root)
+    assert _rules(r) == ["lock-blocking-call"]
+    assert len(r.findings) == 1
+    assert "different lock" in r.findings[0].message
+
+
+def test_check_then_act_bad_and_guarded(tmp_path):
+    root = _tree(tmp_path, {"spark_rapids_tpu/serve/s.py": """
+        import threading
+
+        class Sessions:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._by_tenant = {}
+
+            def racy(self, k):
+                if k not in self._by_tenant:
+                    self._by_tenant[k] = object()
+                return self._by_tenant[k]
+
+            def guarded(self, k):
+                with self._lock:
+                    if k not in self._by_tenant:
+                        self._by_tenant[k] = object()
+                    return self._by_tenant[k]
+    """})
+    r = _lint(root)
+    assert _rules(r) == ["check-then-act"]
+    assert len(r.findings) == 1
+    assert "_by_tenant" in r.findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# family 4: drift
+# ---------------------------------------------------------------------------
+
+def test_metric_key_rule(tmp_path):
+    root = _tree(tmp_path, {
+        "spark_rapids_tpu/metrics.py": """
+            OP_TIME = "opTime"
+            ROGUE = "notDescribedConstant"
+            METRIC_DESCRIPTIONS = {
+                OP_TIME: "operator wall",
+                "goodKey": "described",
+            }
+            METRIC_PREFIX_DESCRIPTIONS = {"perChip.": "per chip <N>"}
+        """,
+        "spark_rapids_tpu/exec/x.py": """
+            from spark_rapids_tpu import metrics as M
+
+            def use(metrics):
+                metrics.create("goodKey").add(1)
+                metrics.create(M.OP_TIME).add(1)
+                metrics.create("perChip.3").add(1)
+                metrics.create("rogueLiteral").add(1)
+                metrics.create(dynamic_key()).add(1)  # invisible: ok
+        """})
+    r = _lint(root)
+    assert _rules(r) == ["metric-key"]
+    msgs = " ".join(f.message for f in r.findings)
+    assert "notDescribedConstant" in msgs  # constant direction
+    assert "rogueLiteral" in msgs          # call-site direction
+    assert len(r.findings) == 2
+
+
+def test_conf_key_rule(tmp_path):
+    root = _tree(tmp_path, {
+        "spark_rapids_tpu/conf.py": """
+            def conf(key):
+                return key
+
+            conf("spark.rapids.sql.fixture.enabled")
+        """,
+        "spark_rapids_tpu/exec/x.py": """
+            GOOD = "spark.rapids.sql.fixture.enabled"
+            BAD = "spark.rapids.sql.fixture.typo"
+            PREFIX = "spark.rapids.sql.fixture."  # namespace match: ok
+        """})
+    r = _lint(root)
+    assert _rules(r) == ["conf-key"]
+    assert len(r.findings) == 1
+    assert "typo" in r.findings[0].message
+
+
+def test_span_scope_rule(tmp_path):
+    root = _tree(tmp_path, {
+        "spark_rapids_tpu/trace.py": "def span(*a, **k): pass\n",
+        "spark_rapids_tpu/exec/x.py": """
+            from spark_rapids_tpu import trace as _trace
+
+            def use():
+                _trace.span("leaky")
+                with _trace.span("fine"):
+                    pass
+        """})
+    r = _lint(root)
+    assert _rules(r) == ["span-scope"]
+    assert [f.line for f in r.findings] == [4]
+
+
+def test_generated_doc_content_carries_drift_tables():
+    """The content direction of the retired runtime drift tests:
+    docs-drift proves docs == generator output byte-for-byte; this
+    proves the GENERATOR still emits the metric description table and
+    the conf/profile sections (otherwise regenerating stale docs could
+    silently drop them both)."""
+    import spark_rapids_tpu.profile  # noqa: F401 — registers confs
+    import spark_rapids_tpu.trace  # noqa: F401 — registers confs
+    from spark_rapids_tpu import metrics as M
+    from spark_rapids_tpu.tools import generate_observability_docs
+    doc = generate_observability_docs()
+    for name in M.METRIC_DESCRIPTIONS:
+        assert name in doc, name
+    for key in ("spark.rapids.sql.profile.enabled",
+                "spark.rapids.sql.profile.dir",
+                "spark.rapids.sql.explain",
+                "spark.rapids.sql.trace.enabled"):
+        assert key in doc, key
+    assert "Reading a query profile" in doc
+    assert "Explain / fallback reasons" in doc
+
+
+# ---------------------------------------------------------------------------
+# engine: suppressions, baseline, JSON schema
+# ---------------------------------------------------------------------------
+
+def test_suppression_requires_reason(tmp_path):
+    root = _tree(tmp_path, {"spark_rapids_tpu/exec/x.py": """
+        import jax
+
+        def a(fn):
+            return jax.jit(fn)  # tpu-lint: disable=jit-direct(fixture program, bounded)
+
+        def b(fn):
+            return jax.jit(fn)  # tpu-lint: disable=jit-direct
+    """})
+    r = _lint(root)
+    # the reasoned suppression holds; the reasonless one does NOT
+    # suppress and is itself a finding
+    assert r.suppressed == 1
+    assert _rules(r) == ["bad-suppression", "jit-direct"]
+    bad = [f for f in r.findings if f.rule == "jit-direct"]
+    assert [f.line for f in bad] == [7]
+
+
+def test_malformed_suppression_lists_fail_closed(tmp_path):
+    # parens inside a reason / prose after the list must fail the
+    # WHOLE comment (nothing suppressed, one bad-suppression), never
+    # register fragments of free text as rules
+    root = _tree(tmp_path, {"spark_rapids_tpu/exec/x.py": """
+        import jax
+
+        def a(fn):
+            return jax.jit(fn)  # tpu-lint: disable=jit-direct(probe (one-shot) cap)
+
+        def b(fn):
+            return jax.jit(fn)  # tpu-lint: disable=jit-direct(why) see docs/linting.md
+
+        def c(fn):
+            return jax.jit(fn)  # tpu-lint: disable=jit-direct(ok reason), span-scope(also fine)
+    """})
+    r = _lint(root)
+    assert r.suppressed == 1  # only c's well-formed multi-item list
+    rules = sorted(f.rule for f in r.findings)
+    assert rules.count("jit-direct") == 2  # a and b stay findings
+    assert rules.count("bad-suppression") == 2
+
+
+def test_standalone_suppression_covers_next_line(tmp_path):
+    root = _tree(tmp_path, {"spark_rapids_tpu/exec/x.py": """
+        import jax
+
+        # tpu-lint: disable=jit-direct(fixture program, bounded)
+        _FN = jax.jit(lambda x: x)
+    """})
+    r = _lint(root)
+    assert r.clean and r.suppressed == 1
+
+
+def test_baseline_semantics_and_fix_baseline(tmp_path):
+    root = _tree(tmp_path, {"spark_rapids_tpu/exec/x.py": """
+        import jax
+
+        def a(fn):
+            return jax.jit(fn)
+    """})
+    cfg = LintConfig(check_docs=False)
+    r = run_lint(root, cfg)
+    assert len(r.findings) == 1 and r.baselined == 0
+    # --fix-baseline captures current findings as accepted debt
+    path = write_baseline(root, cfg, r.findings, r.pctx)
+    data = json.load(open(path))
+    assert data["version"] == 1 and len(data["findings"]) == 1
+    assert data["findings"][0]["rule"] == "jit-direct"
+    r2 = run_lint(root, cfg)
+    assert r2.clean and r2.baselined == 1
+    # baseline is line-TEXT keyed: edits above the site don't churn it
+    p = os.path.join(root, "spark_rapids_tpu/exec/x.py")
+    src = open(p).read()
+    open(p, "w").write("import os  # shift lines\n" + src)
+    r3 = run_lint(root, cfg)
+    assert r3.clean and r3.baselined == 1
+    # re-capturing with a NEW finding present must keep the still-live
+    # old debt (what run_cli --fix-baseline writes), not drop it
+    # (distinct line text: identical lines share a fingerprint by
+    # design, like any text-keyed baseline)
+    open(p, "a").write(
+        "\n\ndef c(fn):\n    return jax.jit(fn, static_argnums=0)\n")
+    r4 = run_lint(root, cfg)
+    assert len(r4.findings) == 1 and r4.baselined == 1
+    write_baseline(root, cfg, r4.findings + r4.baselined_findings,
+                   r4.pctx)
+    data = json.load(open(path))
+    assert len(data["findings"]) == 2
+    r5 = run_lint(root, cfg)
+    assert r5.clean and r5.baselined == 2
+
+
+def test_json_output_schema(tmp_path):
+    root = _tree(tmp_path, {"spark_rapids_tpu/exec/x.py": """
+        import jax
+
+        def a(fn):
+            return jax.jit(fn)
+    """})
+    r = run_lint(root, LintConfig(check_docs=False))
+    out = json.loads(render_json(r, r.pctx))
+    assert out["version"] == 1
+    assert out["clean"] is False
+    assert set(out["counts"]) == {"findings", "suppressed", "baselined",
+                                  "files"}
+    f = out["findings"][0]
+    assert set(f) == {"rule", "path", "line", "col", "message",
+                      "fingerprint"}
+    assert f["rule"] == "jit-direct"
+    assert "jit-direct" in out["rules"]
+    assert out["internalErrors"] == []
+
+
+def test_config_file_overrides(tmp_path):
+    root = _tree(tmp_path, {"spark_rapids_tpu/exec/x.py": """
+        def proto(staged, device):
+            return finish_upload(staged, device)
+    """})
+    (tmp_path / "fixture" / "tpu-lint.json").write_text(json.dumps({
+        "check_docs": False,
+        "retry_allowlist": {
+            "spark_rapids_tpu/exec/x.py::proto": "fixture exemption"},
+    }))
+    cfg = load_config(root)
+    assert cfg.check_docs is False
+    assert run_lint(root, cfg).clean
+
+
+# ---------------------------------------------------------------------------
+# the real package is the ultimate fixture: zero findings, every
+# suppression reasoned — this test IS the tier-1 lint gate
+# ---------------------------------------------------------------------------
+
+def test_real_package_is_lint_clean():
+    root = default_root()
+    cfg = load_config(root)
+    assert cfg.check_docs  # docs-drift runs against the real docs/
+    r = run_lint(root, cfg)
+    assert r.internal_errors == []
+    assert r.findings == [], "\n".join(
+        f"{f.path}:{f.line} [{f.rule}] {f.message}" for f in r.findings)
+    # the hand-audited invariants are live: suppressions exist and each
+    # carried a reason (reasonless ones would be findings above)
+    assert r.suppressed > 0
+    assert r.files > 50
+
+
+def test_cli_exit_contract(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # 0: clean repo (shells the real CLI — the CI gate invocation)
+    out = subprocess.run(
+        [sys.executable, "-m", "spark_rapids_tpu.tools", "lint",
+         "--json"],
+        capture_output=True, text=True, env=env,
+        cwd=default_root(), timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["clean"] is True
+
+    # 1: findings
+    bad = _tree(tmp_path, {"spark_rapids_tpu/exec/x.py": """
+        import jax
+
+        def a(fn):
+            return jax.jit(fn)
+    """})
+    out = subprocess.run(
+        [sys.executable, "-m", "spark_rapids_tpu.tools", "lint",
+         "--root", bad], capture_output=True, text=True, env=env,
+        cwd=default_root(), timeout=300)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "jit-direct" in out.stdout
+
+    # --fix-baseline flips it back to 0
+    out = subprocess.run(
+        [sys.executable, "-m", "spark_rapids_tpu.tools", "lint",
+         "--root", bad, "--fix-baseline"],
+        capture_output=True, text=True, env=env,
+        cwd=default_root(), timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    out = subprocess.run(
+        [sys.executable, "-m", "spark_rapids_tpu.tools", "lint",
+         "--root", bad], capture_output=True, text=True, env=env,
+        cwd=default_root(), timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+    # 2: internal error (unparseable source)
+    broken = _tree(tmp_path / "b",
+                   {"spark_rapids_tpu/x.py": "def broken(:\n"})
+    out = subprocess.run(
+        [sys.executable, "-m", "spark_rapids_tpu.tools", "lint",
+         "--root", broken], capture_output=True, text=True, env=env,
+        cwd=default_root(), timeout=300)
+    assert out.returncode == 2, out.stdout + out.stderr
+
+    # 2: zero files collected (a wrong --root must not pass the gate)
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty, exist_ok=True)
+    out = subprocess.run(
+        [sys.executable, "-m", "spark_rapids_tpu.tools", "lint",
+         "--root", empty], capture_output=True, text=True, env=env,
+        cwd=default_root(), timeout=300)
+    assert out.returncode == 2, out.stdout + out.stderr
+    assert "no files found" in out.stdout

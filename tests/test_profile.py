@@ -369,16 +369,17 @@ def test_tools_profile_cli_smoke(tmp_path, capsys):
 # Satellites: metric-description lint, registry epoch, event-log fields
 # ---------------------------------------------------------------------------
 
-def test_every_registered_tpu_metric_is_described():
-    """CI lint (the PR-5 drift guard, extended): every metric ANY
-    Tpu*Exec registers at runtime must resolve in the central
-    description table metrics.METRIC_DESCRIPTIONS (memory metrics
-    included), so profile/docs/bench never disagree on names."""
+def test_dynamic_metric_keys_are_described():
+    """Runtime smoke for what the STATIC lint cannot see: metric keys
+    built dynamically (f-string per-chip / per-encoding families like
+    ``dispatchCount.chip3``) must still resolve via describe_metric.
+    Literal keys and metrics.py constants are machine-checked by
+    tpu-lint's `metric-key` rule (tests/test_lint.py), so one executed
+    query shape suffices here."""
     spark = TpuSparkSession(_conf())
     try:
         spark.start_capture()
         _q1_silhouette(spark)._execute()
-        _q3_silhouette(spark)._execute()
         plans = spark.get_captured_plans()
     finally:
         spark.stop()
@@ -404,25 +405,12 @@ def test_every_registered_tpu_metric_is_described():
         f"{undescribed} — add them so profile/docs/bench agree")
 
 
-def test_every_metric_constant_is_described_and_documented():
-    """Both directions of the drift guard: every metrics.py name
-    constant has a description, and the generated observability doc
-    carries the whole description table."""
-    from spark_rapids_tpu.tools import (generate_observability_docs,
-                                        metric_name_constants)
-    for const, name in metric_name_constants():
-        assert name in M.METRIC_DESCRIPTIONS, (
-            f"constant {const} = {name!r} missing from "
-            "METRIC_DESCRIPTIONS")
-    doc = generate_observability_docs()
-    for name, desc in M.METRIC_DESCRIPTIONS.items():
-        assert name in doc, name
-    for key in ("spark.rapids.sql.profile.enabled",
-                "spark.rapids.sql.profile.dir",
-                "spark.rapids.sql.explain"):
-        assert key in doc, key
-    assert "Reading a query profile" in doc
-    assert "Explain / fallback reasons" in doc
+# The constant-is-described / description-table-in-docs directions of
+# the drift guard are now STATIC: tpu-lint's `metric-key` rule checks
+# every metrics.py constant against METRIC_DESCRIPTIONS and its
+# `docs-drift` rule diffs docs/observability.md against the generator
+# byte-for-byte (tests/test_lint.py asserts both over the real
+# package). Only the dynamic-key smoke above still needs a live run.
 
 
 def test_registry_epoch_scopes_process_wide_snapshot():
