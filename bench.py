@@ -725,6 +725,114 @@ def run_profile(clean_wall: float, cpu_rows) -> dict:
     }
 
 
+_KERNEL_NAMES = ("groupbyHash", "joinProbe", "murmur3")
+
+# the q1 agg-drain span families whose EXCLUSIVE self-time the kernel
+# tier targets (ISSUE 11 acceptance: >= 2x on the drain, kernel vs
+# oracle): the per-batch aggregation dispatches plus the drain wall
+_DRAIN_SPANS = ("TpuHashAggregateExec.dispatch",
+                "TpuHashAggregateExec.pipelineDrainTime",
+                "pipelineDrainTime")
+
+
+def run_kernels(clean_wall: float, cpu_rows) -> dict:
+    """detail.kernels (docs/kernels.md): per-kernel A/B walls — q1
+    with the Pallas kernel tier on (stock conf) vs the XLA-op oracle
+    composition (kernel.enabled=false), plus one leg per kernel with
+    only that kernel disabled — with the q1 agg-drain EXCLUSIVE
+    self-time extracted from each leg's trace (tools.exclusive_times)
+    and the kernelDispatchCount/kernelFallbacks counters. Every leg
+    asserts bit-identical rows. On backends without native Pallas
+    lowering the kernels run in interpreter-mode emulation: the legs
+    still measure (the parity/counter story holds) but walls are not
+    representative of TPU kernels — `pallasMode` says which."""
+    import glob
+
+    from spark_rapids_tpu import device_caps as DC
+    from spark_rapids_tpu import trace as TR
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    from spark_rapids_tpu.tools import exclusive_times
+    from spark_rapids_tpu.trace import load_trace
+    mode = DC.pallas_mode()
+    if mode is None:
+        return {"skipped": True,
+                "reason": "pallas unavailable on this backend"}
+    tdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".bench-data", "kernel-traces")
+
+    def leg(extra, traced=True, runs=2) -> dict:
+        shutil.rmtree(tdir, ignore_errors=True)
+        TR.reset_tracing()
+        fresh_leg()
+        conf = dict(TPU_CONF)
+        if traced:
+            conf["spark.rapids.sql.trace.enabled"] = "true"
+            conf["spark.rapids.sql.trace.dir"] = tdir
+        conf.update(extra)
+        tpu = TpuSparkSession(conf)
+        try:
+            q = build_query(tpu)
+            run_once(q)  # jit compile warm-up
+            times, rows = [], None
+            for i in range(runs):
+                if i == runs - 1:
+                    tpu.start_capture()
+                dt, rows = run_once(q)
+                times.append(dt)
+            assert_rows_match(cpu_rows, rows)
+            counters = collect_counters(
+                tpu.get_captured_plans(),
+                tuple(f"kernelDispatchCount.{n}" for n in _KERNEL_NAMES)
+                + tuple(f"kernelFallbacks.{n}" for n in _KERNEL_NAMES))
+            out = {"wall_s": round(min(times), 4),
+                   "kernelDispatchCount": {
+                       n: counters[f"kernelDispatchCount.{n}"]
+                       for n in _KERNEL_NAMES
+                       if counters[f"kernelDispatchCount.{n}"]},
+                   "kernelFallbacks": {
+                       n: counters[f"kernelFallbacks.{n}"]
+                       for n in _KERNEL_NAMES
+                       if counters[f"kernelFallbacks.{n}"]}}
+            if traced:
+                files = sorted(glob.glob(
+                    os.path.join(tdir, "trace-*.json")))
+                if files:
+                    excl = exclusive_times(
+                        load_trace(files[-1])["spans"])
+                    out["aggDrainSelf_s"] = round(sum(
+                        d["exclusive"] for name, d in excl.items()
+                        if name in _DRAIN_SPANS) / 1e6, 4)
+            return out
+        finally:
+            tpu.stop()
+            TR.reset_tracing()
+
+    on = leg({})
+    off = leg({"spark.rapids.sql.kernel.enabled": "false"})
+    per_kernel = {}
+    for name in _KERNEL_NAMES:
+        per_kernel[name] = leg(
+            {f"spark.rapids.sql.kernel.{name}.enabled": "false"},
+            traced=False, runs=1)
+    out = {
+        "skipped": False,
+        "pallasMode": mode,
+        "clean_wall_s": round(clean_wall, 4),
+        "kernelsOn": on,
+        "kernelsOff": off,
+        "oneKernelOff": per_kernel,
+        "wallSpeedup": round(off["wall_s"] / on["wall_s"], 4),
+    }
+    if on.get("aggDrainSelf_s") and off.get("aggDrainSelf_s"):
+        out["aggDrainSpeedup"] = round(
+            off["aggDrainSelf_s"] / on["aggDrainSelf_s"], 4)
+    if mode != "native":
+        out["note"] = ("interpret-mode emulation: parity/counters are "
+                       "real, walls are not representative of TPU "
+                       "kernel performance")
+    return out
+
+
 def run_serving(clean_wall: float, cpu_rows, q3_cpu_rows) -> dict:
     """Mixed q1/q3 workload through the query server
     (docs/serving.md): sustained QPS and p50/p99 latency at
@@ -912,6 +1020,13 @@ def main():
         profile_leg = {"skipped": True,
                        "reason": f"profile leg failed: {e!r}"}
 
+    # Pallas kernel tier A/B (docs/kernels.md), equally fault-isolated
+    try:
+        kernels_leg = run_kernels(fused["wall_s"], cpu_rows)
+    except Exception as e:  # noqa: BLE001 - reported, not swallowed
+        kernels_leg = {"skipped": True,
+                       "reason": f"kernels leg failed: {e!r}"}
+
     # serving leg (docs/serving.md): QPS/latency through the query
     # server at concurrency 1/4/16, equally fault-isolated
     try:
@@ -957,6 +1072,7 @@ def main():
             "robustness": robustness,
             "trace": trace_leg,
             "profile": profile_leg,
+            "kernels": kernels_leg,
             "serving": serving,
             "jitCaches": registry_snapshot()["jitCaches"],
             "tpcds_q3": {

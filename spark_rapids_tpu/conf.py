@@ -457,6 +457,60 @@ PARQUET_DEVICE_DECODE_BSS = conf(
     "the byte-plane reinterleave is a strided device gather. Off = "
     "those columns fall back to the pyarrow host decode.").boolean(True)
 
+KERNEL_ENABLED = conf("spark.rapids.sql.kernel.enabled").doc(
+    "Master switch for the hand-written Pallas kernel tier "
+    "(spark_rapids_tpu/kernels/): ops whose shape a kernel supports "
+    "swap their stock XLA-op composition for the kernel behind the "
+    "same JitCache keys, with automatic per-call fallback to the "
+    "composition (the bit-identity oracle) on lowering/compile "
+    "failure or hash-table overflow — counted as kernelFallbacks.* "
+    "metrics. On backends without native Pallas lowering (CPU) the "
+    "kernels run in interpreter mode so every kernel path stays "
+    "exercised (docs/kernels.md).").boolean(True)
+
+KERNEL_GROUPBY_HASH = conf(
+    "spark.rapids.sql.kernel.groupbyHash.enabled").doc(
+    "Single-pass open-addressed hash-table group-by kernel for the "
+    "PARTIAL aggregation update (SUM/COUNT/MIN/MAX over fixed-width "
+    "keys and values): replaces the lexsort + segmented-scan pipeline "
+    "with one insert/combine pass over the batch. Batches with more "
+    "distinct groups than kernel.groupbyHash.tableSlots overflow and "
+    "re-run on the oracle composition (docs/kernels.md).").boolean(True)
+
+KERNEL_GROUPBY_TABLE_SLOTS = conf(
+    "spark.rapids.sql.kernel.groupbyHash.tableSlots").doc(
+    "Hash-table capacity (slots, rounded up to a power of two) of the "
+    "group-by kernel. Bounds the distinct groups one batch may "
+    "produce through the kernel; beyond it the batch overflows to the "
+    "oracle composition (kernelFallbacks.groupbyHash). Sized for "
+    "low-cardinality aggregations (the q1 shape); raise it for "
+    "wider group counts at the cost of on-chip table state."
+    ).integer(1024)
+
+KERNEL_JOIN_PROBE = conf(
+    "spark.rapids.sql.kernel.joinProbe.enabled").doc(
+    "Hash-table build/probe kernel for the join gather map: the build "
+    "side inserts into an open-addressed table (first-occurrence row "
+    "per key), the stream side probes it — replacing the sort-based "
+    "key plan for semi/anti joins and the certified-unique-build-key "
+    "(FK) fast path. Applies when the build side fits "
+    "kernel.joinProbe.maxBuildRows (docs/kernels.md).").boolean(True)
+
+KERNEL_JOIN_MAX_BUILD_ROWS = conf(
+    "spark.rapids.sql.kernel.joinProbe.maxBuildRows").doc(
+    "Largest build-side row capacity the join probe kernel accepts; "
+    "the table is sized at twice the capacity (load factor <= 0.5, so "
+    "probe chains always terminate and overflow is impossible). "
+    "Bigger build sides keep the sort-based oracle plan.").integer(8192)
+
+KERNEL_MURMUR3 = conf("spark.rapids.sql.kernel.murmur3.enabled").doc(
+    "Fused Murmur3 partition-hashing kernel: the per-column "
+    "rotl/fmix chains of Spark's Murmur3_x86_32 fold in one pass over "
+    "the row block instead of a chain of stock XLA ops. Bit-identical "
+    "to ops/hashing.py (the same arithmetic runs inside the kernel); "
+    "used by the in-process hash exchange (docs/kernels.md)."
+    ).boolean(True)
+
 PARQUET_DEVICE_DECODE_MAX_IN_FLIGHT = conf(
     "spark.rapids.sql.format.parquet.deviceDecode.maxInFlight").doc(
     "Scan upload pipeline depth: how many staged scan batches may have "

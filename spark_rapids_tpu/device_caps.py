@@ -92,6 +92,50 @@ def f64_bitcast_exact() -> bool:
         return False
 
 
+@functools.lru_cache(maxsize=None)
+def pallas_mode():
+    """How the Pallas kernel tier (spark_rapids_tpu/kernels/) can run
+    on the default backend: ``"native"`` when ``pl.pallas_call``
+    lowers and executes for real (TPU), ``"interpret"`` when only the
+    interpreter-mode emulation works (CPU — tier-1 exercises every
+    kernel path through it), ``None`` when Pallas is unusable (kernels
+    stay disabled and every op keeps its XLA-op oracle composition)."""
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        from jax.experimental import pallas as pl
+    except Exception:
+        return None
+
+    def kern(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2
+
+    x = np.arange(8, dtype=np.int32)
+    for mode, interpret in (("native", False), ("interpret", True)):
+        try:
+            # .lower().compile() forces REAL lowering even when the
+            # first probe call happens inside an outer trace (a plain
+            # call would inline the pallas_call into the outer jaxpr
+            # and "succeed" without ever testing the backend)
+            # tpu-lint: disable=jit-direct(one-shot lru_cached capability probe, never re-compiled)
+            fn = jax.jit(lambda v: pl.pallas_call(
+                kern,
+                out_shape=jax.ShapeDtypeStruct(x.shape, jnp.int32),
+                interpret=interpret)(v))
+            out = fn.lower(x).compile()(x)
+            if np.array_equal(np.asarray(out), np.arange(8) * 2):
+                return mode
+        except Exception:
+            continue
+    return None
+
+
+def pallas_interpret() -> bool:
+    """True when kernels must pass ``interpret=True`` to pallas_call."""
+    return pallas_mode() == "interpret"
+
+
 def float_arith_reason(kind: str = "arithmetic") -> str:
     return (f"device float {kind} is not bit-identical to CPU on this "
             "backend (TPU f64 is emulated); set "

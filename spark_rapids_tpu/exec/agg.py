@@ -271,7 +271,8 @@ class TpuHashAggregateExec(TpuExec):
                   slot_srcs: List[E.Expression],
                   prims: List[Tuple[str, T.DataType]],
                   has_nans: bool, prelude_steps=None,
-                  donate: bool = False) -> Callable:
+                  donate: bool = False,
+                  kernel_slots: Optional[int] = None) -> Callable:
         aliases = self._agg_aliases()
         slot_counts = [len(self.slots[a.expr_id]) for a in aliases]
         grouping = self.grouping
@@ -311,6 +312,26 @@ class TpuHashAggregateExec(TpuExec):
                     uniq_srcs.append(e)
                 src_map.append(uniq_of[k])
             slot_vals = [X.dev_eval(e, ctx) for e in uniq_srcs]
+            if kernel_slots is not None:
+                # Pallas hash-table kernel (docs/kernels.md): one
+                # open-addressed insert/combine pass replaces the
+                # lexsort + segmented scans below. Same compacted
+                # partial-output contract, plus the overflow flag the
+                # exec resolves at drain time (overflowed batches
+                # re-run on this very oracle path, kernels off).
+                from spark_rapids_tpu.columnar.device import _compact_body
+                from spark_rapids_tpu.kernels import groupby_hash as KG
+                entries = [(slot_vals[j], p, dt)
+                           for j, (p, dt) in zip(src_map, prims)]
+                key_out, buffers, used, cnt, ovf = KG.hash_groupby(
+                    key_cols, entries, active, kernel_slots,
+                    has_nans=has_nans)
+                out_cols = list(key_out if grouping else []) \
+                    + list(buffers)
+                flat2, spec2 = flatten_columns(out_cols)
+                new_active, outs2 = _compact_body(used, flat2)
+                return rebuild_columns(spec2, outs2), new_active, cnt, \
+                    ovf
             # keys AND slot values ride the segment sort as payload (one
             # fused lane-matrix gather; sorting each array separately is
             # a flat ~25-40ms per op on this backend)
@@ -408,10 +429,15 @@ class TpuHashAggregateExec(TpuExec):
         return tuple(desc)
 
     def _aggregate_batch(self, batch: DeviceBatch,
-                         mode: Optional[str] = None):
-        """Run one aggregation program. Returns ``(DeviceBatch, cnt)``
-        where ``cnt`` is the device-scalar group count for partial/merge
-        modes (compacted output) and None for final/complete."""
+                         mode: Optional[str] = None,
+                         force_oracle: bool = False):
+        """Run one aggregation program. Returns ``(DeviceBatch, cnt,
+        overflow)``: ``cnt`` is the device-scalar group count for
+        partial/merge modes (compacted output) and None for
+        final/complete; ``overflow`` is the kernel path's device
+        hash-table-overflow flag (None on the oracle path) — the
+        partial drain re-runs overflowed batches with
+        ``force_oracle=True`` (docs/kernels.md)."""
         mode = mode or self.mode
         prelude = (self._prelude_ops
                    if self._prelude_ops and mode == "partial" else None)
@@ -430,30 +456,45 @@ class TpuHashAggregateExec(TpuExec):
         slot_srcs, prims = self._bound_slot_sources(mode, child_out)
         prelude_steps = None
         donate = False
-        if prelude:
-            from spark_rapids_tpu.exec.fused import (batch_donatable,
-                                                     bind_chain_steps)
-            prelude_steps = bind_chain_steps(prelude)
-            # per-batch: aliased buffers (one array on two pytree
-            # leaves) must not be donated twice
-            donate = self._donate_input and batch_donatable(batch)
         salt = G.kernel_salt()  # snapshot: key AND trace use this value
-        key = (mode, salt,
-               tuple(X.expr_key(e) for e in key_bound),
-               tuple(X.expr_key(e) for e in slot_srcs),
-               tuple(p for p, _ in prims),
-               tuple(repr(dt) for _, dt in prims),
-               tuple(len(self.slots[a.expr_id])
-                     for a in self._agg_aliases()),
-               self._out_desc(),
-               X.stage_structural_key(prelude_steps)
-               if prelude_steps else None, donate)
-        fn, was_miss = _AGG_FN_CACHE.get_or_build(
-            key, lambda: self._build_fn(mode, key_bound, slot_srcs,
-                                        prims, has_nans=salt[0],
-                                        prelude_steps=prelude_steps,
-                                        donate=donate))
-        mirror_to_metrics(_AGG_FN_CACHE, self.metrics, was_miss)
+        struct = (mode, salt,
+                  tuple(X.expr_key(e) for e in key_bound),
+                  tuple(X.expr_key(e) for e in slot_srcs),
+                  tuple(p for p, _ in prims),
+                  tuple(repr(dt) for _, dt in prims),
+                  tuple(len(self.slots[a.expr_id])
+                        for a in self._agg_aliases()),
+                  self._out_desc(),
+                  X.stage_structural_key(prelude_steps)
+                  if prelude_steps else None)
+        if prelude:
+            from spark_rapids_tpu.exec.fused import bind_chain_steps
+            prelude_steps = bind_chain_steps(prelude)
+            struct = struct[:-1] + (
+                X.stage_structural_key(prelude_steps),)
+        # Pallas kernel tier (docs/kernels.md): the hash-table kernel
+        # takes the partial update when the whole program's shape is
+        # eligible; a structure whose kernel build/dispatch ever
+        # failed is poisoned back to the oracle for the process life
+        from spark_rapids_tpu import kernels as KR
+        from spark_rapids_tpu.kernels import groupby_hash as KG
+        kern_slots = None
+        if (not force_oracle
+                and KR.kernel_enabled(self.conf, "groupbyHash")
+                and KG.agg_kernel_eligible(mode, self.grouping,
+                                           slot_srcs, prims)
+                and not KR.is_poisoned("groupbyHash", struct)):
+            kern_slots = KR.table_slots(self.conf, batch.capacity)
+        if prelude:
+            from spark_rapids_tpu.exec.fused import batch_donatable
+            # per-batch: aliased buffers (one array on two pytree
+            # leaves) must not be donated twice; the kernel path also
+            # never donates — an overflowed batch re-runs on the
+            # oracle, so its input buffers must survive the dispatch —
+            # and neither does a force_oracle re-run, whose input is a
+            # STORE-RETAINED batch a concurrent spill may still read
+            donate = (self._donate_input and batch_donatable(batch)
+                      and kern_slots is None and not force_oracle)
         lit_vals = X.literal_values(list(key_bound) + list(slot_srcs))
         if prelude_steps:
             lit_vals = (X.stage_literal_values(prelude_steps), lit_vals)
@@ -465,19 +506,53 @@ class TpuHashAggregateExec(TpuExec):
         qt = TR._ACTIVE
         chip = TR.chip_of(batch)  # None (no device query) when untraced
         import time as _time
+
+        def _get_fn(kslots):
+            return _AGG_FN_CACHE.get_or_build(
+                struct + (donate, kslots),
+                lambda: self._build_fn(mode, key_bound, slot_srcs,
+                                       prims, has_nans=salt[0],
+                                       prelude_steps=prelude_steps,
+                                       donate=donate,
+                                       kernel_slots=kslots))
+
+        fn, was_miss = _get_fn(kern_slots)
+        mirror_to_metrics(_AGG_FN_CACHE, self.metrics, was_miss)
+        ovf = None
         t0 = _time.perf_counter_ns()
-        if mode in ("partial", "merge", "merge_partial"):
+        try:
+            if kern_slots is not None:
+                KR.check_injected_failure("groupbyHash")
+                KR.count_dispatch(self.metrics, "groupbyHash")
+                out_cols, out_active, cnt, ovf = fn(
+                    batch.columns, batch.active, lit_vals)
+            elif mode in ("partial", "merge", "merge_partial"):
+                out_cols, out_active, cnt = fn(batch.columns,
+                                               batch.active, lit_vals)
+            else:
+                out_cols, out_active = fn(batch.columns, batch.active,
+                                          lit_vals)
+        except Exception as e:
+            if kern_slots is None or not KR.is_oracle_fallback_error(e):
+                raise
+            # kernel failed to lower/compile/execute: poison the
+            # structure and re-run this call on the oracle composition
+            KR.poison("groupbyHash", struct)
+            KR.count_fallback(self.metrics, "groupbyHash")
+            kern_slots = None
+            fn, was_miss = _get_fn(None)
+            mirror_to_metrics(_AGG_FN_CACHE, self.metrics, was_miss)
+            t0 = _time.perf_counter_ns()
             out_cols, out_active, cnt = fn(batch.columns, batch.active,
                                            lit_vals)
-        else:
-            out_cols, out_active = fn(batch.columns, batch.active,
-                                      lit_vals)
         elapsed = _time.perf_counter_ns() - t0
         if qt is not None:
             # the same measurement feeds computeAggTime/stageCompileTime
             # below — trace and metrics agree (docs/observability.md)
             qt.add("TpuHashAggregateExec.dispatch", t0, t0 + elapsed,
-                   chip=chip, mode=mode, compile=bool(was_miss))
+                   chip=chip, mode=mode, compile=bool(was_miss),
+                   **({"kernel": "groupbyHash"}
+                      if kern_slots is not None else {}))
         if was_miss:
             # first call after a compile miss carries trace+XLA compile
             self.metrics.create(M.STAGE_COMPILE_TIME,
@@ -505,7 +580,8 @@ class TpuHashAggregateExec(TpuExec):
                  for a in child_out])
         else:
             schema = self.schema
-        return DeviceBatch(schema, list(out_cols), out_active, None), cnt
+        return DeviceBatch(schema, list(out_cols), out_active,
+                           None), cnt, ovf
 
     def _empty_global_result(self) -> DeviceBatch:
         cols: List[HostColumn] = []
@@ -545,7 +621,7 @@ class TpuHashAggregateExec(TpuExec):
                     continue
                 whole = concat_device([h.get() for h in chunk])
                 from spark_rapids_tpu import retry as R
-                out, cnt = R.with_retry(
+                out, cnt, _ovf = R.with_retry(
                     lambda w=whole: self._aggregate_batch(w, mode="merge"),
                     self.conf, self.metrics)
                 out._num_rows = int(cnt)  # sizes the bucket slice
@@ -583,7 +659,7 @@ class TpuHashAggregateExec(TpuExec):
                 # no shrink: results stay mask-scattered (caps here are
                 # already small post-exchange; skipping saves a sync)
                 from spark_rapids_tpu import retry as R
-                out, _cnt = R.with_retry(
+                out, _cnt, _ovf = R.with_retry(
                     lambda: self._aggregate_batch(whole),
                     self.conf, self.metrics)
                 if not grouped and self.mode in ("final", "complete") \
@@ -611,19 +687,33 @@ class TpuHashAggregateExec(TpuExec):
         from spark_rapids_tpu.columnar.device import _prefetch_host
         pending = []
         prefetched = True
+
+        def run_piece(piece):
+            out, cnt, ovf = self._aggregate_batch(piece)
+            return piece, out, cnt, ovf
+
         for b in thunk():
             # OOM protocol on the per-batch update program: spill+retry
             # first, then split the input in half by rows — partial
             # outputs from the halves merge downstream exactly like two
             # ordinary input batches, so results stay bit-identical
-            for out, cnt in R.with_split_retry(
-                    b, self._aggregate_batch, self.conf, self.metrics,
+            for piece, out, cnt, ovf in R.with_split_retry(
+                    b, run_piece, self.conf, self.metrics,
                     translate_real=not self._donate_input):
                 # async host copy starts NOW: by drain time the scalar
                 # is already local, so the drain costs pipeline-
                 # completion, not + a flat ~0.2s roundtrip per fetch
-                prefetched = _prefetch_host([cnt]) and prefetched
-                pending.append((self.register_spillable(store, out), cnt))
+                prefetched = _prefetch_host(
+                    [cnt] + ([ovf] if ovf is not None else [])) \
+                    and prefetched
+                # kernel path: RETAIN the input (spillable) until the
+                # drain resolves its overflow flag — an overflowed
+                # table means the output is missing groups and the
+                # batch re-runs on the oracle (docs/kernels.md)
+                h_in = (self.register_spillable(store, piece)
+                        if ovf is not None else None)
+                pending.append((self.register_spillable(store, out),
+                                cnt, ovf, h_in))
         if not pending:
             return
         # This read is where the whole async upstream pipeline (upload
@@ -638,23 +728,60 @@ class TpuHashAggregateExec(TpuExec):
         # wall so the bench stage breakdown sums sensibly
         with self.metrics.timed_wall("pipelineDrainTime"):
             if prefetched:
-                counts = [int(np.asarray(c)) for _h, c in pending]
+                counts = [int(np.asarray(c)) for _h, c, _o, _i in pending]
+                overflows = [o is not None and bool(np.asarray(o))
+                             for _h, _c, o, _i in pending]
             else:
                 counts = np.asarray(
-                    _stack_counts([c for _h, c in pending]))
+                    _stack_counts([c for _h, c, _o, _i in pending]))
+                # one stacked fetch for ALL overflow flags too — each
+                # separate D2H read costs a flat roundtrip on tunneled
+                # backends, exactly like the counts above
+                ovf_list = [o for _h, _c, o, _i in pending
+                            if o is not None]
+                flags = (np.asarray(_stack_counts(ovf_list))
+                         if ovf_list else [])
+                it = iter(flags)
+                overflows = [o is not None and bool(next(it))
+                             for _h, _c, o, _i in pending]
         shrunk = []
-        for (h, _c), cnt in zip(pending, counts):
+        from spark_rapids_tpu import kernels as KR
+        for (h, _c, _o, h_in), cnt, ovf in zip(pending, counts,
+                                               overflows):
+            if ovf:
+                # hash-table overflow: more distinct groups than the
+                # kernel's table holds. Re-run the RETAINED input on
+                # the oracle composition — bit-identity is preserved
+                # because the kernel output is simply discarded. The
+                # re-run keeps the full split-retry protocol (and
+                # force_oracle never donates: the input is
+                # store-retained)
+                KR.count_fallback(self.metrics, "groupbyHash")
+                h.close()
+                whole = h_in.get()
+                h_in.close()
+                for b2, cnt2, _ovf2 in R.with_split_retry(
+                        whole,
+                        lambda piece: self._aggregate_batch(
+                            piece, force_oracle=True),
+                        self.conf, self.metrics):
+                    b2._num_rows = int(np.asarray(cnt2))
+                    b2 = slice_compacted_to_bucket(b2)
+                    shrunk.append(self.register_spillable(store, b2))
+                continue
             b = h.get()
             b._num_rows = int(cnt)
-            b = slice_compacted_to_bucket(b)
             h.close()
+            if h_in is not None:
+                h_in.close()
+            b = slice_compacted_to_bucket(b)
             shrunk.append(self.register_spillable(store, b))
         total = sum(h.rows for h in shrunk)
         if len(shrunk) > 1 and total <= self.conf.batch_size_rows:
             whole = concat_device([h.get() for h in shrunk])
             for h in shrunk:
                 h.close()
-            out, _cnt = R.with_retry(
+            out, _cnt, _ovf = R.with_retry(
                 lambda: self._aggregate_batch(whole,
                                               mode="merge_partial"),
                 self.conf, self.metrics)

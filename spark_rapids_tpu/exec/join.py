@@ -147,7 +147,8 @@ class TpuShuffledHashJoinExec(TpuExec):
         def attempt():
             out = device_join(lwhole, rwhole, lk, rk, self.join_type,
                               out_schema, null_safe=self.null_safe,
-                              fk_hint=fk_hint)
+                              fk_hint=fk_hint, conf=self.conf,
+                              metrics=self.metrics)
             if self.condition is not None:
                 cond = E.bind_references(self.condition,
                                          self._pair_attrs())
@@ -410,7 +411,9 @@ class TpuShuffledHashJoinExec(TpuExec):
             out, matched = R.with_retry(
                 lambda: device_join(lwhole, rwhole, lk, rk, chunk_type,
                                     out_schema, collect_matched_r=True,
-                                    null_safe=self.null_safe),
+                                    null_safe=self.null_safe,
+                                    conf=self.conf,
+                                    metrics=self.metrics),
                 self.conf, self.metrics)
         if out._num_rows is not None:
             self.metrics.create(M.NUM_OUTPUT_ROWS, M.ESSENTIAL).add(

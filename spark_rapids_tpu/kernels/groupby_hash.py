@@ -1,0 +1,449 @@
+"""Single-pass hash-table group-by kernel (Pallas).
+
+Replaces ``ops/groupby.py``'s lexsort + segmented-scan pipeline for the
+PARTIAL aggregation update when every slot is in the SUM/COUNT/MIN/MAX
+family over fixed-width data: one open-addressed insert/combine pass
+over the batch instead of a multi-word radix sort plus scans — the
+direct twin of the cuDF hash aggregation the reference leans on
+(SURVEY.md §2.4), shaped for this engine's static-capacity batches.
+
+Bit-identity with the oracle is by construction, not by luck:
+
+- every accumulator lane is **int64** (counts, integer/decimal sums in
+  the exact 32-bit-part encoding of ``seg_sums_batched``, min/max over
+  order-preserving integer ranks), so accumulation order cannot change
+  a single bit — float sums are *not* eligible (their segmented-scan
+  order is part of the oracle's contract);
+- group KEY columns are gathered from the original batch by each
+  group's first-occurrence row index, never reconstructed from hashes;
+- partial-mode group ORDER is not part of the engine contract (the
+  merge/final stage re-groups), so the kernel emitting groups in
+  table-slot order instead of hash-sorted order is invisible
+  downstream — q1/q3 stay bit-identical end to end.
+
+The table lives in the program's value space (``slots`` entries, power
+of two); a batch with more distinct groups than the table holds raises
+the ``overflow`` flag and the exec re-runs it on the oracle
+(``kernelFallbacks.groupbyHash``) — the remaining blocks short-circuit
+the moment overflow is known.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+import numpy as np
+
+from spark_rapids_tpu.sql import expressions as E
+from spark_rapids_tpu.sql import types as T
+
+_I64_MAX = np.int64(2**63 - 1)
+_I64_MIN = np.int64(-(2**63))
+_ROW_BIG = np.int32(2**31 - 1)
+
+# aggregation primitives the kernel implements, by lane op
+_SUM_PRIMS = {E.PRIM_COUNT, E.PRIM_SUM, E.PRIM_SUM_NONNULL}
+_EXTREME_PRIMS = {E.PRIM_MIN, E.PRIM_MAX}
+
+# key/value scalar types whose equality words / min-max ranks are a
+# fixed number of integer lanes (floats stay on the oracle: their
+# NaN-word encodings are float-typed and their sums are order-bound)
+_WORD_KEY_TYPES = (T.BooleanType, T.ByteType, T.ShortType,
+                   T.IntegerType, T.LongType, T.DateType,
+                   T.TimestampType, T.StringType, T.DecimalType)
+_EXTREME_TYPES = (T.BooleanType, T.ByteType, T.ShortType,
+                  T.IntegerType, T.LongType, T.DateType,
+                  T.TimestampType)
+
+
+def _key_type_ok(dt: T.DataType) -> bool:
+    return isinstance(dt, _WORD_KEY_TYPES)
+
+
+def _extreme_type_ok(dt: T.DataType) -> bool:
+    if isinstance(dt, _EXTREME_TYPES):
+        return True
+    return isinstance(dt, T.DecimalType) and dt.precision <= 18
+
+
+def agg_kernel_eligible(mode: str,
+                        grouping: Sequence[E.AttributeReference],
+                        slot_srcs: Sequence[E.Expression],
+                        prims: Sequence[Tuple[str, T.DataType]]) -> bool:
+    """Static shape check (no tracing): can the whole aggregation
+    program run through the hash-table kernel? All-or-nothing — a
+    single ineligible slot keeps the entire program on the oracle, so
+    one program never mixes the two pipelines."""
+    from spark_rapids_tpu.columnar.device import storage_jnp_dtype
+    if mode != "partial" or not grouping:
+        return False
+    for g in grouping:
+        if not _key_type_ok(g.data_type):
+            return False
+    for src, (prim, out_type) in zip(slot_srcs, prims):
+        if prim == E.PRIM_COUNT:
+            continue
+        if prim in (E.PRIM_SUM, E.PRIM_SUM_NONNULL):
+            if T.is_limb_decimal(out_type):
+                continue
+            if jnp.issubdtype(storage_jnp_dtype(out_type),
+                              jnp.floating):
+                return False
+            continue
+        if prim in _EXTREME_PRIMS:
+            if not _extreme_type_ok(out_type):
+                return False
+            continue
+        return False
+    return True
+
+
+def pack_words_i64(words: Sequence[jax.Array]) -> jax.Array:
+    """Equality words (bool / uintN / intN, as grouping_subkeys emits
+    them) -> one ``(cap, K)`` int64 bit-image matrix. Equality on the
+    bit images is exactly equality on the words."""
+    from spark_rapids_tpu.ops.lanes import _as_u64_bits
+    cols = [_as_u64_bits(w).view(jnp.int64) for w in words]
+    return jnp.stack(cols, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# lane planning: (col, prim, out_type) entries -> int64 lanes + decode
+# ---------------------------------------------------------------------------
+
+def plan_lanes(entries, active: jax.Array):
+    """Encode every aggregation slot into int64 lanes, mirroring
+    ``seg_sums_batched``'s exact encodings (32-bit decimal parts with a
+    wraparound high limb) plus rank-encoded min/max lanes. Returns
+    ``(add_lanes, min_lanes, max_lanes, decode)`` where ``decode``
+    rebuilds the slot's device columns from the accumulated tables."""
+    from spark_rapids_tpu.columnar.device import (DeviceColumn as DC,
+                                                  DeviceDecimal128Column,
+                                                  storage_jnp_dtype)
+    from spark_rapids_tpu.ops import int128 as I
+    add_lanes: List[jax.Array] = []
+    min_lanes: List[jax.Array] = []
+    max_lanes: List[jax.Array] = []
+    specs: List[Tuple] = []
+    lane_of: dict = {}
+    m32 = jnp.uint64(0xFFFFFFFF)
+    z64 = jnp.int64(0)
+
+    def _add(arr, tag, a) -> int:
+        key = (id(arr), tag)
+        li = lane_of.get(key)
+        if li is None:
+            li = len(add_lanes)
+            add_lanes.append(a)
+            lane_of[key] = li
+        return li
+
+    for col, prim, out_type in entries:
+        valid = col.validity & active
+        if prim == E.PRIM_COUNT:
+            specs.append(("count",
+                          _add(col.validity, "valid",
+                               valid.astype(jnp.int64))))
+            continue
+        if prim in _EXTREME_PRIMS:
+            is_min = prim == E.PRIM_MIN
+            dt = col.data.dtype
+            enc = col.data.astype(jnp.int64)
+            sent = jnp.int64(_I64_MAX if is_min else _I64_MIN)
+            lane = jnp.where(valid, enc, sent)
+            has = _add(col.validity, "valid", valid.astype(jnp.int64))
+            if is_min:
+                specs.append(("min", len(min_lanes), has, out_type, dt))
+                min_lanes.append(lane)
+            else:
+                specs.append(("max", len(max_lanes), has, out_type, dt))
+                max_lanes.append(lane)
+            continue
+        nwe = prim == E.PRIM_SUM  # null_when_empty
+        has_lane = _add(col.validity, "valid",
+                        valid.astype(jnp.int64)) if nwe else None
+        if T.is_limb_decimal(out_type):
+            if isinstance(col, DeviceDecimal128Column):
+                hi, lo = col.hi, col.lo
+            else:
+                hi, lo = I.from_i64(jnp, col.data.astype(jnp.int64))
+            hi = jnp.where(valid, hi, z64)
+            lo = jnp.where(valid, lo, z64)
+            ulo = lo.view(jnp.uint64)
+            l0 = _add(col, "dec0", (ulo & m32).astype(jnp.int64))
+            l1 = _add(col, "dec1",
+                      (ulo >> jnp.uint64(32)).astype(jnp.int64))
+            lh = _add(col, "dechi", hi)  # wraparound == mod-2^128 high
+            specs.append(("dec", (l0, l1, lh), has_lane, out_type))
+        else:
+            specs.append(("int",
+                          _add(col, "ival",
+                               jnp.where(valid,
+                                         col.data.astype(jnp.int64),
+                                         z64)),
+                          has_lane, out_type))
+
+    def decode(add_out, min_out, max_out, used):
+        from spark_rapids_tpu.columnar.device import storage_jnp_dtype
+        outs = []
+        for spec in specs:
+            if spec[0] == "count":
+                run = add_out[:, spec[1]]
+                outs.append(DC(T.LongT, jnp.where(used, run, z64), used))
+                continue
+            if spec[0] in ("min", "max"):
+                _k, li, has, out_type, dt = spec
+                lane = (min_out if spec[0] == "min" else max_out)[:, li]
+                validity = used & (add_out[:, has] > 0)
+                data = jnp.where(validity, lane, z64).astype(dt)
+                outs.append(DC(out_type, data, validity))
+                continue
+            kind, lane, has_lane, out_type = spec
+            validity = used
+            if has_lane is not None:
+                validity = validity & (add_out[:, has_lane] > 0)
+            if kind == "dec":
+                l0, l1, lh = lane
+                s0, s1 = add_out[:, l0], add_out[:, l1]
+                shi = add_out[:, lh]
+                rhi, rlo = I.from_i64(jnp, s0)
+                h1, lo1 = I.mul_i64(jnp, s1, jnp.full_like(s1, 1 << 32))
+                rhi, rlo = I.add(jnp, rhi, rlo, h1, lo1)
+                rhi = rhi + shi
+                ok = I.fits_precision(jnp, rhi, rlo, out_type.precision)
+                validity = validity & ok
+                rhi = jnp.where(validity, rhi, z64)
+                rlo = jnp.where(validity, rlo, z64)
+                outs.append(DeviceDecimal128Column(out_type, rhi, rlo,
+                                                   validity))
+            else:
+                run = add_out[:, lane]
+                acc = storage_jnp_dtype(out_type)
+                outs.append(DC(out_type,
+                               jnp.where(validity, run.astype(acc),
+                                         jnp.zeros((), acc)), validity))
+        return outs
+
+    return add_lanes, min_lanes, max_lanes, decode
+
+
+# ---------------------------------------------------------------------------
+# the kernel
+# ---------------------------------------------------------------------------
+
+def _block_rows(cap: int) -> int:
+    """Largest power-of-two block <= 4096 that divides the capacity
+    (batch capacities are {1,1.25,1.5,1.75} x 2^k buckets, so this is
+    at least cap/7 and usually 4096)."""
+    return min(4096, cap & -cap)
+
+
+# probe-loop bound per block: a row unresolved after this many steps
+# (pathological clustering or a full table) overflows to the oracle
+_MAX_PROBES = 64
+
+
+def insert_step(kw, rows, slot, done, tbl_kw, tbl_used, tbl_row,
+                T_: int, K: int):
+    """ONE lockstep open-addressing insert iteration — the
+    concurrency-critical core shared by this kernel's group-by loop
+    and the join build loop (kernels/join_probe.py): probe the current
+    slot, claim empties with deterministic min-row-id winners (losers
+    land on the dead row ``T_``), then RE-match so a row whose key was
+    claimed by another row this very step resolves here instead of
+    inserting a duplicate group at the next free slot. Returns
+    ``(hit, tbl_kw, tbl_used, tbl_row)``; callers advance ``slot`` for
+    ``~(done | hit)`` rows."""
+    tk = jnp.take(tbl_kw, slot, axis=0)
+    used = jnp.take(tbl_used, slot)
+    match = used
+    for w in range(K):
+        match = match & (tk[:, w] == kw[:, w])
+    want = (~done) & (~used)
+    claim = jnp.full((T_ + 1,), _ROW_BIG, jnp.int32).at[
+        jnp.where(want, slot, T_)].min(rows)
+    won = want & (jnp.take(claim, slot) == rows)
+    idx = jnp.where(won, slot, T_)
+    tbl_kw = tbl_kw.at[idx].set(kw)
+    tbl_row = tbl_row.at[idx].set(rows)
+    tbl_used = tbl_used.at[idx].set(True)
+    tk2 = jnp.take(tbl_kw, slot, axis=0)
+    match2 = jnp.take(tbl_used, slot)
+    for w in range(K):
+        match2 = match2 & (tk2[:, w] == kw[:, w])
+    hit = (~done) & (match | won | match2)
+    return hit, tbl_kw, tbl_used, tbl_row
+
+
+def _build_kernel(cap: int, K: int, n_add: int, n_min: int, n_max: int,
+                  slots: int, interpret: bool) -> Callable:
+    """The pallas_call wrapper: (kw, h, valid, add?, min?, max?) ->
+    (tbl_row, used, add_out?, min_out?, max_out?, overflow). Traced
+    into the caller's jitted program (built only inside JitCache
+    builders — the compile-discipline lint holds for kernels too)."""
+    from jax.experimental import pallas as pl
+    RB = _block_rows(cap)
+    T_ = slots
+
+    def kern(*refs):
+        kw_ref, h_ref, valid_ref = refs[:3]
+        off_in = 3
+        add_ref = mnr = mxr = None
+        if n_add:
+            add_ref = refs[off_in]
+            off_in += 1
+        if n_min:
+            mnr = refs[off_in]
+            off_in += 1
+        if n_max:
+            mxr = refs[off_in]
+            off_in += 1
+        outs = refs[off_in:]
+        row_ref, used_ref = outs[:2]
+        off_out = 2
+        add_out_ref = mno = mxo = None
+        if n_add:
+            add_out_ref = outs[off_out]
+            off_out += 1
+        if n_min:
+            mno = outs[off_out]
+            off_out += 1
+        if n_max:
+            mxo = outs[off_out]
+            off_out += 1
+        ovf_ref = outs[off_out]
+
+        def block(b, carry):
+            (tbl_kw, tbl_used, tbl_row, tbl_add, tbl_min, tbl_max,
+             ovf) = carry
+            off = b * RB
+            kw = kw_ref[pl.ds(off, RB), :]
+            h = h_ref[pl.ds(off, RB)]
+            valid = valid_ref[pl.ds(off, RB)]
+            rows = off + jax.lax.broadcasted_iota(
+                jnp.int32, (RB, 1), 0)[:, 0]
+            slot0 = (h & (T_ - 1)).astype(jnp.int32)
+
+            def probe_cond(st):
+                _s, done, _f, _tk, _tu, _tr, it = st
+                return jnp.any(~done) & (it < _MAX_PROBES)
+
+            def probe_body(st):
+                slot, done, fslot, tbl_kw, tbl_used, tbl_row, it = st
+                hit, tbl_kw, tbl_used, tbl_row = insert_step(
+                    kw, rows, slot, done, tbl_kw, tbl_used, tbl_row,
+                    T_, K)
+                fslot = jnp.where(hit, slot, fslot)
+                done = done | hit
+                slot = jnp.where(done, slot, (slot + 1) & (T_ - 1))
+                return slot, done, fslot, tbl_kw, tbl_used, tbl_row, \
+                    it + 1
+
+            (_slot, done, fslot, tbl_kw, tbl_used, tbl_row,
+             _it) = jax.lax.while_loop(
+                 probe_cond, probe_body,
+                 (slot0, ~valid, jnp.zeros_like(slot0),
+                  tbl_kw, tbl_used, tbl_row, jnp.int32(0)))
+            ovf = ovf | jnp.any(valid & ~done)
+            contrib = valid & done
+            idx = jnp.where(contrib, fslot, T_)
+            if n_add:
+                tbl_add = tbl_add.at[idx].add(
+                    add_ref[pl.ds(off, RB), :])
+            if n_min:
+                tbl_min = tbl_min.at[idx].min(
+                    mnr[pl.ds(off, RB), :])
+            if n_max:
+                tbl_max = tbl_max.at[idx].max(
+                    mxr[pl.ds(off, RB), :])
+            return (tbl_kw, tbl_used, tbl_row, tbl_add, tbl_min,
+                    tbl_max, ovf)
+
+        def body(b, carry):
+            # an overflowed batch re-runs whole on the oracle: skip the
+            # remaining blocks instead of thrashing the full table
+            return jax.lax.cond(carry[6], lambda c: c,
+                                lambda c: block(b, c), carry)
+
+        init = (jnp.zeros((T_ + 1, K), jnp.int64),
+                jnp.zeros((T_ + 1,), jnp.bool_),
+                jnp.zeros((T_ + 1,), jnp.int32),
+                jnp.zeros((T_ + 1, n_add), jnp.int64),
+                jnp.full((T_ + 1, n_min), _I64_MAX, jnp.int64),
+                jnp.full((T_ + 1, n_max), _I64_MIN, jnp.int64),
+                jnp.zeros((), jnp.bool_))
+        (tbl_kw, tbl_used, tbl_row, tbl_add, tbl_min, tbl_max,
+         ovf) = jax.lax.fori_loop(0, cap // RB, body, init)
+        row_ref[...] = tbl_row[:T_]
+        used_ref[...] = tbl_used[:T_]
+        if n_add:
+            add_out_ref[...] = tbl_add[:T_]
+        if n_min:
+            mno[...] = tbl_min[:T_]
+        if n_max:
+            mxo[...] = tbl_max[:T_]
+        ovf_ref[...] = ovf.reshape(1)
+
+    out_shape = [jax.ShapeDtypeStruct((T_,), jnp.int32),
+                 jax.ShapeDtypeStruct((T_,), jnp.bool_)]
+    if n_add:
+        out_shape.append(jax.ShapeDtypeStruct((T_, n_add), jnp.int64))
+    if n_min:
+        out_shape.append(jax.ShapeDtypeStruct((T_, n_min), jnp.int64))
+    if n_max:
+        out_shape.append(jax.ShapeDtypeStruct((T_, n_max), jnp.int64))
+    out_shape.append(jax.ShapeDtypeStruct((1,), jnp.bool_))
+    return pl.pallas_call(kern, out_shape=tuple(out_shape),
+                          interpret=interpret)
+
+
+def hash_groupby(key_cols, entries, active: jax.Array, slots: int,
+                 has_nans: Optional[bool] = None):
+    """Traced single-pass group-by: ``(key_out, buffers, used, cnt,
+    overflow)``, all capacity ``slots``. ``entries`` are ``(col, prim,
+    out_type)`` like ``seg_sums_batched``; callers pre-check
+    ``agg_kernel_eligible``. Output groups sit in table-slot order
+    (compacted by the caller); the key columns are gathered from the
+    batch by first-occurrence row, so values round-trip untouched."""
+    from spark_rapids_tpu import kernels as KR
+    from spark_rapids_tpu.columnar.device import take_columns
+    from spark_rapids_tpu.ops import groupby as G
+    cap = active.shape[0]
+    subkeys: List[jax.Array] = []
+    for c in key_cols:
+        subkeys.extend(G.grouping_subkeys(c, has_nans))
+    kw = pack_words_i64(subkeys)
+    h = G.hash_subkey_words(subkeys).view(jnp.int64)
+    add_lanes, min_lanes, max_lanes, decode = plan_lanes(entries, active)
+    call = _build_kernel(cap, kw.shape[1], len(add_lanes),
+                         len(min_lanes), len(max_lanes), slots,
+                         KR.interpret())
+    args = [kw, h, active]
+    for lanes in (add_lanes, min_lanes, max_lanes):
+        if lanes:
+            args.append(lanes[0][:, None] if len(lanes) == 1
+                        else jnp.stack(lanes, axis=1))
+    outs = list(call(*args))
+    tbl_row, used = outs[0], outs[1]
+    oi = 2
+    add_out = min_out = max_out = None
+    if add_lanes:
+        add_out = outs[oi]
+        oi += 1
+    if min_lanes:
+        min_out = outs[oi]
+        oi += 1
+    if max_lanes:
+        max_out = outs[oi]
+        oi += 1
+    overflow = outs[oi][0]
+    key_out = take_columns(key_cols,
+                           jnp.clip(tbl_row, 0, cap - 1).astype(
+                               jnp.int32),
+                           valid_at=used)
+    buffers = decode(add_out, min_out, max_out, used)
+    cnt = jnp.sum(used)
+    return key_out, buffers, used, cnt, overflow

@@ -1,0 +1,142 @@
+"""Join gather-map build/probe kernel (Pallas).
+
+``ops/join.py`` derives per-row match information by sorting the
+COMBINED key set of both sides (the no-scatter XLA design). When the
+build side is small — broadcast dimension tables, the star-schema /
+FK shape — an actual hash table is cheaper: one build pass inserts the
+right side's keys (first-occurrence row per key, exactly the row the
+oracle's key-sorted ``order_r[base]`` yields), one probe pass resolves
+every left row. That covers the two join forms whose *results* need no
+pair expansion:
+
+- **semi/anti masks**: ``matched`` per left row is the whole answer;
+- **FK fast path** (build keys certified unique by
+  ``build_key_max_multiplicity``): ``(matched, first_row)`` reproduces
+  ``_build_fast_gather_fn``'s gather inputs with NO count program and
+  no sizing sync.
+
+The table is sized at twice the build capacity (load factor <= 0.5),
+so linear-probe chains always terminate at an empty slot within the
+table size — overflow is impossible by construction, and general
+expanding joins simply stay on the sort-based oracle.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def probe_table_slots(cap_r: int) -> int:
+    """Power-of-two table capacity >= 2 * build capacity."""
+    t = 64
+    while t < 2 * cap_r:
+        t <<= 1
+    return t
+
+
+def build_probe(kw_r: jax.Array, h_r: jax.Array, valid_r: jax.Array,
+                kw_l: jax.Array, h_l: jax.Array, valid_l: jax.Array
+                ) -> Tuple[jax.Array, jax.Array]:
+    """Traced build+probe: returns ``(matched, first_row)`` per LEFT
+    row — ``matched`` only for valid left rows whose key has at least
+    one valid right row; ``first_row`` is the smallest-index matching
+    right row (0 where unmatched; gate gathers on ``matched``).
+    ``kw_*`` are (cap, K) int64 equality-word matrices built with the
+    SAME word layout on both sides (pad string char caps first)."""
+    from jax.experimental import pallas as pl
+
+    from spark_rapids_tpu import kernels as KR
+    from spark_rapids_tpu.kernels.groupby_hash import (_block_rows,
+                                                       insert_step)
+    cap_r = valid_r.shape[0]
+    cap_l = valid_l.shape[0]
+    K = kw_r.shape[1]
+    T_ = probe_table_slots(cap_r)
+    RBR = _block_rows(cap_r)
+    RBL = _block_rows(cap_l)
+
+    def kern(kwr_ref, hr_ref, vr_ref, kwl_ref, hl_ref, vl_ref,
+             m_ref, ri_ref):
+
+        def build_block(b, carry):
+            tbl_kw, tbl_used, tbl_row = carry
+            off = b * RBR
+            kw = kwr_ref[pl.ds(off, RBR), :]
+            h = hr_ref[pl.ds(off, RBR)]
+            valid = vr_ref[pl.ds(off, RBR)]
+            rows = off + jax.lax.broadcasted_iota(
+                jnp.int32, (RBR, 1), 0)[:, 0]
+            slot0 = (h & (T_ - 1)).astype(jnp.int32)
+
+            def cond(st):
+                _s, done, _tk, _tu, _tr, it = st
+                return jnp.any(~done) & (it <= T_)
+
+            def body(st):
+                slot, done, tbl_kw, tbl_used, tbl_row, it = st
+                hit, tbl_kw, tbl_used, tbl_row = insert_step(
+                    kw, rows, slot, done, tbl_kw, tbl_used, tbl_row,
+                    T_, K)
+                done = done | hit
+                slot = jnp.where(done, slot, (slot + 1) & (T_ - 1))
+                return slot, done, tbl_kw, tbl_used, tbl_row, it + 1
+
+            (_s, _done, tbl_kw, tbl_used, tbl_row,
+             _it) = jax.lax.while_loop(
+                 cond, body, (slot0, ~valid, tbl_kw, tbl_used,
+                              tbl_row, jnp.int32(0)))
+            return tbl_kw, tbl_used, tbl_row
+
+        tbl_kw, tbl_used, tbl_row = jax.lax.fori_loop(
+            0, cap_r // RBR, build_block,
+            (jnp.zeros((T_ + 1, K), jnp.int64),
+             jnp.zeros((T_ + 1,), jnp.bool_),
+             jnp.zeros((T_ + 1,), jnp.int32)))
+
+        def probe_block(b, _):
+            off = b * RBL
+            kw = kwl_ref[pl.ds(off, RBL), :]
+            h = hl_ref[pl.ds(off, RBL)]
+            valid = vl_ref[pl.ds(off, RBL)]
+            slot0 = (h & (T_ - 1)).astype(jnp.int32)
+
+            def cond(st):
+                _s, done, _m, _r, it = st
+                return jnp.any(~done) & (it <= T_)
+
+            def body(st):
+                slot, done, matched, ri, it = st
+                tk = jnp.take(tbl_kw, slot, axis=0)
+                used = jnp.take(tbl_used, slot)
+                match = used
+                for w in range(K):
+                    match = match & (tk[:, w] == kw[:, w])
+                # open-addressing invariant: the first EMPTY slot on
+                # the probe path proves the key is absent
+                miss = ~used
+                hitnow = (~done) & match
+                matched = matched | hitnow
+                ri = jnp.where(hitnow, jnp.take(tbl_row, slot), ri)
+                done = done | match | miss
+                slot = jnp.where(done, slot, (slot + 1) & (T_ - 1))
+                return slot, done, matched, ri, it + 1
+
+            (_s, _done, matched, ri, _it) = jax.lax.while_loop(
+                cond, body,
+                (slot0, ~valid, jnp.zeros((RBL,), jnp.bool_),
+                 jnp.zeros((RBL,), jnp.int32), jnp.int32(0)))
+            m_ref[pl.ds(off, RBL)] = matched
+            ri_ref[pl.ds(off, RBL)] = ri
+            return 0
+
+        jax.lax.fori_loop(0, cap_l // RBL, probe_block, 0)
+
+    call = pl.pallas_call(
+        kern,
+        out_shape=(jax.ShapeDtypeStruct((cap_l,), jnp.bool_),
+                   jax.ShapeDtypeStruct((cap_l,), jnp.int32)),
+        interpret=KR.interpret())
+    return call(kw_r, h_r, valid_r, kw_l, h_l, valid_l)

@@ -1,0 +1,118 @@
+"""Fused Murmur3 partition-hashing kernel (Pallas).
+
+``ops/hashing.py`` composes Spark's Murmur3_x86_32 from stock XLA ops —
+a per-column chain of rotl/fmix steps the compiler is free to split
+across fusions. This kernel folds ALL key columns of a row block in one
+pass over VMEM-resident data. Bit-identity is structural: the kernel
+body calls the very same ``hash_int``/``hash_long``/``hash_bytes``
+functions from ``ops/hashing.py`` on the block slices, so there is no
+second implementation to drift (the host twin in
+``columnar/murmur3.py`` stays the pinned oracle for both).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.sql import types as T
+
+# column types the kernel hashes; structs/decimal128 keep the oracle
+# composition (struct folding needs per-field seed snapshots)
+_KERNEL_HASH_TYPES = (T.BooleanType, T.ByteType, T.ShortType,
+                      T.IntegerType, T.DateType, T.LongType,
+                      T.TimestampType, T.FloatType, T.DoubleType,
+                      T.StringType)
+
+
+def hash_kernel_eligible(dtypes: Sequence[T.DataType]) -> bool:
+    for dt in dtypes:
+        if isinstance(dt, T.DecimalType):
+            if dt.precision > 18:
+                return False
+            continue
+        if not isinstance(dt, _KERNEL_HASH_TYPES):
+            return False
+    return True
+
+
+def _col_desc(col) -> Tuple[str, tuple]:
+    """(kind, arrays) for one evaluated device column, mirroring the
+    dispatch in ops/hashing.hash_device_column."""
+    from spark_rapids_tpu.columnar.device import DeviceStringColumn
+    dt = col.dtype
+    if isinstance(col, DeviceStringColumn):
+        return "bytes", (col.chars, col.lengths, col.validity)
+    if isinstance(dt, (T.BooleanType, T.ByteType, T.ShortType,
+                       T.IntegerType, T.DateType)):
+        return "int", (col.data.astype(jnp.int32), col.validity)
+    if isinstance(dt, (T.LongType, T.TimestampType)):
+        return "long", (col.data.astype(jnp.int64), col.validity)
+    if isinstance(dt, T.FloatType):
+        return "float", (col.data, col.validity)
+    if isinstance(dt, T.DoubleType):
+        return "double", (col.data, col.validity)
+    if isinstance(dt, T.DecimalType) and dt.precision <= 18:
+        return "long", (col.data.astype(jnp.int64), col.validity)
+    raise TypeError(f"murmur3 kernel cannot hash {dt}")
+
+
+def murmur3_columns_kernel(cols, capacity: int, seed: int = 42
+                           ) -> jax.Array:
+    """Traced kernel twin of ``ops.hashing.murmur3_columns``: fold the
+    columns left-to-right inside ONE pallas program over row blocks.
+    Callers pre-check :func:`hash_kernel_eligible`."""
+    from jax.experimental import pallas as pl
+
+    from spark_rapids_tpu import kernels as KR
+    from spark_rapids_tpu.kernels.groupby_hash import _block_rows
+    from spark_rapids_tpu.ops import hashing as H
+    descs: List[Tuple[str, tuple]] = [_col_desc(c) for c in cols]
+    kinds = tuple(d[0] for d in descs)
+    flat: List[jax.Array] = []
+    arity: List[int] = []
+    for _k, arrs in descs:
+        flat.extend(arrs)
+        arity.append(len(arrs))
+    RB = _block_rows(capacity)
+
+    def kern(*refs):
+        ins = refs[:-1]
+        out_ref = refs[-1]
+
+        def block(b, _):
+            off = b * RB
+            h = jnp.full((RB,), seed, dtype=jnp.int32)
+            pos = 0
+            for kind, k in zip(kinds, arity):
+                cr = ins[pos:pos + k]
+                pos += k
+                if kind == "bytes":
+                    chars = cr[0][pl.ds(off, RB), :]
+                    lengths = cr[1][pl.ds(off, RB)]
+                    valid = cr[2][pl.ds(off, RB)]
+                    hv = H.hash_bytes(chars, lengths, h)
+                else:
+                    data = cr[0][pl.ds(off, RB)]
+                    valid = cr[1][pl.ds(off, RB)]
+                    if kind == "int":
+                        hv = H.hash_int(data, h)
+                    elif kind == "long":
+                        hv = H.hash_long(data, h)
+                    elif kind == "float":
+                        hv = H.hash_float(data, h)
+                    else:
+                        hv = H.hash_double(data, h)
+                h = jnp.where(valid, hv, h)
+            out_ref[pl.ds(off, RB)] = h
+            return 0
+
+        jax.lax.fori_loop(0, capacity // RB, block, 0)
+
+    call = pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((capacity,), jnp.int32),
+        interpret=KR.interpret())
+    return call(*flat)

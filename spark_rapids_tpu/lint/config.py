@@ -69,6 +69,9 @@ class LintConfig:
 
     # -- jit discipline ----------------------------------------------------
     jit_home: str = "spark_rapids_tpu/jit_cache.py"
+    # the Pallas kernel registry package: pallas_call is sanctioned
+    # here (its builders only run inside JitCache-routed programs)
+    kernels_home: str = "spark_rapids_tpu/kernels"
 
     # -- concurrency -------------------------------------------------------
     concurrency_scope: Tuple[str, ...] = (
@@ -101,8 +104,8 @@ def load_config(root: str) -> LintConfig:
         return cfg
     with open(path, "r", encoding="utf-8") as f:
         data = json.load(f)
-    for key in ("check_docs", "baseline", "jit_home", "metrics_rel",
-                "trace_rel"):
+    for key in ("check_docs", "baseline", "jit_home", "kernels_home",
+                "metrics_rel", "trace_rel"):
         if key in data:
             setattr(cfg, key, data[key])
     for key in ("scan_roots", "retry_scope", "retry_wrappers",

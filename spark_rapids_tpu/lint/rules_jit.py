@@ -8,6 +8,13 @@ transitively over the package call graph, across modules via imports:
 ``_STAGE_CACHE.put(key, X.build_stage_fn(...))`` makes
 ``ops/exprs.py::build_stage_fn`` a builder).
 
+``pl.pallas_call`` is treated exactly like ``jax.jit`` (a Pallas
+kernel pins a compiled program the same way): it must be built inside
+the kernels/ registry package (``kernels_home``) — whose builders are
+only ever invoked from JitCache-routed programs — or inside a
+``JitCache`` builder closure, with reasoned suppressions for anything
+else (the capability probes).
+
 ``jit-module-cache``: a module-level dict used as a compile cache
 (``_FOO_CACHE = {}``) bypasses the LRU bound and the single-flight
 build path — compiled programs pin XLA executables, so unbounded dicts
@@ -25,6 +32,12 @@ from spark_rapids_tpu.lint.engine import Finding, rule
 
 def _is_jax_jit(fctx: A.FileCtx, call: ast.Call) -> bool:
     return A.resolve_path(fctx, call.func) == "jax.jit"
+
+
+def _is_pallas_call(fctx: A.FileCtx, call: ast.Call) -> bool:
+    p = A.resolve_path(fctx, call.func)
+    return p is not None and (p == "pallas_call"
+                              or p.endswith(".pallas_call"))
 
 
 def _jitcache_names(fctx: A.FileCtx) -> Set[str]:
@@ -118,31 +131,44 @@ def _builder_closure(pctx) -> Dict[str, Set[int]]:
 
 
 @rule("jit-direct",
-      "jax.jit calls must be routed through the bounded single-flight "
-      "JitCache (jit_cache.py)")
+      "jax.jit / pl.pallas_call must be routed through the bounded "
+      "single-flight JitCache (jit_cache.py) or, for pallas, built "
+      "inside the kernels/ registry package")
 def check_jit_direct(pctx):
     cfg = pctx.config
+    kernels_home = getattr(cfg, "kernels_home",
+                           "spark_rapids_tpu/kernels")
     builders = _builder_closure(pctx)
     for fctx in pctx.files:
         if fctx.rel == cfg.jit_home:
             continue
+        in_kernels = fctx.rel.startswith(kernels_home.rstrip("/") + "/")
         file_builders = builders.get(fctx.rel, set())
         for call in A.walk_calls(fctx.tree):
-            if not _is_jax_jit(fctx, call):
+            is_jit = _is_jax_jit(fctx, call)
+            is_pallas = not is_jit and _is_pallas_call(fctx, call)
+            if not (is_jit or is_pallas):
+                continue
+            if is_pallas and in_kernels:
+                # the kernels/ registry IS the sanctioned home: its
+                # builders only run inside JitCache-routed programs
                 continue
             # inside a builder function/lambda or a .put value expr?
             ok = any(id(a) in file_builders
                      for a in [call] + list(A.ancestors(call)))
             if ok:
                 continue
+            what = "pl.pallas_call" if is_pallas else "jax.jit"
             yield Finding(
                 "jit-direct", fctx.rel, call.lineno,
                 call.col_offset + 1,
-                "direct jax.jit outside the JitCache path — compile "
+                f"direct {what} outside the JitCache path — compile "
                 "via a bounded JitCache (get_or_build or "
-                "cache.put(key, jax.jit(fn))), or suppress with a "
-                "reason if the program is fixed and bounded by "
-                "construction")
+                "cache.put(key, jax.jit(fn)))"
+                + (", or move the kernel into the kernels/ registry "
+                   "package" if is_pallas else "")
+                + ", or suppress with a reason if the program is "
+                "fixed and bounded by construction")
 
 
 _DICTISH = ("dict", "OrderedDict", "defaultdict")

@@ -144,6 +144,11 @@ METRIC_PREFIX_DESCRIPTIONS: Dict[str, str] = {
     "dispatchCount.chip": "device programs dispatched on chip <N>",
     "meshScanUnits.chip": "scan units assigned to chip <N>'s stream",
     "deviceDecodedValues.": "values decoded on device per encoding",
+    "kernelDispatchCount.": "device programs dispatched through the "
+                            "named Pallas kernel (docs/kernels.md)",
+    "kernelFallbacks.": "kernel-path calls that fell back to the "
+                        "XLA-op oracle composition (lowering/compile "
+                        "failure or hash-table overflow)",
     "hostDecodedValues.": "values host-decoded (fallback columns) per "
                           "encoding",
 }

@@ -15,15 +15,17 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+import numpy as np
+
 from spark_rapids_tpu.sql import types as T
 
-_C1 = jnp.uint32(0xCC9E2D51)
-_C2 = jnp.uint32(0x1B873593)
-_M5 = jnp.uint32(0xE6546B64)
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
+_M5 = np.uint32(0xE6546B64)
 
 
 def _rotl(x: jax.Array, r: int) -> jax.Array:
-    return (x << jnp.uint32(r)) | (x >> jnp.uint32(32 - r))
+    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
 
 
 def _mix_k1(k1: jax.Array) -> jax.Array:
@@ -35,16 +37,16 @@ def _mix_k1(k1: jax.Array) -> jax.Array:
 def _mix_h1(h1: jax.Array, k1: jax.Array) -> jax.Array:
     h1 = h1 ^ k1
     h1 = _rotl(h1, 13)
-    return h1 * jnp.uint32(5) + _M5
+    return h1 * np.uint32(5) + _M5
 
 
 def _fmix(h1: jax.Array, length: jax.Array) -> jax.Array:
     h1 = h1 ^ length.astype(jnp.uint32)
-    h1 = h1 ^ (h1 >> jnp.uint32(16))
-    h1 = h1 * jnp.uint32(0x85EBCA6B)
-    h1 = h1 ^ (h1 >> jnp.uint32(13))
-    h1 = h1 * jnp.uint32(0xC2B2AE35)
-    h1 = h1 ^ (h1 >> jnp.uint32(16))
+    h1 = h1 ^ (h1 >> np.uint32(16))
+    h1 = h1 * np.uint32(0x85EBCA6B)
+    h1 = h1 ^ (h1 >> np.uint32(13))
+    h1 = h1 * np.uint32(0xC2B2AE35)
+    h1 = h1 ^ (h1 >> np.uint32(16))
     return h1
 
 
@@ -52,23 +54,23 @@ def hash_int(values: jax.Array, seed: jax.Array) -> jax.Array:
     """hashInt: one 4-byte round + fmix(4). Returns int32."""
     k1 = _mix_k1(values.astype(jnp.int32).view(jnp.uint32))
     h1 = _mix_h1(seed.astype(jnp.int32).view(jnp.uint32), k1)
-    return _fmix(h1, jnp.uint32(4)).view(jnp.int32)
+    return _fmix(h1, np.uint32(4)).view(jnp.int32)
 
 
 def hash_long(values: jax.Array, seed: jax.Array) -> jax.Array:
     """hashLong: low int32 word then high, + fmix(8)."""
     v = values.astype(jnp.int64).view(jnp.uint64)
-    low = (v & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
-    high = (v >> jnp.uint64(32)).astype(jnp.uint32)
+    low = (v & np.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    high = (v >> np.uint64(32)).astype(jnp.uint32)
     h1 = seed.astype(jnp.int32).view(jnp.uint32)
     h1 = _mix_h1(h1, _mix_k1(low))
     h1 = _mix_h1(h1, _mix_k1(high))
-    return _fmix(h1, jnp.uint32(8)).view(jnp.int32)
+    return _fmix(h1, np.uint32(8)).view(jnp.int32)
 
 
 def hash_float(values: jax.Array, seed: jax.Array) -> jax.Array:
     v = values.astype(jnp.float32)
-    v = jnp.where(v == jnp.float32(0.0), jnp.float32(0.0), v)  # fold -0.0
+    v = jnp.where(v == np.float32(0.0), np.float32(0.0), v)  # fold -0.0
     return hash_int(v.view(jnp.int32), seed)
 
 
@@ -298,15 +300,24 @@ def xxhash64_columns(cols, capacity: int, seed: int = 42) -> jax.Array:
 
 
 def traced_partition_ids(exprs, cols, active, lit_vals,
-                         n_parts: int) -> jax.Array:
+                         n_parts: int,
+                         use_kernel: bool = False) -> jax.Array:
     """Inside a traced program: pmod(murmur3(keys, 42), n) per row — the
     single definition of Spark HashPartitioning placement, shared by the
     in-process exchange and the ICI shard_map exchange so the two paths
     can never diverge. ``lit_vals`` must be passed as traced inputs (the
-    compile caches key on expression *structure*, not literal values)."""
+    compile caches key on expression *structure*, not literal values).
+    ``use_kernel`` swaps the stock-XLA murmur3 chain for the fused
+    Pallas kernel (bit-identical — the kernel body runs this module's
+    own hash functions; docs/kernels.md). Callers must fold the flag
+    into their compile-cache keys."""
     from spark_rapids_tpu.ops import exprs as X
     cap = active.shape[0]
     ctx = X.Ctx(cols, cap, tuple(exprs), lit_vals)
     key_cols = [X.dev_eval(e, ctx) for e in exprs]
-    hv = murmur3_columns(key_cols, cap, 42)
+    if use_kernel:
+        from spark_rapids_tpu.kernels import murmur3 as KM
+        hv = KM.murmur3_columns_kernel(key_cols, cap, 42)
+    else:
+        hv = murmur3_columns(key_cols, cap, 42)
     return jnp.mod(hv.astype(jnp.int64), n_parts).astype(jnp.int32)

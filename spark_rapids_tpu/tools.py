@@ -419,6 +419,58 @@ def format_trace_report(path: str, top: int = 10) -> str:
     return "\n".join(lines)
 
 
+def hotspots_report(paths: List[str], top: int = 20) -> str:
+    """Rank EXCLUSIVE self-time per span name across a whole trace
+    directory (the `tools hotspots` CLI): the picker for the NEXT
+    Pallas kernel target (docs/kernels.md) — a span family's summed
+    self-time across queries is the ceiling on what hand-writing that
+    loop can save. Kernel dispatches are split out per kernel
+    (`kernelDispatch[<name>]`) so kernel vs oracle time is directly
+    attributable."""
+    from spark_rapids_tpu.trace import load_trace
+    agg: Dict[str, Dict[str, float]] = {}
+    window = 0.0
+    for fp in paths:
+        tr = load_trace(fp)
+        spans = tr["spans"]
+        if not spans:
+            continue
+        t0, t1 = _trace_bounds(spans)
+        window += t1 - t0
+
+        def _name(s) -> str:
+            if s["name"] == "kernelDispatch":
+                k = s.get("args", {}).get("kernel")
+                if k:
+                    return f"kernelDispatch[{k}]"
+            return s["name"]
+
+        for name, d in exclusive_times(
+                [dict(s, name=_name(s)) for s in spans]).items():
+            e = agg.setdefault(name, {"count": 0, "total": 0.0,
+                                      "exclusive": 0.0})
+            e["count"] += d["count"]
+            e["total"] += d["total"]
+            e["exclusive"] += d["exclusive"]
+    lines = ["=== TPU Hotspot Report ===",
+             f"{len(paths)} trace file(s), "
+             f"{window / 1e6:.3f}s summed traced window", "",
+             "exclusive self-time per span family (the next kernel "
+             "targets — docs/kernels.md):", ""]
+    if not agg:
+        lines.append("no spans recorded")
+        return "\n".join(lines)
+    ranked = sorted(agg.items(), key=lambda kv: -kv[1]["exclusive"])
+    lines.append(f"  {'span':44s} {'count':>7s} {'total_s':>9s} "
+                 f"{'self_s':>9s} {'self%':>6s}")
+    for name, d in ranked[:top]:
+        pct = d["exclusive"] / window if window else 0.0
+        lines.append(f"  {name:44s} {d['count']:7d} "
+                     f"{d['total'] / 1e6:9.3f} "
+                     f"{d['exclusive'] / 1e6:9.3f} {pct:6.1%}")
+    return "\n".join(lines)
+
+
 def _main(argv: List[str]) -> int:
     import argparse
 
@@ -427,12 +479,14 @@ def _main(argv: List[str]) -> int:
         description="TPU qualification/profiling tools")
     ap.add_argument("command",
                     choices=["qualify", "profile", "docs", "trace",
-                             "serve", "serve-client", "lint"])
+                             "hotspots", "serve", "serve-client",
+                             "lint"])
     ap.add_argument("sql", nargs="?", help="SQL text to analyze (live "
                     "mode; omit when using --log), the trace "
-                    "file/directory for the trace command, or a "
-                    "profile-*.json file/directory for the profile "
-                    "command (spark.rapids.sql.profile.dir output)")
+                    "file/directory for the trace/hotspots commands, "
+                    "or a profile-*.json file/directory for the "
+                    "profile command (spark.rapids.sql.profile.dir "
+                    "output)")
     ap.add_argument("--view", action="append", default=[],
                     help="name=path parquet view registrations")
     ap.add_argument("--log", help="offline mode: event-log file or "
@@ -506,7 +560,7 @@ def _main(argv: List[str]) -> int:
                 return 1
             return 0
 
-    if args.command == "trace":
+    if args.command in ("trace", "hotspots"):
         import os
         path = args.sql or args.log
         if not path:
@@ -521,6 +575,9 @@ def _main(argv: List[str]) -> int:
                 return 1
         else:
             files = [path]
+        if args.command == "hotspots":
+            print(hotspots_report(files, top=args.top))
+            return 0
         for i, fp in enumerate(files):
             if i:
                 print()

@@ -1,0 +1,589 @@
+"""Pallas kernel tier corpus (docs/kernels.md): per-kernel property
+tests against the XLA-op oracle, query-level bit-identity with kernels
+on vs off, the overflow / injected-failure / injected-OOM fallback
+protocols, trace/metric attribution, and the `tools hotspots` picker.
+
+Everything here runs the kernels through ``device_caps.pallas_mode()``
+— interpreter emulation on the CPU tier-1 backend — so every kernel
+path is exercised without hardware. Heavy sweeps are ``slow``."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import device_caps as DC
+from spark_rapids_tpu import kernels as KR
+# module-level jnp constants (ops/groupby._SIGN64 et al.) must exist
+# BEFORE any jit trace in this module: a first import inside a trace
+# would capture them as leaked tracers (production imports these
+# eagerly through exec/agg.py)
+import spark_rapids_tpu.ops.groupby  # noqa: F401
+import spark_rapids_tpu.ops.hashing  # noqa: F401
+import spark_rapids_tpu.ops.int128  # noqa: F401
+import spark_rapids_tpu.ops.lanes  # noqa: F401
+from spark_rapids_tpu.columnar.device import (DeviceColumn,
+                                              DeviceDecimal128Column)
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+from spark_rapids_tpu.kernels import groupby_hash as KG
+from spark_rapids_tpu.kernels import join_probe as KJ
+from spark_rapids_tpu.kernels import murmur3 as KM
+from spark_rapids_tpu.metrics import describe_metric, registry_snapshot
+from spark_rapids_tpu.sql import expressions as E
+from spark_rapids_tpu.sql import types as T
+from spark_rapids_tpu.sql.session import TpuSparkSession
+
+TPU = {"spark.rapids.sql.enabled": "true",
+       "spark.rapids.sql.test.forceDevice": "true"}
+CPU = {"spark.rapids.sql.enabled": "false"}
+DEC = T.DecimalType(15, 2)
+
+
+def _run(conf, views, sql, parts=1):
+    """Run one SQL under ``conf`` with {name: HostBatch} views; returns
+    (pydict, captured plans)."""
+    s = TpuSparkSession(dict(conf))
+    try:
+        for name, hb in views.items():
+            s.createDataFrame(hb, num_partitions=parts) \
+                .createOrReplaceTempView(name)
+        s.start_capture()
+        out = s.sql(sql)._execute().to_pydict()
+        return out, s.get_captured_plans()
+    finally:
+        s.stop()
+
+
+def _kcounters(plans):
+    snap = registry_snapshot(plans)["metrics"]
+    return {k: v for k, v in snap.items() if k.startswith("kernel")}
+
+
+def _groupy_batch(n=6000, ngroups=5, seed=3, null_prob=0.15):
+    rng = np.random.default_rng(seed)
+    keys = np.array([f"k{i}" for i in range(ngroups)],
+                    dtype=object)[rng.integers(0, ngroups, n)]
+    vals = rng.integers(-1000, 1000, n)
+    dec = rng.integers(100, 100000, n)
+    kv = rng.random(n) >= null_prob
+    vv = rng.random(n) >= null_prob
+    return HostBatch(T.StructType([
+        T.StructField("k", T.StringT),
+        T.StructField("v", T.LongT),
+        T.StructField("d", DEC),
+    ]), [HostColumn(T.StringT, keys, kv).normalized(),
+         HostColumn(T.LongT, vals, vv).normalized(),
+         HostColumn.all_valid(dec, DEC)], n)
+
+
+Q_AGG = ("SELECT k, sum(v), count(v), min(v), max(v), sum(d), avg(d), "
+         "count(*) FROM t GROUP BY k ORDER BY k")
+
+
+# ---------------------------------------------------------------------------
+# environment / registry
+# ---------------------------------------------------------------------------
+
+def test_pallas_mode_available():
+    # tier-1 runs on CPU -> interpret; real TPU backends probe native.
+    # Either way the kernel tier must be exercisable here.
+    assert DC.pallas_mode() in ("native", "interpret")
+
+
+def test_kernel_metric_families_described():
+    assert describe_metric("kernelDispatchCount.groupbyHash")
+    assert describe_metric("kernelFallbacks.murmur3")
+
+
+def test_registry_names_have_confs():
+    from spark_rapids_tpu.conf import _REGISTRY
+    for name in KR.KERNELS:
+        key = f"spark.rapids.sql.kernel.{name}.enabled"
+        assert key in _REGISTRY, key
+
+
+# ---------------------------------------------------------------------------
+# groupbyHash kernel: direct property tests vs a numpy oracle
+# ---------------------------------------------------------------------------
+
+def _gb_direct(cap, keys, kvalid, vals, vvalid, active, slots,
+               dec_vals=None):
+    """Run hash_groupby inside jit; return numpy views of the result."""
+    entries_dt = [(E.PRIM_SUM, T.LongT), (E.PRIM_COUNT, T.LongT),
+                  (E.PRIM_MIN, T.LongT), (E.PRIM_MAX, T.LongT)]
+    use_dec = dec_vals is not None
+    out_dec = T.DecimalType(25, 2)
+
+    @jax.jit
+    def run(kd, kv, vd, vv, act, dd):
+        kc = DeviceColumn(T.IntegerT, kd, kv)
+        vc = DeviceColumn(T.LongT, vd, vv)
+        entries = [(vc, p, dt) for p, dt in entries_dt]
+        if use_dec:
+            entries.append((DeviceColumn(DEC, dd, vv), E.PRIM_SUM,
+                            out_dec))
+        key_out, bufs, used, cnt, ovf = KG.hash_groupby(
+            [kc], entries, act, slots)
+        flat = [a for c in key_out for a in c.arrays()]
+        flat += [a for c in bufs for a in c.arrays()]
+        return flat, used, cnt, ovf
+
+    flat, used, cnt, ovf = run(
+        jnp.asarray(keys, jnp.int32), jnp.asarray(kvalid),
+        jnp.asarray(vals, jnp.int64), jnp.asarray(vvalid),
+        jnp.asarray(active),
+        jnp.asarray(dec_vals if use_dec else np.zeros(cap), jnp.int64))
+    return ([np.asarray(a) for a in flat], np.asarray(used),
+            int(np.asarray(cnt)), bool(np.asarray(ovf)))
+
+
+def _gb_numpy_oracle(keys, kvalid, vals, vvalid, active, dec_vals=None):
+    acc = {}
+    for i in range(len(keys)):
+        if not active[i]:
+            continue
+        k = (bool(kvalid[i]), int(keys[i]) if kvalid[i] else 0)
+        e = acc.setdefault(k, {"sum": 0, "cnt": 0, "mn": None,
+                               "mx": None, "dsum": 0, "dcnt": 0})
+        if vvalid[i]:
+            v = int(vals[i])
+            e["sum"] += v
+            e["cnt"] += 1
+            e["mn"] = v if e["mn"] is None else min(e["mn"], v)
+            e["mx"] = v if e["mx"] is None else max(e["mx"], v)
+            if dec_vals is not None:
+                e["dsum"] += int(dec_vals[i])
+                e["dcnt"] += 1
+    return acc
+
+
+@pytest.mark.parametrize("cap,ngroups,null_prob",
+                         [(64, 5, 0.0), (256, 17, 0.3), (96, 9, 0.15)],
+                         ids=["tiny", "nulls", "oddcap"])
+def test_groupby_kernel_vs_numpy_oracle(cap, ngroups, null_prob):
+    rng = np.random.default_rng(cap + ngroups)
+    kvalid = rng.random(cap) >= null_prob
+    # engine invariant: invalid slots hold zeros (mask_col et al.)
+    keys = np.where(kvalid, rng.integers(-3, ngroups, cap), 0)
+    vals = rng.integers(-10**6, 10**6, cap)
+    vvalid = rng.random(cap) >= null_prob
+    active = rng.random(cap) >= 0.1
+    dec = rng.integers(-10**9, 10**9, cap)
+    flat, used, cnt, ovf = _gb_direct(cap, keys, kvalid, vals, vvalid,
+                                      active, 64, dec_vals=dec)
+    assert not ovf
+    exp = _gb_numpy_oracle(keys, kvalid, vals, vvalid, active,
+                           dec_vals=dec)
+    assert cnt == len(exp)
+    # flat layout: key(data, validity), then per entry (data, validity)
+    # x4, then decimal (hi, lo, validity)
+    kd, kv = flat[0], flat[1]
+    got = {}
+    for t in range(len(used)):
+        if not used[t]:
+            continue
+        k = (bool(kv[t]), int(kd[t]) if kv[t] else 0)
+        got[k] = {
+            "sum": int(flat[2][t]) if flat[3][t] else None,
+            "cnt": int(flat[4][t]),
+            "mn": int(flat[6][t]) if flat[7][t] else None,
+            "mx": int(flat[8][t]) if flat[9][t] else None,
+            "dsum": ((int(flat[10][t]) << 64)
+                     | (int(flat[11][t]) & ((1 << 64) - 1)))
+            if flat[12][t] else None,
+        }
+    want = {k: {"sum": e["sum"] if e["cnt"] else None, "cnt": e["cnt"],
+                "mn": e["mn"], "mx": e["mx"],
+                "dsum": e["dsum"] if e["dcnt"] else None}
+            for k, e in exp.items()}
+    assert got == want
+
+
+def test_groupby_kernel_empty_and_single_row():
+    cap = 64
+    zeros = np.zeros(cap, dtype=np.int64)
+    none_active = np.zeros(cap, dtype=bool)
+    flat, used, cnt, ovf = _gb_direct(cap, zeros, zeros > -1, zeros,
+                                      zeros > -1, none_active, 64)
+    assert cnt == 0 and not ovf and not used.any()
+    one = none_active.copy()
+    one[17] = True
+    vals = zeros.copy()
+    vals[17] = -42
+    flat, used, cnt, ovf = _gb_direct(cap, zeros, zeros > -1, vals,
+                                      zeros > -1, one, 64)
+    assert cnt == 1 and not ovf
+    t = int(np.argmax(used))
+    assert int(flat[2][t]) == -42 and int(flat[4][t]) == 1
+
+
+def test_groupby_kernel_overflow_flag():
+    cap = 256
+    keys = np.arange(cap, dtype=np.int64)  # every row its own group
+    valid = np.ones(cap, dtype=bool)
+    _flat, _used, _cnt, ovf = _gb_direct(cap, keys, valid, keys, valid,
+                                         valid, 64)
+    assert ovf  # 256 groups cannot fit a 64-slot table
+
+
+@pytest.mark.slow
+def test_groupby_kernel_property_sweep():
+    """Wide interpret-mode sweep: dtype x null pattern x capacity
+    bucket x group cardinality, every combination against the numpy
+    oracle (slow: dozens of kernel compiles)."""
+    for cap in (64, 96, 160, 512):
+        for ngroups in (1, 3, 50):
+            for null_prob in (0.0, 0.5, 0.95):
+                rng = np.random.default_rng(cap * ngroups + 1)
+                kvalid = rng.random(cap) >= null_prob
+                keys = np.where(kvalid,
+                                rng.integers(-2, ngroups, cap), 0)
+                vals = rng.integers(-10**9, 10**9, cap)
+                vvalid = rng.random(cap) >= null_prob
+                active = rng.random(cap) >= 0.2
+                flat, used, cnt, ovf = _gb_direct(
+                    cap, keys, kvalid, vals, vvalid, active, 128)
+                assert not ovf
+                exp = _gb_numpy_oracle(keys, kvalid, vals, vvalid,
+                                       active)
+                assert cnt == len(exp), (cap, ngroups, null_prob)
+
+
+# ---------------------------------------------------------------------------
+# joinProbe kernel: direct property test
+# ---------------------------------------------------------------------------
+
+def test_join_probe_kernel_vs_numpy_oracle():
+    cap_r, cap_l = 64, 256
+    rng = np.random.default_rng(5)
+    rk = rng.integers(0, 40, cap_r)
+    lk = rng.integers(0, 80, cap_l)
+    vr = rng.random(cap_r) > 0.25
+    vl = rng.random(cap_l) > 0.25
+
+    @jax.jit
+    def run(rk, vr, lk, vl):
+        wr = [rk.astype(jnp.int64).view(jnp.uint64)]
+        wl = [lk.astype(jnp.int64).view(jnp.uint64)]
+        from spark_rapids_tpu.ops.groupby import hash_subkey_words
+        return KJ.build_probe(
+            KG.pack_words_i64(wr),
+            hash_subkey_words(wr).view(jnp.int64), vr,
+            KG.pack_words_i64(wl),
+            hash_subkey_words(wl).view(jnp.int64), vl)
+
+    m, ri = run(jnp.asarray(rk), jnp.asarray(vr), jnp.asarray(lk),
+                jnp.asarray(vl))
+    m, ri = np.asarray(m), np.asarray(ri)
+    for i in range(cap_l):
+        rows = [j for j in range(cap_r) if vr[j] and rk[j] == lk[i]]
+        assert m[i] == bool(vl[i] and rows)
+        if m[i]:
+            # first-occurrence row: the oracle's key-sorted order_r
+            # picks the lowest original index too
+            assert ri[i] == rows[0]
+
+
+# ---------------------------------------------------------------------------
+# murmur3 kernel: oracle + host-twin drift guard (ISSUE 11 satellite)
+# ---------------------------------------------------------------------------
+
+def _hash_battery(n=200, seed=9):
+    """HostBatch covering every kernel-hashable type, with the edge
+    cases the twin-parity guard pins: empty strings, embedded null
+    bytes, and high-bit (negative-as-int8) trailing bytes."""
+    rng = np.random.default_rng(seed)
+    strs = np.empty(n, dtype=object)
+    pool = ["", "a", "ab", "abc", "abcd", "abcde", "\x00", "x\x00y",
+            "\x7f\x00", "éä", "ÿþ", "0123456789abcdef",
+            "tailé"]
+    for i in range(n):
+        strs[i] = pool[rng.integers(0, len(pool))]
+    cols = [
+        ("b", T.BooleanT, rng.integers(0, 2, n).astype(bool)),
+        ("i", T.IntegerT, rng.integers(-2**31, 2**31, n,
+                                       dtype=np.int64).astype(np.int32)),
+        ("l", T.LongT, rng.integers(-2**62, 2**62, n)),
+        ("f", T.FloatT, np.where(rng.random(n) < 0.1, -0.0,
+                                 rng.standard_normal(n)
+                                 ).astype(np.float32)),
+        ("d", T.DoubleT, np.where(rng.random(n) < 0.1, -0.0,
+                                  rng.standard_normal(n))),
+        ("dt", T.DateT, rng.integers(-11000, 47000, n
+                                     ).astype(np.int32)),
+        ("ts", T.TimestampT, rng.integers(-10**15, 10**15, n)),
+        ("dec", DEC, rng.integers(-10**10, 10**10, n)),
+        ("s", T.StringT, strs),
+    ]
+    fields, hcols = [], []
+    for name, dt, vals in cols:
+        valid = rng.random(n) > 0.15
+        fields.append(T.StructField(name, dt))
+        hcols.append(HostColumn(dt, vals, valid).normalized())
+    return HostBatch(T.StructType(fields), hcols, n)
+
+
+def test_murmur3_host_device_twin_parity():
+    """Device murmur3 (ops/hashing.py) vs the host implementation
+    (columnar/murmur3.py via expressions._hash_column), swept over all
+    hashable column types — the pinned oracle the fused kernel lands
+    against."""
+    from spark_rapids_tpu.columnar.device import DeviceBatch
+    from spark_rapids_tpu.ops import hashing as H
+    from spark_rapids_tpu.sql.expressions import _hash_column
+    hb = _hash_battery()
+    n = hb.num_rows
+    host = np.full(n, 42, dtype=np.int32)
+    for c in hb.columns:
+        host = _hash_column(c, host)
+    db = DeviceBatch.from_host(hb)  # capacity-bucketed: compare prefix
+    dev = np.asarray(jax.jit(
+        lambda: H.murmur3_columns(db.columns, db.capacity, 42))())
+    assert np.array_equal(host, dev[:n])
+
+
+def test_murmur3_kernel_matches_oracle_composition():
+    from spark_rapids_tpu.columnar.device import DeviceBatch
+    from spark_rapids_tpu.ops import hashing as H
+    hb = _hash_battery(seed=10)
+    db = DeviceBatch.from_host(hb)
+    cap = db.capacity
+    assert KM.hash_kernel_eligible([f.data_type
+                                    for f in hb.schema.fields])
+    want = np.asarray(jax.jit(
+        lambda: H.murmur3_columns(db.columns, cap, 42))())
+    got = np.asarray(jax.jit(
+        lambda: KM.murmur3_columns_kernel(db.columns, cap, 42))())
+    assert np.array_equal(want, got)
+
+
+# ---------------------------------------------------------------------------
+# query-level bit-identity: kernels on vs off vs CPU oracle
+# ---------------------------------------------------------------------------
+
+def test_q1_shape_bit_identical_kernels_on_off():
+    views = {"t": _groupy_batch()}
+    cpu, _ = _run(CPU, views, Q_AGG)
+    on, plans = _run(TPU, views, Q_AGG)
+    off, _ = _run({**TPU, "spark.rapids.sql.kernel.enabled": "false"},
+                  views, Q_AGG)
+    assert cpu == on == off
+    counters = _kcounters(plans)
+    assert counters.get("kernelDispatchCount.groupbyHash", 0) > 0
+    assert counters.get("kernelFallbacks.groupbyHash", 0) == 0
+
+
+def test_groupby_overflow_falls_back_bit_identical():
+    n = 4000
+    rng = np.random.default_rng(8)
+    keys = np.array([f"g{i:04d}" for i in rng.integers(0, 1500, n)],
+                    dtype=object)
+    hb = HostBatch(T.StructType([T.StructField("k", T.StringT),
+                                 T.StructField("v", T.LongT)]),
+                   [HostColumn.all_valid(keys, T.StringT),
+                    HostColumn.all_valid(
+                        rng.integers(0, 100, n), T.LongT)], n)
+    q = "SELECT k, sum(v), count(*) FROM t GROUP BY k ORDER BY k"
+    views = {"t": hb}
+    cpu, _ = _run(CPU, views, q)
+    small = {**TPU,
+             "spark.rapids.sql.kernel.groupbyHash.tableSlots": "64"}
+    on, plans = _run(small, views, q)
+    assert cpu == on
+    counters = _kcounters(plans)
+    assert counters.get("kernelFallbacks.groupbyHash", 0) >= 1
+
+
+@pytest.mark.parametrize("name", ["groupbyHash", "murmur3"])
+def test_injected_kernel_failure_falls_back(name):
+    views = {"t": _groupy_batch(n=3000)}
+    conf = {**TPU, "spark.rapids.sql.shuffle.devicePartitions": "4"}
+    cpu, _ = _run(CPU, views, Q_AGG)
+    KR.inject_failure(name)
+    try:
+        on, plans = _run(conf, views, Q_AGG)
+    finally:
+        KR.inject_failure(name, on=False)
+        KR.clear_poison()
+    assert cpu == on
+    assert _kcounters(plans).get(f"kernelFallbacks.{name}", 0) >= 1
+
+
+@pytest.mark.fault
+def test_groupby_kernel_under_injected_oom():
+    """Kernel dispatches ride the PR 4 retry protocol: injected OOM
+    spills+retries (and splits) around the kernel program, results
+    stay bit-identical, and the kernel path stays on (no fallback —
+    OOM is NOT a lowering failure)."""
+    views = {"t": _groupy_batch(n=8000)}
+    cpu, _ = _run(CPU, views, Q_AGG)
+    conf = {**TPU, "spark.rapids.sql.test.injectOOM": "5"}
+    on, plans = _run(conf, views, Q_AGG)
+    assert cpu == on
+    snap = registry_snapshot(plans)["metrics"]
+    assert snap.get("retryCount", 0) > 0
+    assert snap.get("kernelDispatchCount.groupbyHash", 0) > 0
+    assert snap.get("kernelFallbacks.groupbyHash", 0) == 0
+
+
+def _join_views(m=300, n=3000, dup=False):
+    rng = np.random.default_rng(13)
+    pk = np.arange(1, m + 1)
+    if dup:
+        pk = np.concatenate([pk, pk[: m // 4]])
+    dim = HostBatch(T.StructType([T.StructField("pk", T.LongT),
+                                  T.StructField("nm", T.StringT)]),
+                    [HostColumn.all_valid(pk, T.LongT),
+                     HostColumn.all_valid(
+                         np.array([f"n{i}" for i in range(len(pk))],
+                                  dtype=object), T.StringT)], len(pk))
+    fkv = rng.integers(1, m + 120, n)
+    fvalid = rng.random(n) > 0.1
+    fact = HostBatch(T.StructType([T.StructField("fk", T.LongT),
+                                   T.StructField("v", T.LongT)]),
+                     [HostColumn(T.LongT, fkv, fvalid).normalized(),
+                      HostColumn.all_valid(
+                          rng.integers(0, 50, n), T.LongT)], n)
+    return fact, dim
+
+
+def _join_rows(conf, jt, dup=False, capture=True):
+    fact, dim = _join_views(dup=dup)
+    s = TpuSparkSession(dict(conf))
+    try:
+        f = s.createDataFrame(fact)
+        d = s.createDataFrame(dim)
+        s.start_capture()
+        out = f.join(d, f["fk"] == d["pk"], jt)._execute().to_pydict()
+        names = list(out)
+        nn = len(out[names[0]]) if names else 0
+        rows = sorted((tuple(out[c][i] for c in names)
+                       for i in range(nn)),
+                      key=lambda r: tuple((v is None, str(v))
+                                          for v in r))
+        return rows, s.get_captured_plans()
+    finally:
+        s.stop()
+
+
+@pytest.mark.parametrize("jt", ["leftsemi", "leftanti", "inner"])
+def test_join_kernel_parity(jt):
+    cpu, _ = _join_rows(CPU, jt)
+    on, plans = _join_rows(TPU, jt)
+    off, _ = _join_rows({**TPU,
+                         "spark.rapids.sql.kernel.enabled": "false"},
+                        jt)
+    assert cpu == on == off
+    assert _kcounters(plans).get("kernelDispatchCount.joinProbe",
+                                 0) > 0
+
+
+@pytest.mark.parametrize("jt", ["leftsemi", "inner"])
+def test_join_kernel_duplicate_build_keys(jt):
+    """Duplicate build keys: semi stays on the probe kernel (existence
+    only); inner loses its unique-key certificate and must take the
+    oracle expansion — both bit-identical."""
+    cpu, _ = _join_rows(CPU, jt, dup=True)
+    on, _ = _join_rows(TPU, jt, dup=True)
+    assert cpu == on
+
+
+def test_exchange_murmur3_kernel_parity():
+    views = {"t": _groupy_batch(n=4000)}
+    conf = {**TPU, "spark.rapids.sql.shuffle.devicePartitions": "4"}
+    cpu, _ = _run(CPU, views, Q_AGG, parts=3)
+    on, plans = _run(conf, views, Q_AGG, parts=3)
+    off, _ = _run({**conf, "spark.rapids.sql.kernel.murmur3.enabled":
+                   "false"}, views, Q_AGG, parts=3)
+    assert cpu == on == off
+    counters = _kcounters(plans)
+    assert counters.get("kernelDispatchCount.murmur3", 0) > 0
+
+
+def test_each_kernel_individually_disableable():
+    views = {"t": _groupy_batch(n=3000)}
+    conf = {**TPU, "spark.rapids.sql.shuffle.devicePartitions": "4"}
+    cpu, _ = _run(CPU, views, Q_AGG)
+    for name in KR.KERNELS:
+        off_one = {**conf,
+                   f"spark.rapids.sql.kernel.{name}.enabled": "false"}
+        out, plans = _run(off_one, views, Q_AGG)
+        assert cpu == out, name
+        counters = _kcounters(plans)
+        assert counters.get(f"kernelDispatchCount.{name}", 0) == 0, name
+
+
+# ---------------------------------------------------------------------------
+# observability: spans, hotspots CLI
+# ---------------------------------------------------------------------------
+
+def test_kernel_dispatch_spans_and_hotspots(tmp_path):
+    from spark_rapids_tpu import trace as TR
+    from spark_rapids_tpu.tools import hotspots_report
+    from spark_rapids_tpu.trace import load_trace
+    TR.reset_tracing()
+    tdir = str(tmp_path / "traces")
+    conf = {**TPU,
+            "spark.rapids.sql.shuffle.devicePartitions": "4",
+            "spark.rapids.sql.trace.enabled": "true",
+            "spark.rapids.sql.trace.dir": tdir}
+    try:
+        _run(conf, {"t": _groupy_batch(n=3000)}, Q_AGG)
+    finally:
+        TR.reset_tracing()
+    files = sorted(glob.glob(os.path.join(tdir, "trace-*.json")))
+    assert files
+    spans = [s for fp in files for s in load_trace(fp)["spans"]]
+    agg_disp = [s for s in spans
+                if s["name"] == "TpuHashAggregateExec.dispatch"
+                and s.get("args", {}).get("kernel") == "groupbyHash"]
+    assert agg_disp, "agg dispatch spans must carry the kernel attr"
+    kd = [s for s in spans if s["name"] == "kernelDispatch"]
+    assert any(s.get("args", {}).get("kernel") == "murmur3"
+               for s in kd)
+    report = hotspots_report(files)
+    assert "kernelDispatch[murmur3]" in report
+    assert "TpuHashAggregateExec.dispatch" in report
+
+
+def test_hotspots_cli_exit_contract(tmp_path):
+    import subprocess
+    import sys
+    out = subprocess.run(
+        [sys.executable, "-m", "spark_rapids_tpu.tools", "hotspots",
+         str(tmp_path)],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 1  # empty dir: no trace files
+    assert "no trace-*.json" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# conf plumbing
+# ---------------------------------------------------------------------------
+
+def test_table_slots_shrinks_to_batch():
+    from spark_rapids_tpu.conf import TpuConf
+    conf = TpuConf({})
+    assert KR.table_slots(conf, 1 << 20) == 1024  # conf bound
+    assert KR.table_slots(conf, 64) == 128        # 2x a tiny batch
+    conf2 = TpuConf(
+        {"spark.rapids.sql.kernel.groupbyHash.tableSlots": "4096"})
+    assert KR.table_slots(conf2, 1 << 20) == 4096
+
+
+def test_kernel_enabled_gates():
+    from spark_rapids_tpu.conf import TpuConf
+    assert KR.kernel_enabled(TpuConf({}), "groupbyHash") == (
+        DC.pallas_mode() is not None)
+    assert not KR.kernel_enabled(
+        TpuConf({"spark.rapids.sql.kernel.enabled": "false"}),
+        "groupbyHash")
+    assert not KR.kernel_enabled(
+        TpuConf({"spark.rapids.sql.kernel.groupbyHash.enabled":
+                 "false"}), "groupbyHash")
+    assert KR.kernel_enabled(None, "groupbyHash") is False

@@ -147,6 +147,53 @@ def test_jit_builder_resolves_across_modules(tmp_path):
     assert _lint(root).clean
 
 
+def test_pallas_call_treated_like_jit(tmp_path):
+    # pl.pallas_call is compile-discipline traffic exactly like
+    # jax.jit: sanctioned inside the kernels/ registry package or a
+    # JitCache builder closure, a finding anywhere else
+    root = _tree(tmp_path, {
+        "spark_rapids_tpu/exec/x.py": """
+            import jax
+            from jax.experimental import pallas as pl
+            from spark_rapids_tpu.jit_cache import JitCache
+
+            _C = JitCache("fixture")
+
+            def bad(x):
+                return pl.pallas_call(_k, out_shape=x)(x)
+
+            def good(key):
+                fn, _ = _C.get_or_build(key, lambda: _builder())
+                return fn
+
+            def _builder():
+                return jax.jit(lambda x: pl.pallas_call(
+                    _k, out_shape=None)(x))
+        """,
+        "spark_rapids_tpu/kernels/__init__.py": "",
+        "spark_rapids_tpu/kernels/k.py": """
+            from jax.experimental import pallas as pl
+
+            def build_kernel(shape):
+                # registry home: pallas_call sanctioned here
+                return pl.pallas_call(_kern, out_shape=shape)
+        """})
+    r = _lint(root)
+    assert _rules(r) == ["jit-direct"]
+    assert [f.line for f in r.findings] == [8]
+    assert "pl.pallas_call" in r.findings[0].message
+
+
+def test_pallas_call_suppressible_with_reason(tmp_path):
+    root = _tree(tmp_path, {"spark_rapids_tpu/exec/x.py": """
+        from jax.experimental import pallas as pl
+
+        def probe(shape):
+            return pl.pallas_call(_k, out_shape=shape)  # tpu-lint: disable=jit-direct(one-shot capability probe)
+    """})
+    assert _lint(root).clean
+
+
 def test_jit_module_cache_flags_raw_dicts(tmp_path):
     root = _tree(tmp_path, {"spark_rapids_tpu/exec/x.py": """
         from collections import OrderedDict
