@@ -954,6 +954,163 @@ def run_serving(clean_wall: float, cpu_rows, q3_cpu_rows) -> dict:
         srv.shutdown()
 
 
+def run_telemetry(clean_wall: float, cpu_rows) -> dict:
+    """detail.telemetry (docs/observability.md "Live telemetry"): the
+    q1 ring-recorder overhead ratio vs trace fully off (budget
+    <= 1.05x — INTERLEAVED walls so machine drift can't masquerade as
+    recorder overhead), the Prometheus endpoint's scrape latency while
+    c=4 queries run, and one forced slow-query bundle round trip (ring
+    dump loads in the trace analyzer, bundle names its condition)."""
+    import glob
+    import threading
+
+    from spark_rapids_tpu import trace as TR
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    from spark_rapids_tpu.telemetry import triggers as TEL
+    from spark_rapids_tpu.tools import analyze_trace
+
+    # -- ring-recorder overhead (interleaved best-of) ----------------------
+    TR.reset_tracing()
+    fresh_leg()
+    off = TpuSparkSession(dict(TPU_CONF))
+    on = TpuSparkSession({**TPU_CONF,
+                          "spark.rapids.sql.trace.enabled": "true",
+                          "spark.rapids.sql.trace.mode": "ring"})
+    try:
+        q_off, q_on = build_query(off), build_query(on)
+        run_once(q_off)  # warm (compile caches are process-wide)
+        run_once(q_on)
+        offs, ons = [], []
+        for _ in range(2):
+            dt, rows_off = run_once(q_off)
+            offs.append(dt)
+            dt, rows_on = run_once(q_on)
+            ons.append(dt)
+        assert_rows_match(cpu_rows, rows_off)
+        assert_rows_match(cpu_rows, rows_on)
+        ring = TR.ring_active()
+        ring_counts = ring.record_counts() if ring is not None else {}
+    finally:
+        on.stop()
+        off.stop()
+        TR.reset_tracing()
+    out = {
+        "skipped": False,
+        "clean_wall_s": round(clean_wall, 4),
+        "ringWall_s": round(min(ons), 4),
+        "offWall_s": round(min(offs), 4),
+        "ringOverhead": round(min(ons) / min(offs), 4),
+        "ringOverheadBudget": 1.05,
+        "ringRecordCounts": ring_counts,
+    }
+
+    # -- endpoint scrape under load + forced slow-query bundle -------------
+    tdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".bench-data", "telemetry")
+    shutil.rmtree(tdir, ignore_errors=True)
+    from spark_rapids_tpu.serve import QueryServer, ServeClient
+    TEL.engine().reset()
+    conf = dict(TPU_CONF)
+    conf.update({
+        "spark.rapids.sql.telemetry.dir": tdir,
+        # every query is "slow": one forced bundle, then rate-limited
+        "spark.rapids.sql.telemetry.slowQueryMs": "1",
+        "spark.rapids.sql.telemetry.triggerMinIntervalS": "3600",
+        "spark.rapids.sql.profile.enabled": "true",
+        "spark.rapids.sql.profile.dir": os.path.join(tdir, "profiles"),
+    })
+    try:
+        srv = QueryServer(conf).start()
+    except OSError as e:
+        out["endpoint"] = {"skipped": True,
+                           "reason": f"cannot bind: {e!r}"}
+        return out
+    try:
+        srv.register_view("lineitem", DATA_DIR)
+        stop = threading.Event()
+        errors: list = []
+
+        def load_worker(i):
+            try:
+                with ServeClient(srv.port, tenant=f"t{i % 2}") as c:
+                    while not stop.is_set():
+                        c.sql(Q1)
+            except Exception as e:  # noqa: BLE001 - reported below
+                if not stop.is_set():
+                    errors.append(repr(e))
+
+        workers = [threading.Thread(target=load_worker, args=(i,))
+                   for i in range(4)]
+        for w in workers:
+            w.start()
+        time.sleep(0.5)  # let the first queries land
+        scrape_lat = []
+        with ServeClient(srv.port, tenant="scraper") as sc:
+            for _ in range(20):
+                t0 = time.perf_counter()
+                text = sc.metrics()
+                scrape_lat.append(time.perf_counter() - t0)
+        stop.set()
+        for w in workers:
+            w.join(timeout=120)
+        from spark_rapids_tpu.serve.scheduler import percentile
+        out["endpoint"] = {
+            "scrapes": len(scrape_lat),
+            "scrapeLatencyMs": {
+                "p50": round(percentile(scrape_lat, 0.50) * 1e3, 3),
+                "p99": round(percentile(scrape_lat, 0.99) * 1e3, 3),
+            },
+            "families": sum(1 for ln in text.splitlines()
+                            if ln.startswith("# TYPE ")),
+            "loadErrors": errors[:3],
+        }
+        TEL.engine().drain(timeout=30)
+        bundles = sorted(glob.glob(os.path.join(tdir, "bundle-*.json")))
+        bundle_leg = {"bundles": len(bundles)}
+        if bundles:
+            with open(bundles[0]) as f:
+                b = json.load(f)
+            bundle_leg["trigger"] = b.get("trigger")
+            bundle_leg["condition"] = b.get("condition")
+            bundle_leg["hasProfile"] = bool(b.get("profile"))
+            bundle_leg["hasServerStats"] = bool(b.get("serverStats"))
+            ring_dump = b.get("ringDump")
+            if ring_dump and os.path.exists(ring_dump):
+                analysis = analyze_trace(ring_dump)
+                bundle_leg["ringDumpSpans"] = analysis.get(
+                    "spanCount", 0)
+        out["slowQueryBundle"] = bundle_leg
+        out["triggerStats"] = TEL.engine().stats()
+        out["triggerStats"].pop("bundles", None)
+    finally:
+        srv.shutdown()
+        TEL.engine().reset()
+        TR.reset_tracing()
+    return out
+
+
+def run_bench_diff(current: dict) -> dict:
+    """Regression tracking: diff THIS run's output against the newest
+    BENCH_r0*.json in the repo (docs/observability.md 'Live
+    telemetry'); the machine verdict rides in the bench JSON so the
+    round trajectory is an enforced curve, not loose files."""
+    from spark_rapids_tpu.telemetry.bench_diff import (bench_diff,
+                                                      latest_bench_file)
+    prev = latest_bench_file(os.path.dirname(os.path.abspath(__file__)))
+    if prev is None:
+        return {"skipped": True, "reason": "no previous BENCH_r*.json"}
+    report = bench_diff(prev, current)
+    return {
+        "skipped": False,
+        "baseline": os.path.basename(prev),
+        "verdict": report["verdict"],
+        "regressed": report["regressed"],
+        "improved": report["improved"],
+        "compared": len(report["checks"]),
+        "notComparable": len(report["missing"]),
+    }
+
+
 def main():
     from spark_rapids_tpu.metrics import registry_snapshot
     from spark_rapids_tpu.sql.session import TpuSparkSession
@@ -1035,11 +1192,20 @@ def main():
         serving = {"skipped": True,
                    "reason": f"serving leg failed: {e!r}"}
 
+    # live-telemetry leg (docs/observability.md "Live telemetry"):
+    # ring-recorder overhead, endpoint scrape-under-load latency, one
+    # forced slow-query bundle round trip — equally fault-isolated
+    try:
+        telemetry_leg = run_telemetry(fused["wall_s"], cpu_rows)
+    except Exception as e:  # noqa: BLE001 - reported, not swallowed
+        telemetry_leg = {"skipped": True,
+                         "reason": f"telemetry leg failed: {e!r}"}
+
     cpu_t = min(cpu_times)
     tpu_t = fused["wall_s"]
     q3_tpu_t = fused["q3"]["wall_s"]
     speedup = cpu_t / tpu_t
-    print(json.dumps({
+    result = {
         "metric": "tpch_q1_sf1_parquet",
         "value": round(N_ROWS / tpu_t, 1),
         "unit": "rows/s",
@@ -1074,6 +1240,7 @@ def main():
             "profile": profile_leg,
             "kernels": kernels_leg,
             "serving": serving,
+            "telemetry": telemetry_leg,
             "jitCaches": registry_snapshot()["jitCaches"],
             "tpcds_q3": {
                 "device_wall_s": round(q3_tpu_t, 4),
@@ -1084,7 +1251,15 @@ def main():
                 "decode": fused["q3"]["decode"],
             },
         },
-    }))
+    }
+    # regression verdict vs the previous round rides IN the output
+    # (fault-isolated: a differ failure must not discard the results)
+    try:
+        telemetry_leg["benchDiff"] = run_bench_diff(result)
+    except Exception as e:  # noqa: BLE001 - reported, not swallowed
+        telemetry_leg["benchDiff"] = {
+            "skipped": True, "reason": f"bench-diff failed: {e!r}"}
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

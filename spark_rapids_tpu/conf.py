@@ -415,6 +415,62 @@ SERVE_TENANT_ID = conf("spark.rapids.sql.serve.tenantId").internal().doc(
     "session; threads through trace files, event-log lines, profile "
     "artifacts, and the store's per-tenant HBM ledger.").string("")
 
+TELEMETRY_DIR = conf("spark.rapids.sql.telemetry.dir").doc(
+    "Directory for slow-query bundles emitted by the telemetry trigger "
+    "engine (bundle-<pid>-<n>-<trigger>.json + the flight-recorder "
+    "dump trace-ring-<pid>-<n>.json it references; "
+    "docs/observability.md 'Live telemetry').").string("/tmp/srt_telemetry")
+
+TELEMETRY_SLOW_QUERY_MS = conf("spark.rapids.sql.telemetry.slowQueryMs").doc(
+    "Slow-query trigger: a query whose wall exceeds this many "
+    "milliseconds emits a slow-query bundle (flight-recorder dump + "
+    "profile artifact path + server stats + the condition) into "
+    "spark.rapids.sql.telemetry.dir. 0 disables the trigger."
+    ).integer(0)
+
+TELEMETRY_RETRY_COUNT_THRESHOLD = conf(
+    "spark.rapids.sql.telemetry.retryCountThreshold").doc(
+    "Per-query retry trigger: a query whose plan accumulates MORE than "
+    "this many retryCount (OOM retries) emits a slow-query bundle. "
+    "0 disables the trigger.").integer(0)
+
+TELEMETRY_KERNEL_FALLBACK_THRESHOLD = conf(
+    "spark.rapids.sql.telemetry.kernelFallbackThreshold").doc(
+    "Per-query kernel-fallback trigger: a query whose plan accumulates "
+    "MORE than this many kernelFallbacks.* (Pallas kernel calls that "
+    "fell back to the XLA-op oracle) emits a slow-query bundle. "
+    "0 disables the trigger.").integer(0)
+
+TELEMETRY_RETRY_STORM_THRESHOLD = conf(
+    "spark.rapids.sql.telemetry.retryStormThreshold").doc(
+    "Process-wide retry-storm trigger: MORE than this many OOM retries "
+    "inside one 60-second window emits a retryStorm bundle (evaluated "
+    "at retry time, not query end — a storm is visible while the "
+    "storm is happening). 0 disables the trigger.").integer(0)
+
+TELEMETRY_HBM_WATERMARK = conf(
+    "spark.rapids.sql.telemetry.hbmWatermark").doc(
+    "HBM-occupancy trigger: a device-store sample whose live bytes "
+    "exceed this fraction of the pool budget emits an hbmWatermark "
+    "bundle (evaluated at every store transition). 0 disables the "
+    "trigger. Arm it via any session that sets a telemetry conf "
+    "(triggers.configure).").double(0.0)
+
+TELEMETRY_QUEUE_WATERMARK = conf(
+    "spark.rapids.sql.telemetry.queueWatermark").doc(
+    "Admission-saturation trigger: an admission queue whose depth "
+    "exceeds this fraction of serve.maxQueued emits a queueSaturation "
+    "bundle (evaluated at every enqueue). 0 disables the trigger."
+    ).double(0.0)
+
+TELEMETRY_MIN_INTERVAL_S = conf(
+    "spark.rapids.sql.telemetry.triggerMinIntervalS").doc(
+    "Per-trigger rate limit: after a trigger fires, further firings of "
+    "the SAME trigger inside this many seconds are counted "
+    "(rateLimited in the engine stats, srt_telemetry_triggers_rate_"
+    "limited_total on the endpoint) but emit no bundle — a storm "
+    "cannot flood the disk.").double(60.0)
+
 PARQUET_DEVICE_DECODE = conf(
     "spark.rapids.sql.format.parquet.deviceDecode.enabled").doc(
     "Decode Parquet pages ON DEVICE (the default scan path, the "

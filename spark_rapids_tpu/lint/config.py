@@ -89,6 +89,9 @@ class LintConfig:
     # -- drift -------------------------------------------------------------
     metrics_rel: str = "spark_rapids_tpu/metrics.py"
     trace_rel: str = "spark_rapids_tpu/trace.py"
+    # the telemetry endpoint module whose SERVER_FAMILY_HELP table the
+    # prom-family rule checks emissions against
+    prometheus_rel: str = "spark_rapids_tpu/telemetry/prometheus.py"
     # generated docs compared against `tools docs` regeneration
     check_docs: bool = True
 
@@ -105,7 +108,7 @@ def load_config(root: str) -> LintConfig:
     with open(path, "r", encoding="utf-8") as f:
         data = json.load(f)
     for key in ("check_docs", "baseline", "jit_home", "kernels_home",
-                "metrics_rel", "trace_rel"):
+                "metrics_rel", "trace_rel", "prometheus_rel"):
         if key in data:
             setattr(cfg, key, data[key])
     for key in ("scan_roots", "retry_scope", "retry_wrappers",

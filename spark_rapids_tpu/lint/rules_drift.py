@@ -15,6 +15,23 @@ runtime lints; docs/observability.md).
 ``span-scope``  — every ``trace.span(...)`` open must be the context
                   expression of a ``with`` (an unclosed span corrupts
                   the B/E nesting of the whole lane).
+``span-kind``   — every LITERAL span/instant kind recorded in the
+                  package (``trace.span``/``trace.instant`` calls, and
+                  the ``qt.add``/``qt.mark`` convention over the
+                  active trace) must appear in trace.py's
+                  ``SPAN_CATALOG``/``INSTANT_CATALOG``, so flight-
+                  recorder dumps and trace files can never carry a
+                  vocabulary the documentation doesn't (metric-mirror
+                  spans are dynamic ``<Exec>.<metric>`` names and are
+                  covered by ``metric-key`` instead).
+``prom-family`` — every Prometheus family name the telemetry endpoint
+                  emits (telemetry/prometheus.py ``_emit_server``
+                  sites) must be a key of ``SERVER_FAMILY_HELP`` (the
+                  table the observability doc renders) and match the
+                  ``srt_[a-z0-9_]+`` naming rule; engine-metric
+                  families are derived from registry keys, whose
+                  describe_metric coverage the renderer enforces at
+                  runtime (srt_undescribed_metric_keys must be 0).
 ``docs-drift``  — docs/configs.md, docs/supported_ops.md and
                   docs/observability.md must match `tools docs`
                   regeneration byte-for-byte.
@@ -217,6 +234,103 @@ def check_span_scope(pctx):
                 call.col_offset + 1,
                 "trace span opened outside a `with` — every span must "
                 "be with-scoped so its B/E pair always closes")
+
+
+@rule("span-kind",
+      "literal span/instant kinds must come from trace.py's "
+      "SPAN_CATALOG / INSTANT_CATALOG (docs/observability.md)")
+def check_span_kinds(pctx):
+    cfg = pctx.config
+    trace_mod = os.path.splitext(cfg.trace_rel.replace("/", "."))[0]
+    tfctx = pctx.file(cfg.trace_rel)
+    if tfctx is None:
+        return
+    consts = _module_str_constants(tfctx)
+    span_kinds = _dict_keys(tfctx, "SPAN_CATALOG", consts)
+    instant_kinds = _dict_keys(tfctx, "INSTANT_CATALOG", consts)
+    if span_kinds is None or instant_kinds is None:
+        return  # no catalogs in this tree (fixture runs)
+
+    def _literal(call) -> Optional[str]:
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and isinstance(call.args[0].value, str):
+            return call.args[0].value
+        return None
+
+    for fctx in pctx.files:
+        if fctx.rel == cfg.trace_rel:
+            continue
+        for call in A.walk_calls(fctx.tree):
+            tail = A.call_tail(call)
+            if tail in ("span", "instant"):
+                if not isinstance(call.func, ast.Attribute) or \
+                        A.resolve_path(fctx, call.func.value) != trace_mod:
+                    continue
+                catalog = span_kinds if tail == "span" else instant_kinds
+            elif tail in ("add", "mark"):
+                # the package convention: `qt = trace._ACTIVE` (or the
+                # metrics-module mirror) — literal kinds recorded
+                # through it are catalog members too
+                f = call.func
+                if not (isinstance(f, ast.Attribute)
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id == "qt"):
+                    continue
+                catalog = span_kinds if tail == "add" else instant_kinds
+            else:
+                continue
+            kind = _literal(call)
+            if kind is None or kind in catalog:
+                continue
+            which = ("SPAN_CATALOG" if catalog is span_kinds
+                     else "INSTANT_CATALOG")
+            yield Finding(
+                "span-kind", fctx.rel, call.lineno,
+                call.col_offset + 1,
+                f"span kind {kind!r} is not in trace.py {which} — "
+                f"add it (with a description) so dumps can't carry "
+                f"undocumented vocabulary")
+
+
+@rule("prom-family",
+      "Prometheus families emitted by the telemetry endpoint must be "
+      "SERVER_FAMILY_HELP entries named srt_[a-z0-9_]+")
+def check_prom_families(pctx):
+    cfg = pctx.config
+    pfctx = pctx.file(cfg.prometheus_rel)
+    if pfctx is None:
+        return
+    consts = _module_str_constants(pfctx)
+    families = _dict_keys(pfctx, "SERVER_FAMILY_HELP", consts)
+    if families is None:
+        return
+    name_re = re.compile(r"^srt_[a-z0-9_]+$")
+    for name in sorted(families):
+        if not name_re.match(name):
+            yield Finding(
+                "prom-family", pfctx.rel, 1, 1,
+                f"family {name!r} violates the srt_[a-z0-9_]+ naming "
+                f"rule")
+    for call in A.walk_calls(pfctx.tree):
+        if A.call_tail(call) != "_emit_server" or len(call.args) < 2:
+            continue
+        arg = call.args[1]
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            yield Finding(
+                "prom-family", pfctx.rel, call.lineno,
+                call.col_offset + 1,
+                "emitted family name must be a string literal (the "
+                "SERVER_FAMILY_HELP table and the generated doc "
+                "cannot cover a dynamic name)")
+            continue
+        if arg.value not in families:
+            yield Finding(
+                "prom-family", pfctx.rel, call.lineno,
+                call.col_offset + 1,
+                f"family {arg.value!r} has no SERVER_FAMILY_HELP "
+                f"entry — add it (type + help) so the endpoint and "
+                f"docs/observability.md stay in lockstep")
 
 
 @rule("docs-drift",

@@ -39,6 +39,7 @@ from collections import OrderedDict
 from typing import Dict, Optional
 
 from spark_rapids_tpu import trace as _trace
+from spark_rapids_tpu.telemetry import triggers as _telemetry
 from spark_rapids_tpu.columnar.device import DeviceBatch
 from spark_rapids_tpu.columnar.host import HostBatch
 from spark_rapids_tpu.conf import (DEVICE_MEMORY_LIMIT,
@@ -297,8 +298,11 @@ class DeviceStore:
 
     def _sample_counters(self) -> None:
         """Pool occupancy sample into the active trace (Chrome "C"
-        counter events -> the Perfetto HBM timeline). One None check
-        when tracing is off."""
+        counter events -> the Perfetto HBM timeline) and the telemetry
+        HBM-watermark trigger. One None/bool check each when off; the
+        trigger hook only ENQUEUES (no IO under this store's lock)."""
+        _telemetry.on_store_sample(self.device_bytes,
+                                   self.device_budget)
         qt = _trace._ACTIVE
         if qt is not None:
             qt.count("deviceStoreBytes", self.device_bytes)

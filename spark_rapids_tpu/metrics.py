@@ -18,6 +18,7 @@ import contextlib
 import threading
 import time
 import weakref
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, Optional
 
@@ -175,6 +176,12 @@ class TpuMetric:
     name: str
     level: int = MODERATE
     value: int = 0
+    # mutation counter (one int += under the already-held lock): the
+    # telemetry endpoint's registry-delta aggregator sums versions per
+    # registry to decide whether a cached snapshot is still current, so
+    # a scrape re-reads only registries that actually changed
+    # (telemetry/prometheus.py)
+    version: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock,
                                   repr=False, compare=False)
     # wall-union timer state (timed_wall): overlapping intervals from
@@ -185,10 +192,12 @@ class TpuMetric:
     def add(self, v: int) -> None:
         with self._lock:
             self.value += int(v)
+            self.version += 1
 
     def set_max(self, v: int) -> None:
         with self._lock:
             self.value = max(self.value, int(v))
+            self.version += 1
 
     def enter_wall(self) -> None:
         with self._lock:
@@ -201,11 +210,59 @@ class TpuMetric:
             self._active -= 1
             if self._active == 0:
                 self.value += time.perf_counter_ns() - self._wall_start
+                self.version += 1
 
 
 # every live registry, for registry_snapshot(); weak so plans release
 # their metrics with themselves
 _REGISTRIES: "weakref.WeakSet[MetricRegistry]" = weakref.WeakSet()
+
+# process-LIFETIME totals: when a registry is garbage-collected with
+# its plan, its final values fold in here (the finalizer holds the
+# inner metrics dict, which needs no access to the dead registry), so
+# the telemetry endpoint's counters stay monotone across plan
+# lifetimes — a query that completed between two scrapes still counts
+# (telemetry/prometheus.py layers live registries on top of this base)
+_RETIRED_LOCK = threading.Lock()
+_RETIRED_TOTALS: Dict[str, int] = {}
+# finalizers run at arbitrary allocation points (possibly while a
+# reader holds _RETIRED_LOCK on the same thread), so they must not
+# lock: the handoff is an atomic deque append, drained by readers
+_RETIRED_QUEUE: deque = deque()
+
+
+def _retire_metrics(metrics_dict: Dict[str, "TpuMetric"]) -> None:
+    _RETIRED_QUEUE.append(metrics_dict)
+
+
+def is_watermark_metric(name: str) -> bool:
+    """True for high-watermark (``set_max``-style) metrics: they fold
+    across registries by MAX, not sum — 10k dead per-plan peaks summed
+    would dwarf the pool budget and mean nothing (the telemetry
+    endpoint exports these as gauges)."""
+    return "peak" in name.lower()
+
+
+def fold_metric(totals: Dict[str, int], name: str, value: int) -> None:
+    """Fold one registry's value into cross-registry totals with the
+    right semantics (max for watermarks, sum otherwise)."""
+    if is_watermark_metric(name):
+        totals[name] = max(totals.get(name, 0), value)
+    else:
+        totals[name] = totals.get(name, 0) + value
+
+
+def retired_totals() -> Dict[str, int]:
+    """Folded final values of every garbage-collected registry."""
+    with _RETIRED_LOCK:
+        while True:
+            try:
+                md = _RETIRED_QUEUE.popleft()
+            except IndexError:
+                break
+            for k, m in md.items():
+                fold_metric(_RETIRED_TOTALS, k, m.value)
+        return dict(_RETIRED_TOTALS)
 
 # registry epoch: process-wide counters (the weak set above, the device
 # store peaks) otherwise bleed one bench leg's numbers into the next
@@ -241,6 +298,7 @@ class MetricRegistry:
         self.epoch = _EPOCH
         self._lock = threading.Lock()
         _REGISTRIES.add(self)
+        weakref.finalize(self, _retire_metrics, self.metrics)
 
     def clone_empty(self) -> "MetricRegistry":
         """A fresh registry with the same level/owner and the same
@@ -255,6 +313,7 @@ class MetricRegistry:
         r.epoch = _EPOCH
         r._lock = threading.Lock()
         _REGISTRIES.add(r)
+        weakref.finalize(r, _retire_metrics, r.metrics)
         for k, m in self.metrics.items():
             r.create(k, m.level)
         return r
@@ -316,6 +375,12 @@ class MetricRegistry:
 
     def snapshot(self) -> Dict[str, int]:
         return {k: m.value for k, m in self.metrics.items()}
+
+
+def live_registries() -> list:
+    """Every live MetricRegistry in the process (a stable list copy of
+    the weak set) — the telemetry aggregator's iteration surface."""
+    return list(_REGISTRIES)
 
 
 def registry_snapshot(plans=None, epoch: Optional[int] = None

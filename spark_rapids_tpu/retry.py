@@ -343,7 +343,12 @@ def _recover(conf, metrics, attempt: int, backoff_ms: int,
     spans, undoing the documented retryBlockTime-inside-opTime double
     count at the reporting layer (docs/observability.md)."""
     from spark_rapids_tpu import trace as TR
+    from spark_rapids_tpu.telemetry import triggers as TEL
     TR.instant("retryOOM", attempt=attempt)
+    # retry-STORM telemetry is evaluated here, at retry time, so a
+    # storm surfaces while it is happening (one boolean check when the
+    # engine is unarmed; docs/observability.md "Live telemetry")
+    TEL.on_retry()
     t0 = time.perf_counter_ns()
     freed = 0
     with suppress_injection():

@@ -107,6 +107,15 @@ class ServeClient:
             raise ServeError(header.get("error", "stats failed"))
         return header["stats"]
 
+    def metrics(self) -> str:
+        """One Prometheus text scrape of the server (the `metrics`
+        verb; `tools top` and the bench's scrape-latency leg poll
+        this)."""
+        header, payload = self._roundtrip({"op": "metrics"})
+        if header.get("status") != "ok":
+            raise ServeError(header.get("error", "metrics failed"))
+        return payload.decode("utf-8")
+
     def ping(self) -> bool:
         header, _ = self._roundtrip({"op": "ping"})
         return header.get("status") == "ok"

@@ -12,10 +12,13 @@ boundary — io/arrow_convert.py), so any Arrow-capable client can read
 results without this module.
 
 Request ops: ``sql`` (fields: sql, tenant), ``view`` (name, path,
-fmt), ``stats``, ``ping``, ``shutdown``. Responses carry ``status``
-(ok | rejected | error) plus op-specific fields; ``sql`` responses
-attach ``rows``, ``queueWaitMs``, ``execMs``, ``planCacheHit`` and the
-Arrow payload.
+fmt), ``stats``, ``metrics`` (alias ``stats-stream``: one Prometheus
+text scrape per request, returned as the frame PAYLOAD with
+``contentType`` in the header — clients poll it, `tools top` and
+Prometheus scrapers both ride this verb), ``ping``, ``shutdown``.
+Responses carry ``status`` (ok | rejected | error) plus op-specific
+fields; ``sql`` responses attach ``rows``, ``queueWaitMs``, ``execMs``,
+``planCacheHit`` and the Arrow payload.
 """
 
 from __future__ import annotations

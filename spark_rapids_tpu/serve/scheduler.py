@@ -36,6 +36,7 @@ from typing import Dict, List, Optional
 from spark_rapids_tpu.conf import (SERVE_MAX_CONCURRENT,
                                    SERVE_MAX_PER_TENANT, SERVE_MAX_QUEUED,
                                    TpuConf)
+from spark_rapids_tpu.telemetry import triggers as _telemetry
 
 # bounded reservoir per tenant: enough for stable p99 at bench scale
 # without unbounded growth on a long-lived server
@@ -145,6 +146,9 @@ class AdmissionController:
             self._seq += 1
             tk = _Ticket(self._seq, tenant)
             self._queue.append(tk)
+            # telemetry queue-saturation trigger (enqueue only — the
+            # bundle writer runs on its own thread, never under _cv)
+            _telemetry.on_admission(len(self._queue), self.max_queued)
             # maxQueued bounds WAITING queries: a ticket that can run
             # immediately is admitted regardless (maxQueued=0 means
             # "reject whenever anything must wait", not "reject all")

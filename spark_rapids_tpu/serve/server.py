@@ -56,6 +56,18 @@ class QueryServer:
         # serving default: cross-query plan caching ON unless the
         # operator explicitly disabled it
         base.setdefault("spark.rapids.sql.planCache.enabled", "true")
+        # serving default: the FLIGHT RECORDER is on (trace.mode=ring)
+        # — a long-lived multi-tenant process must be able to
+        # reconstruct the query it didn't pre-instrument; the ring is
+        # bounded memory and near-zero overhead, and slow-query
+        # triggers dump it (docs/observability.md "Live telemetry").
+        # An operator who set EITHER trace conf keeps their exact
+        # choice: trace.enabled=true alone must mean the documented
+        # default (per-query files), not a silent flip to ring
+        if "spark.rapids.sql.trace.enabled" not in base \
+                and "spark.rapids.sql.trace.mode" not in base:
+            base["spark.rapids.sql.trace.enabled"] = "true"
+            base["spark.rapids.sql.trace.mode"] = "ring"
         self._base_conf = base
         cobj = TpuConf(base)
         self.host = host if host is not None else str(cobj.get(SERVE_HOST))
@@ -70,6 +82,7 @@ class QueryServer:
         self._tenant_locks: Dict[str, threading.Lock] = {}
         self._views: Dict[str, Tuple[str, str]] = {}  # name -> (fmt, path)
         self._sock: Optional[socket.socket] = None
+        self._metrics_httpd = None
         self._accept_thread: Optional[threading.Thread] = None
         self._conn_threads: List[threading.Thread] = []
         self._conn_lock = threading.Lock()
@@ -101,7 +114,21 @@ class QueryServer:
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="srt-serve-accept", daemon=True)
         self._accept_thread.start()
+        # slow-query bundles emitted while this server is up embed a
+        # server stats snapshot (docs/observability.md)
+        from spark_rapids_tpu.telemetry import triggers as _telemetry
+        _telemetry.set_stats_provider(self.stats)
         return self
+
+    def start_metrics_http(self, port: int,
+                           host: Optional[str] = None) -> int:
+        """The `tools serve --metrics-port` HTTP twin of the `metrics`
+        protocol verb: GET /metrics returns the same Prometheus text.
+        Returns the bound port (ephemeral when 0)."""
+        from spark_rapids_tpu.telemetry import prometheus as _prom
+        self._metrics_httpd = _prom.serve_http_metrics(
+            self.metrics_text, port, host=host or self.host)
+        return self._metrics_httpd.server_address[1]
 
     def shutdown(self, timeout: float = 60.0) -> bool:
         """Clean shutdown: stop accepting, reject queued queries, DRAIN
@@ -110,6 +137,15 @@ class QueryServer:
         drain finished inside the timeout."""
         self._stopping.set()
         self._admission.begin_shutdown()
+        from spark_rapids_tpu.telemetry import triggers as _telemetry
+        _telemetry.set_stats_provider(None)
+        if self._metrics_httpd is not None:
+            try:
+                self._metrics_httpd.shutdown()
+                self._metrics_httpd.server_close()
+            except Exception:
+                pass
+            self._metrics_httpd = None
         if self._sock is not None:
             try:
                 self._sock.close()
@@ -225,6 +261,15 @@ class QueryServer:
                 elif op == "stats":
                     protocol.send_msg(conn, {"status": "ok",
                                              "stats": self.stats()})
+                elif op in ("metrics", "stats-stream"):
+                    # Prometheus text exposition as the frame payload
+                    # (one scrape per request; `stats-stream` is the
+                    # poll-me alias `tools top` uses)
+                    protocol.send_msg(
+                        conn,
+                        {"status": "ok",
+                         "contentType": "text/plain; version=0.0.4"},
+                        self.metrics_text().encode("utf-8"))
                 elif op == "ping":
                     protocol.send_msg(conn, {"status": "ok"})
                 elif op == "shutdown":
@@ -318,6 +363,13 @@ class QueryServer:
             del lat[:-_LAT_RESERVOIR]
 
     # -- observability -----------------------------------------------------
+
+    def metrics_text(self) -> str:
+        """The Prometheus exposition of this server's stats plus the
+        process registries (the `metrics` verb and the HTTP twin share
+        this; docs/observability.md 'Live telemetry')."""
+        from spark_rapids_tpu.telemetry import prometheus as _prom
+        return _prom.render_prometheus(server_stats=self.stats())
 
     def stats(self) -> Dict:
         """Server metrics (docs/serving.md): admission counters +

@@ -69,6 +69,12 @@ class TpuSparkSession:
                             SHUFFLE_ICI_DEVICES)) or None
                         PM.set_active_mesh(PM.build_mesh(n))
                         self._owns_mesh = True
+        # live telemetry (docs/observability.md): a session that sets
+        # any spark.rapids.sql.telemetry.* conf arms the process
+        # trigger engine's conf-less hooks (HBM watermark, admission
+        # saturation, retry storm); default sessions never disarm it
+        from spark_rapids_tpu.telemetry import triggers as _telemetry
+        _telemetry.configure(self.conf_obj)
         self.conf = RuntimeConfApi(self.conf_obj)
         self.catalog_views: Dict[str, L.LogicalPlan] = {}
         self._plan_capture: List = []  # ExecutionPlanCaptureCallback twin
@@ -297,6 +303,17 @@ class TpuSparkSession:
                 memory_by_op=(store.owner_stats()
                               if store is not None else None),
                 query_id=qid, tenant=self.tenant)
+        # telemetry query-close triggers (slow query, per-query retry /
+        # kernel-fallback deltas): evaluated AFTER the profile write so
+        # a fired bundle can reference this query's artifact
+        from spark_rapids_tpu.telemetry import triggers as _telemetry
+        _telemetry.on_query_end(
+            self.conf_obj, wall_s, plan=physical, tenant=self.tenant,
+            query_id=qid,
+            # THIS thread's artifact: a concurrent query on the shared
+            # tenant session may overwrite last_profile_path before
+            # the hook runs — the bundle must reference its own query
+            profile_path=self.thread_profile_path())
         return result
 
     def explain_string(self, plan: L.LogicalPlan, physical=None) -> str:

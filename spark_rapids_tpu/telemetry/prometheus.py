@@ -1,0 +1,409 @@
+"""Prometheus text exposition of the process metric registries and the
+QueryServer stats (docs/observability.md "Live telemetry").
+
+Two kinds of families:
+
+- **engine metrics** — every metric key the registries carry, exported
+  as ``srt_<snake_case>`` (prefix families like
+  ``kernelFallbacks.groupbyHash`` become one family with a ``key``
+  label; ``*Time`` metrics convert ns -> seconds with a
+  ``_seconds_total`` suffix). HELP text comes from
+  ``metrics.describe_metric`` — a key that does not resolve is NOT
+  exported (it is counted in ``srt_undescribed_metric_keys``, asserted
+  zero by tier-1), so the endpoint cannot drift from the documented
+  metric tables.
+- **server families** — admission/tenant/cache/store/trigger gauges and
+  counters with names and HELP from :data:`SERVER_FAMILY_HELP`; the
+  tpu-lint ``prom-family`` rule checks every emitted literal name
+  against that table, and the generated observability doc renders the
+  same table, so names can't drift either.
+
+Scrapes go through a **registry-delta aggregator**: per-live-registry
+snapshots are cached and re-read only when the registry's summed
+mutation counter changed, and a registry that is garbage-collected with
+its plan folds its last snapshot into a retired base — counters stay
+MONOTONE across plan lifetimes (a Prometheus `rate()` works), and a
+scrape costs O(changed registries), not O(every metric ever created).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+# name -> (prom type, help). Every literal family name emitted below
+# MUST be a key here (tpu-lint `prom-family`); the observability doc's
+# Prometheus table is generated from this dict.
+SERVER_FAMILY_HELP: Dict[str, Tuple[str, str]] = {
+    "srt_queries_ok_total": ("counter", "queries served successfully"),
+    "srt_queries_err_total": ("counter", "queries that failed"),
+    "srt_uptime_seconds": ("gauge", "server uptime in seconds"),
+    "srt_qps": ("gauge", "successful queries per second since server "
+                         "start"),
+    "srt_admission_in_flight": ("gauge", "queries executing right now"),
+    "srt_admission_queued": ("gauge", "queries waiting for admission"),
+    "srt_admission_admitted_total": ("counter",
+                                     "queries admitted to execute"),
+    "srt_admission_rejected_total": ("counter",
+                                     "queries rejected (queue full or "
+                                     "shutdown)"),
+    "srt_admission_throttled_waits_total": (
+        "counter", "admissions delayed by the fair-share HBM throttle"),
+    "srt_tenant_admitted_total": ("counter",
+                                  "queries admitted per tenant"),
+    "srt_tenant_rejected_total": ("counter",
+                                  "queries rejected per tenant"),
+    "srt_tenant_in_flight": ("gauge", "queries executing per tenant"),
+    "srt_tenant_queue_wait_ms": ("gauge",
+                                 "admission queue wait quantiles per "
+                                 "tenant (ms)"),
+    "srt_tenant_latency_ms": ("gauge",
+                              "end-to-end latency quantiles per "
+                              "tenant (ms)"),
+    "srt_tenant_hbm_live_bytes": ("gauge",
+                                  "live device-store bytes per tenant"),
+    "srt_tenant_hbm_peak_bytes": ("gauge",
+                                  "peak device-store bytes per tenant"),
+    "srt_tenant_hbm_spill_bytes_total": (
+        "counter", "device bytes spilled from the tenant's working "
+                   "set"),
+    "srt_jit_cache_hits_total": ("counter",
+                                 "compile-cache hits per cache"),
+    "srt_jit_cache_misses_total": ("counter",
+                                   "compile-cache misses per cache"),
+    "srt_jit_cache_evictions_total": ("counter",
+                                      "compile-cache evictions per "
+                                      "cache"),
+    "srt_jit_cache_contention_total": (
+        "counter", "threads that blocked on another thread's "
+                   "in-progress compile"),
+    "srt_jit_cache_size": ("gauge", "entries live per compile cache"),
+    "srt_store_device_bytes": ("gauge",
+                               "device-store live HBM bytes"),
+    "srt_store_peak_device_bytes": ("gauge",
+                                    "device-store peak HBM bytes"),
+    "srt_store_host_bytes": ("gauge", "device-store host-tier bytes"),
+    "srt_store_spill_count_total": ("counter",
+                                    "device->host store demotions"),
+    "srt_store_spilled_device_bytes_total": (
+        "counter", "HBM bytes demoted device->host"),
+    "srt_store_disk_files_live": ("gauge",
+                                  "disk-tier spill files believed "
+                                  "live"),
+    "srt_telemetry_triggers_fired_total": (
+        "counter", "telemetry trigger firings per trigger"),
+    "srt_telemetry_triggers_rate_limited_total": (
+        "counter", "trigger firings suppressed by the per-trigger "
+                   "rate limit"),
+    "srt_undescribed_metric_keys": (
+        "gauge", "registry metric keys that did not resolve via "
+                 "describe_metric and were NOT exported (must be 0)"),
+}
+
+
+# ---------------------------------------------------------------------------
+# Registry-delta aggregator
+# ---------------------------------------------------------------------------
+
+class RegistryAggregator:
+    """Monotone totals over every MetricRegistry the process ever
+    created: ``metrics.retired_totals()`` (each registry's FINAL
+    values, folded in by a metrics.py finalizer when the registry is
+    garbage-collected with its plan — a query completing between two
+    scrapes still counts) plus the live registries, whose snapshots are
+    cached and re-read only when their summed metric-mutation counters
+    changed."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # id(registry) -> [version_sum, snapshot]; dropped at GC (the
+        # dead registry's contribution moves to the retired base)
+        self._cache: Dict[int, List] = {}
+        self._finalized: set = set()
+
+    def _drop(self, rid: int) -> None:
+        # finalize path: runs at arbitrary allocation points, so no
+        # locks — dict.pop / set.discard are atomic under the GIL
+        self._cache.pop(rid, None)
+        self._finalized.discard(rid)
+
+    @staticmethod
+    def _read(reg) -> Optional[Tuple[int, Dict[str, int]]]:
+        """(version sum, snapshot) of one registry; None when a
+        concurrent create() mutated the metric dict mid-read (the
+        caller reuses the cached snapshot — next scrape catches up)."""
+        for _ in range(4):
+            try:
+                vsum = 0
+                snap: Dict[str, int] = {}
+                for k, m in reg.metrics.items():
+                    vsum += m.version
+                    snap[k] = m.value
+                return vsum + len(snap), snap
+            except RuntimeError:
+                continue
+        return None
+
+    def scrape(self) -> Tuple[Dict[str, int], int]:
+        """(folded totals per metric key — sums for counters, max for
+        watermark metrics — and the count of changed registries re-read
+        this scrape)."""
+        from spark_rapids_tpu.metrics import (fold_metric,
+                                              live_registries,
+                                              retired_totals)
+        regs = live_registries()
+        changed = 0
+        with self._lock:
+            totals = retired_totals()
+            for reg in regs:
+                rid = id(reg)
+                entry = self._cache.get(rid)
+                if entry is None:
+                    entry = [-1, {}]
+                    self._cache[rid] = entry
+                    if rid not in self._finalized:
+                        self._finalized.add(rid)
+                        weakref.finalize(reg, self._drop, rid)
+                got = self._read(reg)
+                if got is not None and got[0] != entry[0]:
+                    entry[0], entry[1] = got
+                    changed += 1
+                for k, v in entry[1].items():
+                    fold_metric(totals, k, v)
+        return totals, changed
+
+
+_AGG = RegistryAggregator()
+
+
+def aggregator() -> RegistryAggregator:
+    return _AGG
+
+
+# ---------------------------------------------------------------------------
+# Rendering
+# ---------------------------------------------------------------------------
+
+_SNAKE_RE = re.compile(r"([a-z0-9])([A-Z])")
+
+
+def prom_name(key: str) -> str:
+    """camelCase metric base -> srt_snake_case."""
+    s = _SNAKE_RE.sub(r"\1_\2", key).lower()
+    return "srt_" + re.sub(r"[^a-z0-9_]", "_", s)
+
+
+def engine_family(key: str) -> Tuple[str, Optional[Tuple[str, str]],
+                                     bool, bool]:
+    """(family name, optional (label, value), is_seconds, is_gauge)
+    for one registry metric key. Prefix-family members
+    (``base.member``) share one family with a ``key`` label; watermark
+    metrics are gauges (max-folded by the aggregator), everything else
+    a ``_total`` counter."""
+    from spark_rapids_tpu.metrics import is_watermark_metric
+    base, dot, rest = key.partition(".")
+    label = ("key", rest) if dot else None
+    seconds = base.endswith(("Time", "time"))
+    name = prom_name(base)
+    if seconds:
+        name += "_seconds"
+    gauge = is_watermark_metric(base)
+    if not gauge:
+        name += "_total"
+    return name, label, seconds, gauge
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+class _Out:
+    """Family-grouped exposition builder: HELP/TYPE once per family,
+    samples in emission order."""
+
+    def __init__(self):
+        self._fams: "Dict[str, List[str]]" = {}
+        self._meta: Dict[str, Tuple[str, str]] = {}
+
+    def family(self, name: str, ftype: str, help_text: str) -> None:
+        self._meta.setdefault(name, (ftype, help_text))
+        self._fams.setdefault(name, [])
+
+    def sample(self, name: str, value, labels: Dict[str, Any] = None
+               ) -> None:
+        lab = ""
+        if labels:
+            lab = "{" + ",".join(
+                f'{k}="{_escape(v)}"'
+                for k, v in sorted(labels.items())) + "}"
+        if isinstance(value, float):
+            sval = repr(round(value, 9))
+        else:
+            sval = str(int(value))
+        self._fams.setdefault(name, []).append(f"{name}{lab} {sval}")
+
+    def text(self) -> str:
+        lines: List[str] = []
+        for name in sorted(self._fams):
+            ftype, help_text = self._meta.get(name, ("untyped", ""))
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {ftype}")
+            lines.extend(self._fams[name])
+        return "\n".join(lines) + "\n"
+
+
+def _emit_server(out: "_Out", name: str, value,
+                 labels: Dict[str, Any] = None) -> None:
+    ftype, help_text = SERVER_FAMILY_HELP[name]
+    out.family(name, ftype, help_text)
+    out.sample(name, value, labels)
+
+
+def render_prometheus(server_stats: Optional[Dict] = None) -> str:
+    """The full exposition: engine registry totals + store/jit-cache/
+    trigger process families + (when given) the QueryServer's
+    admission/tenant stats."""
+    from spark_rapids_tpu import memory
+    from spark_rapids_tpu.jit_cache import cache_stats
+    from spark_rapids_tpu.metrics import describe_metric
+    from spark_rapids_tpu.telemetry import triggers as _triggers
+    out = _Out()
+
+    totals, _changed = _AGG.scrape()
+    undescribed = 0
+    for key in sorted(totals):
+        desc = describe_metric(key)
+        if desc is None:
+            undescribed += 1
+            continue
+        name, label, seconds, gauge = engine_family(key)
+        out.family(name, "gauge" if gauge else "counter", desc)
+        value = totals[key] / 1e9 if seconds else totals[key]
+        out.sample(name, float(value) if seconds else value,
+                   dict([label]) if label else None)
+    _emit_server(out, "srt_undescribed_metric_keys", undescribed)
+
+    store = memory._STORE
+    if store is not None:
+        st = store.stats()
+        _emit_server(out, "srt_store_device_bytes", st["deviceBytes"])
+        _emit_server(out, "srt_store_peak_device_bytes",
+                     st["peakDeviceBytes"])
+        _emit_server(out, "srt_store_host_bytes", st["hostBytes"])
+        _emit_server(out, "srt_store_spill_count_total",
+                     st["spillCount"])
+        _emit_server(out, "srt_store_spilled_device_bytes_total",
+                     st["spilledDeviceBytes"])
+        _emit_server(out, "srt_store_disk_files_live",
+                     st["diskFilesLive"])
+        for tenant, ts in store.tenant_stats().items():
+            lab = {"tenant": tenant}
+            _emit_server(out, "srt_tenant_hbm_live_bytes",
+                         ts["liveBytes"], lab)
+            _emit_server(out, "srt_tenant_hbm_peak_bytes",
+                         ts["peakBytes"], lab)
+            _emit_server(out, "srt_tenant_hbm_spill_bytes_total",
+                         ts["spillBytes"], lab)
+
+    for cache, cs in sorted(cache_stats().items()):
+        lab = {"cache": cache}
+        _emit_server(out, "srt_jit_cache_hits_total", cs["hits"], lab)
+        _emit_server(out, "srt_jit_cache_misses_total", cs["misses"],
+                     lab)
+        _emit_server(out, "srt_jit_cache_evictions_total",
+                     cs["evictions"], lab)
+        _emit_server(out, "srt_jit_cache_contention_total",
+                     cs["contention"], lab)
+        _emit_server(out, "srt_jit_cache_size", cs["size"], lab)
+
+    tstats = _triggers.engine().stats()
+    for trig, n in sorted(tstats["fired"].items()):
+        _emit_server(out, "srt_telemetry_triggers_fired_total", n,
+                     {"trigger": trig})
+    for trig, n in sorted(tstats["rateLimited"].items()):
+        _emit_server(out, "srt_telemetry_triggers_rate_limited_total",
+                     n, {"trigger": trig})
+
+    if server_stats:
+        _emit_server(out, "srt_queries_ok_total",
+                     server_stats.get("queriesOk", 0))
+        _emit_server(out, "srt_queries_err_total",
+                     server_stats.get("queriesErr", 0))
+        _emit_server(out, "srt_uptime_seconds",
+                     float(server_stats.get("uptimeSeconds", 0.0)))
+        _emit_server(out, "srt_qps",
+                     float(server_stats.get("qps", 0.0)))
+        adm = server_stats.get("admission", {})
+        _emit_server(out, "srt_admission_in_flight",
+                     adm.get("inFlight", 0))
+        _emit_server(out, "srt_admission_queued", adm.get("queued", 0))
+        _emit_server(out, "srt_admission_admitted_total",
+                     adm.get("admitted", 0))
+        _emit_server(out, "srt_admission_rejected_total",
+                     adm.get("rejected", 0))
+        _emit_server(out, "srt_admission_throttled_waits_total",
+                     adm.get("throttledWaits", 0))
+        for tenant, ts in sorted(adm.get("tenants", {}).items()):
+            lab = {"tenant": tenant}
+            _emit_server(out, "srt_tenant_admitted_total",
+                         ts.get("admitted", 0), lab)
+            _emit_server(out, "srt_tenant_rejected_total",
+                         ts.get("rejected", 0), lab)
+            _emit_server(out, "srt_tenant_in_flight",
+                         ts.get("inFlight", 0), lab)
+            for q, v in ts.get("queueWaitMs", {}).items():
+                _emit_server(out, "srt_tenant_queue_wait_ms",
+                             float(v), {**lab, "quantile": q})
+            for q, v in ts.get("latencyMs", {}).items():
+                if q == "count":
+                    continue
+                _emit_server(out, "srt_tenant_latency_ms", float(v),
+                             {**lab, "quantile": q})
+    return out.text()
+
+
+# ---------------------------------------------------------------------------
+# HTTP twin (`tools serve --metrics-port`)
+# ---------------------------------------------------------------------------
+
+def serve_http_metrics(render_fn, port: int, host: str = "127.0.0.1"):
+    """Serve ``GET /metrics`` (Prometheus text via ``render_fn``) on a
+    daemon thread; returns the httpd (``.shutdown()`` +
+    ``.server_close()`` to stop). ``render_fn`` is called per request
+    so scrapes always see current state."""
+    import json as _json
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 - http.server API
+            path = self.path.split("?", 1)[0].rstrip("/") or "/metrics"
+            if path in ("/metrics", "/"):
+                try:
+                    body = render_fn().encode("utf-8")
+                    ctype = "text/plain; version=0.0.4"
+                    code = 200
+                except Exception as e:  # pragma: no cover - defensive
+                    body = _json.dumps({"error": str(e)}).encode()
+                    ctype = "application/json"
+                    code = 500
+            else:
+                body = b"not found (try /metrics)\n"
+                ctype = "text/plain"
+                code = 404
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # silence per-request stderr
+            pass
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    t = threading.Thread(target=httpd.serve_forever,
+                         name="srt-metrics-http", daemon=True)
+    t.start()
+    return httpd

@@ -480,13 +480,18 @@ def _main(argv: List[str]) -> int:
     ap.add_argument("command",
                     choices=["qualify", "profile", "docs", "trace",
                              "hotspots", "serve", "serve-client",
-                             "lint"])
+                             "lint", "top", "bench-diff"])
     ap.add_argument("sql", nargs="?", help="SQL text to analyze (live "
                     "mode; omit when using --log), the trace "
                     "file/directory for the trace/hotspots commands, "
-                    "or a profile-*.json file/directory for the "
+                    "a profile-*.json file/directory for the "
                     "profile command (spark.rapids.sql.profile.dir "
-                    "output)")
+                    "output), the server port for `top`, or the "
+                    "BASELINE bench JSON for `bench-diff`")
+    ap.add_argument("paths", nargs="*",
+                    help="bench-diff: the CANDIDATE bench JSON, or a "
+                    "directory holding BENCH_r*.json files (the "
+                    "newest round is the candidate)")
     ap.add_argument("--view", action="append", default=[],
                     help="name=path parquet view registrations")
     ap.add_argument("--log", help="offline mode: event-log file or "
@@ -515,6 +520,17 @@ def _main(argv: List[str]) -> int:
     ap.add_argument("--root", default=None,
                     help="lint: repo root to analyze (default: the "
                     "installed package's parent directory)")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve: also serve GET /metrics (Prometheus "
+                    "text) over HTTP on this port (0 = ephemeral)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="top: seconds between stats polls")
+    ap.add_argument("--iterations", type=int, default=0,
+                    help="top: frames to render before exiting "
+                    "(0 = until interrupted)")
+    ap.add_argument("--threshold", type=float, default=None,
+                    help="bench-diff: relative regression threshold "
+                    "for gating checks (default 0.10)")
     # intermixed: `serve-client --port N "SELECT ..."` must parse (the
     # plain parser cannot allocate a positional after optionals)
     args = ap.parse_intermixed_args(argv)
@@ -530,6 +546,23 @@ def _main(argv: List[str]) -> int:
         return _serve_main(args)
     if args.command == "serve-client":
         return _serve_client_main(args, ap)
+
+    if args.command == "top":
+        from spark_rapids_tpu.telemetry.top import run_top
+        target = args.sql or (str(args.port) if args.port else None)
+        if not target:
+            ap.error("top requires the server port (or host:port)")
+        host, _, port_s = target.rpartition(":")
+        try:
+            port = int(port_s)
+        except ValueError:
+            ap.error(f"top: not a port: {target!r}")
+        return run_top(port, host=host or args.host or "127.0.0.1",
+                       interval=args.interval,
+                       iterations=args.iterations)
+
+    if args.command == "bench-diff":
+        return _bench_diff_main(args, ap)
 
     if args.command == "profile":
         # offline renderer: a path argument means "render the written
@@ -566,22 +599,34 @@ def _main(argv: List[str]) -> int:
         if not path:
             ap.error("provide a trace file or directory "
                      "(spark.rapids.sql.trace.dir output)")
+        # a path that does not exist is an ERROR (clean message, exit
+        # 1, never a stack trace); an existing-but-empty trace dir is
+        # a normal answer ("no spans found", exit 0) — an untraced or
+        # idle-ring deployment must not fail automation that tails it
+        if not os.path.exists(path):
+            print(f"no such trace file or directory: {path}")
+            return 1
         if os.path.isdir(path):
             files = sorted(
                 os.path.join(path, f) for f in os.listdir(path)
                 if f.startswith("trace-") and f.endswith(".json"))
             if not files:
-                print(f"no trace-*.json files in {path}")
-                return 1
+                print(f"no spans found (no trace-*.json files in "
+                      f"{path})")
+                return 0
         else:
             files = [path]
-        if args.command == "hotspots":
-            print(hotspots_report(files, top=args.top))
-            return 0
-        for i, fp in enumerate(files):
-            if i:
-                print()
-            print(format_trace_report(fp, top=args.top))
+        try:
+            if args.command == "hotspots":
+                print(hotspots_report(files, top=args.top))
+                return 0
+            for i, fp in enumerate(files):
+                if i:
+                    print()
+                print(format_trace_report(fp, top=args.top))
+        except (ValueError, KeyError) as e:  # incl. JSONDecodeError
+            print(f"not a readable Chrome-trace file: {e}")
+            return 1
         return 0
 
     if args.command == "docs":
@@ -628,10 +673,45 @@ def _main(argv: List[str]) -> int:
 
 
 
+def _bench_diff_main(args, ap) -> int:
+    """`tools bench-diff <a> <b|dir>`: exit 0 when no gating check
+    regressed, 1 on regression, 2 on unusable inputs
+    (docs/observability.md 'Live telemetry')."""
+    import json as _json
+    import os
+
+    from spark_rapids_tpu.telemetry.bench_diff import (
+        DEFAULT_THRESHOLD, bench_diff, format_diff, latest_bench_file)
+    if not args.sql or not args.paths:
+        ap.error("bench-diff requires <baseline.json> "
+                 "<candidate.json | dir>")
+    a, b = args.sql, args.paths[0]
+    if os.path.isdir(b):
+        picked = latest_bench_file(b, exclude=a)
+        if picked is None:
+            print(f"no BENCH_r*.json files in {b}")
+            return 2
+        b = picked
+    for p in (a, b):
+        if not os.path.exists(p):
+            print(f"no such bench file: {p}")
+            return 2
+    try:
+        report = bench_diff(
+            a, b, threshold=(args.threshold if args.threshold is not None
+                             else DEFAULT_THRESHOLD))
+    except ValueError as e:
+        print(f"bench-diff: {e}")
+        return 2
+    print(_json.dumps(report, indent=2) if args.json
+          else format_diff(report))
+    return 1 if report["verdict"] == "regression" else 0
+
+
 def _serve_main(args) -> int:
     """`tools serve`: run the query server until interrupted
     (docs/serving.md). Views from --view name=path, confs from
-    --conf key=value."""
+    --conf key=value; --metrics-port adds the Prometheus HTTP twin."""
     import json as _json
     import signal
     import threading
@@ -643,11 +723,15 @@ def _serve_main(args) -> int:
         conf[k.strip()] = v.strip()
     srv = QueryServer(conf, host=args.host, port=args.port)
     srv.start()
+    metrics_port = None
+    if args.metrics_port is not None:
+        metrics_port = srv.start_metrics_http(args.metrics_port)
     for v in args.view:
         name, _, path = v.partition("=")
         srv.register_view(name, path)
     print(_json.dumps({"event": "serving", "host": srv.host,
                        "port": srv.port,
+                       "metricsPort": metrics_port,
                        "views": sorted(v.partition("=")[0]
                                        for v in args.view)}),
           flush=True)
@@ -861,7 +945,8 @@ def generate_observability_docs() -> str:
     ]
     for e in sorted(C.registered_entries(), key=lambda e: e.key):
         if e.key.startswith(("spark.rapids.sql.trace.",
-                             "spark.rapids.sql.profile.")) \
+                             "spark.rapids.sql.profile.",
+                             "spark.rapids.sql.telemetry.")) \
                 or e.key == "spark.rapids.sql.explain":
             lines.append(f"| {e.key} | {e.default} | {e.doc} |")
     lines += [
@@ -973,6 +1058,127 @@ def generate_observability_docs() -> str:
         "the same id appears in the profile artifact and the trace",
         "file's `otherData.tenant`, and admission waits show up as",
         "`serveQueueWait` spans.",
+        "",
+        "## Live telemetry",
+        "",
+        "The serving tier's always-on observability layer",
+        "(spark_rapids_tpu/telemetry/): file traces and profile",
+        "artifacts are opt-in *per query*, but on a long-lived",
+        "multi-tenant server the interesting query is the one you",
+        "didn't pre-instrument — the p99 outlier, the retry storm, the",
+        "tenant whose ledger tripped an over-share spill.",
+        "",
+        "### Flight recorder (`spark.rapids.sql.trace.mode=ring`)",
+        "",
+        "The existing Tracer grows a second sink: a fixed-size,",
+        "lock-free ring buffer keeping the last",
+        "`spark.rapids.sql.trace.ringSpans` spans/instants/counter",
+        "samples PER THREAD, always on (query server sessions default",
+        "to it), bounded memory, near-zero overhead (the bench's",
+        "`detail.telemetry` leg measures the q1 ring-on/off ratio",
+        "against a <= 1.05x budget). `telemetry.dump_ring(dir)` — or a",
+        "trigger firing — writes the rings as a standard Chrome-trace",
+        "file (`trace-ring-<pid>-<n>.json`), so Perfetto,",
+        "`tools trace` and `tools hotspots` work unchanged on dumps.",
+        "`tools trace`/`tools hotspots` on an empty or span-free trace",
+        "directory print `no spans found` and exit 0 (an idle recorder",
+        "is a normal answer, not an error); a nonexistent path errors",
+        "with exit 1.",
+        "",
+        "### Triggers and slow-query bundles",
+        "",
+        "Declarative conditions evaluated where they become true, each",
+        "emitting one *bundle* (`bundle-<pid>-<n>-<trigger>.json`",
+        "under `spark.rapids.sql.telemetry.dir`) that ties together",
+        "the ring dump, the query's profile-artifact path (when",
+        "profiling is on), a server stats snapshot (when a QueryServer",
+        "is up), the device-store stats, and the triggering condition:",
+        "",
+        "| Trigger | Condition | Evaluated at |",
+        "|---|---|---|",
+        "| slowQuery | query wall > telemetry.slowQueryMs | query "
+        "close |",
+        "| retryCount | per-query retry+split deltas > telemetry."
+        "retryCountThreshold | query close |",
+        "| kernelFallbacks | per-query kernelFallbacks.* delta > "
+        "telemetry.kernelFallbackThreshold | query close |",
+        "| retryStorm | > telemetry.retryStormThreshold OOM retries "
+        "in a 60 s window | retry time |",
+        "| hbmWatermark | store live bytes > telemetry.hbmWatermark x "
+        "pool budget | every store transition |",
+        "| queueSaturation | admission depth > telemetry."
+        "queueWatermark x serve.maxQueued | every enqueue |",
+        "",
+        "Per-trigger rate limiting (`telemetry.triggerMinIntervalS`)",
+        "bounds disk pressure under a storm (suppressed firings count",
+        "in the engine stats and on the endpoint); bundle IO runs on a",
+        "dedicated daemon thread so no query, store or admission path",
+        "blocks on a file write. The store/admission/retry triggers",
+        "arm when any session sets a `spark.rapids.sql.telemetry.*`",
+        "conf.",
+        "",
+        "### Prometheus endpoint",
+        "",
+        "The QueryServer's `metrics` protocol verb (alias",
+        "`stats-stream`; `ServeClient.metrics()`), and the",
+        "`tools serve --metrics-port N` HTTP twin (`GET /metrics`),",
+        "export one text exposition per scrape: every registry metric",
+        "as `srt_<snake_case>[_seconds]_total` (prefix families like",
+        "`kernelFallbacks.groupbyHash` become one family with a",
+        "`key` label; `*Time` metrics convert ns to seconds; `peak*`",
+        "metrics are gauges folded by MAX across registries, not",
+        "summed — a high-watermark, never a sum of dead plans' peaks),",
+        "HELP text from `describe_metric` — an",
+        "undescribed key is NOT exported and counts in",
+        "`srt_undescribed_metric_keys`, which tier-1 asserts is 0.",
+        "Scrapes run through a registry-delta aggregator: per-registry",
+        "snapshots are cached against metric mutation counters (a",
+        "scrape re-reads only registries that changed) and registries",
+        "garbage-collected with their plans fold into a retired base,",
+        "so counters stay MONOTONE across plan lifetimes. Server-level",
+        "families:",
+        "",
+        "| Family | Type | Help |",
+        "|---|---|---|",
+    ]
+    from spark_rapids_tpu.telemetry.prometheus import SERVER_FAMILY_HELP
+    for name, (ftype, help_text) in sorted(SERVER_FAMILY_HELP.items()):
+        lines.append(f"| `{name}` | {ftype} | {help_text} |")
+    lines += [
+        "",
+        "`tools top <port>` renders a refreshing terminal table over",
+        "the same stats (tenants x QPS / p50 / p99 / queue wait / live",
+        "HBM / in-flight / rejections; `--interval`, `--iterations`).",
+        "",
+        "### Regression tracking (`tools bench-diff`)",
+        "",
+        "`tools bench-diff <baseline.json> <candidate.json|dir>` diffs",
+        "two bench outputs — headline rows/s, device walls, decode",
+        "overlap, kernel A/B, serving QPS, tracing/profiling/ring",
+        "overheads — against a relative `--threshold` (default 10%),",
+        "prints a verdict table (`--json` for machines), and exits 1",
+        "when a gating check regressed; bench.py runs it against the",
+        "previous BENCH_r0*.json every round (`detail.telemetry.",
+        "benchDiff`). Informational checks (CPU-engine wall, retry",
+        "counters) report but never gate.",
+        "",
+        "### Span catalog",
+        "",
+        "Every explicit span/instant kind the engine records (the",
+        "tpu-lint `span-kind` rule pins literal recording sites to",
+        "these tables; metric-mirror spans are the dynamic",
+        "`<Exec>.<metric>` family covered by `metric-key`):",
+        "",
+        "| Span kind | Meaning |",
+        "|---|---|",
+    ]
+    from spark_rapids_tpu.trace import INSTANT_CATALOG, SPAN_CATALOG
+    for kind, desc in sorted(SPAN_CATALOG.items()):
+        lines.append(f"| `{kind}` | {desc} |")
+    lines += ["", "| Instant kind | Meaning |", "|---|---|"]
+    for kind, desc in sorted(INSTANT_CATALOG.items()):
+        lines.append(f"| `{kind}` | {desc} |")
+    lines += [
         "",
         "## Metric-name reference",
         "",

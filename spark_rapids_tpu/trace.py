@@ -70,6 +70,83 @@ TRACE_SAMPLE_SEED = conf("spark.rapids.sql.trace.sampleSeed").doc(
     "Seed of the deterministic query-sampling stream used by "
     "spark.rapids.sql.trace.sampleRate.").integer(0)
 
+TRACE_MODE = conf("spark.rapids.sql.trace.mode").doc(
+    "Trace sink: 'file' writes one Chrome-trace JSON per sampled query "
+    "(the per-query exporter); 'ring' is the FLIGHT RECORDER — an "
+    "always-on, fixed-size, lock-free per-thread ring buffer that "
+    "survives across queries with bounded memory (the last "
+    "spark.rapids.sql.trace.ringSpans records per thread) and dumps on "
+    "demand — slow-query triggers (spark.rapids.sql.telemetry.*) or "
+    "telemetry.dump_ring() — as the SAME Chrome-trace JSON, so `tools "
+    "trace`/`tools hotspots` work unchanged on dumps. Query server "
+    "sessions default to 'ring' (docs/observability.md 'Live "
+    "telemetry').").string("file")
+
+TRACE_RING_SPANS = conf("spark.rapids.sql.trace.ringSpans").doc(
+    "Flight-recorder capacity in trace.mode=ring: spans (and instants "
+    "/ counter samples) retained PER THREAD before the oldest are "
+    "overwritten. Bounds recorder memory on a long-lived server; a "
+    "dump reconstructs the most recent window of work."
+    ).integer(4096)
+
+
+# ---------------------------------------------------------------------------
+# Span catalog (docs/observability.md; the tpu-lint `span-kind` rule
+# checks every literal span/instant kind recorded in the package
+# against these tables, so a dump's vocabulary can never drift from
+# the documentation). Metric-mirror spans are the dynamic family
+# `<Exec>.<metric>` — every member resolves via metrics.describe_metric
+# and is covered by the `metric-key` rule instead.
+# ---------------------------------------------------------------------------
+
+SPAN_CATALOG: Dict[str, str] = {
+    "scanPrefetch": "scan producer thread reading+packing one staged "
+                    "batch (mirrors scanPrefetchTime)",
+    "uploadAhead": "async raw-chunk device_put issued ahead of the "
+                   "consuming stage (docs/scan.md)",
+    "finishUpload": "host->device upload completion per staging mode "
+                    "and chip",
+    "TpuFusedStageExec.dispatch": "one fused-stage device program "
+                                  "dispatch (chip, batch seq, compile "
+                                  "flag)",
+    "TpuHashAggregateExec.dispatch": "one aggregation device program "
+                                     "dispatch (mode, kernel= attr)",
+    "kernelDispatch": "one Pallas kernel dispatch (kernel= names it; "
+                      "docs/kernels.md)",
+    "exchangeMaterialize": "exchange input drain + partition "
+                           "materialization",
+    "meshStack": "per-device shard assembly into the globally-sharded "
+                 "stack (ICI exchange)",
+    "meshSizeExchange": "all-to-all partition-size exchange over the "
+                        "mesh",
+    "meshExchange": "HBM-resident all-to-all data exchange over the "
+                    "mesh",
+    "compile": "JIT build+compile on a cache miss (cache= names the "
+               "LRU)",
+    "semaphoreWait": "wall blocked on the device semaphore",
+    "serveQueueWait": "admission-queue wait of a served query "
+                      "(docs/serving.md)",
+    "spillToHost": "device->host store demotion",
+    "spillToDisk": "host->disk store demotion",
+    "promoteFromDisk": "disk->host store promotion",
+    "promoteToDevice": "host->device store promotion",
+    "retryBlock": "spill+backoff recovery inside an OOM retry (the "
+                  "retryBlockTime interval)",
+}
+
+INSTANT_CATALOG: Dict[str, str] = {
+    "retryOOM": "an OOM retry re-attempted the operation",
+    "splitRetry": "an input batch split in half after OOM exhaustion",
+    "ioRetry": "a transient reader IO error was retried",
+    "chipFailure": "a mesh chip was demoted after persistent failure",
+    "compileCacheContention": "a thread blocked on another thread's "
+                              "in-progress compile of the same key",
+    "queryEnd": "a query finished while the ring recorder was active "
+                "(wallSeconds/rows/error attrs)",
+    "telemetryTrigger": "a telemetry trigger fired (trigger= names it; "
+                        "docs/observability.md 'Live telemetry')",
+}
+
 
 # ---------------------------------------------------------------------------
 # Active-trace state (process-wide, like the DeviceStore / FaultInjector)
@@ -133,6 +210,10 @@ def _clean(attrs: dict) -> Optional[dict]:
 # load when tracing is off). Guarded by _LOCK only for begin/end.
 _ACTIVE: Optional[QueryTrace] = None
 _LOCK = threading.Lock()
+# an installed flight recorder parked while a file-mode root query
+# owns _ACTIVE: the ring is process-lifetime state and a file trace
+# must not destroy it (restored when the file trace closes)
+_RING_STASH: Optional[QueryTrace] = None
 _DEPTH = 0           # nested execute_plan calls (scalar subqueries)
 _SEQ = 0             # traced-candidate query counter (sampling stream)
 _RNG: Optional[random.Random] = None
@@ -143,13 +224,22 @@ def active() -> Optional[QueryTrace]:
     return _ACTIVE
 
 
+def ring_active():
+    """The installed flight recorder (telemetry.ring.RingTrace) when
+    trace.mode=ring has been activated, else None."""
+    qt = _ACTIVE
+    return qt if getattr(qt, "is_ring", False) else None
+
+
 def reset_tracing() -> None:
     """Drop the sampling stream + query counter so the next query sees
     a fresh deterministic schedule (tests call this between runs, like
-    retry.reset_fault_injection)."""
-    global _ACTIVE, _DEPTH, _SEQ, _RNG, _RNG_SEED
+    retry.reset_fault_injection). Uninstalls an active ring recorder
+    too."""
+    global _ACTIVE, _DEPTH, _SEQ, _RNG, _RNG_SEED, _RING_STASH
     with _LOCK:
         _ACTIVE = None
+        _RING_STASH = None
         _DEPTH = 0
         _SEQ = 0
         _RNG = None
@@ -159,14 +249,37 @@ def reset_tracing() -> None:
 def begin_query(conf_obj) -> Optional[str]:
     """Start (or join) a query trace. Returns an opaque token for
     ``end_query`` — ``None`` when tracing is disabled, ``"root"`` when
-    this call opened the trace, ``"nested"``/``"unsampled"`` otherwise.
-    Nested queries (scalar subqueries executed during planning) fold
-    their spans into the outer query's trace; so does a concurrent
-    query from another session thread (documented limitation — span
-    streams are a property of the process timeline)."""
-    global _ACTIVE, _DEPTH, _SEQ, _RNG, _RNG_SEED
+    this call opened the trace, ``"ring"`` when the flight recorder is
+    the sink (trace.mode=ring — installed on first use, shared by
+    every query for the process life), ``"nested"``/``"unsampled"``
+    otherwise. Nested queries (scalar subqueries executed during
+    planning) fold their spans into the outer query's trace; so does a
+    concurrent query from another session thread (documented
+    limitation — span streams are a property of the process
+    timeline)."""
+    global _ACTIVE, _DEPTH, _SEQ, _RNG, _RNG_SEED, _RING_STASH
     if conf_obj is None or not bool(conf_obj.get(TRACE_ENABLED)):
         return None
+    if str(conf_obj.get(TRACE_MODE)).lower() == "ring":
+        # flight recorder: always on once installed, never sampled,
+        # never cleared at query end — the interesting query is the
+        # one you didn't pre-instrument. A query that begins while a
+        # file-mode trace is open folds into that trace instead (the
+        # nested-scope contract above).
+        with _LOCK:
+            if _ACTIVE is None:
+                from spark_rapids_tpu.telemetry.ring import RingTrace
+                from spark_rapids_tpu.conf import SERVE_TENANT_ID
+                _ACTIVE = RingTrace(
+                    int(conf_obj.get(TRACE_RING_SPANS)),
+                    tenant=str(conf_obj.get(SERVE_TENANT_ID)) or None)
+            elif not getattr(_ACTIVE, "is_ring", False):
+                # a file-mode trace is open: fold into it WITHOUT
+                # touching its depth bookkeeping (the "folded" token
+                # is a no-op at end_query)
+                return "folded"
+            _ACTIVE.queries_begun += 1
+            return "ring"
     with _LOCK:
         _DEPTH += 1
         if _DEPTH > 1:
@@ -181,6 +294,11 @@ def begin_query(conf_obj) -> Optional[str]:
             if _RNG.random() >= rate:
                 return "unsampled"
         from spark_rapids_tpu.conf import SERVE_TENANT_ID
+        if getattr(_ACTIVE, "is_ring", False):
+            # park the process-lifetime flight recorder for the file
+            # trace's duration — a file-mode query must not destroy
+            # the ring's accumulated history (restored at end_query)
+            _RING_STASH = _ACTIVE
         _ACTIVE = QueryTrace(
             _SEQ, tenant=str(conf_obj.get(SERVE_TENANT_ID)) or None)
         return "root"
@@ -191,14 +309,26 @@ def end_query(conf_obj, token: Optional[str], wall_s: float = 0.0,
     """Close a ``begin_query`` scope; on the outermost sampled close,
     write the Chrome-trace file and return its path. Failures never
     break the query (observability must not take down execution)."""
-    global _ACTIVE, _DEPTH
+    global _ACTIVE, _DEPTH, _RING_STASH
     if token is None:
+        return None
+    if token == "folded":
+        return None
+    if token == "ring":
+        # the recorder stays installed; the query leaves only a
+        # boundary marker (the trigger engine receives wall/rows via
+        # its own query-end hook, telemetry/triggers.py)
+        qt = ring_active()
+        if qt is not None:
+            qt.mark("queryEnd", wallSeconds=round(wall_s, 6), rows=rows,
+                    error=bool(error) or None)
         return None
     with _LOCK:
         _DEPTH = max(0, _DEPTH - 1)
         if token != "root":
             return None
-        qt, _ACTIVE = _ACTIVE, None
+        # reinstall a parked flight recorder, if any
+        qt, _ACTIVE, _RING_STASH = _ACTIVE, _RING_STASH, None
     if qt is None:
         return None
     try:
@@ -343,12 +473,8 @@ def write_chrome_trace(path: str, qt: QueryTrace, wall_s: float = 0.0,
     by_thread: Dict[int, List[Tuple]] = {}
     for s in qt.spans:
         by_thread.setdefault(s[3], []).append(s)
-    for ins in qt.instants:
-        by_thread.setdefault(ins[2], [])
     tid = 1
-    tid_of: Dict[int, int] = {}
     for ident in sorted(by_thread):
-        tid_of[ident] = tid
         ev, lanes = _lane_events(by_thread[ident], base, pid, tid)
         name = qt._thread_names.get(ident, str(ident))
         for li in range(lanes):
@@ -358,12 +484,26 @@ def write_chrome_trace(path: str, qt: QueryTrace, wall_s: float = 0.0,
                                     else f"{name}!{li}"}})
         events.extend(ev)
         tid += lanes
-    for kind, t_ns, ident, attrs in qt.instants:
-        ev = {"name": kind, "ph": "i", "s": "t", "pid": pid,
-              "tid": tid_of.get(ident, 0), "ts": _us(t_ns, base)}
-        if attrs:
-            ev["args"] = attrs
-        events.append(ev)
+    # instants get a dedicated lane per source thread, time-sorted:
+    # sharing the span lane would interleave timestamps out of order
+    # (a ring dump always carries markers older than the lane's last
+    # span end), breaking the per-tid monotonicity the schema test —
+    # and Perfetto's track model — expect
+    ins_by_thread: Dict[int, List[Tuple]] = {}
+    for ins in qt.instants:
+        ins_by_thread.setdefault(ins[2], []).append(ins)
+    for ident in sorted(ins_by_thread):
+        name = qt._thread_names.get(ident, str(ident))
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": tid, "args": {"name": f"{name}!i"}})
+        for kind, t_ns, _ident, attrs in sorted(
+                ins_by_thread[ident], key=lambda i: i[1]):
+            ev = {"name": kind, "ph": "i", "s": "t", "pid": pid,
+                  "tid": tid, "ts": _us(t_ns, base)}
+            if attrs:
+                ev["args"] = attrs
+            events.append(ev)
+        tid += 1
     if qt.counters:
         # counter tracks get a lane of their own: samples from many
         # threads interleave in append order, so sort by time to keep

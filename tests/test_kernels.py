@@ -551,15 +551,24 @@ def test_kernel_dispatch_spans_and_hotspots(tmp_path):
 
 
 def test_hotspots_cli_exit_contract(tmp_path):
+    # PR 12 contract: an EXISTING but empty trace dir is a normal
+    # answer ("no spans found", exit 0) — an idle ring recorder must
+    # not fail automation tailing it; a missing path stays an error
     import subprocess
     import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     out = subprocess.run(
         [sys.executable, "-m", "spark_rapids_tpu.tools", "hotspots",
          str(tmp_path)],
-        capture_output=True, text=True,
-        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    assert out.returncode == 1  # empty dir: no trace files
-    assert "no trace-*.json" in out.stdout
+        capture_output=True, text=True, cwd=repo)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "no spans found" in out.stdout
+    out = subprocess.run(
+        [sys.executable, "-m", "spark_rapids_tpu.tools", "hotspots",
+         str(tmp_path / "does-not-exist")],
+        capture_output=True, text=True, cwd=repo)
+    assert out.returncode == 1
+    assert "no such trace file or directory" in out.stdout
 
 
 # ---------------------------------------------------------------------------
