@@ -954,6 +954,173 @@ def run_serving(clean_wall: float, cpu_rows, q3_cpu_rows) -> dict:
         srv.shutdown()
 
 
+def run_lifecycle(clean_wall: float, cpu_rows) -> dict:
+    """detail.lifecycle (docs/serving.md "Query lifecycle"): cancel
+    latency p50/p99 (cancel verb fired against a running q1; latency =
+    cancel send -> status:cancelled on the submitter's wire), a
+    deadline leg asserting the cancelled response lands within the
+    deadline + one batch interval, graceful-drain wall with in-flight
+    queries, and the poison-query quarantine's fail-fast behavior."""
+    import threading
+
+    from spark_rapids_tpu import lifecycle as LC
+    from spark_rapids_tpu import retry as R
+    from spark_rapids_tpu.serve import QueryServer, ServeClient
+    from spark_rapids_tpu.serve.client import ServeCancelled, ServeError
+    from spark_rapids_tpu.serve.scheduler import percentile
+    fresh_leg()
+    conf = dict(TPU_CONF)
+    conf.update({
+        "spark.rapids.sql.serve.maxConcurrentQueries": "4",
+        "spark.rapids.sql.serve.maxQueued": "16",
+        "spark.rapids.sql.serve.maxConcurrentPerTenant": "4",
+    })
+    try:
+        srv = QueryServer(conf).start()
+    except OSError as e:
+        return {"skipped": True, "reason": f"cannot bind: {e!r}"}
+    cancel_lat: list = []
+    completed_before_cancel = 0
+    deadline_leg = {}
+    try:
+        srv.register_view("lineitem", DATA_DIR)
+        with ServeClient(srv.port, tenant="warm") as c:
+            b, _ = c.sql(Q1)
+            assert_rows_match(cpu_rows, [tuple(r) for r in b.rows()])
+
+        # -- cancel latency: q1 runs multiple seconds at SF1, so a
+        # cancel fired shortly after submit lands mid-execution
+        for i in range(5):
+            state = {}
+            done = threading.Event()
+
+            def submit(qid=f"bench-cancel-{i}"):
+                try:
+                    with ServeClient(srv.port, tenant="cancelme") as c:
+                        c.sql(Q1, query_id=qid)
+                        state["outcome"] = "ok"
+                except ServeCancelled:
+                    state["t_resp"] = time.perf_counter()
+                    state["outcome"] = "cancelled"
+                except ServeError as e:
+                    state["outcome"] = f"error: {e}"
+                finally:
+                    done.set()
+
+            t = threading.Thread(target=submit)
+            t.start()
+            time.sleep(0.3)
+            t_cancel = time.perf_counter()
+            with ServeClient(srv.port) as cc:
+                n = cc.cancel(query_id=f"bench-cancel-{i}",
+                              tenant="cancelme")
+            done.wait(timeout=120)
+            t.join(timeout=10)
+            if n and state.get("outcome") == "cancelled":
+                cancel_lat.append(state["t_resp"] - t_cancel)
+            else:
+                completed_before_cancel += 1
+
+        # -- deadline: the cancelled response must land within the
+        # deadline + one batch interval (acceptance criterion)
+        deadline_ms = 400
+        t0 = time.perf_counter()
+        try:
+            with ServeClient(srv.port, tenant="deadline") as c:
+                c.sql(Q1, timeout_ms=deadline_ms)
+            deadline_leg = {"outcome": "completed under deadline"}
+        except ServeCancelled as e:
+            resp_ms = (time.perf_counter() - t0) * 1e3
+            deadline_leg = {
+                "outcome": "cancelled",
+                "reason": e.reason,
+                "deadlineMs": deadline_ms,
+                "responseMs": round(resp_ms, 1),
+                # one batch interval of slack: the checkpoint slice is
+                # 50ms; generous bound for the verdict flag
+                "withinBound": resp_ms <= deadline_ms + 1000,
+            }
+
+        # -- graceful drain with in-flight queries
+        def drain_worker(i: int) -> None:
+            try:
+                with ServeClient(srv.port, tenant=f"drain{i}") as c:
+                    c.sql(Q1)
+            except ServeError:
+                pass  # a straggler cancel is a valid drain outcome
+
+        inflight = []
+        for i in range(2):
+            t = threading.Thread(target=drain_worker, args=(i,))
+            t.start()
+            inflight.append(t)
+        time.sleep(0.3)
+        t0 = time.perf_counter()
+        drained = srv.shutdown(timeout=120)
+        drain_s = time.perf_counter() - t0
+        for t in inflight:
+            t.join(timeout=30)
+        drain_leg = {"drained": drained, "drain_s": round(drain_s, 3)}
+    finally:
+        srv.shutdown(timeout=10)
+
+    # -- quarantine: a signature that fails K consecutive times fails
+    # fast afterwards (fresh server; IO injection makes every scan
+    # runtime-fatal quickly and deterministically)
+    R.reset_fault_injection()
+    LC.reset_lifecycle()
+    qconf = dict(TPU_CONF)
+    qconf.update({
+        "spark.rapids.sql.test.injectIOError": "1:99",
+        "spark.rapids.sql.reader.maxRetries": "1",
+        "spark.rapids.sql.serve.quarantineThreshold": "2",
+    })
+    quarantine = {}
+    try:
+        qsrv = QueryServer(qconf).start()
+        try:
+            qsrv.register_view("lineitem", DATA_DIR)
+            statuses = []
+            fail_fast_ms = None
+            for i in range(3):
+                t0 = time.perf_counter()
+                try:
+                    with ServeClient(qsrv.port, tenant="poison") as c:
+                        c.sql(Q1)
+                    statuses.append("ok")
+                except ServeError as e:
+                    statuses.append(type(e).__name__)
+                    if i == 2:
+                        fail_fast_ms = round(
+                            (time.perf_counter() - t0) * 1e3, 1)
+            quarantine = {
+                "statuses": statuses,
+                "thirdFailedFast": statuses[2:] == ["ServeQuarantined"],
+                "failFastMs": fail_fast_ms,
+            }
+        finally:
+            qsrv.shutdown(timeout=30)
+    except OSError as e:
+        quarantine = {"skipped": True, "reason": f"cannot bind: {e!r}"}
+    finally:
+        R.reset_fault_injection()
+        LC.reset_lifecycle()
+
+    return {
+        "skipped": False,
+        "clean_wall_s": round(clean_wall, 4),
+        "cancelLatency": {
+            "samples": len(cancel_lat),
+            "completedBeforeCancel": completed_before_cancel,
+            "p50_s": round(percentile(cancel_lat, 0.50), 4),
+            "p99_s": round(percentile(cancel_lat, 0.99), 4),
+        },
+        "deadline": deadline_leg,
+        "drain": drain_leg,
+        "quarantine": quarantine,
+    }
+
+
 def run_telemetry(clean_wall: float, cpu_rows) -> dict:
     """detail.telemetry (docs/observability.md "Live telemetry"): the
     q1 ring-recorder overhead ratio vs trace fully off (budget
@@ -1201,6 +1368,14 @@ def main():
         telemetry_leg = {"skipped": True,
                          "reason": f"telemetry leg failed: {e!r}"}
 
+    # query-lifecycle leg (docs/serving.md "Query lifecycle"): cancel
+    # latency, deadline bound, drain wall, quarantine fail-fast
+    try:
+        lifecycle_leg = run_lifecycle(fused["wall_s"], cpu_rows)
+    except Exception as e:  # noqa: BLE001 - reported, not swallowed
+        lifecycle_leg = {"skipped": True,
+                         "reason": f"lifecycle leg failed: {e!r}"}
+
     cpu_t = min(cpu_times)
     tpu_t = fused["wall_s"]
     q3_tpu_t = fused["q3"]["wall_s"]
@@ -1241,6 +1416,7 @@ def main():
             "kernels": kernels_leg,
             "serving": serving,
             "telemetry": telemetry_leg,
+            "lifecycle": lifecycle_leg,
             "jitCaches": registry_snapshot()["jitCaches"],
             "tpcds_q3": {
                 "device_wall_s": round(q3_tpu_t, 4),

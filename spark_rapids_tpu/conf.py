@@ -410,6 +410,55 @@ SERVE_PORT = conf("spark.rapids.sql.serve.port").doc(
     "Port the query server binds (0 = ephemeral; the bound port is "
     "printed/returned for clients).").integer(0)
 
+SERVE_QUERY_TIMEOUT_MS = conf(
+    "spark.rapids.sql.serve.queryTimeoutMs").doc(
+    "Per-query deadline in milliseconds, enforced from request "
+    "admission (queue wait counts against the budget): a query that "
+    "exceeds it is cooperatively cancelled at the engine's lifecycle "
+    "checkpoints and returns status=cancelled (reason=deadline) on "
+    "the wire. 0 disables. Per-tenant override: set "
+    "spark.rapids.sql.serve.queryTimeoutMs.<tenant>; a client may "
+    "TIGHTEN the deadline (or set one where the operator set none) "
+    "per request via the sql header's timeoutMs field — it can never "
+    "loosen or disable an operator-enforced bound "
+    "(docs/serving.md 'Query lifecycle').").integer(0)
+
+SERVE_WATCHDOG_FACTOR = conf(
+    "spark.rapids.sql.serve.watchdogFactor").doc(
+    "Stuck-query watchdog: a running query whose elapsed wall exceeds "
+    "this factor times its plan-cache signature's observed p99 wall "
+    "fires a stuckQuery slow-query bundle through the telemetry "
+    "trigger engine (and, with serve.watchdogCancel, a cooperative "
+    "cancel). Signatures with fewer than 5 observed walls are never "
+    "flagged. 0 disables (docs/serving.md 'Query lifecycle')."
+    ).double(0.0)
+
+SERVE_WATCHDOG_CANCEL = conf(
+    "spark.rapids.sql.serve.watchdogCancel").doc(
+    "When the stuck-query watchdog flags a query, also CANCEL it "
+    "(reason=watchdog) instead of only emitting the stuckQuery "
+    "bundle. Off by default — observation first, enforcement opt-in "
+    "(docs/serving.md 'Query lifecycle').").boolean(False)
+
+SERVE_QUARANTINE_THRESHOLD = conf(
+    "spark.rapids.sql.serve.quarantineThreshold").doc(
+    "Poison-query quarantine: a plan-cache signature that fails this "
+    "many CONSECUTIVE times with a runtime-fatal error (cancellations "
+    "and deadline timeouts never count) is blacklisted — further "
+    "submissions fail fast with status=quarantined before touching "
+    "the device, instead of re-wedging the runtime. One success "
+    "clears the streak; a restart clears the blacklist. 0 disables "
+    "(docs/serving.md 'Query lifecycle').").integer(0)
+
+SERVE_DRAIN_TIMEOUT_MS = conf(
+    "spark.rapids.sql.serve.drainTimeoutMs").doc(
+    "Graceful-drain deadline for `tools serve` shutdown (SIGTERM or "
+    "the shutdown verb): admission stops immediately, in-flight "
+    "queries get this long to finish, then stragglers are "
+    "cooperatively cancelled (reason=shutdown) so the process exits "
+    "with the store empty and all permits restored "
+    "(docs/serving.md 'Query lifecycle').").integer(60000)
+
 SERVE_TENANT_ID = conf("spark.rapids.sql.serve.tenantId").internal().doc(
     "Session-scoped tenant id the server sets on each tenant's "
     "session; threads through trace files, event-log lines, profile "

@@ -255,9 +255,21 @@ class TpuRowToColumnarExec(TpuExec):
                                  name="srt-scan-prefetch")
             t.start()
             ring: List = []
+
+            def get_item():
+                # cancellation-aware ring pull: a cancelled query must
+                # not park on the prefetch queue (the raise runs the
+                # finally below, which stops and joins the producer)
+                from spark_rapids_tpu.lifecycle import checkpoint
+                while True:
+                    try:
+                        return q.get(timeout=0.05)
+                    except _q.Empty:
+                        checkpoint("prefetch")
+
             try:
                 while True:
-                    item = q.get()
+                    item = get_item()
                     if item[0] == "done":
                         break
                     if item[0] == "error":
@@ -332,6 +344,10 @@ class TpuRowToColumnarExec(TpuExec):
         falls back per batch exactly like the synchronous path."""
         from spark_rapids_tpu import retry as R
         from spark_rapids_tpu.columnar.transfer import finish_started
+        from spark_rapids_tpu.lifecycle import checkpoint
+        # per-scan-batch cancellation point: the upload loop is the
+        # highest-frequency batch loop in the engine
+        checkpoint("batch")
         num_rows, tok, src, device = entry
         try:
             with metrics.timed(M.COPY_TO_DEVICE_TIME,
@@ -368,6 +384,8 @@ class TpuRowToColumnarExec(TpuExec):
                 device=None) -> List[DeviceBatch]:
         from spark_rapids_tpu import retry as R
         from spark_rapids_tpu.columnar.transfer import finish_upload
+        from spark_rapids_tpu.lifecycle import checkpoint
+        checkpoint("batch")
         num_rows, staged, src = prepared
         sem.acquire_if_necessary(metrics)
         if device is not None:
@@ -452,12 +470,14 @@ class TpuColumnarToRowExec(P.PhysicalPlan):
         def make(thunk: DevicePartitionThunk) -> P.PartitionThunk:
             def run() -> Iterator[HostBatch]:
                 from spark_rapids_tpu.columnar.device import finish_to_host
+                from spark_rapids_tpu.lifecycle import checkpoint
                 try:
                     # 1-ahead: batch k+1's pack program + async D2H
                     # copies are in flight while batch k converts on
                     # the host — the flat fetch latency overlaps
                     prev = None
                     for b in thunk():
+                        checkpoint("batch")
                         tok = b.start_to_host()
                         if prev is not None:
                             with metrics.timed(M.COPY_FROM_DEVICE_TIME):

@@ -120,7 +120,11 @@ class JitCache:
                 self.contention += 1
                 wait_ev = ev
             _trace.instant("compileCacheContention", cache=self.name)
-            wait_ev.wait()
+            # cancellation-aware single-flight wait: a cancelled query
+            # parked behind another thread's compile unwinds instead
+            # of waiting the build out (the builder is unaffected)
+            from spark_rapids_tpu.lifecycle import cancellable_wait
+            cancellable_wait(wait_ev, site="jitWait")
         t0 = time.perf_counter_ns()
         try:
             val = build()

@@ -15,6 +15,10 @@ hand (docs/linting.md):
   static promotion of the former runtime drift lints: metric keys
   resolve in ``describe_metric``, ``spark.rapids.*`` literals are
   registered confs, spans are with-scoped, generated docs are fresh.
+* ``cancel-checkpoint`` — blocking waits in serve/, retry.py and
+  jit_cache.py stay cancellable: bounded timeouts or the
+  CancelToken-aware lifecycle helpers (docs/serving.md "Query
+  lifecycle").
 
 CLI: ``python -m spark_rapids_tpu.tools lint`` (exit 0 clean /
 1 findings / 2 internal error). Per-line suppressions must carry a
@@ -35,6 +39,7 @@ from spark_rapids_tpu.lint import rules_retry  # noqa: F401,E402
 from spark_rapids_tpu.lint import rules_jit  # noqa: F401,E402
 from spark_rapids_tpu.lint import rules_concurrency  # noqa: F401,E402
 from spark_rapids_tpu.lint import rules_drift  # noqa: F401,E402
+from spark_rapids_tpu.lint import rules_lifecycle  # noqa: F401,E402
 
 __all__ = ["LintConfig", "load_config", "Finding", "LintResult",
            "run_lint", "run_cli", "render_human", "render_json",

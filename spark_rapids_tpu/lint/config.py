@@ -86,6 +86,17 @@ class LintConfig:
         "DeviceStore._lock", "TpuSemaphore._cv",
         "AdmissionController._cv", "JitCache._lock")
 
+    # -- cancellation discipline -------------------------------------------
+    # files whose blocking waits must be cancellable: bounded timeout
+    # (re-checked in a loop) or a lifecycle-aware helper — a new wait
+    # site in the serving tier must not silently become uncancellable
+    # (docs/serving.md "Query lifecycle")
+    cancel_scope: Tuple[str, ...] = (
+        "spark_rapids_tpu/serve/",
+        "spark_rapids_tpu/retry.py",
+        "spark_rapids_tpu/jit_cache.py",
+    )
+
     # -- drift -------------------------------------------------------------
     metrics_rel: str = "spark_rapids_tpu/metrics.py"
     trace_rel: str = "spark_rapids_tpu/trace.py"
@@ -113,7 +124,7 @@ def load_config(root: str) -> LintConfig:
             setattr(cfg, key, data[key])
     for key in ("scan_roots", "retry_scope", "retry_wrappers",
                 "alloc_entrypoints", "concurrency_scope",
-                "critical_locks"):
+                "critical_locks", "cancel_scope"):
         if key in data:
             setattr(cfg, key, tuple(data[key]))
     if "retry_allowlist" in data:

@@ -485,6 +485,24 @@ class DeviceStore:
 
     # -- OOM-retry hook + lifecycle ----------------------------------------
 
+    def release_for_registries(self, reg_ids) -> int:
+        """Close every live handle registered under one of the given
+        metric-registry ids (the cancellation path: a cancelled query's
+        plan is dead, so its HBM frees NOW instead of at GC — the
+        weakref finalizers remain the backstop). Returns the number of
+        handles released."""
+        with self._lock:
+            victims = []
+            for hid, st in self._states.items():
+                if st.closed or st.metrics_ref is None:
+                    continue
+                m = st.metrics_ref()
+                if m is not None and id(m) in reg_ids:
+                    victims.append(hid)
+            for hid in victims:
+                self._release_id(hid)
+        return len(victims)
+
     def spill_device_down(self, target_bytes: int = 0) -> int:
         """Demote device-tier handles (LRU first) until at most
         ``target_bytes`` remain in HBM — the retry framework's
@@ -666,6 +684,32 @@ def reset_store_peaks() -> None:
     store); the bench leg / test hook pairing metrics.begin_epoch."""
     if _STORE is not None:
         _STORE.reset_peaks()
+
+
+def release_plan_handles(physical) -> int:
+    """Deterministically close every store handle registered by the
+    given physical plan's metric registries (fused constituents
+    included). The cancellation path calls this so a cancelled /
+    timed-out query's HBM ledger and spillable handles free at the
+    cancel, not at plan GC (docs/serving.md 'Query lifecycle')."""
+    store = _STORE
+    if store is None or physical is None:
+        return 0
+    regs = set()
+
+    def walk(p) -> None:
+        m = getattr(p, "metrics", None)
+        if m is not None:
+            regs.add(id(m))
+        for op in getattr(p, "fused_ops", []) or []:
+            fm = getattr(op, "metrics", None)
+            if fm is not None:
+                regs.add(id(fm))
+        for c in getattr(p, "children", []):
+            walk(c)
+
+    walk(physical)
+    return store.release_for_registries(regs)
 
 
 def store_owner_stats() -> Dict[str, Dict[str, int]]:

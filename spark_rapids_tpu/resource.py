@@ -79,7 +79,14 @@ class TpuSemaphore:
         t0 = time.perf_counter_ns()
         with self._cv:
             while self._in_use >= self.permits:
-                self._cv.wait()
+                # bounded wait + lifecycle checkpoint: a cancelled /
+                # timed-out query must not park on the semaphore
+                # forever (docs/serving.md "Query lifecycle"); raising
+                # here leaves the permit count untouched
+                self._cv.wait(timeout=0.05)
+                if self._in_use >= self.permits:
+                    from spark_rapids_tpu.lifecycle import checkpoint
+                    checkpoint("semaphore")
             self._in_use += 1
         t1 = time.perf_counter_ns()
         if metrics is not None:

@@ -117,7 +117,11 @@ class _Schedule:
     - ``"seed:S:P"`` seeded random: each event fails with probability P
     - ``"site:NAME:SPEC"`` scope any of the above to events tagged
       with site NAME (e.g. ``site:upload:2`` fails every 2nd scan
-      upload-ahead; untagged sites never count against the schedule)
+      upload-ahead; untagged sites never count against the schedule).
+      ``site:cancel:SPEC`` is special: it counts LIFECYCLE
+      cancellation checkpoints and injects a cooperative cancel of
+      the live query's token instead of an OOM (docs/robustness.md
+      site catalog)
     """
 
     __slots__ = ("every_n", "streak", "split", "seed", "prob", "rng",
@@ -166,6 +170,14 @@ class FaultInjector:
     def __init__(self, oom_spec: str = "", io_spec: str = "",
                  chip_spec: str = ""):
         self._oom = _parse_schedule(oom_spec)
+        # `site:cancel:N` is the LIFECYCLE leg of the grammar
+        # (docs/robustness.md): the schedule counts cancellation
+        # CHECKPOINTS (lifecycle.checkpoint) instead of allocations,
+        # and the injected fault is a cooperative cancel of the live
+        # query's token — never an OOM
+        self._cancel = None
+        if self._oom is not None and self._oom.site == "cancel":
+            self._cancel, self._oom = self._oom, None
         self._io = _parse_schedule(io_spec)
         self._chips = set()
         for part in str(chip_spec or "").split(","):
@@ -177,10 +189,12 @@ class FaultInjector:
         self._oom_streak = 0
         self._io_count = 0
         self._io_streak = 0
+        self._cancel_count = 0
         # observability (bench detail.robustness, tests)
         self.oom_injected = 0
         self.io_injected = 0
         self.chip_failures_injected = 0
+        self.cancels_injected = 0
 
     def _fire(self, sched: _Schedule, count: int) -> bool:
         if sched.prob > 0.0:
@@ -243,12 +257,30 @@ class FaultInjector:
                 self.chip_failures_injected += 1
             raise TpuChipFailure(chip_id)
 
+    def on_cancel_point(self, token, site: str = "") -> None:
+        """Checkpoint at one lifecycle cancellation checkpoint
+        (lifecycle.checkpoint). A ``site:cancel:N`` schedule cancels
+        the live query's token at the Nth checkpoint — the fault it
+        injects IS a cancellation, so the query unwinds through the
+        cooperative-cancel protocol, not the retry protocol. Recovery
+        paths are exempt like every other injection site."""
+        if self._cancel is None or token is None or _suppressed():
+            return
+        with self._lock:
+            self._cancel_count += 1
+            if not self._fire(self._cancel, self._cancel_count):
+                return
+            self.cancels_injected += 1
+        from spark_rapids_tpu.lifecycle import REASON_INJECTED
+        token.cancel(REASON_INJECTED)
+
     def stats(self) -> dict:
         with self._lock:
             return {"allocations": self._alloc_count,
                     "oomInjected": self.oom_injected,
                     "ioInjected": self.io_injected,
-                    "chipFailuresInjected": self.chip_failures_injected}
+                    "chipFailuresInjected": self.chip_failures_injected,
+                    "cancelsInjected": self.cancels_injected}
 
 
 _INJECTOR: Optional[FaultInjector] = None
@@ -370,7 +402,11 @@ def _recover(conf, metrics, attempt: int, backoff_ms: int,
             freed = store.spill_device_down(target)
         delay = min(backoff_ms * (1 << (attempt - 1)), max_backoff_ms)
         if delay > 0:
-            time.sleep(delay / 1000.0)
+            # cancellation-aware backoff (docs/serving.md "Query
+            # lifecycle"): a cancelled/timed-out query must not sleep
+            # through its deadline inside the retry protocol
+            from spark_rapids_tpu.lifecycle import cancellable_sleep
+            cancellable_sleep(delay / 1000.0, site="retryBackoff")
     t1 = time.perf_counter_ns()
     qt = TR._ACTIVE
     if qt is not None:
@@ -422,6 +458,9 @@ def with_retry(fn: Callable[[], T], conf=None, metrics=None, *,
         except TpuChipFailure:
             raise  # handled by the mesh degrade loop, never retried here
         except Exception as e:
+            from spark_rapids_tpu.lifecycle import TpuQueryCancelled
+            if isinstance(e, TpuQueryCancelled):
+                raise  # cooperative cancel unwinds, never retried
             if not translate_real or not is_oom_error(e):
                 raise
             attempt += 1
@@ -522,8 +561,10 @@ def io_with_retry(fn: Callable[[], T], conf=None, metrics=None,
             if metrics is not None:
                 metrics.create(M.IO_RETRY_COUNT, M.ESSENTIAL).add(1)
             t0 = time.perf_counter_ns()
-            time.sleep(min(backoff_ms * (1 << (attempt - 1)), 1000)
-                       / 1000.0)
+            from spark_rapids_tpu.lifecycle import cancellable_sleep
+            cancellable_sleep(
+                min(backoff_ms * (1 << (attempt - 1)), 1000) / 1000.0,
+                site="retryBackoff")
             if metrics is not None:
                 metrics.create(M.RETRY_BLOCK_TIME).add(
                     time.perf_counter_ns() - t0)

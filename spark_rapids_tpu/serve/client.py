@@ -24,12 +24,33 @@ class ServeRejected(ServeError):
     backpressure signal; retry is the CLIENT's decision."""
 
 
+class ServeCancelled(ServeError):
+    """The query was cancelled (cancel verb, deadline, watchdog, or
+    drain) — ``reason`` names which, ``where`` is ``queued`` or
+    ``running``. A NORMAL protocol outcome: the stream stays
+    synchronized, so the client is NOT marked broken and may submit
+    the next query immediately (docs/serving.md 'Query lifecycle')."""
+
+    def __init__(self, reason: str, where: str = ""):
+        super().__init__(f"query cancelled ({reason})"
+                         + (f" while {where}" if where else ""))
+        self.reason = reason
+        self.where = where
+
+
+class ServeQuarantined(ServeError):
+    """The query's plan signature is quarantined after consecutive
+    runtime-fatal failures; it failed fast without executing
+    (docs/serving.md 'Query lifecycle')."""
+
+
 class ServeClient:
     def __init__(self, port: int, host: str = "127.0.0.1",
                  tenant: str = "default", timeout: float = 300.0):
         self.host = host
         self.port = port
         self.tenant = tenant
+        self._timeout = timeout
         self._sock = socket.create_connection((host, port),
                                               timeout=timeout)
         self._lock = threading.Lock()
@@ -38,6 +59,28 @@ class ServeClient:
         # could read the PREVIOUS query's late response. The client
         # refuses further use instead of silently mixing results.
         self._broken = False
+
+    def reconnect(self) -> "ServeClient":
+        """Re-establish the connection after a transport error marked
+        this client broken: opens a fresh socket to the same host/port
+        and clears the broken flag, so the caller resumes WITHOUT
+        rebuilding tenant state by hand (sessions/views/ledgers are
+        per TENANT on the server, not per connection). Any request
+        still in flight on the old connection is cancelled by the
+        server's disconnect monitor. Returns self."""
+        with self._lock:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = socket.create_connection(
+                (self.host, self.port), timeout=self._timeout)
+            self._broken = False
+        return self
+
+    @property
+    def broken(self) -> bool:
+        return self._broken
 
     def close(self) -> None:
         try:
@@ -73,20 +116,56 @@ class ServeClient:
             raise ServeError("server closed the connection")
         return msg
 
-    def sql(self, text: str,
-            tenant: Optional[str] = None) -> Tuple[object, Dict]:
+    def sql(self, text: str, tenant: Optional[str] = None,
+            timeout_ms: Optional[int] = None,
+            query_id: Optional[str] = None) -> Tuple[object, Dict]:
         """Execute SQL; returns ``(HostBatch, response header)``. The
-        header carries rows/queueWaitMs/execMs/planCacheHit. Raises
-        ServeRejected on admission rejection, ServeError on failure."""
-        header, payload = self._roundtrip({
-            "op": "sql", "sql": text,
-            "tenant": tenant or self.tenant})
+        header carries rows/queueWaitMs/execMs/planCacheHit.
+        ``timeout_ms`` sets a per-request deadline (wins over the
+        server's serve.queryTimeoutMs confs); ``query_id`` names the
+        query so ANOTHER connection can ``cancel`` it. Raises
+        ServeRejected on admission rejection, ServeCancelled when the
+        query was cancelled or timed out (the client stays usable),
+        ServeQuarantined for a quarantined signature, ServeError on
+        failure."""
+        req = {"op": "sql", "sql": text,
+               "tenant": tenant or self.tenant}
+        if timeout_ms is not None:
+            req["timeoutMs"] = int(timeout_ms)
+        if query_id is not None:
+            req["queryId"] = str(query_id)
+        header, payload = self._roundtrip(req)
         status = header.get("status")
         if status == "rejected":
             raise ServeRejected(header.get("error", "rejected"))
+        if status == "cancelled":
+            # a normal, stream-synchronized outcome: must NOT mark the
+            # client broken (docs/serving.md "Query lifecycle")
+            raise ServeCancelled(header.get("reason", "cancel"),
+                                 header.get("where", ""))
+        if status == "quarantined":
+            raise ServeQuarantined(
+                header.get("error", "signature quarantined"))
         if status != "ok":
             raise ServeError(header.get("error", "unknown server error"))
         return protocol.ipc_to_batch(payload), header
+
+    def cancel(self, query_id: Optional[str] = None,
+               tenant: Optional[str] = None) -> int:
+        """Cancel in-flight queries matching ``tenant`` and/or
+        ``query_id`` (the `cancel` protocol verb; both None cancels
+        everything in flight). Returns how many queries were newly
+        cancelled; each returns ``status: cancelled`` on its own
+        connection."""
+        req = {"op": "cancel"}
+        if tenant is not None:
+            req["tenant"] = tenant
+        if query_id is not None:
+            req["queryId"] = str(query_id)
+        header, _ = self._roundtrip(req)
+        if header.get("status") != "ok":
+            raise ServeError(header.get("error", "cancel failed"))
+        return int(header.get("cancelled", 0))
 
     def collect(self, text: str,
                 tenant: Optional[str] = None) -> List[tuple]:

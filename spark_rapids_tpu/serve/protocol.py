@@ -11,14 +11,25 @@ stream (the interchange the engine already speaks at every host
 boundary — io/arrow_convert.py), so any Arrow-capable client can read
 results without this module.
 
-Request ops: ``sql`` (fields: sql, tenant), ``view`` (name, path,
-fmt), ``stats``, ``metrics`` (alias ``stats-stream``: one Prometheus
-text scrape per request, returned as the frame PAYLOAD with
-``contentType`` in the header — clients poll it, `tools top` and
-Prometheus scrapers both ride this verb), ``ping``, ``shutdown``.
-Responses carry ``status`` (ok | rejected | error) plus op-specific
+Request ops: ``sql`` (fields: sql, tenant, optional ``timeoutMs`` — a
+per-request deadline that wins over the server's
+``serve.queryTimeoutMs`` confs — and optional ``queryId`` naming the
+query so another connection can cancel it), ``cancel`` (optional
+tenant and/or queryId selecting which in-flight queries to cancel;
+response reports ``cancelled``: how many tokens newly cancelled),
+``view`` (name, path, fmt), ``stats``, ``metrics`` (alias
+``stats-stream``: one Prometheus text scrape per request, returned as
+the frame PAYLOAD with ``contentType`` in the header — clients poll
+it, `tools top` and Prometheus scrapers both ride this verb),
+``ping``, ``shutdown`` (graceful drain: in-flight queries finish
+within the drain deadline, stragglers are cancelled).
+Responses carry ``status``
+(ok | rejected | cancelled | quarantined | error) plus op-specific
 fields; ``sql`` responses attach ``rows``, ``queueWaitMs``, ``execMs``,
-``planCacheHit`` and the Arrow payload.
+``planCacheHit`` and the Arrow payload; a ``cancelled`` response
+carries ``reason`` (cancel | deadline | disconnect | watchdog |
+shutdown | injected) and ``where`` (queued | running) — see
+docs/serving.md "Query lifecycle".
 """
 
 from __future__ import annotations

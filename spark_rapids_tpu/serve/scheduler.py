@@ -48,14 +48,10 @@ class QueryRejected(Exception):
     server maps this to a ``status: rejected`` response."""
 
 
-def percentile(samples: List[float], q: float) -> float:
-    """Nearest-rank percentile of an unsorted sample list (0 when
-    empty); small-n behavior matches what the bench reports."""
-    if not samples:
-        return 0.0
-    s = sorted(samples)
-    idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
-    return s[idx]
+# ONE copy of the nearest-rank rule (lifecycle.py): the admission
+# stats, bench legs, and the watchdog's p99 must agree on what a
+# percentile means; re-exported here for the existing import sites
+from spark_rapids_tpu.lifecycle import percentile  # noqa: E402,F401
 
 
 class _Ticket:
@@ -133,10 +129,14 @@ class AdmissionController:
 
     # -- acquire/release ---------------------------------------------------
 
-    def acquire(self, tenant: str) -> float:
+    def acquire(self, tenant: str, token=None) -> float:
         """Block until the query may execute; returns the queue wait in
         seconds. Raises QueryRejected when the queue is full (the
-        backpressure path) or the server is shutting down."""
+        backpressure path) or the server is shutting down. With a
+        lifecycle ``token``, a cancellation or deadline expiry WHILE
+        QUEUED raises TpuQueryCancelled and releases the queue slot —
+        deadlines are enforced from admission time (docs/serving.md
+        "Query lifecycle")."""
         t0 = time.perf_counter()
         throttled = False
         with self._cv:
@@ -165,6 +165,15 @@ class AdmissionController:
                         # (stats must reconcile with what clients saw)
                         self._count_rejection(tenant)
                         raise QueryRejected("server is shutting down")
+                    if token is not None:
+                        # cancelled / past-deadline while queued: the
+                        # BaseException cleanup below releases the
+                        # ticket and wakes the queue (the admission
+                        # wait is a lifecycle checkpoint, so the
+                        # site:cancel injection schedule counts it)
+                        from spark_rapids_tpu.lifecycle import \
+                            checkpoint_token
+                        checkpoint_token(token, "admission")
                     over = self._over_share()
                     if self._eligible(tk, over):
                         break

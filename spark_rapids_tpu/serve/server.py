@@ -14,7 +14,12 @@ colocated-daemon sketch. Division of labor:
 - the tenant id threads through everything the engine already records:
   trace files, event-log lines, profile artifacts, and the store's
   per-tenant live/peak/spill ledger (``serve.tenantId``);
-- results return as Arrow IPC streams (protocol.py).
+- results return as Arrow IPC streams (protocol.py);
+- every query runs under a lifecycle ``CancelToken`` (docs/serving.md
+  "Query lifecycle"): deadlines from ``serve.queryTimeoutMs`` /
+  per-request ``timeoutMs``, the ``cancel`` verb, a client-disconnect
+  monitor, the stuck-query watchdog, the poison-query quarantine, and
+  a graceful drain that cancels stragglers.
 
 Server sessions enable the cross-query plan cache by default
 (``spark.rapids.sql.planCache.enabled``), so repeated query shapes —
@@ -70,6 +75,7 @@ class QueryServer:
             base["spark.rapids.sql.trace.mode"] = "ring"
         self._base_conf = base
         cobj = TpuConf(base)
+        self._conf_obj = cobj
         self.host = host if host is not None else str(cobj.get(SERVE_HOST))
         self.port = port if port is not None else int(cobj.get(SERVE_PORT))
         self._admission = AdmissionController(cobj)
@@ -85,6 +91,7 @@ class QueryServer:
         self._metrics_httpd = None
         self._accept_thread: Optional[threading.Thread] = None
         self._conn_threads: List[threading.Thread] = []
+        self._conns: List[socket.socket] = []
         self._conn_lock = threading.Lock()
         self._stopping = threading.Event()
         self._started = time.perf_counter()
@@ -93,6 +100,19 @@ class QueryServer:
         self._tenant_lat: Dict[str, List[float]] = {}
         self.queries_ok = 0
         self.queries_err = 0
+        # query lifecycle (docs/serving.md "Query lifecycle"):
+        # in-flight sql requests tracked conn -> CancelToken so the
+        # `cancel` verb, the disconnect monitor, and the drain
+        # straggler pass can reach them; cancellations counted by
+        # terminal reason
+        self._live_lock = threading.Lock()
+        self._inflight: Dict[object, object] = {}
+        self.queries_cancelled = 0
+        self.queries_quarantined = 0
+        self._cancel_reasons: Dict[str, int] = {}
+        from spark_rapids_tpu.lifecycle import StuckQueryWatchdog
+        self._watchdog = StuckQueryWatchdog(cobj)
+        self._disco_thread: Optional[threading.Thread] = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -118,6 +138,14 @@ class QueryServer:
         # server stats snapshot (docs/observability.md)
         from spark_rapids_tpu.telemetry import triggers as _telemetry
         _telemetry.set_stats_provider(self.stats)
+        # lifecycle threads: the stuck-query watchdog (conf-gated) and
+        # the client-disconnect monitor (always on — a vanished client
+        # must not pin its admission slot/permit/ledger)
+        self._watchdog.start()
+        self._disco_thread = threading.Thread(
+            target=self._disconnect_monitor, name="srt-serve-disco",
+            daemon=True)
+        self._disco_thread.start()
         return self
 
     def start_metrics_http(self, port: int,
@@ -131,12 +159,17 @@ class QueryServer:
         return self._metrics_httpd.server_address[1]
 
     def shutdown(self, timeout: float = 60.0) -> bool:
-        """Clean shutdown: stop accepting, reject queued queries, DRAIN
-        in-flight queries (they complete and their responses are
-        delivered), then stop tenant sessions. Returns True when the
-        drain finished inside the timeout."""
+        """Graceful drain (docs/serving.md "Query lifecycle"): stop
+        accepting, reject queued queries, let in-flight queries finish
+        within the drain deadline, then cooperatively CANCEL the
+        stragglers (reason=shutdown — they return status=cancelled),
+        stop tenant sessions, and release every lifecycle resource so
+        the process exits with the store empty and all permits
+        restored. Returns True when every in-flight query terminated
+        (finished or cancelled) before return."""
         self._stopping.set()
         self._admission.begin_shutdown()
+        self._watchdog.stop()
         from spark_rapids_tpu.telemetry import triggers as _telemetry
         _telemetry.set_stats_provider(None)
         if self._metrics_httpd is not None:
@@ -155,6 +188,34 @@ class QueryServer:
             # the port is only released once the accept loop exits
             self._accept_thread.join(timeout=5.0)
         drained = self._admission.drain(timeout)
+        if not drained:
+            # drain deadline passed: cancel the stragglers and give
+            # them a short grace to unwind through their checkpoints
+            from spark_rapids_tpu.lifecycle import REASON_SHUTDOWN
+            with self._live_lock:
+                stragglers = list(self._inflight.values())
+            for tok in stragglers:
+                tok.cancel(REASON_SHUTDOWN)
+            drained = self._admission.drain(
+                max(5.0, min(30.0, timeout * 0.25)))
+        if self._disco_thread is not None:
+            self._disco_thread.join(timeout=5.0)
+            self._disco_thread = None
+        # after the drain, close remaining connections: idle clients
+        # (pollers parked between requests) observe EOF and exit
+        # cleanly instead of holding conn threads alive forever
+        with self._conn_lock:
+            conns = list(self._conns)
+            self._conns = []
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
         with self._conn_lock:
             threads = list(self._conn_threads)
         for t in threads:
@@ -166,6 +227,12 @@ class QueryServer:
                 s.stop()
             except Exception:
                 pass
+        # post-drain invariants (asserted by the soak harness): run the
+        # collector once so any plan still referenced from an unwinding
+        # frame drops its store handles via the weakref finalizers —
+        # the store must read empty and the semaphore fully restored
+        import gc
+        gc.collect()
         return drained
 
     # -- catalog -----------------------------------------------------------
@@ -240,6 +307,7 @@ class QueryServer:
                                  name="srt-serve-conn", daemon=True)
             with self._conn_lock:
                 self._conn_threads.append(t)
+                self._conns.append(conn)
                 # drop finished threads so a long-lived server's list
                 # stays bounded
                 self._conn_threads = [x for x in self._conn_threads
@@ -256,6 +324,8 @@ class QueryServer:
                 op = header.get("op")
                 if op == "sql":
                     self._handle_sql(conn, header)
+                elif op == "cancel":
+                    self._handle_cancel(conn, header)
                 elif op == "view":
                     self._handle_view(conn, header)
                 elif op == "stats":
@@ -285,10 +355,133 @@ class QueryServer:
         except (protocol.ProtocolError, OSError):
             pass  # client went away / malformed stream: drop the conn
         finally:
+            with self._conn_lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
             try:
                 conn.close()
             except OSError:
                 pass
+
+    # -- query lifecycle ---------------------------------------------------
+
+    def _query_timeout_ms(self, tenant: str, header: Dict) -> int:
+        """Deadline resolution (docs/serving.md "Query lifecycle"):
+        the operator bound is the per-tenant conf override
+        (``serve.queryTimeoutMs.<tenant>``) or the base
+        ``serve.queryTimeoutMs``; the request's ``timeoutMs`` may
+        TIGHTEN it (or set one where the operator set none) but never
+        loosen or disable an operator-enforced bound. 0 = no
+        deadline."""
+        from spark_rapids_tpu.conf import SERVE_QUERY_TIMEOUT_MS
+        base = 0
+        o = self._base_conf.get(
+            "spark.rapids.sql.serve.queryTimeoutMs." + tenant)
+        if o is not None:
+            try:
+                base = max(0, int(o))
+            except (TypeError, ValueError):
+                base = 0
+        else:
+            base = max(0, int(self._conf_obj.get(
+                SERVE_QUERY_TIMEOUT_MS)))
+        v = header.get("timeoutMs")
+        if v is not None:
+            try:
+                req = max(0, int(v))
+            except (TypeError, ValueError):
+                return base
+            if req > 0:
+                return min(req, base) if base > 0 else req
+        return base
+
+    def _track(self, conn, token) -> None:
+        from spark_rapids_tpu import lifecycle as LC
+        LC.register_query(token)
+        with self._live_lock:
+            self._inflight[conn] = token
+
+    def _untrack(self, conn, token) -> None:
+        from spark_rapids_tpu import lifecycle as LC
+        with self._live_lock:
+            if self._inflight.get(conn) is token:
+                self._inflight.pop(conn, None)
+        LC.unregister_query(token)
+
+    def _count_cancel(self, reason: str) -> None:
+        with self._lat_lock:
+            self.queries_cancelled += 1
+            self._cancel_reasons[reason] = \
+                self._cancel_reasons.get(reason, 0) + 1
+
+    def _handle_cancel(self, conn: socket.socket, header: Dict) -> None:
+        """The ``cancel`` protocol verb: cancel in-flight queries
+        matching the given ``tenant`` and/or ``queryId`` (both
+        optional; neither = every in-flight query — the operator
+        hammer). Cancellation is cooperative: the response reports how
+        many tokens were newly cancelled; each query returns
+        ``status: cancelled`` on its OWN connection."""
+        from spark_rapids_tpu.lifecycle import REASON_CANCEL
+        tenant = header.get("tenant")
+        qid = header.get("queryId")
+        with self._live_lock:
+            tokens = list(self._inflight.values())
+        n = 0
+        for tok in tokens:
+            if tenant is not None and tok.tenant != str(tenant):
+                continue
+            if qid is not None and tok.query_id != str(qid):
+                continue
+            if tok.cancel(REASON_CANCEL):
+                n += 1
+        protocol.send_msg(conn, {"status": "ok", "cancelled": n})
+
+    def _disconnect_monitor(self) -> None:
+        """Cancel-on-client-disconnect (docs/serving.md "Query
+        lifecycle"): while a sql request executes, its connection
+        thread is NOT reading the socket — this monitor select()s the
+        in-flight connections and a readable socket whose peek returns
+        EOF means the client vanished; its query is cancelled so the
+        admission slot, semaphore permit, and tenant HBM ledger free
+        instead of riding a dead query to completion."""
+        import select
+        from spark_rapids_tpu.lifecycle import REASON_DISCONNECT
+        while not self._stopping.is_set():
+            with self._live_lock:
+                pairs = list(self._inflight.items())
+            if not pairs:
+                self._stopping.wait(0.05)
+                continue
+            try:
+                readable, _, _ = select.select(
+                    [c for c, _ in pairs], [], [], 0.05)
+            except (OSError, ValueError):
+                # a connection closed between snapshot and select:
+                # re-snapshot next round
+                self._stopping.wait(0.02)
+                continue
+            gone = set()
+            saw_data = False
+            for conn in readable:
+                try:
+                    if conn.recv(1, socket.MSG_PEEK) == b"":
+                        gone.add(conn)
+                    else:
+                        # data while a response is pending =
+                        # client-side pipelining; it stays buffered
+                        # until the response goes out. The buffered
+                        # bytes would make every select() return
+                        # immediately, so pace the loop explicitly
+                        # instead of busy-spinning a core for the
+                        # whole query
+                        saw_data = True
+                except OSError:
+                    gone.add(conn)
+            for conn, tok in pairs:
+                if conn in gone:
+                    tok.cancel(REASON_DISCONNECT)
+            if saw_data:
+                self._stopping.wait(0.05)
 
     def _handle_view(self, conn: socket.socket, header: Dict) -> None:
         try:
@@ -299,62 +492,114 @@ class QueryServer:
             protocol.send_msg(conn, {"status": "error", "error": str(e)})
 
     def _handle_sql(self, conn: socket.socket, header: Dict) -> None:
+        from spark_rapids_tpu import lifecycle as LC
         from spark_rapids_tpu import trace as TR
         from spark_rapids_tpu import plan_cache as PC
         tenant = str(header.get("tenant") or "default")
         sql = header.get("sql") or ""
         t_req = time.perf_counter()
         session = self._session(tenant)
+        # per-query lifecycle token (docs/serving.md "Query
+        # lifecycle"): the deadline clock starts HERE, at request
+        # admission, so queue wait counts against the budget; the
+        # token is tracked for the cancel verb / disconnect monitor /
+        # watchdog until the response is on the wire
+        token = LC.CancelToken(
+            tenant=tenant,
+            query_id=(str(header["queryId"])
+                      if header.get("queryId") is not None else None))
+        timeout_ms = self._query_timeout_ms(tenant, header)
+        if timeout_ms > 0:
+            token.set_deadline(timeout_ms / 1000.0)
+        self._track(conn, token)
         # the server opens the query trace scope BEFORE admission, so
         # the admission wait (the scheduler's serveQueueWait span) lands
         # inside the traced window; execute_plan's own begin_query folds
         # in as the nested scope it already supports
         tok = TR.begin_query(session.conf_obj)
         try:
-            wait_s = self._admission.acquire(tenant)
-        except QueryRejected as e:
-            TR.end_query(session.conf_obj, tok, error=True)
-            protocol.send_msg(conn, {"status": "rejected",
-                                     "error": str(e), "tenant": tenant})
-            return
-        try:
-            t0 = time.perf_counter()
-            batch = session.sql(sql)._execute()
-            exec_s = time.perf_counter() - t0
-            TR.end_query(session.conf_obj, tok, wall_s=exec_s,
-                         rows=batch.num_rows)
-            tok = None
-            payload = protocol.batch_to_ipc(batch)
-            resp = {
-                "status": "ok",
-                "tenant": tenant,
-                "rows": batch.num_rows,
-                "queueWaitMs": round(wait_s * 1e3, 3),
-                "execMs": round(exec_s * 1e3, 3),
-                # per-THREAD outcome: the request plans and executes on
-                # this connection thread, so this cannot misreport
-                # under concurrent queries the way a global hits-delta
-                # would
-                "planCacheHit": bool(PC.last_lookup_was_hit()),
-            }
-            ppath = session.thread_profile_path()
-            if ppath:
-                resp["profilePath"] = ppath
-            protocol.send_msg(conn, resp, payload)
-            # counted AFTER the successful send: a query whose response
-            # delivery fails must not land in both ok and err
-            with self._lat_lock:
-                self.queries_ok += 1
-            self._record_latency(tenant, time.perf_counter() - t_req)
-        except Exception as e:  # noqa: BLE001 - reported to the client
-            if tok is not None:
+            try:
+                wait_s = self._admission.acquire(tenant, token=token)
+                # the watchdog measures RUNNING time from here, not
+                # from request receipt (queue wait must not make a
+                # healthy query look stuck under load)
+                token.mark_admitted()
+            except QueryRejected as e:
                 TR.end_query(session.conf_obj, tok, error=True)
-            with self._lat_lock:
-                self.queries_err += 1
-            protocol.send_msg(conn, {"status": "error", "tenant": tenant,
-                                     "error": f"{type(e).__name__}: {e}"})
+                protocol.send_msg(conn, {"status": "rejected",
+                                         "error": str(e),
+                                         "tenant": tenant})
+                return
+            except LC.TpuQueryCancelled as e:
+                # cancelled / past-deadline while still QUEUED: the
+                # slot was never acquired, nothing to release
+                TR.end_query(session.conf_obj, tok, error=True)
+                self._count_cancel(e.reason)
+                protocol.send_msg(conn, {
+                    "status": "cancelled", "tenant": tenant,
+                    "reason": e.reason, "where": "queued"})
+                return
+            try:
+                t0 = time.perf_counter()
+                with LC.token_scope(token):
+                    batch = session.sql(sql)._execute()
+                exec_s = time.perf_counter() - t0
+                TR.end_query(session.conf_obj, tok, wall_s=exec_s,
+                             rows=batch.num_rows)
+                tok = None
+                payload = protocol.batch_to_ipc(batch)
+                resp = {
+                    "status": "ok",
+                    "tenant": tenant,
+                    "rows": batch.num_rows,
+                    "queueWaitMs": round(wait_s * 1e3, 3),
+                    "execMs": round(exec_s * 1e3, 3),
+                    # per-THREAD outcome: the request plans and
+                    # executes on this connection thread, so this
+                    # cannot misreport under concurrent queries the
+                    # way a global hits-delta would
+                    "planCacheHit": bool(PC.last_lookup_was_hit()),
+                }
+                if token.query_id is not None:
+                    resp["queryId"] = token.query_id
+                ppath = session.thread_profile_path()
+                if ppath:
+                    resp["profilePath"] = ppath
+                protocol.send_msg(conn, resp, payload)
+                # counted AFTER the successful send: a query whose
+                # response delivery fails must not land in both ok/err
+                with self._lat_lock:
+                    self.queries_ok += 1
+                self._record_latency(tenant,
+                                     time.perf_counter() - t_req)
+            except LC.TpuQueryCancelled as e:
+                if tok is not None:
+                    TR.end_query(session.conf_obj, tok, error=True)
+                self._count_cancel(e.reason)
+                protocol.send_msg(conn, {
+                    "status": "cancelled", "tenant": tenant,
+                    "reason": e.reason, "where": "running",
+                    "queueWaitMs": round(wait_s * 1e3, 3)})
+            except LC.TpuQueryQuarantined as e:
+                if tok is not None:
+                    TR.end_query(session.conf_obj, tok, error=True)
+                with self._lat_lock:
+                    self.queries_quarantined += 1
+                protocol.send_msg(conn, {
+                    "status": "quarantined", "tenant": tenant,
+                    "error": str(e), "failures": e.failures})
+            except Exception as e:  # noqa: BLE001 - reported to client
+                if tok is not None:
+                    TR.end_query(session.conf_obj, tok, error=True)
+                with self._lat_lock:
+                    self.queries_err += 1
+                protocol.send_msg(conn, {
+                    "status": "error", "tenant": tenant,
+                    "error": f"{type(e).__name__}: {e}"})
+            finally:
+                self._admission.release(tenant)
         finally:
-            self._admission.release(tenant)
+            self._untrack(conn, token)
 
     def _record_latency(self, tenant: str, seconds: float) -> None:
         with self._lat_lock:
@@ -387,14 +632,27 @@ class QueryServer:
                     "count": len(lat),
                 }
         uptime = max(1e-9, time.perf_counter() - self._started)
+        from spark_rapids_tpu import lifecycle as LC
+        with self._lat_lock:
+            cancelled = self.queries_cancelled
+            reasons = dict(self._cancel_reasons)
+            quarantined = self.queries_quarantined
         return {
             "host": self.host,
             "port": self.port,
             "uptimeSeconds": round(uptime, 3),
             "queriesOk": self.queries_ok,
             "queriesErr": self.queries_err,
+            "queriesCancelled": cancelled,
             "qps": round(self.queries_ok / uptime, 4),
             "admission": adm,
             "tenantsHBM": memory.store_tenant_stats(),
             "jitCaches": cache_stats(),
+            "lifecycle": {
+                "cancelledByReason": reasons,
+                "queriesQuarantined": quarantined,
+                "watchdogFlagged": self._watchdog.flagged,
+                "watchdogCancelled": self._watchdog.cancelled,
+                **LC.lifecycle_stats(),
+            },
         }

@@ -100,7 +100,13 @@ class PhysicalPlan:
             getattr(self, "metrics", None))
 
     def _collect_once(self, parallelism: int) -> HostBatch:
+        from spark_rapids_tpu import lifecycle as LC
         from spark_rapids_tpu.resource import release_current_thread
+        # the query's CancelToken follows the work onto pool threads
+        # explicitly (a thread-local cannot cross the task pool);
+        # checkpointing per drained batch is the cooperative batch-loop
+        # cancellation point (docs/serving.md "Query lifecycle")
+        token = LC.current_token()
 
         def drain(t) -> list:
             # per-task try/finally: an injected/real fault mid-drain
@@ -108,7 +114,12 @@ class PhysicalPlan:
             # threads are discarded with the pool, so a leaked permit
             # would shrink the semaphore for the process lifetime
             try:
-                return list(t())
+                with LC.token_scope(token):
+                    out = []
+                    for b in t():
+                        LC.checkpoint("batch")
+                        out.append(b)
+                    return out
             finally:
                 release_current_thread()
 

@@ -193,6 +193,16 @@ class TpuSparkSession:
         if use_cache:
             from spark_rapids_tpu import plan_cache as PC
             sig = PC.plan_signature(plan, self.conf_obj)
+            # lifecycle keying (docs/serving.md "Query lifecycle"): the
+            # signature identifies this query shape for the watchdog's
+            # p99 history and the poison-query quarantine; threaded
+            # per-thread (concurrent queries share this session) and
+            # onto the live CancelToken for the watchdog's scan
+            self._tls.plan_signature = sig
+            from spark_rapids_tpu import lifecycle as LC
+            ltok = LC.current_token()
+            if ltok is not None:
+                ltok.signature = sig
             # single-flight build: concurrent cold misses of one shape
             # (a burst of identical queries on a fresh server) run the
             # rewrite once; everyone executes a clone of the template
@@ -205,6 +215,7 @@ class TpuSparkSession:
                 # (the building thread printed inside apply_overrides)
                 report.print_explain(self.conf_obj)
         else:
+            self._tls.plan_signature = None
             template, report = self._rewrite_fresh(plan)
             physical = template
             self.last_rewrite_report = report
@@ -255,9 +266,28 @@ class TpuSparkSession:
         # BEFORE planning so compile spans and scalar-subquery execution
         # (nested execute_plan calls fold into this query's trace) are
         # attributed; one Chrome-trace file per sampled query
+        # lifecycle (docs/serving.md "Query lifecycle"): materialize
+        # the process fault injector up front so checkpoint-level
+        # site:cancel schedules fire even before the first wrapped
+        # allocation, and read the quarantine threshold once
+        from spark_rapids_tpu import lifecycle as LC
+        from spark_rapids_tpu import retry as _retry
+        from spark_rapids_tpu.conf import SERVE_QUARANTINE_THRESHOLD
+        _retry.get_fault_injector(self.conf_obj)
+        quar_thr = int(self.conf_obj.get(SERVE_QUARANTINE_THRESHOLD))
+        sig = None
+        physical = None
         tok = TR.begin_query(self.conf_obj)
         try:
             physical = self.plan_physical(plan)
+            sig = getattr(self._tls, "plan_signature", None)
+            if quar_thr > 0 and sig is not None \
+                    and LC.is_quarantined(sig):
+                # poison-query quarantine: fail fast BEFORE touching
+                # the device — the signature already wedged the
+                # runtime quar_thr consecutive times
+                raise LC.TpuQueryQuarantined(
+                    sig, LC.quarantined_failures(sig))
             # THIS thread's rewrite report: a concurrent query on the
             # same session may overwrite last_rewrite_report before the
             # profile/event-log writes below run
@@ -273,11 +303,31 @@ class TpuSparkSession:
                 result = physical.execute_collect(
                     int(self.conf_obj.get(TASK_PARALLELISM)))
             wall_s = _time.perf_counter() - t0
+        except LC.TpuQueryCancelled:
+            TR.end_query(self.conf_obj, tok, error=True)
+            # a cancelled/timed-out query's HBM frees NOW: close the
+            # dead plan's spillable handles deterministically instead
+            # of waiting for plan GC (cancellation never counts toward
+            # quarantine — it is not a runtime-fatal failure)
+            from spark_rapids_tpu import memory as _mem
+            _mem.release_plan_handles(physical)
+            raise
+        except LC.TpuQueryQuarantined:
+            TR.end_query(self.conf_obj, tok, error=True)
+            raise  # never ran: neither a failure nor a success
         except BaseException:
             TR.end_query(self.conf_obj, tok, error=True)
+            if quar_thr > 0 and sig is not None:
+                LC.record_runtime_failure(sig, quar_thr)
             raise
         TR.end_query(self.conf_obj, tok, wall_s=wall_s,
                      rows=result.num_rows)
+        if sig is not None:
+            # the watchdog's per-signature p99 history; one success
+            # also clears the signature's quarantine streak
+            LC.record_wall(sig, wall_s)
+            if quar_thr > 0:
+                LC.record_success(sig)
         # profile artifact (docs/observability.md "Reading a query
         # profile"): the executed plan's registries + the store's
         # owner-attributed HBM ledger + the rewrite explain, one JSON

@@ -59,6 +59,14 @@ CHECKS: List[Tuple[str, str, bool, str]] = [
      "serving QPS @ c=16"),
     ("detail.telemetry.ringOverhead", "lower", True,
      "ring-recorder overhead"),
+    ("detail.lifecycle.cancelLatency.p50_s", "lower", False,
+     "cancel latency p50"),
+    ("detail.lifecycle.cancelLatency.p99_s", "lower", False,
+     "cancel latency p99"),
+    ("detail.lifecycle.drain.drain_s", "lower", False,
+     "graceful-drain wall with in-flight queries"),
+    ("detail.lifecycle.quarantine.failFastMs", "lower", False,
+     "quarantine fail-fast latency"),
     ("detail.robustness.legs.oomEveryN.retryCount", "lower", False,
      "retries under injected OOM"),
     ("detail.robustness.legs.oomEveryN.slowdown_vs_clean", "lower",

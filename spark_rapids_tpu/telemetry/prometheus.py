@@ -39,6 +39,12 @@ from typing import Any, Dict, List, Optional, Tuple
 SERVER_FAMILY_HELP: Dict[str, Tuple[str, str]] = {
     "srt_queries_ok_total": ("counter", "queries served successfully"),
     "srt_queries_err_total": ("counter", "queries that failed"),
+    "srt_queries_cancelled_total": (
+        "counter", "queries that terminated cancelled (cancel verb, "
+                   "deadline, disconnect, watchdog, or drain)"),
+    "srt_queries_quarantined_total": (
+        "counter", "queries failed fast by the poison-query "
+                   "quarantine"),
     "srt_uptime_seconds": ("gauge", "server uptime in seconds"),
     "srt_qps": ("gauge", "successful queries per second since server "
                          "start"),
@@ -332,6 +338,11 @@ def render_prometheus(server_stats: Optional[Dict] = None) -> str:
                      server_stats.get("queriesOk", 0))
         _emit_server(out, "srt_queries_err_total",
                      server_stats.get("queriesErr", 0))
+        _emit_server(out, "srt_queries_cancelled_total",
+                     server_stats.get("queriesCancelled", 0))
+        _emit_server(out, "srt_queries_quarantined_total",
+                     server_stats.get("lifecycle", {})
+                     .get("queriesQuarantined", 0))
         _emit_server(out, "srt_uptime_seconds",
                      float(server_stats.get("uptimeSeconds", 0.0)))
         _emit_server(out, "srt_qps",

@@ -71,10 +71,16 @@ def format_top(stats: Dict, prev: Optional[Dict] = None,
 
 
 def run_top(port: int, host: str = "127.0.0.1", interval: float = 2.0,
-            iterations: int = 0) -> int:
-    """The CLI loop: ``iterations`` frames (0 = until interrupted).
-    Returns 0; connection failures print a clean error and return 1."""
+            iterations: int = 0, once: bool = False) -> int:
+    """The CLI loop: ``iterations`` frames (0 = until interrupted);
+    ``once`` renders exactly one frame (scripting sugar for
+    ``--once``). Returns 0; a server that goes away MID-POLL (drained,
+    restarted, crashed) is a clean exit — message + code 0, never a
+    raw socket traceback — while an initial connect failure stays an
+    error (code 1)."""
     from spark_rapids_tpu.serve import ServeClient
+    if once:
+        iterations = 1
     try:
         client = ServeClient(port, host=host)
     except OSError as e:
@@ -87,8 +93,10 @@ def run_top(port: int, host: str = "127.0.0.1", interval: float = 2.0,
             try:
                 stats = client.stats()
             except Exception as e:  # noqa: BLE001 - reported cleanly
-                print(f"stats poll failed: {e}")
-                return 1
+                # mid-poll disappearance is the server's normal end of
+                # life from a watcher's point of view: exit clean
+                print(f"server at {host}:{port} went away: {e}")
+                return 0
             frame = format_top(stats, prev=prev,
                                interval=interval if prev else 0.0)
             if n and sys.stdout.isatty():

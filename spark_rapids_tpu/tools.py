@@ -480,7 +480,7 @@ def _main(argv: List[str]) -> int:
     ap.add_argument("command",
                     choices=["qualify", "profile", "docs", "trace",
                              "hotspots", "serve", "serve-client",
-                             "lint", "top", "bench-diff"])
+                             "lint", "top", "bench-diff", "soak"])
     ap.add_argument("sql", nargs="?", help="SQL text to analyze (live "
                     "mode; omit when using --log), the trace "
                     "file/directory for the trace/hotspots commands, "
@@ -528,6 +528,21 @@ def _main(argv: List[str]) -> int:
     ap.add_argument("--iterations", type=int, default=0,
                     help="top: frames to render before exiting "
                     "(0 = until interrupted)")
+    ap.add_argument("--once", action="store_true",
+                    help="top: render exactly one frame and exit "
+                    "(scripting mode)")
+    ap.add_argument("--rounds", type=int, default=5,
+                    help="soak: chaos rounds (fault schedules rotate "
+                    "per round)")
+    ap.add_argument("--concurrency", type=int, default=8,
+                    help="soak: concurrent tenants")
+    ap.add_argument("--queries", type=int, default=3,
+                    help="soak: queries per tenant per round")
+    ap.add_argument("--seed", type=int, default=7,
+                    help="soak: deterministic action/schedule seed")
+    ap.add_argument("--data", default=None,
+                    help="soak: existing data directory (default: "
+                    "generate into a temp dir)")
     ap.add_argument("--threshold", type=float, default=None,
                     help="bench-diff: relative regression threshold "
                     "for gating checks (default 0.10)")
@@ -559,10 +574,24 @@ def _main(argv: List[str]) -> int:
             ap.error(f"top: not a port: {target!r}")
         return run_top(port, host=host or args.host or "127.0.0.1",
                        interval=args.interval,
-                       iterations=args.iterations)
+                       iterations=args.iterations, once=args.once)
 
     if args.command == "bench-diff":
         return _bench_diff_main(args, ap)
+
+    if args.command == "soak":
+        # chaos soak harness (docs/serving.md "Query lifecycle"):
+        # exit 0 when every round completed with zero hangs, diverged
+        # survivors, or post-drain leaks; 1 otherwise
+        import json as _json
+
+        from spark_rapids_tpu.soak import run_soak
+        report = run_soak(rounds=args.rounds,
+                          concurrency=args.concurrency,
+                          queries_per_tenant=args.queries,
+                          seed=args.seed, data_dir=args.data)
+        print(_json.dumps(report, indent=2, default=str))
+        return 0 if report["ok"] else 1
 
     if args.command == "profile":
         # offline renderer: a path argument means "render the written
@@ -740,8 +769,15 @@ def _serve_main(args) -> int:
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     while not stop.is_set() and not srv._stopping.is_set():
         stop.wait(0.2)
-    srv.shutdown()
-    print(_json.dumps({"event": "stopped", **srv.stats()}), flush=True)
+    # graceful drain (docs/serving.md "Query lifecycle"): in-flight
+    # queries finish inside serve.drainTimeoutMs, stragglers are
+    # cooperatively cancelled, the process exits with the store empty
+    from spark_rapids_tpu.conf import SERVE_DRAIN_TIMEOUT_MS, TpuConf
+    drain_s = max(1.0, int(TpuConf(conf).get(
+        SERVE_DRAIN_TIMEOUT_MS)) / 1000.0)
+    drained = srv.shutdown(timeout=drain_s)
+    print(_json.dumps({"event": "stopped", "drained": drained,
+                       **srv.stats()}), flush=True)
     return 0
 
 
@@ -1108,6 +1144,11 @@ def generate_observability_docs() -> str:
         "pool budget | every store transition |",
         "| queueSaturation | admission depth > telemetry."
         "queueWatermark x serve.maxQueued | every enqueue |",
+        "| stuckQuery | elapsed wall > serve.watchdogFactor x the "
+        "plan-cache signature's observed p99 | the lifecycle "
+        "watchdog's periodic scan (docs/serving.md 'Query "
+        "lifecycle'; with serve.watchdogCancel the query is also "
+        "cancelled) |",
         "",
         "Per-trigger rate limiting (`telemetry.triggerMinIntervalS`)",
         "bounds disk pressure under a storm (suppressed firings count",
@@ -1148,7 +1189,10 @@ def generate_observability_docs() -> str:
         "",
         "`tools top <port>` renders a refreshing terminal table over",
         "the same stats (tenants x QPS / p50 / p99 / queue wait / live",
-        "HBM / in-flight / rejections; `--interval`, `--iterations`).",
+        "HBM / in-flight / rejections; `--interval`, `--iterations`,",
+        "`--once` for scripting). A server that goes away mid-poll is",
+        "a clean exit (message + code 0); a failed initial connect",
+        "exits 1.",
         "",
         "### Regression tracking (`tools bench-diff`)",
         "",

@@ -145,6 +145,9 @@ INSTANT_CATALOG: Dict[str, str] = {
                 "(wallSeconds/rows/error attrs)",
     "telemetryTrigger": "a telemetry trigger fired (trigger= names it; "
                         "docs/observability.md 'Live telemetry')",
+    "queryCancelled": "a query's CancelToken was cancelled (reason= "
+                      "cancel/deadline/disconnect/watchdog/shutdown/"
+                      "injected; docs/serving.md 'Query lifecycle')",
 }
 
 

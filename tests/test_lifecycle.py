@@ -1,0 +1,678 @@
+"""Query-lifecycle corpus (docs/serving.md "Query lifecycle"):
+CancelToken semantics, cancellation reaching every wait site
+(semaphore, jit single-flight, admission queue, backoff sleeps),
+deadlines enforced from admission, the `cancel` protocol verb,
+cancel-on-client-disconnect freeing the admission slot / semaphore
+permit / tenant HBM ledger (the leak-class regression), the
+stuck-query watchdog riding the trigger engine, the poison-query
+quarantine, graceful drain cancelling stragglers, `site:cancel`
+injection, ServeClient.reconnect, and `tools top --once` / clean exit
+when the server goes away."""
+
+from __future__ import annotations
+
+import gc
+import glob
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from spark_rapids_tpu import lifecycle as LC
+from spark_rapids_tpu import memory as MEM
+from spark_rapids_tpu import retry as R
+from spark_rapids_tpu import trace as TR
+from spark_rapids_tpu.sql.session import TpuSparkSession
+
+from tests.datagen import (IntegerGen, KeyStringGen, LongGen,
+                           SmallIntGen, gen_batch)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    TR.reset_tracing()
+    R.reset_fault_injection()
+    LC.reset_lifecycle()
+    yield
+    TR.reset_tracing()
+    R.reset_fault_injection()
+    LC.reset_lifecycle()
+
+
+Q1S = """
+SELECT flag, status, sum(qty) AS sq, min(price) AS mn,
+       max(price) AS mx, count(*) AS c
+FROM lineitem WHERE qty % 5 != 0
+GROUP BY flag, status ORDER BY flag, status
+"""
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("lifecycle_data")
+    gen = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        li = gen.createDataFrame(gen_batch(
+            [("flag", KeyStringGen(cardinality=3)),
+             ("status", SmallIntGen()), ("qty", LongGen()),
+             ("price", IntegerGen())], 3000, 31), num_partitions=4)
+        li.write.mode("overwrite").parquet(str(d / "lineitem"))
+    finally:
+        gen.stop()
+    return d
+
+
+@pytest.fixture(scope="module")
+def oracle(data_dir):
+    spark = TpuSparkSession({"spark.rapids.sql.enabled": "true",
+                             "spark.rapids.sql.batchSizeRows": "512"})
+    try:
+        spark.read.parquet(str(data_dir / "lineitem")) \
+            .createOrReplaceTempView("lineitem")
+        return [tuple(r) for r in spark.sql(Q1S)._execute().rows()]
+    finally:
+        spark.stop()
+
+
+def _server(data_dir, **conf):
+    from spark_rapids_tpu.serve import QueryServer
+    base = {"spark.rapids.sql.enabled": "true",
+            "spark.rapids.sql.batchSizeRows": "512"}
+    base.update({k: str(v) for k, v in conf.items()})
+    srv = QueryServer(base).start()
+    srv.register_view("lineitem", str(data_dir / "lineitem"))
+    return srv
+
+
+def _hook_parked_query(srv, slow_tenant, started, release):
+    """Queries from ``slow_tenant`` park at a LIFECYCLE CHECKPOINT
+    between admission and planning, so cancellation (verb, deadline,
+    disconnect, watchdog, drain) can interrupt them deterministically
+    — unlike a plain Event.wait, which no cancel could reach."""
+    orig_session = srv._session
+
+    def hook(tenant):
+        s = orig_session(tenant)
+        if tenant == slow_tenant and not getattr(s, "_park_hook",
+                                                 None):
+            orig_sql = s.sql
+
+            def parked_sql(text):
+                started.set()
+                end = time.monotonic() + 60
+                while not release.is_set() and time.monotonic() < end:
+                    LC.checkpoint("batch")
+                    time.sleep(0.01)
+                return orig_sql(text)
+
+            s._park_hook = True
+            s.sql = parked_sql
+        return s
+
+    srv._session = hook
+
+
+# ---------------------------------------------------------------------------
+# Token + checkpoint units
+# ---------------------------------------------------------------------------
+
+def test_cancel_token_semantics():
+    tok = LC.CancelToken(tenant="t", query_id="q")
+    assert not tok.cancelled()
+    assert tok.cancel("cancel") is True
+    assert tok.cancel("deadline") is False  # first cancel wins
+    assert tok.reason == "cancel"
+    with pytest.raises(LC.TpuQueryCancelled) as ei:
+        tok.check()
+    assert ei.value.reason == "cancel"
+    # deadline converts into a cancellation on observation
+    tok2 = LC.CancelToken()
+    tok2.set_deadline(0.0)
+    time.sleep(0.01)
+    assert tok2.cancelled()
+    assert tok2.reason == "deadline"
+    # checkpoints are free outside a scope, cooperative inside
+    LC.checkpoint("batch")
+    with LC.token_scope(tok2):
+        with pytest.raises(LC.TpuQueryCancelled):
+            LC.checkpoint("batch")
+
+
+def test_cancellable_sleep_interrupts():
+    tok = LC.CancelToken()
+    t = threading.Timer(0.1, tok.cancel, args=("cancel",))
+    t.start()
+    t0 = time.perf_counter()
+    with LC.token_scope(tok):
+        with pytest.raises(LC.TpuQueryCancelled):
+            LC.cancellable_sleep(30.0)
+    assert time.perf_counter() - t0 < 5.0, \
+        "cancel must interrupt the sleep, not wait it out"
+    t.join()
+
+
+def test_cancel_interrupts_semaphore_wait():
+    import spark_rapids_tpu.resource as RES
+    sem = RES.TpuSemaphore(1)
+    sem.acquire_if_necessary()  # this thread holds the only permit
+    tok = LC.CancelToken()
+    out = {}
+
+    def blocked():
+        with LC.token_scope(tok):
+            try:
+                sem.acquire_if_necessary()
+                out["got"] = True
+            except LC.TpuQueryCancelled as e:
+                out["cancelled"] = e.reason
+
+    t = threading.Thread(target=blocked)
+    t.start()
+    time.sleep(0.1)
+    tok.cancel("cancel")
+    t.join(timeout=10)
+    assert out.get("cancelled") == "cancel"
+    assert sem.in_use == 1  # the cancelled waiter took no permit
+    sem.release_if_necessary()
+
+
+def test_cancel_interrupts_jit_single_flight_wait():
+    from spark_rapids_tpu.jit_cache import JitCache
+    cache = JitCache("testCancelWait", capacity=4)
+    in_build = threading.Event()
+    release = threading.Event()
+
+    def build():
+        in_build.set()
+        release.wait(timeout=30)
+        return "compiled"
+
+    tok = LC.CancelToken()
+    out = {}
+
+    def builder():
+        out["built"] = cache.get_or_build("k", build)
+
+    def waiter():
+        in_build.wait(timeout=30)
+        with LC.token_scope(tok):
+            try:
+                cache.get_or_build("k", build)
+            except LC.TpuQueryCancelled:
+                out["cancelled"] = True
+
+    t1 = threading.Thread(target=builder)
+    t2 = threading.Thread(target=waiter)
+    t1.start()
+    t2.start()
+    time.sleep(0.2)
+    tok.cancel("cancel")
+    t2.join(timeout=10)
+    assert out.get("cancelled") is True
+    release.set()  # the BUILDER is unaffected by the waiter's cancel
+    t1.join(timeout=30)
+    assert out["built"] == ("compiled", True)
+
+
+def test_deadline_in_admission_queue():
+    from spark_rapids_tpu.conf import TpuConf
+    from spark_rapids_tpu.serve.scheduler import AdmissionController
+    ac = AdmissionController(TpuConf({
+        "spark.rapids.sql.serve.maxConcurrentQueries": "1",
+        "spark.rapids.sql.serve.maxQueued": "8"}))
+    ac.acquire("A")
+    tok = LC.CancelToken(tenant="B")
+    tok.set_deadline(0.1)
+    t0 = time.perf_counter()
+    with pytest.raises(LC.TpuQueryCancelled) as ei:
+        ac.acquire("B", token=tok)
+    assert ei.value.reason == "deadline"
+    assert time.perf_counter() - t0 < 5.0
+    st = ac.stats()
+    assert st["queued"] == 0, "the expired ticket must leave the queue"
+    ac.release("A")
+
+
+def test_fault_injector_site_cancel_unit():
+    from spark_rapids_tpu.conf import TpuConf
+    inj = R.get_fault_injector(TpuConf(
+        {"spark.rapids.sql.test.injectOOM": "site:cancel:3"}))
+    tok = LC.CancelToken()
+    with LC.token_scope(tok):
+        LC.checkpoint("batch")
+        LC.checkpoint("batch")
+        with pytest.raises(LC.TpuQueryCancelled) as ei:
+            LC.checkpoint("batch")  # the 3rd checkpoint cancels
+    assert ei.value.reason == "injected"
+    assert inj.stats()["cancelsInjected"] == 1
+    # the schedule never fires the ALLOC path
+    assert inj.stats()["oomInjected"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Wire-level lifecycle: deadline, cancel verb, disconnect, drain
+# ---------------------------------------------------------------------------
+
+def test_deadline_returns_cancelled_and_client_survives(data_dir,
+                                                        oracle):
+    from spark_rapids_tpu.serve import ServeClient
+    from spark_rapids_tpu.serve.client import ServeCancelled
+    srv = _server(data_dir)
+    started = threading.Event()
+    release = threading.Event()
+    _hook_parked_query(srv, "slow", started, release)
+    try:
+        with ServeClient(srv.port, tenant="slow") as c:
+            t0 = time.perf_counter()
+            with pytest.raises(ServeCancelled) as ei:
+                c.sql(Q1S, timeout_ms=200)
+            assert ei.value.reason == "deadline"
+            # acceptance bound: deadline + one batch interval (the
+            # checkpoint slice is 50ms; generous CI slack)
+            assert time.perf_counter() - t0 < 5.0
+            # cancelled queries must NOT mark the client broken
+            assert not c.broken
+            release.set()
+            rows = c.collect(Q1S, tenant="fast")
+            assert rows == oracle
+        st = srv.stats()
+        assert st["queriesCancelled"] == 1
+        assert st["lifecycle"]["cancelledByReason"] == {"deadline": 1}
+    finally:
+        release.set()
+        srv.shutdown()
+
+
+def test_per_tenant_timeout_override(data_dir):
+    from spark_rapids_tpu.serve import ServeClient
+    from spark_rapids_tpu.serve.client import ServeCancelled
+    srv = _server(
+        data_dir,
+        **{"spark.rapids.sql.serve.queryTimeoutMs.impatient": "150"})
+    started = threading.Event()
+    release = threading.Event()
+    _hook_parked_query(srv, "impatient", started, release)
+    try:
+        with ServeClient(srv.port, tenant="impatient") as c:
+            with pytest.raises(ServeCancelled) as ei:
+                c.sql(Q1S)  # no per-request timeout: tenant conf rules
+            assert ei.value.reason == "deadline"
+    finally:
+        release.set()
+        srv.shutdown()
+
+
+def test_cancel_verb_mid_flight(data_dir):
+    from spark_rapids_tpu.serve import ServeClient
+    from spark_rapids_tpu.serve.client import ServeCancelled
+    srv = _server(data_dir)
+    started = threading.Event()
+    release = threading.Event()
+    _hook_parked_query(srv, "slow", started, release)
+    out = {}
+    try:
+        def submit():
+            try:
+                with ServeClient(srv.port, tenant="slow") as c:
+                    c.sql(Q1S, query_id="job-1")
+                    out["status"] = "ok"
+            except ServeCancelled as e:
+                out["status"] = "cancelled"
+                out["reason"] = e.reason
+                out["t_resp"] = time.perf_counter()
+
+        t = threading.Thread(target=submit)
+        t.start()
+        assert started.wait(timeout=60)
+        t_cancel = time.perf_counter()
+        with ServeClient(srv.port) as cc:
+            assert cc.cancel(query_id="job-1", tenant="slow") == 1
+        t.join(timeout=60)
+        assert out.get("status") == "cancelled"
+        assert out.get("reason") == "cancel"
+        # the status:cancelled response lands promptly (the bench
+        # measures this as cancel latency)
+        assert out["t_resp"] - t_cancel < 5.0
+        st = srv.stats()
+        assert st["lifecycle"]["cancelledByReason"] == {"cancel": 1}
+        assert st["admission"]["inFlight"] == 0
+    finally:
+        release.set()
+        srv.shutdown()
+
+
+def test_disconnect_mid_query_frees_slot_permit_and_ledger(data_dir):
+    """THE leak-class regression (satellite): a client that vanishes
+    mid-query must free the admission slot, the semaphore permit, and
+    the tenant HBM ledger — asserted via server stats + store stats."""
+    import spark_rapids_tpu.resource as RES
+    from spark_rapids_tpu.serve import ServeClient, protocol
+    srv = _server(data_dir)
+    started = threading.Event()
+    release = threading.Event()  # never released: only cancel ends it
+    _hook_parked_query(srv, "ghost", started, release)
+    try:
+        sock = socket.create_connection(("127.0.0.1", srv.port),
+                                        timeout=30)
+        protocol.send_msg(sock, {"op": "sql", "sql": Q1S,
+                                 "tenant": "ghost"})
+        assert started.wait(timeout=60)
+        st = srv.stats()
+        assert st["admission"]["inFlight"] == 1
+        sock.close()  # the client vanishes mid-flight
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            st = srv.stats()
+            if st["admission"]["inFlight"] == 0:
+                break
+            time.sleep(0.05)
+        assert st["admission"]["inFlight"] == 0, \
+            "disconnect must free the admission slot"
+        assert st["lifecycle"]["cancelledByReason"] \
+            .get("disconnect") == 1
+        # semaphore permits restored
+        sem = RES._SEMAPHORE
+        assert sem is None or sem.in_use == 0
+        # tenant HBM ledger freed (handles closed deterministically on
+        # the cancel path; GC is only the backstop)
+        gc.collect()
+        ledger = MEM.store_tenant_stats().get("ghost", {})
+        assert ledger.get("liveBytes", 0) == 0
+        # a live client still gets service afterwards
+        with ServeClient(srv.port, tenant="fast") as c:
+            assert len(c.collect(Q1S)) > 0
+    finally:
+        release.set()
+        srv.shutdown()
+
+
+def test_graceful_drain_cancels_stragglers(data_dir):
+    from spark_rapids_tpu.serve import ServeClient
+    from spark_rapids_tpu.serve.client import ServeCancelled
+    import spark_rapids_tpu.resource as RES
+    srv = _server(data_dir)
+    started = threading.Event()
+    release = threading.Event()  # never set: the query would park 60s
+    _hook_parked_query(srv, "straggler", started, release)
+    out = {}
+    try:
+        def submit():
+            try:
+                with ServeClient(srv.port, tenant="straggler") as c:
+                    c.sql(Q1S)
+                    out["status"] = "ok"
+            except ServeCancelled as e:
+                out["status"] = "cancelled"
+                out["reason"] = e.reason
+
+        t = threading.Thread(target=submit)
+        t.start()
+        assert started.wait(timeout=60)
+        drained = srv.shutdown(timeout=1.0)  # tiny drain deadline
+        t.join(timeout=60)
+        assert drained is True, \
+            "straggler cancellation must complete the drain"
+        assert out.get("status") == "cancelled"
+        assert out.get("reason") == "shutdown"
+        with srv._sessions_lock:
+            assert not srv._sessions
+        sem = RES._SEMAPHORE
+        assert sem is None or sem.in_use == 0
+        assert LC.live_queries() == []
+    finally:
+        release.set()
+
+
+def test_site_cancel_injection_through_server(data_dir):
+    """site:cancel:N end-to-end: the schedule cancels the query at a
+    real engine checkpoint; the wire reports reason=injected."""
+    from spark_rapids_tpu.serve import ServeClient
+    from spark_rapids_tpu.serve.client import ServeCancelled
+    srv = _server(data_dir,
+                  **{"spark.rapids.sql.test.injectOOM":
+                     "site:cancel:3"})
+    try:
+        with ServeClient(srv.port, tenant="a") as c:
+            with pytest.raises(ServeCancelled) as ei:
+                c.sql(Q1S)
+            assert ei.value.reason == "injected"
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Watchdog + quarantine
+# ---------------------------------------------------------------------------
+
+def _hook_parked_after_planning(srv, slow_tenant, started, release):
+    """Park AFTER plan_physical returns — the token's plan-cache
+    signature is resolved by then, which is what the watchdog keys
+    its p99 comparison on."""
+    orig_session = srv._session
+
+    def hook(tenant):
+        s = orig_session(tenant)
+        if tenant == slow_tenant and not getattr(s, "_pp_hook", None):
+            orig_pp = s.plan_physical
+
+            def parked_pp(plan, execute_subqueries=True):
+                out = orig_pp(plan,
+                              execute_subqueries=execute_subqueries)
+                started.set()
+                end = time.monotonic() + 60
+                while not release.is_set() and time.monotonic() < end:
+                    LC.checkpoint("batch")
+                    time.sleep(0.01)
+                return out
+
+            s._pp_hook = True
+            s.plan_physical = parked_pp
+        return s
+
+    srv._session = hook
+
+
+def test_watchdog_fires_bundle_and_cancels(data_dir, oracle, tmp_path):
+    from spark_rapids_tpu.serve import ServeClient
+    from spark_rapids_tpu.serve.client import ServeCancelled
+    from spark_rapids_tpu.telemetry import triggers as TEL
+    tel_dir = str(tmp_path / "tel")
+    srv = _server(
+        data_dir,
+        **{"spark.rapids.sql.serve.watchdogFactor": "3",
+           "spark.rapids.sql.serve.watchdogCancel": "true",
+           "spark.rapids.sql.telemetry.dir": tel_dir,
+           "spark.rapids.sql.telemetry.triggerMinIntervalS": "0"})
+    started = threading.Event()
+    release = threading.Event()
+    _hook_parked_after_planning(srv, "stuck", started, release)
+    try:
+        # build the signature's p99 history (>= 5 samples)
+        with ServeClient(srv.port, tenant="warm") as c:
+            for _ in range(6):
+                assert c.collect(Q1S) == oracle
+        # now park one: elapsed quickly exceeds factor x p99
+        with ServeClient(srv.port, tenant="stuck") as c:
+            with pytest.raises(ServeCancelled) as ei:
+                c.sql(Q1S)
+            assert ei.value.reason == "watchdog"
+        st = srv.stats()
+        assert st["lifecycle"]["watchdogFlagged"] >= 1
+        assert st["lifecycle"]["watchdogCancelled"] >= 1
+        # the stuckQuery bundle landed (rides the trigger engine)
+        assert TEL.engine().drain(timeout=15)
+        bundles = glob.glob(os.path.join(tel_dir,
+                                         "bundle-*-stuckQuery.json"))
+        assert bundles, "stuckQuery must emit a slow-query bundle"
+        with open(bundles[0]) as f:
+            bundle = json.load(f)
+        assert bundle["trigger"] == "stuckQuery"
+        assert bundle["condition"]["willCancel"] is True
+    finally:
+        release.set()
+        srv.shutdown()
+        TEL.engine().reset()
+
+
+def test_quarantine_after_consecutive_fatal_failures(data_dir):
+    """K consecutive runtime-fatal failures blacklist the signature;
+    the next submission fails FAST (no device work) and a success
+    after reset clears the streak."""
+    conf = {"spark.rapids.sql.enabled": "true",
+            "spark.rapids.sql.batchSizeRows": "512",
+            "spark.rapids.sql.planCache.enabled": "true",
+            "spark.rapids.sql.serve.quarantineThreshold": "2",
+            "spark.rapids.sql.test.injectIOError": "1:99",
+            "spark.rapids.sql.reader.maxRetries": "1"}
+    spark = TpuSparkSession(conf)
+    try:
+        spark.read.parquet(str(data_dir / "lineitem")) \
+            .createOrReplaceTempView("lineitem")
+        q = spark.sql(Q1S)
+        for _ in range(2):
+            with pytest.raises(OSError):
+                q._execute()
+        inj = R.get_fault_injector(spark.conf_obj)
+        io_before = inj.stats()["ioInjected"]
+        t0 = time.perf_counter()
+        with pytest.raises(LC.TpuQueryQuarantined):
+            q._execute()
+        assert time.perf_counter() - t0 < 2.0
+        # fail-fast: the quarantined run never reached the reader
+        assert inj.stats()["ioInjected"] == io_before
+    finally:
+        spark.stop()
+
+
+def test_quarantine_streak_clears_on_success_unit():
+    """CONSECUTIVE is load-bearing: one success resets the streak, so
+    an occasionally-failing signature is never blacklisted."""
+    assert not LC.record_runtime_failure("sigX", 3)
+    assert not LC.record_runtime_failure("sigX", 3)
+    LC.record_success("sigX")
+    assert not LC.record_runtime_failure("sigX", 3)
+    assert not LC.record_runtime_failure("sigX", 3)
+    assert LC.record_runtime_failure("sigX", 3) is True
+    assert LC.is_quarantined("sigX")
+    assert not LC.is_quarantined("sigOther")
+
+
+def test_release_plan_handles_closes_registered_batches():
+    """The cancellation path's deterministic HBM release: handles
+    registered under a plan's metric registries close with the plan,
+    without waiting for GC."""
+    import numpy as np
+    from spark_rapids_tpu.columnar.device import DeviceBatch
+    from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+    from spark_rapids_tpu.metrics import MetricRegistry
+    from spark_rapids_tpu.sql import types as T
+
+    store = MEM.DeviceStore(device_budget=1 << 30,
+                            host_budget=1 << 30,
+                            spill_dir="/tmp/srt_spill_lc_test")
+    try:
+        reg = MetricRegistry("MODERATE", owner="FakeExec")
+        data = np.arange(64, dtype=np.int64)
+        hb = HostBatch(
+            T.StructType([T.StructField("x", T.LongT)]),
+            [HostColumn(T.LongT, data,
+                        np.ones(64, dtype=bool))], 64)
+        h = store.register(DeviceBatch.from_host(hb), owner="FakeExec",
+                           metrics=reg)
+        assert store.device_bytes > 0
+
+        released = store.release_for_registries({id(reg)})
+        assert released == 1
+        assert store.device_bytes == 0
+        assert h.closed
+        # foreign registries' handles are untouched
+        assert store.release_for_registries({id(object())}) == 0
+    finally:
+        store.close()
+
+
+def test_quarantined_status_on_the_wire(data_dir):
+    from spark_rapids_tpu.serve import ServeClient
+    from spark_rapids_tpu.serve.client import ServeQuarantined
+    srv = _server(
+        data_dir,
+        **{"spark.rapids.sql.serve.quarantineThreshold": "2",
+           "spark.rapids.sql.test.injectIOError": "1:99",
+           "spark.rapids.sql.reader.maxRetries": "1"})
+    try:
+        with ServeClient(srv.port, tenant="poison") as c:
+            from spark_rapids_tpu.serve.client import ServeError
+            for _ in range(2):
+                with pytest.raises(ServeError):
+                    c.sql(Q1S)
+            with pytest.raises(ServeQuarantined):
+                c.sql(Q1S)
+        assert srv.stats()["lifecycle"]["queriesQuarantined"] == 1
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Client satellites: reconnect, top
+# ---------------------------------------------------------------------------
+
+def test_reconnect_after_transport_error(data_dir, oracle):
+    from spark_rapids_tpu.serve import ServeClient
+    from spark_rapids_tpu.serve.client import ServeError
+    srv = _server(data_dir)
+    try:
+        c = ServeClient(srv.port, tenant="alice")
+        assert c.collect(Q1S) == oracle
+        # a real transport error marks the client broken...
+        c._sock.close()
+        with pytest.raises(ServeError):
+            c.collect(Q1S)
+        assert c.broken
+        with pytest.raises(ServeError):
+            c.ping()  # refuses while broken
+        # ...reconnect resumes WITHOUT rebuilding tenant state
+        c.reconnect()
+        assert not c.broken
+        assert c.collect(Q1S) == oracle
+        c.close()
+        # tenant session survived the connection churn (one session)
+        with srv._sessions_lock:
+            assert list(srv._sessions) == ["alice"]
+    finally:
+        srv.shutdown()
+
+
+def test_top_once_and_clean_exit_when_server_goes_away(data_dir,
+                                                       capsys):
+    from spark_rapids_tpu.serve import ServeClient
+    from spark_rapids_tpu.telemetry.top import run_top
+    srv = _server(data_dir)
+    port = srv.port
+    try:
+        with ServeClient(port, tenant="a") as c:
+            c.collect(Q1S)
+        # --once: exactly one frame, exit 0
+        assert run_top(port, once=True) == 0
+        out = capsys.readouterr().out
+        assert "spark-rapids-tpu serve" in out
+        # mid-poll disappearance: clean message + exit 0
+        results = {}
+
+        def poll():
+            results["rc"] = run_top(port, interval=0.1, iterations=50)
+
+        t = threading.Thread(target=poll)
+        t.start()
+        time.sleep(0.3)
+        srv.shutdown()
+        t.join(timeout=30)
+        assert results["rc"] == 0
+        out = capsys.readouterr().out
+        assert "went away" in out
+    finally:
+        srv.shutdown()
+    # initial connect failure stays an ERROR (exit 1)
+    assert run_top(port) == 1

@@ -438,6 +438,78 @@ def test_generated_doc_content_carries_drift_tables():
 
 
 # ---------------------------------------------------------------------------
+# family 5: cancellation discipline
+# ---------------------------------------------------------------------------
+
+def test_cancel_checkpoint_bad_and_good(tmp_path):
+    root = _tree(tmp_path, {"spark_rapids_tpu/serve/w.py": """
+        import threading
+        import time
+
+        _CV = threading.Condition()
+
+        def bad_wait():
+            with _CV:
+                _CV.wait()
+
+        def bad_sleep():
+            time.sleep(0.5)
+
+        def bad_queue_get(q):
+            return q.get()
+
+        def bad_explicit_blocking_get(q):
+            return q.get(block=True)
+
+        def good_bounded_wait():
+            with _CV:
+                _CV.wait(timeout=0.05)
+
+        def good_positional_wait(ev):
+            ev.wait(0.05)
+
+        def good_queue_get(q):
+            return q.get(timeout=0.1)
+
+        def good_nonblocking_get(q):
+            return q.get(block=False)
+
+        def fine_dict_get(d, k):
+            return d.get(k)
+    """})
+    r = _lint(root)
+    assert _rules(r) == ["cancel-checkpoint"]
+    assert len(r.findings) == 4
+    msgs = " | ".join(f.message for f in r.findings)
+    assert "time.sleep" in msgs
+    assert "unbounded .wait()" in msgs
+    assert "blocking queue .get()" in msgs
+
+
+def test_cancel_checkpoint_none_timeout_and_scope(tmp_path):
+    files = {
+        # timeout=None is NOT bounded
+        "spark_rapids_tpu/jit_cache.py": """
+        def bad(ev):
+            ev.wait(timeout=None)
+    """,
+        # same primitives OUTSIDE the lifecycle-critical scope: clean
+        "spark_rapids_tpu/exec/y.py": """
+        import time
+
+        def elsewhere(ev, q):
+            time.sleep(0.5)
+            ev.wait()
+            return q.get()
+    """}
+    root = _tree(tmp_path, files)
+    r = _lint(root)
+    assert _rules(r) == ["cancel-checkpoint"]
+    assert len(r.findings) == 1
+    assert r.findings[0].path == "spark_rapids_tpu/jit_cache.py"
+
+
+# ---------------------------------------------------------------------------
 # engine: suppressions, baseline, JSON schema
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,63 @@
+"""Chaos soak (docs/serving.md "Query lifecycle"): the lifecycle
+acceptance leg — mixed q1/q3 tenants under rotating FaultInjector
+schedules WHILE deadlines, explicit cancels, and client disconnects
+are injected. Asserts no hangs (global watchdog), bit-identical
+survivors vs the CPU oracle, and zero leaked HBM/permits/sessions
+after every round's graceful drain.
+
+The quick leg runs in tier-1; the full sweep (every schedule,
+including the ICI chip-failure round) is marked ``slow``."""
+
+from __future__ import annotations
+
+import pytest
+
+from spark_rapids_tpu import lifecycle as LC
+from spark_rapids_tpu import retry as R
+from spark_rapids_tpu import trace as TR
+from spark_rapids_tpu.soak import run_soak
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    TR.reset_tracing()
+    R.reset_fault_injection()
+    LC.reset_lifecycle()
+    yield
+    TR.reset_tracing()
+    R.reset_fault_injection()
+    LC.reset_lifecycle()
+
+
+@pytest.mark.fault
+def test_quick_soak(tmp_path):
+    """c=8 mixed tenants, two rounds (clean + injected OOM), lifecycle
+    injections on: the acceptance criteria in miniature."""
+    report = run_soak(rounds=2, concurrency=8, queries_per_tenant=2,
+                      seed=11, data_dir=str(tmp_path),
+                      log=lambda m: None)
+    assert report["ok"], report["errors"]
+    totals = report["totals"]
+    # the action mix must actually have exercised the lifecycle legs
+    assert totals["ok"] > 0, "no survivors at all"
+    assert totals["cancelled"] + totals["disconnected"] > 0, \
+        "no lifecycle injection landed"
+    for rep in report["roundReports"]:
+        inv = rep["invariants"]
+        assert inv["drained"] is True
+        assert inv.get("semaphoreInUse", 0) == 0
+        assert inv.get("liveSessions") == 0
+        assert inv.get("liveQueryTokens") == 0
+
+
+@pytest.mark.fault
+@pytest.mark.slow
+def test_full_soak(tmp_path):
+    """The full schedule sweep: every FaultInjector schedule (OOM, IO,
+    split+IO, site:cancel, chip failure when multi-device) x lifecycle
+    injections, more rounds and queries."""
+    report = run_soak(rounds=6, concurrency=8, queries_per_tenant=4,
+                      seed=7, data_dir=str(tmp_path),
+                      log=lambda m: None)
+    assert report["ok"], report["errors"]
+    assert report["totals"]["ok"] > 0
