@@ -320,6 +320,18 @@ MULTICHIP_SCAN_ENABLED = conf(
     "and the CPU engine are unchanged and results are bit-identical."
     ).boolean(True)
 
+MULTICHIP_SERIALIZE_SERVED = conf(
+    "spark.rapids.sql.multichip.serializeServedQueries").doc(
+    "Serialize ICI-mesh collective sections across concurrently served "
+    "queries behind a per-process mesh mutex. Two concurrent XLA CPU "
+    "collectives over one device set deadlock at rendezvous (the PR 13 "
+    "soak-documented limit), so served sessions take the mutex around "
+    "each mesh exchange by default — other queries keep executing "
+    "their non-collective stages, and waiting queries remain "
+    "cancellable. Non-served (single-user) sessions never contend and "
+    "skip the mutex entirely. Disable only on runtimes with per-query "
+    "collective isolation.").boolean(True)
+
 RETRY_MAX_RETRIES = conf("spark.rapids.sql.retry.maxRetries").doc(
     "Maximum OOM retries of one device allocation/operation before the "
     "failure escalates (split-and-retry where the operator supports "

@@ -655,11 +655,26 @@ class TpuShuffleExchangeExec(TpuExec):
             concat_device(bs) if bs else DeviceBatch.empty(schema)
             for bs in slots]
         self.metrics.create("numIciExchanges", M.ESSENTIAL).add(1)
-        with self.metrics.timed(M.PARTITION_TIME):
-            return R.with_retry(
-                lambda: mesh_exchange(slot_batches, bound, n, mesh,
-                                      self.metrics),
-                self.conf, self.metrics)
+        # collective_section AFTER the drain: the child's own (possibly
+        # mesh) stages completed above, so the mutex only serializes
+        # this exchange's collective dispatch — holding it across the
+        # drain could deadlock against a nested exchange on a pool
+        # thread (docs/multichip.md "Served queries")
+        from spark_rapids_tpu.parallel.mesh import collective_section
+
+        # the mutex is taken PER ATTEMPT, inside the retried thunk, so
+        # the OOM backoff sleeps between attempts run with it released
+        # (other served queries' collectives proceed while this one
+        # waits out memory pressure); the timed scope sits inside the
+        # mutex so queue-wait never inflates partitionTime (the
+        # slow-query triggers and bench-diff read that metric)
+        def _locked_exchange():
+            with collective_section(self.conf), \
+                    self.metrics.timed(M.PARTITION_TIME):
+                return mesh_exchange(slot_batches, bound, n, mesh,
+                                     self.metrics)
+
+        return R.with_retry(_locked_exchange, self.conf, self.metrics)
 
     def device_partitions(self) -> List[DevicePartitionThunk]:
         from spark_rapids_tpu.memory import SpillableBatch

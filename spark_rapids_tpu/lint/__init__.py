@@ -19,6 +19,13 @@ hand (docs/linting.md):
   jit_cache.py stay cancellable: bounded timeouts or the
   CancelToken-aware lifecycle helpers (docs/serving.md "Query
   lifecycle").
+* ``donation-safety`` / ``hidden-sync`` / ``handle-leak`` /
+  ``trace-purity`` — the interprocedural data-flow tier
+  (``lint/dataflow.py``): no read-after-donate on any forward path, no
+  unallowlisted device->host sync in the hot-path scopes, every
+  spillable handle deterministically released or escaped, no host
+  impurity (clocks/RNG/conf/nonlocal mutation) reachable from a traced
+  program builder.
 
 CLI: ``python -m spark_rapids_tpu.tools lint`` (exit 0 clean /
 1 findings / 2 internal error). Per-line suppressions must carry a
@@ -40,6 +47,7 @@ from spark_rapids_tpu.lint import rules_jit  # noqa: F401,E402
 from spark_rapids_tpu.lint import rules_concurrency  # noqa: F401,E402
 from spark_rapids_tpu.lint import rules_drift  # noqa: F401,E402
 from spark_rapids_tpu.lint import rules_lifecycle  # noqa: F401,E402
+from spark_rapids_tpu.lint import rules_dataflow  # noqa: F401,E402
 
 __all__ = ["LintConfig", "load_config", "Finding", "LintResult",
            "run_lint", "run_cli", "render_human", "render_json",

@@ -97,6 +97,48 @@ class LintConfig:
         "spark_rapids_tpu/jit_cache.py",
     )
 
+    # -- data-flow tier (tpu-lint v2, docs/linting.md family 6) -----------
+    # hot-path scopes where a hidden device->host sync stalls the
+    # async dispatch pipeline (the prefetched-device-scalar discipline)
+    hot_scope: Tuple[str, ...] = (
+        "spark_rapids_tpu/exec/",
+        "spark_rapids_tpu/ops/",
+        "spark_rapids_tpu/kernels/",
+        "spark_rapids_tpu/parallel/",
+        "spark_rapids_tpu/columnar/",
+    )
+    # "<rel>::<qualname>" -> reason: the SANCTIONED drain points —
+    # every one is a deliberate, documented sync the pipeline is built
+    # around (prefetched scalars resolve here, sizing handshakes, the
+    # host half of serde), not an accidental stall
+    sync_allowlist: Dict[str, str] = dataclasses.field(
+        default_factory=lambda: {
+            "spark_rapids_tpu/exec/exchange.py::split_by_pid":
+                "the ONE documented counts sync per input batch "
+                "(contiguousSplit): partition row counts are attached "
+                "so downstream consumers never re-sync",
+            "spark_rapids_tpu/ops/join.py::build_key_max_multiplicity":
+                "prefetched multiplicity scalar resolved lazily at the "
+                "probe's sizing decision — _prefetch_host overlaps the "
+                "copy with the stream-side scan (docs/kernels.md)",
+            "spark_rapids_tpu/ops/join.py::device_join":
+                "the ONE sizing sync per probe: all three scalars ride "
+                "one stacked fetch, and the FK fast path skips it "
+                "entirely",
+            "spark_rapids_tpu/parallel/ici.py::mesh_exchange":
+                "the size-exchange handshake: a tiny [n_dev, n_dev] "
+                "counts fetch sizes occupancy-proportional send blocks "
+                "before the collective (VERDICT r3 weak #6)",
+        })
+    # registration entry points whose returned handle/token must reach
+    # a close/release_*/finish_* call or escape to a tracked container
+    # (plus `<store>.register`, matched by receiver)
+    handle_sources: Tuple[str, ...] = (
+        "register_spillable", "start_upload")
+    # "<rel>::<qualname>" -> reason for trace-purity exemptions
+    purity_allowlist: Dict[str, str] = dataclasses.field(
+        default_factory=lambda: {})
+
     # -- drift -------------------------------------------------------------
     metrics_rel: str = "spark_rapids_tpu/metrics.py"
     trace_rel: str = "spark_rapids_tpu/trace.py"
@@ -108,6 +150,10 @@ class LintConfig:
 
     # -- engine ------------------------------------------------------------
     baseline: str = "tpu-lint-baseline.json"
+    # total lint wall budget in seconds: `tools lint` exits 2 when a
+    # run exceeds it, so the data-flow tier can never quietly make the
+    # tier-1 gate unaffordable (per-rule timings ride --json)
+    time_budget_s: float = 60.0
 
 
 def load_config(root: str) -> LintConfig:
@@ -119,16 +165,20 @@ def load_config(root: str) -> LintConfig:
     with open(path, "r", encoding="utf-8") as f:
         data = json.load(f)
     for key in ("check_docs", "baseline", "jit_home", "kernels_home",
-                "metrics_rel", "trace_rel", "prometheus_rel"):
+                "metrics_rel", "trace_rel", "prometheus_rel",
+                "time_budget_s"):
         if key in data:
             setattr(cfg, key, data[key])
     for key in ("scan_roots", "retry_scope", "retry_wrappers",
                 "alloc_entrypoints", "concurrency_scope",
-                "critical_locks", "cancel_scope"):
+                "critical_locks", "cancel_scope", "hot_scope",
+                "handle_sources"):
         if key in data:
             setattr(cfg, key, tuple(data[key]))
-    if "retry_allowlist" in data:
-        merged = dict(cfg.retry_allowlist)
-        merged.update(data["retry_allowlist"])
-        cfg.retry_allowlist = merged
+    for key in ("retry_allowlist", "sync_allowlist",
+                "purity_allowlist"):
+        if key in data:
+            merged = dict(getattr(cfg, key))
+            merged.update(data[key])
+            setattr(cfg, key, merged)
     return cfg

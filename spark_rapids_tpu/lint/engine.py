@@ -13,8 +13,10 @@ import dataclasses
 import hashlib
 import json
 import os
+import subprocess
+import time
 import traceback
-from typing import Callable, Dict, Iterable, List, Optional
+from typing import Callable, Dict, Iterable, List, Optional, Set
 
 from spark_rapids_tpu.lint.astutil import FileCtx
 from spark_rapids_tpu.lint.config import LintConfig, load_config
@@ -91,6 +93,15 @@ class LintResult:
     # silently dropped from the rewritten file)
     baselined_findings: List[Finding] = dataclasses.field(
         default_factory=list)
+    # baseline entries no longer matching ANY current finding: the debt
+    # was paid but the entry lingers. Informational (exit stays 0) —
+    # reported as `baseline-stale` notes and pruned by --fix-baseline.
+    stale_baseline: List[dict] = dataclasses.field(default_factory=list)
+    # per-rule wall seconds + the total analysis wall, so the data-flow
+    # tier's cost is visible in --json and gated by time_budget_s
+    rule_timings: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+    wall_s: float = 0.0
 
     @property
     def clean(self) -> bool:
@@ -133,7 +144,12 @@ def _load_baseline(root: str, config: LintConfig) -> Dict[str, dict]:
 
 def write_baseline(root: str, config: LintConfig,
                    findings: List[Finding], pctx: PackageContext) -> str:
-    """--fix-baseline: capture current findings as accepted debt."""
+    """--fix-baseline: capture current findings as accepted debt.
+    Stale entries (not in ``findings``) are pruned by construction.
+    Churn guard: when the accepted-debt SET is unchanged — same
+    fingerprints, which hash line TEXT, not line numbers — the file is
+    left byte-identical, so edits that merely shift lines (or shrink a
+    line's suppressed-rule set elsewhere) never rewrite line_hints."""
     path = os.path.join(root, config.baseline)
     entries = []
     for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
@@ -142,6 +158,10 @@ def write_baseline(root: str, config: LintConfig,
             "rule": f.rule, "path": f.path, "line_hint": f.line,
             "message": f.message,
         })
+    existing = _load_baseline(root, config)
+    if existing and set(existing) == {e["fingerprint"]
+                                      for e in entries}:
+        return path
     with open(path, "w", encoding="utf-8") as fh:
         json.dump({"version": JSON_SCHEMA_VERSION, "findings": entries},
                   fh, indent=2, sort_keys=True)
@@ -156,6 +176,7 @@ def _line_text(pctx: PackageContext, f: Finding) -> str:
 
 def run_lint(root: Optional[str] = None,
              config: Optional[LintConfig] = None) -> LintResult:
+    t_start = time.perf_counter()
     root = root or default_root()
     config = config or load_config(root)
     files = collect_files(root, config)
@@ -163,12 +184,15 @@ def run_lint(root: Optional[str] = None,
 
     raw: List[Finding] = []
     internal: List[str] = []
+    timings: Dict[str, float] = {}
     for r in RULES.values():
+        t0 = time.perf_counter()
         try:
             raw.extend(r.func(pctx))
         except Exception:
             internal.append(
                 f"rule {r.name} crashed:\n{traceback.format_exc()}")
+        timings[r.name] = time.perf_counter() - t0
     # suppressions without a reason are findings themselves and are
     # not suppressible (otherwise the grammar could erase its own gate)
     for fctx in files:
@@ -189,22 +213,34 @@ def run_lint(root: Optional[str] = None,
     baseline = _load_baseline(root, config)
     baselined: List[Finding] = []
     active: List[Finding] = []
+    matched: Set[str] = set()
     for f in unsuppressed:
-        if f.fingerprint(_line_text(pctx, f)) in baseline:
+        fp = f.fingerprint(_line_text(pctx, f))
+        if fp in baseline:
             baselined.append(f)
+            matched.add(fp)
         else:
             active.append(f)
+    # entries whose debt was paid (the finding is gone — fixed, or its
+    # suppressed-rule set shrank) linger as dead weight and churn every
+    # rewrite: surface them as informational `baseline-stale` notes so
+    # --fix-baseline prunes them deliberately, not accidentally
+    stale = [e for fp, e in sorted(baseline.items())
+             if fp not in matched]
     active.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return LintResult(root=root, findings=active, suppressed=suppressed,
                       baselined=len(baselined), files=len(files),
                       internal_errors=internal, pctx=pctx,
-                      baselined_findings=baselined)
+                      baselined_findings=baselined,
+                      stale_baseline=stale, rule_timings=timings,
+                      wall_s=time.perf_counter() - t_start)
 
 
 # -- rendering -------------------------------------------------------------
 
 def render_json(result: LintResult,
-                pctx: Optional[PackageContext] = None) -> str:
+                pctx: Optional[PackageContext] = None,
+                budget: Optional[float] = None) -> str:
     findings = []
     for f in result.findings:
         findings.append({
@@ -213,6 +249,11 @@ def render_json(result: LintResult,
             "fingerprint": f.fingerprint(
                 _line_text(pctx, f) if pctx is not None else ""),
         })
+    if budget is None:
+        # the config default; run_cli passes the effective budget so a
+        # --time-budget override and the exit code agree with the JSON
+        budget = (result.pctx.config.time_budget_s
+                  if result.pctx is not None else None)
     return json.dumps({
         "version": JSON_SCHEMA_VERSION,
         "root": result.root,
@@ -225,6 +266,13 @@ def render_json(result: LintResult,
         },
         "rules": sorted(RULES),
         "findings": findings,
+        "staleBaseline": result.stale_baseline,
+        "timings": {
+            "perRule": {k: round(v, 4)
+                        for k, v in sorted(result.rule_timings.items())},
+            "totalSeconds": round(result.wall_s, 4),
+            "budgetSeconds": budget,
+        },
         "internalErrors": result.internal_errors,
     }, indent=2)
 
@@ -234,18 +282,102 @@ def render_human(result: LintResult) -> str:
     for f in result.findings:
         lines.append(f"{f.path}:{f.line}:{f.col}: [{f.rule}] "
                      f"{f.message}")
+    for e in result.stale_baseline:
+        # informational: the debt was paid; exit code is unaffected
+        lines.append(f"{e['path']}: note: [baseline-stale] entry "
+                     f"`{e['rule']}` no longer matches any finding — "
+                     f"run --fix-baseline to prune it")
     lines.append(
         f"tpu-lint: {len(result.findings)} finding(s), "
         f"{result.suppressed} suppressed, {result.baselined} baselined "
+        f"({len(result.stale_baseline)} stale) "
         f"across {result.files} files "
-        f"({len(RULES)} rules)")
+        f"({len(RULES)} rules, {result.wall_s:.1f}s)")
     return "\n".join(lines)
 
 
+def render_github(result: LintResult) -> str:
+    """GitHub Actions workflow-command annotations: one ::error per
+    finding (file/line/col land as inline PR annotations), ::notice
+    for stale baseline entries, ::warning for internal errors."""
+
+    def esc(s: str) -> str:
+        # workflow-command data escapes (docs.github.com: % -> %25,
+        # CR/LF -> %0D/%0A)
+        return (s.replace("%", "%25").replace("\r", "%0D")
+                .replace("\n", "%0A"))
+
+    lines: List[str] = []
+    for f in result.findings:
+        lines.append(f"::error file={esc(f.path)},line={f.line},"
+                     f"col={f.col},title=tpu-lint {esc(f.rule)}::"
+                     f"{esc(f.message)}")
+    for e in result.stale_baseline:
+        lines.append(f"::notice file={esc(e['path'])},"
+                     f"title=tpu-lint baseline-stale::baseline entry "
+                     f"`{esc(e['rule'])}` no longer matches any "
+                     f"finding — run --fix-baseline to prune it")
+    for err in result.internal_errors:
+        lines.append(f"::warning title=tpu-lint internal::{esc(err)}")
+    lines.append(f"tpu-lint: {len(result.findings)} finding(s) across "
+                 f"{result.files} files")
+    return "\n".join(lines)
+
+
+def changed_files(root: str, base: str) -> Optional[Set[str]]:
+    """ROOT-relative paths changed vs ``base`` per
+    ``git diff --name-only`` (plus untracked files, so a brand-new
+    module is linted pre-commit too); None when git fails. ``git
+    diff`` emits toplevel-relative paths, so when the lint root is
+    nested inside the worktree they are re-based onto the root —
+    otherwise the intersection with finding paths would be empty and
+    the incremental mode would silently pass bad code."""
+    try:
+        # quotepath=off: default git octal-escapes non-ASCII paths
+        # ("caf\303\251.py"), which would never match a finding path
+        # and silently drop that file from the incremental gate
+        out = subprocess.run(
+            ["git", "-C", root, "-c", "core.quotepath=off", "diff",
+             "--name-only", base],
+            capture_output=True, text=True, timeout=30)
+        if out.returncode != 0:
+            return None
+        prefix = ""
+        pfx = subprocess.run(
+            ["git", "-C", root, "rev-parse", "--show-prefix"],
+            capture_output=True, text=True, timeout=30)
+        if pfx.returncode == 0:
+            prefix = pfx.stdout.strip()
+        paths = {p.strip()[len(prefix):] for p in out.stdout.splitlines()
+                 if p.strip() and p.strip().startswith(prefix)}
+        extra = subprocess.run(
+            ["git", "-C", root, "-c", "core.quotepath=off", "ls-files",
+             "--others", "--exclude-standard"],
+            capture_output=True, text=True, timeout=30)
+        if extra.returncode == 0:
+            # ls-files paths are already relative to the -C directory
+            paths |= {p.strip() for p in extra.stdout.splitlines()
+                      if p.strip()}
+        return paths
+    except Exception:
+        return None
+
+
 def run_cli(root: Optional[str] = None, as_json: bool = False,
-            fix_baseline: bool = False) -> int:
+            fix_baseline: bool = False, fmt: Optional[str] = None,
+            changed_only: Optional[str] = None,
+            time_budget: Optional[float] = None) -> int:
     """`tools lint` body. Exit contract: 0 clean / 1 findings /
-    2 internal error."""
+    2 internal error — including a run whose wall exceeds the time
+    budget (the gate must stay affordable, docs/linting.md).
+
+    ``fmt``: "human" (default) / "json" / "github" (workflow-command
+    annotations); ``as_json`` is the legacy spelling of fmt="json".
+    ``changed_only``: a git base ref — findings are restricted to files
+    in ``git diff --name-only <base>`` (+ untracked), while the
+    ANALYSIS still covers the whole package so cross-module data-flow
+    rules see true call graphs. ``time_budget``: override the
+    config's ``time_budget_s``."""
     try:
         root = root or default_root()
         config = load_config(root)
@@ -262,14 +394,49 @@ def run_cli(root: Optional[str] = None, as_json: bool = False,
             return 2
         if fix_baseline:
             # active findings PLUS still-live accepted debt: rewriting
-            # with only the new findings would un-accept the old ones
+            # with only the new findings would un-accept the old ones.
+            # Stale entries are pruned by construction (they match no
+            # current finding, so they are in neither list).
             keep = result.findings + result.baselined_findings
             path = write_baseline(root, config, keep, result.pctx)
-            print(f"tpu-lint: baselined {len(keep)} "
-                  f"finding(s) into {path}")
+            pruned = len(result.stale_baseline)
+            print(f"tpu-lint: baselined {len(keep)} finding(s) into "
+                  f"{path}"
+                  + (f" ({pruned} stale entr"
+                     f"{'y' if pruned == 1 else 'ies'} pruned)"
+                     if pruned else ""))
             return 0
-        print(render_json(result, result.pctx) if as_json
-              else render_human(result))
+        if changed_only is not None:
+            changed = changed_files(root, changed_only)
+            if changed is None:
+                print(f"tpu-lint: --changed-only: git diff "
+                      f"--name-only {changed_only} failed under "
+                      f"{root}")
+                return 2
+            result = dataclasses.replace(
+                result,
+                findings=[f for f in result.findings
+                          if f.path in changed],
+                stale_baseline=[e for e in result.stale_baseline
+                                if e.get("path") in changed])
+        budget = (time_budget if time_budget is not None
+                  else config.time_budget_s)
+        fmt = fmt or ("json" if as_json else "human")
+        if fmt == "json":
+            print(render_json(result, result.pctx, budget=budget))
+        elif fmt == "github":
+            print(render_github(result))
+        else:
+            print(render_human(result))
+        if budget and result.wall_s > budget:
+            import sys
+            # stderr: the budget breach must not corrupt --json stdout
+            print(f"tpu-lint: analysis wall {result.wall_s:.1f}s "
+                  f"exceeded the {budget:.0f}s budget — the gate must "
+                  f"stay affordable; profile the slow rule "
+                  f"(--json timings.perRule) or raise time_budget_s "
+                  f"in tpu-lint.json", file=sys.stderr)
+            return 2
         return 0 if result.clean else 1
     except Exception:
         traceback.print_exc()

@@ -124,6 +124,46 @@ def active_mesh(mesh: Mesh) -> Iterator[Mesh]:
         set_active_mesh(prev)
 
 
+# ---------------------------------------------------------------------------
+# Served-query collective serialization (docs/multichip.md)
+#
+# Two concurrent XLA CPU collectives over ONE device set deadlock at
+# rendezvous — the PR 13 soak-documented limit of the mesh path under
+# the server. Until the runtime grows per-query collective isolation
+# (ROADMAP item 3's prerequisite), served sessions serialize their mesh
+# collective sections behind this per-process mutex
+# (spark.rapids.sql.multichip.serializeServedQueries, default on): only
+# the collective dispatch is exclusive — staging, scans and
+# non-collective stages of other queries keep running — and waiting
+# queries re-check their CancelToken every bounded slice, so a
+# cancelled/timed-out query never parks on the mutex.
+# ---------------------------------------------------------------------------
+
+_COLLECTIVE_MUTEX = threading.RLock()
+
+
+@contextlib.contextmanager
+def collective_section(conf) -> Iterator[None]:
+    """Scoped mesh-collective exclusion. A no-op for non-served
+    sessions (a single user cannot race itself into the rendezvous
+    deadlock) and when ``serializeServedQueries`` is off; reentrant on
+    one thread, so nested sections compose."""
+    from spark_rapids_tpu.conf import (MULTICHIP_SERIALIZE_SERVED,
+                                       SERVE_TENANT_ID)
+    if conf is None or not str(conf.get(SERVE_TENANT_ID)) \
+            or not bool(conf.get(MULTICHIP_SERIALIZE_SERVED)):
+        yield
+        return
+    from spark_rapids_tpu import lifecycle as LC
+    while not _COLLECTIVE_MUTEX.acquire(timeout=0.05):
+        # bounded slices: cancellation reaches a queued mesh query
+        LC.checkpoint("meshMutex")
+    try:
+        yield
+    finally:
+        _COLLECTIVE_MUTEX.release()
+
+
 def mesh_scan_devices(conf) -> list:
     """Devices for the mesh-sharded scan: the active mesh's chips when
     ``spark.rapids.sql.multichip.scan.enabled`` is on AND a multi-device

@@ -157,14 +157,12 @@ def _run_round(rnd: int, data_dir: str, oracle: Dict[str, list],
         "spark.rapids.sql.serve.maxConcurrentPerTenant": "8",
     }
     conf.update(schedule)
-    if schedule.get("spark.rapids.shuffle.mode") == "ici":
-        # the ICI round SERIALIZES execution: two concurrent XLA CPU
-        # collectives over one device set deadlock at rendezvous (a
-        # known limit of the mesh path under concurrency — the chip
-        # failure ladder is exercised, tenants still QUEUE through
-        # admission and lifecycle injections still fire)
-        conf["spark.rapids.sql.serve.maxConcurrentQueries"] = "1"
-        conf["spark.rapids.sql.serve.maxConcurrentPerTenant"] = "1"
+    # the ICI round runs at FULL concurrency: served sessions
+    # serialize only their mesh collective sections behind the
+    # per-process mutex (spark.rapids.sql.multichip
+    # .serializeServedQueries, default on), so the XLA CPU collective
+    # rendezvous deadlock cannot fire while admission, lifecycle
+    # injections, and every non-collective stage still run concurrent
     store = MEM._STORE
     base_device = store.device_bytes if store is not None else 0
     base_host = store.host_bytes if store is not None else 0

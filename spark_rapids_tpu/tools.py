@@ -513,10 +513,28 @@ def _main(argv: List[str]) -> int:
                     help="serve-client: print server stats instead of "
                     "running SQL")
     ap.add_argument("--json", action="store_true",
-                    help="lint: machine-readable JSON output")
+                    help="lint: machine-readable JSON output "
+                    "(same as --format=json)")
+    ap.add_argument("--format", default=None, dest="lint_format",
+                    choices=["human", "json", "github"],
+                    help="lint: output format; `github` emits "
+                    "workflow-command annotations (::error ...) for "
+                    "inline PR comments in Actions")
+    ap.add_argument("--changed-only", nargs="?", const="HEAD",
+                    default=None, metavar="BASE",
+                    help="lint: restrict findings to files in `git "
+                    "diff --name-only BASE` (default HEAD) plus "
+                    "untracked files — the incremental pre-commit "
+                    "mode; the analysis still covers the whole "
+                    "package so cross-module rules stay sound")
+    ap.add_argument("--time-budget", type=float, default=None,
+                    help="lint: fail (exit 2) when the analysis wall "
+                    "exceeds this many seconds (default: "
+                    "time_budget_s in tpu-lint.json, 60s)")
     ap.add_argument("--fix-baseline", action="store_true",
                     help="lint: capture current findings into the "
-                    "baseline file as accepted debt")
+                    "baseline file as accepted debt (stale entries "
+                    "are pruned)")
     ap.add_argument("--root", default=None,
                     help="lint: repo root to analyze (default: the "
                     "installed package's parent directory)")
@@ -555,7 +573,10 @@ def _main(argv: List[str]) -> int:
         # 2 internal error
         from spark_rapids_tpu.lint import run_cli
         return run_cli(root=args.root, as_json=args.json,
-                       fix_baseline=args.fix_baseline)
+                       fix_baseline=args.fix_baseline,
+                       fmt=args.lint_format,
+                       changed_only=args.changed_only,
+                       time_budget=args.time_budget)
 
     if args.command == "serve":
         return _serve_main(args)

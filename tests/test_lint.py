@@ -510,6 +510,345 @@ def test_cancel_checkpoint_none_timeout_and_scope(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# family 6: interprocedural data-flow (tpu-lint v2)
+# ---------------------------------------------------------------------------
+
+def _of(result, rule):
+    return [f for f in result.findings if f.rule == rule]
+
+
+def test_donation_safety_direct_bad_and_good(tmp_path):
+    root = _tree(tmp_path, {"spark_rapids_tpu/exec/x.py": """
+        import jax
+
+        _F = jax.jit(lambda a: a, donate_argnums=(0,))
+
+        def bad(x):
+            y = _F(x)
+            return x.shape  # read after donate
+
+        def good(x):
+            n = x.shape  # staged BEFORE the donating dispatch
+            y = _F(x)
+            return y, n
+
+        def rebound(x):
+            y = _F(x)
+            x = y
+            return x.shape  # rebinding kills the flag
+
+        def canonical(x):
+            x = _F(x)  # rebound IN the donating statement
+            return x.shape  # reads the program's output: clean
+
+        def canonical_loop(batches, acc):
+            for b in batches:
+                use(acc)
+                acc = _F(acc)  # same-statement rebind: clean
+    """})
+    r = _lint(root)
+    bad = _of(r, "donation-safety")
+    assert [f.line for f in bad] == [7]
+    assert "`x` is read after being donated" in bad[0].message
+
+
+def test_donation_safety_through_helper_one_level(tmp_path):
+    # the helper donates ITS positional parameter; the caller's read
+    # after the helper call is the finding (one call level deep)
+    root = _tree(tmp_path, {"spark_rapids_tpu/exec/x.py": """
+        import jax
+
+        _F = jax.jit(lambda a: a, donate_argnums=(0,))
+
+        def helper(buf):
+            return _F(buf)
+
+        def caller(x):
+            out = helper(x)
+            return x.shape  # flagged: x was donated one call down
+    """})
+    r = _lint(root)
+    bad = _of(r, "donation-safety")
+    assert [f.line for f in bad] == [10]
+    assert "helper" in bad[0].message
+
+
+def test_donation_safety_resolves_jitcache_builder(tmp_path):
+    # the real package's shape: fn, miss = CACHE.get_or_build(key,
+    # lambda: build(...)) where build returns a MAY-donating jit
+    root = _tree(tmp_path, {"spark_rapids_tpu/exec/x.py": """
+        import jax
+        from spark_rapids_tpu.jit_cache import JitCache
+
+        _C = JitCache("fixture")
+
+        def build(donate):
+            def fn(a, b):
+                return a
+            return jax.jit(fn, donate_argnums=(0, 1) if donate else ())
+
+        def run(b, lits):
+            fn, miss = _C.get_or_build("k", lambda: build(True))
+            cols, act = fn(b.columns, b.active)
+            return b.rows  # read after the donating dispatch
+
+        def run_ok(b, lits):
+            fn, miss = _C.get_or_build("k", lambda: build(True))
+            rows = b.rows  # staged before
+            cols, act = fn(b.columns, b.active)
+            return rows
+    """})
+    r = _lint(root)
+    bad = _of(r, "donation-safety")
+    assert [f.line for f in bad] == [14]
+
+
+def test_donation_safety_loop_back_edge(tmp_path):
+    # the read PRECEDES the call in source but follows it on the loop's
+    # back edge; the for target rebinds, so only the un-rebound name
+    # (the accumulator) is flagged
+    root = _tree(tmp_path, {"spark_rapids_tpu/exec/x.py": """
+        import jax
+
+        _F = jax.jit(lambda a: a, donate_argnums=(0,))
+
+        def bad(batches, acc):
+            for b in batches:
+                use(acc)  # next iteration reads the donated acc
+                _F(acc)
+
+        def good(batches):
+            for b in batches:
+                use(b)
+                _F(b)  # b rebinds at the loop head: clean
+    """})
+    r = _lint(root)
+    bad = _of(r, "donation-safety")
+    assert [f.line for f in bad] == [7]
+    assert "`acc`" in bad[0].message
+
+
+def test_hidden_sync_tainted_flagged_host_value_not(tmp_path):
+    root = _tree(tmp_path, {"spark_rapids_tpu/exec/x.py": """
+        import numpy as np
+        import jax.numpy as jnp
+
+        def bad(col):
+            s = jnp.sum(col)
+            return s.item()  # device scalar forced on the hot path
+
+        def bad2(col):
+            s = jnp.sum(col)
+            return float(np.asarray(s))  # one finding: the asarray
+
+        def fine(host_list):
+            a = np.asarray(host_list)  # NOT a device value
+            return int(a[0])
+
+        def kwargs_only(rows):
+            return np.array(object=rows)  # no positional arg: no crash
+
+        def outer(col):
+            s = jnp.sum(col)
+
+            def cb(s):
+                return float(s)  # SHADOWED host param: not the device s
+            return cb
+    """})
+    r = _lint(root)
+    bad = _of(r, "hidden-sync")
+    assert [f.line for f in bad] == [6, 10]
+    assert ".item()" in bad[0].message
+
+
+def test_hidden_sync_scope_and_allowlist(tmp_path):
+    files = {"spark_rapids_tpu/exec/x.py": """
+        import jax.numpy as jnp
+
+        def drain(col):
+            s = jnp.sum(col)
+            return int(s)
+    """,
+             # identical code OUTSIDE the hot-path scopes: clean
+             "spark_rapids_tpu/sql/y.py": """
+        import jax.numpy as jnp
+
+        def elsewhere(col):
+            s = jnp.sum(col)
+            return int(s)
+    """}
+    root = _tree(tmp_path, files)
+    r = _lint(root)
+    assert [(f.path, f.line) for f in _of(r, "hidden-sync")] == \
+        [("spark_rapids_tpu/exec/x.py", 5)]
+    allow = {"spark_rapids_tpu/exec/x.py::drain":
+             "fixture sanctioned drain point"}
+    assert not _of(_lint(root, sync_allowlist=allow), "hidden-sync")
+
+
+def test_handle_leak_bad_and_escapes(tmp_path):
+    root = _tree(tmp_path, {"spark_rapids_tpu/exec/x.py": """
+        def leak(staged, device):
+            tok = start_upload(staged, device)  # never finished
+            return None
+
+        def dropped(staged, device):
+            start_upload(staged, device)  # result dropped
+
+        def tracked(store, b, out):
+            h = store.register(b)
+            out.append(h)  # escapes to the tracked container: fine
+
+        def closed(store, b):
+            h = store.register(b)
+            try:
+                return h.get()
+            finally:
+                h.close()
+
+        def returned(store, b):
+            return store.register(b)
+
+        def except_only(store, b):
+            h = store.register(b)
+            try:
+                return compute(h.get())
+            except Exception:
+                h.close()  # success path still leaks
+                raise
+    """})
+    r = _lint(root)
+    bad = _of(r, "handle-leak")
+    assert [f.line for f in bad] == [2, 6, 23]
+    assert "never closed" in bad[0].message
+    assert "result dropped" in bad[1].message
+    assert "exception path" in bad[2].message
+
+
+def test_trace_purity_two_calls_deep(tmp_path):
+    root = _tree(tmp_path, {"spark_rapids_tpu/exec/x.py": """
+        import time
+
+        import jax
+
+        _REG = {}
+
+        def build():
+            return jax.jit(_traced)
+
+        def _traced(x):
+            return _helper(x)
+
+        def _helper(x):
+            t = time.time()  # host clock two calls below the builder
+            _REG["k"] = t    # module-state mutation
+            return x
+    """})
+    r = _lint(root)
+    bad = _of(r, "trace-purity")
+    assert [f.line for f in bad] == [14, 15]
+    assert "host clock" in bad[0].message
+    assert "mutates free state" in bad[1].message
+
+
+def test_trace_purity_conf_read_and_pure_twin(tmp_path):
+    root = _tree(tmp_path, {"spark_rapids_tpu/exec/x.py": """
+        import jax
+
+        def build(conf):
+            limit = conf.get("k")  # snapshotted OUTSIDE the trace: ok
+            return jax.jit(lambda x: _traced(x, limit))
+
+        def _traced(x, limit):
+            return x + limit
+
+        def build_bad(conf):
+            def fn(x):
+                return x + conf.get("k")  # read AT TRACE TIME
+            return jax.jit(fn)
+    """})
+    r = _lint(root)
+    bad = _of(r, "trace-purity")
+    assert [f.line for f in bad] == [12]
+    assert "dynamic conf read" in bad[0].message
+
+
+def test_trace_purity_cross_module_from_import(tmp_path):
+    # `from mod import helper` flows must resolve across files: the
+    # impurity sits one from-imported call below the traced root
+    root = _tree(tmp_path, {
+        "spark_rapids_tpu/exec/a.py": """
+            import jax
+            from spark_rapids_tpu.exec.b import helper
+
+            def build():
+                return jax.jit(_traced)
+
+            def _traced(x):
+                return helper(x)
+        """,
+        "spark_rapids_tpu/exec/b.py": """
+            import time
+
+            def helper(x):
+                return x + time.time()
+        """})
+    bad = _of(_lint(root), "trace-purity")
+    assert [(f.path, f.line) for f in bad] == \
+        [("spark_rapids_tpu/exec/b.py", 4)]
+
+
+def test_donation_attribute_receiver_no_name_collision(tmp_path):
+    # `obj.dispatch(...)` must NOT resolve to an unrelated same-file
+    # donating `def dispatch` — only self/cls receivers match in-file
+    root = _tree(tmp_path, {"spark_rapids_tpu/exec/x.py": """
+        import jax
+
+        _F = jax.jit(lambda a: a, donate_argnums=(0,))
+
+        def dispatch(buf):
+            return _F(buf)
+
+        def unrelated(obj, y):
+            obj.dispatch(y)
+            return y.shape  # obj.dispatch is NOT the donating helper
+
+        class C:
+            def dispatch(self, buf):
+                return _F(buf)
+
+            def caller(self, z):
+                self.dispatch(z)
+                return z.shape  # self.dispatch IS: flagged
+    """})
+    bad = _of(_lint(root), "donation-safety")
+    assert [f.line for f in bad] == [18]
+
+
+def test_trace_purity_closure_accumulator_is_pure(tmp_path):
+    # per-trace bookkeeping (the decode programs' lazy byte memo, the
+    # lane planners' append) binds in an ENCLOSING function — that is
+    # deterministic trace-local state, not cross-trace impurity
+    root = _tree(tmp_path, {"spark_rapids_tpu/exec/x.py": """
+        import jax
+
+        def build():
+            def fn(x):
+                lanes = []
+                memo = None
+
+                def add(v):
+                    nonlocal memo
+                    lanes.append(v)
+                    memo = v
+                    return memo
+                return add(x)
+            return jax.jit(fn)
+    """})
+    assert not _of(_lint(root), "trace-purity")
+
+
+# ---------------------------------------------------------------------------
 # engine: suppressions, baseline, JSON schema
 # ---------------------------------------------------------------------------
 
@@ -639,6 +978,163 @@ def test_config_file_overrides(tmp_path):
     cfg = load_config(root)
     assert cfg.check_docs is False
     assert run_lint(root, cfg).clean
+
+
+# ---------------------------------------------------------------------------
+# engine v2: timings + budget, github format, changed-only, stale
+# baseline pruning
+# ---------------------------------------------------------------------------
+
+_BAD_JIT = """
+    import jax
+
+    def a(fn):
+        return jax.jit(fn)
+"""
+
+
+def test_json_timings_and_budget_exit(tmp_path, capsys):
+    from spark_rapids_tpu.lint import run_cli
+    root = _tree(tmp_path, {"spark_rapids_tpu/exec/x.py": _BAD_JIT})
+    (tmp_path / "fixture" / "tpu-lint.json").write_text(
+        json.dumps({"check_docs": False}))
+    assert run_cli(root=root, as_json=True) == 1
+    out = json.loads(capsys.readouterr().out)
+    t = out["timings"]
+    assert t["totalSeconds"] >= 0 and t["budgetSeconds"] == 60.0
+    assert set(t["perRule"]) == set(out["rules"])
+    assert all(v >= 0 for v in t["perRule"].values())
+    # a --time-budget override must show up in the JSON it judges by
+    assert run_cli(root=root, as_json=True, time_budget=45.0) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["timings"]["budgetSeconds"] == 45.0
+    # an unaffordable run fails the gate even when findings-free:
+    # exit 2, not a quietly slower tier-1
+    clean = _tree(tmp_path / "c",
+                  {"spark_rapids_tpu/exec/x.py": "X = 1\n"})
+    ((tmp_path / "c") / "fixture" / "tpu-lint.json").write_text(
+        json.dumps({"check_docs": False}))
+    assert run_cli(root=clean) == 0
+    capsys.readouterr()
+    assert run_cli(root=clean, time_budget=1e-9) == 2
+    # the breach goes to STDERR so --json stdout stays parseable
+    captured = capsys.readouterr()
+    assert "exceeded" in captured.err and "exceeded" not in captured.out
+
+
+def test_time_budget_config_override(tmp_path, capsys):
+    from spark_rapids_tpu.lint import run_cli
+    root = _tree(tmp_path, {"spark_rapids_tpu/exec/x.py": "X = 1\n"})
+    (tmp_path / "fixture" / "tpu-lint.json").write_text(
+        json.dumps({"check_docs": False, "time_budget_s": 1e-9}))
+    assert run_cli(root=root) == 2
+    assert "exceeded" in capsys.readouterr().err
+
+
+def test_github_format_annotations(tmp_path, capsys):
+    from spark_rapids_tpu.lint import run_cli
+    root = _tree(tmp_path, {"spark_rapids_tpu/exec/x.py": _BAD_JIT})
+    (tmp_path / "fixture" / "tpu-lint.json").write_text(
+        json.dumps({"check_docs": False}))
+    assert run_cli(root=root, fmt="github") == 1
+    out = capsys.readouterr().out
+    assert ("::error file=spark_rapids_tpu/exec/x.py,line=4,col=12,"
+            "title=tpu-lint jit-direct::") in out
+    # the whole annotation (message included) stays on ONE line — a
+    # raw newline would truncate the workflow command
+    err_lines = [ln for ln in out.splitlines()
+                 if ln.startswith("::error")]
+    assert len(err_lines) == 1 and "jit-direct" in err_lines[0]
+
+
+def test_changed_only_filters_to_git_diff(tmp_path, capsys):
+    from spark_rapids_tpu.lint import run_cli
+    root = _tree(tmp_path, {
+        "spark_rapids_tpu/exec/old.py": _BAD_JIT,
+        "spark_rapids_tpu/exec/new.py": _BAD_JIT,
+    })
+    (tmp_path / "fixture" / "tpu-lint.json").write_text(
+        json.dumps({"check_docs": False}))
+    git = ["git", "-C", root, "-c", "user.email=t@t",
+           "-c", "user.name=t"]
+    subprocess.run(git + ["init", "-q"], check=True)
+    subprocess.run(git + ["add", "spark_rapids_tpu/exec/old.py"],
+                   check=True)
+    subprocess.run(git + ["commit", "-qm", "seed"], check=True)
+    # full run sees both files' findings; --changed-only only the
+    # untracked one (old.py is committed and unchanged vs HEAD)
+    assert run_cli(root=root) == 1
+    full = capsys.readouterr().out
+    assert "old.py" in full and "new.py" in full
+    assert run_cli(root=root, changed_only="HEAD") == 1
+    changed = capsys.readouterr().out
+    assert "new.py" in changed and "old.py:" not in changed
+    # a bad base ref must not silently lint nothing
+    assert run_cli(root=root, changed_only="no-such-ref") == 2
+
+
+def test_changed_only_nested_root(tmp_path, capsys):
+    # git toplevel ABOVE the lint root: `git diff` emits toplevel-
+    # relative paths ("fixture/...") that must re-base onto the root,
+    # or the incremental mode silently passes bad code
+    from spark_rapids_tpu.lint import run_cli
+    root = _tree(tmp_path, {"spark_rapids_tpu/exec/old.py": _BAD_JIT})
+    (tmp_path / "fixture" / "tpu-lint.json").write_text(
+        json.dumps({"check_docs": False}))
+    git = ["git", "-C", str(tmp_path), "-c", "user.email=t@t",
+           "-c", "user.name=t"]
+    subprocess.run(git + ["init", "-q"], check=True)
+    subprocess.run(git + ["add", "-A"], check=True)
+    subprocess.run(git + ["commit", "-qm", "seed"], check=True)
+    p = os.path.join(root, "spark_rapids_tpu/exec/old.py")
+    open(p, "a").write(
+        "\n\ndef b(fn):\n    return jax.jit(fn, static_argnums=0)\n")
+    assert run_cli(root=root, changed_only="HEAD") == 1
+    assert "old.py" in capsys.readouterr().out
+
+
+def test_stale_baseline_reported_and_pruned(tmp_path, capsys):
+    from spark_rapids_tpu.lint import run_cli
+    root = _tree(tmp_path, {"spark_rapids_tpu/exec/x.py": _BAD_JIT})
+    cfg = LintConfig(check_docs=False)
+    r = run_lint(root, cfg)
+    write_baseline(root, cfg, r.findings, r.pctx)
+    # fix the violation: the baseline entry goes stale but the run
+    # stays CLEAN (informational note, exit 0)
+    p = os.path.join(root, "spark_rapids_tpu/exec/x.py")
+    open(p, "w").write("def a(fn):\n    return fn\n")
+    r2 = run_lint(root, cfg)
+    assert r2.clean and r2.baselined == 0
+    assert [e["rule"] for e in r2.stale_baseline] == ["jit-direct"]
+    out = json.loads(render_json(r2, r2.pctx))
+    assert out["clean"] is True
+    assert out["staleBaseline"][0]["rule"] == "jit-direct"
+    # --fix-baseline prunes the dead entry and says so
+    (tmp_path / "fixture" / "tpu-lint.json").write_text(
+        json.dumps({"check_docs": False}))
+    assert run_cli(root=root, fix_baseline=True) == 0
+    assert "1 stale entry pruned" in capsys.readouterr().out
+    path = os.path.join(root, cfg.baseline)
+    assert json.load(open(path))["findings"] == []
+    assert run_lint(root, cfg).clean
+
+
+def test_fix_baseline_no_churn_when_unchanged(tmp_path):
+    # same accepted-debt SET (text-keyed fingerprints) -> the file is
+    # left byte-identical even though line numbers shifted
+    root = _tree(tmp_path, {"spark_rapids_tpu/exec/x.py": _BAD_JIT})
+    cfg = LintConfig(check_docs=False)
+    r = run_lint(root, cfg)
+    path = write_baseline(root, cfg, r.findings, r.pctx)
+    before = open(path).read()
+    p = os.path.join(root, "spark_rapids_tpu/exec/x.py")
+    src = open(p).read()
+    open(p, "w").write("import os  # shift\n" + src)
+    r2 = run_lint(root, cfg)
+    assert r2.clean and r2.baselined == 1 and not r2.stale_baseline
+    write_baseline(root, cfg,
+                   r2.findings + r2.baselined_findings, r2.pctx)
+    assert open(path).read() == before
 
 
 # ---------------------------------------------------------------------------

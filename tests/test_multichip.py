@@ -463,3 +463,52 @@ def test_multichip_scan_disabled_falls_back(tmp_path):
     cpu, _ = _collect_rows(_scan_agg(path),
                            {"spark.rapids.sql.enabled": "false"})
     assert rows == cpu
+
+
+def test_collective_section_serializes_served_queries():
+    """Served sessions' mesh collective sections are mutually
+    exclusive (the XLA CPU rendezvous-deadlock guard,
+    spark.rapids.sql.multichip.serializeServedQueries); non-served
+    sessions and the conf-off case skip the mutex; the section is
+    reentrant on one thread."""
+    import threading
+    import time as _t
+
+    from spark_rapids_tpu.conf import TpuConf
+    from spark_rapids_tpu.parallel.mesh import collective_section
+
+    def max_overlap(conf, workers=4):
+        state = {"inside": 0, "peak": 0}
+        lock = threading.Lock()
+        start = threading.Barrier(workers)
+
+        def worker():
+            start.wait()
+            with collective_section(conf):
+                with lock:
+                    state["inside"] += 1
+                    state["peak"] = max(state["peak"], state["inside"])
+                _t.sleep(0.03)
+                with lock:
+                    state["inside"] -= 1
+
+        ts = [threading.Thread(target=worker) for _ in range(workers)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in ts)
+        return state["peak"]
+
+    served = TpuConf({"spark.rapids.sql.serve.tenantId": "t1"})
+    assert max_overlap(served) == 1
+    # conf off / non-served: no exclusion (sections overlap freely)
+    off = TpuConf({
+        "spark.rapids.sql.serve.tenantId": "t1",
+        "spark.rapids.sql.multichip.serializeServedQueries": "false"})
+    assert max_overlap(off) > 1
+    assert max_overlap(TpuConf({})) > 1
+    # reentrancy: a nested section on the same thread must not deadlock
+    with collective_section(served):
+        with collective_section(served):
+            pass
