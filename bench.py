@@ -1256,6 +1256,135 @@ def run_telemetry(clean_wall: float, cpu_rows) -> dict:
     return out
 
 
+def run_history(clean_wall: float, cpu_rows) -> dict:
+    """detail.history (docs/observability.md "Query history"): the q1
+    history-append overhead ratio (interleaved on/off walls, budget
+    <= 1.05x), a doctor round trip on a FORCED slow query (OOM storm
+    injected via the process injector while the session conf — and so
+    the plan signature — stays identical to the baseline runs), and a
+    warm-start leg proving the watchdog p99 is available with ZERO
+    fresh samples after a lifecycle reset."""
+    from spark_rapids_tpu import lifecycle as LC
+    from spark_rapids_tpu import retry as R
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+    from spark_rapids_tpu.telemetry import history as H
+    from spark_rapids_tpu.telemetry.doctor import diagnose
+
+    hdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".bench-data", "history")
+    shutil.rmtree(hdir, ignore_errors=True)
+    H.reset_history()
+    LC.reset_lifecycle()
+    R.reset_fault_injection()
+    fresh_leg()
+
+    # -- append overhead (interleaved best-of; the sessions differ in
+    # ONE variable — history.dir — so the ratio measures the append
+    # path alone, not profile writing or plan-cache savings) ---------------
+    off = TpuSparkSession({
+        **TPU_CONF,
+        "spark.rapids.sql.planCache.enabled": "true",
+    })
+    on = TpuSparkSession({
+        **TPU_CONF,
+        "spark.rapids.sql.planCache.enabled": "true",
+        "spark.rapids.sql.telemetry.history.dir": hdir,
+    })
+    prof_conf = {
+        **TPU_CONF,
+        "spark.rapids.sql.planCache.enabled": "true",
+        "spark.rapids.sql.telemetry.history.dir": hdir,
+        "spark.rapids.sql.profile.enabled": "true",
+        "spark.rapids.sql.profile.dir": os.path.join(hdir, "profiles"),
+        # consulted only when retries happen — harmless on the clean
+        # baseline runs, but it must live in the BASELINE conf too so
+        # the storm session's plan signature matches
+        "spark.rapids.sql.retry.backoffMs": "20",
+        "spark.rapids.sql.retry.maxBackoffMs": "200",
+    }
+    prof = TpuSparkSession(prof_conf)
+    try:
+        q_off, q_on = build_query(off), build_query(on)
+        run_once(q_off)  # warm
+        run_once(q_on)
+        offs, ons = [], []
+        for _ in range(2):
+            dt, rows_off = run_once(q_off)
+            offs.append(dt)
+            dt, rows_on = run_once(q_on)
+            ons.append(dt)
+        assert_rows_match(cpu_rows, rows_off)
+        assert_rows_match(cpu_rows, rows_on)
+
+        # -- doctor round trip on a forced slow query ----------------------
+        # baseline runs with profile artifacts (the doctor's stage
+        # source), then the storm on a session whose conf adds ONLY
+        # the injection schedule — test.inject* keys are excluded from
+        # the plan signature, so the storm query diffs against these
+        # baselines, exactly the situation `tools doctor` exists for
+        q_prof = build_query(prof)
+        # 4 baselines + the storm = 5 finished records for this
+        # signature, the watchdog's minimum sample count — so the
+        # warm-start leg below proves p99 availability
+        for _ in range(4):
+            _, base_rows = run_once(q_prof)
+        assert_rows_match(cpu_rows, base_rows)
+        storm_sess = TpuSparkSession({
+            **prof_conf,
+            "spark.rapids.sql.test.injectOOM": "4:2",
+        })
+        try:
+            q_storm = build_query(storm_sess)
+            t0 = time.perf_counter()
+            _, storm_rows = run_once(q_storm)
+            storm_wall = time.perf_counter() - t0
+            assert_rows_match(cpu_rows, storm_rows)
+        finally:
+            storm_sess.stop()
+            R.reset_fault_injection()
+        recs = H.read_records(hdir)
+        storm = recs[-1]
+        t0 = time.perf_counter()
+        diag = diagnose(hdir, str(storm.get("queryId")))
+        doctor_ms = (time.perf_counter() - t0) * 1e3
+        doctor_leg = {
+            "records": len(recs),
+            "stormWall_s": round(storm_wall, 4),
+            "stormRetries": storm.get("retryCount", 0),
+            "verdict": diag.get("verdict"),
+            "divergentStage": diag.get("divergentStage"),
+            "roundTripMs": round(doctor_ms, 1),
+        }
+
+        # -- warm-start: watchdog p99 with zero fresh samples --------------
+        sig = storm.get("signature")
+        LC.reset_lifecycle()  # the "restart"
+        assert LC.signature_p99(sig) is None
+        ws = H.warm_start(on.conf_obj)
+        warm_leg = {
+            "summary": ws,
+            "p99AvailableWithZeroFreshSamples":
+                LC.signature_p99(sig) is not None,
+        }
+    finally:
+        prof.stop()
+        on.stop()
+        off.stop()
+        R.reset_fault_injection()
+        LC.reset_lifecycle()
+        H.reset_history()
+    return {
+        "skipped": False,
+        "clean_wall_s": round(clean_wall, 4),
+        "historyWall_s": round(min(ons), 4),
+        "offWall_s": round(min(offs), 4),
+        "appendOverhead": round(min(ons) / min(offs), 4),
+        "appendOverheadBudget": 1.05,
+        "doctor": doctor_leg,
+        "warmStart": warm_leg,
+    }
+
+
 def run_bench_diff(current: dict) -> dict:
     """Regression tracking: diff THIS run's output against the newest
     BENCH_r0*.json in the repo (docs/observability.md 'Live
@@ -1376,6 +1505,15 @@ def main():
         lifecycle_leg = {"skipped": True,
                          "reason": f"lifecycle leg failed: {e!r}"}
 
+    # query-history leg (docs/observability.md "Query history"):
+    # append overhead, doctor round trip on a forced slow query,
+    # warm-start watchdog availability — equally fault-isolated
+    try:
+        history_leg = run_history(fused["wall_s"], cpu_rows)
+    except Exception as e:  # noqa: BLE001 - reported, not swallowed
+        history_leg = {"skipped": True,
+                       "reason": f"history leg failed: {e!r}"}
+
     cpu_t = min(cpu_times)
     tpu_t = fused["wall_s"]
     q3_tpu_t = fused["q3"]["wall_s"]
@@ -1417,6 +1555,7 @@ def main():
             "serving": serving,
             "telemetry": telemetry_leg,
             "lifecycle": lifecycle_leg,
+            "history": history_leg,
             "jitCaches": registry_snapshot()["jitCaches"],
             "tpcds_q3": {
                 "device_wall_s": round(q3_tpu_t, 4),

@@ -532,6 +532,78 @@ TELEMETRY_MIN_INTERVAL_S = conf(
     "limited_total on the endpoint) but emit no bundle — a storm "
     "cannot flood the disk.").double(60.0)
 
+TELEMETRY_MAX_BUNDLES = conf(
+    "spark.rapids.sql.telemetry.maxBundles").doc(
+    "Retention bound on telemetry artifacts in "
+    "spark.rapids.sql.telemetry.dir: trigger bundles "
+    "(bundle-*.json) and flight-recorder dumps (trace-ring-*.json) "
+    "beyond this count are pruned OLDEST-FIRST by the bundle-worker "
+    "thread after each write (never under a hot-path lock). Pruned "
+    "counts show in the engine stats, the server stats telemetry "
+    "section, and srt_telemetry_bundles_pruned_total. 0 disables "
+    "count-based retention.").integer(256)
+
+TELEMETRY_MAX_BUNDLE_BYTES = conf(
+    "spark.rapids.sql.telemetry.maxBundleBytes").doc(
+    "Retention bound on the TOTAL bytes of telemetry artifacts "
+    "(bundles + ring dumps) in spark.rapids.sql.telemetry.dir, pruned "
+    "oldest-first alongside spark.rapids.sql.telemetry.maxBundles. "
+    "0 disables byte-based retention.").bytes(0)
+
+TELEMETRY_HISTORY_DIR = conf(
+    "spark.rapids.sql.telemetry.history.dir").doc(
+    "Directory of the persistent query-history store: one compact "
+    "JSONL record per finished query (signature, tenant, terminal "
+    "status/reason, wall/queue-wait, retry/spill/kernel/jit counters, "
+    "fallback coverage, peak HBM, artifact paths), appended at query "
+    "close by session.execute_plan and the query server, rotated into "
+    "bounded segments and compacted by telemetry.history.maxBytes / "
+    "maxAgeDays. The store is the cross-run performance memory behind "
+    "server warm-start, SLO tracking, `tools history`, and `tools "
+    "doctor` (docs/observability.md 'Query history'). Empty = "
+    "disabled.").string("")
+
+TELEMETRY_HISTORY_MAX_BYTES = conf(
+    "spark.rapids.sql.telemetry.history.maxBytes").doc(
+    "Size bound on the query-history store: segments are rotated at a "
+    "fraction of this and the OLDEST whole segments are deleted once "
+    "the store's total size exceeds it (each record is one JSON line, "
+    "so compaction never truncates a record mid-line)."
+    ).bytes(64 << 20)
+
+TELEMETRY_HISTORY_MAX_AGE_DAYS = conf(
+    "spark.rapids.sql.telemetry.history.maxAgeDays").doc(
+    "Age bound on the query-history store: a rotated segment whose "
+    "newest record is older than this many days is deleted at "
+    "compaction. 0 disables age-based compaction.").double(14.0)
+
+TELEMETRY_HISTORY_WARM_START = conf(
+    "spark.rapids.sql.telemetry.history.warmStart").doc(
+    "Seed the serving tier's lifecycle state from the query-history "
+    "store at server start: per-signature wall reservoirs (so the "
+    "stuck-query watchdog has a p99 from query one after a restart) "
+    "and consecutive-failure streaks / quarantine blacklisting (so a "
+    "poison signature stays fail-fast across restarts). Effective "
+    "only when spark.rapids.sql.telemetry.history.dir is set "
+    "(docs/observability.md 'Query history').").boolean(True)
+
+SERVE_SLO_P99_MS = conf("spark.rapids.sql.serve.slo.p99Ms").doc(
+    "Per-tenant latency objective: the tenant's observed p99 wall over "
+    "the spark.rapids.sql.serve.slo.window seconds of query history "
+    "must stay under this many milliseconds. Evaluated over the "
+    "persistent history store (telemetry.history.dir must be set), "
+    "exported as the srt_slo_* Prometheus families, and — when the "
+    "observed p99 exceeds the objective — fires a rate-limited "
+    "sloBurn bundle through the telemetry trigger engine. Per-tenant "
+    "override: spark.rapids.sql.serve.slo.p99Ms.<tenant>. 0 disables "
+    "(docs/observability.md 'SLO tracking').").integer(0)
+
+SERVE_SLO_WINDOW = conf("spark.rapids.sql.serve.slo.window").doc(
+    "SLO evaluation window in seconds: objectives under "
+    "spark.rapids.sql.serve.slo.p99Ms are checked against the query "
+    "history's finished records newer than this."
+    ).double(3600.0)
+
 PARQUET_DEVICE_DECODE = conf(
     "spark.rapids.sql.format.parquet.deviceDecode.enabled").doc(
     "Decode Parquet pages ON DEVICE (the default scan path, the "

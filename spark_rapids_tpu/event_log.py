@@ -71,8 +71,12 @@ def _collect_ops(physical) -> List[Dict[str, Any]]:
 
 
 # event-line format version: 2 adds zero-valued metrics, the compact
-# conf snapshot, and the fault-injector summary; readers treat absent
-# version as 1 (read_events normalizes)
+# conf snapshot, the fault-injector summary, and the terminal
+# status/reason fields (finished/cancelled/timed-out/quarantined/
+# failed — the same vocabulary as the query-history store, so event
+# logs and history records agree on query outcomes); readers treat
+# absent version as 1 and absent status as finished (read_events
+# normalizes)
 EVENT_VERSION = 2
 
 
@@ -81,10 +85,17 @@ def write_event(log_dir: str, session_id: int, physical,
                 store_stats: Optional[Dict[str, int]] = None,
                 conf=None,
                 memory_by_op: Optional[Dict[str, Dict[str, int]]] = None,
-                query_id: Optional[int] = None,
-                tenant: Optional[str] = None) -> None:
+                query_id=None,
+                tenant: Optional[str] = None,
+                status: str = "finished",
+                reason: Optional[str] = None) -> None:
     """Append one query-completion event; failures never break the
-    query (observability must not take down execution)."""
+    query (observability must not take down execution). ``physical``
+    may be None for queries that terminated before planning resolved
+    (e.g. cancelled mid-plan); ``query_id`` is the process int
+    sequence, or the server's wire queryId string for served
+    terminal outcomes — the SAME value the query-history record
+    carries, so the two sinks join."""
     try:
         os.makedirs(log_dir, exist_ok=True)
         qid = query_id if query_id is not None else next_query_id()
@@ -93,11 +104,17 @@ def write_event(log_dir: str, session_id: int, physical,
             "version": EVENT_VERSION,
             "ts": time.time(),
             "queryId": qid,
+            "status": status,
             "wallSeconds": round(wall_s, 6),
             "outputRows": rows,
-            "plan": repr(physical),
-            "ops": _collect_ops(physical),
+            "plan": repr(physical) if physical is not None else None,
+            "ops": _collect_ops(physical) if physical is not None
+            else [],
         }
+        if reason:
+            # cancellation reason (cancel/deadline/disconnect/
+            # watchdog/shutdown/injected) for cancelled/timed-out lines
+            rec["reason"] = reason
         if tenant:
             # serving tenancy: the session's tenant id rides on every
             # event line so offline tools can slice per tenant
@@ -154,6 +171,10 @@ def read_events(path: str) -> Iterator[Dict[str, Any]]:
                 line = line.strip()
                 if line:
                     ev = json.loads(line)
-                    # pre-versioning lines are format 1
+                    # pre-versioning lines are format 1; lines written
+                    # before the terminal-status field are finished by
+                    # construction (failure paths did not log then)
                     ev.setdefault("version", 1)
+                    if ev.get("event") == "queryCompleted":
+                        ev.setdefault("status", "finished")
                     yield ev

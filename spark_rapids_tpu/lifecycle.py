@@ -416,6 +416,18 @@ def lifecycle_stats() -> Dict:
     return {"liveQueries": live, "quarantinedSignatures": quarantined}
 
 
+# bumped by every reset: the history warm-start keys its replay on
+# (dir, generation), so one process lifetime replays a store at most
+# once per reset — a second server start must not double-count
+# failure streaks into the SAME live state (history.warm_start)
+_GENERATION = [0]
+
+
+def lifecycle_generation() -> int:
+    with _HIST_LOCK:
+        return _GENERATION[0]
+
+
 def reset_lifecycle() -> None:
     """Test hook: drop the wall history, quarantine state, and the
     live-query registry."""
@@ -423,6 +435,7 @@ def reset_lifecycle() -> None:
         _WALLS.clear()
         _FATAL_STREAK.clear()
         _QUARANTINED.clear()
+        _GENERATION[0] += 1
     with _LIVE_LOCK:
         _LIVE.clear()
 

@@ -145,6 +145,9 @@ class LintConfig:
     # the telemetry endpoint module whose SERVER_FAMILY_HELP table the
     # prom-family rule checks emissions against
     prometheus_rel: str = "spark_rapids_tpu/telemetry/prometheus.py"
+    # the query-history module whose HISTORY_FIELD_CATALOG the
+    # history-field rule checks record construction against
+    history_rel: str = "spark_rapids_tpu/telemetry/history.py"
     # generated docs compared against `tools docs` regeneration
     check_docs: bool = True
 
@@ -166,7 +169,7 @@ def load_config(root: str) -> LintConfig:
         data = json.load(f)
     for key in ("check_docs", "baseline", "jit_home", "kernels_home",
                 "metrics_rel", "trace_rel", "prometheus_rel",
-                "time_budget_s"):
+                "history_rel", "time_budget_s"):
         if key in data:
             setattr(cfg, key, data[key])
     for key in ("scan_roots", "retry_scope", "retry_wrappers",

@@ -333,6 +333,60 @@ def check_prom_families(pctx):
                 f"docs/observability.md stay in lockstep")
 
 
+@rule("history-field",
+      "query-history record fields must be HISTORY_FIELD_CATALOG "
+      "entries (docs/observability.md 'Query history')")
+def check_history_fields(pctx):
+    cfg = pctx.config
+    hfctx = pctx.file(cfg.history_rel)
+    if hfctx is None:
+        return
+    consts = _module_str_constants(hfctx)
+    catalog = _dict_keys(hfctx, "HISTORY_FIELD_CATALOG", consts)
+    if catalog is None:
+        return  # no catalog in this tree (fixture runs)
+    name_re = re.compile(r"^[a-z][A-Za-z0-9]*$")
+    for name in sorted(catalog):
+        if not name_re.match(name):
+            yield Finding(
+                "history-field", hfctx.rel, 1, 1,
+                f"history field {name!r} violates the camelCase "
+                f"naming rule")
+
+    def _check_key(node: ast.AST, lineno: int, col: int):
+        if isinstance(node, ast.Constant) and isinstance(node.value,
+                                                         str) \
+                and node.value not in catalog:
+            yield Finding(
+                "history-field", hfctx.rel, lineno, col + 1,
+                f"record field {node.value!r} has no "
+                f"HISTORY_FIELD_CATALOG entry — add it (with a "
+                f"description) so the on-disk schema and the "
+                f"generated doc stay in lockstep")
+
+    # record construction convention: the dict literal assigned to a
+    # name `rec`, and every literal subscript store `rec["k"] = ...`
+    for node in ast.walk(hfctx.tree):
+        if isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+            value = node.value
+        elif isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        else:
+            continue
+        if isinstance(value, ast.Dict) and any(
+                isinstance(t, ast.Name) and t.id == "rec"
+                for t in targets):
+            for k in value.keys:
+                if k is not None:
+                    yield from _check_key(k, k.lineno, k.col_offset)
+        for t in targets:
+            if isinstance(t, ast.Subscript) and isinstance(
+                    t.value, ast.Name) and t.value.id == "rec":
+                yield from _check_key(t.slice, t.lineno, t.col_offset)
+
+
 @rule("docs-drift",
       "generated docs must match `tools docs` regeneration")
 def check_docs_drift(pctx):

@@ -58,6 +58,17 @@ _RLOCK_TYPE = type(threading.RLock())
 # Signature
 # ---------------------------------------------------------------------------
 
+def signature_digest(signature: str) -> str:
+    """Stable short identifier of a plan signature (sha1 hex). The
+    lifecycle layer (watchdog p99 history, quarantine streaks) and the
+    persistent query-history store key on THIS, not the full encoded
+    plan string: the digest is compact enough to persist per record
+    and survives restarts, while the plan cache itself keeps the full
+    string (a digest collision must never alias two plans)."""
+    import hashlib
+    return hashlib.sha1(signature.encode()).hexdigest()
+
+
 def plan_signature(plan, conf) -> str:
     """Normalized structural signature of a logical plan + the explicit
     session settings. Expression ids are renumbered in first-occurrence
@@ -122,11 +133,19 @@ def plan_signature(plan, conf) -> str:
     parts.append("||conf:")
     # serve.* keys (tenant id, admission limits) do not affect
     # planning: excluding them lets tenants SHARE cache entries for the
-    # same query shape — the whole point of a cross-query cache
+    # same query shape — the whole point of a cross-query cache.
+    # test.inject* keys are runtime fault SCHEDULES, not plan shape
+    # (the rewrite never reads them): excluding them keeps one
+    # signature per query shape across clean and injected runs, so the
+    # quarantine streaks, watchdog p99 history, and the query-history
+    # baselines `tools doctor` diffs against all key consistently.
     parts.append(";".join(
         f"{k}={v}" for k, v in sorted(
             (str(k), str(v)) for k, v in conf.settings.items())
-        if not k.startswith("spark.rapids.sql.serve.")))
+        if not k.startswith((
+            "spark.rapids.sql.serve.",
+            # tpu-lint: disable=conf-key(prefix over the test.inject* key family, not a key literal)
+            "spark.rapids.sql.test.inject"))))
     return "".join(parts)
 
 
@@ -202,18 +221,48 @@ def last_lookup_was_hit() -> bool | None:
     return getattr(_TLS, "hit", None)
 
 
-def get_or_clone(signature: str, build) -> Tuple[Any, Any, bool]:
+def rebind_conf(plan, conf_obj) -> None:
+    """Point every node of a cloned plan at the EXECUTING session's
+    conf. The signature guarantees equality of every planning-relevant
+    key, but the excluded families (serve.*, test.inject*) are read at
+    EXECUTION time — a cached template built by a clean session must
+    not silently strip another session's fault-injection schedule (or
+    serve settings) from its clone."""
+    if conf_obj is None:
+        return
+    seen = set()
+
+    def walk(p):
+        if id(p) in seen:
+            return
+        seen.add(id(p))
+        if getattr(p, "conf", None) is not None:
+            p.conf = conf_obj
+        for op in getattr(p, "fused_ops", []):
+            walk(op)
+        for c in getattr(p, "children", []):
+            walk(c)
+
+    walk(plan)
+
+
+def get_or_clone(signature: str, build,
+                 conf_obj=None) -> Tuple[Any, Any, bool]:
     """The cached (clone, report) for ``signature``, building the
     template via ``build()`` — which must return ``(physical plan,
     rewrite report)`` — on a miss. SINGLE-FLIGHT via the underlying
     JitCache: concurrent cold misses of one shape run the rewrite
     pipeline once, the rest wait and clone the winner's template.
     Returns ``(fresh clone, report, was_miss)``; the template itself is
-    never executed."""
+    never executed. ``conf_obj`` (the executing session's conf) rebinds
+    the clone's per-node conf so execution-time reads of
+    signature-excluded keys follow the EXECUTING session."""
     (template, report), was_miss = PLAN_CACHE.get_or_build(
         signature, build)
     _TLS.hit = not was_miss
-    return clone_plan(template), report, was_miss
+    clone = clone_plan(template)
+    rebind_conf(clone, conf_obj)
+    return clone, report, was_miss
 
 
 def stats() -> Dict[str, int]:

@@ -113,12 +113,27 @@ class QueryServer:
         from spark_rapids_tpu.lifecycle import StuckQueryWatchdog
         self._watchdog = StuckQueryWatchdog(cobj)
         self._disco_thread: Optional[threading.Thread] = None
+        # persistent query history + SLO burn tracking
+        # (docs/observability.md "Query history" / "SLO tracking"):
+        # the store is the cross-run memory the watchdog/quarantine
+        # warm-start reads; the tracker evaluates per-tenant p99
+        # objectives over its window
+        from spark_rapids_tpu.telemetry import history as _history
+        self._history = _history.store_for(cobj)
+        self._slo = _history.SloTracker(cobj)
+        self.warm_start_summary: Dict = {"enabled": False}
 
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "QueryServer":
         """Bind + listen + start the accept loop; ``self.port`` holds
         the bound port (ephemeral when configured 0)."""
+        # warm-start (docs/observability.md "Query history"): seed the
+        # watchdog's per-signature p99 reservoirs and the quarantine
+        # streaks from the persistent history BEFORE serving, so the
+        # lifecycle layer works from query one after a restart
+        from spark_rapids_tpu.telemetry import history as _history
+        self.warm_start_summary = _history.warm_start(self._conf_obj)
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         sock.bind((self.host, self.port))
@@ -532,9 +547,20 @@ class QueryServer:
                 return
             except LC.TpuQueryCancelled as e:
                 # cancelled / past-deadline while still QUEUED: the
-                # slot was never acquired, nothing to release
+                # slot was never acquired, nothing to release. The
+                # SERVER writes this terminal's history record — the
+                # session never started, so its close hook cannot
+                from spark_rapids_tpu.telemetry import history as _h
                 TR.end_query(session.conf_obj, tok, error=True)
                 self._count_cancel(e.reason)
+                _h.record_query_close(
+                    session.conf_obj,
+                    status=(_h.STATUS_TIMED_OUT
+                            if e.reason == LC.REASON_DEADLINE
+                            else _h.STATUS_CANCELLED),
+                    reason=e.reason, tenant=tenant,
+                    query_id=token.query_id,
+                    queue_wait_s=token.elapsed())
                 protocol.send_msg(conn, {
                     "status": "cancelled", "tenant": tenant,
                     "reason": e.reason, "where": "queued"})
@@ -572,6 +598,10 @@ class QueryServer:
                     self.queries_ok += 1
                 self._record_latency(tenant,
                                      time.perf_counter() - t_req)
+                # SLO burn evaluation point (docs/observability.md
+                # "SLO tracking"): the finished history record landed
+                # during execute, so the window now includes this query
+                self._slo.on_query_close(tenant)
             except LC.TpuQueryCancelled as e:
                 if tok is not None:
                     TR.end_query(session.conf_obj, tok, error=True)
@@ -637,7 +667,9 @@ class QueryServer:
             cancelled = self.queries_cancelled
             reasons = dict(self._cancel_reasons)
             quarantined = self.queries_quarantined
-        return {
+        from spark_rapids_tpu.telemetry import triggers as _triggers
+        tstats = _triggers.engine().stats()
+        out = {
             "host": self.host,
             "port": self.port,
             "uptimeSeconds": round(uptime, 3),
@@ -655,4 +687,17 @@ class QueryServer:
                 "watchdogCancelled": self._watchdog.cancelled,
                 **LC.lifecycle_stats(),
             },
+            # telemetry-artifact retention visibility (satellite of
+            # the query-history PR): pruned counts ride the stats
+            "telemetry": {
+                "triggersFired": tstats["fired"],
+                "triggersRateLimited": tstats["rateLimited"],
+                "bundlesPruned": tstats["pruned"],
+            },
         }
+        if self._history is not None:
+            out["history"] = {**self._history.stats(),
+                              "warmStart": self.warm_start_summary}
+        if self._slo.enabled:
+            out["slo"] = self._slo.evaluate()
+        return out

@@ -194,20 +194,25 @@ class TpuSparkSession:
             from spark_rapids_tpu import plan_cache as PC
             sig = PC.plan_signature(plan, self.conf_obj)
             # lifecycle keying (docs/serving.md "Query lifecycle"): the
-            # signature identifies this query shape for the watchdog's
-            # p99 history and the poison-query quarantine; threaded
-            # per-thread (concurrent queries share this session) and
-            # onto the live CancelToken for the watchdog's scan
-            self._tls.plan_signature = sig
+            # signature DIGEST identifies this query shape for the
+            # watchdog's p99 history, the poison-query quarantine, and
+            # the persistent query history (compact enough to persist
+            # per record); threaded per-thread (concurrent queries
+            # share this session) and onto the live CancelToken for
+            # the watchdog's scan. The plan cache keys on the full
+            # string — a digest collision must never alias two plans.
+            sig_key = PC.signature_digest(sig)
+            self._tls.plan_signature = sig_key
             from spark_rapids_tpu import lifecycle as LC
             ltok = LC.current_token()
             if ltok is not None:
-                ltok.signature = sig
+                ltok.signature = sig_key
             # single-flight build: concurrent cold misses of one shape
             # (a burst of identical queries on a fresh server) run the
             # rewrite once; everyone executes a clone of the template
             physical, report, was_miss = PC.get_or_clone(
-                sig, lambda: self._rewrite_fresh(plan))
+                sig, lambda: self._rewrite_fresh(plan),
+                conf_obj=self.conf_obj)
             self.last_rewrite_report = report
             self._tls.rewrite_report = report
             if not was_miss and report is not None:
@@ -277,6 +282,7 @@ class TpuSparkSession:
         quar_thr = int(self.conf_obj.get(SERVE_QUARANTINE_THRESHOLD))
         sig = None
         physical = None
+        t_begin = _time.perf_counter()
         tok = TR.begin_query(self.conf_obj)
         try:
             physical = self.plan_physical(plan)
@@ -303,7 +309,7 @@ class TpuSparkSession:
                 result = physical.execute_collect(
                     int(self.conf_obj.get(TASK_PARALLELISM)))
             wall_s = _time.perf_counter() - t0
-        except LC.TpuQueryCancelled:
+        except LC.TpuQueryCancelled as e:
             TR.end_query(self.conf_obj, tok, error=True)
             # a cancelled/timed-out query's HBM frees NOW: close the
             # dead plan's spillable handles deterministically instead
@@ -311,17 +317,27 @@ class TpuSparkSession:
             # quarantine — it is not a runtime-fatal failure)
             from spark_rapids_tpu import memory as _mem
             _mem.release_plan_handles(physical)
+            self._record_terminal(
+                ("timed-out" if e.reason == LC.REASON_DEADLINE
+                 else "cancelled"), e.reason, physical, sig,
+                _time.perf_counter() - t_begin)
             raise
         except LC.TpuQueryQuarantined:
             TR.end_query(self.conf_obj, tok, error=True)
+            self._record_terminal(
+                "quarantined", None, physical, sig,
+                _time.perf_counter() - t_begin)
             raise  # never ran: neither a failure nor a success
         except BaseException:
             TR.end_query(self.conf_obj, tok, error=True)
             if quar_thr > 0 and sig is not None:
                 LC.record_runtime_failure(sig, quar_thr)
+            self._record_terminal(
+                "failed", None, physical, sig,
+                _time.perf_counter() - t_begin)
             raise
-        TR.end_query(self.conf_obj, tok, wall_s=wall_s,
-                     rows=result.num_rows)
+        trace_path = TR.end_query(self.conf_obj, tok, wall_s=wall_s,
+                                  rows=result.num_rows)
         if sig is not None:
             # the watchdog's per-signature p99 history; one success
             # also clears the signature's quarantine streak
@@ -335,9 +351,13 @@ class TpuSparkSession:
         # allocated for both sinks so the artifact and the event-log
         # line for this query correlate by queryId
         from spark_rapids_tpu import event_log
+        from spark_rapids_tpu.conf import TELEMETRY_HISTORY_DIR
         log_dir = str(self.conf_obj.get(EVENT_LOG_DIR))
         profiling = bool(self.conf_obj.get(PROF.PROFILE_ENABLED))
-        qid = event_log.next_query_id() if (log_dir or profiling) else None
+        history_on = bool(str(
+            self.conf_obj.get(TELEMETRY_HISTORY_DIR) or ""))
+        qid = event_log.next_query_id() \
+            if (log_dir or profiling or history_on) else None
         self.last_profile_path = PROF.write_profile(
             self.conf_obj, physical, report,
             wall_s, result.num_rows, query_id=qid)
@@ -364,7 +384,79 @@ class TpuSparkSession:
             # tenant session may overwrite last_profile_path before
             # the hook runs — the bundle must reference its own query
             profile_path=self.thread_profile_path())
+        # persistent query history (docs/observability.md "Query
+        # history"): one compact record per finished query, the
+        # cross-run memory behind warm-start / SLO burn / tools
+        # history / tools doctor. Appended AFTER the profile/trace
+        # writes so the record can reference both artifacts.
+        from spark_rapids_tpu.telemetry import history as _history
+        # the WIRE queryId wins when the server supplied one (same
+        # rule as the cancelled/failed paths): the id the client saw
+        # in its response must resolve in `tools doctor`
+        wire_qid = self._wire_query_id()
+        _history.record_query_close(
+            self.conf_obj, status=_history.STATUS_FINISHED,
+            signature=sig, tenant=self.tenant,
+            query_id=(wire_qid if wire_qid is not None else qid),
+            wall_s=wall_s, queue_wait_s=self._queue_wait(),
+            rows=result.num_rows, physical=physical, report=report,
+            profile_path=self.thread_profile_path(),
+            trace_path=trace_path)
         return result
+
+    @staticmethod
+    def _queue_wait() -> float:
+        """The calling thread's admission-queue wait (0 outside a
+        served query) — the lifecycle token records admission time."""
+        from spark_rapids_tpu import lifecycle as LC
+        tok = LC.current_token()
+        if tok is None or tok.admitted is None:
+            return 0.0
+        return max(0.0, tok.admitted - tok.started)
+
+    @staticmethod
+    def _wire_query_id():
+        from spark_rapids_tpu import lifecycle as LC
+        tok = LC.current_token()
+        return tok.query_id if tok is not None else None
+
+    def _record_terminal(self, status: str, reason, physical, sig,
+                         wall_s: float) -> None:
+        """Event-log + history sinks for a NON-finished terminal
+        outcome (cancelled / timed-out / quarantined / failed), so the
+        two surfaces agree on query outcomes. Never raises — the
+        original exception is already propagating."""
+        try:
+            from spark_rapids_tpu import event_log
+            from spark_rapids_tpu import memory
+            from spark_rapids_tpu.conf import (EVENT_LOG_DIR,
+                                               TELEMETRY_HISTORY_DIR)
+            from spark_rapids_tpu.telemetry import history as _history
+            log_dir = str(self.conf_obj.get(EVENT_LOG_DIR))
+            history_on = bool(str(
+                self.conf_obj.get(TELEMETRY_HISTORY_DIR) or ""))
+            # ONE id for both sinks, so the failure's event line and
+            # history record correlate (same contract as success);
+            # the wire queryId wins when the server supplied one
+            qid = self._wire_query_id()
+            if qid is None and (log_dir or history_on):
+                qid = event_log.next_query_id()
+            if log_dir:
+                store = memory._STORE
+                event_log.write_event(
+                    log_dir, id(self) & 0xFFFF, physical, None,
+                    wall_s, 0,
+                    store.stats() if store is not None else None,
+                    conf=self.conf_obj, tenant=self.tenant,
+                    query_id=qid, status=status, reason=reason)
+            _history.record_query_close(
+                self.conf_obj, status=status, reason=reason,
+                signature=sig, tenant=self.tenant,
+                query_id=qid, wall_s=wall_s,
+                queue_wait_s=self._queue_wait(), rows=0,
+                physical=physical)
+        except Exception:
+            pass  # observability must not mask the real failure
 
     def explain_string(self, plan: L.LogicalPlan, physical=None) -> str:
         if physical is None:

@@ -25,7 +25,14 @@ after the fact. Four coordinated pieces:
 - **regression tracking** (bench_diff.py): ``tools bench-diff`` diffs
   two bench JSON outputs (headline walls + detail legs) against
   configurable thresholds with a machine-readable verdict and a
-  nonzero exit on regression.
+  nonzero exit on regression;
+- **query history** (history.py): the persistent, bounded JSONL store
+  of one record per finished query — the cross-run memory behind
+  server warm-start (watchdog p99 + quarantine streaks survive
+  restarts), per-tenant SLO burn tracking (``srt_slo_*`` families +
+  the ``sloBurn`` trigger), ``tools history`` trends, and the
+  ``tools doctor`` auto-diagnosis (doctor.py) that names WHY a query
+  was slow against its signature's historical baseline.
 """
 
 from spark_rapids_tpu.telemetry.ring import RingTrace, dump_ring  # noqa: F401

@@ -71,6 +71,12 @@ CHECKS: List[Tuple[str, str, bool, str]] = [
      "retries under injected OOM"),
     ("detail.robustness.legs.oomEveryN.slowdown_vs_clean", "lower",
      False, "injected-OOM slowdown"),
+    ("detail.history.appendOverhead", "lower", False,
+     "query-history append overhead"),
+    ("detail.history.doctor.roundTripMs", "lower", False,
+     "tools doctor round-trip latency"),
+    ("detail.history.doctor.stormWall_s", "lower", False,
+     "forced retry-storm wall (doctor leg)"),
 ]
 
 

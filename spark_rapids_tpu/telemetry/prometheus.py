@@ -103,6 +103,24 @@ SERVER_FAMILY_HELP: Dict[str, Tuple[str, str]] = {
     "srt_telemetry_triggers_rate_limited_total": (
         "counter", "trigger firings suppressed by the per-trigger "
                    "rate limit"),
+    "srt_telemetry_bundles_pruned_total": (
+        "counter", "telemetry artifacts (bundles + ring dumps) "
+                   "pruned by the maxBundles/maxBundleBytes "
+                   "retention"),
+    "srt_slo_objective_p99_ms": (
+        "gauge", "per-tenant SLO p99 objective in ms "
+                 "(serve.slo.p99Ms[.<tenant>])"),
+    "srt_slo_observed_p99_ms": (
+        "gauge", "observed p99 wall in ms over the SLO window per "
+                 "tenant (query history)"),
+    "srt_slo_window_queries": (
+        "gauge", "finished queries inside the SLO window per tenant"),
+    "srt_slo_window_violations": (
+        "gauge", "queries over the tenant's SLO objective inside the "
+                 "window"),
+    "srt_slo_burn_ratio": (
+        "gauge", "fraction of the tenant's window queries over its "
+                 "SLO objective"),
     "srt_undescribed_metric_keys": (
         "gauge", "registry metric keys that did not resolve via "
                  "describe_metric and were NOT exported (must be 0)"),
@@ -332,6 +350,8 @@ def render_prometheus(server_stats: Optional[Dict] = None) -> str:
     for trig, n in sorted(tstats["rateLimited"].items()):
         _emit_server(out, "srt_telemetry_triggers_rate_limited_total",
                      n, {"trigger": trig})
+    _emit_server(out, "srt_telemetry_bundles_pruned_total",
+                 tstats.get("pruned", 0))
 
     if server_stats:
         _emit_server(out, "srt_queries_ok_total",
@@ -373,6 +393,23 @@ def render_prometheus(server_stats: Optional[Dict] = None) -> str:
                     continue
                 _emit_server(out, "srt_tenant_latency_ms", float(v),
                              {**lab, "quantile": q})
+        # SLO burn tracking over the query history (docs/
+        # observability.md "SLO tracking"): per-tenant objective vs
+        # observed p99 over the window, gauges because the window
+        # slides
+        for tenant, slo in sorted(
+                (server_stats.get("slo") or {}).items()):
+            lab = {"tenant": tenant}
+            _emit_server(out, "srt_slo_objective_p99_ms",
+                         float(slo.get("objectiveP99Ms", 0)), lab)
+            _emit_server(out, "srt_slo_observed_p99_ms",
+                         float(slo.get("observedP99Ms", 0.0)), lab)
+            _emit_server(out, "srt_slo_window_queries",
+                         slo.get("windowQueries", 0), lab)
+            _emit_server(out, "srt_slo_window_violations",
+                         slo.get("violations", 0), lab)
+            _emit_server(out, "srt_slo_burn_ratio",
+                         float(slo.get("burnRatio", 0.0)), lab)
     return out.text()
 
 

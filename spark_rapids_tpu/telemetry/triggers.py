@@ -20,6 +20,10 @@ the places they become true —
                    ``telemetry.queueWatermark`` x maxQueued (evaluated
                    at every enqueue, serve/scheduler.py)
 
+(The lifecycle watchdog's ``stuckQuery`` and the SLO tracker's
+``sloBurn`` firings ride the same engine — lifecycle.py and
+telemetry/history.py call ``_maybe_fire`` with their own conditions.)
+
 — and emits a *slow-query bundle* per firing: one JSON under
 ``spark.rapids.sql.telemetry.dir`` tying together the flight-recorder
 dump (a standard Chrome-trace file ``tools trace`` loads), the query's
@@ -48,6 +52,8 @@ from typing import Any, Callable, Dict, Optional
 from spark_rapids_tpu.conf import (TELEMETRY_DIR,
                                    TELEMETRY_HBM_WATERMARK,
                                    TELEMETRY_KERNEL_FALLBACK_THRESHOLD,
+                                   TELEMETRY_MAX_BUNDLE_BYTES,
+                                   TELEMETRY_MAX_BUNDLES,
                                    TELEMETRY_MIN_INTERVAL_S,
                                    TELEMETRY_QUEUE_WATERMARK,
                                    TELEMETRY_RETRY_COUNT_THRESHOLD,
@@ -79,6 +85,13 @@ class TriggerEngine:
         self.fired: Dict[str, int] = {}
         self.rate_limited: Dict[str, int] = {}
         self.bundle_paths: list = []
+        # artifact retention (satellite of the query-history PR):
+        # bundles + ring dumps in telemetry.dir are pruned oldest-first
+        # by the bundle WORKER after each write — never under a
+        # hot-path lock
+        self._max_bundles = int(TELEMETRY_MAX_BUNDLES.default)
+        self._max_bundle_bytes = int(TELEMETRY_MAX_BUNDLE_BYTES.default)
+        self.pruned = 0
         self._seq = 0
         self._pending = 0
         self._queue: "queue.Queue" = queue.Queue()
@@ -107,6 +120,10 @@ class TriggerEngine:
                 conf_obj.get(TELEMETRY_QUEUE_WATERMARK))
             self._retry_storm = int(
                 conf_obj.get(TELEMETRY_RETRY_STORM_THRESHOLD))
+            self._max_bundles = int(
+                conf_obj.get(TELEMETRY_MAX_BUNDLES))
+            self._max_bundle_bytes = int(
+                conf_obj.get(TELEMETRY_MAX_BUNDLE_BYTES))
             self.armed = True
         # arming implies firings may come from under the store /
         # admission locks, where the worker must already exist
@@ -133,6 +150,7 @@ class TriggerEngine:
                 "armed": self.armed,
                 "fired": dict(self.fired),
                 "rateLimited": dict(self.rate_limited),
+                "pruned": self.pruned,
                 "bundles": list(self.bundle_paths),
             }
 
@@ -148,6 +166,10 @@ class TriggerEngine:
             self.fired.clear()
             self.rate_limited.clear()
             self.bundle_paths.clear()
+            self.pruned = 0
+            self._max_bundles = int(TELEMETRY_MAX_BUNDLES.default)
+            self._max_bundle_bytes = int(
+                TELEMETRY_MAX_BUNDLE_BYTES.default)
             self._stats_provider = None
 
     # -- firing ------------------------------------------------------------
@@ -233,6 +255,54 @@ class TriggerEngine:
         with self._lock:
             self.bundle_paths.append(path)
             del self.bundle_paths[:-64]
+        # retention sweep (telemetry.maxBundles / maxBundleBytes):
+        # runs HERE on the worker thread, after the write, so the
+        # hot-path hooks never pay for directory listing or unlinks
+        self._prune_artifacts(out_dir)
+
+    def _prune_artifacts(self, out_dir: str) -> None:
+        """Prune telemetry artifacts (trigger bundles + flight-recorder
+        dumps) oldest-first until the directory fits the configured
+        count/byte bounds. Never raises."""
+        with self._lock:
+            max_bundles = self._max_bundles
+            max_bytes = self._max_bundle_bytes
+        if max_bundles <= 0 and max_bytes <= 0:
+            return
+        try:
+            files = [
+                os.path.join(out_dir, f) for f in os.listdir(out_dir)
+                if f.endswith(".json")
+                and (f.startswith("bundle-")
+                     or f.startswith("trace-ring-"))]
+            stats = []
+            for p in files:
+                try:
+                    st = os.stat(p)
+                    stats.append((st.st_mtime, p, st.st_size))
+                except OSError:
+                    continue
+            stats.sort()
+            total = sum(s for _, _, s in stats)
+            pruned = 0
+            while stats and (
+                    (max_bundles > 0 and len(stats) > max_bundles)
+                    or (max_bytes > 0 and total > max_bytes)):
+                _, p, size = stats.pop(0)
+                try:
+                    os.unlink(p)
+                    pruned += 1
+                    total -= size
+                except OSError:
+                    total -= size
+            if pruned:
+                with self._lock:
+                    self.pruned += pruned
+                    self.bundle_paths[:] = [
+                        p for p in self.bundle_paths
+                        if os.path.exists(p)]
+        except Exception:
+            pass  # observability must not take down execution
 
     def drain(self, timeout: float = 10.0) -> bool:
         """Wait until every accepted firing has its bundle on disk

@@ -480,14 +480,17 @@ def _main(argv: List[str]) -> int:
     ap.add_argument("command",
                     choices=["qualify", "profile", "docs", "trace",
                              "hotspots", "serve", "serve-client",
-                             "lint", "top", "bench-diff", "soak"])
+                             "lint", "top", "bench-diff", "soak",
+                             "history", "doctor"])
     ap.add_argument("sql", nargs="?", help="SQL text to analyze (live "
                     "mode; omit when using --log), the trace "
                     "file/directory for the trace/hotspots commands, "
                     "a profile-*.json file/directory for the "
                     "profile command (spark.rapids.sql.profile.dir "
-                    "output), the server port for `top`, or the "
-                    "BASELINE bench JSON for `bench-diff`")
+                    "output), the server port for `top`, the "
+                    "BASELINE bench JSON for `bench-diff`, the "
+                    "history directory for `history`, or the "
+                    "queryId/signature selector for `doctor`")
     ap.add_argument("paths", nargs="*",
                     help="bench-diff: the CANDIDATE bench JSON, or a "
                     "directory holding BENCH_r*.json files (the "
@@ -507,8 +510,17 @@ def _main(argv: List[str]) -> int:
     ap.add_argument("--port", type=int, default=None,
                     help="serve: bind port (0/unset = ephemeral); "
                     "serve-client: server port (required)")
-    ap.add_argument("--tenant", default="default",
-                    help="serve-client: tenant id for the request")
+    ap.add_argument("--tenant", default=None,
+                    help="serve-client: tenant id for the request "
+                    "(default 'default'); history: restrict the "
+                    "report to one tenant")
+    ap.add_argument("--since", default=None,
+                    help="history: only records newer than this — a "
+                    "number of seconds ago (e.g. 3600) or an ISO "
+                    "timestamp (2026-08-04T12:00)")
+    ap.add_argument("--history", default=None,
+                    help="doctor: the query-history directory "
+                    "(spark.rapids.sql.telemetry.history.dir)")
     ap.add_argument("--stats", action="store_true",
                     help="serve-client: print server stats instead of "
                     "running SQL")
@@ -599,6 +611,11 @@ def _main(argv: List[str]) -> int:
 
     if args.command == "bench-diff":
         return _bench_diff_main(args, ap)
+
+    if args.command == "history":
+        return _history_main(args, ap)
+    if args.command == "doctor":
+        return _doctor_main(args, ap)
 
     if args.command == "soak":
         # chaos soak harness (docs/serving.md "Query lifecycle"):
@@ -723,6 +740,78 @@ def _main(argv: List[str]) -> int:
 
 
 
+def _parse_since(raw, ap) -> float:
+    """`--since` value -> unix-seconds lower bound: a number means
+    that many seconds ago, anything else must parse as an ISO
+    timestamp."""
+    import datetime
+    import time as _t
+    try:
+        return _t.time() - float(raw)
+    except (TypeError, ValueError):
+        pass
+    try:
+        return datetime.datetime.fromisoformat(str(raw)).timestamp()
+    except ValueError:
+        ap.error(f"--since: not seconds-ago or an ISO timestamp: "
+                 f"{raw!r}")
+
+
+def _history_main(args, ap) -> int:
+    """`tools history <dir>`: per-signature/per-tenant table over the
+    persistent query-history store, with trends
+    (docs/observability.md 'Query history'). Exit 0 on a rendered
+    report (an EMPTY store is a normal answer), 1 on a missing
+    path."""
+    import json as _json
+    import os
+
+    from spark_rapids_tpu.telemetry.history import (format_history,
+                                                    read_records,
+                                                    signature_aggregates)
+    path = args.sql or args.history
+    if not path:
+        ap.error("history requires the history directory "
+                 "(spark.rapids.sql.telemetry.history.dir output)")
+    if not os.path.exists(path):
+        print(f"no such history file or directory: {path}")
+        return 1
+    since = _parse_since(args.since, ap) if args.since else None
+    records = read_records(path, since=since, tenant=args.tenant)
+    if args.json:
+        print(_json.dumps({
+            "records": len(records),
+            "signatures": signature_aggregates(records),
+        }, indent=2, default=str))
+        return 0
+    print(format_history(records, top=max(args.top, 10)))
+    return 0
+
+
+def _doctor_main(args, ap) -> int:
+    """`tools doctor <queryId|signature> --history <dir>`: automated
+    slow-query diagnosis against the signature's historical baseline
+    (docs/observability.md 'tools doctor'). Exit 0 with a verdict, 1
+    when the selector or the directory does not resolve."""
+    import json as _json
+    import os
+
+    from spark_rapids_tpu.telemetry.doctor import (diagnose,
+                                                   format_diagnosis)
+    if not args.sql:
+        ap.error("doctor requires a queryId or signature selector")
+    if not args.history:
+        ap.error("doctor requires --history <dir> "
+                 "(spark.rapids.sql.telemetry.history.dir output)")
+    if not os.path.exists(args.history):
+        print(f"no such history file or directory: {args.history}")
+        return 1
+    d = diagnose(args.history, args.sql)
+    print(_json.dumps(d, indent=2, default=str) if args.json
+          else format_diagnosis(d))
+    return 1 if d.get("error") else 0
+
+
 def _bench_diff_main(args, ap) -> int:
     """`tools bench-diff <a> <b|dir>`: exit 0 when no gating check
     regressed, 1 on regression, 2 on unusable inputs
@@ -811,7 +900,7 @@ def _serve_client_main(args, ap) -> int:
     if args.port is None:
         ap.error("serve-client requires --port")
     with ServeClient(args.port, host=args.host or "127.0.0.1",
-                     tenant=args.tenant) as c:
+                     tenant=args.tenant or "default") as c:
         if args.stats:
             print(_json.dumps(c.stats(), indent=2))
             return 0
@@ -1170,6 +1259,9 @@ def generate_observability_docs() -> str:
         "watchdog's periodic scan (docs/serving.md 'Query "
         "lifecycle'; with serve.watchdogCancel the query is also "
         "cancelled) |",
+        "| sloBurn | a tenant's observed p99 over the history window "
+        "> its serve.slo.p99Ms objective | query close on the server "
+        "(see 'SLO tracking' below) |",
         "",
         "Per-trigger rate limiting (`telemetry.triggerMinIntervalS`)",
         "bounds disk pressure under a storm (suppressed firings count",
@@ -1177,7 +1269,13 @@ def generate_observability_docs() -> str:
         "dedicated daemon thread so no query, store or admission path",
         "blocks on a file write. The store/admission/retry triggers",
         "arm when any session sets a `spark.rapids.sql.telemetry.*`",
-        "conf.",
+        "conf. Artifact sprawl is bounded: bundles and ring dumps in",
+        "`telemetry.dir` beyond `telemetry.maxBundles` (or",
+        "`telemetry.maxBundleBytes` total) are pruned OLDEST-FIRST by",
+        "the bundle-worker thread after each write — never under a",
+        "hot-path lock; pruned counts show in the engine stats, the",
+        "server stats `telemetry` section, and",
+        "`srt_telemetry_bundles_pruned_total`.",
         "",
         "### Prometheus endpoint",
         "",
@@ -1214,6 +1312,82 @@ def generate_observability_docs() -> str:
         "`--once` for scripting). A server that goes away mid-poll is",
         "a clean exit (message + code 0); a failed initial connect",
         "exits 1.",
+        "",
+        "### Query history",
+        "",
+        "`spark.rapids.sql.telemetry.history.dir` turns on the",
+        "persistent query-history store: ONE compact JSONL record per",
+        "finished query, appended at query close by",
+        "`session.execute_plan` (every terminal status it sees) and by",
+        "the query server (outcomes the session never starts, e.g.",
+        "cancelled while queued). Storage is crash-safe and bounded:",
+        "records are single JSON lines in rotated segments",
+        "(`history-<ms>-<pid>-<seq>.jsonl`), compacted",
+        "whole-segment-at-a-time by `telemetry.history.maxBytes` and",
+        "`telemetry.history.maxAgeDays` (a torn tail line from a crash",
+        "is skipped by the reader, never propagated). The record",
+        "schema (`HISTORY_FIELD_CATALOG`; the tpu-lint `history-field`",
+        "rule pins record construction to it):",
+        "",
+        "| Field | Meaning |",
+        "|---|---|",
+    ]
+    from spark_rapids_tpu.telemetry.history import HISTORY_FIELD_CATALOG
+    for fname, fdesc in sorted(HISTORY_FIELD_CATALOG.items()):
+        lines.append(f"| `{fname}` | {fdesc} |")
+    lines += [
+        "",
+        "**Warm-start** (`telemetry.history.warmStart`, on by default",
+        "when the dir is set): at server start the history replays",
+        "into the lifecycle layer — finished records seed the",
+        "stuck-query watchdog's per-signature p99 reservoirs and clear",
+        "failure streaks, failed records replay the quarantine",
+        "streaks — so a restarted server can tell \"stuck\" from",
+        "\"first time\" from query one, and a poison signature stays",
+        "fail-fast across restarts. Cancelled/timed-out/quarantined",
+        "records never count, the same rules as the live paths.",
+        "",
+        "### SLO tracking",
+        "",
+        "`spark.rapids.sql.serve.slo.p99Ms` (per-tenant override",
+        "`serve.slo.p99Ms.<tenant>`) sets a latency objective: the",
+        "tenant's observed p99 wall over the last `serve.slo.window`",
+        "seconds of query history must stay under it. The server",
+        "evaluates objectives over the history store (cached ~1 s),",
+        "exposes them in its stats (`slo` section) and as the",
+        "`srt_slo_*` Prometheus families (objective, observed p99,",
+        "window queries, violations, burn ratio — gauges, because the",
+        "window slides), and fires a rate-limited `sloBurn` bundle",
+        "through the trigger engine when the observed p99 exceeds the",
+        "objective.",
+        "",
+        "### `tools history`",
+        "",
+        "`tools history <dir> [--since N|ISO] [--tenant T] [--json]`",
+        "renders the store as a per-signature table (count, wall",
+        "p50/p99, trend slope in seconds-of-wall per hour-of-history,",
+        "retry/fallback rates, status histogram, tenants) plus a",
+        "per-tenant rollup. An empty store is a normal answer (exit",
+        "0); a missing path exits 1.",
+        "",
+        "### `tools doctor`",
+        "",
+        "`tools doctor <queryId|signature> --history <dir> [--json]`",
+        "answers \"why was this query slow\" automatically: it joins",
+        "the query's history record, profile artifact, and trace",
+        "against the signature's historical baseline (the other",
+        "finished records of the same shape), diffs per-stage",
+        "self-times stage by stage (profile time metrics aggregated by",
+        "stage key — `retryBlockTime` -> `retryBlock`), and emits a",
+        "ranked verdict with evidence lines. The verdict taxonomy:",
+        "",
+        "| Verdict | Meaning |",
+        "|---|---|",
+    ]
+    from spark_rapids_tpu.telemetry.doctor import VERDICT_CLASSES
+    for vname, vdesc in sorted(VERDICT_CLASSES.items()):
+        lines.append(f"| `{vname}` | {vdesc} |")
+    lines += [
         "",
         "### Regression tracking (`tools bench-diff`)",
         "",
