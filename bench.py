@@ -1385,6 +1385,225 @@ def run_history(clean_wall: float, cpu_rows) -> dict:
     }
 
 
+def _adaptive_skew_query(spark):
+    """A shuffled join with ONE hot key at ~20x the median partition
+    (48 base keys spread the other partitions; the right side is small
+    but broadcast is disabled in the leg conf, so the skew-split replan
+    is the adaptive action under test)."""
+    rep = 24
+    lk = [100 + (i % 48) for i in range(48 * rep)]
+    lk += [7] * (rep * 12 * 20)
+    lv = list(range(len(lk)))
+    rk = list(range(100, 148)) * 2 + [7, 7]
+    rw = [i * 10 for i in range(len(rk))]
+    left = spark.createDataFrame({"k": lk, "v": lv}, "k int, v long",
+                                 num_partitions=3)
+    right = spark.createDataFrame({"k2": rk, "w": rw},
+                                  "k2 int, w long", num_partitions=2)
+    from spark_rapids_tpu.sql import functions as F
+    return (left.join(right, left["k"] == right["k2"], "inner")
+            .groupBy("k").agg(F.sum("v").alias("sv"),
+                              F.sum("w").alias("sw"),
+                              F.count("*").alias("c"))
+            .orderBy("k"))
+
+
+def run_adaptive(clean_wall: float) -> dict:
+    """detail.adaptive (docs/adaptive.md): (a) skewed-join wall A/B —
+    the adaptive run skew-splits the hot partition and completes clean
+    (retryCount == 0) while the unadaptive run of the same shape rides
+    an injected OOM storm (the CPU backend's DeviceStore spills instead
+    of raising, so the deterministic storm stands in for the monolithic
+    hot partition blowing HBM on real hardware, exactly like
+    detail.robustness) — both bit-identical to the CPU oracle;
+    (b) AQE partition coalescing on a mostly-empty exchange: dispatch
+    count adaptive-on vs adaptive-off; (c) same-signature serving: 16
+    concurrent same-template queries (distinct literal bindings)
+    through the server with batch fusion on vs off under ONE saturated
+    admission slot, bit-identical per member."""
+    import threading
+
+    from spark_rapids_tpu import retry as RT
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+
+    out = {"skipped": False, "clean_wall_s": round(clean_wall, 4)}
+
+    # -- (a) skewed-join wall A/B -------------------------------------
+    skew_conf = dict(TPU_CONF)
+    skew_conf.update({
+        "spark.rapids.sql.autoBroadcastJoinThreshold": "-1",
+        "spark.rapids.sql.shuffle.devicePartitions": "4",
+        "spark.rapids.sql.batchSizeRows": "512",
+        "spark.rapids.sql.retry.backoffMs": "40",
+        "spark.rapids.sql.retry.maxBackoffMs": "400",
+    })
+    cpu = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        _, skew_oracle = run_once(_adaptive_skew_query(cpu))
+    finally:
+        cpu.stop()
+
+    def skew_leg(extra):
+        RT.reset_fault_injection()
+        fresh_leg()
+        conf = dict(skew_conf)
+        conf.update(extra)
+        spark = TpuSparkSession(conf)
+        try:
+            q = _adaptive_skew_query(spark)
+            run_once(q)  # warm compile caches
+            RT.reset_fault_injection()
+            spark.start_capture()
+            dt, rows = run_once(q)
+            assert_rows_match(skew_oracle, rows)
+            counters = collect_counters(
+                spark.get_captured_plans(),
+                ("retryCount", "splitRetryCount", "aqeReplans",
+                 "aqeSkewSplits", "aqeBroadcastFlip"))
+        finally:
+            spark.stop()
+            RT.reset_fault_injection()
+        return dt, counters
+
+    on_dt, on_c = skew_leg({})
+    off_dt, off_c = skew_leg({
+        "spark.rapids.sql.adaptive.enabled": "false",
+        "spark.rapids.sql.test.injectOOM": "5"})
+    assert on_c["retryCount"] == 0, on_c
+    assert on_c["aqeSkewSplits"] > 0, on_c
+    out["skew"] = {
+        "adaptive_wall_s": round(on_dt, 4),
+        "unadaptive_wall_s": round(off_dt, 4),
+        "speedup": round(off_dt / on_dt, 4),
+        "retryCount_adaptive": on_c["retryCount"],
+        "retryCount_unadaptive": off_c["retryCount"],
+        "aqeSkewSplits": on_c["aqeSkewSplits"],
+        "aqeReplans": on_c["aqeReplans"],
+    }
+
+    # -- (b) coalesce dispatch delta ----------------------------------
+    coalesce_conf = dict(TPU_CONF)
+    coalesce_conf.update({
+        "spark.rapids.sql.shuffle.devicePartitions": "8",
+        "spark.rapids.sql.batchSizeRows": "512",
+    })
+
+    def coalesce_query(spark):
+        from spark_rapids_tpu.sql import functions as F
+        df = spark.createDataFrame(
+            {"g": [i % 3 for i in range(3000)],
+             "v": list(range(3000))}, "g int, v long",
+            num_partitions=4)
+        return df.groupBy("g").agg(F.sum("v").alias("sv")) \
+                 .orderBy("g")
+
+    def coalesce_leg(extra):
+        fresh_leg()
+        conf = dict(coalesce_conf)
+        conf.update(extra)
+        spark = TpuSparkSession(conf)
+        try:
+            q = coalesce_query(spark)
+            run_once(q)
+            spark.start_capture()
+            dt, rows = run_once(q)
+            counters = collect_counters(
+                spark.get_captured_plans(),
+                ("dispatchCount", "aqeCoalescedPartitions"))
+        finally:
+            spark.stop()
+        return dt, rows, counters
+
+    c_on_dt, c_on_rows, c_on = coalesce_leg({})
+    c_off_dt, c_off_rows, c_off = coalesce_leg(
+        {"spark.rapids.sql.adaptive.enabled": "false"})
+    assert_rows_match(c_off_rows, c_on_rows)
+    out["coalesce"] = {
+        "adaptive_wall_s": round(c_on_dt, 4),
+        "unadaptive_wall_s": round(c_off_dt, 4),
+        "dispatchCount_adaptive": c_on["dispatchCount"],
+        "dispatchCount_unadaptive": c_off["dispatchCount"],
+        "dispatchDelta": c_off["dispatchCount"] - c_on["dispatchCount"],
+        "aqeCoalescedPartitions": c_on["aqeCoalescedPartitions"],
+    }
+
+    # -- (c) same-signature batch fusion QPS A/B ----------------------
+    from spark_rapids_tpu.serve import QueryServer, ServeClient
+
+    def variant(i):
+        return ("SELECT l_returnflag, count(*) AS c, "
+                "sum(l_quantity) AS sq FROM lineitem "
+                f"WHERE l_quantity > {i}00 "
+                "GROUP BY l_returnflag ORDER BY l_returnflag")
+
+    def fusion_leg(enabled):
+        fresh_leg()
+        conf = dict(TPU_CONF)
+        conf.update({
+            "spark.rapids.sql.serve.maxConcurrentQueries": "1",
+            "spark.rapids.sql.serve.maxQueued": "64",
+            "spark.rapids.sql.serve.maxConcurrentPerTenant": "32",
+            "spark.rapids.sql.serve.batchFusion.enabled":
+                "true" if enabled else "false",
+            "spark.rapids.sql.serve.batchFusion.windowMs": "50",
+            "spark.rapids.sql.serve.batchFusion.maxBatch": "16",
+        })
+        try:
+            srv = QueryServer(conf).start()
+        except OSError as e:
+            return None, {"skipped": True,
+                          "reason": f"cannot bind: {e!r}"}
+        results: dict = {}
+        errors: list = []
+        try:
+            srv.register_view("lineitem", DATA_DIR)
+            with ServeClient(srv.port, tenant="warmup") as c:
+                for i in range(4):
+                    results[f"warm{i}"] = c.collect(variant(i))
+
+            def worker(i):
+                try:
+                    with ServeClient(srv.port,
+                                     tenant=f"t{i % 4}") as c:
+                        results[i] = c.collect(variant(i % 4))
+                except Exception as e:  # noqa: BLE001
+                    errors.append(repr(e))
+
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(16)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            if errors:
+                return None, {"errors": errors[:3]}
+            for i in range(16):
+                assert results[i] == results[f"warm{i % 4}"], (
+                    f"fusion={enabled}: member {i} diverged")
+            st = srv.stats()
+            leg = {"wall_s": round(wall, 4),
+                   "qps": round(16 / wall, 4)}
+            if enabled:
+                leg["batchFusion"] = st.get("batchFusion", {})
+            return results, leg
+        finally:
+            srv.shutdown()
+
+    r_off, leg_off = fusion_leg(False)
+    r_on, leg_on = fusion_leg(True)
+    fusion = {"off": leg_off, "on": leg_on}
+    if r_on is not None and r_off is not None:
+        for i in range(16):
+            assert r_on[i] == r_off[i], (
+                f"fusion on/off diverged on member {i}")
+        fusion["qpsSpeedup"] = round(
+            leg_on["qps"] / leg_off["qps"], 4)
+    out["batchFusion"] = fusion
+    return out
+
+
 def run_bench_diff(current: dict) -> dict:
     """Regression tracking: diff THIS run's output against the newest
     BENCH_r0*.json in the repo (docs/observability.md 'Live
@@ -1514,6 +1733,14 @@ def main():
         history_leg = {"skipped": True,
                        "reason": f"history leg failed: {e!r}"}
 
+    # adaptive-execution leg (docs/adaptive.md): skewed-join replan
+    # A/B, coalesce dispatch delta, same-signature batch-fusion QPS
+    try:
+        adaptive_leg = run_adaptive(fused["wall_s"])
+    except Exception as e:  # noqa: BLE001 - reported, not swallowed
+        adaptive_leg = {"skipped": True,
+                        "reason": f"adaptive leg failed: {e!r}"}
+
     cpu_t = min(cpu_times)
     tpu_t = fused["wall_s"]
     q3_tpu_t = fused["q3"]["wall_s"]
@@ -1556,6 +1783,7 @@ def main():
             "telemetry": telemetry_leg,
             "lifecycle": lifecycle_leg,
             "history": history_leg,
+            "adaptive": adaptive_leg,
             "jitCaches": registry_snapshot()["jitCaches"],
             "tpcds_q3": {
                 "device_wall_s": round(q3_tpu_t, 4),

@@ -1,0 +1,260 @@
+"""Adaptive query execution over measured exchange statistics
+(docs/adaptive.md; GpuQueryStagePrepOverrides / AQE ShuffleQueryStage
+roles from GpuOverrides.scala:3550 and SURVEY §2.5).
+
+The engine holds EXACT per-partition byte/row counts the moment any
+exchange materializes and previously threw them away. This module is
+the decision layer over those numbers:
+
+- ``ExchangeStats`` — the record every ``TpuShuffleExchangeExec``
+  captures at ``_materialize`` (single-chip and mesh paths both);
+- broadcast demotion / partition coalescing / skew splitting policy
+  helpers consumed by ``exec/join.py`` and ``exec/exchange.py``;
+- the literal-normalization key the server's batch fusion uses to
+  recognize same-shape queries (``fusion_key``).
+
+Every decision here only changes HOW a result is computed, never WHAT
+it is: the adaptive-off plan and the CPU engine are both oracles for
+the adaptive plan (tests/test_adaptive.py asserts bit-identity).
+
+Gating: ``adaptive_enabled`` requires BOTH ``spark.sql.adaptive.
+enabled`` (the v0 switch) and ``spark.rapids.sql.adaptive.enabled``,
+so either knob disables every runtime replan. The adaptive.* conf
+family is excluded from the plan-cache signature (plan_cache.py):
+adaptive and unadaptive runs of one query shape share baselines,
+quarantine streaks, and doctor history.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Sequence, Tuple
+
+from spark_rapids_tpu.conf import (ADAPTIVE_AUTO_BROADCAST_BYTES,
+                                   ADAPTIVE_ENABLED, ADAPTIVE_SKEW_FACTOR,
+                                   ADAPTIVE_TARGET_PARTITION_BYTES,
+                                   AQE_ADVISORY_PARTITION_BYTES,
+                                   AQE_ENABLED,
+                                   AUTO_BROADCAST_JOIN_THRESHOLD, TpuConf)
+
+# ---------------------------------------------------------------------------
+# Exchange statistics
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ExchangeStats:
+    """Realized per-partition sizes of one materialized exchange.
+
+    Bytes are ACTIVE-row refined where the handle can say (a filter
+    only flips the active mask, so capacity-based sizes over-count);
+    spilled handles keep their full size — off-device data is costed
+    conservatively rather than re-promoted for a statistic. Rows are
+    whatever the producer attached; a partition whose counts were
+    never synced contributes 0 rows (bytes still count)."""
+
+    partition_bytes: Tuple[int, ...]
+    partition_rows: Tuple[int, ...]
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self.partition_bytes)
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(self.partition_bytes)
+
+    @property
+    def max_bytes(self) -> int:
+        return max(self.partition_bytes, default=0)
+
+    @property
+    def median_bytes(self) -> int:
+        """Median over NON-EMPTY partitions: empty partitions are the
+        normal hash-shuffle tail and would drag the median toward zero,
+        making every real partition look skewed."""
+        live = sorted(b for b in self.partition_bytes if b > 0)
+        if not live:
+            return 0
+        mid = len(live) // 2
+        if len(live) % 2:
+            return live[mid]
+        return (live[mid - 1] + live[mid]) // 2
+
+    @property
+    def skew_ratio(self) -> float:
+        med = self.median_bytes
+        return (self.max_bytes / med) if med > 0 else 0.0
+
+
+def _item_stats(item) -> Tuple[int, int]:
+    """(bytes, rows) of one retained partition item — a SpillableBatch
+    handle on the in-process paths, a raw per-chip DeviceBatch on the
+    mesh path. Never forces a device sync: unknown row counts read 0."""
+    from spark_rapids_tpu.memory import SpillableBatch
+    if isinstance(item, SpillableBatch):
+        size = item.sizeof()
+        cap = item.capacity_hint
+        st = item._state
+        rows = st.rows if st.rows is not None else 0
+        if cap and st.rows is not None:
+            size = int(size * (st.rows / cap))
+        return size, int(rows)
+    size = int(item.sizeof()) if hasattr(item, "sizeof") else 0
+    rows = getattr(item, "_num_rows", None)
+    return size, int(rows) if rows is not None else 0
+
+
+def capture_stats(cache: Sequence[Sequence]) -> ExchangeStats:
+    """Build the ExchangeStats record from a materialized exchange
+    cache (list of partitions, each a list of retained items)."""
+    pbytes: List[int] = []
+    prows: List[int] = []
+    for part in cache:
+        b = r = 0
+        for item in part:
+            ib, ir = _item_stats(item)
+            b += ib
+            r += ir
+        pbytes.append(b)
+        prows.append(r)
+    return ExchangeStats(tuple(pbytes), tuple(prows))
+
+
+# ---------------------------------------------------------------------------
+# Conf resolution (the -1/0 "inherit the v0 knob" sentinels)
+# ---------------------------------------------------------------------------
+
+
+def adaptive_enabled(conf: TpuConf) -> bool:
+    """BOTH adaptive switches on — the gate every runtime replan
+    (broadcast demotion, coalescing, skew split, re-fusion) checks."""
+    return bool(conf.get(AQE_ENABLED)) and bool(conf.get(ADAPTIVE_ENABLED))
+
+
+def auto_broadcast_bytes(conf: TpuConf) -> int:
+    """Runtime broadcast-demotion threshold; -1 (the default) inherits
+    the static autoBroadcastJoinThreshold. Negative result disables."""
+    v = int(conf.get(ADAPTIVE_AUTO_BROADCAST_BYTES))
+    if v >= 0:
+        return v
+    return int(conf.get(AUTO_BROADCAST_JOIN_THRESHOLD))
+
+
+def target_partition_bytes(conf: TpuConf) -> int:
+    """Coalescing target; 0 (the default) inherits the v0 advisory
+    partition size."""
+    v = int(conf.get(ADAPTIVE_TARGET_PARTITION_BYTES))
+    if v > 0:
+        return v
+    return int(conf.get(AQE_ADVISORY_PARTITION_BYTES))
+
+
+def skew_factor(conf: TpuConf) -> float:
+    return float(conf.get(ADAPTIVE_SKEW_FACTOR))
+
+
+# ---------------------------------------------------------------------------
+# Decision helpers
+# ---------------------------------------------------------------------------
+
+
+def coalesce_groups(sizes: Sequence[int], target: int) -> List[List[int]]:
+    """Merge ADJACENT partitions up to ``target`` bytes
+    (GpuCustomShuffleReaderExec / coalesced-partition-spec role;
+    adjacency preserves range-partition ordering). Returns the list of
+    partition-index groups, in order."""
+    groups: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    for i, sz in enumerate(sizes):
+        if cur and cur_bytes + sz > target:
+            groups.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += sz
+    if cur:
+        groups.append(cur)
+    return groups
+
+
+# one pathological partition must not explode the probe-thunk count
+MAX_SKEW_SPLITS = 16
+
+
+def skew_splits(stats: ExchangeStats, factor: float) -> Dict[int, int]:
+    """Skew plan: partition index -> sub-partition count (>= 2) for
+    every partition whose realized bytes exceed ``factor`` x the median
+    non-empty partition. The split count aims each sub-partition back
+    at the median, capped at MAX_SKEW_SPLITS. Empty/None-factor plans
+    return {} (no replan)."""
+    if factor <= 0:
+        return {}
+    med = stats.median_bytes
+    if med <= 0:
+        return {}
+    out: Dict[int, int] = {}
+    for i, b in enumerate(stats.partition_bytes):
+        if b > factor * med:
+            out[i] = min(MAX_SKEW_SPLITS, max(2, (b + med - 1) // med))
+    return out
+
+
+def slice_groups(weights: Sequence[int], k: int) -> List[List[int]]:
+    """Greedy contiguous slicing of ``len(weights)`` items into at most
+    ``k`` groups of roughly equal total weight (the skew split over a
+    partition's retained handle list — contiguity keeps batch order,
+    so the joined output concatenation stays deterministic)."""
+    n = len(weights)
+    k = max(1, min(k, n))
+    total = sum(weights)
+    if k == 1 or total <= 0:
+        return [list(range(n))]
+    goal = total / k
+    groups: List[List[int]] = []
+    cur: List[int] = []
+    cur_w = 0
+    remaining = k
+    for i, w in enumerate(weights):
+        if cur and cur_w + w > goal and len(groups) < remaining - 1:
+            groups.append(cur)
+            cur, cur_w = [], 0
+        cur.append(i)
+        cur_w += w
+    if cur:
+        groups.append(cur)
+    return groups
+
+
+# ---------------------------------------------------------------------------
+# Batch-fusion key (the serving layer's same-shape recognizer)
+# ---------------------------------------------------------------------------
+
+# SQL string literals first ('' is the embedded quote), then bare
+# numerics not embedded in an identifier/qualified name
+_SQL_STRING = re.compile(r"'(?:[^']|'')*'")
+_SQL_NUMBER = re.compile(
+    r"(?<![A-Za-z0-9_.'\"])\d+(?:\.\d+)?(?![A-Za-z0-9_])")
+
+
+def fusion_key(sql: str) -> Tuple[str, Tuple[str, ...]]:
+    """(normalized text, literal vector) for one SQL string: string and
+    numeric literals become ``?`` placeholders and whitespace collapses,
+    so queries differing only in literal bindings share a key. The
+    literal vector is the binding that distinguishes members inside one
+    fused batch (identical SQL => identical vector => one execution).
+
+    This is the serving-layer proxy for "same plan-cache signature
+    modulo literals": numeric literals are runtime arguments to the
+    compiled device programs (ops/exprs.py ``expr_key``), so every
+    member of a fused batch rides the same XLA executables."""
+    literals: List[str] = []
+
+    def keep(m: "re.Match[str]") -> str:
+        literals.append(m.group(0))
+        return "?"
+
+    s = _SQL_STRING.sub(keep, sql)
+    s = _SQL_NUMBER.sub(keep, s)
+    return " ".join(s.split()), tuple(literals)

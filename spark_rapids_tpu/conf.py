@@ -178,6 +178,42 @@ AUTO_BROADCAST_JOIN_THRESHOLD = conf(
     "semantics; the reference consumes Spark's decision via "
     "GpuBroadcastHashJoinExec).").bytes(10 << 20)
 
+ADAPTIVE_ENABLED = conf("spark.rapids.sql.adaptive.enabled").doc(
+    "Adaptive query execution over MEASURED exchange statistics "
+    "(docs/adaptive.md): every exchange materialization records exact "
+    "per-partition byte/row counts, and before the probe side compiles "
+    "the AQE pass may demote a shuffled hash join to broadcast "
+    "(adaptive.autoBroadcastBytes), coalesce undersized partitions "
+    "toward adaptive.targetPartitionBytes, or split skewed stream "
+    "partitions above adaptive.skewFactor x the median. Results are "
+    "bit-identical to the unadaptive plan. Composes with "
+    "spark.sql.adaptive.enabled: BOTH must be on (turning either off "
+    "disables every runtime replan).").boolean(True)
+
+ADAPTIVE_AUTO_BROADCAST_BYTES = conf(
+    "spark.rapids.sql.adaptive.autoBroadcastBytes").doc(
+    "Runtime broadcast-demotion threshold: a shuffled hash join whose "
+    "REALIZED build-side bytes (exchange stats, active-row refined) "
+    "land at or under this flips to a broadcast-style join, bypassing "
+    "the stream side's co-partitioning exchange. -1 inherits "
+    "spark.rapids.sql.autoBroadcastJoinThreshold (docs/adaptive.md)."
+    ).bytes(-1)
+
+ADAPTIVE_TARGET_PARTITION_BYTES = conf(
+    "spark.rapids.sql.adaptive.targetPartitionBytes").doc(
+    "Target size AQE coalesces undersized exchange output partitions "
+    "toward (fewer, fuller device programs). 0 inherits "
+    "spark.sql.adaptive.advisoryPartitionSizeInBytes "
+    "(docs/adaptive.md).").bytes(0)
+
+ADAPTIVE_SKEW_FACTOR = conf("spark.rapids.sql.adaptive.skewFactor").doc(
+    "Skewed-partition detection: a realized stream-side join partition "
+    "larger than this factor times the median non-empty partition is "
+    "split into sub-partitions (each re-joined against the same build "
+    "partition) so one hot key stops serializing the probe stage and "
+    "stops triggering OOM-retry. 0 disables skew splitting "
+    "(docs/adaptive.md).").double(4.0)
+
 BATCH_SIZE_BYTES = conf("spark.rapids.sql.batchSizeBytes").doc(
     "Target size in bytes of columnar batches fed to TPU operators "
     "(RapidsConf.scala GPU_BATCH_SIZE_BYTES).").bytes(128 << 20)
@@ -413,6 +449,30 @@ SERVE_FAIR_SHARE_FACTOR = conf(
     "to the offender, not an LRU victim) and its queued queries are "
     "passed over while other tenants wait (docs/serving.md)."
     ).double(1.5)
+
+SERVE_BATCH_FUSION_ENABLED = conf(
+    "spark.rapids.sql.serve.batchFusion.enabled").doc(
+    "Same-signature batch fusion (docs/adaptive.md): concurrent "
+    "queries whose SQL differs only in literal bindings are collected "
+    "within batchFusion.windowMs and executed under ONE admission "
+    "slot; identical texts share a single execution, distinct "
+    "bindings ride the same cached plan template and compiled device "
+    "programs back-to-back. Per-tenant results stay bit-identical and "
+    "each member bills its own tenant ledger and queue wait; the "
+    "window engages only while the server is saturated, so an idle "
+    "server adds no latency.").boolean(True)
+
+SERVE_BATCH_FUSION_WINDOW_MS = conf(
+    "spark.rapids.sql.serve.batchFusion.windowMs").doc(
+    "Collection window for batch fusion: the first query of a shape "
+    "holds its batch open this long (only while the server is "
+    "saturated) so same-shape peers can join before execution "
+    "(docs/adaptive.md).").integer(10)
+
+SERVE_BATCH_FUSION_MAX_BATCH = conf(
+    "spark.rapids.sql.serve.batchFusion.maxBatch").doc(
+    "Maximum member queries one fused batch accepts; the next arrival "
+    "opens a fresh batch (docs/adaptive.md).").integer(16)
 
 SERVE_HOST = conf("spark.rapids.sql.serve.host").doc(
     "Interface the query server binds (local serving; the cross-host "

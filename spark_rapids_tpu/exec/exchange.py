@@ -334,6 +334,11 @@ class TpuShuffleExchangeExec(TpuExec):
         # set by the rewrite for consumers that accept any partition
         # count (agg/sort/window) - enables AQE partition coalescing
         self.allow_aqe_coalesce = False
+        # realized per-partition byte/row counts, captured at
+        # _materialize (adaptive.ExchangeStats): the AQE pass reads
+        # these to demote joins to broadcast, coalesce undersized
+        # partitions, and split skewed ones (docs/adaptive.md)
+        self.exchange_stats = None
 
     @property
     def child(self) -> TpuExec:
@@ -407,6 +412,21 @@ class TpuShuffleExchangeExec(TpuExec):
             from spark_rapids_tpu.conf import SHUFFLE_MODE
             if str(self.conf.get(SHUFFLE_MODE)).lower() == "external":
                 cache = self._external_roundtrip(cache)
+            # the exchange-stat capture (docs/adaptive.md): exact
+            # realized partition sizes on EVERY path (single-chip,
+            # mesh, external) — the reference treats file-level stats
+            # as a first guess and replans from map output sizes; these
+            # counts are that signal. Recorded as node metrics too, so
+            # the profile artifact carries them to `tools doctor`'s
+            # skewedShuffle verdict
+            from spark_rapids_tpu import adaptive as A
+            self.exchange_stats = stats = A.capture_stats(cache)
+            self.metrics.create("exchangeTotalBytes",
+                                M.ESSENTIAL).add(stats.total_bytes)
+            self.metrics.create("exchangeMaxPartitionBytes",
+                                M.ESSENTIAL).add(stats.max_bytes)
+            self.metrics.create("exchangeMedianPartitionBytes",
+                                M.ESSENTIAL).add(stats.median_bytes)
             self._cache = cache
             return self._cache
 
@@ -695,36 +715,27 @@ class TpuShuffleExchangeExec(TpuExec):
         return [make(g) for g in groups]
 
     def _aqe_coalesce_eligible(self) -> bool:
-        from spark_rapids_tpu.conf import AQE_ENABLED
+        from spark_rapids_tpu import adaptive as A
         return (self.allow_aqe_coalesce
-                and bool(self.conf.get(AQE_ENABLED))
+                and A.adaptive_enabled(self.conf)
                 and not getattr(self.partitioning, "user_specified", False)
                 and self.partitioning.num_partitions > 1
                 and not self._mesh_eligible())
 
     def _aqe_partition_groups(self, nparts: int) -> List[List[int]]:
-        """Merge ADJACENT materialized partitions up to the advisory
-        size (GpuCustomShuffleReaderExec / Spark coalesced-partition-
-        spec role; adjacency preserves range-partition ordering).
-        Only consumers that accept any partition count opt in
-        (allow_aqe_coalesce) — co-partitioned join inputs never do."""
-        from spark_rapids_tpu.conf import AQE_ADVISORY_PARTITION_BYTES
-        from spark_rapids_tpu.memory import SpillableBatch
-        advisory = int(self.conf.get(AQE_ADVISORY_PARTITION_BYTES))
-        mat = self._materialize()
-        sizes = [sum(h.sizeof() for h in part
-                     if isinstance(h, SpillableBatch)) for part in mat]
-        groups: List[List[int]] = []
-        cur: List[int] = []
-        cur_bytes = 0
-        for i, sz in enumerate(sizes):
-            if cur and cur_bytes + sz > advisory:
-                groups.append(cur)
-                cur, cur_bytes = [], 0
-            cur.append(i)
-            cur_bytes += sz
-        if cur:
-            groups.append(cur)
+        """Merge ADJACENT materialized partitions toward
+        adaptive.targetPartitionBytes (GpuCustomShuffleReaderExec /
+        Spark coalesced-partition-spec role; adjacency preserves
+        range-partition ordering). Only consumers that accept any
+        partition count opt in (allow_aqe_coalesce) — co-partitioned
+        join inputs never do. Sizes come from the exchange-stat
+        capture, so coalescing and skew detection agree on what a
+        partition weighs."""
+        from spark_rapids_tpu import adaptive as A
+        self._materialize()
+        stats = self.exchange_stats
+        groups = A.coalesce_groups(stats.partition_bytes,
+                                   A.target_partition_bytes(self.conf))
         if len(groups) < nparts:
             self.metrics.create("aqeCoalescedPartitions",
                                 M.ESSENTIAL).add(nparts - len(groups))

@@ -194,19 +194,20 @@ class TpuShuffledHashJoinExec(TpuExec):
                       "leftanti")
 
     def _aqe_try_broadcast(self) -> Optional[List[DevicePartitionThunk]]:
-        """AQE v0 runtime replan (GpuOverrides.scala:3550
-        GpuQueryStagePrepOverrides role): materialize the build-side
-        exchange, and when its MEASURED bytes land under the broadcast
-        threshold, flip to a broadcast-style join - build side concat
-        once and shared across stream partitions, and the stream side's
-        co-partitioning exchange is bypassed entirely."""
-        from spark_rapids_tpu.conf import (AQE_ENABLED,
-                                           AUTO_BROADCAST_JOIN_THRESHOLD)
+        """AQE runtime replan (GpuOverrides.scala:3550
+        GpuQueryStagePrepOverrides role; docs/adaptive.md): materialize
+        the build-side exchange, and when its MEASURED bytes land under
+        adaptive.autoBroadcastBytes, demote the shuffled hash join to a
+        broadcast-style join - build side concat once and shared across
+        stream partitions, and the stream side's co-partitioning
+        exchange is bypassed entirely (the surviving subtree re-enters
+        the static fusion pass)."""
+        from spark_rapids_tpu import adaptive as A
         from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
         from spark_rapids_tpu.memory import SpillableBatch
-        if not bool(self.conf.get(AQE_ENABLED)):
+        if not A.adaptive_enabled(self.conf):
             return None
-        threshold = int(self.conf.get(AUTO_BROADCAST_JOIN_THRESHOLD))
+        threshold = A.auto_broadcast_bytes(self.conf)
         if threshold < 0 or self.join_type not in self._BROADCASTABLE:
             return None
         rexch = self.right
@@ -234,19 +235,140 @@ class TpuShuffledHashJoinExec(TpuExec):
                     return None
         if total > threshold:
             return None
-        self.metrics.create("aqeBroadcastFlip", M.ESSENTIAL).add(1)
-        rbatches = [h.get() for h in handles]
-        rwhole = (concat_device(rbatches) if len(rbatches) > 1 else
-                  rbatches[0] if rbatches else
-                  DeviceBatch.empty(self.right.schema))
-        left_src = self.left
-        if isinstance(left_src, TpuShuffleExchangeExec) \
-                and not getattr(left_src.partitioning, "user_specified",
-                                False) \
-                and not left_src._mesh_eligible():
-            # the exchange existed only for this join's co-partitioning
-            left_src = left_src.child
+        from spark_rapids_tpu import trace as TR
+        with TR.span("aqeReplan", action="broadcastDemotion",
+                     buildBytes=total, thresholdBytes=threshold):
+            self.metrics.create("aqeBroadcastFlip", M.ESSENTIAL).add(1)
+            self.metrics.create("aqeReplans", M.ESSENTIAL).add(1)
+            rbatches = [h.get() for h in handles]
+            rwhole = (concat_device(rbatches) if len(rbatches) > 1 else
+                      rbatches[0] if rbatches else
+                      DeviceBatch.empty(self.right.schema))
+            left_src = self.left
+            if isinstance(left_src, TpuShuffleExchangeExec) \
+                    and not getattr(left_src.partitioning,
+                                    "user_specified", False) \
+                    and not left_src._mesh_eligible():
+                # the exchange existed only for this join's
+                # co-partitioning
+                left_src = self._replan_stream_side(left_src)
         return self._broadcast_stream_thunks(left_src, rwhole)
+
+    def _replan_stream_side(self, exch) -> TpuExec:
+        """Drop the stream side's now-useless co-partitioning exchange.
+        The surviving subtree is cloned plan_cache.clone_plan-style
+        (fresh metric registries, locks and containers; the original
+        nodes keep whatever was already recorded against them) and
+        re-enters apply_overrides' fusion pass — the removed exchange
+        boundary can expose a Filter/Project chain the static pass had
+        to stop at. The join's child pointer is rewired so profile and
+        history walks see the subtree that actually executed."""
+        from spark_rapids_tpu.overrides import refuse_replanned_subtree
+        from spark_rapids_tpu.plan_cache import clone_plan
+        new_left = refuse_replanned_subtree(clone_plan(exch.child),
+                                            self.conf)
+        self.children[0] = new_left
+        return new_left
+
+    def _aqe_try_skew_split(self
+                            ) -> Optional[List[DevicePartitionThunk]]:
+        """AQE skew mitigation (docs/adaptive.md): when the realized
+        stream-side partition sizes show a partition above
+        adaptive.skewFactor x the median, that partition's retained
+        batches split into sub-partitions — each re-joined against the
+        SAME build partition — so one hot key stops serializing the
+        probe stage behind a single task and stops riding the OOM-retry
+        storm. Valid only for join types whose per-left-row results are
+        independent (_LEFT_STREAM_TYPES); key colocation within the
+        original partition is irrelevant downstream because the planner
+        always re-partitions before the next keyed operator."""
+        from spark_rapids_tpu import adaptive as A
+        from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+        if not A.adaptive_enabled(self.conf) \
+                or self.join_type not in self._LEFT_STREAM_TYPES:
+            return None
+        factor = A.skew_factor(self.conf)
+        if factor <= 0:
+            return None
+        lexch, rexch = self.left, self.right
+        for e in (lexch, rexch):
+            if not isinstance(e, TpuShuffleExchangeExec) \
+                    or e._mesh_eligible():
+                return None
+        lexch._materialize()
+        stats = lexch.exchange_stats
+        if stats is None:
+            return None
+        plan = A.skew_splits(stats, factor)
+        if not plan:
+            return None
+        from spark_rapids_tpu import trace as TR
+        with TR.span("aqeReplan", action="skewSplit",
+                     partitions=len(plan),
+                     skewRatio=round(stats.skew_ratio, 2)):
+            self.metrics.create("aqeSkewSplits", M.ESSENTIAL).add(
+                len(plan))
+            self.metrics.create("aqeReplans", M.ESSENTIAL).add(1)
+            mat = lexch._materialize()
+            rparts = device_channel(rexch)
+            assert len(mat) == len(rparts), \
+                "join children must be co-partitioned"
+            thunks: List[DevicePartitionThunk] = []
+            for pid, rt in enumerate(rparts):
+                if pid not in plan:
+                    thunks.append(self._partition_join_thunk(
+                        self._items_thunk(mat[pid]), rt))
+                    continue
+                for items in self._split_partition(mat[pid], plan[pid]):
+                    thunks.append(self._partition_join_thunk(
+                        self._items_thunk(items), rt))
+        return thunks
+
+    def _items_thunk(self, items) -> DevicePartitionThunk:
+        """A stream-partition thunk over already-materialized exchange
+        items (mirrors TpuShuffleExchangeExec.device_partitions' pull:
+        promote, never close — the exchange owns its handles)."""
+        from spark_rapids_tpu.memory import SpillableBatch
+
+        def run() -> Iterator[DeviceBatch]:
+            for item in items:
+                yield (item.get() if isinstance(item, SpillableBatch)
+                       else item)
+        return run
+
+    def _split_partition(self, items: List, k: int) -> List[List]:
+        """Split one skewed partition's retained items into up to ``k``
+        sub-partitions: contiguous byte-balanced slices of the handle
+        list, and — when the list is too short to slice — the largest
+        batch goes through the exchange's sort-split program first
+        (split_by_pid over round-robin pids, the existing machinery).
+        Sub-batches register as the join's own spillables; the
+        exchange's originals stay untouched for other consumers."""
+        from spark_rapids_tpu import adaptive as A
+        if len(items) < k:
+            import jax.numpy as jnp
+
+            from spark_rapids_tpu import retry as R
+            from spark_rapids_tpu.exec.exchange import (_round_robin_pids,
+                                                        split_by_pid)
+            from spark_rapids_tpu.memory import (SpillableBatch,
+                                                 get_device_store)
+            store = get_device_store(self.conf)
+            weights = [A._item_stats(it)[0] for it in items]
+            big = max(range(len(items)), key=lambda i: weights[i])
+            pieces = k - len(items) + 1
+            item = items[big]
+            b = item.get() if isinstance(item, SpillableBatch) else item
+            pids = _round_robin_pids(b.active, jnp.int32(0), pieces)
+            parts = R.with_retry(
+                lambda: split_by_pid(b, pids, pieces),
+                self.conf, self.metrics)
+            subs = [self.register_spillable(store, p)
+                    for p in parts if p is not None]
+            items = items[:big] + subs + items[big + 1:]
+        weights = [A._item_stats(it)[0] for it in items]
+        return [[items[i] for i in g]
+                for g in A.slice_groups(weights, k)]
 
     def _broadcast_stream_thunks(self, left_src: TpuExec,
                                  rwhole: DeviceBatch
@@ -320,10 +442,19 @@ class TpuShuffledHashJoinExec(TpuExec):
         flipped = self._aqe_try_broadcast()
         if flipped is not None:
             return flipped
+        skewed = self._aqe_try_skew_split()
+        if skewed is not None:
+            return skewed
         lparts = device_channel(self.left)
         rparts = device_channel(self.right)
         assert len(lparts) == len(rparts), \
             "join children must be co-partitioned"
+        return [self._partition_join_thunk(lt, rt)
+                for lt, rt in zip(lparts, rparts)]
+
+    def _partition_join_thunk(self, lt: DevicePartitionThunk,
+                              rt: DevicePartitionThunk
+                              ) -> DevicePartitionThunk:
         goal = self.conf.batch_size_rows
 
         def make(lt: DevicePartitionThunk, rt: DevicePartitionThunk
@@ -388,7 +519,7 @@ class TpuShuffledHashJoinExec(TpuExec):
                         rwhole, matched_any, left_fields, pair_schema)
                     yield self._project_output(extras)
             return run
-        return [make(lt, rt) for lt, rt in zip(lparts, rparts)]
+        return make(lt, rt)
 
     def _pair_schema(self) -> T.StructType:
         return T.StructType(

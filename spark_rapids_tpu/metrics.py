@@ -118,6 +118,16 @@ METRIC_DESCRIPTIONS: Dict[str, str] = {
     "numIciExchanges": "all-to-all exchanges run over the ICI mesh",
     "aqeCoalescedPartitions": "tiny exchange partitions coalesced by AQE",
     "aqeBroadcastFlip": "shuffled joins flipped to broadcast at runtime",
+    "aqeReplans": "adaptive runtime replans applied over measured "
+                  "exchange stats (docs/adaptive.md)",
+    "aqeSkewSplits": "skewed exchange partitions split by the adaptive "
+                     "skew-join rewrite",
+    "exchangeTotalBytes": "materialized exchange output bytes (all "
+                          "partitions)",
+    "exchangeMaxPartitionBytes": "largest materialized exchange "
+                                 "partition",
+    "exchangeMedianPartitionBytes": "median non-empty materialized "
+                                    "exchange partition",
     "fkFastPathJoins": "joins taking the unique-build-key fast path",
     "meshPadWaste": "staged-minus-active rows padded by mesh stacking",
     # scan-side keys (CpuFileScanExec; kept here so the profile tree and

@@ -785,6 +785,21 @@ def apply_overrides(physical: P.PhysicalPlan, conf: TpuConf,
     return new_plan
 
 
+def refuse_replanned_subtree(plan: P.PhysicalPlan,
+                             conf: TpuConf) -> P.PhysicalPlan:
+    """AQE's re-entry into the static fusion pass (docs/adaptive.md):
+    a runtime replan that removes an exchange boundary (the broadcast
+    demotion in exec/join.py) hands the surviving — already cloned —
+    subtree back through fuse_stages under the same conf gate
+    apply_overrides used, so the replanned plan gets the Filter/Project
+    chains the boundary previously blocked. No-op with fusion off."""
+    from spark_rapids_tpu.conf import STAGE_FUSION_ENABLED
+    if conf.get(STAGE_FUSION_ENABLED):
+        from spark_rapids_tpu.exec.fused import fuse_stages
+        return fuse_stages(plan, conf)
+    return plan
+
+
 # -- cost model (CostBasedOptimizer.scala:52 CpuCostModel/GpuCostModel) ----
 #
 # Constants calibrated against THIS stack's measured behavior, in

@@ -139,11 +139,19 @@ def plan_signature(plan, conf) -> str:
     # signature per query shape across clean and injected runs, so the
     # quarantine streaks, watchdog p99 history, and the query-history
     # baselines `tools doctor` diffs against all key consistently.
+    # adaptive.* keys gate RUNTIME replans over measured exchange
+    # stats, not the static rewrite: excluding them keeps adaptive and
+    # unadaptive runs of one shape on one signature, so they share
+    # baselines/quarantine/doctor history and the doctor can attribute
+    # a wall change to an aqeActions delta instead of a shape change
+    # (serve.batchFusion.* rides the serve. prefix already excluded
+    # above).
     parts.append(";".join(
         f"{k}={v}" for k, v in sorted(
             (str(k), str(v)) for k, v in conf.settings.items())
         if not k.startswith((
             "spark.rapids.sql.serve.",
+            "spark.rapids.sql.adaptive.",
             # tpu-lint: disable=conf-key(prefix over the test.inject* key family, not a key literal)
             "spark.rapids.sql.test.inject"))))
     return "".join(parts)

@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from spark_rapids_tpu.conf import (SERVE_MAX_CONCURRENT,
                                    SERVE_MAX_PER_TENANT, SERVE_MAX_QUEUED,
@@ -208,6 +208,37 @@ class AdmissionController:
                    tenant=tenant)
         return wait
 
+    def bill_fused_member(self, tenant: str, wait_s: float) -> None:
+        """FIFO-fairness accounting for batch fusion (docs/adaptive.md):
+        a fused batch occupies ONE execution slot, but every member
+        query is a real admission from its tenant's point of view —
+        admitted totals and the queue-wait reservoir bill per member,
+        so `stats()`/Prometheus and the fair-share picture cannot
+        under-report a tenant just because its queries fused. No slot
+        is taken (the executor's own acquire holds the batch's one)."""
+        with self._cv:
+            self.admitted += 1
+            self._tenant_admitted[tenant] = \
+                self._tenant_admitted.get(tenant, 0) + 1
+            waits = self._tenant_waits.setdefault(tenant, [])
+            waits.append(max(0.0, wait_s))
+            del waits[:-_RESERVOIR]
+        from spark_rapids_tpu import trace as _trace
+        qt = _trace._ACTIVE
+        if qt is not None:
+            now = time.perf_counter_ns()
+            qt.add("serveQueueWait", now - int(max(0.0, wait_s) * 1e9),
+                   now, tenant=tenant)
+
+    def saturated(self) -> bool:
+        """Queue-pressure hint for the batch-fusion window gate
+        (docs/adaptive.md): anything waiting, or every slot occupied.
+        An unsaturated server closes fusion batches immediately, so
+        fusion never adds latency when there is no queue to amortize."""
+        with self._cv:
+            return bool(self._queue) or \
+                self._in_flight >= self.max_concurrent
+
     def release(self, tenant: str) -> None:
         with self._cv:
             self._in_flight -= 1
@@ -266,3 +297,218 @@ class AdmissionController:
                 "throttledWaits": self.throttled_waits,
                 "tenants": per_tenant,
             }
+
+
+# ---------------------------------------------------------------------------
+# Same-signature batch fusion (docs/adaptive.md)
+# ---------------------------------------------------------------------------
+
+
+class _FusionMember:
+    """One query's seat in a fused batch. ``evicted`` flips when the
+    member's OWN lifecycle token cancels: the member leaves, the batch
+    never aborts (the PR-13 cancel contract under fusion)."""
+
+    __slots__ = ("sql", "tenant", "token", "arrive_t", "evicted",
+                 "result", "error", "queue_wait_s", "fused_size")
+
+    def __init__(self, sql: str, tenant: str, token):
+        self.sql = sql
+        self.tenant = tenant
+        self.token = token
+        self.arrive_t = time.monotonic()
+        self.evicted = False
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.queue_wait_s = 0.0
+        self.fused_size = 1
+
+
+class _FusionBatch:
+    __slots__ = ("key", "deadline", "max_batch", "members", "closed",
+                 "executor", "done")
+
+    def __init__(self, key: str, window_s: float, max_batch: int):
+        self.key = key
+        self.deadline = time.monotonic() + window_s
+        self.max_batch = max_batch
+        self.members: List[_FusionMember] = []
+        self.closed = False
+        self.executor: Optional[_FusionMember] = None
+        self.done = threading.Event()
+
+
+class BatchFusionCoordinator:
+    """Collects same-shape queries — identical literal-normalized SQL,
+    ``adaptive.fusion_key`` — arriving within ``batchFusion.windowMs``
+    and executes the whole batch under ONE admission slot: identical
+    texts share a single execution, distinct literal bindings run
+    back-to-back on the same cached plan template and compiled device
+    programs (numeric literals are runtime arguments — ops/exprs.py).
+
+    Roles are raced, not fixed: every member waits on its batch, and
+    the FIRST surviving member to observe the batch closed claims the
+    executor role. A would-be executor that cancels while waiting is
+    just another eviction — some other member executes, so a single
+    cancel can never abort the batch. Fairness is per member: the
+    executor's ``execute_batch`` bills every other member's tenant
+    ledger and queue wait through
+    ``AdmissionController.bill_fused_member``.
+
+    The window only engages while the server is saturated (the
+    ``busy`` hint at ``join``): an idle server closes the batch
+    immediately and pays zero added latency."""
+
+    # member wait-loop poll tick (the batch window is O(10ms))
+    _TICK = 0.002
+
+    def __init__(self, window_ms: int, max_batch: int):
+        self._window_s = max(0.0, window_ms / 1000.0)
+        self._max_batch = max(1, max_batch)
+        self._lock = threading.Lock()
+        self._open: Dict[str, _FusionBatch] = {}
+        # members delivered out of batches of size >= 2, and such
+        # batches — the server's batchFusion stats / srt_aqe_* families
+        self.fused_queries = 0
+        self.fused_batches = 0
+
+    def join(self, sql: str, tenant: str, token,
+             busy: bool) -> "Tuple[_FusionBatch, _FusionMember]":
+        from spark_rapids_tpu.adaptive import fusion_key
+        key, _ = fusion_key(sql)
+        m = _FusionMember(sql, tenant, token)
+        with self._lock:
+            fb = self._open.get(key)
+            if fb is not None and not fb.closed:
+                fb.members.append(m)
+                if len(fb.members) >= fb.max_batch:
+                    fb.closed = True
+                    self._open.pop(key, None)
+                return fb, m
+            fb = _FusionBatch(key,
+                              self._window_s if busy else 0.0,
+                              self._max_batch)
+            fb.members.append(m)
+            self._open[key] = fb
+            return fb, m
+
+    def wait_role(self, fb: _FusionBatch, m: _FusionMember,
+                  checkpoint) -> str:
+        """Block until this member becomes the batch's executor
+        (returns ``"execute"``) or the batch completes (``"done"``).
+        ``checkpoint`` runs every tick and raises to cancel; on cancel
+        the member is evicted — only it aborts, never the batch."""
+        while True:
+            try:
+                checkpoint()
+            except BaseException:
+                with self._lock:
+                    m.evicted = True
+                raise
+            with self._lock:
+                if fb.done.is_set():
+                    return "done"
+                if not fb.closed and \
+                        time.monotonic() >= fb.deadline:
+                    fb.closed = True
+                    if self._open.get(fb.key) is fb:
+                        del self._open[fb.key]
+                if fb.closed and fb.executor is None:
+                    fb.executor = m
+                    return "execute"
+            fb.done.wait(self._TICK)
+
+    def execute_batch(self, fb: _FusionBatch, m: _FusionMember,
+                      admission: AdmissionController, run_sql) -> None:
+        """Executor side: acquire the batch's ONE slot under the
+        executor's tenant, bill every member, run each distinct SQL
+        once for its surviving members via ``run_sql(sql, tenant)``
+        (executed under the session of one of its own requesters), and
+        publish per-member results. Every exit path resolves the done
+        event or hands the executor role back — a failure (admission
+        rejection included) reaches members as their error, never as a
+        hang."""
+        from spark_rapids_tpu.lifecycle import TpuQueryCancelled
+        try:
+            # the executor-elect waits for the slot under its OWN
+            # token: a deadline expiring here is still a
+            # cancelled-WHILE-QUEUED outcome for it
+            admission.acquire(m.tenant, token=m.token)
+        except TpuQueryCancelled:
+            # personal to the executor-elect — evict it and hand the
+            # role back so a surviving member re-races (the batch never
+            # aborts on one member's cancel); done only fires when
+            # nobody is left to claim the role
+            with self._lock:
+                m.evicted = True
+                fb.executor = None
+                if not any(not mm.evicted for mm in fb.members):
+                    fb.done.set()
+            raise
+        except BaseException as e:
+            # rejection/shutdown applies to the whole batch: every
+            # member would have met the same gate
+            with self._lock:
+                for mm in fb.members:
+                    mm.error = e
+                fb.done.set()
+            raise
+        try:
+            t_admit = time.monotonic()
+            with self._lock:
+                members = list(fb.members)
+                # evicted members were cancelled while QUEUED: like the
+                # unfused path they are never billed as admitted and do
+                # not count toward the fused size
+                live_members = [mm for mm in members if not mm.evicted]
+                size = len(live_members)
+            for mm in members:
+                mm.queue_wait_s = max(0.0, t_admit - mm.arrive_t)
+            for mm in live_members:
+                mm.fused_size = size
+                if mm.token is not None:
+                    # the watchdog measures RUNNING time from here for
+                    # every member — fusion wait is queue wait, not
+                    # runtime
+                    mm.token.mark_admitted()
+                if mm is not m:
+                    admission.bill_fused_member(mm.tenant,
+                                                mm.queue_wait_s)
+            groups: Dict[str, List[_FusionMember]] = {}
+            for mm in members:
+                groups.setdefault(mm.sql, []).append(mm)
+            from spark_rapids_tpu import lifecycle as LC
+            for sql, mems in groups.items():
+                live = [mm for mm in mems if not mm.evicted]
+                if not live:
+                    continue
+                try:
+                    if len(live) == 1 and live[0].token is not None:
+                        # a group with ONE surviving requester keeps
+                        # exact unfused lifecycle semantics: its own
+                        # token scopes the execution, so deadlines /
+                        # cancel / drain reach the running query
+                        with LC.token_scope(live[0].token):
+                            res = run_sql(sql, live[0].tenant)
+                    else:
+                        # >=2 requesters: tokenless — one member's
+                        # cancel evicts only that member, never the
+                        # shared execution
+                        res = run_sql(sql, live[0].tenant)
+                    for mm in mems:
+                        mm.result = res
+                except BaseException as e:
+                    for mm in mems:
+                        mm.error = e
+            if size >= 2:
+                with self._lock:
+                    self.fused_batches += 1
+                    self.fused_queries += size
+        finally:
+            admission.release(m.tenant)
+            fb.done.set()
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {"fusedQueries": self.fused_queries,
+                    "fusedBatches": self.fused_batches}

@@ -34,7 +34,10 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from spark_rapids_tpu.conf import SERVE_HOST, SERVE_PORT, TpuConf
+from spark_rapids_tpu.conf import (SERVE_BATCH_FUSION_ENABLED,
+                                   SERVE_BATCH_FUSION_MAX_BATCH,
+                                   SERVE_BATCH_FUSION_WINDOW_MS,
+                                   SERVE_HOST, SERVE_PORT, TpuConf)
 from spark_rapids_tpu.serve import protocol
 from spark_rapids_tpu.serve.scheduler import (AdmissionController,
                                               QueryRejected, percentile)
@@ -79,6 +82,16 @@ class QueryServer:
         self.host = host if host is not None else str(cobj.get(SERVE_HOST))
         self.port = port if port is not None else int(cobj.get(SERVE_PORT))
         self._admission = AdmissionController(cobj)
+        # same-signature batch fusion (docs/adaptive.md): when OFF the
+        # coordinator is never constructed and _handle_sql takes the
+        # classic acquire/execute path untouched
+        self._fusion = None
+        if bool(cobj.get(SERVE_BATCH_FUSION_ENABLED)):
+            from spark_rapids_tpu.serve.scheduler import \
+                BatchFusionCoordinator
+            self._fusion = BatchFusionCoordinator(
+                int(cobj.get(SERVE_BATCH_FUSION_WINDOW_MS)),
+                int(cobj.get(SERVE_BATCH_FUSION_MAX_BATCH)))
         self._sessions: Dict[str, object] = {}
         self._sessions_lock = threading.Lock()
         # per-tenant creation locks: concurrent first requests for ONE
@@ -533,6 +546,14 @@ class QueryServer:
         # in as the nested scope it already supports
         tok = TR.begin_query(session.conf_obj)
         try:
+            if self._fusion is not None:
+                # batch-fusion path (docs/adaptive.md): join/wait on a
+                # same-signature fusion batch INSTEAD of acquiring a
+                # per-query admission slot — the batch's raced executor
+                # acquires the one slot for everyone
+                self._handle_sql_fused(conn, tenant, sql, session,
+                                       token, tok, t_req)
+                return
             try:
                 wait_s = self._admission.acquire(tenant, token=token)
                 # the watchdog measures RUNNING time from here, not
@@ -631,6 +652,130 @@ class QueryServer:
         finally:
             self._untrack(conn, token)
 
+    def _handle_sql_fused(self, conn, tenant: str, sql: str, session,
+                          token, tok, t_req: float) -> None:
+        """The batch-fusion twin of ``_handle_sql``'s admission +
+        execute seam (docs/adaptive.md "Same-signature batch fusion").
+        This member joins its fusion batch instead of taking an
+        admission slot; the batch's raced executor acquires the ONE
+        slot, runs each distinct SQL once, bills every member's tenant
+        ledger, and publishes per-member results. A size-1 batch (idle
+        server — the window only engages under saturation) keeps exact
+        unfused execution semantics: its own token scopes the run."""
+        from spark_rapids_tpu import lifecycle as LC
+        from spark_rapids_tpu import plan_cache as PC
+        from spark_rapids_tpu import trace as TR
+        fb, member = self._fusion.join(
+            sql, tenant, token, busy=self._admission.saturated())
+        try:
+            role = self._fusion.wait_role(
+                fb, member,
+                lambda: LC.checkpoint_token(token, "admission"))
+            if role == "execute":
+                try:
+                    self._fusion.execute_batch(
+                        fb, member, self._admission,
+                        lambda s, t:
+                        self._session(t).sql(s)._execute())
+                except LC.TpuQueryCancelled:
+                    # the executor-elect's own cancel/deadline while
+                    # waiting for the admission slot: it was evicted
+                    # and the role handed back — a queued outcome
+                    raise
+                except BaseException:  # noqa: BLE001
+                    # already published to every member (this one
+                    # included) by execute_batch; delivered below via
+                    # member.error
+                    pass
+        except LC.TpuQueryCancelled as e:
+            # cancelled / past-deadline while waiting on the batch (or,
+            # as executor-elect, for the batch's admission slot): the
+            # member is EVICTED, the batch runs on without it. No slot
+            # was held, and the session never started — the SERVER
+            # writes the history record, exactly as on the classic
+            # cancelled-while-queued path
+            from spark_rapids_tpu.telemetry import history as _h
+            TR.end_query(session.conf_obj, tok, error=True)
+            self._count_cancel(e.reason)
+            _h.record_query_close(
+                session.conf_obj,
+                status=(_h.STATUS_TIMED_OUT
+                        if e.reason == LC.REASON_DEADLINE
+                        else _h.STATUS_CANCELLED),
+                reason=e.reason, tenant=tenant,
+                query_id=token.query_id,
+                queue_wait_s=token.elapsed())
+            protocol.send_msg(conn, {
+                "status": "cancelled", "tenant": tenant,
+                "reason": e.reason, "where": "queued"})
+            return
+        wait_s = member.queue_wait_s
+        err = member.error
+        if err is not None:
+            TR.end_query(session.conf_obj, tok, error=True)
+            if isinstance(err, QueryRejected):
+                protocol.send_msg(conn, {"status": "rejected",
+                                         "error": str(err),
+                                         "tenant": tenant})
+            elif isinstance(err, LC.TpuQueryCancelled):
+                self._count_cancel(err.reason)
+                protocol.send_msg(conn, {
+                    "status": "cancelled", "tenant": tenant,
+                    "reason": err.reason, "where": "running",
+                    "queueWaitMs": round(wait_s * 1e3, 3)})
+            elif isinstance(err, LC.TpuQueryQuarantined):
+                with self._lat_lock:
+                    self.queries_quarantined += 1
+                protocol.send_msg(conn, {
+                    "status": "quarantined", "tenant": tenant,
+                    "error": str(err), "failures": err.failures})
+            else:
+                with self._lat_lock:
+                    self.queries_err += 1
+                protocol.send_msg(conn, {
+                    "status": "error", "tenant": tenant,
+                    "error": f"{type(err).__name__}: {err}"})
+            return
+        batch = member.result
+        if batch is None:
+            # defensive: the executor failed outside the per-group
+            # publish path — report an error, never crash this handler
+            TR.end_query(session.conf_obj, tok, error=True)
+            with self._lat_lock:
+                self.queries_err += 1
+            protocol.send_msg(conn, {
+                "status": "error", "tenant": tenant,
+                "error": "fused batch executor failed"})
+            return
+        exec_s = max(0.0, time.perf_counter() - t_req - wait_s)
+        TR.end_query(session.conf_obj, tok, wall_s=exec_s,
+                     rows=batch.num_rows)
+        payload = protocol.batch_to_ipc(batch)
+        resp = {
+            "status": "ok",
+            "tenant": tenant,
+            "rows": batch.num_rows,
+            "queueWaitMs": round(wait_s * 1e3, 3),
+            "execMs": round(exec_s * 1e3, 3),
+            # the executor thread planned, so its per-thread outcome is
+            # exact (as on the classic path); a follower rode the
+            # executor's shared plan — a cache hit by construction
+            "planCacheHit": (bool(PC.last_lookup_was_hit())
+                             if role == "execute" else True),
+        }
+        if member.fused_size >= 2:
+            resp["fusedWith"] = member.fused_size
+        if token.query_id is not None:
+            resp["queryId"] = token.query_id
+        ppath = session.thread_profile_path()
+        if ppath:
+            resp["profilePath"] = ppath
+        protocol.send_msg(conn, resp, payload)
+        with self._lat_lock:
+            self.queries_ok += 1
+        self._record_latency(tenant, time.perf_counter() - t_req)
+        self._slo.on_query_close(tenant)
+
     def _record_latency(self, tenant: str, seconds: float) -> None:
         with self._lat_lock:
             lat = self._tenant_lat.setdefault(tenant, [])
@@ -695,6 +840,8 @@ class QueryServer:
                 "bundlesPruned": tstats["pruned"],
             },
         }
+        if self._fusion is not None:
+            out["batchFusion"] = self._fusion.stats()
         if self._history is not None:
             out["history"] = {**self._history.stats(),
                               "warmStart": self.warm_start_summary}

@@ -71,6 +71,12 @@ CHECKS: List[Tuple[str, str, bool, str]] = [
      "retries under injected OOM"),
     ("detail.robustness.legs.oomEveryN.slowdown_vs_clean", "lower",
      False, "injected-OOM slowdown"),
+    ("detail.adaptive.skew.speedup", "higher", True,
+     "skewed-join adaptive speedup"),
+    ("detail.adaptive.coalesce.dispatchDelta", "higher", False,
+     "AQE coalesce dispatch savings"),
+    ("detail.adaptive.batchFusion.qpsSpeedup", "higher", False,
+     "same-signature batch-fusion QPS speedup"),
     ("detail.history.appendOverhead", "lower", False,
      "query-history append overhead"),
     ("detail.history.doctor.roundTripMs", "lower", False,

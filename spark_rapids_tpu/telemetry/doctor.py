@@ -54,6 +54,12 @@ VERDICT_CLASSES: Dict[str, str] = {
     "biggerInput": "the query genuinely processed more data than its "
                    "baseline runs (rows well above baseline, stages "
                    "scaled roughly uniformly)",
+    "skewedShuffle": "a materialized exchange in the profile artifact "
+                     "is heavily skewed (max partition well above the "
+                     "median) — one partition serializes the stage; "
+                     "check the aqeActions field / "
+                     "spark.rapids.sql.adaptive.skewFactor "
+                     "(docs/adaptive.md)",
     "unknown": "no stage or counter diverges enough from the "
                "signature's baseline to name a cause",
 }
@@ -101,6 +107,43 @@ def _profile_stage_times(profile_path: str) -> Dict[str, float]:
     if isinstance(plan, dict):
         walk(plan)
     return out
+
+
+def _profile_exchange_skew(profile_path: str) -> Dict[str, Any]:
+    """The WORST exchange-partition skew in one profile artifact:
+    max/median partition-byte ratio over every plan node that recorded
+    the exchange-stat metrics ``_materialize`` captures
+    (docs/adaptive.md). Empty dict when the artifact is unreadable or
+    no exchange materialized."""
+    import json
+    try:
+        with open(profile_path, encoding="utf-8") as f:
+            prof = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    worst: Dict[str, Any] = {}
+
+    def visit(entry: Dict[str, Any]) -> None:
+        m = entry.get("metrics") or {}
+        mx = float(m.get("exchangeMaxPartitionBytes", 0))
+        med = float(m.get("exchangeMedianPartitionBytes", 0))
+        if mx > 0 and med > 0:
+            ratio = mx / med
+            if ratio > worst.get("ratio", 0.0):
+                worst.update({
+                    "ratio": round(ratio, 2),
+                    "maxBytes": int(mx),
+                    "medianBytes": int(med),
+                    "node": entry.get("op") or "exchange"})
+        for fe in entry.get("fused", []):
+            visit(fe)
+        for c in entry.get("children", []):
+            visit(c)
+
+    plan = prof.get("plan")
+    if isinstance(plan, dict):
+        visit(plan)
+    return worst
 
 
 def _trace_self_times(trace_path: str) -> Dict[str, float]:
@@ -317,6 +360,31 @@ def diagnose(history_dir: str, selector: str) -> Dict[str, Any]:
             f"scan stages explain {scan_share:.0%} of the wall "
             f"regression"])
 
+    # skewed-shuffle: one exchange partition dwarfs the median in the
+    # target's profile artifact — that partition serializes the stage
+    # regardless of baseline comparisons (the stats come straight from
+    # the _materialize capture, docs/adaptive.md)
+    pp = target.get("profilePath")
+    skew = _profile_exchange_skew(str(pp)) \
+        if pp and os.path.exists(str(pp)) else {}
+    if skew.get("ratio", 0.0) >= 4.0:
+        ev = [f"{skew['node']}: max partition {skew['maxBytes']}B is "
+              f"{skew['ratio']:.1f}x the median "
+              f"({skew['medianBytes']}B)"]
+        acts = target.get("aqeActions") or {}
+        if acts.get("aqeSkewSplits"):
+            ev.append(f"AQE already split it "
+                      f"(aqeSkewSplits={acts['aqeSkewSplits']}) — "
+                      f"the ratio is pre-split")
+        elif acts:
+            ev.append(f"aqeActions={acts} (no skew split fired — "
+                      f"check adaptive.skewFactor)")
+        else:
+            ev.append("no aqeActions on record — check "
+                      "spark.rapids.sql.adaptive.enabled/skewFactor")
+        verdict("skewedShuffle",
+                min(1.0, 0.3 + skew["ratio"] / 40.0), ev)
+
     # genuinely-bigger-input: rows well over baseline, stages
     # scaled roughly uniformly (no single stage owns the regression)
     if base["rowsMean"] > 0 and rows > 1.5 * base["rowsMean"]:
@@ -343,6 +411,8 @@ def diagnose(history_dir: str, selector: str) -> Dict[str, Any]:
         "regressed": regressed,
         "stageDiff": diff[:12],
         "divergentStage": divergent,
+        "exchangeSkew": skew,
+        "aqeActions": target.get("aqeActions") or {},
         "traceSelfTimes": _trace_self_times(target["tracePath"])
         if target.get("tracePath")
         and os.path.exists(str(target.get("tracePath"))) else {},

@@ -110,6 +110,10 @@ HISTORY_FIELD_CATALOG: Dict[str, str] = {
                    "(spark.rapids.sql.profile.*), when written",
     "tracePath": "this query's Chrome-trace file "
                  "(spark.rapids.sql.trace.*), when written",
+    "aqeActions": "adaptive replan counters from the executed plan "
+                  "(aqeReplans/aqeBroadcastFlip/aqeSkewSplits/"
+                  "aqeCoalescedPartitions; nonzero entries only, "
+                  "present only when any fired — docs/adaptive.md)",
 }
 
 
@@ -307,6 +311,22 @@ def _plan_counters(physical) -> Dict[str, Any]:
     }
 
 
+def _aqe_actions(physical) -> Dict[str, int]:
+    """Adaptive replan counters from the executed plan (nonzero
+    entries only), so ``tools doctor`` can attribute a wall change
+    between two runs of ONE signature — adaptive and unadaptive runs
+    share signatures by the plan_signature exclusion — to an AQE
+    decision delta instead of a shape change (docs/adaptive.md)."""
+    if physical is None:
+        return {}
+    from spark_rapids_tpu.metrics import registry_snapshot
+    vals = registry_snapshot(plans=[physical])["metrics"]
+    return {k: int(vals[k])
+            for k in ("aqeReplans", "aqeBroadcastFlip",
+                      "aqeSkewSplits", "aqeCoalescedPartitions")
+            if vals.get(k)}
+
+
 def build_record(*, status: str, reason: Optional[str] = None,
                  signature: Optional[str] = None,
                  tenant: Optional[str] = None,
@@ -336,6 +356,9 @@ def build_record(*, status: str, reason: Optional[str] = None,
         rec["reason"] = reason
     for k, v in _plan_counters(physical).items():
         rec[k] = v
+    acts = _aqe_actions(physical)
+    if acts:
+        rec["aqeActions"] = acts
     if report is not None:
         try:
             rec["fallbackCoverage"] = round(

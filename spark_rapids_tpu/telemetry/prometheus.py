@@ -124,6 +124,12 @@ SERVER_FAMILY_HELP: Dict[str, Tuple[str, str]] = {
     "srt_undescribed_metric_keys": (
         "gauge", "registry metric keys that did not resolve via "
                  "describe_metric and were NOT exported (must be 0)"),
+    "srt_aqe_batch_fused_queries_total": (
+        "counter", "queries served out of same-signature fused "
+                   "batches of size >= 2 (docs/adaptive.md)"),
+    "srt_aqe_batch_fusion_batches_total": (
+        "counter", "fused batches of size >= 2 executed under one "
+                   "admission slot"),
 }
 
 
@@ -393,6 +399,14 @@ def render_prometheus(server_stats: Optional[Dict] = None) -> str:
                     continue
                 _emit_server(out, "srt_tenant_latency_ms", float(v),
                              {**lab, "quantile": q})
+        # same-signature batch fusion (docs/adaptive.md): present only
+        # when the server runs with batchFusion.enabled
+        bf = server_stats.get("batchFusion")
+        if bf:
+            _emit_server(out, "srt_aqe_batch_fused_queries_total",
+                         bf.get("fusedQueries", 0))
+            _emit_server(out, "srt_aqe_batch_fusion_batches_total",
+                         bf.get("fusedBatches", 0))
         # SLO burn tracking over the query history (docs/
         # observability.md "SLO tracking"): per-tenant objective vs
         # observed p99 over the window, gauges because the window

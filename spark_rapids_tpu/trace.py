@@ -132,6 +132,9 @@ SPAN_CATALOG: Dict[str, str] = {
     "promoteToDevice": "host->device store promotion",
     "retryBlock": "spill+backoff recovery inside an OOM retry (the "
                   "retryBlockTime interval)",
+    "aqeReplan": "an adaptive runtime replan over measured exchange "
+                 "stats (action= broadcastDemotion/skewSplit; "
+                 "docs/adaptive.md)",
 }
 
 INSTANT_CATALOG: Dict[str, str] = {
