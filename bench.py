@@ -954,6 +954,138 @@ def run_serving(clean_wall: float, cpu_rows, q3_cpu_rows) -> dict:
         srv.shutdown()
 
 
+def run_result_cache(clean_wall: float, cpu_rows, q3_cpu_rows) -> dict:
+    """detail.resultCache (docs/caching.md): dashboard-replay QPS at
+    c=16 — the same mixed q1/q3 workload replayed against a cache-off
+    server (cold: every query executes) and a result-cache server after
+    one priming pass per shape (warm: hits serve payload bytes from
+    memory) — plus the subplan-cache join build-time delta on repeated
+    q3. Every response, cached or executed, is asserted bit-identical
+    to the CPU oracle. Skips gracefully when the server cannot bind."""
+    import threading
+
+    from spark_rapids_tpu.serve import QueryServer, ServeClient
+
+    def check(kind, rows):
+        assert_rows_match(cpu_rows if kind == "q1" else q3_cpu_rows,
+                          rows)
+
+    def serve(extra: dict) -> "QueryServer":
+        conf = dict(TPU_CONF)
+        conf.update({
+            "spark.rapids.sql.serve.maxConcurrentQueries": "4",
+            "spark.rapids.sql.serve.maxQueued": "64",
+            "spark.rapids.sql.serve.maxConcurrentPerTenant": "4",
+        })
+        conf.update(extra)
+        srv = QueryServer(conf).start()
+        srv.register_view("lineitem", DATA_DIR)
+        for name in ("item", "date_dim", "store_sales"):
+            srv.register_view(name, os.path.join(TPCDS_DIR, name))
+        return srv
+
+    def replay(port: int, total: int, concurrency: int = 16):
+        errors: list = []
+
+        def worker(i):
+            try:
+                with ServeClient(port, tenant=f"dash{i % 4}") as c:
+                    kind = "q1" if i % 2 == 0 else "q3"
+                    b, _ = c.sql(Q1 if kind == "q1" else TPCDS_Q3)
+                    check(kind, [tuple(r) for r in b.rows()])
+            except Exception as e:  # noqa: BLE001 - reported below
+                errors.append(repr(e))
+
+        t0 = time.perf_counter()
+        threads = []
+        for i in range(total):
+            t = threading.Thread(target=worker, args=(i,))
+            t.start()
+            threads.append(t)
+            while sum(1 for x in threads if x.is_alive()) \
+                    >= concurrency:
+                time.sleep(0.005)
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0, errors
+
+    fresh_leg()
+    total = int(os.environ.get("BENCH_REPLAY_QUERIES", "32"))
+
+    # cold side: caches off — every replayed query admits and executes
+    try:
+        srv = serve({})
+    except OSError as e:
+        return {"skipped": True, "reason": f"cannot bind: {e!r}"}
+    try:
+        cold_wall, errors = replay(srv.port, total)
+        if errors:
+            return {"skipped": True, "reason": errors[:3]}
+    finally:
+        srv.shutdown()
+
+    # warm side: result cache on — one priming pass per shape, then
+    # the identical replay; hits bypass admission and device work
+    srv = serve({
+        "spark.rapids.sql.resultCache.enabled": "true",
+        "spark.rapids.sql.subplanCache.enabled": "true",
+    })
+    try:
+        with ServeClient(srv.port, tenant="prime") as c:
+            b, _ = c.sql(Q1)
+            check("q1", [tuple(r) for r in b.rows()])
+            b, _ = c.sql(TPCDS_Q3)
+            check("q3", [tuple(r) for r in b.rows()])
+        warm_wall, errors = replay(srv.port, total)
+        if errors:
+            return {"skipped": True, "reason": errors[:3]}
+        rc = srv.stats().get("cache", {}).get("result", {})
+    finally:
+        srv.shutdown()
+    probes = rc.get("hits", 0) + rc.get("misses", 0)
+    out = {
+        "skipped": False,
+        "clean_wall_s": round(clean_wall, 4),
+        "replay": {
+            "queries": total,
+            "coldWall_s": round(cold_wall, 4),
+            "coldQps": round(total / cold_wall, 4),
+            "warmWall_s": round(warm_wall, 4),
+            "warmQps": round(total / warm_wall, 4),
+            "qpsSpeedup": round(cold_wall / max(1e-9, warm_wall), 4),
+            "hitRate": round(rc.get("hits", 0) / max(1, probes), 4),
+            "result": rc,
+        },
+    }
+
+    # subplan leg: result cache OFF so repeats re-execute, subplan
+    # cache ON so the q3 join build tables are reused — the wall delta
+    # between the first (building) and best repeated run is the
+    # build-time saving
+    from spark_rapids_tpu.serve import result_cache as RC
+    RC.reset_subplan_cache()
+    srv = serve({"spark.rapids.sql.subplanCache.enabled": "true"})
+    try:
+        walls = []
+        with ServeClient(srv.port, tenant="sub") as c:
+            for _ in range(3):
+                tq = time.perf_counter()
+                b, _ = c.sql(TPCDS_Q3)
+                walls.append(time.perf_counter() - tq)
+                check("q3", [tuple(r) for r in b.rows()])
+        sp = srv.stats().get("cache", {}).get("subplan", {})
+    finally:
+        srv.shutdown()
+    out["subplan"] = {
+        "buildWall_s": round(walls[0], 4),
+        "reuseWall_s": round(min(walls[1:]), 4),
+        "buildSpeedup": round(
+            walls[0] / max(1e-9, min(walls[1:])), 4),
+        "stats": sp,
+    }
+    return out
+
+
 def run_lifecycle(clean_wall: float, cpu_rows) -> dict:
     """detail.lifecycle (docs/serving.md "Query lifecycle"): cancel
     latency p50/p99 (cancel verb fired against a running q1; latency =
@@ -1741,6 +1873,15 @@ def main():
         adaptive_leg = {"skipped": True,
                         "reason": f"adaptive leg failed: {e!r}"}
 
+    # result + subplan cache leg (docs/caching.md): dashboard-replay
+    # warm-vs-cold QPS at c=16, hit rates, join build reuse delta
+    try:
+        result_cache_leg = run_result_cache(fused["wall_s"], cpu_rows,
+                                            q3_cpu_rows)
+    except Exception as e:  # noqa: BLE001 - reported, not swallowed
+        result_cache_leg = {"skipped": True,
+                            "reason": f"result-cache leg failed: {e!r}"}
+
     cpu_t = min(cpu_times)
     tpu_t = fused["wall_s"]
     q3_tpu_t = fused["q3"]["wall_s"]
@@ -1784,6 +1925,7 @@ def main():
             "lifecycle": lifecycle_leg,
             "history": history_leg,
             "adaptive": adaptive_leg,
+            "resultCache": result_cache_leg,
             "jitCaches": registry_snapshot()["jitCaches"],
             "tpcds_q3": {
                 "device_wall_s": round(q3_tpu_t, 4),

@@ -421,6 +421,53 @@ PLAN_CACHE_ENABLED = conf("spark.rapids.sql.planCache.enabled").doc(
     "default; the query server enables it for its sessions "
     "(docs/serving.md).").boolean(False)
 
+RESULT_CACHE_ENABLED = conf("spark.rapids.sql.resultCache.enabled").doc(
+    "Serve-tier result cache (docs/caching.md): the final Arrow IPC "
+    "payload of a finished query is kept in a bounded LRU keyed on "
+    "(plan-signature digest, extracted literal bindings, input-file "
+    "fingerprint set). A hit is detected BEFORE admission and served "
+    "straight from memory — zero device work, zero queue wait, zero "
+    "admission slot — and any input-file fingerprint mismatch "
+    "(path/size/mtime) invalidates the entry and falls through to "
+    "normal execution, so served bytes are always bit-identical to a "
+    "fresh run. Off by default.").boolean(False)
+
+RESULT_CACHE_MAX_ENTRIES = conf(
+    "spark.rapids.sql.resultCache.maxEntries").doc(
+    "Bound on distinct cached results; least-recently-served entries "
+    "are evicted past it (docs/caching.md).").integer(256)
+
+RESULT_CACHE_MAX_BYTES = conf(
+    "spark.rapids.sql.resultCache.maxBytes").doc(
+    "Bound on total cached Arrow IPC payload bytes held by the result "
+    "cache; LRU eviction keeps the sum under it (docs/caching.md)."
+    ).integer(256 << 20)
+
+SUBPLAN_CACHE_ENABLED = conf(
+    "spark.rapids.sql.subplanCache.enabled").doc(
+    "Cross-query broadcast build-table cache (docs/caching.md): the "
+    "device-resident build side of a broadcast hash join is kept keyed "
+    "on the build subtree's structural signature + its input-file "
+    "fingerprint set and reused across queries and tenants, lifting "
+    "the reference's within-plan GpuBroadcastExchangeExec reuse across "
+    "query boundaries. Entries register in the device store as "
+    "evict-FIRST: pool pressure drops cached build tables before any "
+    "live query's batches spill. Fingerprints are re-checked on every "
+    "reuse; a mismatch drops the entry and rebuilds. Off by default."
+    ).boolean(False)
+
+SUBPLAN_CACHE_MAX_ENTRIES = conf(
+    "spark.rapids.sql.subplanCache.maxEntries").doc(
+    "Bound on distinct cached build tables; least-recently-reused "
+    "entries are dropped past it (docs/caching.md).").integer(32)
+
+SUBPLAN_CACHE_MAX_BYTES = conf(
+    "spark.rapids.sql.subplanCache.maxBytes").doc(
+    "Bound on total device bytes the subplan cache may pin; LRU drops "
+    "keep the sum under it. The device store may additionally drop "
+    "entries at any moment under pool pressure (docs/caching.md)."
+    ).integer(64 << 20)
+
 SERVE_MAX_CONCURRENT = conf(
     "spark.rapids.sql.serve.maxConcurrentQueries").doc(
     "Queries the server executes simultaneously across all tenants; "

@@ -193,6 +193,27 @@ class TpuShuffledHashJoinExec(TpuExec):
     _BROADCASTABLE = ("inner", "cross", "left", "leftouter", "leftsemi",
                       "leftanti")
 
+    def _subplan_cache_key(self) -> Optional[tuple]:
+        """``(cache, key)`` for this join's build side when the
+        cross-query subplan cache (docs/caching.md) is enabled, else
+        None. The key is the build subtree's structural signature —
+        identical build sides across queries, sessions, and tenants
+        share one device-resident table."""
+        from spark_rapids_tpu.serve import result_cache as RC
+        if not RC.subplan_cache_enabled(self.conf):
+            return None
+        key = RC.subplan_signature(self.right, self.conf)
+        return (RC.get_subplan_cache(self.conf), key)
+
+    def _subplan_cache_put(self, probe, captured, rwhole) -> None:
+        """Publish a freshly built broadcast table for cross-query
+        reuse; refused entries (no fingerprints, oversized) just skip."""
+        if probe is None or captured is None:
+            return
+        from spark_rapids_tpu.memory import get_device_store
+        cache, key = probe
+        cache.put(key, captured, rwhole, get_device_store(self.conf))
+
     def _aqe_try_broadcast(self) -> Optional[List[DevicePartitionThunk]]:
         """AQE runtime replan (GpuOverrides.scala:3550
         GpuQueryStagePrepOverrides role; docs/adaptive.md): materialize
@@ -236,14 +257,28 @@ class TpuShuffledHashJoinExec(TpuExec):
         if total > threshold:
             return None
         from spark_rapids_tpu import trace as TR
+        from spark_rapids_tpu.serve import result_cache as RC
         with TR.span("aqeReplan", action="broadcastDemotion",
                      buildBytes=total, thresholdBytes=threshold):
             self.metrics.create("aqeBroadcastFlip", M.ESSENTIAL).add(1)
             self.metrics.create("aqeReplans", M.ESSENTIAL).add(1)
-            rbatches = [h.get() for h in handles]
-            rwhole = (concat_device(rbatches) if len(rbatches) > 1 else
-                      rbatches[0] if rbatches else
-                      DeviceBatch.empty(self.right.schema))
+            probe = self._subplan_cache_key()
+            rwhole = probe[0].lookup(probe[1]) if probe is not None \
+                else None
+            if rwhole is not None:
+                self.metrics.create("subplanCacheHits",
+                                    M.ESSENTIAL).add(1)
+            else:
+                rbatches = [h.get() for h in handles]
+                rwhole = (concat_device(rbatches) if len(rbatches) > 1
+                          else rbatches[0] if rbatches else
+                          DeviceBatch.empty(self.right.schema))
+                # the build executed during the exchange's stat
+                # materialization above, so the pre-EXECUTION capture
+                # (session TLS; a superset of this subtree's inputs) is
+                # the only fingerprint honest for this data
+                self._subplan_cache_put(
+                    probe, RC.current_execution_fingerprints(), rwhole)
             left_src = self.left
             if isinstance(left_src, TpuShuffleExchangeExec) \
                     and not getattr(left_src.partitioning,
@@ -562,6 +597,21 @@ class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
     the device residency — no per-partition re-upload)."""
 
     def device_partitions(self) -> List[DevicePartitionThunk]:
+        from spark_rapids_tpu.serve import result_cache as RC
+        probe = self._subplan_cache_key()
+        captured = None
+        if probe is not None:
+            cached = probe[0].lookup(probe[1])
+            if cached is not None:
+                # cross-query build reuse (docs/caching.md): the build
+                # subtree never executes — zero scan/decode/concat work
+                self.metrics.create("subplanCacheHits",
+                                    M.ESSENTIAL).add(1)
+                return self._broadcast_stream_thunks(self.left, cached)
+            # fingerprint the build inputs BEFORE the build reads them:
+            # a file mutated mid-build mismatches at reuse time instead
+            # of going stale
+            captured = RC.capture_fingerprints(self.right)
         # skip only KNOWN-empty batches: a row_count() here costs a
         # blocking roundtrip per batch; concat_device syncs counts once
         # when it actually has to stitch
@@ -574,6 +624,7 @@ class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
         rwhole = (concat_device(rbatches) if len(rbatches) > 1 else
                   rbatches[0] if rbatches else
                   DeviceBatch.empty(self.right.schema))
+        self._subplan_cache_put(probe, captured, rwhole)
         return self._broadcast_stream_thunks(self.left, rwhole)
 
     def simple_string(self):

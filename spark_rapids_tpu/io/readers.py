@@ -82,6 +82,19 @@ def list_files(paths: Sequence[str]) -> List[tuple]:
     return out
 
 
+def file_fingerprints(files: Sequence[str]):
+    """``(path, size, mtime_ns)`` per input file — the invalidation
+    currency of the serve-tier caches (docs/caching.md). ``None`` when
+    any file cannot be statted (vanished between listing and here): an
+    unfingerprintable input set is simply uncacheable, never stale."""
+    try:
+        return tuple(
+            (f, st.st_size, st.st_mtime_ns)
+            for f, st in ((f, os.stat(f)) for f in files))
+    except OSError:
+        return None
+
+
 def discovered_partition_fields(files: List[tuple]) -> List[T.StructField]:
     """Partition columns + value-inferred types (Spark's
     PartitioningUtils.inferPartitionColumnValue: int -> long -> double ->
@@ -508,6 +521,12 @@ class CpuFileScanExec(P.PhysicalPlan):
                                         owner="FileScan")
         listed = list_files(paths)
         self.files = [f for f, _ in listed]
+        # input-file fingerprints (path, size, mtime_ns) captured at
+        # scan planning: the serve-tier caches (docs/caching.md) key on
+        # these and re-stat before every reuse, so ANY change to the
+        # inputs — append, rewrite, touch, delete — invalidates instead
+        # of serving stale bytes
+        self.fingerprints = file_fingerprints(self.files)
         part_names = {k for _f, pv in listed for k in pv}
         self._part_fields = [f for f in self.schema.fields
                              if f.name in part_names]

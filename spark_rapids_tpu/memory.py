@@ -130,10 +130,10 @@ class _State:
 
     __slots__ = ("tier", "device", "host", "disk_path", "device_bytes",
                  "host_bytes", "closed", "rows", "ever_spilled", "owner",
-                 "metrics_ref", "tenant")
+                 "metrics_ref", "tenant", "cache_entry")
 
     def __init__(self, batch: DeviceBatch, owner: str = UNATTRIBUTED,
-                 metrics=None):
+                 metrics=None, cache_entry: bool = False):
         self.tier = TIER_DEVICE
         self.device: Optional[DeviceBatch] = batch
         self.host: Optional[HostBatch] = None
@@ -158,6 +158,12 @@ class _State:
         self.tenant: Optional[str] = (
             getattr(metrics, "_tenant", None) if metrics is not None
             else None) or current_tenant()
+        # cache-tier entry (docs/caching.md): reconstructible data a
+        # serve-tier cache registered opportunistically. Under pool
+        # pressure these DROP (release outright, never demote to
+        # host/disk) and drop FIRST — before any live query's batch
+        # spills — because the cache can always rebuild from source
+        self.cache_entry = cache_entry
 
 
 class SpillableBatch:
@@ -266,6 +272,10 @@ class DeviceStore:
         self._file_prefix = f"spill-{uuid.uuid4().hex[:8]}"
         self.disk_files_live = 0
         self._closed = False
+        # cache-tier accounting (docs/caching.md): entries the pool
+        # dropped under pressure (released, not spilled)
+        self.cache_drop_count = 0
+        self.cache_dropped_bytes = 0
 
     # -- owner accounting + occupancy timeline -----------------------------
 
@@ -311,13 +321,16 @@ class DeviceStore:
     # -- registration ------------------------------------------------------
 
     def register(self, batch: DeviceBatch, owner: str = UNATTRIBUTED,
-                 metrics=None) -> SpillableBatch:
+                 metrics=None, cache_entry: bool = False) -> SpillableBatch:
         """Track ``batch`` as spillable. ``owner`` names the creating
         operator for the per-op HBM ledger (execs call this through
         ``TpuExec.register_spillable``, which threads their class name
-        and metric registry)."""
+        and metric registry). ``cache_entry`` marks reconstructible
+        cache data that drops FIRST under pool pressure instead of
+        spilling (docs/caching.md)."""
         with self._lock:
-            st = _State(batch, owner=owner, metrics=metrics)
+            st = _State(batch, owner=owner, metrics=metrics,
+                        cache_entry=cache_entry)
             hid = self._next_id
             self._next_id += 1
             self._states[hid] = st
@@ -381,16 +394,21 @@ class DeviceStore:
 
     def _device_spill_order(self, exclude: int) -> list:
         """Handle ids in the order the pool should demote them:
-        over-share tenants' handles first (most-over tenant first, LRU
-        within), then plain LRU — the fair-share arbitration that bills
-        spill pressure to the tenant causing it (docs/serving.md)."""
+        cache-tier entries FIRST (reconstructible data never outranks a
+        live query's batches, docs/caching.md), then over-share
+        tenants' handles (most-over tenant first, LRU within), then
+        plain LRU — the fair-share arbitration that bills spill
+        pressure to the tenant causing it (docs/serving.md)."""
         over = self._over_share_tenants()
         if not over:
-            return [h for h in self._states if h != exclude]
+            return sorted(
+                (h for h in self._states if h != exclude),
+                key=lambda h: 0 if self._states[h].cache_entry else 1)
         rank = {t: i for i, t in enumerate(over)}
         ordered = sorted(
             (h for h in self._states if h != exclude),
-            key=lambda h: rank.get(self._states[h].tenant, len(rank)))
+            key=lambda h: (0 if self._states[h].cache_entry else 1,
+                           rank.get(self._states[h].tenant, len(rank))))
         return ordered
 
     def _enforce(self, exclude: int) -> None:
@@ -399,7 +417,11 @@ class DeviceStore:
                 if self.device_bytes <= self.device_budget:
                     break
                 st = self._states[hid]
-                if st.tier == TIER_DEVICE:
+                if st.tier != TIER_DEVICE:
+                    continue
+                if st.cache_entry:
+                    self._drop_cache_entry(hid, st)
+                else:
                     self._spill_to_host(st)
         if self.host_bytes > self.host_budget:
             for hid in list(self._states):
@@ -439,6 +461,19 @@ class DeviceStore:
             from spark_rapids_tpu import metrics as M
             m.create(M.SPILL_BYTES, M.ESSENTIAL).add(st.device_bytes)
         self._sample_counters()
+
+    def _drop_cache_entry(self, hid: int, st: _State) -> None:
+        """Release a cache-tier entry outright under pool pressure
+        (docs/caching.md): the data is reconstructible from source, so
+        demoting it to host/disk would spend spill bandwidth preserving
+        bytes nobody is owed. The owning cache observes the closed
+        handle on its next lookup and forgets the entry."""
+        dropped = st.device_bytes
+        with _trace.span("cacheEntryDrop", bytes=dropped,
+                         owner=st.owner):
+            self._release_id(hid)
+        self.cache_drop_count += 1
+        self.cache_dropped_bytes += dropped
 
     def _spill_to_disk(self, st: _State) -> None:
         if self.debug:
@@ -520,7 +555,10 @@ class DeviceStore:
                 st = self._states[hid]
                 if st.tier == TIER_DEVICE and not st.closed:
                     freed += st.device_bytes
-                    self._spill_to_host(st)
+                    if st.cache_entry:
+                        self._drop_cache_entry(hid, st)
+                    else:
+                        self._spill_to_host(st)
         return freed
 
     def close(self) -> None:
@@ -556,6 +594,8 @@ class DeviceStore:
             "spilledDeviceBytes": self.spilled_device_bytes,
             "diskSpillCount": self.disk_spill_count,
             "diskFilesLive": self.disk_files_live,
+            "cacheDropCount": self.cache_drop_count,
+            "cacheDroppedBytes": self.cache_dropped_bytes,
         }
 
     def owner_stats(self) -> Dict[str, Dict[str, int]]:

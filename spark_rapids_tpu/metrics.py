@@ -115,6 +115,8 @@ METRIC_DESCRIPTIONS: Dict[str, str] = {
     "externalShuffleReadTime": "external-shuffle read+re-upload wall",
     "externalShuffleBytes": "bytes shipped through the external shuffle",
     "broadcastBuilds": "broadcast build-side materializations",
+    "subplanCacheHits": "join build tables reused from the subplan "
+                        "cache instead of rebuilt (docs/caching.md)",
     "numIciExchanges": "all-to-all exchanges run over the ICI mesh",
     "aqeCoalescedPartitions": "tiny exchange partitions coalesced by AQE",
     "aqeBroadcastFlip": "shuffled joins flipped to broadcast at runtime",

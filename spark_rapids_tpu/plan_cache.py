@@ -146,12 +146,19 @@ def plan_signature(plan, conf) -> str:
     # a wall change to an aqeActions delta instead of a shape change
     # (serve.batchFusion.* rides the serve. prefix already excluded
     # above).
+    # resultCache.*/subplanCache.* gate the serve-tier caches
+    # (docs/caching.md), which never change what a plan computes — only
+    # whether a byte-identical result is served from memory: excluding
+    # them keeps cache-on and cache-off runs of one shape on one
+    # signature, so they share doctor baselines and quarantine streaks.
     parts.append(";".join(
         f"{k}={v}" for k, v in sorted(
             (str(k), str(v)) for k, v in conf.settings.items())
         if not k.startswith((
             "spark.rapids.sql.serve.",
             "spark.rapids.sql.adaptive.",
+            "spark.rapids.sql.resultCache.",
+            "spark.rapids.sql.subplanCache.",
             # tpu-lint: disable=conf-key(prefix over the test.inject* key family, not a key literal)
             "spark.rapids.sql.test.inject"))))
     return "".join(parts)

@@ -230,6 +230,14 @@ class AdmissionController:
             qt.add("serveQueueWait", now - int(max(0.0, wait_s) * 1e9),
                    now, tenant=tenant)
 
+    def bill_cache_hit(self, tenant: str) -> None:
+        """Result-cache-hit accounting (docs/caching.md): a hit is
+        served BEFORE admission — no slot, no queue wait — but it is a
+        real admitted query from the tenant's point of view, so the
+        admitted totals and queue-wait reservoir bill it exactly like a
+        fused member (with a zero wait — that zero is the product)."""
+        self.bill_fused_member(tenant, 0.0)
+
     def saturated(self) -> bool:
         """Queue-pressure hint for the batch-fusion window gate
         (docs/adaptive.md): anything waiting, or every slot occupied.

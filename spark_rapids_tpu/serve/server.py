@@ -34,7 +34,10 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from spark_rapids_tpu.conf import (SERVE_BATCH_FUSION_ENABLED,
+from spark_rapids_tpu.conf import (RESULT_CACHE_ENABLED,
+                                   RESULT_CACHE_MAX_BYTES,
+                                   RESULT_CACHE_MAX_ENTRIES,
+                                   SERVE_BATCH_FUSION_ENABLED,
                                    SERVE_BATCH_FUSION_MAX_BATCH,
                                    SERVE_BATCH_FUSION_WINDOW_MS,
                                    SERVE_HOST, SERVE_PORT, TpuConf)
@@ -92,6 +95,15 @@ class QueryServer:
             self._fusion = BatchFusionCoordinator(
                 int(cobj.get(SERVE_BATCH_FUSION_WINDOW_MS)),
                 int(cobj.get(SERVE_BATCH_FUSION_MAX_BATCH)))
+        # serve-tier result cache (docs/caching.md): when OFF the
+        # cache is never constructed and every request takes the
+        # execute path untouched
+        self._result_cache = None
+        if bool(cobj.get(RESULT_CACHE_ENABLED)):
+            from spark_rapids_tpu.serve.result_cache import ResultCache
+            self._result_cache = ResultCache(
+                int(cobj.get(RESULT_CACHE_MAX_ENTRIES)),
+                int(cobj.get(RESULT_CACHE_MAX_BYTES)))
         self._sessions: Dict[str, object] = {}
         self._sessions_lock = threading.Lock()
         # per-tenant creation locks: concurrent first requests for ONE
@@ -273,6 +285,12 @@ class QueryServer:
         with self._sessions_lock:
             self._views[name] = (fmt, path)
             sessions = list(self._sessions.values())
+        # a (re-)registered view may point existing SQL text at
+        # different data under the same name — fingerprints alone
+        # cannot see that until the paths change, so the result cache
+        # starts over (docs/caching.md)
+        if self._result_cache is not None:
+            self._result_cache.bump_generation()
         for s in sessions:
             self._apply_view(s, name, fmt, path)
 
@@ -546,6 +564,14 @@ class QueryServer:
         # in as the nested scope it already supports
         tok = TR.begin_query(session.conf_obj)
         try:
+            # result cache (docs/caching.md): consulted BEFORE
+            # admission AND before fusion — a hit serves the stored
+            # Arrow payload with zero device work, zero queue wait,
+            # and zero admission slot
+            if self._result_cache is not None and \
+                    self._try_result_cache(conn, tenant, sql, session,
+                                           token, tok, t_req):
+                return
             if self._fusion is not None:
                 # batch-fusion path (docs/adaptive.md): join/wait on a
                 # same-signature fusion batch INSTEAD of acquiring a
@@ -595,6 +621,11 @@ class QueryServer:
                              rows=batch.num_rows)
                 tok = None
                 payload = protocol.batch_to_ipc(batch)
+                # this thread planned and executed: its signature +
+                # pre-execution fingerprints admit the exact payload
+                # bytes the client is about to receive
+                self._maybe_cache_result(session, sql, payload,
+                                         batch.num_rows)
                 resp = {
                     "status": "ok",
                     "tenant": tenant,
@@ -651,6 +682,92 @@ class QueryServer:
                 self._admission.release(tenant)
         finally:
             self._untrack(conn, token)
+
+    def _try_result_cache(self, conn, tenant: str, sql: str, session,
+                          token, tok, t_req: float) -> bool:
+        """Serve ``sql`` from the result cache when a fingerprint-valid
+        entry exists (docs/caching.md). Returns True when the request
+        was fully handled here — a bit-identical payload served with
+        zero device work, zero queue wait, and zero admission slot
+        (only per-tenant billing and a ``resultCacheHit`` span) — or
+        when the query was cancelled at the pre-serve checkpoint. False
+        falls through to normal admission + execution."""
+        from spark_rapids_tpu import lifecycle as LC
+        from spark_rapids_tpu import trace as TR
+        from spark_rapids_tpu.telemetry import history as _h
+        entry = self._result_cache.lookup(sql)
+        if entry is None:
+            return False
+        try:
+            # one cooperative checkpoint before serving: a request
+            # cancelled (or already past its deadline) between receipt
+            # and the cache probe returns cleanly instead of shipping
+            # a payload nobody is waiting for
+            LC.checkpoint_token(token, "admission")
+        except LC.TpuQueryCancelled as e:
+            TR.end_query(session.conf_obj, tok, error=True)
+            self._count_cancel(e.reason)
+            _h.record_query_close(
+                session.conf_obj,
+                status=(_h.STATUS_TIMED_OUT
+                        if e.reason == LC.REASON_DEADLINE
+                        else _h.STATUS_CANCELLED),
+                reason=e.reason, tenant=tenant,
+                query_id=token.query_id,
+                queue_wait_s=token.elapsed())
+            protocol.send_msg(conn, {
+                "status": "cancelled", "tenant": tenant,
+                "reason": e.reason, "where": "cached"})
+            return True
+        with TR.span("resultCacheHit", tenant=tenant,
+                     signature=entry.signature, rows=entry.rows,
+                     bytes=len(entry.payload)):
+            # a real admitted query on the tenant's ledger, served off
+            # the cache: billed with a ZERO queue wait, no slot taken
+            self._admission.bill_cache_hit(tenant)
+            exec_s = time.perf_counter() - t_req
+            resp = {
+                "status": "ok",
+                "tenant": tenant,
+                "rows": entry.rows,
+                "queueWaitMs": 0.0,
+                "execMs": round(exec_s * 1e3, 3),
+                # the entry exists because this shape planned and
+                # executed before; no planning happened at all
+                "planCacheHit": True,
+                "resultCacheHit": True,
+            }
+            if token.query_id is not None:
+                resp["queryId"] = token.query_id
+            protocol.send_msg(conn, resp, entry.payload)
+        TR.end_query(session.conf_obj, tok, wall_s=exec_s,
+                     rows=entry.rows)
+        with self._lat_lock:
+            self.queries_ok += 1
+        self._record_latency(tenant, time.perf_counter() - t_req)
+        # the session never ran, so the SERVER writes the history
+        # record; resultCacheHit=True keeps the near-zero wall out of
+        # doctor baselines and SLO windows (docs/caching.md)
+        _h.record_query_close(
+            session.conf_obj, status=_h.STATUS_FINISHED,
+            signature=entry.signature, tenant=tenant,
+            query_id=token.query_id, wall_s=exec_s,
+            rows=entry.rows, result_cache_hit=True)
+        self._slo.on_query_close(tenant)
+        return True
+
+    def _maybe_cache_result(self, session, sql: str, payload,
+                            rows: int) -> None:
+        """Admit a freshly executed query's payload (docs/caching.md).
+        Must run on the thread that planned AND executed ``sql`` — the
+        plan signature and the pre-execution fingerprint capture are
+        thread-local to it."""
+        if self._result_cache is None:
+            return
+        from spark_rapids_tpu.serve import result_cache as RC
+        self._result_cache.put(
+            sql, session.thread_plan_signature(),
+            RC.current_execution_fingerprints(), payload, rows)
 
     def _handle_sql_fused(self, conn, tenant: str, sql: str, session,
                           token, tok, t_req: float) -> None:
@@ -751,6 +868,14 @@ class QueryServer:
         TR.end_query(session.conf_obj, tok, wall_s=exec_s,
                      rows=batch.num_rows)
         payload = protocol.batch_to_ipc(batch)
+        if role == "execute" and member.fused_size == 1:
+            # only a size-1 executor ran exactly its OWN sql on this
+            # thread, so the thread-local signature + fingerprints are
+            # its own; multi-member batches skip population (the
+            # executor thread's capture belongs to the LAST group it
+            # ran) — hits increasingly bypass fusion anyway
+            self._maybe_cache_result(session, sql, payload,
+                                     batch.num_rows)
         resp = {
             "status": "ok",
             "tenant": tenant,
@@ -842,6 +967,15 @@ class QueryServer:
         }
         if self._fusion is not None:
             out["batchFusion"] = self._fusion.stats()
+        cache: Dict = {}
+        if self._result_cache is not None:
+            cache["result"] = self._result_cache.stats()
+        from spark_rapids_tpu.serve import result_cache as _rc
+        sp = _rc.subplan_cache_stats()
+        if sp is not None:
+            cache["subplan"] = sp
+        if cache:
+            out["cache"] = cache
         if self._history is not None:
             out["history"] = {**self._history.stats(),
                               "warmStart": self.warm_start_summary}

@@ -304,6 +304,21 @@ class TpuSparkSession:
             # registrations from any pool thread bill the right ledger
             from spark_rapids_tpu import memory as _mem
             _mem.stamp_plan_tenant(physical, self.tenant)
+            # serve-tier caching (docs/caching.md): fingerprint every
+            # file-scan input BEFORE execution reads it — a file
+            # mutated mid-query then mismatches at lookup time instead
+            # of going stale. Captured on this thread for the server's
+            # result-cache population and the join build-reuse hooks;
+            # skipped (and cleared) when neither cache is on.
+            from spark_rapids_tpu.conf import (RESULT_CACHE_ENABLED,
+                                               SUBPLAN_CACHE_ENABLED)
+            from spark_rapids_tpu.serve import result_cache as _RC
+            if (bool(self.conf_obj.get(RESULT_CACHE_ENABLED))
+                    or bool(self.conf_obj.get(SUBPLAN_CACHE_ENABLED))):
+                _RC.set_execution_fingerprints(
+                    _RC.capture_fingerprints(physical))
+            else:
+                _RC.set_execution_fingerprints(None)
             t0 = _time.perf_counter()
             with _mem.tenant_scope(self.tenant):
                 result = physical.execute_collect(
@@ -468,6 +483,13 @@ class TpuSparkSession:
         query on this session (None when none) — race-free under the
         server's shared-session-per-tenant concurrency."""
         return getattr(self._tls, "profile_path", None)
+
+    def thread_plan_signature(self) -> Optional[str]:
+        """The plan-signature digest of the CALLING thread's last
+        planned query on this session (None when planning ran without
+        the plan cache) — the server's result-cache population reads
+        this after _execute() on the same thread (docs/caching.md)."""
+        return getattr(self._tls, "plan_signature", None)
 
     # -- plan capture (ExecutionPlanCaptureCallback, Plugin.scala:268-390)
     def start_capture(self) -> None:

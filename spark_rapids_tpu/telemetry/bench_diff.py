@@ -77,6 +77,14 @@ CHECKS: List[Tuple[str, str, bool, str]] = [
      "AQE coalesce dispatch savings"),
     ("detail.adaptive.batchFusion.qpsSpeedup", "higher", False,
      "same-signature batch-fusion QPS speedup"),
+    ("detail.resultCache.replay.warmQps", "higher", True,
+     "dashboard-replay warm QPS @ c=16"),
+    ("detail.resultCache.replay.qpsSpeedup", "higher", True,
+     "result-cache replay QPS speedup (warm vs cold)"),
+    ("detail.resultCache.replay.hitRate", "higher", True,
+     "result-cache replay hit rate"),
+    ("detail.resultCache.subplan.buildSpeedup", "higher", False,
+     "subplan-cache join build-time speedup"),
     ("detail.history.appendOverhead", "lower", False,
      "query-history append overhead"),
     ("detail.history.doctor.roundTripMs", "lower", False,

@@ -206,7 +206,11 @@ def _baseline(records: List[Dict[str, Any]],
     base = [r for r in records
             if sig and r is not target
             and r.get("status") == STATUS_FINISHED
-            and r.get("signature") == sig]
+            and r.get("signature") == sig
+            # cache-served records carry near-zero walls and no device
+            # work — aggregating them would make every real execution
+            # look like a regression (docs/caching.md)
+            and not r.get("resultCacheHit")]
     walls = [float(r.get("wallSeconds", 0)) for r in base]
     use_trace = _pick_stage_source(target, base)
     stage_sets: List[Dict[str, float]] = []

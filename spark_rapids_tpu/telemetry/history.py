@@ -114,6 +114,12 @@ HISTORY_FIELD_CATALOG: Dict[str, str] = {
                   "(aqeReplans/aqeBroadcastFlip/aqeSkewSplits/"
                   "aqeCoalescedPartitions; nonzero entries only, "
                   "present only when any fired — docs/adaptive.md)",
+    "resultCacheHit": "true when the query was served verbatim from "
+                      "the serve-tier result cache (docs/caching.md); "
+                      "cache-served records are EXCLUDED from doctor "
+                      "baselines, SLO windows, warm-start replay, and "
+                      "per-signature wall aggregates — a near-zero "
+                      "cached wall must not poison a shape's baseline",
 }
 
 
@@ -334,7 +340,8 @@ def build_record(*, status: str, reason: Optional[str] = None,
                  queue_wait_s: float = 0.0, rows: int = 0,
                  physical=None, report=None,
                  profile_path: Optional[str] = None,
-                 trace_path: Optional[str] = None) -> Dict[str, Any]:
+                 trace_path: Optional[str] = None,
+                 result_cache_hit: bool = False) -> Dict[str, Any]:
     """One history record. Every key written here must be a
     HISTORY_FIELD_CATALOG entry (tpu-lint ``history-field``)."""
     from spark_rapids_tpu import memory
@@ -376,6 +383,8 @@ def build_record(*, status: str, reason: Optional[str] = None,
         rec["profilePath"] = profile_path
     if trace_path:
         rec["tracePath"] = trace_path
+    if result_cache_hit:
+        rec["resultCacheHit"] = True
     return rec
 
 
@@ -491,6 +500,10 @@ def signature_aggregates(records: List[Dict[str, Any]]
     out: Dict[str, Dict[str, Any]] = {}
     for sig, recs in by_sig.items():
         fin = [r for r in recs if r.get("status") == STATUS_FINISHED]
+        # cache-served queries count in the histogram but never drive
+        # the latency numbers: a near-zero cached wall would crater a
+        # shape's p50/p99 and trend slope (docs/caching.md)
+        fin = [r for r in fin if not r.get("resultCacheHit")]
         walls = [float(r.get("wallSeconds", 0)) for r in fin]
         statuses: Dict[str, int] = {}
         tenants = set()
@@ -644,8 +657,12 @@ def warm_start(conf_obj) -> Dict[str, Any]:
             continue
         status = rec.get("status")
         if status == STATUS_FINISHED:
-            LC.record_wall(sig, float(rec.get("wallSeconds", 0.0)))
-            out["walls"] += 1
+            if not rec.get("resultCacheHit"):
+                # a cache-served wall is not an execution wall: seeding
+                # the watchdog's p99 history with near-zero values
+                # would make every real run look stuck
+                LC.record_wall(sig, float(rec.get("wallSeconds", 0.0)))
+                out["walls"] += 1
             if thr > 0:
                 LC.record_success(sig)
         elif status == STATUS_FAILED and thr > 0:
@@ -714,6 +731,11 @@ class SloTracker:
         by_tenant: Dict[str, List[float]] = {}
         for rec in read_records(self._dir, since=since):
             if rec.get("status") != STATUS_FINISHED:
+                continue
+            if rec.get("resultCacheHit"):
+                # cache-served queries are excluded from the SLO
+                # window: near-zero cached walls would mask a real
+                # latency burn behind a high hit rate
                 continue
             t = rec.get("tenant")
             if not t:

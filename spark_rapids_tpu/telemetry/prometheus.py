@@ -130,6 +130,39 @@ SERVER_FAMILY_HELP: Dict[str, Tuple[str, str]] = {
     "srt_aqe_batch_fusion_batches_total": (
         "counter", "fused batches of size >= 2 executed under one "
                    "admission slot"),
+    "srt_cache_result_hits_total": (
+        "counter", "queries served verbatim from the result cache "
+                   "(zero device work; docs/caching.md)"),
+    "srt_cache_result_misses_total": (
+        "counter", "result-cache probes that fell through to "
+                   "execution"),
+    "srt_cache_result_entries": (
+        "gauge", "result-cache entries resident"),
+    "srt_cache_result_bytes": (
+        "gauge", "Arrow IPC payload bytes held by the result cache"),
+    "srt_cache_result_invalidations_total": (
+        "counter", "result-cache entries dropped because an input "
+                   "file fingerprint or the view generation changed"),
+    "srt_cache_result_evictions_total": (
+        "counter", "result-cache entries evicted by the LRU bounds"),
+    "srt_cache_subplan_hits_total": (
+        "counter", "join build tables reused from the subplan cache "
+                   "(docs/caching.md)"),
+    "srt_cache_subplan_misses_total": (
+        "counter", "subplan-cache probes that fell through to a "
+                   "build"),
+    "srt_cache_subplan_entries": (
+        "gauge", "device-resident build tables held by the subplan "
+                 "cache"),
+    "srt_cache_subplan_bytes": (
+        "gauge", "HBM bytes held by cached build tables (evict-first "
+                 "under pool pressure)"),
+    "srt_cache_subplan_invalidations_total": (
+        "counter", "cached build tables dropped because an input "
+                   "file fingerprint changed"),
+    "srt_cache_subplan_evictions_total": (
+        "counter", "cached build tables evicted (LRU bounds or "
+                   "device-pool pressure drop)"),
 }
 
 
@@ -407,6 +440,37 @@ def render_prometheus(server_stats: Optional[Dict] = None) -> str:
                          bf.get("fusedQueries", 0))
             _emit_server(out, "srt_aqe_batch_fusion_batches_total",
                          bf.get("fusedBatches", 0))
+        # result + subplan caches (docs/caching.md): present only when
+        # the server runs with resultCache/subplanCache enabled
+        cache = server_stats.get("cache") or {}
+        rc = cache.get("result")
+        if rc:
+            _emit_server(out, "srt_cache_result_hits_total",
+                         rc.get("hits", 0))
+            _emit_server(out, "srt_cache_result_misses_total",
+                         rc.get("misses", 0))
+            _emit_server(out, "srt_cache_result_entries",
+                         rc.get("entries", 0))
+            _emit_server(out, "srt_cache_result_bytes",
+                         rc.get("bytes", 0))
+            _emit_server(out, "srt_cache_result_invalidations_total",
+                         rc.get("invalidations", 0))
+            _emit_server(out, "srt_cache_result_evictions_total",
+                         rc.get("evictions", 0))
+        sp = cache.get("subplan")
+        if sp:
+            _emit_server(out, "srt_cache_subplan_hits_total",
+                         sp.get("hits", 0))
+            _emit_server(out, "srt_cache_subplan_misses_total",
+                         sp.get("misses", 0))
+            _emit_server(out, "srt_cache_subplan_entries",
+                         sp.get("entries", 0))
+            _emit_server(out, "srt_cache_subplan_bytes",
+                         sp.get("bytes", 0))
+            _emit_server(out, "srt_cache_subplan_invalidations_total",
+                         sp.get("invalidations", 0))
+            _emit_server(out, "srt_cache_subplan_evictions_total",
+                         sp.get("evictions", 0))
         # SLO burn tracking over the query history (docs/
         # observability.md "SLO tracking"): per-tenant objective vs
         # observed p99 over the window, gauges because the window

@@ -41,6 +41,26 @@ def format_top(stats: Dict, prev: Optional[Dict] = None,
         f"{adm.get('admitted', 0)} admitted, "
         f"{adm.get('rejected', 0)} rejected, "
         f"{adm.get('throttledWaits', 0)} fair-share waits",
+    ]
+    # result/subplan cache hit rates (docs/caching.md): line present
+    # only when the server runs with a cache enabled
+    cache = stats.get("cache") or {}
+
+    def _rate(cs: Dict) -> str:
+        probes = cs.get("hits", 0) + cs.get("misses", 0)
+        pct = 100.0 * cs.get("hits", 0) / probes if probes else 0.0
+        return (f"{cs.get('hits', 0)}/{probes} hits ({pct:.0f}%), "
+                f"{cs.get('entries', 0)} entries "
+                f"{_fmt_bytes(cs.get('bytes', 0))}")
+
+    if cache:
+        parts = []
+        if cache.get("result") is not None:
+            parts.append(f"result {_rate(cache['result'])}")
+        if cache.get("subplan") is not None:
+            parts.append(f"subplan {_rate(cache['subplan'])}")
+        lines.append("cache: " + "; ".join(parts))
+    lines += [
         "",
         f"{'tenant':16s} {'qps':>7s} {'p50ms':>8s} {'p99ms':>8s} "
         f"{'waitP99':>8s} {'liveHBM':>9s} {'inFlt':>5s} {'rej':>5s}",

@@ -135,6 +135,12 @@ SPAN_CATALOG: Dict[str, str] = {
     "aqeReplan": "an adaptive runtime replan over measured exchange "
                  "stats (action= broadcastDemotion/skewSplit; "
                  "docs/adaptive.md)",
+    "resultCacheHit": "a query served verbatim from the result cache "
+                      "— zero device work, zero queue wait, zero "
+                      "admission slot (docs/caching.md)",
+    "cacheEntryDrop": "the device pool dropped a cache-tier entry "
+                      "under pressure instead of spilling a live "
+                      "query's batch (docs/caching.md)",
 }
 
 INSTANT_CATALOG: Dict[str, str] = {

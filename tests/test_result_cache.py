@@ -1,0 +1,661 @@
+"""Result + subplan cache corpus (docs/caching.md): fingerprint-honest
+invalidation (append / same-size rewrite / mtime-only touch / delete
+all force re-execution), cache-on == cache-off == CPU bit-identity at
+c=16 mixed tenants under fault injection, zero device work on a result
+hit (dispatchCount delta 0), subplan build-table reuse with parity and
+evict-first behavior under pool pressure (cache entries drop BEFORE
+any live batch spills), cancelled-while-cached-hit returning cleanly,
+history/SLO/doctor math excluding cache-served walls, and the lint
+catalog fixtures for the new spans, metrics, Prometheus families,
+history field, and confs."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import memory as MEM
+from spark_rapids_tpu import retry as R
+from spark_rapids_tpu import trace as TR
+from spark_rapids_tpu.serve import result_cache as RC
+from spark_rapids_tpu.sql.session import TpuSparkSession
+
+from tests.datagen import (IntegerGen, KeyStringGen, LongGen,
+                           SmallIntGen, gen_batch)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    TR.reset_tracing()
+    R.reset_fault_injection()
+    RC.reset_subplan_cache()
+    yield
+    TR.reset_tracing()
+    R.reset_fault_injection()
+    RC.reset_subplan_cache()
+
+
+# ---------------------------------------------------------------------------
+# Shared data + oracle results (the test_serve corpus shapes)
+# ---------------------------------------------------------------------------
+
+Q1S = """
+SELECT flag, status, sum(qty) AS sq, min(price) AS mn,
+       max(price) AS mx, count(*) AS c
+FROM lineitem WHERE qty % 5 != 0
+GROUP BY flag, status ORDER BY flag, status
+"""
+
+Q3S = """
+SELECT brand, sum(amt) AS sa, count(*) AS c
+FROM fact JOIN dim ON item = item2
+GROUP BY brand ORDER BY brand LIMIT 50
+"""
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("rc_data")
+    gen = TpuSparkSession({"spark.rapids.sql.enabled": "false"})
+    try:
+        li = gen.createDataFrame(gen_batch(
+            [("flag", KeyStringGen(cardinality=3)),
+             ("status", SmallIntGen()), ("qty", LongGen()),
+             ("price", IntegerGen())], 3000, 31), num_partitions=4)
+        li.write.mode("overwrite").parquet(str(d / "lineitem"))
+        fact = gen.createDataFrame(gen_batch(
+            [("k", SmallIntGen()), ("item", IntegerGen()),
+             ("amt", LongGen())], 2500, 32), num_partitions=3)
+        fact.write.mode("overwrite").parquet(str(d / "fact"))
+        dim = gen.createDataFrame(gen_batch(
+            [("item2", IntegerGen()),
+             ("brand", KeyStringGen(cardinality=5))], 400, 33),
+            num_partitions=2)
+        dim.write.mode("overwrite").parquet(str(d / "dim"))
+    finally:
+        gen.stop()
+    return d
+
+
+def _register_views(spark, data_dir) -> None:
+    spark.read.parquet(str(data_dir / "lineitem")) \
+        .createOrReplaceTempView("lineitem")
+    spark.read.parquet(str(data_dir / "fact")) \
+        .createOrReplaceTempView("fact")
+    spark.read.parquet(str(data_dir / "dim")) \
+        .createOrReplaceTempView("dim")
+
+
+def _serial_rows(data_dir, sql, enabled="true", **extra):
+    conf = {"spark.rapids.sql.enabled": enabled,
+            "spark.rapids.sql.batchSizeRows": "512"}
+    conf.update({k: str(v) for k, v in extra.items()})
+    spark = TpuSparkSession(conf)
+    try:
+        _register_views(spark, data_dir)
+        return [tuple(r) for r in
+                spark.sql(sql)._execute().rows()]
+    finally:
+        spark.stop()
+
+
+@pytest.fixture(scope="module")
+def oracle(data_dir):
+    """Serial cache-off results (and CPU cross-check) for both shapes —
+    the bit-identity reference every cached response is held to."""
+    q1 = _serial_rows(data_dir, Q1S)
+    q3 = _serial_rows(data_dir, Q3S)
+    assert q1 == _serial_rows(data_dir, Q1S, enabled="false")
+    assert q3 == _serial_rows(data_dir, Q3S, enabled="false")
+    return {"q1": q1, "q3": q3}
+
+
+def _server(data_dir, **conf):
+    from spark_rapids_tpu.serve import QueryServer
+    base = {"spark.rapids.sql.enabled": "true",
+            "spark.rapids.sql.batchSizeRows": "512",
+            "spark.rapids.sql.resultCache.enabled": "true"}
+    base.update({k: str(v) for k, v in conf.items()})
+    srv = QueryServer(base).start()
+    srv.register_view("lineitem", str(data_dir / "lineitem"))
+    srv.register_view("fact", str(data_dir / "fact"))
+    srv.register_view("dim", str(data_dir / "dim"))
+    return srv
+
+
+# ---------------------------------------------------------------------------
+# Result-cache hit: bit-identical payload, zero device work, billing
+# ---------------------------------------------------------------------------
+
+def test_hit_bit_identical_zero_device_work(data_dir, oracle):
+    from spark_rapids_tpu.metrics import begin_epoch, registry_snapshot
+    from spark_rapids_tpu.serve import ServeClient
+    srv = _server(data_dir)
+    try:
+        with ServeClient(srv.port, tenant="alice") as c:
+            cold, h_cold = c.sql(Q1S)
+            assert [tuple(r) for r in cold.rows()] == oracle["q1"]
+            assert not h_cold.get("resultCacheHit")
+            adm0 = srv.stats()["admission"]["admitted"]
+            # the hit must execute NOTHING: no registries created, no
+            # device program dispatched after this epoch stamp
+            ep = begin_epoch()
+            warm, h = c.sql(Q1S, tenant="bob")  # hits ACROSS tenants
+            assert [tuple(r) for r in warm.rows()] == oracle["q1"]
+            assert h["resultCacheHit"] and h["planCacheHit"]
+            assert h["queueWaitMs"] == 0.0
+            snap = registry_snapshot(epoch=ep)["metrics"]
+            assert snap.get("dispatchCount", 0) == 0, snap
+            st = srv.stats()
+            rc = st["cache"]["result"]
+            assert rc["hits"] == 1 and rc["entries"] >= 1
+            assert rc["bytes"] > 0
+            # billed on the tenant ledger without consuming a slot
+            assert st["admission"]["admitted"] == adm0 + 1
+            assert st["admission"]["tenants"]["bob"]["admitted"] == 1
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Invalidation matrix: any input change forces re-execution
+# ---------------------------------------------------------------------------
+
+def _part_files(d):
+    return sorted(p for p in os.listdir(d) if p.endswith(".parquet"))
+
+
+@pytest.mark.parametrize("mutation",
+                         ["append", "rewrite", "touch", "delete"])
+def test_invalidation_matrix(data_dir, oracle, tmp_path, mutation):
+    """A file appended, rewritten in place (same size), mtime-only
+    touched, or deleted must all drop the entry and fall through to a
+    real execution whose result matches the CPU engine over the
+    MUTATED inputs — never the stale cached bytes."""
+    from spark_rapids_tpu.plan_cache import PLAN_CACHE
+    from spark_rapids_tpu.serve import ServeClient
+    li = tmp_path / "lineitem"
+    shutil.copytree(str(data_dir / "lineitem"), str(li))
+    for aux in ("fact", "dim"):
+        shutil.copytree(str(data_dir / aux), str(tmp_path / aux))
+    srv = _server(tmp_path)
+    try:
+        with ServeClient(srv.port, tenant="dash") as c:
+            base, h0 = c.sql(Q1S)
+            assert [tuple(r) for r in base.rows()] == oracle["q1"]
+            _, h1 = c.sql(Q1S)
+            assert h1["resultCacheHit"], "cache must be warm pre-mutation"
+
+            part = str(li / _part_files(str(li))[0])
+            if mutation == "append":
+                shutil.copy(part, str(li / "part-zz-extra.parquet"))
+            elif mutation == "rewrite":
+                # identical bytes rewritten in place: size unchanged,
+                # mtime_ns changes — content COULD have changed, so the
+                # cache must not trust it
+                with open(part, "rb") as f:
+                    blob = f.read()
+                time.sleep(0.01)
+                with open(part, "wb") as f:
+                    f.write(blob)
+            elif mutation == "touch":
+                st = os.stat(part)
+                os.utime(part, ns=(st.st_atime_ns,
+                                   st.st_mtime_ns + 1_000_000))
+            else:
+                os.remove(part)
+            # drop the (path-keyed) plan template too, so the forced
+            # re-execution re-lists and the CPU comparison below runs
+            # over the mutated directory on both engines
+            PLAN_CACHE.clear()
+
+            fresh, h2 = c.sql(Q1S)
+            assert not h2.get("resultCacheHit"), mutation
+            rows = [tuple(r) for r in fresh.rows()]
+            assert rows == _serial_rows(tmp_path, Q1S,
+                                        enabled="false"), mutation
+            if mutation in ("touch", "rewrite"):
+                # content unchanged -> same answer, still re-executed
+                assert rows == oracle["q1"]
+            st = srv.stats()["cache"]["result"]
+            assert st["invalidations"] >= 1
+            # the re-execution repopulated with CURRENT fingerprints
+            _, h3 = c.sql(Q1S)
+            assert h3["resultCacheHit"]
+    finally:
+        srv.shutdown()
+
+
+def test_register_view_invalidates(data_dir, oracle):
+    from spark_rapids_tpu.serve import ServeClient
+    srv = _server(data_dir)
+    try:
+        with ServeClient(srv.port, tenant="a") as c:
+            c.collect(Q1S)
+            _, h = c.sql(Q1S)
+            assert h["resultCacheHit"]
+        # re-pointing ANY view bumps the generation: nothing cached
+        # before it may be served after it
+        srv.register_view("lineitem", str(data_dir / "lineitem"))
+        with ServeClient(srv.port, tenant="a") as c:
+            _, h = c.sql(Q1S)
+            assert not h.get("resultCacheHit")
+            assert [tuple(r) for r in c.sql(Q1S)[0].rows()] \
+                == oracle["q1"]
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# c=16 mixed tenants, fault injection: cache-on == cache-off == CPU
+# ---------------------------------------------------------------------------
+
+def test_parity_concurrent_mixed_tenants_fault_injection(data_dir,
+                                                         oracle):
+    """16 concurrent mixed q1/q3 requests across 4 tenants, with OOM
+    injection exercising the retry path underneath: every response —
+    cold, cached, or retried — must be bit-identical to the serial
+    cache-off oracle (which the oracle fixture cross-checks against
+    the CPU engine)."""
+    from spark_rapids_tpu.serve import ServeClient
+    srv = _server(data_dir,
+                  **{"spark.rapids.sql.subplanCache.enabled": "true",
+                     "spark.rapids.sql.serve.maxConcurrentQueries": 8,
+                     "spark.rapids.sql.serve.maxQueued": 64,
+                     "spark.rapids.sql.serve.maxConcurrentPerTenant": 8,
+                     "spark.rapids.sql.test.injectOOM": "5"})
+    errors: list = []
+    results: dict = {}
+
+    def worker(i: int) -> None:
+        try:
+            with ServeClient(srv.port, tenant=f"t{i % 4}") as c:
+                kind = "q1" if i % 2 == 0 else "q3"
+                rows = c.collect(Q1S if kind == "q1" else Q3S)
+                results[i] = (kind, rows)
+        except Exception as e:  # noqa: BLE001 - surfaced by the assert
+            errors.append((i, repr(e)))
+
+    try:
+        # prime both shapes so the concurrent wave mixes cached hits
+        # with (retried) executions on the same connections
+        with ServeClient(srv.port, tenant="prime") as c:
+            assert c.collect(Q1S) == oracle["q1"]
+            assert c.collect(Q3S) == oracle["q3"]
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        assert not errors, errors
+        assert len(results) == 16
+        for kind, rows in results.values():
+            assert rows == oracle[kind], (
+                f"{kind} diverged from the cache-off oracle")
+        rc = srv.stats()["cache"]["result"]
+        # both shapes were primed: the wave must be cache-served
+        assert rc["hits"] >= 1
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Subplan cache: build-table reuse with parity, metric, cross-session
+# ---------------------------------------------------------------------------
+
+def test_subplan_cache_reuse_parity_and_metric(data_dir, oracle):
+    conf = {"spark.rapids.sql.enabled": "true",
+            "spark.rapids.sql.batchSizeRows": "512",
+            "spark.rapids.sql.subplanCache.enabled": "true"}
+    spark = TpuSparkSession(conf)
+    try:
+        _register_views(spark, data_dir)
+        first = [tuple(r) for r in spark.sql(Q3S)._execute().rows()]
+        assert first == oracle["q3"]
+        sp0 = RC.subplan_cache_stats()
+        assert sp0 is not None and sp0["entries"] >= 1
+        again = [tuple(r) for r in spark.sql(Q3S)._execute().rows()]
+        assert again == oracle["q3"]
+        sp1 = RC.subplan_cache_stats()
+        assert sp1["hits"] >= sp0["hits"] + 1
+    finally:
+        spark.stop()
+    # a DIFFERENT session sharing the build-side subtree reuses the
+    # same device-resident table (cross-query/cross-tenant sharing)
+    spark2 = TpuSparkSession(conf)
+    try:
+        _register_views(spark2, data_dir)
+        h_before = RC.subplan_cache_stats()["hits"]
+        cross = [tuple(r) for r in spark2.sql(Q3S)._execute().rows()]
+        assert cross == oracle["q3"]
+        assert RC.subplan_cache_stats()["hits"] >= h_before + 1
+    finally:
+        spark2.stop()
+
+
+def test_subplan_cache_fingerprint_invalidation(data_dir, oracle,
+                                                tmp_path):
+    for name in ("lineitem", "fact", "dim"):
+        shutil.copytree(str(data_dir / name), str(tmp_path / name))
+    conf = {"spark.rapids.sql.enabled": "true",
+            "spark.rapids.sql.batchSizeRows": "512",
+            # the plan cache serves the frozen template, so the second
+            # run probes under the SAME subplan key and the re-stat is
+            # the only thing standing between it and a stale reuse
+            "spark.rapids.sql.planCache.enabled": "true",
+            "spark.rapids.sql.subplanCache.enabled": "true"}
+    spark = TpuSparkSession(conf)
+    try:
+        _register_views(spark, tmp_path)
+        assert [tuple(r) for r in spark.sql(Q3S)._execute().rows()] \
+            == oracle["q3"]
+        # touch a build-side (dim) file: the plan cache still serves
+        # the same template (same subplan key), so the next probe finds
+        # the entry, re-stats, sees the mtime change, and must DROP it
+        # instead of reusing the build table
+        dim = str(tmp_path / "dim")
+        part = os.path.join(dim, _part_files(dim)[0])
+        st = os.stat(part)
+        os.utime(part, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+        inv0 = RC.subplan_cache_stats()["invalidations"]
+        assert [tuple(r) for r in spark.sql(Q3S)._execute().rows()] \
+            == oracle["q3"]
+        assert RC.subplan_cache_stats()["invalidations"] >= inv0 + 1
+    finally:
+        spark.stop()
+
+
+# ---------------------------------------------------------------------------
+# Evict-first: pool pressure drops cache entries before any live spill
+# ---------------------------------------------------------------------------
+
+def _batch(n=256, seed=0):
+    from spark_rapids_tpu.columnar.device import DeviceBatch
+    from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+    from spark_rapids_tpu.sql import types as T
+    rng = np.random.default_rng(seed)
+    col = HostColumn(T.LongT, rng.integers(0, 1 << 40, n),
+                     np.ones(n, dtype=bool))
+    return DeviceBatch.from_host(
+        HostBatch(T.StructType([T.StructField("v", T.LongT)]), [col], n))
+
+
+def test_cache_entries_drop_before_live_spill(tmp_path):
+    """Under device pressure the store must DROP cache-tier entries
+    (release, no spill IO) before demoting any live query's batch —
+    even when the live batch is the LRU-oldest."""
+    b_live, b_cache, b_new = _batch(256, 1), _batch(256, 2), \
+        _batch(256, 3)
+    budget = b_live.sizeof() * 2 + 10
+    store = MEM.DeviceStore(budget, 1 << 30, str(tmp_path))
+    h_live = store.register(b_live, owner="query")
+    h_cache = store.register(b_cache, owner="subplanCache",
+                             cache_entry=True)
+    store.register(b_new, owner="query")  # over budget -> enforce
+    assert store.cache_drop_count == 1
+    assert store.cache_dropped_bytes > 0
+    assert store.spill_count == 0, \
+        "a live batch spilled while a cache entry was resident"
+    assert h_cache.closed
+    # the live batch survived on device, bit-intact
+    got = np.asarray(h_live.get().columns[0].data)[:256]
+    assert (got == np.asarray(b_live.columns[0].data)[:256]).all()
+    st = store.stats()
+    assert st["cacheDropCount"] == 1 and st["cacheDroppedBytes"] > 0
+
+
+def test_subplan_cache_observes_pressure_drop_as_eviction(tmp_path):
+    """A pool-dropped build table is a MISS (counted as an eviction)
+    at the owning cache's next lookup, never an error."""
+    store = MEM.DeviceStore(1 << 30, 1 << 30, str(tmp_path))
+    cache = RC.SubplanCache(max_entries=8, max_bytes=1 << 30)
+    b = _batch(128, 7)
+    src = tmp_path / "src.bin"
+    src.write_bytes(b"x" * 64)
+    paths = (str(src),)
+    captured = (paths, RC.source_fingerprints(paths))
+    assert cache.put("k1", captured, b, store)
+    assert cache.lookup("k1") is not None
+    # the pool drops the entry out from under the cache
+    store.spill_device_down(0)
+    assert store.cache_drop_count == 1
+    ev0 = cache.evictions
+    assert cache.lookup("k1") is None
+    st = cache.stats()
+    assert cache.evictions == ev0 + 1
+    assert st["entries"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Cancelled while serving a cached hit
+# ---------------------------------------------------------------------------
+
+def test_cancelled_while_cached_hit_returns_cleanly(data_dir, oracle):
+    from spark_rapids_tpu.serve import ServeClient
+    from spark_rapids_tpu.serve.client import ServeCancelled
+    srv = _server(data_dir)
+    try:
+        with ServeClient(srv.port, tenant="a") as c:
+            c.collect(Q1S)  # populate
+        started, release = threading.Event(), threading.Event()
+        orig = srv._result_cache.lookup
+
+        def parked_lookup(sql):
+            entry = orig(sql)
+            if entry is not None:
+                started.set()
+                release.wait(timeout=30)
+            return entry
+
+        srv._result_cache.lookup = parked_lookup
+        outcome: list = []
+
+        def submitter():
+            try:
+                with ServeClient(srv.port, tenant="a") as c:
+                    c.sql(Q1S, query_id="q-cached")
+                    outcome.append(("ok", None))
+            except ServeCancelled as e:
+                outcome.append(("cancelled", e))
+            except Exception as e:  # noqa: BLE001 - asserted below
+                outcome.append(("error", repr(e)))
+
+        t = threading.Thread(target=submitter)
+        t.start()
+        assert started.wait(timeout=30), "hit never reached the cache"
+        from spark_rapids_tpu.serve import ServeClient as SC
+        with SC(srv.port, tenant="a") as killer:
+            assert killer.cancel(query_id="q-cached") == 1
+        release.set()
+        t.join(timeout=60)
+        srv._result_cache.lookup = orig
+        assert outcome and outcome[0][0] == "cancelled", outcome
+        assert outcome[0][1].where == "cached"
+        # the connection protocol stayed synchronized: the SAME server
+        # keeps serving, and the entry is still valid
+        with ServeClient(srv.port, tenant="a") as c:
+            rows, h = c.sql(Q1S)
+            assert h["resultCacheHit"]
+            assert [tuple(r) for r in rows.rows()] == oracle["q1"]
+        assert srv.stats()["queriesCancelled"] == 1
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# History / SLO / doctor math excludes cache-served walls
+# ---------------------------------------------------------------------------
+
+def _rec(ts, sig="a" * 40, status="finished", wall=0.1, **kw):
+    r = {"version": 1, "ts": ts, "signature": sig, "status": status,
+         "wallSeconds": wall, "queueWaitSeconds": 0.0,
+         "outputRows": 10}
+    r.update(kw)
+    return r
+
+
+def test_signature_aggregates_exclude_cached_walls():
+    from spark_rapids_tpu.telemetry import history as H
+    t0 = time.time()
+    recs = [_rec(t0 + i, wall=2.0, tenant="t") for i in range(3)]
+    recs += [_rec(t0 + 10 + i, wall=0.001, tenant="t",
+                  resultCacheHit=True) for i in range(5)]
+    a = H.signature_aggregates(recs)["a" * 40]
+    # cached records count in the histogram but not the latency math
+    assert a["count"] == 8
+    assert a["wallP50"] == pytest.approx(2.0)
+    assert a["wallP99"] == pytest.approx(2.0)
+
+
+def test_slo_window_excludes_cached_queries(tmp_path):
+    from spark_rapids_tpu.conf import TpuConf
+    from spark_rapids_tpu.telemetry import history as H
+    d = str(tmp_path / "hist")
+    store = H.HistoryStore(d, max_bytes=1 << 20, max_age_days=14)
+    now = time.time()
+    for i in range(3):
+        store.append(_rec(now - 1 - i, wall=0.2, tenant="gold"))
+    for i in range(5):
+        store.append(_rec(now - 1 - i, wall=0.001, tenant="gold",
+                          resultCacheHit=True))
+    slo = H.SloTracker(TpuConf({
+        "spark.rapids.sql.telemetry.history.dir": d,
+        "spark.rapids.sql.serve.slo.p99Ms": "100"}))
+    out = slo.evaluate(max_age_s=0)["gold"]
+    # 3 real 200ms queries burn against the 100ms objective; the 5
+    # near-zero cached hits must not dilute the ratio to 3/8
+    assert out["windowQueries"] == 3
+    assert out["violations"] == 3
+    assert out["burnRatio"] == pytest.approx(1.0)
+
+
+def test_doctor_baseline_and_warm_start_exclude_cached(tmp_path):
+    from spark_rapids_tpu.conf import TpuConf
+    from spark_rapids_tpu.telemetry import doctor as D
+    from spark_rapids_tpu.telemetry import history as H
+    recs = [_rec(time.time() - 100 + i, wall=3.0) for i in range(4)]
+    recs += [_rec(time.time() - 50 + i, wall=0.001,
+                  resultCacheHit=True) for i in range(6)]
+    target = _rec(time.time(), wall=3.1)
+    base = D._baseline(recs + [target], target)
+    assert base["count"] == 4
+    assert base["wallP50"] == pytest.approx(3.0)
+    # warm start: cached walls never seed the watchdog's p99 history
+    d = str(tmp_path / "hist")
+    store = H.HistoryStore(d, max_bytes=1 << 20, max_age_days=14)
+    for r in recs:
+        store.append(r)
+    out = H.warm_start(TpuConf({
+        "spark.rapids.sql.telemetry.history.dir": d,
+        "spark.rapids.sql.telemetry.history.warmStart": "true"}))
+    assert out["records"] == 10
+    assert out["walls"] == 4
+
+
+# ---------------------------------------------------------------------------
+# Lint-catalog + docs fixtures (satellites: every new name registered)
+# ---------------------------------------------------------------------------
+
+def test_catalogs_cover_cache_names():
+    from spark_rapids_tpu.metrics import METRIC_DESCRIPTIONS
+    from spark_rapids_tpu.telemetry import history as H
+    from spark_rapids_tpu.telemetry.prometheus import SERVER_FAMILY_HELP
+    assert "resultCacheHit" in TR.SPAN_CATALOG
+    assert "cacheEntryDrop" in TR.SPAN_CATALOG
+    assert "resultCacheHit" in H.HISTORY_FIELD_CATALOG
+    assert "subplanCacheHits" in METRIC_DESCRIPTIONS
+    for fam in ("srt_cache_result_hits_total",
+                "srt_cache_result_misses_total",
+                "srt_cache_result_entries",
+                "srt_cache_result_bytes",
+                "srt_cache_result_invalidations_total",
+                "srt_cache_result_evictions_total",
+                "srt_cache_subplan_hits_total",
+                "srt_cache_subplan_misses_total",
+                "srt_cache_subplan_entries",
+                "srt_cache_subplan_bytes",
+                "srt_cache_subplan_invalidations_total",
+                "srt_cache_subplan_evictions_total"):
+        assert fam in SERVER_FAMILY_HELP, fam
+
+
+def test_cache_confs_registered_and_documented():
+    from spark_rapids_tpu.conf import (RESULT_CACHE_ENABLED,
+                                       RESULT_CACHE_MAX_BYTES,
+                                       RESULT_CACHE_MAX_ENTRIES,
+                                       SUBPLAN_CACHE_ENABLED,
+                                       SUBPLAN_CACHE_MAX_BYTES,
+                                       SUBPLAN_CACHE_MAX_ENTRIES,
+                                       TpuConf)
+    c = TpuConf({})
+    assert c.get(RESULT_CACHE_ENABLED) is False
+    assert c.get(SUBPLAN_CACHE_ENABLED) is False
+    assert c.get(RESULT_CACHE_MAX_ENTRIES) == 256
+    assert c.get(RESULT_CACHE_MAX_BYTES) == 256 << 20
+    assert c.get(SUBPLAN_CACHE_MAX_ENTRIES) == 32
+    assert c.get(SUBPLAN_CACHE_MAX_BYTES) == 64 << 20
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "docs", "configs.md")) as f:
+        configs = f.read()
+    assert "spark.rapids.sql.resultCache.enabled" in configs
+    assert "spark.rapids.sql.subplanCache.enabled" in configs
+    with open(os.path.join(root, "docs", "observability.md")) as f:
+        obs = f.read()
+    assert "srt_cache_result_hits_total" in obs
+    assert "resultCacheHit" in obs
+    assert os.path.exists(os.path.join(root, "docs", "caching.md"))
+
+
+def test_cache_confs_excluded_from_plan_signature(data_dir):
+    """resultCache.*/subplanCache.* never change what a plan computes,
+    so cache-on and cache-off runs of one shape share one signature
+    (baselines, quarantine, doctor history)."""
+    sigs = []
+    for extra in ({}, {"spark.rapids.sql.resultCache.enabled": "true",
+                       "spark.rapids.sql.subplanCache.enabled": "true",
+                       "spark.rapids.sql.resultCache.maxEntries": "7"}):
+        conf = {"spark.rapids.sql.enabled": "true",
+                "spark.rapids.sql.batchSizeRows": "512",
+                # signatures are computed on the plan-cache path
+                "spark.rapids.sql.planCache.enabled": "true"}
+        conf.update(extra)
+        spark = TpuSparkSession(conf)
+        try:
+            _register_views(spark, data_dir)
+            spark.sql(Q1S)._execute()
+            sigs.append(spark.thread_plan_signature())
+        finally:
+            spark.stop()
+    assert sigs[0] is not None and sigs[0] == sigs[1]
+
+
+def test_server_stats_and_prometheus_render_cache_section(data_dir):
+    from spark_rapids_tpu.serve import ServeClient
+    from spark_rapids_tpu.telemetry.prometheus import render_prometheus
+    from spark_rapids_tpu.telemetry.top import format_top
+    srv = _server(data_dir,
+                  **{"spark.rapids.sql.subplanCache.enabled": "true"})
+    try:
+        with ServeClient(srv.port, tenant="a") as c:
+            c.collect(Q3S)
+            c.collect(Q3S)
+            st = c.stats()
+        cache = st["cache"]
+        for side in ("result", "subplan"):
+            for k in ("entries", "bytes", "hits", "misses",
+                      "invalidations", "evictions"):
+                assert k in cache[side], (side, k)
+        assert cache["result"]["hits"] >= 1
+        text = render_prometheus(server_stats=st)
+        assert "srt_cache_result_hits_total" in text
+        assert "srt_cache_subplan_entries" in text
+        frame = format_top(st)
+        assert "cache:" in frame and "result" in frame
+    finally:
+        srv.shutdown()
