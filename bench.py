@@ -725,7 +725,7 @@ def run_profile(clean_wall: float, cpu_rows) -> dict:
     }
 
 
-_KERNEL_NAMES = ("groupbyHash", "joinProbe", "murmur3")
+_KERNEL_NAMES = ("groupbyHash", "joinProbe", "murmur3", "decodeFused")
 
 # the q1 agg-drain span families whose EXCLUSIVE self-time the kernel
 # tier targets (ISSUE 11 acceptance: >= 2x on the drain, kernel vs
@@ -783,7 +783,8 @@ def run_kernels(clean_wall: float, cpu_rows) -> dict:
             counters = collect_counters(
                 tpu.get_captured_plans(),
                 tuple(f"kernelDispatchCount.{n}" for n in _KERNEL_NAMES)
-                + tuple(f"kernelFallbacks.{n}" for n in _KERNEL_NAMES))
+                + tuple(f"kernelFallbacks.{n}" for n in _KERNEL_NAMES)
+                + ("deviceDecodePrograms", "deviceDecodedBatches"))
             out = {"wall_s": round(min(times), 4),
                    "kernelDispatchCount": {
                        n: counters[f"kernelDispatchCount.{n}"]
@@ -793,6 +794,13 @@ def run_kernels(clean_wall: float, cpu_rows) -> dict:
                        n: counters[f"kernelFallbacks.{n}"]
                        for n in _KERNEL_NAMES
                        if counters[f"kernelFallbacks.{n}"]}}
+            if counters["deviceDecodedBatches"]:
+                # decode-stage programs billed per device-decoded
+                # batch: 1.0 when every batch ran the fused kernel, the
+                # XLA chain's stage count otherwise (docs/kernels.md)
+                out["decodeProgramsPerBatch"] = round(
+                    counters["deviceDecodePrograms"]
+                    / counters["deviceDecodedBatches"], 4)
             if traced:
                 files = sorted(glob.glob(
                     os.path.join(tdir, "trace-*.json")))
@@ -814,6 +822,67 @@ def run_kernels(clean_wall: float, cpu_rows) -> dict:
         per_kernel[name] = leg(
             {f"spark.rapids.sql.kernel.{name}.enabled": "false"},
             traced=False, runs=1)
+
+    def decode_fused_ab() -> dict:
+        """Fused single-program decode vs the stock XLA chain at equal
+        run counts: the stock ``on`` leg IS the fused leg (decodeFused
+        defaults on), so only the chain side runs fresh."""
+        chain = leg(
+            {"spark.rapids.sql.kernel.decodeFused.enabled": "false"},
+            traced=False, runs=2)
+        ab = {
+            "fused": {
+                "wall_s": on["wall_s"],
+                "programsPerBatch": on.get("decodeProgramsPerBatch")},
+            "chain": {
+                "wall_s": chain["wall_s"],
+                "programsPerBatch": chain.get(
+                    "decodeProgramsPerBatch")},
+        }
+        if on["wall_s"]:
+            ab["wallSpeedup"] = round(
+                chain["wall_s"] / on["wall_s"], 4)
+        return ab
+
+    def autotune_leg() -> dict:
+        """Cold sweep cost vs warm-start zero-cost: a first leg against
+        a fresh tuning dir sweeps each (kernel, bucket) once during
+        warm-up; after a simulated restart (tables dropped, file kept)
+        the second leg must load every winner off disk and perform ZERO
+        sweeps. Totals include session build + warm-up, so the sweep
+        cost shows up in coldTotal_s vs warmTotal_s."""
+        import tempfile
+
+        from spark_rapids_tpu.kernels import autotune as AT
+        d = tempfile.mkdtemp(prefix="bench-kernel-autotune-")
+        extra = {"spark.rapids.sql.kernel.autotune.enabled": "true",
+                 "spark.rapids.sql.kernel.autotune.dir": d}
+        try:
+            AT.reset_for_tests()
+            t0 = time.perf_counter()
+            cold = leg(extra, traced=False, runs=1)
+            cold_total = time.perf_counter() - t0
+            cold_stats = AT.stats()
+            AT.reset_for_tests()  # "restart": memory gone, file kept
+            t0 = time.perf_counter()
+            warm = leg(extra, traced=False, runs=1)
+            warm_total = time.perf_counter() - t0
+            warm_stats = AT.stats()
+            return {
+                "coldWall_s": cold["wall_s"],
+                "coldTotal_s": round(cold_total, 4),
+                "coldSweeps": cold_stats["sweeps"],
+                "rejected": cold_stats["rejected"],
+                "warmWall_s": warm["wall_s"],
+                "warmTotal_s": round(warm_total, 4),
+                "warmSweeps": warm_stats["sweeps"],
+                "warmLoaded": warm_stats["loaded"],
+                "warmHits": warm_stats["hits"],
+            }
+        finally:
+            AT.reset_for_tests()
+            shutil.rmtree(d, ignore_errors=True)
+
     out = {
         "skipped": False,
         "pallasMode": mode,
@@ -822,6 +891,8 @@ def run_kernels(clean_wall: float, cpu_rows) -> dict:
         "kernelsOff": off,
         "oneKernelOff": per_kernel,
         "wallSpeedup": round(off["wall_s"] / on["wall_s"], 4),
+        "decodeFused": decode_fused_ab(),
+        "autotune": autotune_leg(),
     }
     if on.get("aggDrainSelf_s") and off.get("aggDrainSelf_s"):
         out["aggDrainSpeedup"] = round(

@@ -442,13 +442,17 @@ def _stage_direct(batch, cap: int):
     return ("direct", batch.schema, n, spec, np_arrays)
 
 
-def prepare_upload(batch, cap: int):
+def prepare_upload(batch, cap: int, conf=None, metrics=None):
     """Host-side half of an upload (pack/stage, NO device touch): the
     returned opaque token feeds finish_upload. Splitting the phases lets
-    a producer thread pack batch k+1 while batch k's bytes move."""
+    a producer thread pack batch k+1 while batch k's bytes move.
+    ``conf``/``metrics`` (scan path) gate the fused-decode kernel and
+    receive its dispatch/fallback counters; without them the encoded
+    path runs the stock XLA chain uncounted."""
     from spark_rapids_tpu.io.device_decode import EncodedBatch
     if isinstance(batch, EncodedBatch):
-        return prepare_encoded_upload(batch, cap)
+        return prepare_encoded_upload(batch, cap, conf=conf,
+                                      metrics=metrics)
     n = batch.num_rows
     if n < PACKED_MIN_ROWS or any(
             isinstance(f.data_type, (T.ArrayType, T.StructType))
@@ -483,10 +487,11 @@ def start_upload(staged, device: Optional[jax.Device] = None):
         _tag, schema, n, spec, np_arrays = staged
         return ("direct", schema, n, spec, put(np_arrays))
     if staged[0] == "encoded":
-        _tag, schema, n, cap, words, extras, layout, spec = staged
+        (_tag, schema, n, cap, words, extras, layout, spec,
+         fuse) = staged
         dev = put([words, np.asarray(n, dtype=np.int64)] + list(extras))
         return ("encoded", schema, n, cap, words.nbytes, layout, spec,
-                dev)
+                dev, fuse)
     _tag, schema, n, cap, words, extras, layout = staged
     return ("packed", schema, n, cap, words.nbytes, layout,
             put([words] + extras))
@@ -539,7 +544,7 @@ def _pad_pow2(n: int, floor: int = 8) -> int:
     return 1 << (n - 1).bit_length()
 
 
-def prepare_encoded_upload(enc, cap: int):
+def prepare_encoded_upload(enc, cap: int, conf=None, metrics=None):
     """EncodedBatch -> staged token: pads plan tables to pow2 buckets so
     the decode-program cache keys repeat across row groups (the row
     count itself rides as a device scalar, so row groups of any size
@@ -607,8 +612,216 @@ def prepare_encoded_upload(enc, cap: int):
     if nw > len(words):
         words = np.concatenate([words,
                                 np.zeros(nw - len(words), np.int32)])
+    # fuse context: resolved HERE (the host-side half, where the conf
+    # lives) so the device-side finish never touches conf objects; the
+    # params come from the autotuner's warm table (defaults untuned)
+    fuse = None
+    if conf is not None or metrics is not None:
+        fuse = {"enabled": False, "metrics": metrics, "params": {},
+                "tuned": False}
+        from spark_rapids_tpu import kernels as KR
+        if conf is not None and KR.kernel_enabled(conf, "decodeFused"):
+            from spark_rapids_tpu.kernels import autotune as AT
+            params, tuned = AT.params_for(conf, "decodeFused", cap)
+            fuse.update(enabled=True, params=params, tuned=tuned)
     return ("encoded", enc.schema, n, cap, words, extras,
-            tuple(layout), tuple(spec))
+            tuple(layout), tuple(spec), fuse)
+
+
+def _encoded_decode_body(layout: Tuple, cap: int, words, n_arr, extras,
+                         char_chunk: int = 0):
+    """The encoded-decode arithmetic, shared verbatim by the XLA chain
+    (``_build_encoded_decode`` jits it directly) and the fused Pallas
+    kernel (``kernels/decode_fused.py`` executes it inside one
+    ``pallas_call``) — bit-identity between the two paths is
+    structural, not tested-into (the murmur3 kernel's model).
+    ``char_chunk`` bounds the string char-gather's live index matrix
+    (autotunable; 0 = unchunked) without changing a byte."""
+    from spark_rapids_tpu.io.device_decode import (PGE_BSS, PGE_DELTA,
+                                                   PGE_DICT, PGE_DL_STR,
+                                                   PGE_PLAIN_STR)
+    from spark_rapids_tpu.ops import rle as R
+    bytes_all = None
+
+    def get_bytes():
+        nonlocal bytes_all
+        if bytes_all is None:
+            bytes_all = R.bytes_of_words(words)
+        return bytes_all
+
+    active = jnp.arange(cap) < n_arr
+    pos = jnp.arange(cap, dtype=jnp.int64)
+    outs: List[jax.Array] = []
+    cur = 0
+    for ent in layout:
+        if ent[0] == "host":
+            _tag, n_parts = ent
+            outs.extend(extras[cur:cur + n_parts])
+            cur += n_parts
+            continue
+        (_tag, kind, np_dt, elem_bytes, char_cap, npg, ndl, nvr,
+         ndr, dict_shapes, has_plain, has_delta, has_bss,
+         has_slen) = ent
+        dense_start = extras[cur]
+        plain_byte = extras[cur + 1]
+        pg_enc = extras[cur + 2]
+        cur += 3
+        pg_first = None
+        if has_delta:
+            pg_first = extras[cur]
+            cur += 1
+        if ndl:
+            dl = extras[cur:cur + 5]
+            cur += 5
+            dl_v = R.hybrid_lookup(get_bytes(), pos, *dl)
+            validity = (dl_v == 1) & active
+        else:
+            validity = active
+        vr = None
+        if nvr:
+            vr = extras[cur:cur + 5]
+            cur += 5
+        dr = None
+        if ndr:
+            dr = extras[cur:cur + 5]
+            cur += 5
+        slen = None
+        if has_slen:
+            slen = extras[cur]
+            cur += 1
+        dicts = [extras[cur + i] for i in range(len(dict_shapes))]
+        cur += len(dict_shapes)
+
+        j = jnp.clip(R.dense_ranks(validity), 0, cap - 1) \
+            .astype(jnp.int64)
+        if kind == "bool":
+            v = R.hybrid_lookup(get_bytes(), j, *vr)
+            data = jnp.where(validity, v != 0, False)
+            outs.extend([data, validity])
+            continue
+        pg = jnp.clip(
+            jnp.searchsorted(dense_start, j, side="right") - 1,
+            0, npg - 1)
+        local = j - dense_start[pg]
+        enc_pg = pg_enc[pg]
+        didx = None
+        if vr is not None and dict_shapes:
+            didx = jnp.clip(R.hybrid_lookup(get_bytes(), j, *vr),
+                            0, dict_shapes[0][0][0] - 1)
+        if kind == "str":
+            if has_slen:
+                # offset+bytes model (SURVEY.md §7 c), computed in
+                # DENSE coordinates (pos) — each stored value's
+                # footprint counts exactly once even when null rows
+                # repeat a dense index through j: offsets are a
+                # per-page segmented prefix-sum over the byte
+                # footprints (PLAIN values add their 4-byte length
+                # prefix), then one gather builds the char matrix
+                pgd = jnp.clip(
+                    jnp.searchsorted(dense_start, pos,
+                                     side="right") - 1, 0, npg - 1)
+                encd = pg_enc[pgd]
+                sl_d = slen.astype(jnp.int64)
+                lp_d = jnp.where(encd == PGE_PLAIN_STR, 4, 0) \
+                    .astype(jnp.int64)
+                is_str_d = (encd == PGE_PLAIN_STR) \
+                    | (encd == PGE_DL_STR)
+                contrib = jnp.where(is_str_d, sl_d + lp_d, 0)
+                based = jnp.clip(dense_start[pgd], 0, cap - 1)
+                rel_d = R.seg_excl_cumsum(contrib, based)
+                start_d = plain_byte[pgd] + rel_d + lp_d
+                jj = jnp.clip(j, 0, cap - 1)
+                pchars = R.gather_chars_chunked(get_bytes(), start_d[jj],
+                                                sl_d[jj].astype(jnp.int32),
+                                                char_cap, char_chunk)
+                plens = sl_d[jj].astype(jnp.int32)
+            else:
+                pchars = jnp.zeros((cap, char_cap), dtype=jnp.uint8)
+                plens = jnp.zeros(cap, dtype=jnp.int32)
+            if didx is not None:
+                is_dict_pg = enc_pg == PGE_DICT
+                chars = jnp.where(is_dict_pg[:, None],
+                                  dicts[0][didx], pchars)
+                lengths = jnp.where(is_dict_pg,
+                                    dicts[1][didx].astype(jnp.int32),
+                                    plens)
+            else:
+                chars, lengths = pchars, plens
+            chars = jnp.where(validity[:, None], chars, 0)
+            lengths = jnp.where(validity, lengths, 0)
+            outs.extend([chars, lengths, validity])
+            continue
+        if kind == "dec128":
+            if has_plain:
+                off = plain_byte[pg] + local * elem_bytes
+                p_hi, p_lo = R.read_be_limbs(get_bytes(), off,
+                                             elem_bytes)
+            else:
+                p_hi = p_lo = jnp.zeros(cap, dtype=jnp.int64)
+            if didx is not None:
+                is_dict_pg = enc_pg == PGE_DICT
+                hi = jnp.where(is_dict_pg, dicts[0][didx], p_hi)
+                lo = jnp.where(is_dict_pg, dicts[1][didx], p_lo)
+            else:
+                hi, lo = p_hi, p_lo
+            hi = jnp.where(validity, hi, 0)
+            lo = jnp.where(validity, lo, 0)
+            outs.extend([hi, lo, validity])
+            continue
+        # fixed-width scalar kinds: select in the int64 bit domain
+        if has_plain:
+            off = plain_byte[pg] + local * elem_bytes
+            if kind == "dec64":
+                v = R.read_be_signed(get_bytes(), off, elem_bytes)
+            else:
+                v = R.read_le(get_bytes(), off, elem_bytes)
+        else:
+            v = jnp.zeros(cap, dtype=jnp.int64)
+        if has_bss:
+            # BYTE_STREAM_SPLIT: byte j of value i lives at
+            # page_base + j*values_in_page + i
+            stride = jnp.clip(dense_start[pg + 1] - dense_start[pg],
+                              0, cap)
+            b_v = R.read_bss(get_bytes(), plain_byte[pg], stride,
+                             local, elem_bytes)
+            v = jnp.where(enc_pg == PGE_BSS, b_v, v)
+        if has_delta:
+            # DELTA_BINARY_PACKED, in DENSE coordinates (each delta
+            # counts once even when null rows repeat a dense index):
+            # per-value deltas from the miniblock run table,
+            # reconstructed by a per-page segmented prefix-sum off
+            # the page's first_value, then gathered per row
+            pgd = jnp.clip(
+                jnp.searchsorted(dense_start, pos,
+                                 side="right") - 1, 0, npg - 1)
+            encd = pg_enc[pgd]
+            d_raw = R.delta_lookup(get_bytes(), pos, *dr)
+            d_contrib = jnp.where(
+                (encd == PGE_DELTA) & (pos > dense_start[pgd]),
+                d_raw, 0)
+            c = jnp.cumsum(d_contrib)
+            based = jnp.clip(dense_start[pgd], 0, cap - 1)
+            val_d = pg_first[pgd] + (c - c[based])
+            d_v = val_d[jnp.clip(j, 0, cap - 1)]
+            v = jnp.where(enc_pg == PGE_DELTA, d_v, v)
+        if didx is not None:
+            v = jnp.where(enc_pg == PGE_DICT, dicts[0][didx], v)
+        if kind == "f32":
+            data = jax.lax.bitcast_convert_type(
+                v.astype(jnp.int32), jnp.float32)
+            data = jnp.where(validity, data, jnp.float32(0))
+        elif kind == "f64":
+            data = jax.lax.bitcast_convert_type(v, jnp.float64)
+            data = jnp.where(validity, data, jnp.float64(0))
+        else:  # int / dec64: reinterpret low bits into the storage
+            data = v.astype(jnp.dtype(np_dt)) if np_dt != "int64" \
+                else v
+            if np_dt == "int64" and elem_bytes == 4 \
+                    and kind != "dec64":
+                data = v.astype(jnp.int32).astype(jnp.int64)
+            data = jnp.where(validity, data, 0)
+        outs.extend([data, validity])
+    return active, tuple(outs)
 
 
 def _build_encoded_decode(layout: Tuple, cap: int) -> Callable:
@@ -617,200 +830,14 @@ def _build_encoded_decode(layout: Tuple, cap: int) -> Callable:
     The page-encoding class array (pg_enc) selects the decode lane per
     page, so dict / PLAIN / DELTA / BYTE_STREAM_SPLIT / string pages
     can mix freely inside one chunk (dictionary overflow)."""
-    from spark_rapids_tpu.io.device_decode import (PGE_BSS, PGE_DELTA,
-                                                   PGE_DICT, PGE_DL_STR,
-                                                   PGE_PLAIN_STR)
-    from spark_rapids_tpu.ops import rle as R
 
     def fn(words, n_arr, *extras):
-        bytes_all = None
-
-        def get_bytes():
-            nonlocal bytes_all
-            if bytes_all is None:
-                bytes_all = R.bytes_of_words(words)
-            return bytes_all
-
-        active = jnp.arange(cap) < n_arr
-        pos = jnp.arange(cap, dtype=jnp.int64)
-        outs: List[jax.Array] = []
-        cur = 0
-        for ent in layout:
-            if ent[0] == "host":
-                _tag, n_parts = ent
-                outs.extend(extras[cur:cur + n_parts])
-                cur += n_parts
-                continue
-            (_tag, kind, np_dt, elem_bytes, char_cap, npg, ndl, nvr,
-             ndr, dict_shapes, has_plain, has_delta, has_bss,
-             has_slen) = ent
-            dense_start = extras[cur]
-            plain_byte = extras[cur + 1]
-            pg_enc = extras[cur + 2]
-            cur += 3
-            pg_first = None
-            if has_delta:
-                pg_first = extras[cur]
-                cur += 1
-            if ndl:
-                dl = extras[cur:cur + 5]
-                cur += 5
-                dl_v = R.hybrid_lookup(get_bytes(), pos, *dl)
-                validity = (dl_v == 1) & active
-            else:
-                validity = active
-            vr = None
-            if nvr:
-                vr = extras[cur:cur + 5]
-                cur += 5
-            dr = None
-            if ndr:
-                dr = extras[cur:cur + 5]
-                cur += 5
-            slen = None
-            if has_slen:
-                slen = extras[cur]
-                cur += 1
-            dicts = [extras[cur + i] for i in range(len(dict_shapes))]
-            cur += len(dict_shapes)
-
-            j = jnp.clip(R.dense_ranks(validity), 0, cap - 1) \
-                .astype(jnp.int64)
-            if kind == "bool":
-                v = R.hybrid_lookup(get_bytes(), j, *vr)
-                data = jnp.where(validity, v != 0, False)
-                outs.extend([data, validity])
-                continue
-            pg = jnp.clip(
-                jnp.searchsorted(dense_start, j, side="right") - 1,
-                0, npg - 1)
-            local = j - dense_start[pg]
-            enc_pg = pg_enc[pg]
-            didx = None
-            if vr is not None and dict_shapes:
-                didx = jnp.clip(R.hybrid_lookup(get_bytes(), j, *vr),
-                                0, dict_shapes[0][0][0] - 1)
-            if kind == "str":
-                if has_slen:
-                    # offset+bytes model (SURVEY.md §7 c), computed in
-                    # DENSE coordinates (pos) — each stored value's
-                    # footprint counts exactly once even when null rows
-                    # repeat a dense index through j: offsets are a
-                    # per-page segmented prefix-sum over the byte
-                    # footprints (PLAIN values add their 4-byte length
-                    # prefix), then one gather builds the char matrix
-                    pgd = jnp.clip(
-                        jnp.searchsorted(dense_start, pos,
-                                         side="right") - 1, 0, npg - 1)
-                    encd = pg_enc[pgd]
-                    sl_d = slen.astype(jnp.int64)
-                    lp_d = jnp.where(encd == PGE_PLAIN_STR, 4, 0) \
-                        .astype(jnp.int64)
-                    is_str_d = (encd == PGE_PLAIN_STR) \
-                        | (encd == PGE_DL_STR)
-                    contrib = jnp.where(is_str_d, sl_d + lp_d, 0)
-                    based = jnp.clip(dense_start[pgd], 0, cap - 1)
-                    rel_d = R.seg_excl_cumsum(contrib, based)
-                    start_d = plain_byte[pgd] + rel_d + lp_d
-                    jj = jnp.clip(j, 0, cap - 1)
-                    pchars = R.gather_chars(get_bytes(), start_d[jj],
-                                            sl_d[jj].astype(jnp.int32),
-                                            char_cap)
-                    plens = sl_d[jj].astype(jnp.int32)
-                else:
-                    pchars = jnp.zeros((cap, char_cap), dtype=jnp.uint8)
-                    plens = jnp.zeros(cap, dtype=jnp.int32)
-                if didx is not None:
-                    is_dict_pg = enc_pg == PGE_DICT
-                    chars = jnp.where(is_dict_pg[:, None],
-                                      dicts[0][didx], pchars)
-                    lengths = jnp.where(is_dict_pg,
-                                        dicts[1][didx].astype(jnp.int32),
-                                        plens)
-                else:
-                    chars, lengths = pchars, plens
-                chars = jnp.where(validity[:, None], chars, 0)
-                lengths = jnp.where(validity, lengths, 0)
-                outs.extend([chars, lengths, validity])
-                continue
-            if kind == "dec128":
-                if has_plain:
-                    off = plain_byte[pg] + local * elem_bytes
-                    p_hi, p_lo = R.read_be_limbs(get_bytes(), off,
-                                                 elem_bytes)
-                else:
-                    p_hi = p_lo = jnp.zeros(cap, dtype=jnp.int64)
-                if didx is not None:
-                    is_dict_pg = enc_pg == PGE_DICT
-                    hi = jnp.where(is_dict_pg, dicts[0][didx], p_hi)
-                    lo = jnp.where(is_dict_pg, dicts[1][didx], p_lo)
-                else:
-                    hi, lo = p_hi, p_lo
-                hi = jnp.where(validity, hi, 0)
-                lo = jnp.where(validity, lo, 0)
-                outs.extend([hi, lo, validity])
-                continue
-            # fixed-width scalar kinds: select in the int64 bit domain
-            if has_plain:
-                off = plain_byte[pg] + local * elem_bytes
-                if kind == "dec64":
-                    v = R.read_be_signed(get_bytes(), off, elem_bytes)
-                else:
-                    v = R.read_le(get_bytes(), off, elem_bytes)
-            else:
-                v = jnp.zeros(cap, dtype=jnp.int64)
-            if has_bss:
-                # BYTE_STREAM_SPLIT: byte j of value i lives at
-                # page_base + j*values_in_page + i
-                stride = jnp.clip(dense_start[pg + 1] - dense_start[pg],
-                                  0, cap)
-                b_v = R.read_bss(get_bytes(), plain_byte[pg], stride,
-                                 local, elem_bytes)
-                v = jnp.where(enc_pg == PGE_BSS, b_v, v)
-            if has_delta:
-                # DELTA_BINARY_PACKED, in DENSE coordinates (each delta
-                # counts once even when null rows repeat a dense index):
-                # per-value deltas from the miniblock run table,
-                # reconstructed by a per-page segmented prefix-sum off
-                # the page's first_value, then gathered per row
-                pgd = jnp.clip(
-                    jnp.searchsorted(dense_start, pos,
-                                     side="right") - 1, 0, npg - 1)
-                encd = pg_enc[pgd]
-                d_raw = R.delta_lookup(get_bytes(), pos, *dr)
-                d_contrib = jnp.where(
-                    (encd == PGE_DELTA) & (pos > dense_start[pgd]),
-                    d_raw, 0)
-                c = jnp.cumsum(d_contrib)
-                based = jnp.clip(dense_start[pgd], 0, cap - 1)
-                val_d = pg_first[pgd] + (c - c[based])
-                d_v = val_d[jnp.clip(j, 0, cap - 1)]
-                v = jnp.where(enc_pg == PGE_DELTA, d_v, v)
-            if didx is not None:
-                v = jnp.where(enc_pg == PGE_DICT, dicts[0][didx], v)
-            if kind == "f32":
-                data = jax.lax.bitcast_convert_type(
-                    v.astype(jnp.int32), jnp.float32)
-                data = jnp.where(validity, data, jnp.float32(0))
-            elif kind == "f64":
-                data = jax.lax.bitcast_convert_type(v, jnp.float64)
-                data = jnp.where(validity, data, jnp.float64(0))
-            else:  # int / dec64: reinterpret low bits into the storage
-                data = v.astype(jnp.dtype(np_dt)) if np_dt != "int64" \
-                    else v
-                if np_dt == "int64" and elem_bytes == 4 \
-                        and kind != "dec64":
-                    data = v.astype(jnp.int32).astype(jnp.int64)
-                data = jnp.where(validity, data, 0)
-            outs.extend([data, validity])
-        return active, tuple(outs)
+        return _encoded_decode_body(layout, cap, words, n_arr, extras)
 
     return jax.jit(fn)
 
 
-def _finish_encoded_upload(token):
-    from spark_rapids_tpu.columnar import device as D
-    _tag, schema, n, cap, nbytes, layout, spec, dev = token
+def _chain_fn(layout, cap: int, nbytes: int):
     # the row count is a DEVICE SCALAR input, not a static shape: row
     # groups of any size share one compiled program per (layout, cap,
     # bucketed-words) key
@@ -818,6 +845,51 @@ def _finish_encoded_upload(token):
     fn = _DECODE_CACHE.get(key)
     if fn is None:
         fn = _DECODE_CACHE.put(key, _build_encoded_decode(layout, cap))
-    active, outs = fn(dev[0], dev[1], *dev[2:])
+    return fn
+
+
+def _finish_encoded_upload(token):
+    from spark_rapids_tpu.columnar import device as D
+    _tag, schema, n, cap, nbytes, layout, spec, dev, fuse = token
+    from spark_rapids_tpu import kernels as KR
+    from spark_rapids_tpu.kernels import decode_fused as DF
+    metrics = fuse.get("metrics") if fuse else None
+    fused = bool(fuse and fuse["enabled"]) \
+        and not KR.is_poisoned("decodeFused", (layout, cap))
+    active = outs = None
+    if fused:
+        params = fuse.get("params") or {}
+        char_chunk = int(params.get("charChunk", 0))
+        key = ("encF", layout, cap, nbytes, char_chunk)
+        try:
+            KR.check_injected_failure("decodeFused")
+            fn = _DECODE_CACHE.get(key)
+            if fn is None:
+                fn = _DECODE_CACHE.put(key, DF.build_fused_decode(
+                    layout, cap, interpret=KR.interpret(),
+                    char_chunk=char_chunk))
+            KR.count_dispatch(metrics, "decodeFused")
+            with KR.dispatch_span("decodeFused", bucket=cap,
+                                  tuned=bool(fuse.get("tuned"))):
+                active, outs = fn(dev[0], dev[1], *dev[2:])
+        except Exception as e:
+            if not KR.is_oracle_fallback_error(e):
+                raise
+            # lowering/compile/dispatch failure: poison this (layout,
+            # cap) and decode THIS batch (and every later one of the
+            # shape) on the stock XLA chain — bit-identical either way
+            KR.poison("decodeFused", (layout, cap))
+            KR.count_fallback(metrics, "decodeFused")
+            fused = False
+            active = outs = None
+    if outs is None:
+        fn = _chain_fn(layout, cap, nbytes)
+        active, outs = fn(dev[0], dev[1], *dev[2:])
+    if metrics is not None:
+        # programs-per-batch attribution for the fused A/B: the chain
+        # bills its static per-layout logical stage count, the fused
+        # kernel bills 1 (bench divides by deviceDecodedBatches)
+        metrics.create("deviceDecodePrograms").add(
+            1 if fused else DF.chain_programs(layout))
     return D.DeviceBatch(schema, D.rebuild_columns(list(spec), outs),
                          active, n)

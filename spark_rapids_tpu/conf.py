@@ -807,6 +807,47 @@ KERNEL_MURMUR3 = conf("spark.rapids.sql.kernel.murmur3.enabled").doc(
     "used by the in-process hash exchange (docs/kernels.md)."
     ).boolean(True)
 
+KERNEL_DECODE_FUSED = conf(
+    "spark.rapids.sql.kernel.decodeFused.enabled").doc(
+    "Fused Parquet decode kernel: collapse the per-batch encoded-scan "
+    "decode chain (RLE/bit-unpack, dictionary gather, definition-level "
+    "validity expansion, byte-array offsets-from-lengths + char "
+    "gather) into ONE Pallas kernel per (layout, capacity bucket), "
+    "behind the same uploadDecode cache keys. The stock XLA "
+    "composition stays the bit-identity oracle and the per-call "
+    "fallback on any lowering/compile/dispatch failure "
+    "(kernelFallbacks.decodeFused); host-decoded columns pass through "
+    "outside the kernel untouched (docs/kernels.md).").boolean(True)
+
+KERNEL_AUTOTUNE_ENABLED = conf(
+    "spark.rapids.sql.kernel.autotune.enabled").doc(
+    "Per-kernel parameter autotuner (docs/kernels.md): the first "
+    "dispatch of a kernel at a new (kernel, shape bucket, device kind) "
+    "sweeps a small bounded parameter grid (block shapes, tableSlots "
+    "multiplier, char-gather chunking), validates every candidate "
+    "against the kernel's oracle, and persists the winner in the "
+    "crash-safe table under kernel.autotune.dir. Off (the default) = "
+    "read-only: previously recorded winners still apply, but no sweep "
+    "ever runs — production servers against a warmed table never "
+    "re-tune.").boolean(False)
+
+KERNEL_AUTOTUNE_DIR = conf("spark.rapids.sql.kernel.autotune.dir").doc(
+    "Directory of the autotuner's persistent winner table "
+    "(kernel-autotune.jsonl, append-only JSON lines next to the "
+    "JitCache artifacts): loaded once per process at first use, so a "
+    "second session against the same directory performs zero sweeps. "
+    "Torn or garbage lines are skipped on load; an unreadable table "
+    "falls back to default parameters. Empty = autotuning fully off "
+    "(defaults everywhere).").string("")
+
+KERNEL_AUTOTUNE_BUDGET_MS = conf(
+    "spark.rapids.sql.kernel.autotune.budgetMs").doc(
+    "Wall budget in milliseconds for ONE autotune sweep (one kernel at "
+    "one shape bucket): candidate timing stops once the budget is "
+    "spent and the best validated candidate so far wins. Bounds the "
+    "cold-start cost a sweep can add to the first query at a new "
+    "shape.").integer(2000)
+
 PARQUET_DEVICE_DECODE_MAX_IN_FLIGHT = conf(
     "spark.rapids.sql.format.parquet.deviceDecode.maxInFlight").doc(
     "Scan upload pipeline depth: how many staged scan batches may have "

@@ -272,7 +272,8 @@ class TpuHashAggregateExec(TpuExec):
                   prims: List[Tuple[str, T.DataType]],
                   has_nans: bool, prelude_steps=None,
                   donate: bool = False,
-                  kernel_slots: Optional[int] = None) -> Callable:
+                  kernel_slots: Optional[int] = None,
+                  kernel_params: Optional[dict] = None) -> Callable:
         aliases = self._agg_aliases()
         slot_counts = [len(self.slots[a.expr_id]) for a in aliases]
         grouping = self.grouping
@@ -325,7 +326,7 @@ class TpuHashAggregateExec(TpuExec):
                            for j, (p, dt) in zip(src_map, prims)]
                 key_out, buffers, used, cnt, ovf = KG.hash_groupby(
                     key_cols, entries, active, kernel_slots,
-                    has_nans=has_nans)
+                    has_nans=has_nans, params=kernel_params)
                 out_cols = list(key_out if grouping else []) \
                     + list(buffers)
                 flat2, spec2 = flatten_columns(out_cols)
@@ -479,12 +480,23 @@ class TpuHashAggregateExec(TpuExec):
         from spark_rapids_tpu import kernels as KR
         from spark_rapids_tpu.kernels import groupby_hash as KG
         kern_slots = None
+        kern_params: dict = {}
+        kern_tuned = False
         if (not force_oracle
                 and KR.kernel_enabled(self.conf, "groupbyHash")
                 and KG.agg_kernel_eligible(mode, self.grouping,
                                            slot_srcs, prims)
                 and not KR.is_poisoned("groupbyHash", struct)):
-            kern_slots = KR.table_slots(self.conf, batch.capacity)
+            # per-bucket tuning from the autotuner's warm table (the
+            # defaults when untuned); slotsMult scales the table bound
+            # BEFORE the batch clamp so tuning can trade VMEM for
+            # fewer overflow re-runs
+            from spark_rapids_tpu.kernels import autotune as AT
+            kern_params, kern_tuned = AT.params_for(
+                self.conf, "groupbyHash", batch.capacity)
+            kern_slots = KR.table_slots(
+                self.conf, batch.capacity,
+                slots_mult=int(kern_params.get("slotsMult", 1)))
         if prelude:
             from spark_rapids_tpu.exec.fused import batch_donatable
             # per-batch: aliased buffers (one array on two pytree
@@ -507,14 +519,23 @@ class TpuHashAggregateExec(TpuExec):
         chip = TR.chip_of(batch)  # None (no device query) when untraced
         import time as _time
 
+        kp_key = tuple(sorted(kern_params.items()))
+
         def _get_fn(kslots):
+            # tuning parameters are part of the program structure (a
+            # different block shape is a different trace), so they key
+            # the cache alongside the slot count
             return _AGG_FN_CACHE.get_or_build(
-                struct + (donate, kslots),
+                struct + (donate, kslots)
+                + (kp_key if kslots is not None else ()),
                 lambda: self._build_fn(mode, key_bound, slot_srcs,
                                        prims, has_nans=salt[0],
                                        prelude_steps=prelude_steps,
                                        donate=donate,
-                                       kernel_slots=kslots))
+                                       kernel_slots=kslots,
+                                       kernel_params=(kern_params
+                                                      if kslots is not None
+                                                      else None)))
 
         fn, was_miss = _get_fn(kern_slots)
         mirror_to_metrics(_AGG_FN_CACHE, self.metrics, was_miss)
@@ -551,7 +572,8 @@ class TpuHashAggregateExec(TpuExec):
             # below — trace and metrics agree (docs/observability.md)
             qt.add("TpuHashAggregateExec.dispatch", t0, t0 + elapsed,
                    chip=chip, mode=mode, compile=bool(was_miss),
-                   **({"kernel": "groupbyHash"}
+                   **({"kernel": "groupbyHash",
+                       "bucket": batch.capacity, "tuned": kern_tuned}
                       if kern_slots is not None else {}))
         if was_miss:
             # first call after a compile miss carries trace+XLA compile

@@ -378,7 +378,8 @@ class TpuRowToColumnarExec(TpuExec):
             # cost: at most one extra host copy per in-flight upload
             # (the 1-deep prefetch bounds this at 2 per stream), freed
             # as soon as _finish returns
-            return whole.num_rows, prepare_upload(whole, cap), whole
+            return whole.num_rows, prepare_upload(
+                whole, cap, conf=self.conf, metrics=metrics), whole
 
     def _finish(self, prepared, sem, metrics,
                 device=None) -> List[DeviceBatch]:

@@ -43,12 +43,16 @@ KERNELS: Dict[str, str] = {
     "joinProbe": "hash-table build/probe gather map (semi/anti joins "
                  "+ the FK unique-build-key fast path)",
     "murmur3": "fused Spark Murmur3_x86_32 partition hashing",
+    "decodeFused": "single-program fused Parquet page decode "
+                   "(RLE/bit-unpack + dict gather + validity expansion "
+                   "+ string offsets/chars)",
 }
 
 _CONF_OF = {
     "groupbyHash": "spark.rapids.sql.kernel.groupbyHash.enabled",
     "joinProbe": "spark.rapids.sql.kernel.joinProbe.enabled",
     "murmur3": "spark.rapids.sql.kernel.murmur3.enabled",
+    "decodeFused": "spark.rapids.sql.kernel.decodeFused.enabled",
 }
 
 
@@ -145,20 +149,24 @@ def count_fallback(metrics, name: str) -> None:
 
 
 @_contextlib.contextmanager
-def dispatch_span(name: str, chip=None):
+def dispatch_span(name: str, chip=None, **attrs):
     """Trace span for one kernel dispatch (`kernel=<name>` attr + chip
-    id), so profiles attribute kernel vs oracle time (docs/kernels.md)."""
+    id), so profiles attribute kernel vs oracle time (docs/kernels.md).
+    Extra attrs (shape bucket, tuned flag) ride along for the hotspots
+    per-bucket split."""
     from spark_rapids_tpu import trace as TR
-    with TR.span("kernelDispatch", chip=chip, kernel=name):
+    with TR.span("kernelDispatch", chip=chip, kernel=name, **attrs):
         yield
 
 
-def table_slots(conf, cap: int) -> int:
-    """Group-by table capacity: the conf bound, shrunk toward the batch
-    (a 64-row batch cannot have 1024 groups) and rounded to a power of
+def table_slots(conf, cap: int, slots_mult: int = 1) -> int:
+    """Group-by table capacity: the conf bound (scaled by the
+    autotuner's per-bucket multiplier), shrunk toward the batch (a
+    64-row batch cannot have 1024 groups) and rounded to a power of
     two (the kernel masks slot indices)."""
     from spark_rapids_tpu.conf import KERNEL_GROUPBY_TABLE_SLOTS
-    want = min(int(conf.get(KERNEL_GROUPBY_TABLE_SLOTS)),
+    want = min(int(conf.get(KERNEL_GROUPBY_TABLE_SLOTS))
+               * max(1, int(slots_mult)),
                max(2 * cap, 64))
     t = 64
     while t < want:

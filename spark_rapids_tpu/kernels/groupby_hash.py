@@ -278,6 +278,36 @@ def insert_step(kw, rows, slot, done, tbl_kw, tbl_used, tbl_row,
     return hit, tbl_kw, tbl_used, tbl_row
 
 
+def _probe_rows(kw, h, valid, rows, tbl_kw, tbl_used, tbl_row,
+                T_: int, K: int):
+    """The bounded insert/probe loop over one row block — shared
+    verbatim by the whole-array kernel and the tiled kernel so their
+    table state transitions are structurally identical (bit-identity
+    between the two is by construction, like ``insert_step``).
+    Returns ``(done, fslot, tbl_kw, tbl_used, tbl_row)``."""
+    slot0 = (h & (T_ - 1)).astype(jnp.int32)
+
+    def probe_cond(st):
+        _s, done, _f, _tk, _tu, _tr, it = st
+        return jnp.any(~done) & (it < _MAX_PROBES)
+
+    def probe_body(st):
+        slot, done, fslot, tbl_kw, tbl_used, tbl_row, it = st
+        hit, tbl_kw, tbl_used, tbl_row = insert_step(
+            kw, rows, slot, done, tbl_kw, tbl_used, tbl_row, T_, K)
+        fslot = jnp.where(hit, slot, fslot)
+        done = done | hit
+        slot = jnp.where(done, slot, (slot + 1) & (T_ - 1))
+        return slot, done, fslot, tbl_kw, tbl_used, tbl_row, it + 1
+
+    (_slot, done, fslot, tbl_kw, tbl_used, tbl_row,
+     _it) = jax.lax.while_loop(
+         probe_cond, probe_body,
+         (slot0, ~valid, jnp.zeros_like(slot0),
+          tbl_kw, tbl_used, tbl_row, jnp.int32(0)))
+    return done, fslot, tbl_kw, tbl_used, tbl_row
+
+
 def _build_kernel(cap: int, K: int, n_add: int, n_min: int, n_max: int,
                   slots: int, interpret: bool) -> Callable:
     """The pallas_call wrapper: (kw, h, valid, add?, min?, max?) ->
@@ -325,28 +355,8 @@ def _build_kernel(cap: int, K: int, n_add: int, n_min: int, n_max: int,
             valid = valid_ref[pl.ds(off, RB)]
             rows = off + jax.lax.broadcasted_iota(
                 jnp.int32, (RB, 1), 0)[:, 0]
-            slot0 = (h & (T_ - 1)).astype(jnp.int32)
-
-            def probe_cond(st):
-                _s, done, _f, _tk, _tu, _tr, it = st
-                return jnp.any(~done) & (it < _MAX_PROBES)
-
-            def probe_body(st):
-                slot, done, fslot, tbl_kw, tbl_used, tbl_row, it = st
-                hit, tbl_kw, tbl_used, tbl_row = insert_step(
-                    kw, rows, slot, done, tbl_kw, tbl_used, tbl_row,
-                    T_, K)
-                fslot = jnp.where(hit, slot, fslot)
-                done = done | hit
-                slot = jnp.where(done, slot, (slot + 1) & (T_ - 1))
-                return slot, done, fslot, tbl_kw, tbl_used, tbl_row, \
-                    it + 1
-
-            (_slot, done, fslot, tbl_kw, tbl_used, tbl_row,
-             _it) = jax.lax.while_loop(
-                 probe_cond, probe_body,
-                 (slot0, ~valid, jnp.zeros_like(slot0),
-                  tbl_kw, tbl_used, tbl_row, jnp.int32(0)))
+            done, fslot, tbl_kw, tbl_used, tbl_row = _probe_rows(
+                kw, h, valid, rows, tbl_kw, tbl_used, tbl_row, T_, K)
             ovf = ovf | jnp.any(valid & ~done)
             contrib = valid & done
             idx = jnp.where(contrib, fslot, T_)
@@ -400,14 +410,262 @@ def _build_kernel(cap: int, K: int, n_add: int, n_min: int, n_max: int,
                           interpret=interpret)
 
 
+def _sanitize_tiling(cap: int, n_add: int, block_rows: int,
+                     lane_groups: int) -> Tuple[int, int, int]:
+    """Clamp tuning parameters to shapes the tiled kernel can lower:
+    block rows a power of two dividing the capacity, lane groups that
+    actually split the accumulator matrix, the add width padded to a
+    lane-group multiple. Returns ``(RB, LG, n_add_padded)``."""
+    rb = int(block_rows) if block_rows else _block_rows(cap)
+    rb = max(1, rb)
+    rb = 1 << (rb.bit_length() - 1)
+    rb = min(rb, cap & -cap)
+    lg = max(1, int(lane_groups))
+    if n_add == 0 or lg > n_add:
+        lg = 1
+    return rb, lg, ((n_add + lg - 1) // lg) * lg
+
+
+def _build_kernel_tiled(cap: int, K: int, n_add: int, n_min: int,
+                        n_max: int, slots: int, interpret: bool,
+                        block_rows: int = 0,
+                        lane_groups: int = 1) -> Callable:
+    """The native-tuned variant of ``_build_kernel``: same table state
+    machine (``_probe_rows`` / ``insert_step``), but the batch streams
+    through a ``(lane_groups, cap // RB)`` grid of VMEM-sized blocks
+    instead of one whole-array body. The grid's BlockSpec pipeline
+    double-buffers the key/accumulator tile DMAs behind the probe
+    compute, the tables persist in VMEM scratch across the sequential
+    block steps, and the lane-group dimension is ``parallel`` so
+    megacore splits the accumulator columns across cores (each group
+    re-probes — the table build is cheap next to the DMA volume).
+    Output signature matches ``_build_kernel`` exactly."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    RB, LG, n_add_p = _sanitize_tiling(cap, n_add, block_rows,
+                                       lane_groups)
+    GA = n_add_p // LG if n_add_p else 0
+    nb = cap // RB
+    T_ = slots
+    n_in = 3 + (1 if n_add else 0) + (1 if n_min else 0) \
+        + (1 if n_max else 0)
+    n_out = 3 + (1 if n_add else 0) + (1 if n_min else 0) \
+        + (1 if n_max else 0)
+
+    def kern(*refs):
+        ins = refs[:n_in]
+        outs = refs[n_in:n_in + n_out]
+        scr = refs[n_in + n_out:]
+        kw_ref, h_ref, valid_ref = ins[:3]
+        ii = 3
+        add_ref = mnr = mxr = None
+        if n_add:
+            add_ref = ins[ii]
+            ii += 1
+        if n_min:
+            mnr = ins[ii]
+            ii += 1
+        if n_max:
+            mxr = ins[ii]
+            ii += 1
+        row_ref, used_ref = outs[:2]
+        oo = 2
+        add_out_ref = mno = mxo = None
+        if n_add:
+            add_out_ref = outs[oo]
+            oo += 1
+        if n_min:
+            mno = outs[oo]
+            oo += 1
+        if n_max:
+            mxo = outs[oo]
+            oo += 1
+        ovf_ref = outs[oo]
+        si = 0
+        s_kw, s_used, s_row = scr[:3]
+        si = 3
+        s_add = s_min = s_max = None
+        if n_add:
+            s_add = scr[si]
+            si += 1
+        if n_min:
+            s_min = scr[si]
+            si += 1
+        if n_max:
+            s_max = scr[si]
+            si += 1
+        s_ovf = scr[si]
+
+        b = pl.program_id(1)
+
+        @pl.when(b == 0)
+        def _init():
+            s_kw[...] = jnp.zeros((T_ + 1, K), jnp.int64)
+            s_used[...] = jnp.zeros((T_ + 1,), jnp.bool_)
+            s_row[...] = jnp.zeros((T_ + 1,), jnp.int32)
+            if n_add:
+                s_add[...] = jnp.zeros((T_ + 1, GA), jnp.int64)
+            if n_min:
+                s_min[...] = jnp.full((T_ + 1, n_min), _I64_MAX,
+                                      jnp.int64)
+            if n_max:
+                s_max[...] = jnp.full((T_ + 1, n_max), _I64_MIN,
+                                      jnp.int64)
+            s_ovf[...] = jnp.zeros((1,), jnp.bool_)
+
+        kw = kw_ref[...]
+        h = h_ref[...]
+        valid = valid_ref[...]
+        rows = b * RB + jax.lax.broadcasted_iota(
+            jnp.int32, (RB, 1), 0)[:, 0]
+
+        def run(carry):
+            (tbl_kw, tbl_used, tbl_row, tbl_add, tbl_min, tbl_max,
+             ovf) = carry
+            done, fslot, tbl_kw, tbl_used, tbl_row = _probe_rows(
+                kw, h, valid, rows, tbl_kw, tbl_used, tbl_row, T_, K)
+            ovf = ovf | jnp.any(valid & ~done)
+            contrib = valid & done
+            idx = jnp.where(contrib, fslot, T_)
+            if n_add:
+                tbl_add = tbl_add.at[idx].add(add_ref[...])
+            if n_min:
+                tbl_min = tbl_min.at[idx].min(mnr[...])
+            if n_max:
+                tbl_max = tbl_max.at[idx].max(mxr[...])
+            return (tbl_kw, tbl_used, tbl_row, tbl_add, tbl_min,
+                    tbl_max, ovf)
+
+        carry = (s_kw[...], s_used[...], s_row[...],
+                 s_add[...] if n_add
+                 else jnp.zeros((T_ + 1, 0), jnp.int64),
+                 s_min[...] if n_min
+                 else jnp.zeros((T_ + 1, 0), jnp.int64),
+                 s_max[...] if n_max
+                 else jnp.zeros((T_ + 1, 0), jnp.int64),
+                 s_ovf[0])
+        # an overflowed batch re-runs whole on the oracle: skip the
+        # remaining blocks instead of thrashing the full table
+        carry = jax.lax.cond(carry[6], lambda c: c, run, carry)
+        s_kw[...] = carry[0]
+        s_used[...] = carry[1]
+        s_row[...] = carry[2]
+        if n_add:
+            s_add[...] = carry[3]
+        if n_min:
+            s_min[...] = carry[4]
+        if n_max:
+            s_max[...] = carry[5]
+        s_ovf[...] = carry[6].reshape(1)
+
+        # every output block is indexed by the parallel lane-group
+        # dimension, so concurrent cores never write the same HBM
+        # block; the caller reads group 0's copy of the replicated
+        # outputs and concatenates the split accumulator columns
+        @pl.when(b == nb - 1)
+        def _emit():
+            row_ref[0, :] = s_row[...][:T_]
+            used_ref[0, :] = s_used[...][:T_]
+            if n_add:
+                add_out_ref[...] = s_add[...][:T_]
+            if n_min:
+                mno[0] = s_min[...][:T_]
+            if n_max:
+                mxo[0] = s_max[...][:T_]
+            ovf_ref[0, :] = s_ovf[...]
+
+    in_specs = [pl.BlockSpec((RB, K), lambda g, b: (b, 0)),
+                pl.BlockSpec((RB,), lambda g, b: (b,)),
+                pl.BlockSpec((RB,), lambda g, b: (b,))]
+    out_specs = [pl.BlockSpec((1, T_), lambda g, b: (g, 0)),
+                 pl.BlockSpec((1, T_), lambda g, b: (g, 0))]
+    out_shape = [jax.ShapeDtypeStruct((LG, T_), jnp.int32),
+                 jax.ShapeDtypeStruct((LG, T_), jnp.bool_)]
+    scratch = [pltpu.VMEM((T_ + 1, K), jnp.int64),
+               pltpu.VMEM((T_ + 1,), jnp.bool_),
+               pltpu.VMEM((T_ + 1,), jnp.int32)]
+    if n_add:
+        in_specs.append(pl.BlockSpec((RB, GA), lambda g, b: (b, g)))
+        out_specs.append(pl.BlockSpec((T_, GA), lambda g, b: (0, g)))
+        out_shape.append(jax.ShapeDtypeStruct((T_, n_add_p), jnp.int64))
+        scratch.append(pltpu.VMEM((T_ + 1, GA), jnp.int64))
+    if n_min:
+        in_specs.append(pl.BlockSpec((RB, n_min), lambda g, b: (b, 0)))
+        out_specs.append(pl.BlockSpec((1, T_, n_min),
+                                      lambda g, b: (g, 0, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((LG, T_, n_min),
+                                              jnp.int64))
+        scratch.append(pltpu.VMEM((T_ + 1, n_min), jnp.int64))
+    if n_max:
+        in_specs.append(pl.BlockSpec((RB, n_max), lambda g, b: (b, 0)))
+        out_specs.append(pl.BlockSpec((1, T_, n_max),
+                                      lambda g, b: (g, 0, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((LG, T_, n_max),
+                                              jnp.int64))
+        scratch.append(pltpu.VMEM((T_ + 1, n_max), jnp.int64))
+    out_specs.append(pl.BlockSpec((1, 1), lambda g, b: (g, 0)))
+    out_shape.append(jax.ShapeDtypeStruct((LG, 1), jnp.bool_))
+    scratch.append(pltpu.VMEM((1,), jnp.bool_))
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = pltpu.TPUCompilerParams(
+            dimension_semantics=("parallel", "arbitrary"))
+    call = pl.pallas_call(kern, grid=(LG, nb), in_specs=in_specs,
+                          out_specs=out_specs,
+                          out_shape=tuple(out_shape),
+                          scratch_shapes=scratch, interpret=interpret,
+                          **kwargs)
+
+    def wrapper(kw, h, valid, *lanes):
+        args = [kw, h, valid]
+        li = 0
+        if n_add:
+            add = lanes[li]
+            li += 1
+            if n_add_p != n_add:
+                cap_ = add.shape[0]
+                add = jnp.concatenate(
+                    [add, jnp.zeros((cap_, n_add_p - n_add),
+                                    jnp.int64)], axis=1)
+            args.append(add)
+        if n_min:
+            args.append(lanes[li])
+            li += 1
+        if n_max:
+            args.append(lanes[li])
+            li += 1
+        res = list(call(*args))
+        outs = [res[0][0], res[1][0]]
+        oi = 2
+        if n_add:
+            outs.append(res[oi][:, :n_add])
+            oi += 1
+        if n_min:
+            outs.append(res[oi][0])
+            oi += 1
+        if n_max:
+            outs.append(res[oi][0])
+            oi += 1
+        outs.append(res[oi][0])
+        return tuple(outs)
+
+    return wrapper
+
+
 def hash_groupby(key_cols, entries, active: jax.Array, slots: int,
-                 has_nans: Optional[bool] = None):
+                 has_nans: Optional[bool] = None,
+                 params: Optional[dict] = None):
     """Traced single-pass group-by: ``(key_out, buffers, used, cnt,
     overflow)``, all capacity ``slots``. ``entries`` are ``(col, prim,
     out_type)`` like ``seg_sums_batched``; callers pre-check
     ``agg_kernel_eligible``. Output groups sit in table-slot order
     (compacted by the caller); the key columns are gathered from the
-    batch by first-occurrence row, so values round-trip untouched."""
+    batch by first-occurrence row, so values round-trip untouched.
+
+    ``params`` carries the autotuner's per-bucket tuning (blockRows /
+    laneGroups); native lowering always takes the tiled pipelined
+    builder, interpret mode keeps the legacy whole-array kernel (the
+    tier-1 bit-identity baseline) unless params ask for tiling."""
     from spark_rapids_tpu import kernels as KR
     from spark_rapids_tpu.columnar.device import take_columns
     from spark_rapids_tpu.ops import groupby as G
@@ -418,9 +676,20 @@ def hash_groupby(key_cols, entries, active: jax.Array, slots: int,
     kw = pack_words_i64(subkeys)
     h = G.hash_subkey_words(subkeys).view(jnp.int64)
     add_lanes, min_lanes, max_lanes, decode = plan_lanes(entries, active)
-    call = _build_kernel(cap, kw.shape[1], len(add_lanes),
-                         len(min_lanes), len(max_lanes), slots,
-                         KR.interpret())
+    p = dict(params or {})
+    interp = KR.interpret()
+    rb = int(p.get("blockRows", 0))
+    lg = int(p.get("laneGroups", 0))
+    tiled = (not interp) or rb > 0 or lg > 1 or bool(p.get("tiled"))
+    if tiled:
+        call = _build_kernel_tiled(cap, kw.shape[1], len(add_lanes),
+                                   len(min_lanes), len(max_lanes),
+                                   slots, interp, block_rows=rb,
+                                   lane_groups=lg or 1)
+    else:
+        call = _build_kernel(cap, kw.shape[1], len(add_lanes),
+                             len(min_lanes), len(max_lanes), slots,
+                             interp)
     args = [kw, h, active]
     for lanes in (add_lanes, min_lanes, max_lanes):
         if lanes:
@@ -447,3 +716,58 @@ def hash_groupby(key_cols, entries, active: jax.Array, slots: int,
     buffers = decode(add_out, min_out, max_out, used)
     cnt = jnp.sum(used)
     return key_out, buffers, used, cnt, overflow
+
+
+def autotune_probe(params: dict) -> bool:
+    """Oracle validation of one tiled-kernel tuning candidate on a
+    synthetic batch: build the tiled kernel with the candidate's
+    blockRows/laneGroups/slotsMult, run it over random int64 keys with
+    nulls, and compare every per-group sum/min/max against a pure
+    numpy group-by. The autotuner times only candidates that pass —
+    a tuning table can never make the kernel wrong."""
+    cap, K, n_add, n_min, n_max = 512, 1, 3, 1, 1
+    slots = 128 * max(1, int(params.get("slotsMult", 1)))
+    rng = np.random.RandomState(5)
+    keys = rng.randint(0, 50, size=cap).astype(np.int64)
+    valid = rng.rand(cap) < 0.9
+    add = rng.randint(-1000, 1000, size=(cap, n_add)).astype(np.int64)
+    mn = rng.randint(-1000, 1000, size=(cap, n_min)).astype(np.int64)
+    mx = rng.randint(-1000, 1000, size=(cap, n_max)).astype(np.int64)
+    from spark_rapids_tpu import kernels as KR
+    fn = _build_kernel_tiled(cap, K, n_add, n_min, n_max, slots,
+                             KR.interpret(),
+                             block_rows=int(params.get("blockRows", 0)),
+                             lane_groups=int(params.get("laneGroups",
+                                                        1)))
+    row, used, add_out, min_out, max_out, ovf = fn(
+        jnp.asarray(keys)[:, None], jnp.asarray(keys),
+        jnp.asarray(valid),
+        jnp.asarray(add), jnp.asarray(mn), jnp.asarray(mx))
+    if bool(ovf[0]):
+        return False
+    want: dict = {}
+    for i in range(cap):
+        if not valid[i]:
+            continue
+        e = want.setdefault(int(keys[i]),
+                            [np.zeros(n_add, np.int64),
+                             _I64_MAX, _I64_MIN])
+        e[0] = e[0] + add[i]
+        e[1] = min(e[1], mn[i, 0])
+        e[2] = max(e[2], mx[i, 0])
+    used_np = np.asarray(used)
+    row_np = np.asarray(row)
+    got_keys = []
+    for s in range(slots):
+        if not used_np[s]:
+            continue
+        k = int(keys[row_np[s]])
+        got_keys.append(k)
+        e = want.get(k)
+        if e is None:
+            return False
+        if not (np.array_equal(np.asarray(add_out)[s], e[0])
+                and int(np.asarray(min_out)[s, 0]) == e[1]
+                and int(np.asarray(max_out)[s, 0]) == e[2]):
+            return False
+    return sorted(got_keys) == sorted(want.keys())

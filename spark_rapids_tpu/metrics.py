@@ -139,6 +139,10 @@ METRIC_DESCRIPTIONS: Dict[str, str] = {
     "deviceDecodeTime": "host-side half of the device decode path "
                         "(IO, page headers, decode plans)",
     "deviceDecodedBatches": "scan batches decoded on device",
+    "deviceDecodePrograms": "logical decode-stage programs billed per "
+                            "device-decoded batch (1 when the fused "
+                            "kernel ran; the XLA chain's stage count "
+                            "otherwise — docs/kernels.md)",
     "deviceFallbackUnits": "scan units that fell back to host decode",
     "deviceFallbackColumns": "columns that fell back to host decode",
     # scan pipeline (docs/scan.md): producer-thread prefetch + bounded

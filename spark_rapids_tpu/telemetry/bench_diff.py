@@ -51,6 +51,14 @@ CHECKS: List[Tuple[str, str, bool, str]] = [
      "kernel-tier wall speedup"),
     ("detail.kernels.aggDrainSpeedup", "higher", True,
      "q1 agg-drain speedup"),
+    ("detail.kernels.decodeFused.wallSpeedup", "higher", True,
+     "fused-decode wall speedup (fused vs chain)"),
+    ("detail.kernels.decodeFused.fused.programsPerBatch", "lower", True,
+     "fused-decode programs per batch"),
+    ("detail.kernels.autotune.warmSweeps", "lower", True,
+     "autotune warm-start sweeps (zero when the table holds)"),
+    ("detail.kernels.autotune.coldTotal_s", "lower", False,
+     "autotune cold-sweep leg wall"),
     ("detail.serving.concurrency.c1.qps", "higher", True,
      "serving QPS @ c=1"),
     ("detail.serving.concurrency.c4.qps", "higher", True,

@@ -350,12 +350,20 @@ def diagnose(history_dir: str, selector: str) -> Dict[str, Any]:
                     f"(+{r['deltaS']:.3f}s — the divergent stage)")
         verdict("retrySpill", 0.5 + 0.5 * share, ev)
 
-    # kernel-fallback: the oracle ride
+    # kernel-fallback: the oracle ride, with the culprit kernel(s)
+    # named from the record's per-kernel counters so the operator
+    # checks ONE conf instead of the whole kernel tier
     if fallbacks > base["fallbacksMean"] + 0.5:
-        verdict("kernelFallback", 0.4, [
-            f"kernel fallbacks {fallbacks:.0f} vs baseline mean "
-            f"{base['fallbacksMean']:.1f} — check kernel confs / "
-            f"tableSlots"])
+        ev = [f"kernel fallbacks {fallbacks:.0f} vs baseline mean "
+              f"{base['fallbacksMean']:.1f} — check kernel confs / "
+              f"tableSlots"]
+        by_name = target.get("kernelFallbacksByName") or {}
+        for name, n in sorted(by_name.items(),
+                              key=lambda kv: (-kv[1], kv[0])):
+            ev.append(f"{name}: {n:.0f} fallback(s) — check "
+                      f"spark.rapids.sql.kernel.{name}.enabled "
+                      f"and its tuning confs")
+        verdict("kernelFallback", 0.4, ev)
 
     # scan-bound: scan-side stages own the regression
     scan_share = stage_share(_SCAN_FRAGMENTS)

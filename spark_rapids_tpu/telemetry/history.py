@@ -100,6 +100,11 @@ HISTORY_FIELD_CATALOG: Dict[str, str] = {
                         "(sum of kernelDispatchCount.*)",
     "kernelFallbacks": "Pallas kernel oracle fallbacks "
                        "(sum of kernelFallbacks.*)",
+    "kernelFallbacksByName": "per-kernel oracle fallback counts "
+                             "(nonzero kernelFallbacks.<name> entries; "
+                             "present only when any fired) — the "
+                             "doctor's kernelFallback verdict names "
+                             "the culprit kernel(s) from these",
     "jitMisses": "compile-cache misses billed to the query's plan "
                  "(compileCacheMisses)",
     "fallbackCoverage": "rewrite device-operator coverage (0..1) from "
@@ -303,7 +308,7 @@ def _plan_counters(physical) -> Dict[str, Any]:
         return {}
     from spark_rapids_tpu.metrics import registry_snapshot
     vals = registry_snapshot(plans=[physical])["metrics"]
-    return {
+    out = {
         "retryCount": int(vals.get("retryCount", 0)),
         "splitRetryCount": int(vals.get("splitRetryCount", 0)),
         "spillBytes": int(vals.get("spillBytes", 0)),
@@ -315,6 +320,11 @@ def _plan_counters(physical) -> Dict[str, Any]:
             v for k, v in vals.items()
             if k.startswith("kernelFallbacks.")),
     }
+    by_name = {k.split(".", 1)[1]: int(v) for k, v in vals.items()
+               if k.startswith("kernelFallbacks.") and v}
+    if by_name:
+        out["kernelFallbacksByName"] = by_name
+    return out
 
 
 def _aqe_actions(physical) -> Dict[str, int]:

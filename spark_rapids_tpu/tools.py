@@ -424,9 +424,11 @@ def hotspots_report(paths: List[str], top: int = 20) -> str:
     directory (the `tools hotspots` CLI): the picker for the NEXT
     Pallas kernel target (docs/kernels.md) — a span family's summed
     self-time across queries is the ceiling on what hand-writing that
-    loop can save. Kernel dispatches are split out per kernel
-    (`kernelDispatch[<name>]`) so kernel vs oracle time is directly
-    attributable."""
+    loop can save. Kernel dispatches are split out per (kernel, shape
+    bucket) (`kernelDispatch[<name>@<bucket>]`) so kernel vs oracle
+    time is attributable per capacity class, and dispatches that ran
+    on default parameters are flagged `(untuned)` — the autotuner's
+    remaining targets."""
     from spark_rapids_tpu.trace import load_trace
     agg: Dict[str, Dict[str, float]] = {}
     window = 0.0
@@ -439,10 +441,15 @@ def hotspots_report(paths: List[str], top: int = 20) -> str:
         window += t1 - t0
 
         def _name(s) -> str:
-            if s["name"] == "kernelDispatch":
-                k = s.get("args", {}).get("kernel")
-                if k:
-                    return f"kernelDispatch[{k}]"
+            a = s.get("args", {})
+            k = a.get("kernel")
+            if k and s["name"] in ("kernelDispatch",
+                                   "TpuHashAggregateExec.dispatch"):
+                b = a.get("bucket")
+                bucket = f"@{b}" if b is not None else ""
+                flag = (" (untuned)"
+                        if "tuned" in a and not a["tuned"] else "")
+                return f"{s['name']}[{k}{bucket}]{flag}"
             return s["name"]
 
         for name, d in exclusive_times(

@@ -812,3 +812,102 @@ def test_read_bss_kernel():
     local = jnp.asarray(np.arange(n, dtype=np.int64))
     got = np.asarray(R.read_bss(ba, base, stride, local, 8))
     assert got.tolist() == vals.tolist()
+
+
+# -- fused decode kernel (one Pallas program per batch, docs/kernels.md) ----
+
+FUSED_OFF = {"spark.rapids.sql.kernel.decodeFused.enabled": "false"}
+
+
+def _fused_vs_chain(path):
+    """Host oracle vs fused-kernel decode vs XLA-chain decode over one
+    file; all three must be bit-identical. Returns (fused metrics,
+    chain metrics)."""
+    host, _ = _collect(path, False)
+    fused, mf = _collect(path, True)
+    chain, mc = _collect(path, True, extra_conf=FUSED_OFF)
+    assert list(host) == list(fused) == list(chain)
+    for k in host:
+        assert host[k] == fused[k], f"fused decode differs on {k}"
+        assert host[k] == chain[k], f"chain decode differs on {k}"
+    return mf, mc
+
+
+def test_fused_decode_single_program_per_batch(tmp_path):
+    path = _write(tmp_path, _mixed_table())
+    mf, mc = _fused_vs_chain(path)
+    assert mf.get("kernelDispatchCount.decodeFused", 0) >= 1, mf
+    assert mf.get("kernelFallbacks.decodeFused", 0) == 0, mf
+    # the whole fused claim: ONE logical program per decoded batch
+    assert mf["deviceDecodedBatches"] >= 1
+    assert mf["deviceDecodePrograms"] == mf["deviceDecodedBatches"], mf
+    # the chain leg bills its real multi-stage program count
+    assert mc.get("kernelDispatchCount.decodeFused", 0) == 0, mc
+    assert mc["deviceDecodePrograms"] > mc["deviceDecodedBatches"], mc
+
+
+@pytest.mark.parametrize("case", ["plain", "dict", "page_nulls",
+                                  "dict_overflow"])
+def test_fused_decode_parity_matrix(tmp_path, case):
+    # the PR 8/9 encoding corpus re-run explicitly as fused-vs-chain
+    # A/B: dictionary and PLAIN lanes, nulls straddling tiny pages,
+    # and mid-chunk dict overflow all decode bit-identically in ONE
+    # program with zero fallbacks
+    if case == "plain":
+        tbl = _mixed_table(with_nulls=False)
+        path = _write(tmp_path, tbl, use_dictionary=False)
+    elif case == "dict":
+        path = _write(tmp_path, _mixed_table())
+    elif case == "page_nulls":
+        n = 6000
+        vals = [None if (i // 50) % 2 == 0 else i * 3 for i in range(n)]
+        svals = [None if (i // 37) % 3 == 1 else f"s{i % 5}"
+                 for i in range(n)]
+        tbl = pa.table({"v": pa.array(vals, type=pa.int64()),
+                        "s": pa.array(svals)})
+        path = _write(tmp_path, tbl, data_page_size=512)
+    else:
+        n = 12_000
+        rng = np.random.default_rng(13)
+        vals = [f"prefix-{int(v)}-suffix"
+                for v in rng.integers(0, 6000, n)]
+        tbl = pa.table({"s": pa.array(vals)})
+        path = _write(tmp_path, tbl, dictionary_pagesize_limit=8_000,
+                      data_page_size=4096)
+    mf, _mc = _fused_vs_chain(path)
+    assert mf.get("kernelFallbacks.decodeFused", 0) == 0, mf
+    assert mf.get("kernelDispatchCount.decodeFused", 0) >= 1, mf
+
+
+def test_fused_decode_injected_failure_falls_back_bit_identical(
+        tmp_path):
+    from spark_rapids_tpu import kernels as KR
+    path = _write(tmp_path, _mixed_table())
+    host, _ = _collect(path, False)
+    KR.inject_failure("decodeFused")
+    try:
+        dev, m = _collect(path, True)
+    finally:
+        KR.inject_failure("decodeFused", on=False)
+        KR.clear_poison()
+    for k in host:
+        assert host[k] == dev[k], f"fallback decode differs on {k}"
+    assert m.get("kernelFallbacks.decodeFused", 0) >= 1, m
+    # fallbacks billed at the chain's program count, not the fused 1
+    assert m["deviceDecodePrograms"] > m["deviceDecodedBatches"], m
+
+
+def test_fused_decode_host_only_layout_uses_chain(tmp_path):
+    # a file whose every column host-falls-back (DELTA_BYTE_ARRAY is
+    # genuinely unsupported) has no device entries: nothing to fuse,
+    # no decodeFused fallback billed, parity still holds
+    n = 500
+    tbl = pa.table({"dba": pa.array([f"prefix-common-{i}"
+                                     for i in range(n)])})
+    path = _write(tmp_path, tbl, use_dictionary=False,
+                  column_encoding={"dba": "DELTA_BYTE_ARRAY"})
+    host, _ = _collect(path, False)
+    dev, m = _collect(path, True)
+    for k in host:
+        assert host[k] == dev[k]
+    assert m.get("kernelFallbacks.decodeFused", 0) == 0, m
