@@ -577,6 +577,79 @@ def run_robustness(clean_wall: float, cpu_rows) -> dict:
     return out
 
 
+_OOC_COUNTERS = ("retryCount", "splitRetryCount", "plannedPartitions",
+                 "plannedOutOfCoreEscalations", "budgetPressurePeak")
+
+
+def run_out_of_core(clean_wall: float, cpu_rows) -> dict:
+    """detail.outOfCore (docs/out_of_core.md): q1 with the planning
+    budget pinned at 1x / 4x / 10x UNDER the clean run's peak HBM, so
+    the planned partitioned tier absorbs the pressure. The acceptance
+    number is plannedPathClean: 1.0 means every over-budget leg stayed
+    bit-identical with retryCount == 0 and splitRetryCount == 0 — the
+    degradation ladder never fell past its first two rungs."""
+    from spark_rapids_tpu import retry as RT
+    from spark_rapids_tpu.memory import get_device_store
+    from spark_rapids_tpu.sql.session import TpuSparkSession
+
+    # probe: the clean run's peak HBM is the working-set estimate the
+    # over-budget legs divide down from
+    fresh_leg()
+    tpu = TpuSparkSession(dict(TPU_CONF))
+    try:
+        q = build_query(tpu)
+        run_once(q)
+        peak = int(get_device_store(tpu.conf_obj)
+                   .stats()["peakDeviceBytes"])
+    finally:
+        tpu.stop()
+    if peak <= 0:
+        return {"skipped": True,
+                "reason": f"clean peakDeviceBytes={peak}: no working "
+                          f"set to budget against"}
+
+    out = {"skipped": False, "clean_wall_s": round(clean_wall, 4),
+           "workingSetBytes": peak, "legs": {}}
+    clean_path = True
+    for name, divisor in (("budget1x", 1), ("budget4x", 4),
+                          ("budget10x", 10)):
+        RT.reset_fault_injection()
+        fresh_leg()
+        conf = dict(TPU_CONF)
+        budget = max(1, peak // divisor)
+        conf["spark.rapids.sql.memory.deviceBudgetBytes"] = str(budget)
+        tpu = TpuSparkSession(conf)
+        try:
+            q = build_query(tpu)
+            run_once(q)  # warm: compiles at this budget's plan shape
+            tpu.start_capture()
+            dt, rows = run_once(q)
+            assert_rows_match(cpu_rows, rows)
+            counters = collect_counters(tpu.get_captured_plans(),
+                                        _OOC_COUNTERS)
+            store_peak = int(get_device_store(tpu.conf_obj)
+                             .stats()["peakDeviceBytes"])
+            retried = (counters["retryCount"]
+                       + counters["splitRetryCount"]) > 0
+            clean_path = clean_path and not retried
+            out["legs"][name] = {
+                "wall_s": round(dt, 4),
+                "slowdown_vs_clean": round(dt / clean_wall, 4),
+                "budgetBytes": budget,
+                "peakDeviceBytes": store_peak,
+                "retryCount": counters["retryCount"],
+                "splitRetryCount": counters["splitRetryCount"],
+                "plannedPartitions": counters["plannedPartitions"],
+                "plannedOutOfCoreEscalations":
+                    counters["plannedOutOfCoreEscalations"],
+                "budgetPressurePeak": counters["budgetPressurePeak"],
+            }
+        finally:
+            tpu.stop()
+    out["plannedPathClean"] = 1.0 if clean_path else 0.0
+    return out
+
+
 def run_trace(clean_wall: float, cpu_rows) -> dict:
     """q1 with span tracing on (docs/observability.md): emits one
     Chrome-trace file per run under .bench-data/traces, reports the
@@ -1881,6 +1954,14 @@ def main():
         robustness = {"skipped": True,
                       "reason": f"robustness leg failed: {e!r}"}
 
+    # planned out-of-core sweep (docs/out_of_core.md): 1x/4x/10x over
+    # budget, gated on the planned path staying retry-free
+    try:
+        out_of_core_leg = run_out_of_core(fused["wall_s"], cpu_rows)
+    except Exception as e:  # noqa: BLE001 - reported, not swallowed
+        out_of_core_leg = {"skipped": True,
+                           "reason": f"out-of-core leg failed: {e!r}"}
+
     # span-tracing leg (docs/observability.md), equally fault-isolated
     try:
         trace_leg = run_trace(fused["wall_s"], cpu_rows)
@@ -1988,6 +2069,7 @@ def main():
             },
             "multichip": multichip,
             "robustness": robustness,
+            "outOfCore": out_of_core_leg,
             "trace": trace_leg,
             "profile": profile_leg,
             "kernels": kernels_leg,

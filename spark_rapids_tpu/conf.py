@@ -285,6 +285,46 @@ SPILL_DIR = conf("spark.rapids.memory.spillDirectory").doc(
 MEMORY_DEBUG = conf("spark.rapids.memory.tpu.debug").doc(
     "Log device allocation/free events (RapidsConf.scala:307).").boolean(False)
 
+DEVICE_BUDGET_BYTES = conf("spark.rapids.sql.memory.deviceBudgetBytes").doc(
+    "Planned out-of-core budget in bytes: the working-set ceiling the "
+    "memory oracle hands operators BEFORE they materialize, so a join "
+    "build side or aggregation estimated over its budget share "
+    "partitions/spills up front instead of riding the reactive "
+    "OOM-retry protocol. 0 probes the device (80%% of reported HBM, "
+    "the pool default); set low on CPU for deterministic out-of-core "
+    "tests (docs/out_of_core.md).").bytes(0)
+
+OUT_OF_CORE_ENABLED = conf("spark.rapids.sql.outOfCore.enabled").doc(
+    "Planned out-of-core execution (docs/out_of_core.md): operators "
+    "consult the memory budget oracle before materializing and choose "
+    "a spill-friendly shape up front — partitioned hash join, "
+    "bucketed aggregation, budget-capped exchange coalesce — keeping "
+    "the OOM-retry protocol as a last-resort backstop instead of the "
+    "steady-state execution mode. Results are bit-identical to the "
+    "in-memory paths.").boolean(True)
+
+OUT_OF_CORE_BUDGET_SHARE = conf("spark.rapids.sql.outOfCore.budgetShare").doc(
+    "Fraction of the device budget one operator's working set may "
+    "claim before the planned out-of-core tier engages (several "
+    "operators hold batches concurrently under taskParallelism, so "
+    "one operator never plans for the whole budget).").double(0.5)
+
+OUT_OF_CORE_MAX_PARTITIONS = conf(
+    "spark.rapids.sql.outOfCore.maxPartitions").doc(
+    "Ceiling on the spill-backed partition count the budget oracle "
+    "plans UP FRONT (pow2-rounded estimate/share). A partition that "
+    "still overflows past the ceiling re-partitions recursively "
+    "(bounded by outOfCore.maxRecursion) instead of planning "
+    "thousands of tiny splits from a bad estimate.").integer(64)
+
+OUT_OF_CORE_MAX_RECURSION = conf(
+    "spark.rapids.sql.outOfCore.maxRecursion").doc(
+    "Bound on recursive re-partitioning depth when a planned "
+    "partition still overflows its budget share (each level doubles "
+    "the partition modulus; pmod(hash, 2N) refines pmod(hash, N)). "
+    "Past the bound the partition falls back to the OOM-retry "
+    "backstop.").integer(3)
+
 SHUFFLE_COMPRESSION_CODEC = conf("spark.rapids.shuffle.compression.codec").doc(
     "Codec for serialized batch payloads (disk spill tier and any "
     "host-staged shuffle leg): none, zlib or zstd "
@@ -398,7 +438,11 @@ INJECT_OOM = conf("spark.rapids.sql.test.injectOOM").internal().doc(
     "framework. 'N' = every Nth wrapped allocation throws TpuRetryOOM; "
     "'N:K' = K consecutive failures at every Nth allocation; "
     "'split:N' = TpuSplitAndRetryOOM every Nth; 'seed:S:P' = seeded "
-    "random with probability P (docs/robustness.md).").string("")
+    "random with probability P; 'site:NAME:SPEC' scopes any form to "
+    "the named site — site:cancel counts lifecycle checkpoints and "
+    "injects cooperative cancels, site:budget makes every Nth "
+    "budget-oracle query report half the real headroom "
+    "(docs/robustness.md site catalog).").string("")
 
 INJECT_IO_ERROR = conf("spark.rapids.sql.test.injectIOError").internal().doc(
     "Testing: deterministic synthetic IO-error schedule for the file "

@@ -656,10 +656,126 @@ class TpuHashAggregateExec(TpuExec):
         handles[0].close()
         return final
 
+    def _ooc_eligible(self) -> bool:
+        """The bucketed out-of-core aggregation needs hashable grouping
+        keys and row-splittable batches (array/map columns carry
+        element pools the sort-split cannot ride). Bucketing is by the
+        murmur3 HASH of the grouping keys — never by range — so a run
+        of equal keys can never straddle a bucket boundary and emit a
+        group twice."""
+        for g in self.grouping:
+            if isinstance(g.data_type, (T.ArrayType, T.MapType,
+                                        T.StructType)):
+                return False
+        for a in self.child.output:
+            if isinstance(a.data_type, (T.ArrayType, T.MapType)):
+                return False
+        return True
+
+    def _ooc_split(self, store, handles: List, bound_keys,
+                   modulus: int) -> List[List]:
+        """Split every handle's batch into ``modulus`` spill-backed
+        buckets by the exchange's bit-exact murmur3 partition hash of
+        the grouping keys (a group's rows all land in one bucket, so
+        per-bucket aggregation unions to the full result). Input
+        handles close as they are consumed; only one source batch is
+        promoted at a time."""
+        from spark_rapids_tpu import retry as R
+        from spark_rapids_tpu.exec.exchange import (hash_partition_ids,
+                                                    split_by_pid)
+        buckets: List[List] = [[] for _ in range(modulus)]
+        for h in handles:
+            b = h.get()
+            with self.metrics.timed(M.PARTITION_TIME):
+                parts = R.with_retry(
+                    lambda b=b: split_by_pid(
+                        b, hash_partition_ids(bound_keys, b, modulus,
+                                              self.conf, self.metrics),
+                        modulus),
+                    self.conf, self.metrics)
+            h.close()
+            for pid, part in enumerate(parts):
+                if part is not None:
+                    buckets[pid].append(
+                        self.register_spillable(store, part))
+        return buckets
+
+    def _ooc_aggregate(self, store, handles: List, modulus: int,
+                       oracle, depth: int) -> Iterator[DeviceBatch]:
+        """Planned out-of-core aggregation (docs/out_of_core.md): the
+        partition's buffer batches split by pmod(murmur3(grouping),
+        modulus) into spill-backed buckets, each aggregated alone (the
+        kernel is already sort-based, so this IS the sort fallback of
+        aggregate.scala:224-245 with hash-bucketed staging). The
+        modulus starts at plannedPartitions × co-partition count —
+        rows here already satisfy pmod(h, P) == pid, so any modulus
+        dividing P would put every row in one bucket. A bucket whose
+        estimate still overflows — or whose complete-mode concat OOMs
+        before anything was emitted — re-buckets recursively at a
+        DOUBLED modulus, bounded by outOfCore.maxRecursion; past the
+        bound the OOM-retry protocol is the backstop."""
+        from spark_rapids_tpu import retry as R
+        from spark_rapids_tpu import trace as TR
+        TR.instant("oocAggPlan", modulus=modulus, depth=depth)
+        child_out = self.child.output
+        bound = [E.bind_references(g, child_out) for g in self.grouping]
+        buckets = self._ooc_split(store, handles, bound, modulus)
+        share = oracle.operator_share()
+        inj = R.get_fault_injector(self.conf)
+        for pid in range(modulus):
+            bh = buckets[pid]
+            if not bh:
+                continue
+            if sum(h.sizeof() for h in bh) > share \
+                    and depth < oracle.max_recursion:
+                # the estimate says this bucket still overflows:
+                # re-plan (escalate), don't materialize-and-thrash
+                self.metrics.create(M.PLANNED_OOC_ESCALATIONS,
+                                    M.ESSENTIAL).add(1)
+                yield from self._ooc_aggregate(store, bh, modulus * 2,
+                                               oracle, depth + 1)
+                continue
+            if self.mode == "final":
+                # merge staging is itself spill-backed and row-bounded
+                whole = self._merge_bounded(bh, store)
+            else:  # complete consumes raw rows; concat is the one
+                #    over-budget-risk point for this bucket
+                def mat(hs=bh) -> DeviceBatch:
+                    bs = [h.get() for h in hs]
+                    return concat_device(bs) if len(bs) > 1 else bs[0]
+
+                if depth >= oracle.max_recursion:
+                    whole = R.with_retry(mat, self.conf, self.metrics,
+                                         site="oocAgg")
+                else:
+                    try:
+                        # nothing emitted for this bucket yet and its
+                        # handles are intact, so an OOM here soundly
+                        # re-plans at a doubled modulus instead of
+                        # riding the spill-and-retry loop
+                        if inj is not None:
+                            inj.on_alloc("oocAgg")
+                        whole = mat()
+                    except Exception as e:
+                        if not R.is_oom_error(e):
+                            raise
+                        self.metrics.create(M.PLANNED_OOC_ESCALATIONS,
+                                            M.ESSENTIAL).add(1)
+                        yield from self._ooc_aggregate(
+                            store, bh, modulus * 2, oracle, depth + 1)
+                        continue
+                for h in bh:
+                    h.close()
+            out, _cnt, _ovf = R.with_retry(
+                lambda w=whole: self._aggregate_batch(w),
+                self.conf, self.metrics)
+            yield out
+
     def device_partitions(self) -> List[DevicePartitionThunk]:
         grouped = len(self.grouping) > 0
 
-        def make(thunk: DevicePartitionThunk) -> DevicePartitionThunk:
+        def make(thunk: DevicePartitionThunk,
+                 co_parts: int = 1) -> DevicePartitionThunk:
             def run() -> Iterator[DeviceBatch]:
                 from spark_rapids_tpu.memory import get_device_store
                 store = get_device_store(self.conf)
@@ -672,6 +788,25 @@ class TpuHashAggregateExec(TpuExec):
                     if not grouped and self.mode in ("final", "complete"):
                         yield self._empty_global_result()
                     return
+                # planned out-of-core gate (docs/out_of_core.md): when
+                # the estimated working set exceeds the budget oracle's
+                # operator share, bucket the partition by the murmur3
+                # hash of the grouping keys and aggregate one
+                # spill-backed bucket at a time instead of
+                # concatenating a whole the retry protocol would thrash
+                if grouped and self.mode in ("final", "complete") \
+                        and self._ooc_eligible():
+                    from spark_rapids_tpu.memory import get_budget_oracle
+                    oracle = get_budget_oracle(self.conf)
+                    if oracle.enabled:
+                        n = oracle.plan_partitions(
+                            sum(h.sizeof() for h in handles),
+                            self.metrics)
+                        if n > 1:
+                            yield from self._ooc_aggregate(
+                                store, handles,
+                                n * max(1, co_parts), oracle, depth=0)
+                            return
                 if self.mode == "final":
                     whole = self._merge_bounded(handles, store)
                 else:  # complete consumes raw rows; concat directly
@@ -692,7 +827,8 @@ class TpuHashAggregateExec(TpuExec):
                     return
                 yield out
             return run
-        return [make(t) for t in device_channel(self.child)]
+        thunks = device_channel(self.child)
+        return [make(t, len(thunks)) for t in thunks]
 
     def _run_partial(self, thunk: DevicePartitionThunk, store
                      ) -> Iterator[DeviceBatch]:

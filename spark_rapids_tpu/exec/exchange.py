@@ -734,8 +734,21 @@ class TpuShuffleExchangeExec(TpuExec):
         from spark_rapids_tpu import adaptive as A
         self._materialize()
         stats = self.exchange_stats
-        groups = A.coalesce_groups(stats.partition_bytes,
-                                   A.target_partition_bytes(self.conf))
+        target = A.target_partition_bytes(self.conf)
+        from spark_rapids_tpu.memory import get_budget_oracle
+        oracle = get_budget_oracle(self.conf)
+        if oracle.enabled:
+            # budget-aware cap (docs/out_of_core.md): never coalesce
+            # toward a concat the consumer could not materialize
+            # within its budget share
+            share = oracle.operator_share()
+            if share < target:
+                target = share
+                self.metrics.create(M.BUDGET_PRESSURE_PEAK,
+                                    M.ESSENTIAL).set_max(
+                    int(A.target_partition_bytes(self.conf) * 100
+                        // max(1, share)))
+        groups = A.coalesce_groups(stats.partition_bytes, target)
         if len(groups) < nparts:
             self.metrics.create("aqeCoalescedPartitions",
                                 M.ESSENTIAL).add(nparts - len(groups))

@@ -352,11 +352,13 @@ class TpuShuffledHashJoinExec(TpuExec):
             for pid, rt in enumerate(rparts):
                 if pid not in plan:
                     thunks.append(self._partition_join_thunk(
-                        self._items_thunk(mat[pid]), rt))
+                        self._items_thunk(mat[pid]), rt,
+                        co_parts=len(rparts)))
                     continue
                 for items in self._split_partition(mat[pid], plan[pid]):
                     thunks.append(self._partition_join_thunk(
-                        self._items_thunk(items), rt))
+                        self._items_thunk(items), rt,
+                        co_parts=len(rparts)))
         return thunks
 
     def _items_thunk(self, items) -> DevicePartitionThunk:
@@ -484,77 +486,210 @@ class TpuShuffledHashJoinExec(TpuExec):
         rparts = device_channel(self.right)
         assert len(lparts) == len(rparts), \
             "join children must be co-partitioned"
-        return [self._partition_join_thunk(lt, rt)
+        return [self._partition_join_thunk(lt, rt,
+                                           co_parts=len(lparts))
                 for lt, rt in zip(lparts, rparts)]
 
     def _partition_join_thunk(self, lt: DevicePartitionThunk,
-                              rt: DevicePartitionThunk
+                              rt: DevicePartitionThunk,
+                              co_parts: int = 1
                               ) -> DevicePartitionThunk:
-        goal = self.conf.batch_size_rows
-
         def make(lt: DevicePartitionThunk, rt: DevicePartitionThunk
                  ) -> DevicePartitionThunk:
             def run() -> Iterator[DeviceBatch]:
-                from spark_rapids_tpu.memory import get_device_store
+                from spark_rapids_tpu.memory import (get_budget_oracle,
+                                                     get_device_store)
                 store = get_device_store(self.conf)
                 # stream side drains into spillable handles first, so a
                 # skewed partition never pins both sides at once
                 lhandles = [self.register_spillable(store, b)
                             for b in lt() if b._num_rows != 0]
                 rb = [b for b in rt() if b._num_rows != 0]
-                total_l = sum(h.rows for h in lhandles)
-                chunkable = (self.join_type in self._LEFT_STREAM_TYPES
-                             or self.join_type in self._CHUNKED_OUTER)
-                if not chunkable or total_l <= goal:
-                    lb = [h.get() for h in lhandles]
-                    for h in lhandles:
-                        h.close()
-                    yield from self._join_one(lb, rb)
-                    return
-                # chunked stream: build side concatenated once, left
-                # handles re-promoted and joined goal-rows at a time
-                rwhole = (concat_device(rb) if len(rb) > 1 else
-                          rb[0] if rb else
-                          DeviceBatch.empty(self.right.schema))
-                chunk_type = self._CHUNKED_OUTER.get(self.join_type)
-                matched_any = None
-                if chunk_type is not None:
-                    lk = P.bind_list(self.left_keys, self.left.output)
-                    rk = P.bind_list(self.right_keys, self.right.output)
-                    pair_schema = self._pair_schema()
-                i = 0
-                while i < len(lhandles):
-                    chunk = [lhandles[i]]
-                    rows = lhandles[i].rows
-                    i += 1
-                    while i < len(lhandles) and \
-                            rows + lhandles[i].rows <= goal:
-                        rows += lhandles[i].rows
-                        chunk.append(lhandles[i])
-                        i += 1
-                    lb = [h.get() for h in chunk]
-                    for h in chunk:
-                        h.close()
-                    if chunk_type is None:
-                        yield from self._join_one(lb, [rwhole])
-                    else:
-                        out, matched = self._join_one_matched(
-                            lb, rwhole, chunk_type, lk, rk, pair_schema)
-                        from spark_rapids_tpu.ops.join import or_masks
-                        matched_any = matched if matched_any is None \
-                            else or_masks(matched_any, matched)
-                        yield out
-                if chunk_type is not None:
-                    from spark_rapids_tpu.ops.join import \
-                        right_extras_batch
-                    left_fields = [
-                        T.StructField(a.name, a.data_type, a.nullable)
-                        for a in self.left.output]
-                    extras = right_extras_batch(
-                        rwhole, matched_any, left_fields, pair_schema)
-                    yield self._project_output(extras)
+                # planned out-of-core gate (docs/out_of_core.md): when
+                # the build side's estimated bytes exceed the budget
+                # oracle's operator share, partition BOTH sides by the
+                # murmur3 partition hash into spill-backed buckets
+                # sized up front, instead of concatenating a build
+                # table the retry protocol would then thrash over
+                oracle = get_budget_oracle(self.conf)
+                if rb and oracle.enabled and self._ooc_eligible():
+                    n = oracle.plan_partitions(
+                        sum(b.sizeof() for b in rb), self.metrics)
+                    if n > 1:
+                        rhandles = [self.register_spillable(store, b)
+                                    for b in rb]
+                        yield from self._ooc_join(
+                            store, lhandles, rhandles,
+                            n * max(1, co_parts), oracle, depth=0)
+                        return
+                yield from self._join_items(store, lhandles, rb)
             return run
         return make(lt, rt)
+
+    def _ooc_eligible(self) -> bool:
+        """The partitioned out-of-core join needs hashable equi-keys
+        (cross joins have none — every row would land in one bucket)
+        and row-splittable batches (array/map columns carry element
+        pools the sort-split cannot ride)."""
+        if not self.left_keys:
+            return False
+        for a in list(self.left.output) + list(self.right.output):
+            if isinstance(a.data_type, (T.ArrayType, T.MapType)):
+                return False
+        return True
+
+    def _ooc_split(self, store, handles: List, bound_keys,
+                   modulus: int) -> List[List]:
+        """Split every handle's batch into ``modulus`` spill-backed
+        buckets by the exchange's bit-exact murmur3 partition hash of
+        the join keys (equal keys land in the same bucket on both
+        sides, so per-bucket joins concatenate to the full join).
+        Input handles close as they are consumed; only one source
+        batch is promoted at a time."""
+        from spark_rapids_tpu import retry as R
+        from spark_rapids_tpu.exec.exchange import (hash_partition_ids,
+                                                    split_by_pid)
+        buckets: List[List] = [[] for _ in range(modulus)]
+        for h in handles:
+            b = h.get()
+            with self.metrics.timed(M.PARTITION_TIME):
+                parts = R.with_retry(
+                    lambda b=b: split_by_pid(
+                        b, hash_partition_ids(bound_keys, b, modulus,
+                                              self.conf, self.metrics),
+                        modulus),
+                    self.conf, self.metrics)
+            h.close()
+            for pid, part in enumerate(parts):
+                if part is not None:
+                    buckets[pid].append(
+                        self.register_spillable(store, part))
+        return buckets
+
+    def _ooc_join(self, store, lhandles: List, rhandles: List,
+                  modulus: int, oracle, depth: int
+                  ) -> Iterator[DeviceBatch]:
+        """Planned partitioned hash join (docs/out_of_core.md): both
+        sides split by pmod(murmur3, modulus) into spill-backed
+        buckets, processed one bucket at a time through the ordinary
+        chunked-gather machinery. A bucket whose realized build bytes
+        still exceed the budget share — or whose build materialization
+        OOMs before anything was emitted — re-partitions recursively
+        at a DOUBLED modulus (pmod(h, 2N) refines pmod(h, N)), bounded
+        by outOfCore.maxRecursion; past the bound the OOM-retry
+        protocol is the backstop, as everywhere else."""
+        from spark_rapids_tpu import retry as R
+        from spark_rapids_tpu import trace as TR
+        TR.instant("oocJoinPlan", modulus=modulus, depth=depth)
+        lk = P.bind_list(self.left_keys, self.left.output)
+        rk = P.bind_list(self.right_keys, self.right.output)
+        lbuckets = self._ooc_split(store, lhandles, lk, modulus)
+        rbuckets = self._ooc_split(store, rhandles, rk, modulus)
+        share = oracle.operator_share()
+        inj = R.get_fault_injector(self.conf)
+        for pid in range(modulus):
+            lhs, rhs = lbuckets[pid], rbuckets[pid]
+            if not lhs and not rhs:
+                continue
+            rbytes = sum(h.sizeof() for h in rhs)
+            if rbytes > share and depth < oracle.max_recursion:
+                # the estimate says this bucket still overflows:
+                # re-plan (escalate), don't materialize-and-thrash
+                self.metrics.create(M.PLANNED_OOC_ESCALATIONS,
+                                    M.ESSENTIAL).add(1)
+                yield from self._ooc_join(store, lhs, rhs, modulus * 2,
+                                          oracle, depth + 1)
+                continue
+            def mat(rhs=rhs) -> List[DeviceBatch]:
+                bs = [h.get() for h in rhs]
+                return [concat_device(bs)] if len(bs) > 1 else bs
+
+            if depth >= oracle.max_recursion:
+                # recursion exhausted: the OOM-retry protocol is the
+                # backstop for this bucket, as everywhere else
+                rwhole = R.with_retry(mat, self.conf, self.metrics,
+                                      site="oocJoin")
+            else:
+                try:
+                    # the bucket's ONE over-budget-risk point: promote
+                    # + concat the build bucket. Nothing has been
+                    # emitted for this bucket yet and both sides'
+                    # handles are intact, so an OOM here can soundly
+                    # re-plan at a doubled modulus instead of riding
+                    # the spill-and-retry loop
+                    if inj is not None:
+                        inj.on_alloc("oocJoin")
+                    rwhole = mat()
+                except Exception as e:
+                    if not R.is_oom_error(e):
+                        raise
+                    self.metrics.create(M.PLANNED_OOC_ESCALATIONS,
+                                        M.ESSENTIAL).add(1)
+                    yield from self._ooc_join(store, lhs, rhs,
+                                              modulus * 2, oracle,
+                                              depth + 1)
+                    continue
+            for h in rhs:
+                h.close()
+            yield from self._join_items(store, lhs, rwhole)
+
+    def _join_items(self, store, lhandles: List,
+                    rb: List[DeviceBatch]) -> Iterator[DeviceBatch]:
+        """One co-partition's join: the stream side arrives as
+        spillable handles, the build side as device batches (shared by
+        the in-memory path and each out-of-core bucket)."""
+        goal = self.conf.batch_size_rows
+        total_l = sum(h.rows for h in lhandles)
+        chunkable = (self.join_type in self._LEFT_STREAM_TYPES
+                     or self.join_type in self._CHUNKED_OUTER)
+        if not chunkable or total_l <= goal:
+            lb = [h.get() for h in lhandles]
+            for h in lhandles:
+                h.close()
+            yield from self._join_one(lb, rb)
+            return
+        # chunked stream: build side concatenated once, left
+        # handles re-promoted and joined goal-rows at a time
+        rwhole = (concat_device(rb) if len(rb) > 1 else
+                  rb[0] if rb else
+                  DeviceBatch.empty(self.right.schema))
+        chunk_type = self._CHUNKED_OUTER.get(self.join_type)
+        matched_any = None
+        if chunk_type is not None:
+            lk = P.bind_list(self.left_keys, self.left.output)
+            rk = P.bind_list(self.right_keys, self.right.output)
+            pair_schema = self._pair_schema()
+        i = 0
+        while i < len(lhandles):
+            chunk = [lhandles[i]]
+            rows = lhandles[i].rows
+            i += 1
+            while i < len(lhandles) and \
+                    rows + lhandles[i].rows <= goal:
+                rows += lhandles[i].rows
+                chunk.append(lhandles[i])
+                i += 1
+            lb = [h.get() for h in chunk]
+            for h in chunk:
+                h.close()
+            if chunk_type is None:
+                yield from self._join_one(lb, [rwhole])
+            else:
+                out, matched = self._join_one_matched(
+                    lb, rwhole, chunk_type, lk, rk, pair_schema)
+                from spark_rapids_tpu.ops.join import or_masks
+                matched_any = matched if matched_any is None \
+                    else or_masks(matched_any, matched)
+                yield out
+        if chunk_type is not None:
+            from spark_rapids_tpu.ops.join import \
+                right_extras_batch
+            left_fields = [
+                T.StructField(a.name, a.data_type, a.nullable)
+                for a in self.left.output]
+            extras = right_extras_batch(
+                rwhole, matched_any, left_fields, pair_schema)
+            yield self._project_output(extras)
 
     def _pair_schema(self) -> T.StructType:
         return T.StructType(

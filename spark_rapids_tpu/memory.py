@@ -762,3 +762,84 @@ def store_tenant_stats() -> Dict[str, Dict[str, int]]:
     """The process store's per-tenant HBM ledger ({} without a store)
     — the admission controller's and server stats' data source."""
     return _STORE.tenant_stats() if _STORE is not None else {}
+
+
+# ---------------------------------------------------------------------------
+# Planned out-of-core budget oracle (docs/out_of_core.md). Operators
+# query it BEFORE materializing a working set: a join build side or
+# aggregation estimated over its budget share partitions/spills up
+# front (sized pow2 partition counts) instead of discovering the
+# overflow inside the OOM-retry protocol. The reactive retry ladder
+# stays as the backstop for estimates that lie.
+# ---------------------------------------------------------------------------
+
+class BudgetOracle:
+    """Per-query view over the planned out-of-core budget confs plus
+    the live store occupancy. Cheap to construct (a handful of conf
+    reads); operators build one per materialization decision so conf
+    changes and injected budget faults always apply."""
+
+    def __init__(self, conf: TpuConf):
+        from spark_rapids_tpu.conf import (DEVICE_BUDGET_BYTES,
+                                           OUT_OF_CORE_BUDGET_SHARE,
+                                           OUT_OF_CORE_ENABLED,
+                                           OUT_OF_CORE_MAX_PARTITIONS,
+                                           OUT_OF_CORE_MAX_RECURSION)
+        self.conf = conf
+        self.enabled = bool(conf.get(OUT_OF_CORE_ENABLED))
+        self.budget = (int(conf.get(DEVICE_BUDGET_BYTES))
+                       or _default_budget())
+        self.share_fraction = float(conf.get(OUT_OF_CORE_BUDGET_SHARE))
+        self.max_partitions = max(
+            2, int(conf.get(OUT_OF_CORE_MAX_PARTITIONS)))
+        self.max_recursion = max(
+            0, int(conf.get(OUT_OF_CORE_MAX_RECURSION)))
+
+    def headroom(self) -> int:
+        """Bytes of budget left over the store's live occupancy. A
+        firing ``site:budget:N`` schedule HALVES the report (synthetic
+        memory pressure for the escalation tests — the fault is a lie,
+        never an error, so the planned path absorbs it by planning
+        more partitions, not by retrying)."""
+        live = _STORE.device_bytes if _STORE is not None else 0
+        room = max(0, self.budget - live)
+        from spark_rapids_tpu import retry as R
+        inj = R.get_fault_injector(self.conf)
+        if inj is not None and inj.on_budget_query():
+            room //= 2
+        return room
+
+    def operator_share(self) -> int:
+        """Working-set bytes ONE operator may plan to hold resident at
+        once (several operators hold batches concurrently under
+        taskParallelism, so nobody plans for the whole headroom)."""
+        return max(1, int(self.headroom() * self.share_fraction))
+
+    def plan_partitions(self, estimate_bytes: int, metrics=None,
+                        share: Optional[int] = None) -> int:
+        """Spill-backed partition count for a working set of
+        ``estimate_bytes``: 1 when it fits the operator share (the
+        in-memory path), else estimate/share pow2-rounded UP and
+        clamped to outOfCore.maxPartitions. Records the
+        plannedPartitions / budgetPressurePeak metric family on
+        ``metrics`` when given."""
+        if share is None:
+            share = self.operator_share()
+        n = 1
+        if self.enabled and estimate_bytes > share:
+            n = 2
+            while n * share < estimate_bytes and n < self.max_partitions:
+                n <<= 1
+        if metrics is not None:
+            from spark_rapids_tpu import metrics as M
+            metrics.create(M.BUDGET_PRESSURE_PEAK, M.ESSENTIAL).set_max(
+                int(estimate_bytes * 100 // max(1, share)))
+            if n > 1:
+                metrics.create(M.PLANNED_PARTITIONS,
+                               M.ESSENTIAL).add(n)
+        return n
+
+
+def get_budget_oracle(conf: TpuConf) -> BudgetOracle:
+    """A fresh oracle view for one materialization decision."""
+    return BudgetOracle(conf)

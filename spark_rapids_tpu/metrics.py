@@ -62,6 +62,12 @@ DEGRADED_CHIPS = "degradedChips"          # mesh chips demoted after failure
 IO_RETRY_COUNT = "ioRetryCount"           # transient reader IO retries
 DEVICE_DECODE_OOM_FALLBACKS = "deviceDecodeOomFallbacks"  # encoded-upload
 #   OOMs that fell back to the pyarrow host decode for that batch
+# planned out-of-core family (docs/out_of_core.md): the budget
+# oracle's planning decisions, distinct from the reactive retry
+# counters above
+PLANNED_PARTITIONS = "plannedPartitions"  # spill-backed partitions planned
+BUDGET_PRESSURE_PEAK = "budgetPressurePeak"  # worst estimate/share ratio
+PLANNED_OOC_ESCALATIONS = "plannedOutOfCoreEscalations"  # re-plans
 
 
 # ---------------------------------------------------------------------------
@@ -107,6 +113,17 @@ METRIC_DESCRIPTIONS: Dict[str, str] = {
     IO_RETRY_COUNT: "transient reader IO retries",
     DEVICE_DECODE_OOM_FALLBACKS: "encoded uploads that fell back to the "
                                  "pyarrow host decode after OOM",
+    PLANNED_PARTITIONS: "spill-backed partitions the out-of-core "
+                        "budget oracle planned up front "
+                        "(docs/out_of_core.md)",
+    BUDGET_PRESSURE_PEAK: "worst working-set estimate observed at "
+                          "planning, as bytes per 100 bytes of budget "
+                          "share (>100 = the planned out-of-core tier "
+                          "engaged)",
+    PLANNED_OOC_ESCALATIONS: "planned out-of-core partition plans "
+                             "escalated (re-partitioned at a doubled "
+                             "modulus) after a partition still "
+                             "overflowed its budget share",
     # ad-hoc keys registered inline by individual operators
     "pipelineDrainTime": "wall where the partial agg drained the async "
                          "upstream pipeline (interval union)",

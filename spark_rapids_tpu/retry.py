@@ -121,7 +121,9 @@ class _Schedule:
       ``site:cancel:SPEC`` is special: it counts LIFECYCLE
       cancellation checkpoints and injects a cooperative cancel of
       the live query's token instead of an OOM (docs/robustness.md
-      site catalog)
+      site catalog). ``site:budget:SPEC`` is the planning leg: it
+      counts budget-ORACLE queries and makes the firing query report
+      half the real headroom (docs/out_of_core.md) — never an error
     """
 
     __slots__ = ("every_n", "streak", "split", "seed", "prob", "rng",
@@ -178,6 +180,15 @@ class FaultInjector:
         self._cancel = None
         if self._oom is not None and self._oom.site == "cancel":
             self._cancel, self._oom = self._oom, None
+        # `site:budget:N` is the PLANNING leg (docs/robustness.md,
+        # docs/out_of_core.md): the schedule counts budget-ORACLE
+        # queries instead of allocations, and the injected fault is a
+        # halved headroom report — never a raised error — so the
+        # planned out-of-core tier's escalation path (more partitions,
+        # zero retries) is deterministically testable
+        self._budget = None
+        if self._oom is not None and self._oom.site == "budget":
+            self._budget, self._oom = self._oom, None
         self._io = _parse_schedule(io_spec)
         self._chips = set()
         for part in str(chip_spec or "").split(","):
@@ -190,11 +201,13 @@ class FaultInjector:
         self._io_count = 0
         self._io_streak = 0
         self._cancel_count = 0
+        self._budget_count = 0
         # observability (bench detail.robustness, tests)
         self.oom_injected = 0
         self.io_injected = 0
         self.chip_failures_injected = 0
         self.cancels_injected = 0
+        self.budget_faults_injected = 0
 
     def _fire(self, sched: _Schedule, count: int) -> bool:
         if sched.prob > 0.0:
@@ -274,13 +287,31 @@ class FaultInjector:
         from spark_rapids_tpu.lifecycle import REASON_INJECTED
         token.cancel(REASON_INJECTED)
 
+    def on_budget_query(self) -> bool:
+        """Checkpoint at one budget-oracle headroom query. A
+        ``site:budget:N`` schedule returns True at the Nth query — the
+        oracle then reports HALF the real headroom, so planning sees
+        synthetic memory pressure and escalates its partition count
+        (never an error: the fault exercises the planned path, not the
+        retry backstop). Recovery paths are exempt like every other
+        injection site."""
+        if self._budget is None or _suppressed():
+            return False
+        with self._lock:
+            self._budget_count += 1
+            if not self._fire(self._budget, self._budget_count):
+                return False
+            self.budget_faults_injected += 1
+            return True
+
     def stats(self) -> dict:
         with self._lock:
             return {"allocations": self._alloc_count,
                     "oomInjected": self.oom_injected,
                     "ioInjected": self.io_injected,
                     "chipFailuresInjected": self.chip_failures_injected,
-                    "cancelsInjected": self.cancels_injected}
+                    "cancelsInjected": self.cancels_injected,
+                    "budgetFaultsInjected": self.budget_faults_injected}
 
 
 _INJECTOR: Optional[FaultInjector] = None

@@ -52,6 +52,12 @@ GROUP BY brand ORDER BY brand LIMIT 50
 # round activates the ICI mesh and only runs with >= 2 visible devices
 SCHEDULES: List[Dict[str, str]] = [
     {},  # clean engine: only lifecycle injections (deadline/cancel/...)
+    # memory pressure: a budget far below the working set forces the
+    # planned out-of-core tier on every join/agg, while every 3rd
+    # budget-oracle query lies (half the real headroom) — survivors
+    # must stay bit-identical with NO retry storm (docs/out_of_core.md)
+    {"spark.rapids.sql.memory.deviceBudgetBytes": "65536",
+     "spark.rapids.sql.test.injectOOM": "site:budget:3"},
     {"spark.rapids.sql.test.injectOOM": "6"},
     {"spark.rapids.sql.test.injectIOError": "4"},
     {"spark.rapids.sql.test.injectOOM": "split:5",
@@ -339,7 +345,7 @@ def run_soak(rounds: int = 3, concurrency: int = 8,
             schedule = SCHEDULES[rnd % len(SCHEDULES)]
             if "spark.rapids.sql.test.injectChipFailure" in schedule \
                     and not multi_device:
-                schedule = SCHEDULES[1]  # no mesh: fall back to OOM
+                schedule = SCHEDULES[2]  # no mesh: fall back to OOM
             rep = _run_round(rnd, data_dir, oracle, concurrency,
                              queries_per_tenant, seed, schedule, log)
             round_reports.append(rep)

@@ -79,6 +79,17 @@ CHECKS: List[Tuple[str, str, bool, str]] = [
      "retries under injected OOM"),
     ("detail.robustness.legs.oomEveryN.slowdown_vs_clean", "lower",
      False, "injected-OOM slowdown"),
+    # planned out-of-core (docs/out_of_core.md): the gate is the
+    # 1.0/0.0 indicator — raw retryCount can't gate through the
+    # va==0 short-circuit below, so bench.py derives the boolean
+    ("detail.outOfCore.plannedPathClean", "higher", True,
+     "planned out-of-core path stayed retry-free"),
+    ("detail.outOfCore.legs.budget10x.slowdown_vs_clean", "lower",
+     False, "10x-over-budget slowdown"),
+    ("detail.outOfCore.legs.budget10x.plannedPartitions", "lower",
+     False, "10x-over-budget planned partitions"),
+    ("detail.outOfCore.legs.budget10x.retryCount", "lower", False,
+     "10x-over-budget retries (0 on the planned path)"),
     ("detail.adaptive.skew.speedup", "higher", True,
      "skewed-join adaptive speedup"),
     ("detail.adaptive.coalesce.dispatchDelta", "higher", False,

@@ -348,7 +348,27 @@ def diagnose(history_dir: str, selector: str) -> Dict[str, Any]:
                     f"retryBlock self-time {r['targetS']:.3f}s vs "
                     f"baseline {r['baselineS']:.3f}s "
                     f"(+{r['deltaS']:.3f}s — the divergent stage)")
-        verdict("retrySpill", 0.5 + 0.5 * share, ev)
+        score = 0.5 + 0.5 * share
+        poc = target.get("plannedOutOfCore") or {}
+        if poc.get("plannedPartitions") and \
+                retries <= base["retriesMean"] + 0.5:
+            # spill without retries under an engaged budget oracle is
+            # PLANNED out-of-core activity, not thrash — rank this
+            # verdict below biggerInput (docs/out_of_core.md)
+            score *= 0.3
+            ev.append(
+                f"spill was planned out-of-core activity "
+                f"(plannedPartitions="
+                f"{poc['plannedPartitions']:.0f}, retries stayed at "
+                f"baseline) — not retry thrash")
+        elif retries > max(2.0, 2 * base["retriesMean"] + 1.0):
+            ev.append(
+                "repeated retry storm — set "
+                "spark.rapids.sql.memory.deviceBudgetBytes and "
+                "spark.rapids.sql.outOfCore.enabled so joins/aggs "
+                "partition up front instead of riding the "
+                "spill-and-retry loop (docs/out_of_core.md)")
+        verdict("retrySpill", score, ev)
 
     # kernel-fallback: the oracle ride, with the culprit kernel(s)
     # named from the record's per-kernel counters so the operator
@@ -404,9 +424,22 @@ def diagnose(history_dir: str, selector: str) -> Dict[str, Any]:
         if diff and regressed:
             top = max((r["deltaS"] for r in diff), default=0.0)
             uniform = 1.0 - min(1.0, max(0.0, top / wall_delta - 0.5))
-        verdict("biggerInput", 0.3 + 0.4 * uniform, [
-            f"output rows {rows:.0f} vs baseline mean "
-            f"{base['rowsMean']:.0f}"])
+        ev = [f"output rows {rows:.0f} vs baseline mean "
+              f"{base['rowsMean']:.0f}"]
+        score = 0.3 + 0.4 * uniform
+        poc = target.get("plannedOutOfCore") or {}
+        if poc.get("plannedPartitions"):
+            # the budget oracle engaged: the run paid a planned
+            # partition pass for a working set over budget — direct
+            # evidence the input genuinely grew (docs/out_of_core.md)
+            score = min(1.0, score + 0.3)
+            ev.append(
+                f"planned out-of-core engaged (plannedPartitions="
+                f"{poc['plannedPartitions']:.0f}, "
+                f"budgetPressurePeak="
+                f"{poc.get('budgetPressurePeak', 0):.0f}) — the "
+                f"working set outgrew the device budget")
+        verdict("biggerInput", score, ev)
 
     verdicts.sort(key=lambda v: -v["score"])
     return {

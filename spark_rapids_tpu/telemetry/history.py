@@ -125,6 +125,14 @@ HISTORY_FIELD_CATALOG: Dict[str, str] = {
                       "baselines, SLO windows, warm-start replay, and "
                       "per-signature wall aggregates — a near-zero "
                       "cached wall must not poison a shape's baseline",
+    "plannedOutOfCore": "planned out-of-core counters from the "
+                        "executed plan (plannedPartitions/"
+                        "plannedOutOfCoreEscalations/"
+                        "budgetPressurePeak; nonzero entries only, "
+                        "present only when the budget oracle engaged "
+                        "— docs/out_of_core.md); the doctor uses this "
+                        "to classify planned big-input spill as "
+                        "biggerInput rather than retrySpill",
 }
 
 
@@ -324,6 +332,12 @@ def _plan_counters(physical) -> Dict[str, Any]:
                if k.startswith("kernelFallbacks.") and v}
     if by_name:
         out["kernelFallbacksByName"] = by_name
+    poc = {k: int(vals[k]) for k in ("plannedPartitions",
+                                     "plannedOutOfCoreEscalations",
+                                     "budgetPressurePeak")
+           if vals.get(k)}
+    if poc:
+        out["plannedOutOfCore"] = poc
     return out
 
 

@@ -157,6 +157,12 @@ INSTANT_CATALOG: Dict[str, str] = {
     "queryCancelled": "a query's CancelToken was cancelled (reason= "
                       "cancel/deadline/disconnect/watchdog/shutdown/"
                       "injected; docs/serving.md 'Query lifecycle')",
+    "oocJoinPlan": "the budget oracle partitioned a hash join into "
+                   "spill-backed buckets (modulus=/depth=; depth > 0 "
+                   "is a recursive escalation — docs/out_of_core.md)",
+    "oocAggPlan": "the budget oracle bucketed an aggregation by "
+                  "grouping-key hash (modulus=/depth=; "
+                  "docs/out_of_core.md)",
 }
 
 

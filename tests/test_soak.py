@@ -31,8 +31,10 @@ def _fresh_state():
 
 @pytest.mark.fault
 def test_quick_soak(tmp_path):
-    """c=8 mixed tenants, two rounds (clean + injected OOM), lifecycle
-    injections on: the acceptance criteria in miniature."""
+    """c=8 mixed tenants, two rounds (clean + memory pressure: a tiny
+    device budget plus injected budget faults forcing the planned
+    out-of-core tier), lifecycle injections on: the acceptance
+    criteria in miniature."""
     report = run_soak(rounds=2, concurrency=8, queries_per_tenant=2,
                       seed=11, data_dir=str(tmp_path),
                       log=lambda m: None)
@@ -53,10 +55,10 @@ def test_quick_soak(tmp_path):
 @pytest.mark.fault
 @pytest.mark.slow
 def test_full_soak(tmp_path):
-    """The full schedule sweep: every FaultInjector schedule (OOM, IO,
-    split+IO, site:cancel, chip failure when multi-device) x lifecycle
-    injections, more rounds and queries."""
-    report = run_soak(rounds=6, concurrency=8, queries_per_tenant=4,
+    """The full schedule sweep: every FaultInjector schedule (memory
+    pressure, OOM, IO, split+IO, site:cancel, chip failure when
+    multi-device) x lifecycle injections, more rounds and queries."""
+    report = run_soak(rounds=7, concurrency=8, queries_per_tenant=4,
                       seed=7, data_dir=str(tmp_path),
                       log=lambda m: None)
     assert report["ok"], report["errors"]
