@@ -1661,6 +1661,177 @@ def run_history(clean_wall: float, cpu_rows) -> dict:
     }
 
 
+def run_tuning(clean_wall: float, cpu_rows) -> dict:
+    """detail.tuning (docs/tuning.md): the feedback-control loop end
+    to end. A forced compileStorm verdict (synthetic regressed record
+    with a jit-miss storm) puts the q1 signature in the pre-warm
+    ledger and a server RESTART serves the first request from the
+    pre-warmed plan cache; a site:tuning injected harmful action
+    auto-reverts within the guard window (visible in the stats, the
+    history store, srt_tuning_* and the `tools tuning` table); a
+    forced kernelFallback verdict flips the culprit kernel conf
+    server-wide with results still bit-identical to the CPU oracle.
+    The controller tick interval is parked at 3600s so the LEG drives
+    every tick — each phase is deterministic, not timing-dependent."""
+    from spark_rapids_tpu import lifecycle as LC
+    from spark_rapids_tpu import plan_cache as PC
+    from spark_rapids_tpu import retry as R
+    from spark_rapids_tpu.plan_cache import PLAN_CACHE
+    from spark_rapids_tpu.serve import QueryServer, ServeClient
+    from spark_rapids_tpu.telemetry import history as H
+    from spark_rapids_tpu.telemetry import tuning as T
+
+    hdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".bench-data", "tuning")
+    shutil.rmtree(hdir, ignore_errors=True)
+    H.reset_history()
+    R.reset_fault_injection()
+    fresh_leg()
+    kernel_key = "spark.rapids.sql.kernel.groupbyHash.enabled"
+    conf = {
+        **TPU_CONF,
+        "spark.rapids.sql.planCache.enabled": "true",
+        "spark.rapids.sql.telemetry.history.dir": hdir,
+        "spark.rapids.sql.serve.tuning.enabled": "true",
+        "spark.rapids.sql.serve.tuning.intervalS": "3600",
+        "spark.rapids.sql.serve.tuning.guardWindowQueries": "2",
+        # the 3rd scan tick applies the synthetic harmful action
+        "spark.rapids.sql.test.injectOOM": "site:tuning:3",
+    }
+
+    def new_server():
+        srv = QueryServer(dict(conf))
+        srv.register_view("lineitem", DATA_DIR)
+        return srv.start()
+
+    def run_q1(client):
+        t0 = time.perf_counter()
+        b, _h = client.sql(Q1)
+        dt = time.perf_counter() - t0
+        assert_rows_match(cpu_rows, [tuple(r) for r in b.rows()])
+        return dt
+
+    try:
+        srv = new_server()  # tick 1: empty history, no actions
+    except OSError as e:
+        return {"skipped": True, "reason": f"cannot bind: {e!r}"}
+    try:
+        # -- learn: q1 records + the sql<->signature pairing ---------------
+        with ServeClient(srv.port, tenant="bench") as c:
+            cold_first_s = run_q1(c)
+            for _ in range(2):
+                run_q1(c)
+        tun = srv._tuning
+        sig = tun.signature_hint(Q1)
+        store = H.HistoryStore(hdir, 1 << 30, 14)
+        walls = sorted(float(r.get("wallSeconds", 0))
+                       for r in H.read_records(hdir)
+                       if r.get("signature") == sig)
+        p50 = walls[len(walls) // 2]
+
+        # -- forced compileStorm: a synthetic regressed record with a
+        # jit-miss storm makes the doctor verdict deterministic ------------
+        store.append({"version": 1, "ts": time.time(), "signature": sig,
+                      "status": "finished",
+                      "wallSeconds": 3 * p50 + 0.05,
+                      "queueWaitSeconds": 0.0, "outputRows": 4,
+                      "jitMisses": 64})
+        tun.tick()  # tick 2: applies prewarmCaches for sig
+        prewarmed = sig in (T.load_state(hdir).get("prewarm") or {})
+
+        tun.tick()  # tick 3: site:tuning fires -> harmful clamp on sig
+        injected = [a for a in tun.actions()
+                    if (a.get("evidence") or {}).get("injected")]
+        clamped = srv._admission.signature_limit(sig)
+
+        # guard window: two clean post-action q1 runs, then the judge
+        with ServeClient(srv.port, tenant="bench") as c:
+            for _ in range(2):
+                run_q1(c)
+        tun.tick()  # tick 4: guardrail reverts the injected action
+        reverted = [a for a in tun.actions()
+                    if (a.get("evidence") or {}).get("injected")
+                    and a.get("state") == "reverted"]
+        guard = {
+            "injected": len(injected),
+            "clampApplied": clamped == 1,
+            "autoReverted": 1.0 if reverted else 0.0,
+            "clampCleared": srv._admission.signature_limit(sig) is None,
+            "revertVisible": {
+                "metrics": "srt_tuning_reverts_total 1"
+                           in srv.metrics_text(),
+                "history": any(r.get("status") == "revert"
+                               for r in H.read_records(hdir)),
+                "cli": "reverted" in T.format_tuning(T.load_state(hdir)),
+            },
+        }
+
+        # -- forced kernelFallback: a synthetic signature whose newest
+        # record names the culprit kernel -> server-wide conf flip ---------
+        sig2 = "b" * 40
+        t0 = time.time()
+        for i in range(4):
+            store.append({"version": 1, "ts": t0 - 40 + i,
+                          "signature": sig2, "status": "finished",
+                          "wallSeconds": 0.05,
+                          "queueWaitSeconds": 0.0, "outputRows": 4})
+        store.append({"version": 1, "ts": t0, "signature": sig2,
+                      "status": "finished", "wallSeconds": 0.5,
+                      "queueWaitSeconds": 0.0, "outputRows": 4,
+                      "kernelFallbacks": 6,
+                      "kernelFallbacksByName": {"groupbyHash": 6}})
+        tun.tick()  # tick 5: flips kernel_key to false
+        flipped = str(tun._get_conf(kernel_key)).lower() == "false"
+        with ServeClient(srv.port, tenant="bench") as c:
+            flipped_wall = run_q1(c)  # bit-identity holds post-flip
+        stats_before_restart = srv.stats().get("tuning") or {}
+    finally:
+        srv.shutdown()
+
+    # -- restart: persisted actions re-apply, the pre-warm ledger
+    # replays, and the FIRST request hits the plan cache ------------------
+    PLAN_CACHE.clear()
+    LC.reset_lifecycle()
+    R.reset_fault_injection()
+    try:
+        srv = new_server()
+        try:
+            replayed = srv._tuning.prewarm_replayed
+            h0 = PLAN_CACHE.hits
+            with ServeClient(srv.port, tenant="bench") as c:
+                warm_first_s = run_q1(c)
+            hit = PLAN_CACHE.hits - h0
+            prewarm_leg = {
+                "ledgered": prewarmed,
+                "replayed": replayed,
+                "hitOnRestart": 1.0 if hit >= 1 else 0.0,
+                "firstRequestCold_s": round(cold_first_s, 4),
+                "firstRequestWarm_s": round(warm_first_s, 4),
+                "restartSpeedup": round(cold_first_s / warm_first_s, 4),
+            }
+        finally:
+            srv.shutdown()
+    finally:
+        PC.set_prewarm_digests(set())
+        PLAN_CACHE.clear()
+        LC.reset_lifecycle()
+        R.reset_fault_injection()
+        H.reset_history()
+    return {
+        "skipped": False,
+        "clean_wall_s": round(clean_wall, 4),
+        "prewarm": prewarm_leg,
+        "kernelFallback": {
+            "flipped": 1.0 if flipped else 0.0,
+            "conf": kernel_key,
+            "postFlipWall_s": round(flipped_wall, 4),
+            "bitIdentical": True,  # run_q1 asserted it
+        },
+        "guard": guard,
+        "controller": stats_before_restart,
+    }
+
+
 def _adaptive_skew_query(spark):
     """A shuffled join with ONE hot key at ~20x the median partition
     (48 base keys spread the other partitions; the right side is small
@@ -2017,6 +2188,15 @@ def main():
         history_leg = {"skipped": True,
                        "reason": f"history leg failed: {e!r}"}
 
+    # self-tuning leg (docs/tuning.md): forced compileStorm pre-warm
+    # hit on restart, forced kernelFallback conf flip, injected
+    # harmful action auto-reverted by the guardrail
+    try:
+        tuning_leg = run_tuning(fused["wall_s"], cpu_rows)
+    except Exception as e:  # noqa: BLE001 - reported, not swallowed
+        tuning_leg = {"skipped": True,
+                      "reason": f"tuning leg failed: {e!r}"}
+
     # adaptive-execution leg (docs/adaptive.md): skewed-join replan
     # A/B, coalesce dispatch delta, same-signature batch-fusion QPS
     try:
@@ -2077,6 +2257,7 @@ def main():
             "telemetry": telemetry_leg,
             "lifecycle": lifecycle_leg,
             "history": history_leg,
+            "tuning": tuning_leg,
             "adaptive": adaptive_leg,
             "resultCache": result_cache_leg,
             "jitCaches": registry_snapshot()["jitCaches"],

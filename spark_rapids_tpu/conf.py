@@ -755,6 +755,57 @@ SERVE_SLO_WINDOW = conf("spark.rapids.sql.serve.slo.window").doc(
     "history's finished records newer than this."
     ).double(3600.0)
 
+SERVE_TUNING_ENABLED = conf("spark.rapids.sql.serve.tuning.enabled").doc(
+    "History-driven feedback control (docs/tuning.md): the server "
+    "embeds a TuningController that scores the query history through "
+    "the signature-aggregate + doctor verdict pipeline at start and "
+    "on a periodic tick, and applies bounded, logged, reversible "
+    "per-signature actions from the declared ACTION_CATALOG — cache "
+    "pre-warm for compile storms, admission narrowing / out-of-core "
+    "seeding for retry-spill shapes, culprit-kernel fallback flips, "
+    "and per-tenant admission weight shifts for SLO burn. Every "
+    "action lands in the history store as a tuning record, exports "
+    "as srt_tuning_* Prometheus families, and auto-reverts when the "
+    "post-action baseline regresses (tools tuning inspects/pins/"
+    "reverts). Requires telemetry.history.dir; off by default."
+    ).boolean(False)
+
+SERVE_TUNING_INTERVAL_S = conf(
+    "spark.rapids.sql.serve.tuning.intervalS").doc(
+    "Seconds between TuningController scan ticks (history scoring + "
+    "action application + guardrail evaluation). The start-of-server "
+    "scan always runs regardless (docs/tuning.md).").double(30.0)
+
+SERVE_TUNING_MAX_ACTIONS = conf(
+    "spark.rapids.sql.serve.tuning.maxActionsPerTick").doc(
+    "Ceiling on NEW tuning actions one scan tick may apply — the "
+    "controller converges knob by knob instead of rewriting the whole "
+    "server's posture from one noisy window (docs/tuning.md)."
+    ).integer(4)
+
+SERVE_TUNING_GUARD_WINDOW = conf(
+    "spark.rapids.sql.serve.tuning.guardWindowQueries").doc(
+    "Guardrail sample window: an applied action is judged once this "
+    "many post-action finished records exist for its scope — p50/p99 "
+    "over the window diffed against the pre-action baseline captured "
+    "in the action's evidence; a regression past "
+    "serve.tuning.revertThreshold auto-reverts the action "
+    "(docs/tuning.md).").integer(5)
+
+SERVE_TUNING_REVERT_THRESHOLD = conf(
+    "spark.rapids.sql.serve.tuning.revertThreshold").doc(
+    "Relative p50/p99 regression past which the guardrail reverts an "
+    "applied action — the same relative-change discipline tools "
+    "bench-diff gates on ((baseline - candidate) / baseline for "
+    "lower-is-better metrics; docs/tuning.md).").double(0.25)
+
+SERVE_TUNING_MAX_PREWARM = conf(
+    "spark.rapids.sql.serve.tuning.maxPrewarm").doc(
+    "Ceiling on the signatures the compile-storm pre-warm action may "
+    "hold in its replay ledger (and therefore on the planning replays "
+    "a server start performs) — startup cost stays bounded no matter "
+    "how storm-prone the history looks (docs/tuning.md).").integer(8)
+
 PARQUET_DEVICE_DECODE = conf(
     "spark.rapids.sql.format.parquet.deviceDecode.enabled").doc(
     "Decode Parquet pages ON DEVICE (the default scan path, the "

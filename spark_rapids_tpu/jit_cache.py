@@ -66,8 +66,40 @@ class JitCache:
         # get->put pattern on one thread is covered, which is every
         # caller in the package)
         self._miss_tls = threading.local()
+        # pre-warm protection (docs/tuning.md): an optional predicate
+        # over keys; protected entries are evicted LAST, so a
+        # storm-prone signature's programs survive capacity churn. The
+        # capacity bound always wins — when every resident entry is
+        # protected, plain LRU eviction resumes
+        self._protector: Optional[Callable[[Any], bool]] = None
         with _REG_LOCK:
             _CACHES[name] = self
+
+    def set_protector(self,
+                      pred: Optional[Callable[[Any], bool]]) -> None:
+        """Install (or clear, with None) the eviction-protection
+        predicate. The predicate runs under the cache lock — keep it
+        cheap (set membership)."""
+        with self._lock:
+            self._protector = pred
+
+    def _evict_locked(self) -> None:
+        while len(self._data) > self.capacity:
+            victim = None
+            if self._protector is not None:
+                for k in self._data:  # oldest-used first
+                    try:
+                        if not self._protector(k):
+                            victim = k
+                            break
+                    except Exception:
+                        victim = k
+                        break
+            if victim is None:
+                self._data.popitem(last=False)
+            else:
+                del self._data[victim]
+            self.evictions += 1
 
     def get(self, key) -> Optional[Any]:
         """Lookup, counting a hit or a miss; refreshes LRU order."""
@@ -97,9 +129,7 @@ class JitCache:
         with self._lock:
             self._data[key] = value
             self._data.move_to_end(key)
-            while len(self._data) > self.capacity:
-                self._data.popitem(last=False)
-                self.evictions += 1
+            self._evict_locked()
         return value
 
     def get_or_build(self, key, build: Callable[[], Any]
@@ -143,9 +173,7 @@ class JitCache:
             with self._lock:
                 self._data[key] = val
                 self._data.move_to_end(key)
-                while len(self._data) > self.capacity:
-                    self._data.popitem(last=False)
-                    self.evictions += 1
+                self._evict_locked()
             qt = _trace._ACTIVE
             if qt is not None:
                 qt.add("compile", t0, time.perf_counter_ns(),

@@ -224,6 +224,16 @@ def walk_calls(node: ast.AST) -> Iterator[ast.Call]:
             yield n
 
 
+def file_calls(fctx: "FileCtx") -> List[ast.Call]:
+    """Every Call node in the file, in ``ast.walk`` order, computed
+    once per file: ~a dozen rules iterate the whole tree's calls, and
+    re-walking 100+ trees per rule dominated the engine wall."""
+    cached = getattr(fctx, "_file_calls", None)
+    if cached is None:
+        cached = fctx._file_calls = list(walk_calls(fctx.tree))
+    return cached
+
+
 def defs_by_name(tree: ast.AST) -> Dict[str, List[ast.AST]]:
     out: Dict[str, List[ast.AST]] = {}
     for n in ast.walk(tree):

@@ -156,6 +156,9 @@ class LintConfig:
     # the query-history module whose HISTORY_FIELD_CATALOG the
     # history-field rule checks record construction against
     history_rel: str = "spark_rapids_tpu/telemetry/history.py"
+    # the feedback-control module whose ACTION_CATALOG the
+    # tuning-action rule checks action construction against
+    tuning_rel: str = "spark_rapids_tpu/telemetry/tuning.py"
     # generated docs compared against `tools docs` regeneration
     check_docs: bool = True
 
@@ -177,7 +180,7 @@ def load_config(root: str) -> LintConfig:
         data = json.load(f)
     for key in ("check_docs", "baseline", "jit_home", "kernels_home",
                 "metrics_rel", "trace_rel", "prometheus_rel",
-                "history_rel", "time_budget_s"):
+                "history_rel", "tuning_rel", "time_budget_s"):
         if key in data:
             setattr(cfg, key, data[key])
     for key in ("scan_roots", "retry_scope", "retry_wrappers",

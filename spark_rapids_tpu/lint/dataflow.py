@@ -419,7 +419,7 @@ def donation_sites(pctx, cg: CallGraph) -> List[DonationSite]:
                     return got
             return bindings.get(id(fctx.tree), {}).get(fname)
 
-        for call in A.walk_calls(fctx.tree):
+        for call in A.file_calls(fctx):
             f = call.func
             if isinstance(f, ast.Call):
                 ps = donated_positions(fctx, f)
@@ -447,7 +447,7 @@ def donation_sites(pctx, cg: CallGraph) -> List[DonationSite]:
                     params.index(root))
     if helper_donates:
         for fctx in pctx.files:
-            for call in A.walk_calls(fctx.tree):
+            for call in A.file_calls(fctx):
                 for tgt in cg.resolve_call(fctx, call):
                     ps = helper_donates.get(id(tgt.node))
                     if not ps:
@@ -489,6 +489,12 @@ _FORCING_PATHS = frozenset({"numpy.asarray", "numpy.array",
 
 
 def _jitcache_instance_names(fctx: A.FileCtx) -> Set[str]:
+    # memoized per file: device_taint calls this once per FUNCTION,
+    # and the whole-tree walk dominated the hidden-sync rule's wall
+    # (it is a pure function of the parsed tree)
+    cached = getattr(fctx, "_jitcache_names", None)
+    if cached is not None:
+        return cached
     out: Set[str] = set()
     for node in ast.walk(fctx.tree):
         if isinstance(node, ast.Assign) \
@@ -497,6 +503,7 @@ def _jitcache_instance_names(fctx: A.FileCtx) -> Set[str]:
             for t in node.targets:
                 if isinstance(t, ast.Name):
                     out.add(t.id)
+    fctx._jitcache_names = out
     return out
 
 
@@ -622,7 +629,7 @@ def traced_roots(pctx, cg: CallGraph
             if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                     and isinstance(node.targets[0], ast.Name):
                 assigns[node.targets[0].id] = node.value
-        for call in A.walk_calls(fctx.tree):
+        for call in A.file_calls(fctx):
             p = A.resolve_path(fctx, call.func)
             is_jit = p == "jax.jit"
             is_pallas = p is not None and (p == "pallas_call"

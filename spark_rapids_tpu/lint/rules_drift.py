@@ -32,9 +32,17 @@ runtime lints; docs/observability.md).
                   families are derived from registry keys, whose
                   describe_metric coverage the renderer enforces at
                   runtime (srt_undescribed_metric_keys must be 0).
-``docs-drift``  — docs/configs.md, docs/supported_ops.md and
-                  docs/observability.md must match `tools docs`
-                  regeneration byte-for-byte.
+``tuning-action`` — every action the TuningController constructs
+                  (literal first argument of a ``_new_action`` call in
+                  telemetry/tuning.py) must be an ``ACTION_CATALOG``
+                  key, and every ``spark.rapids.*`` knob declared in
+                  the catalog must be a registered conf key — the
+                  self-tuning loop can only ever actuate the declared,
+                  documented vocabulary (docs/tuning.md renders from
+                  the same dict).
+``docs-drift``  — docs/configs.md, docs/supported_ops.md,
+                  docs/observability.md and docs/tuning.md must match
+                  `tools docs` regeneration byte-for-byte.
 """
 
 from __future__ import annotations
@@ -154,7 +162,7 @@ def check_metric_keys(pctx):
     for fctx in pctx.files:
         if fctx.rel == table.metrics_rel:
             continue
-        for call in A.walk_calls(fctx.tree):
+        for call in A.file_calls(fctx):
             if A.call_tail(call) not in _METRIC_SINKS or not call.args:
                 continue
             if not isinstance(call.func, ast.Attribute):
@@ -176,7 +184,7 @@ def check_conf_keys(pctx):
     registered: Set[str] = set()
     reg_nodes: Set[int] = set()
     for fctx in pctx.files:
-        for call in A.walk_calls(fctx.tree):
+        for call in A.file_calls(fctx):
             if A.call_tail(call) == "conf" and len(call.args) >= 1 \
                     and isinstance(call.args[0], ast.Constant) \
                     and isinstance(call.args[0].value, str) \
@@ -218,7 +226,7 @@ def check_span_scope(pctx):
     for fctx in pctx.files:
         if fctx.rel == cfg.trace_rel:
             continue
-        for call in A.walk_calls(fctx.tree):
+        for call in A.file_calls(fctx):
             if A.call_tail(call) != "span":
                 continue
             if not isinstance(call.func, ast.Attribute):
@@ -260,7 +268,7 @@ def check_span_kinds(pctx):
     for fctx in pctx.files:
         if fctx.rel == cfg.trace_rel:
             continue
-        for call in A.walk_calls(fctx.tree):
+        for call in A.file_calls(fctx):
             tail = A.call_tail(call)
             if tail in ("span", "instant"):
                 if not isinstance(call.func, ast.Attribute) or \
@@ -387,6 +395,101 @@ def check_history_fields(pctx):
                 yield from _check_key(t.slice, t.lineno, t.col_offset)
 
 
+def _action_catalog(fctx: A.FileCtx):
+    """Parse ``ACTION_CATALOG`` from the tuning module's AST: the set
+    of action names, and the knob strings each declares (the ``knob``
+    value plus every ``knobs`` list member). Returns (names, knobs,
+    lineno) or None when the module has no parseable catalog."""
+    for stmt in fctx.tree.body:
+        if isinstance(stmt, ast.AnnAssign):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        else:
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "ACTION_CATALOG"
+                   for t in targets):
+            continue
+        value = stmt.value
+        if not isinstance(value, ast.Dict):
+            return None
+        names: Set[str] = set()
+        knobs: List[Tuple[str, int]] = []
+        for k, v in zip(value.keys, value.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                names.add(k.value)
+            if not isinstance(v, ast.Dict):
+                continue
+            for fk, fv in zip(v.keys, v.values):
+                if not (isinstance(fk, ast.Constant)
+                        and fk.value in ("knob", "knobs")):
+                    continue
+                elts = fv.elts if isinstance(fv, (ast.List,
+                                                  ast.Tuple)) else [fv]
+                for e in elts:
+                    if isinstance(e, ast.Constant) and isinstance(
+                            e.value, str):
+                        knobs.append((e.value, e.lineno))
+        return names, knobs, stmt.lineno
+    return None
+
+
+@rule("tuning-action",
+      "TuningController actions must be ACTION_CATALOG entries and "
+      "catalog conf knobs must be registered conf keys")
+def check_tuning_actions(pctx):
+    cfg = pctx.config
+    tfctx = pctx.file(cfg.tuning_rel)
+    if tfctx is None:
+        return
+    parsed = _action_catalog(tfctx)
+    if parsed is None:
+        return  # no catalog in this tree (fixture runs)
+    names, knobs, cat_lineno = parsed
+    # 1. every spark.rapids.* knob the catalog declares must be a
+    # registered conf key (same registry walk as conf-key)
+    registered: Set[str] = set()
+    for fctx in pctx.files:
+        for call in A.file_calls(fctx):
+            if A.call_tail(call) == "conf" and len(call.args) >= 1 \
+                    and isinstance(call.args[0], ast.Constant) \
+                    and isinstance(call.args[0].value, str) \
+                    and call.args[0].value.startswith("spark.rapids."):
+                registered.add(call.args[0].value)
+    if registered:
+        for knob, lineno in knobs:
+            if knob.startswith("spark.rapids.") \
+                    and knob not in registered:
+                yield Finding(
+                    "tuning-action", tfctx.rel, lineno, 1,
+                    f"ACTION_CATALOG knob {knob!r} is not a "
+                    f"registered conf.py key — the controller would "
+                    f"actuate a conf nothing reads")
+    # 2. every action the controller constructs resolves in the
+    # catalog, and only through a literal name the table can cover
+    for call in A.walk_calls(tfctx.tree):
+        if A.call_tail(call) != "_new_action" or not call.args:
+            continue
+        arg = call.args[0]
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            yield Finding(
+                "tuning-action", tfctx.rel, call.lineno,
+                call.col_offset + 1,
+                "action name must be a string literal (the "
+                "ACTION_CATALOG table and docs/tuning.md cannot cover "
+                "a dynamic name)")
+            continue
+        if arg.value not in names:
+            yield Finding(
+                "tuning-action", tfctx.rel, call.lineno,
+                call.col_offset + 1,
+                f"action {arg.value!r} has no ACTION_CATALOG entry "
+                f"(declared at line {cat_lineno}) — add it (verdict, "
+                f"knob, bounds, doc) so code, lint and docs/tuning.md "
+                f"share one vocabulary")
+
+
 @rule("docs-drift",
       "generated docs must match `tools docs` regeneration")
 def check_docs_drift(pctx):
@@ -408,11 +511,13 @@ def check_docs_drift(pctx):
     import spark_rapids_tpu.trace  # noqa: F401 — registers confs
     from spark_rapids_tpu.conf import generate_docs
     from spark_rapids_tpu.tools import (generate_observability_docs,
-                                        generate_supported_ops)
+                                        generate_supported_ops,
+                                        generate_tuning_docs)
     for fname, gen in (("configs.md", generate_docs),
                        ("supported_ops.md", generate_supported_ops),
                        ("observability.md",
-                        generate_observability_docs)):
+                        generate_observability_docs),
+                       ("tuning.md", generate_tuning_docs)):
         path = os.path.join(docs_dir, fname)
         if not os.path.exists(path):
             continue

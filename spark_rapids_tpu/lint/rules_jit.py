@@ -91,7 +91,7 @@ def _builder_closure(pctx) -> Dict[str, Set[int]]:
 
     for fctx in pctx.files:
         caches = _jitcache_names(fctx)
-        for call in A.walk_calls(fctx.tree):
+        for call in A.file_calls(fctx):
             tail = A.call_tail(call)
             if tail == "put" and isinstance(call.func, ast.Attribute) \
                     and isinstance(call.func.value, ast.Name) \
@@ -144,7 +144,7 @@ def check_jit_direct(pctx):
             continue
         in_kernels = fctx.rel.startswith(kernels_home.rstrip("/") + "/")
         file_builders = builders.get(fctx.rel, set())
-        for call in A.walk_calls(fctx.tree):
+        for call in A.file_calls(fctx):
             is_jit = _is_jax_jit(fctx, call)
             is_pallas = not is_jit and _is_pallas_call(fctx, call)
             if not (is_jit or is_pallas):

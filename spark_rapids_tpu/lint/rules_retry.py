@@ -31,7 +31,7 @@ def _covered_nodes(fctx: A.FileCtx, wrappers) -> Set[int]:
     covered: Set[int] = set()
     covered_names: Set[str] = set()
     by_name = A.defs_by_name(fctx.tree)
-    for call in A.walk_calls(fctx.tree):
+    for call in A.file_calls(fctx):
         if A.call_tail(call) not in wrappers:
             continue
         for arg in A.call_args(call):
@@ -86,7 +86,7 @@ def check_retry_coverage(pctx):
         if not pctx.in_scope(fctx.rel, cfg.retry_scope):
             continue
         covered = _covered_nodes(fctx, wrappers)
-        for call in A.walk_calls(fctx.tree):
+        for call in A.file_calls(fctx):
             tail = A.call_tail(call)
             if tail not in entry:
                 continue

@@ -292,3 +292,37 @@ def get_or_clone(signature: str, build,
 
 def stats() -> Dict[str, int]:
     return PLAN_CACHE.stats()
+
+
+# ---------------------------------------------------------------------------
+# Pre-warm (docs/tuning.md)
+# ---------------------------------------------------------------------------
+
+# signature digests the TuningController flagged compile-storm-prone:
+# resident templates for these shapes are evicted LAST (the JitCache
+# protector below), and the controller's start-of-server replay plans
+# their recorded SQL so the template exists before the first client
+# hits it. History records carry digests, not full signatures, so the
+# protection set is digest-keyed.
+_PREWARM_LOCK = threading.Lock()
+_PREWARM_DIGESTS: set = set()
+
+
+def _prewarm_protected(key) -> bool:
+    return isinstance(key, str) and \
+        signature_digest(key) in _PREWARM_DIGESTS
+
+
+def set_prewarm_digests(digests) -> None:
+    """Install the pre-warm protection set (the whole set each call —
+    the controller owns the membership); empty clears protection."""
+    with _PREWARM_LOCK:
+        _PREWARM_DIGESTS.clear()
+        _PREWARM_DIGESTS.update(str(d) for d in digests)
+        active = bool(_PREWARM_DIGESTS)
+    PLAN_CACHE.set_protector(_prewarm_protected if active else None)
+
+
+def prewarm_digests() -> set:
+    with _PREWARM_LOCK:
+        return set(_PREWARM_DIGESTS)

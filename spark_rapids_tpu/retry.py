@@ -189,6 +189,14 @@ class FaultInjector:
         self._budget = None
         if self._oom is not None and self._oom.site == "budget":
             self._budget, self._oom = self._oom, None
+        # `site:tuning:N` is the FEEDBACK-CONTROL leg (docs/tuning.md):
+        # the schedule counts TuningController scan ticks, and the
+        # injected fault is a deliberately harmful synthetic action —
+        # never an error — so the guardrail's auto-revert path is
+        # deterministically testable end to end
+        self._tuning = None
+        if self._oom is not None and self._oom.site == "tuning":
+            self._tuning, self._oom = self._oom, None
         self._io = _parse_schedule(io_spec)
         self._chips = set()
         for part in str(chip_spec or "").split(","):
@@ -202,12 +210,14 @@ class FaultInjector:
         self._io_streak = 0
         self._cancel_count = 0
         self._budget_count = 0
+        self._tuning_count = 0
         # observability (bench detail.robustness, tests)
         self.oom_injected = 0
         self.io_injected = 0
         self.chip_failures_injected = 0
         self.cancels_injected = 0
         self.budget_faults_injected = 0
+        self.tuning_faults_injected = 0
 
     def _fire(self, sched: _Schedule, count: int) -> bool:
         if sched.prob > 0.0:
@@ -304,6 +314,23 @@ class FaultInjector:
             self.budget_faults_injected += 1
             return True
 
+    def on_tuning_tick(self) -> bool:
+        """Checkpoint at one TuningController scan tick. A
+        ``site:tuning:N`` schedule returns True at the Nth tick — the
+        controller then applies a deliberately HARMFUL synthetic action
+        (docs/tuning.md) so the guardrail's observe-and-revert loop is
+        exercised without waiting for a real bad decision (never an
+        error: the fault is a bad action, and reverting it IS the
+        behavior under test)."""
+        if self._tuning is None or _suppressed():
+            return False
+        with self._lock:
+            self._tuning_count += 1
+            if not self._fire(self._tuning, self._tuning_count):
+                return False
+            self.tuning_faults_injected += 1
+            return True
+
     def stats(self) -> dict:
         with self._lock:
             return {"allocations": self._alloc_count,
@@ -311,7 +338,8 @@ class FaultInjector:
                     "ioInjected": self.io_injected,
                     "chipFailuresInjected": self.chip_failures_injected,
                     "cancelsInjected": self.cancels_injected,
-                    "budgetFaultsInjected": self.budget_faults_injected}
+                    "budgetFaultsInjected": self.budget_faults_injected,
+                    "tuningFaultsInjected": self.tuning_faults_injected}
 
 
 _INJECTOR: Optional[FaultInjector] = None

@@ -55,11 +55,17 @@ from spark_rapids_tpu.lifecycle import percentile  # noqa: E402,F401
 
 
 class _Ticket:
-    __slots__ = ("seq", "tenant")
+    __slots__ = ("seq", "tenant", "signature")
 
-    def __init__(self, seq: int, tenant: str):
+    def __init__(self, seq: int, tenant: str,
+                 signature: Optional[str] = None):
         self.seq = seq
         self.tenant = tenant
+        # signature digest HINT (docs/tuning.md): the plan signature
+        # is only known after planning, so admission-time signature
+        # policy runs off the server's learned sql->digest map; an
+        # unhinted query is never signature-throttled
+        self.signature = signature
 
 
 class AdmissionController:
@@ -73,6 +79,14 @@ class AdmissionController:
         self._in_flight = 0
         self._tenant_flight: Dict[str, int] = {}
         self._shutdown = False
+        # TuningController actuators (docs/tuning.md): per-signature
+        # concurrency ceilings (digest -> limit; a retrySpill action
+        # narrows a thrashing shape) and per-tenant admission weights
+        # (weight scales the per-tenant cap; an sloBurn action widens
+        # a burning tenant before its p99 objective breaches)
+        self._sig_limits: Dict[str, int] = {}
+        self._sig_flight: Dict[str, int] = {}
+        self._weights: Dict[str, float] = {}
         # server metrics (docs/serving.md): admitted/rejected totals,
         # per-tenant counts, queue-wait reservoirs
         self.admitted = 0
@@ -81,6 +95,40 @@ class AdmissionController:
         self._tenant_admitted: Dict[str, int] = {}
         self._tenant_rejected: Dict[str, int] = {}
         self._tenant_waits: Dict[str, List[float]] = {}
+
+    # -- tuning actuators --------------------------------------------------
+
+    def set_signature_limit(self, digest: str,
+                            limit: Optional[int]) -> None:
+        """Cap in-flight queries for one signature digest (None or a
+        non-positive limit clears the cap). The caller (the
+        TuningController's ACTION_CATALOG clamps) owns bounding."""
+        with self._cv:
+            if limit is None or int(limit) <= 0:
+                self._sig_limits.pop(digest, None)
+            else:
+                self._sig_limits[digest] = int(limit)
+            self._cv.notify_all()
+
+    def signature_limit(self, digest: str) -> Optional[int]:
+        with self._cv:
+            return self._sig_limits.get(digest)
+
+    def set_tenant_weight(self, tenant: str,
+                          weight: Optional[float]) -> None:
+        """Scale one tenant's per-tenant concurrency cap (1.0 or None
+        clears). The effective cap is max(1, round(maxConcurrentPerTenant
+        * weight)) — bounded below so no weight can starve a tenant."""
+        with self._cv:
+            if weight is None or abs(float(weight) - 1.0) < 1e-9:
+                self._weights.pop(tenant, None)
+            else:
+                self._weights[tenant] = float(weight)
+            self._cv.notify_all()
+
+    def tenant_weight(self, tenant: str) -> float:
+        with self._cv:
+            return self._weights.get(tenant, 1.0)
 
     # -- policy ------------------------------------------------------------
 
@@ -94,8 +142,23 @@ class AdmissionController:
         except Exception:
             return {}
 
+    def _tenant_cap(self, tenant: str) -> int:
+        w = self._weights.get(tenant)
+        if w is None:
+            return self.max_per_tenant
+        return max(1, int(round(self.max_per_tenant * w)))
+
     def _tenant_ok(self, tenant: str) -> bool:
-        return self._tenant_flight.get(tenant, 0) < self.max_per_tenant
+        return self._tenant_flight.get(tenant, 0) < \
+            self._tenant_cap(tenant)
+
+    def _sig_ok(self, signature: Optional[str]) -> bool:
+        if not signature:
+            return True
+        limit = self._sig_limits.get(signature)
+        if limit is None:
+            return True
+        return self._sig_flight.get(signature, 0) < limit
 
     def _count_rejection(self, tenant: str) -> None:
         """Every wire-level rejection (queue full OR shutdown) counts;
@@ -109,6 +172,11 @@ class AdmissionController:
             return False
         if not self._tenant_ok(tk.tenant):
             return False
+        if not self._sig_ok(tk.signature):
+            # tuning signature cap: the shape yields its slot without
+            # blocking anything behind it (same no-head-of-line rule
+            # as the per-tenant cap)
+            return False
         others_waiting = any(e.tenant != tk.tenant for e in self._queue)
         if tk.tenant in over and others_waiting:
             # fair-share throttle: over-share tenants yield the slot
@@ -120,7 +188,8 @@ class AdmissionController:
         for e in self._queue:
             if e is tk:
                 return True
-            if self._tenant_ok(e.tenant) and not (
+            if self._tenant_ok(e.tenant) and self._sig_ok(e.signature) \
+                    and not (
                     e.tenant in over and any(
                         o.tenant != e.tenant for o in self._queue
                         if o is not e)):
@@ -129,14 +198,17 @@ class AdmissionController:
 
     # -- acquire/release ---------------------------------------------------
 
-    def acquire(self, tenant: str, token=None) -> float:
+    def acquire(self, tenant: str, token=None,
+                signature: Optional[str] = None) -> float:
         """Block until the query may execute; returns the queue wait in
         seconds. Raises QueryRejected when the queue is full (the
         backpressure path) or the server is shutting down. With a
         lifecycle ``token``, a cancellation or deadline expiry WHILE
         QUEUED raises TpuQueryCancelled and releases the queue slot —
         deadlines are enforced from admission time (docs/serving.md
-        "Query lifecycle")."""
+        "Query lifecycle"). ``signature`` is the learned digest hint
+        the tuning signature caps key on; release() must receive the
+        same hint."""
         t0 = time.perf_counter()
         throttled = False
         with self._cv:
@@ -144,7 +216,7 @@ class AdmissionController:
                 self._count_rejection(tenant)
                 raise QueryRejected("server is shutting down")
             self._seq += 1
-            tk = _Ticket(self._seq, tenant)
+            tk = _Ticket(self._seq, tenant, signature)
             self._queue.append(tk)
             # telemetry queue-saturation trigger (enqueue only — the
             # bundle writer runs on its own thread, never under _cv)
@@ -191,6 +263,9 @@ class AdmissionController:
             self._in_flight += 1
             self._tenant_flight[tenant] = \
                 self._tenant_flight.get(tenant, 0) + 1
+            if signature:
+                self._sig_flight[signature] = \
+                    self._sig_flight.get(signature, 0) + 1
             self.admitted += 1
             self._tenant_admitted[tenant] = \
                 self._tenant_admitted.get(tenant, 0) + 1
@@ -247,7 +322,8 @@ class AdmissionController:
             return bool(self._queue) or \
                 self._in_flight >= self.max_concurrent
 
-    def release(self, tenant: str) -> None:
+    def release(self, tenant: str,
+                signature: Optional[str] = None) -> None:
         with self._cv:
             self._in_flight -= 1
             n = self._tenant_flight.get(tenant, 0) - 1
@@ -255,6 +331,12 @@ class AdmissionController:
                 self._tenant_flight[tenant] = n
             else:
                 self._tenant_flight.pop(tenant, None)
+            if signature:
+                s = self._sig_flight.get(signature, 0) - 1
+                if s > 0:
+                    self._sig_flight[signature] = s
+                else:
+                    self._sig_flight.pop(signature, None)
             self._cv.notify_all()
 
     def begin_shutdown(self) -> None:
@@ -304,6 +386,8 @@ class AdmissionController:
                 "rejected": self.rejected,
                 "throttledWaits": self.throttled_waits,
                 "tenants": per_tenant,
+                "signatureLimits": dict(self._sig_limits),
+                "tenantWeights": dict(self._weights),
             }
 
 

@@ -40,7 +40,8 @@ from spark_rapids_tpu.conf import (RESULT_CACHE_ENABLED,
                                    SERVE_BATCH_FUSION_ENABLED,
                                    SERVE_BATCH_FUSION_MAX_BATCH,
                                    SERVE_BATCH_FUSION_WINDOW_MS,
-                                   SERVE_HOST, SERVE_PORT, TpuConf)
+                                   SERVE_HOST, SERVE_PORT,
+                                   SERVE_TUNING_ENABLED, TpuConf)
 from spark_rapids_tpu.serve import protocol
 from spark_rapids_tpu.serve.scheduler import (AdmissionController,
                                               QueryRejected, percentile)
@@ -147,6 +148,19 @@ class QueryServer:
         self._history = _history.store_for(cobj)
         self._slo = _history.SloTracker(cobj)
         self.warm_start_summary: Dict = {"enabled": False}
+        # history-driven feedback control (docs/tuning.md): when OFF
+        # (the default) the controller is never constructed and every
+        # request takes the untouched path
+        self._tuning = None
+        if self._history is not None and \
+                bool(cobj.get(SERVE_TUNING_ENABLED)):
+            from spark_rapids_tpu.telemetry.tuning import \
+                TuningController
+            self._tuning = TuningController(
+                cobj, admission=self._admission, slo=self._slo,
+                session_for=self._session,
+                set_conf=self._set_conf_key,
+                get_conf=self._get_conf_key)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -182,6 +196,12 @@ class QueryServer:
         # the client-disconnect monitor (always on — a vanished client
         # must not pin its admission slot/permit/ledger)
         self._watchdog.start()
+        # feedback control (docs/tuning.md): re-apply persisted
+        # actions, replay the pre-warm ledger (views registered before
+        # start() are visible to the replay sessions), run the
+        # start-of-server scan, then tick periodically
+        if self._tuning is not None:
+            self._tuning.start()
         self._disco_thread = threading.Thread(
             target=self._disconnect_monitor, name="srt-serve-disco",
             daemon=True)
@@ -210,6 +230,8 @@ class QueryServer:
         self._stopping.set()
         self._admission.begin_shutdown()
         self._watchdog.stop()
+        if self._tuning is not None:
+            self._tuning.stop()
         from spark_rapids_tpu.telemetry import triggers as _telemetry
         _telemetry.set_stats_provider(None)
         if self._metrics_httpd is not None:
@@ -337,6 +359,34 @@ class QueryServer:
         for name, (fmt, path) in missed.items():
             self._apply_view(s, name, fmt, path)
         return s
+
+    # -- tuning conf hooks (docs/tuning.md) --------------------------------
+
+    def _get_conf_key(self, key: str):
+        """Current server-wide value of a conf knob (None = unset)."""
+        return self._base_conf.get(key)
+
+    def _set_conf_key(self, key: str, value) -> None:
+        """Server-wide conf write for TuningController actions: the
+        base conf covers future sessions, live sessions update in
+        place (execution-time reads follow immediately; a changed
+        signature-relevant key — kernel.*.enabled — starts a NEW
+        signature history, the kernelFallback action's re-baseline)."""
+        with self._sessions_lock:
+            if value is None:
+                self._base_conf.pop(key, None)
+            else:
+                self._base_conf[key] = str(value)
+            sessions = list(self._sessions.values())
+        if value is None:
+            self._conf_obj.settings.pop(key, None)
+        else:
+            self._conf_obj.set(key, str(value))
+        for s in sessions:
+            if value is None:
+                s.conf_obj.settings.pop(key, None)
+            else:
+                s.conf_obj.set(key, str(value))
 
     # -- request handling --------------------------------------------------
 
@@ -580,8 +630,15 @@ class QueryServer:
                 self._handle_sql_fused(conn, tenant, sql, session,
                                        token, tok, t_req)
                 return
+            # per-signature admission shaping (docs/tuning.md):
+            # planning resolves the signature only AFTER admission, so
+            # the controller supplies a hint from shapes it has seen —
+            # never-seen text admits unshaped, exactly once
+            sig_hint = (self._tuning.signature_hint(sql)
+                        if self._tuning is not None else None)
             try:
-                wait_s = self._admission.acquire(tenant, token=token)
+                wait_s = self._admission.acquire(tenant, token=token,
+                                                 signature=sig_hint)
                 # the watchdog measures RUNNING time from here, not
                 # from request receipt (queue wait must not make a
                 # healthy query look stuck under load)
@@ -626,6 +683,11 @@ class QueryServer:
                 # bytes the client is about to receive
                 self._maybe_cache_result(session, sql, payload,
                                          batch.num_rows)
+                if self._tuning is not None:
+                    # sql<->signature learning: feeds the admission
+                    # hint above and the pre-warm ledger's SQL replay
+                    self._tuning.observe(
+                        sql, session.thread_plan_signature(), tenant)
                 resp = {
                     "status": "ok",
                     "tenant": tenant,
@@ -679,7 +741,7 @@ class QueryServer:
                     "status": "error", "tenant": tenant,
                     "error": f"{type(e).__name__}: {e}"})
             finally:
-                self._admission.release(tenant)
+                self._admission.release(tenant, signature=sig_hint)
         finally:
             self._untrack(conn, token)
 
@@ -876,6 +938,12 @@ class QueryServer:
             # ran) — hits increasingly bypass fusion anyway
             self._maybe_cache_result(session, sql, payload,
                                      batch.num_rows)
+            if self._tuning is not None:
+                # sql<->signature learning (docs/tuning.md): same
+                # thread-locality constraint as the result-cache
+                # capture above
+                self._tuning.observe(
+                    sql, session.thread_plan_signature(), tenant)
         resp = {
             "status": "ok",
             "tenant": tenant,
@@ -981,4 +1049,6 @@ class QueryServer:
                               "warmStart": self.warm_start_summary}
         if self._slo.enabled:
             out["slo"] = self._slo.evaluate()
+        if self._tuning is not None:
+            out["tuning"] = self._tuning.stats()
         return out

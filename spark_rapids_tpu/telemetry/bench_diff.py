@@ -110,6 +110,14 @@ CHECKS: List[Tuple[str, str, bool, str]] = [
      "tools doctor round-trip latency"),
     ("detail.history.doctor.stormWall_s", "lower", False,
      "forced retry-storm wall (doctor leg)"),
+    ("detail.tuning.prewarm.hitOnRestart", "higher", False,
+     "tuning pre-warm plan-cache hit on restart"),
+    ("detail.tuning.prewarm.restartSpeedup", "higher", False,
+     "tuning pre-warm first-request restart speedup"),
+    ("detail.tuning.kernelFallback.flipped", "higher", False,
+     "tuning kernel-fallback conf flip applied"),
+    ("detail.tuning.guard.autoReverted", "higher", False,
+     "tuning guardrail auto-revert of the injected harmful action"),
 ]
 
 

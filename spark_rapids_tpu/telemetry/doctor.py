@@ -272,6 +272,14 @@ def diagnose(history_dir: str, selector: str) -> Dict[str, Any]:
     if target is None:
         return {"error": f"no history record matches {selector!r} "
                          f"in {history_dir}"}
+    return diagnose_record(records, target)
+
+
+def diagnose_record(records: List[Dict[str, Any]],
+                    target: Dict[str, Any]) -> Dict[str, Any]:
+    """Diagnose one already-resolved record against an already-loaded
+    record list — the store is read ONCE however many signatures the
+    batch scan walks."""
     sig = target.get("signature")
     base = _baseline(records, target)
 
@@ -464,6 +472,59 @@ def diagnose(history_dir: str, selector: str) -> Dict[str, Any]:
         "verdicts": verdicts,
         "verdict": verdicts[0]["class"] if verdicts else "unknown",
     }
+
+
+def scan_signatures(history_dir: str, top: int = 10
+                    ) -> List[Dict[str, Any]]:
+    """Batch doctor (`tools doctor --all`; the TuningController's scan
+    loop runs the same walk): diagnose the NEWEST executed finished
+    record of every signature in the store against that signature's
+    baseline and rank regressed shapes worst-first (regressed before
+    not, then by slowdown). One store read covers the whole scan."""
+    records = read_records(history_dir)
+    newest: Dict[str, Dict[str, Any]] = {}
+    for r in records:  # chronological — the last write wins
+        sig = r.get("signature")
+        if not sig or r.get("status") != STATUS_FINISHED \
+                or r.get("resultCacheHit"):
+            continue
+        newest[sig] = r
+    scans: List[Dict[str, Any]] = []
+    for sig, rec in newest.items():
+        d = diagnose_record(records, rec)
+        d["signatureFull"] = sig
+        scans.append(d)
+    scans.sort(key=lambda d: (not d.get("regressed"),
+                              -(d.get("slowdown") or 0.0),
+                              d.get("signature") or ""))
+    return scans[:max(1, int(top))]
+
+
+def format_scan(scans: List[Dict[str, Any]]) -> str:
+    """The `tools doctor --all` table: one row per scanned signature,
+    worst regression first."""
+    lines = ["=== TPU Query Doctor (batch scan) ===",
+             f"{len(scans)} signature(s) scanned", ""]
+    if not scans:
+        lines.append("no finished signatured records found")
+        return "\n".join(lines)
+    lines.append(
+        f"  {'signature':14s} {'tenant':10s} {'verdict':14s} "
+        f"{'x p50':>7s} {'wall_s':>8s} {'base_p50':>9s}  "
+        f"divergent stage")
+    for d in scans:
+        slow = d.get("slowdown")
+        b = d.get("baseline", {})
+        mark = " <-- regressed" if d.get("regressed") else ""
+        lines.append(
+            f"  {d.get('signature') or '-':14s} "
+            f"{(d.get('tenant') or '-'):10s} "
+            f"{d.get('verdict'):14s} "
+            f"{(f'{slow:.2f}' if slow else '-'):>7s} "
+            f"{d.get('wallSeconds', 0):8.3f} "
+            f"{b.get('wallP50', 0):9.3f}  "
+            f"{d.get('divergentStage') or '-'}{mark}")
+    return "\n".join(lines)
 
 
 def format_diagnosis(d: Dict[str, Any]) -> str:

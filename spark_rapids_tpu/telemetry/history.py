@@ -69,6 +69,17 @@ STATUS_FAILED = "failed"
 HISTORY_STATUSES = (STATUS_FINISHED, STATUS_CANCELLED, STATUS_TIMED_OUT,
                     STATUS_QUARANTINED, STATUS_FAILED)
 
+# control-plane statuses: TuningController audit records (an applied
+# action / a guardrail or manual rollback). They live in the SAME
+# store as query records — the audit trail rides the store's
+# durability and compaction — but they are NOT query outcomes:
+# aggregates, SLO windows, doctor baselines, and warm-start replay all
+# exclude them, the same discipline as cache-served records
+# (docs/tuning.md).
+STATUS_TUNING = "tuning"
+STATUS_REVERT = "revert"
+TUNING_STATUSES = (STATUS_TUNING, STATUS_REVERT)
+
 # The record schema. Every field a record construction site in this
 # module writes MUST be a key here (tpu-lint `history-field`), and the
 # generated observability doc renders this table — the store's on-disk
@@ -133,6 +144,22 @@ HISTORY_FIELD_CATALOG: Dict[str, str] = {
                         "— docs/out_of_core.md); the doctor uses this "
                         "to classify planned big-input spill as "
                         "biggerInput rather than retrySpill",
+    "action": "tuning/revert records: the ACTION_CATALOG action name "
+              "(docs/tuning.md)",
+    "scope": "tuning/revert records: what the action applied to — a "
+             "signature digest or tenant:<id>",
+    "knob": "tuning/revert records: the knob the action wrote (a "
+            "registered conf key, or an internal knob like "
+            "signatureConcurrency / tenantWeight / prewarm)",
+    "oldValue": "tuning/revert records: the knob value before the "
+                "write (what a revert restores)",
+    "newValue": "tuning/revert records: the clamped knob value after "
+                "the write",
+    "evidence": "tuning/revert records: why — the verdict, baseline "
+                "p50/p99, and the observed window that motivated the "
+                "action or triggered the rollback",
+    "epoch": "tuning/revert records: the controller's monotonic "
+             "action id (tools tuning pins/reverts by it)",
 }
 
 
@@ -412,6 +439,35 @@ def build_record(*, status: str, reason: Optional[str] = None,
     return rec
 
 
+def build_tuning_record(*, status: str, action: str, scope: str,
+                        knob: str, old_value, new_value,
+                        evidence: Dict[str, Any], epoch: int,
+                        tenant: Optional[str] = None,
+                        signature: Optional[str] = None
+                        ) -> Dict[str, Any]:
+    """One TuningController audit record (status ``tuning`` or
+    ``revert``). Lives in history.py so the ``history-field`` lint
+    rule pins its fields to HISTORY_FIELD_CATALOG like every other
+    record construction site."""
+    rec: Dict[str, Any] = {
+        "version": HISTORY_VERSION,
+        "ts": time.time(),
+        "status": status,
+        "action": action,
+        "scope": scope,
+        "knob": knob,
+        "oldValue": old_value,
+        "newValue": new_value,
+        "evidence": evidence,
+        "epoch": int(epoch),
+    }
+    if tenant:
+        rec["tenant"] = tenant
+    if signature:
+        rec["signature"] = signature
+    return rec
+
+
 def record_query_close(conf_obj, **kwargs) -> None:
     """Append one query-close record when history is configured; the
     session's and the server's shared write hook. Never raises."""
@@ -518,6 +574,11 @@ def signature_aggregates(records: List[Dict[str, Any]]
     numbers; every terminal status counts in the histogram."""
     by_sig: Dict[str, List[Dict[str, Any]]] = {}
     for r in records:
+        if r.get("status") in TUNING_STATUSES:
+            # controller audit records carry the signature they acted
+            # on but are not query outcomes: counting them would make
+            # the aggregates differ with tuning on vs off
+            continue
         sig = r.get("signature")
         if sig:
             by_sig.setdefault(sig, []).append(r)
@@ -615,6 +676,8 @@ def find_record(records: List[Dict[str, Any]], selector: str
         if str(r.get("queryId")) == sel:
             return r
     for r in reversed(records):
+        if r.get("status") in TUNING_STATUSES:
+            continue  # audit records are not diagnosable queries
         sig = r.get("signature")
         if sig and (sig_digest(sig).startswith(sel)
                     or sig.startswith(sel)):
@@ -676,6 +739,8 @@ def warm_start(conf_obj) -> Dict[str, Any]:
     out["enabled"] = True
     out["records"] = len(records)
     for rec in records:  # chronological: streaks replay in order
+        if rec.get("status") in TUNING_STATUSES:
+            continue  # controller audit rows never seed lifecycle
         sig = rec.get("signature")
         if not sig:
             continue
@@ -755,6 +820,8 @@ class SloTracker:
         by_tenant: Dict[str, List[float]] = {}
         for rec in read_records(self._dir, since=since):
             if rec.get("status") != STATUS_FINISHED:
+                # non-query statuses — including the controller's
+                # tuning/revert audit records — never enter the window
                 continue
             if rec.get("resultCacheHit"):
                 # cache-served queries are excluded from the SLO

@@ -121,6 +121,26 @@ SERVER_FAMILY_HELP: Dict[str, Tuple[str, str]] = {
     "srt_slo_burn_ratio": (
         "gauge", "fraction of the tenant's window queries over its "
                  "SLO objective"),
+    "srt_tuning_ticks_total": (
+        "counter", "TuningController scan ticks run (start-of-server "
+                   "scan included; docs/tuning.md)"),
+    "srt_tuning_actions_total": (
+        "counter", "tuning actions applied, labeled by ACTION_CATALOG "
+                   "action name"),
+    "srt_tuning_reverts_total": (
+        "counter", "tuning actions rolled back (guardrail "
+                   "auto-reverts + operator reverts via tools "
+                   "tuning)"),
+    "srt_tuning_active_actions": (
+        "gauge", "actions currently in effect (state applied or "
+                 "accepted)"),
+    "srt_tuning_pinned_actions": (
+        "gauge", "actions pinned by the operator (exempt from the "
+                 "guardrail's auto-revert)"),
+    "srt_tuning_prewarmed_signatures": (
+        "gauge", "signatures in the pre-warm ledger (plan templates "
+                 "replayed at server start and protected from LRU "
+                 "eviction)"),
     "srt_undescribed_metric_keys": (
         "gauge", "registry metric keys that did not resolve via "
                  "describe_metric and were NOT exported (must be 0)"),
@@ -488,6 +508,24 @@ def render_prometheus(server_stats: Optional[Dict] = None) -> str:
                          slo.get("violations", 0), lab)
             _emit_server(out, "srt_slo_burn_ratio",
                          float(slo.get("burnRatio", 0.0)), lab)
+        # feedback control (docs/tuning.md): present only when the
+        # server runs with serve.tuning.enabled
+        tun = server_stats.get("tuning")
+        if tun:
+            _emit_server(out, "srt_tuning_ticks_total",
+                         tun.get("ticks", 0))
+            for action, n in sorted(
+                    (tun.get("actionsByName") or {}).items()):
+                _emit_server(out, "srt_tuning_actions_total", n,
+                             {"action": action})
+            _emit_server(out, "srt_tuning_reverts_total",
+                         tun.get("actionsReverted", 0))
+            _emit_server(out, "srt_tuning_active_actions",
+                         tun.get("activeActions", 0))
+            _emit_server(out, "srt_tuning_pinned_actions",
+                         tun.get("pinnedActions", 0))
+            _emit_server(out, "srt_tuning_prewarmed_signatures",
+                         tun.get("prewarmedSignatures", 0))
     return out.text()
 
 

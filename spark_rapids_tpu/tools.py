@@ -488,7 +488,7 @@ def _main(argv: List[str]) -> int:
                     choices=["qualify", "profile", "docs", "trace",
                              "hotspots", "serve", "serve-client",
                              "lint", "top", "bench-diff", "soak",
-                             "history", "doctor"])
+                             "history", "doctor", "tuning"])
     ap.add_argument("sql", nargs="?", help="SQL text to analyze (live "
                     "mode; omit when using --log), the trace "
                     "file/directory for the trace/hotspots commands, "
@@ -526,8 +526,24 @@ def _main(argv: List[str]) -> int:
                     "number of seconds ago (e.g. 3600) or an ISO "
                     "timestamp (2026-08-04T12:00)")
     ap.add_argument("--history", default=None,
-                    help="doctor: the query-history directory "
+                    help="doctor/tuning: the query-history directory "
                     "(spark.rapids.sql.telemetry.history.dir)")
+    ap.add_argument("--signature", default=None,
+                    help="history: restrict the report to one "
+                    "signature digest (full 40-hex or a prefix)")
+    ap.add_argument("--all", action="store_true",
+                    help="doctor: batch mode — diagnose every "
+                    "signature's newest record and rank regressions "
+                    "worst-first (--top rows)")
+    ap.add_argument("--pin", type=int, default=None, metavar="EPOCH",
+                    help="tuning: pin the action (exempt from the "
+                    "guardrail's auto-revert)")
+    ap.add_argument("--unpin", type=int, default=None, metavar="EPOCH",
+                    help="tuning: clear the pin")
+    ap.add_argument("--revert", type=int, default=None, metavar="EPOCH",
+                    help="tuning: request a rollback — the controller "
+                    "honors it at its next tick (or skips the action "
+                    "at the next server start)")
     ap.add_argument("--stats", action="store_true",
                     help="serve-client: print server stats instead of "
                     "running SQL")
@@ -623,6 +639,8 @@ def _main(argv: List[str]) -> int:
         return _history_main(args, ap)
     if args.command == "doctor":
         return _doctor_main(args, ap)
+    if args.command == "tuning":
+        return _tuning_main(args, ap)
 
     if args.command == "soak":
         # chaos soak harness (docs/serving.md "Query lifecycle"):
@@ -718,8 +736,10 @@ def _main(argv: List[str]) -> int:
             f.write(generate_supported_ops())
         with open(os.path.join(args.out, "observability.md"), "w") as f:
             f.write(generate_observability_docs())
-        print(f"wrote {args.out}/configs.md, {args.out}/supported_ops.md "
-              f"and {args.out}/observability.md")
+        with open(os.path.join(args.out, "tuning.md"), "w") as f:
+            f.write(generate_tuning_docs())
+        print(f"wrote {args.out}/configs.md, {args.out}/supported_ops.md, "
+              f"{args.out}/observability.md and {args.out}/tuning.md")
         return 0
 
     if args.log:
@@ -784,7 +804,17 @@ def _history_main(args, ap) -> int:
         print(f"no such history file or directory: {path}")
         return 1
     since = _parse_since(args.since, ap) if args.since else None
-    records = read_records(path, since=since, tenant=args.tenant)
+    sig = getattr(args, "signature", None)
+    if sig and len(sig) == 40:
+        # full digest: push the filter into the reader
+        records = read_records(path, since=since, tenant=args.tenant,
+                               signature=sig)
+    else:
+        records = read_records(path, since=since, tenant=args.tenant)
+        if sig:
+            # display prefix (tools print 12-hex): prefix-match here
+            records = [r for r in records
+                       if str(r.get("signature", "")).startswith(sig)]
     if args.json:
         print(_json.dumps({
             "records": len(records),
@@ -805,18 +835,69 @@ def _doctor_main(args, ap) -> int:
 
     from spark_rapids_tpu.telemetry.doctor import (diagnose,
                                                    format_diagnosis)
-    if not args.sql:
-        ap.error("doctor requires a queryId or signature selector")
+    if not args.sql and not args.all:
+        ap.error("doctor requires a queryId or signature selector "
+                 "(or --all for the batch scan)")
     if not args.history:
         ap.error("doctor requires --history <dir> "
                  "(spark.rapids.sql.telemetry.history.dir output)")
     if not os.path.exists(args.history):
         print(f"no such history file or directory: {args.history}")
         return 1
+    if args.all:
+        # batch mode: every signature's newest record diagnosed
+        # against its own baseline, worst regression first
+        from spark_rapids_tpu.telemetry.doctor import (format_scan,
+                                                       scan_signatures)
+        scans = scan_signatures(args.history, top=max(args.top, 1))
+        print(_json.dumps(scans, indent=2, default=str) if args.json
+              else format_scan(scans))
+        return 0
     d = diagnose(args.history, args.sql)
     print(_json.dumps(d, indent=2, default=str) if args.json
           else format_diagnosis(d))
     return 1 if d.get("error") else 0
+
+
+def _tuning_main(args, ap) -> int:
+    """`tools tuning --history <dir>`: inspect the TuningController's
+    action ledger; --pin/--unpin/--revert write control flags into the
+    state file, which the controller honors at its next tick (or at
+    the next server start) — the CLI never races the live server's
+    knob writes (docs/tuning.md). Exit 0 on a rendered report, 1 when
+    the directory or the epoch does not resolve."""
+    import json as _json
+    import os
+
+    from spark_rapids_tpu.telemetry.tuning import (format_tuning,
+                                                   load_state,
+                                                   save_state)
+    path = args.sql or args.history
+    if not path:
+        ap.error("tuning requires the history directory "
+                 "(spark.rapids.sql.telemetry.history.dir output)")
+    if not os.path.isdir(path):
+        print(f"no such history directory: {path}")
+        return 1
+    state = load_state(path)
+    edits = [(args.pin, "pinned", True), (args.unpin, "pinned", False),
+             (args.revert, "revertRequested", True)]
+    for epoch, field, value in edits:
+        if epoch is None:
+            continue
+        hit = next((a for a in state.get("actions", [])
+                    if int(a.get("epoch", -1)) == epoch), None)
+        if hit is None:
+            print(f"no tuning action with epoch {epoch}")
+            return 1
+        hit[field] = value
+        save_state(path, state)
+        print(f"epoch {epoch}: {field} = {value}")
+    if args.json:
+        print(_json.dumps(state, indent=2, default=str))
+        return 0
+    print(format_tuning(state))
+    return 0
 
 
 def _bench_diff_main(args, ap) -> int:
@@ -1370,12 +1451,17 @@ def generate_observability_docs() -> str:
         "",
         "### `tools history`",
         "",
-        "`tools history <dir> [--since N|ISO] [--tenant T] [--json]`",
-        "renders the store as a per-signature table (count, wall",
-        "p50/p99, trend slope in seconds-of-wall per hour-of-history,",
-        "retry/fallback rates, status histogram, tenants) plus a",
-        "per-tenant rollup. An empty store is a normal answer (exit",
-        "0); a missing path exits 1.",
+        "`tools history <dir> [--since N|ISO] [--tenant T]",
+        "[--signature D] [--json]` renders the store as a",
+        "per-signature table (count, wall p50/p99, trend slope in",
+        "seconds-of-wall per hour-of-history, retry/fallback rates,",
+        "status histogram, tenants) plus a per-tenant rollup.",
+        "`--signature` restricts the report to one signature digest —",
+        "the full 40-hex form is pushed into the reader's",
+        "`read_records(signature=)` filter, a shorter prefix (the",
+        "12-hex display form the tools print) matches by prefix. An",
+        "empty store is a normal answer (exit 0); a missing path",
+        "exits 1.",
         "",
         "### `tools doctor`",
         "",
@@ -1386,7 +1472,13 @@ def generate_observability_docs() -> str:
         "finished records of the same shape), diffs per-stage",
         "self-times stage by stage (profile time metrics aggregated by",
         "stage key — `retryBlockTime` -> `retryBlock`), and emits a",
-        "ranked verdict with evidence lines. The verdict taxonomy:",
+        "ranked verdict with evidence lines. `tools doctor --all",
+        "--history <dir> [--top N]` is the batch mode: every",
+        "signature's NEWEST finished record is diagnosed against its",
+        "own baseline in one store read, ranked regressed-first then",
+        "by slowdown — the triage view after a bad deploy (the",
+        "TuningController's scan tick runs the same walk,",
+        "docs/tuning.md). The verdict taxonomy:",
         "",
         "| Verdict | Meaning |",
         "|---|---|",
@@ -1406,7 +1498,27 @@ def generate_observability_docs() -> str:
         "when a gating check regressed; bench.py runs it against the",
         "previous BENCH_r0*.json every round (`detail.telemetry.",
         "benchDiff`). Informational checks (CPU-engine wall, retry",
-        "counters) report but never gate.",
+        "counters, the `detail.tuning.*` feedback-control legs) report",
+        "but never gate.",
+        "",
+        "### Self-tuning (`tools tuning`)",
+        "",
+        "`spark.rapids.sql.serve.tuning.enabled` closes the",
+        "observe-diagnose-act loop: the server embeds a",
+        "TuningController that scores the query history through the",
+        "aggregate + doctor pipeline at start and on a periodic tick",
+        "and applies bounded, logged, reversible actions from the",
+        "declared ACTION_CATALOG — see docs/tuning.md for the action",
+        "table, the guardrail/rollback state machine, and the",
+        "pin/revert workflow. Every action lands in the history store",
+        "as a `tuning` record (rollbacks as `revert`); both statuses",
+        "are control-plane records EXCLUDED from signature aggregates,",
+        "SLO windows, doctor baselines, and warm-start replay, so the",
+        "controller's own audit trail never moves the statistics it",
+        "steers by. Controller state exports as the `srt_tuning_*`",
+        "families above; `tools tuning --history <dir>` renders the",
+        "action ledger, `--pin/--unpin/--revert <epoch>` write control",
+        "flags the controller honors at its next tick.",
         "",
         "### Span catalog",
         "",
@@ -1450,6 +1562,150 @@ def generate_observability_docs() -> str:
     lines += ["", "| Constant | Metric key |", "|---|---|"]
     for const, name in metric_name_constants():
         lines.append(f"| {const} | `{name}` |")
+    return "\n".join(lines) + "\n"
+
+
+def generate_tuning_docs() -> str:
+    """docs/tuning.md generator (`python -m spark_rapids_tpu.tools
+    docs`): the feedback-control loop, the action catalog rendered
+    LIVE from ACTION_CATALOG (so docs cannot drift from the declared
+    vocabulary), the guardrail state machine, and the operator
+    pin/revert workflow."""
+    from spark_rapids_tpu import conf as C
+    from spark_rapids_tpu.telemetry.tuning import ACTION_CATALOG
+    lines = [
+        "# Self-tuning: history-driven feedback control",
+        "",
+        "Generated by `python -m spark_rapids_tpu.tools docs`.",
+        "",
+        "`spark.rapids.sql.serve.tuning.enabled` (requires",
+        "`spark.rapids.sql.telemetry.history.dir`) embeds a",
+        "**TuningController** in the query server. At server start and",
+        "every `serve.tuning.intervalS` seconds it scores the",
+        "persistent query history through the `signature_aggregates` +",
+        "doctor-verdict pipeline (the same walk `tools doctor --all`",
+        "runs) and applies per-signature actions from the declared",
+        "catalog below. Tuning never changes what a query COMPUTES —",
+        "only admission shaping, cache residency, and kernel-tier",
+        "routing, all bit-identity-preserving by their own contracts",
+        "(tier-1 asserts results are identical with tuning on vs",
+        "off).",
+        "",
+        "Every action is:",
+        "",
+        "- **bounded** — per-knob min/max clamps declared in the",
+        "  catalog; at most `serve.tuning.maxActionsPerTick` new",
+        "  actions per tick;",
+        "- **logged** — a `tuning` history record (action, scope,",
+        "  knob, old->new value, evidence, epoch) in the same store as",
+        "  query records; rollbacks log a `revert` record. Both are",
+        "  control-plane statuses EXCLUDED from aggregates, SLO",
+        "  windows, doctor baselines, and warm-start replay;",
+        "- **exported** — the `srt_tuning_*` Prometheus families",
+        "  (ticks, actions by name, reverts, active/pinned counts,",
+        "  pre-warmed signatures);",
+        "- **inspectable and reversible** — `tools tuning` below;",
+        "- **guarded** — the post-action baseline is watched and the",
+        "  action auto-reverts on regression (state machine below).",
+        "",
+        "## Action catalog",
+        "",
+        "Rendered from `telemetry.tuning.ACTION_CATALOG` — the",
+        "tpu-lint `tuning-action` rule pins every action the",
+        "controller constructs to this table, and every",
+        "`spark.rapids.*` knob in it to a registered conf key.",
+        "Internal knobs (`signatureConcurrency`, `tenantWeight`,",
+        "`prewarm`) actuate the admission controller and the pre-warm",
+        "ledger directly.",
+        "",
+        "| Action | Trigger verdict | Knob | Bounds | What it does |",
+        "|---|---|---|---|---|",
+    ]
+    for name, cat in sorted(ACTION_CATALOG.items()):
+        knobs = cat.get("knobs", [cat["knob"]])
+        knob_s = " / ".join(f"`{k}`" for k in knobs)
+        lines.append(
+            f"| `{name}` | {cat['verdict']} | {knob_s} | "
+            f"[{cat['min']}, {cat['max']}] | {cat['doc']} |")
+    lines += [
+        "",
+        "## Guardrail / rollback state machine",
+        "",
+        "Each applied action captures the pre-action p50/p99 baseline",
+        "of its scope (a signature digest, or `tenant:<id>`) in its",
+        "evidence. States:",
+        "",
+        "```",
+        "            apply                      window fills, no",
+        " (decided) -------> applied ---------> regression: accepted",
+        "                      |  \\",
+        "                      |   \\ tools tuning --revert",
+        "                      |    \\ (honored at next tick)",
+        "   guardrail:         |     v",
+        "   p50/p99 regressed  +--> reverted  (a `revert` record",
+        "   past threshold            logs old value restored)",
+        "```",
+        "",
+        "- once `serve.tuning.guardWindowQueries` post-action",
+        "  finished records exist for the scope (cache-served and",
+        "  control-plane records excluded), the controller computes",
+        "  `change = (baseline - observed) / baseline` for p50 and",
+        "  p99 — the same relative-change discipline `tools",
+        "  bench-diff` gates on;",
+        "- `change < -serve.tuning.revertThreshold` on either",
+        "  percentile auto-reverts: the knob's old value is restored",
+        "  and a `revert` record lands with the observed window as",
+        "  evidence;",
+        "- otherwise the action graduates to **accepted** (still",
+        "  manually revertible);",
+        "- **pinned** actions are exempt from auto-revert;",
+        "- `kernelFallback` is accepted at birth: the conf flip",
+        "  changes the plan signature (kernel.*.enabled is",
+        "  signature-relevant), so the new shape RE-BASELINES under",
+        "  its own history and the old scope's window can never fill",
+        "  — manual revert only.",
+        "",
+        "Applied/accepted actions persist in",
+        "`<history.dir>/tuning-state.json` and re-actuate at the next",
+        "server start: a retry-storm shape admitted narrowly today is",
+        "admitted narrowly tomorrow, and the pre-warm ledger's",
+        "recorded SQL replays through the planning path before the",
+        "first client request.",
+        "",
+        "## Fault injection (`site:tuning:N`)",
+        "",
+        "`spark.rapids.sql.test.injectOOM=site:tuning:N` makes the Nth",
+        "controller tick apply a deliberately HARMFUL synthetic action",
+        "(a concurrency clamp recorded against an epsilon baseline),",
+        "so the observe-and-revert loop is deterministically testable",
+        "end to end — the injected action must auto-revert within the",
+        "guard window, visible in `tools tuning`, the history store,",
+        "and the `srt_tuning_*` families.",
+        "",
+        "## Operator workflow (`tools tuning`)",
+        "",
+        "```",
+        "tools tuning --history <dir>            # the action ledger",
+        "tools tuning --history <dir> --json     # machine-readable",
+        "tools tuning --history <dir> --pin 7    # exempt from revert",
+        "tools tuning --history <dir> --unpin 7",
+        "tools tuning --history <dir> --revert 7 # request rollback",
+        "```",
+        "",
+        "Pin/revert write control flags into the STATE FILE, not the",
+        "live server: the controller merges them at its next tick (a",
+        "revert request on a stopped server simply skips the action at",
+        "the next start), so the CLI never races the controller's own",
+        "knob writes.",
+        "",
+        "## Configuration",
+        "",
+        "| Key | Default | Description |",
+        "|---|---|---|",
+    ]
+    for e in sorted(C.registered_entries(), key=lambda e: e.key):
+        if e.key.startswith("spark.rapids.sql.serve.tuning."):
+            lines.append(f"| {e.key} | {e.default} | {e.doc} |")
     return "\n".join(lines) + "\n"
 
 
